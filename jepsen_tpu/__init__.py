@@ -1,0 +1,28 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+A ground-up rebuild of the capabilities of Jepsen (the reference lives at
+/root/reference): a control-plane harness that drives concurrent clients
+against a system under test while a nemesis injects faults, records an
+operation *history*, and then analyzes that history for consistency
+violations.  The analysis phase — classically an exponential search run on
+a JVM ("knossos") — is reformulated here as batched JAX/TPU kernels:
+
+  * linearizability  -> frontier-batched WGL search (ops/wgl.py)
+  * cycle anomalies  -> adjacency-matrix SCC via bool matmul (ops/cycle.py)
+  * commutative folds-> masked segmented reductions (ops/fold.py)
+  * many keys        -> vmap/pjit over padded per-key histories (independent.py)
+
+Layer map (mirrors SURVEY.md §1):
+  L0 control/      remote execution (SSH + dummy transport)
+  L1 os_setup/, db internals provisioning + DB lifecycle protocols
+  L2 nemesis, net  fault injection
+  L3 client, generator, workloads
+  L4 history, store persistence
+  L5 core          orchestration (run / analyze)
+  L6 checker/      analysis — the TPU surface
+  L7 cli, web      user interface
+"""
+
+__version__ = "0.1.0"
+
+from jepsen_tpu.history import Op, History  # noqa: F401

@@ -1,0 +1,343 @@
+"""Operation histories: the core data structure of the framework.
+
+A history is an ordered sequence of *operations*.  Each op is a small
+record — equivalent to the reference's Clojure maps
+(`jepsen/src/jepsen/util.clj:146-206`, `jepsen/src/jepsen/core.clj:55-59`)
+— with fields:
+
+  index    monotone position in the history (knossos.history/index)
+  process  logical single-threaded actor id (int), or NEMESIS
+  type     one of invoke | ok | fail | info
+  f        operation function tag (e.g. 'read, 'write, 'cas) — any hashable
+  value    op payload; for reads the invoke carries None and the completion
+           carries the observed value
+  time     relative nanoseconds since test start
+  error    optional error payload on non-ok completions
+
+On the device side a history becomes a *columnar* struct-of-arrays
+(`pack()`), replacing the map-per-op vectors: int32/int64 arrays that JAX
+kernels consume directly.  See SURVEY.md §7 (history core + op codec).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+# Sentinel process id for the nemesis (the reference uses the keyword
+# :nemesis; we reserve a negative int so process columns stay integral).
+NEMESIS = -1
+
+INVOKE, OK, FAIL, INFO = "invoke", "ok", "fail", "info"
+TYPES = (INVOKE, OK, FAIL, INFO)
+TYPE_CODE = {t: i for i, t in enumerate(TYPES)}
+CODE_TYPE = {i: t for t, i in TYPE_CODE.items()}
+
+
+@dataclasses.dataclass
+class Op:
+    """One operation record.  Mutable by design: the worker loop assigns
+    :index/:time/:process as ops flow through it, like the reference's
+    `assoc` chain (`core.clj:306-308`)."""
+
+    process: Any = None
+    type: str = INVOKE
+    f: Any = None
+    value: Any = None
+    time: Optional[int] = None
+    index: Optional[int] = None
+    error: Any = None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    # -- dict-ish ergonomics -------------------------------------------------
+    def __getitem__(self, k):
+        if k in self.__dataclass_fields__ and k != "extra":
+            return getattr(self, k)
+        return self.extra[k]
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __contains__(self, k):
+        if k in ("process", "type", "f", "value", "time", "index", "error"):
+            return getattr(self, k) is not None
+        return k in self.extra
+
+    def assoc(self, **kw) -> "Op":
+        """Functional update: returns a copy with fields replaced."""
+        known = {k: v for k, v in kw.items()
+                 if k in self.__dataclass_fields__ and k != "extra"}
+        extra = dict(self.extra)
+        extra.update({k: v for k, v in kw.items() if k not in known})
+        return dataclasses.replace(self, extra=extra, **known)
+
+    # -- predicates (knossos.op parity: invoke? ok? fail? info?) -------------
+    @property
+    def is_invoke(self):
+        return self.type == INVOKE
+
+    @property
+    def is_ok(self):
+        return self.type == OK
+
+    @property
+    def is_fail(self):
+        return self.type == FAIL
+
+    @property
+    def is_info(self):
+        return self.type == INFO
+
+    def to_dict(self) -> dict:
+        d = {"index": self.index, "process": self.process, "type": self.type,
+             "f": self.f, "value": self.value, "time": self.time}
+        if self.error is not None:
+            d["error"] = self.error
+        d.update(self.extra)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Op":
+        d = dict(d)
+        kw = {k: d.pop(k) for k in
+              ("process", "type", "f", "value", "time", "index", "error")
+              if k in d}
+        return cls(extra=d, **kw)
+
+    def __str__(self):
+        err = f"\t{self.error}" if self.error is not None else ""
+        return f"{self.process}\t{self.type}\t{self.f}\t{self.value}{err}"
+
+
+# Convenience constructors (knossos.core/{invoke-op, ok-op, fail-op} parity —
+# used heavily by the reference's checker tests, checker_test.clj:5-7).
+def invoke_op(process, f, value, **kw):
+    return Op(process=process, type=INVOKE, f=f, value=value, **kw)
+
+
+def ok_op(process, f, value, **kw):
+    return Op(process=process, type=OK, f=f, value=value, **kw)
+
+
+def fail_op(process, f, value, **kw):
+    return Op(process=process, type=FAIL, f=f, value=value, **kw)
+
+
+def info_op(process, f, value, **kw):
+    return Op(process=process, type=INFO, f=f, value=value, **kw)
+
+
+def op(like: Any) -> Op:
+    """Coerce a dict or Op to an Op."""
+    if isinstance(like, Op):
+        return like
+    return Op.from_dict(like)
+
+
+class History:
+    """An indexed list of Ops with the analysis passes the reference gets
+    from knossos.history: `index`, `complete`, `pairs`, `processes`."""
+
+    def __init__(self, ops: Iterable[Any] = ()):
+        self.ops: list[Op] = [op(o) for o in ops]
+
+    def __len__(self):
+        return len(self.ops)
+
+    def __iter__(self) -> Iterator[Op]:
+        return iter(self.ops)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return History(self.ops[i])
+        return self.ops[i]
+
+    def append(self, o: Any) -> "Op":
+        o = op(o)
+        self.ops.append(o)
+        return o
+
+    # -- passes --------------------------------------------------------------
+    def index(self) -> "History":
+        """Assign sequential :index to every op (knossos.history/index,
+        called at jepsen.core/analyze! core.clj:441)."""
+        for i, o in enumerate(self.ops):
+            o.index = i
+        return self
+
+    def processes(self) -> list:
+        return sorted({o.process for o in self.ops},
+                      key=lambda p: (isinstance(p, str), p))
+
+    def pairs(self) -> list[tuple[Op, Optional[Op]]]:
+        """Pair each invocation with its completion (timeline.clj:33-56).
+        Completion is None for ops that never completed.  Nemesis ops
+        (non-invoke-first) pair (op, None)."""
+        out = []
+        open_by_process: dict[Any, Op] = {}
+        for o in self.ops:
+            if o.is_invoke:
+                if o.process in open_by_process:
+                    raise ValueError(
+                        f"process {o.process} invoked twice without completing: {o}")
+                open_by_process[o.process] = o
+            else:
+                inv = open_by_process.pop(o.process, None)
+                if inv is not None:
+                    out.append((inv, o))
+                else:
+                    out.append((o, None))
+        for inv in open_by_process.values():
+            out.append((inv, None))
+        out.sort(key=lambda p: (p[0].index if p[0].index is not None else 0))
+        return out
+
+    def complete(self) -> "History":
+        """Fill in invocation values from completions (knossos.history/complete,
+        used by checker/counter checker.clj:696): an ok completion of a read
+        back-fills the invocation's observed value; invocations whose op
+        crashed are marked info."""
+        out = []
+        open_by_process: dict[Any, Op] = {}
+        for o in self.ops:
+            o = dataclasses.replace(o, extra=dict(o.extra))
+            if o.is_invoke:
+                open_by_process[o.process] = o
+            elif o.process in open_by_process:
+                inv = open_by_process.pop(o.process)
+                if o.is_ok and inv.value is None:
+                    inv.value = o.value
+                if o.is_info:
+                    inv.type = INFO
+            out.append(o)
+        return History(out)
+
+    # -- persistence ---------------------------------------------------------
+    def to_jsonl(self) -> str:
+        return "\n".join(json.dumps(o.to_dict(), default=repr)
+                         for o in self.ops) + ("\n" if self.ops else "")
+
+    @classmethod
+    def from_jsonl(cls, text: str) -> "History":
+        return cls(json.loads(line) for line in text.splitlines() if line.strip())
+
+    def to_dicts(self) -> list[dict]:
+        return [o.to_dict() for o in self.ops]
+
+    # -- device packing ------------------------------------------------------
+    def pack(self, f_codes: Optional[dict] = None,
+             value_encoder: Optional[Callable[[Op], tuple[int, int]]] = None,
+             ) -> "PackedHistory":
+        return pack_history(self, f_codes, value_encoder)
+
+
+@dataclasses.dataclass
+class PackedHistory:
+    """Columnar device representation of a history (SURVEY.md §2.5:
+    "history transport to device").  Two int64 value slots cover every
+    built-in workload (cas carries [old, new]); richer payloads stay
+    host-side.  value_ok marks slots that held encodable (integer) values.
+    """
+
+    index: np.ndarray       # int32 [n]
+    process: np.ndarray     # int32 [n]  (NEMESIS == -1)
+    type: np.ndarray        # uint8 [n]  TYPE_CODE
+    f: np.ndarray           # int32 [n]  per-test f-code table
+    value: np.ndarray       # int64 [n, 2]
+    value_ok: np.ndarray    # bool  [n, 2]
+    time: np.ndarray        # int64 [n]
+    f_codes: dict           # f tag -> code
+
+    def __len__(self):
+        return len(self.index)
+
+    def unpack_op(self, i: int) -> Op:
+        codes_f = {v: k for k, v in self.f_codes.items()}
+        val: Any = None
+        if self.value_ok[i, 0] and self.value_ok[i, 1]:
+            val = [int(self.value[i, 0]), int(self.value[i, 1])]
+        elif self.value_ok[i, 0]:
+            val = int(self.value[i, 0])
+        proc = int(self.process[i])
+        return Op(index=int(self.index[i]), process=proc,
+                  type=CODE_TYPE[int(self.type[i])],
+                  f=codes_f.get(int(self.f[i])), value=val,
+                  time=int(self.time[i]))
+
+
+def default_value_encoder(o: Op) -> tuple[list[int], list[bool]]:
+    """Encode an op value into two int64 slots.  ints -> slot 0;
+    [a, b] pairs (cas) -> both slots; None/other -> marked not-ok."""
+    v = o.value
+    if isinstance(v, bool):  # bool is an int subclass; keep it encodable
+        return [int(v), 0], [True, False]
+    if isinstance(v, int):
+        return [v, 0], [True, False]
+    if (isinstance(v, (list, tuple)) and len(v) == 2
+            and all(isinstance(x, int) and not isinstance(x, bool) for x in v)):
+        return [v[0], v[1]], [True, True]
+    return [0, 0], [False, False]
+
+
+def pack_history(h: History, f_codes: Optional[dict] = None,
+                 value_encoder=None) -> PackedHistory:
+    value_encoder = value_encoder or default_value_encoder
+    if f_codes is None:
+        f_codes = {}
+        for o in h:
+            if o.f not in f_codes:
+                f_codes[o.f] = len(f_codes)
+    n = len(h)
+    index = np.zeros(n, np.int32)
+    process = np.zeros(n, np.int32)
+    typ = np.zeros(n, np.uint8)
+    f = np.zeros(n, np.int32)
+    value = np.zeros((n, 2), np.int64)
+    value_ok = np.zeros((n, 2), bool)
+    time = np.zeros(n, np.int64)
+    for i, o in enumerate(h):
+        index[i] = o.index if o.index is not None else i
+        p = o.process
+        process[i] = NEMESIS if not isinstance(p, int) or isinstance(p, bool) else p
+        typ[i] = TYPE_CODE[o.type]
+        f[i] = f_codes.get(o.f, -1)
+        (value[i, 0], value[i, 1]), (value_ok[i, 0], value_ok[i, 1]) = \
+            value_encoder(o)
+        time[i] = o.time if o.time is not None else 0
+    return PackedHistory(index, process, typ, f, value, value_ok, time,
+                         dict(f_codes))
+
+
+def history_latencies(h: History) -> list[tuple[Op, float]]:
+    """(completed-invocation, latency-ns) pairs for client ops;
+    util.clj:598-632."""
+    out = []
+    for inv, comp in History(h).pairs():
+        if (comp is not None and inv.time is not None
+                and comp.time is not None and isinstance(inv.process, int)
+                and inv.process >= 0):
+            out.append((inv.assoc(completion=comp), comp.time - inv.time))
+    return out
+
+
+def nemesis_intervals(h: History) -> list[tuple[Optional[Op], Optional[Op]]]:
+    """Start/stop op pairs for nemesis activity windows (util.clj:634)."""
+    out = []
+    start = None
+    for o in h:
+        if o.process != NEMESIS and o.process != "nemesis":
+            continue
+        if o.f == "start" and o.is_invoke and start is None:
+            start = o
+        elif o.f == "stop" and not o.is_invoke and start is not None:
+            out.append((start, o))
+            start = None
+    if start is not None:
+        out.append((start, None))
+    return out

@@ -1,0 +1,316 @@
+"""Consistency models: pure state machines that judge single operations.
+
+Equivalent of knossos.model (the reference consumes it at
+`jepsen/src/jepsen/checker.clj:17-23` and documents the protocol in
+`doc/tutorial/04-checker.md:40-64`): a Model has one operation,
+`step(op) -> Model' | Inconsistent`.
+
+Every model here is **immutable and hashable** — the CPU oracle memoizes
+(mask, model) configurations.  Models that want the TPU linearizability
+kernel additionally provide a `DeviceSpec`: an integer state vector
+encoding plus a pure JAX transition `step(state, f, a, b, a_ok) ->
+(state', legal)`.  Rich host-side models without a spec fall back to the
+CPU search automatically (SURVEY.md §7 "Model-state generality").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class Inconsistent:
+    """Returned by step() when the op cannot legally apply."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def __repr__(self):
+        return f"Inconsistent({self.msg!r})"
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+def is_inconsistent(x) -> bool:
+    return isinstance(x, Inconsistent)
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Integer encoding of a model for the TPU WGL kernel.
+
+    state_size : words in the int32 state vector
+    f_codes    : f tag -> small int used by step
+    encode     : model -> np.int32[state_size] initial state
+    step       : jax fn (state i32[S], f i32, a i64, b i64, a_ok bool)
+                 -> (state' i32[S], legal bool).  Must be jit/vmap-safe.
+    """
+
+    state_size: int
+    f_codes: dict
+    encode: Callable[[Any], np.ndarray]
+    step: Callable
+
+
+class Model:
+    def step(self, op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    def device_spec(self) -> Optional[DeviceSpec]:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Register / CAS register
+# ---------------------------------------------------------------------------
+
+_REG_F = {"read": 0, "write": 1, "cas": 2}
+
+
+def _register_step(state, f, a, b, a_ok):
+    """Shared device transition for register & cas-register.
+    state: i32[1].  read -> legal iff unknown-value or state==a;
+    write -> state'=a; cas -> legal iff state==a, state'=b."""
+    import jax.numpy as jnp
+    cur = state[0]
+    a32 = a.astype(jnp.int32)
+    b32 = b.astype(jnp.int32)
+    is_read = f == 0
+    is_write = f == 1
+    is_cas = f == 2
+    legal = jnp.where(is_read, jnp.logical_or(~a_ok, cur == a32),
+                      jnp.where(is_cas, cur == a32, True))
+    new = jnp.where(is_write, a32, jnp.where(is_cas, b32, cur))
+    return jnp.where(legal, new, cur)[None], legal
+
+
+@dataclasses.dataclass(frozen=True)
+class CASRegister(Model):
+    """A register supporting read/write/cas — knossos.model/cas-register,
+    the model behind `checker/linearizable` register workloads
+    (`tests/linearizable_register.clj:33`, `etcd/src/jepsen/etcd.clj:157`).
+    """
+
+    value: Optional[int] = None
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"read {v!r} but register holds {self.value!r}")
+        if f == "write":
+            return CASRegister(v)
+        if f == "cas":
+            old, new = v
+            if self.value != old:
+                return inconsistent(f"cas {old!r}->{new!r} but register holds "
+                                    f"{self.value!r}")
+            return CASRegister(new)
+        return inconsistent(f"unknown f {f!r}")
+
+    def device_spec(self):
+        none_code = -(2 ** 31)  # encodes value=None; no workload writes it
+
+        def encode(m):
+            return np.array(
+                [none_code if m.value is None else m.value], np.int32)
+
+        return DeviceSpec(1, dict(_REG_F), encode, _register_step)
+
+
+@dataclasses.dataclass(frozen=True)
+class Register(Model):
+    """read/write register — knossos.model/register."""
+
+    value: Optional[int] = None
+
+    def step(self, op):
+        f, v = op.f, op.value
+        if f == "read":
+            if v is None or v == self.value:
+                return self
+            return inconsistent(f"read {v!r} but register holds {self.value!r}")
+        if f == "write":
+            return Register(v)
+        return inconsistent(f"unknown f {f!r}")
+
+    def device_spec(self):
+        none_code = -(2 ** 31)
+
+        def encode(m):
+            return np.array(
+                [none_code if m.value is None else m.value], np.int32)
+
+        return DeviceSpec(1, dict(_REG_F), encode, _register_step)
+
+
+# ---------------------------------------------------------------------------
+# Mutex
+# ---------------------------------------------------------------------------
+
+_MUTEX_F = {"acquire": 0, "release": 1}
+
+
+def _mutex_step(state, f, a, b, a_ok):
+    import jax.numpy as jnp
+    locked = state[0] != 0
+    want = f == 0  # acquire
+    legal = jnp.where(want, ~locked, locked)
+    new = jnp.where(legal, jnp.where(want, 1, 0), state[0])
+    return new[None].astype(jnp.int32), legal
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutex(Model):
+    """knossos.model/mutex: acquire/release."""
+
+    locked: bool = False
+
+    def step(self, op):
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire a held mutex")
+            return Mutex(True)
+        if op.f == "release":
+            if not self.locked:
+                return inconsistent("cannot release a free mutex")
+            return Mutex(False)
+        return inconsistent(f"unknown f {op.f!r}")
+
+    def device_spec(self):
+        return DeviceSpec(1, dict(_MUTEX_F),
+                          lambda m: np.array([int(m.locked)], np.int32),
+                          _mutex_step)
+
+
+# ---------------------------------------------------------------------------
+# NoOp
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class NoOp(Model):
+    """knossos.model/noop: accepts everything (tests.clj:24)."""
+
+    def step(self, op):
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Queues
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class UnorderedQueue(Model):
+    """knossos.model/unordered-queue: a multiset; dequeue of an absent
+    element is inconsistent (used by checker/queue, checker.clj:160)."""
+
+    items: tuple = ()  # sorted multiset as tuple
+
+    def step(self, op):
+        if op.f == "enqueue":
+            return UnorderedQueue(tuple(sorted(self.items + (op.value,),
+                                               key=repr)))
+        if op.f == "dequeue":
+            if op.value in self.items:
+                items = list(self.items)
+                items.remove(op.value)
+                return UnorderedQueue(tuple(items))
+            return inconsistent(f"can't dequeue {op.value!r}: not present")
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class FIFOQueue(Model):
+    """knossos.model/fifo-queue."""
+
+    items: tuple = ()
+
+    def step(self, op):
+        if op.f == "enqueue":
+            return FIFOQueue(self.items + (op.value,))
+        if op.f == "dequeue":
+            if not self.items:
+                return inconsistent("can't dequeue an empty queue")
+            if self.items[0] != op.value:
+                return inconsistent(
+                    f"dequeued {op.value!r} but head was {self.items[0]!r}")
+            return FIFOQueue(self.items[1:])
+        return inconsistent(f"unknown f {op.f!r}")
+
+
+# ---------------------------------------------------------------------------
+# Multi-register (knossos.model/multi-register): txn reads/writes over a
+# fixed small set of keys; op value is a list of [f, k, v] micro-ops.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MultiRegister(Model):
+    registers: tuple = ()  # tuple of (key, value) pairs, sorted
+
+    def as_dict(self):
+        return dict(self.registers)
+
+    def step(self, op):
+        regs = self.as_dict()
+        txn = op.value or []
+        for micro in txn:
+            mf, k, v = micro
+            if mf in ("r", "read"):
+                if v is not None and regs.get(k) != v:
+                    return inconsistent(
+                        f"read {v!r} from {k!r} which holds {regs.get(k)!r}")
+            elif mf in ("w", "write"):
+                regs[k] = v
+            else:
+                return inconsistent(f"unknown micro-op {mf!r}")
+        return MultiRegister(tuple(sorted(regs.items(), key=repr)))
+
+
+# ---------------------------------------------------------------------------
+# Registry — string names usable from CLI / test maps
+# ---------------------------------------------------------------------------
+
+MODELS = {
+    "cas-register": CASRegister,
+    "register": Register,
+    "mutex": Mutex,
+    "noop": NoOp,
+    "unordered-queue": UnorderedQueue,
+    "fifo-queue": FIFOQueue,
+    "multi-register": MultiRegister,
+}
+
+
+def model(name: str, *args, **kw) -> Model:
+    return MODELS[name](*args, **kw)
+
+
+def cas_register(value=None):
+    return CASRegister(value)
+
+
+def register(value=None):
+    return Register(value)
+
+
+def mutex():
+    return Mutex()
+
+
+def noop():
+    return NoOp()
+
+
+def unordered_queue():
+    return UnorderedQueue()
+
+
+def fifo_queue():
+    return FIFOQueue()

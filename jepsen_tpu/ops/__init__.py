@@ -1,0 +1,8 @@
+"""Device kernels (JAX/TPU) and their CPU oracles.
+
+  prep.py     shared history -> call-record preprocessing
+  wgl_cpu.py  CPU just-in-time-linearization oracle (knossos-equivalent)
+  wgl.py      batched frontier WGL search on TPU — the centerpiece
+  fold.py     masked segmented reductions for O(n) checkers
+  cycle.py    dependency-graph reachability / SCC via bool matmul
+"""

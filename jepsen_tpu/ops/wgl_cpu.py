@@ -1,0 +1,104 @@
+"""CPU linearizability oracle: just-in-time linearization with memoization.
+
+This is the knossos-equivalent reference implementation (the reference
+delegates to knossos.linear / knossos.wgl at
+`jepsen/src/jepsen/checker.clj:141-145`).  It exists for three reasons:
+
+  1. differential testing of the TPU kernel (same history => same verdict);
+  2. the fallback path for rich host-side models with no DeviceSpec;
+  3. the "CPU knossos" baseline that bench.py measures speedups against.
+
+Algorithm (Lowe-style JIT linearization, equivalent to knossos :linear):
+walk history events in order keeping a set of *configurations*
+(frozenset-of-linearized-open-calls, model).  When a call returns, expand
+each configuration by linearizing pending calls until every surviving
+configuration contains the returning call; configurations that cannot are
+pruned.  If the set empties, the history is not linearizable and the
+current op is the witness.  Crashed (:info) calls stay pending forever and
+may be linearized at any later point or never
+(`doc/tutorial/06-refining.md:12-19`).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from jepsen_tpu.models import is_inconsistent
+from jepsen_tpu.ops.prep import PreparedHistory, prepare
+
+
+def check(model, history, *,
+          max_configs: int = 1_000_000,
+          time_limit: Optional[float] = None) -> dict[str, Any]:
+    """Returns a knossos-shaped analysis map:
+    {'valid?': True|False|'unknown', 'op_count', 'configs', 'final_model'?,
+     'op'? (witness), 'anomaly'?}."""
+    t0 = time.monotonic()
+    prep = history if isinstance(history, PreparedHistory) else prepare(history)
+    calls = prep.calls
+
+    configs: set[tuple[frozenset, Any]] = {(frozenset(), model)}
+    pending: set[int] = set()
+
+    for ev, kind, cid in prep.events:
+        if kind == 0:
+            pending.add(cid)
+            continue
+
+        # Return of call `cid`: close configurations over one-step
+        # linearizations of pending calls until all contain cid.
+        done: set[tuple[frozenset, Any]] = set()
+        frontier = configs
+        seen = set(configs)
+        while frontier:
+            if time_limit is not None and time.monotonic() - t0 > time_limit:
+                return {"valid?": "unknown", "cause": "timeout",
+                        "op_count": len(calls)}
+            nxt: set[tuple[frozenset, Any]] = set()
+            for mask, m in frontier:
+                if cid in mask:
+                    done.add((mask, m))
+                    continue
+                for j in pending:
+                    if j in mask:
+                        continue
+                    m2 = m.step(calls[j].op)
+                    if is_inconsistent(m2):
+                        continue
+                    c2 = (mask | {j}, m2)
+                    if c2 not in seen:
+                        seen.add(c2)
+                        nxt.add(c2)
+            if len(seen) > max_configs:
+                return {"valid?": "unknown", "cause": "config-explosion",
+                        "op_count": len(calls), "configs": len(seen)}
+            frontier = nxt
+
+        call = calls[cid]
+        if not done:
+            return {"valid?": False,
+                    "op": call.op.to_dict(),
+                    "op_index": call.op.index,
+                    "op_count": len(calls),
+                    "anomaly": "nonlinearizable",
+                    "configs": _render_configs(configs, calls)}
+        # cid's slot retires: drop it from masks (it is now linearized in
+        # every surviving configuration, so the bit carries no information).
+        pending.discard(cid)
+        configs = {(mask - {cid}, m) for mask, m in done}
+
+    return {"valid?": True, "op_count": len(calls),
+            "configs": _render_configs(configs, calls, limit=10)}
+
+
+def _render_configs(configs, calls, limit: int = 10):
+    """Human-readable configurations, truncated like the reference
+    (checker.clj:155-158: writing them all 'can take *hours*')."""
+    out = []
+    for mask, m in list(configs)[:limit]:
+        out.append({"model": m,
+                    "pending-linearized": sorted(
+                        calls[c].op.index for c in mask
+                        if calls[c].op.index is not None)})
+    return out

@@ -1,0 +1,24 @@
+"""Test configuration: run the suite on a virtual 8-device CPU platform so
+multi-chip sharding paths are exercised without TPU hardware (the driver
+dry-runs the real multi-chip path separately via __graft_entry__).
+
+This environment's sitecustomize registers an 'axon' TPU PJRT plugin in
+every interpreter and points platform selection at it; initializing that
+backend from inside pytest deadlocks on the device tunnel.  Overriding
+the jax_platforms *config* (which wins over the env var the plugin set)
+before the first backend initialization keeps everything on the virtual
+CPU mesh.  XLA_FLAGS is only read at backend init, so setting it here —
+after sitecustomize imported jax — still works.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

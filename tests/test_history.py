@@ -1,0 +1,90 @@
+import numpy as np
+
+from jepsen_tpu.history import (History, Op, invoke_op, ok_op, fail_op,
+                                info_op, NEMESIS, pack_history,
+                                history_latencies, nemesis_intervals)
+
+
+def test_op_dict_roundtrip():
+    o = Op(process=3, type="ok", f="read", value=5, time=123, index=7)
+    d = o.to_dict()
+    assert d["process"] == 3 and d["f"] == "read"
+    assert Op.from_dict(d) == o
+
+
+def test_op_assoc_and_extra():
+    o = invoke_op(0, "read", None)
+    o2 = o.assoc(time=9, note="hi")
+    assert o2.time == 9 and o2["note"] == "hi"
+    assert o.time is None  # original untouched
+
+
+def test_index_and_processes():
+    h = History([invoke_op(0, "w", 1), ok_op(0, "w", 1),
+                 invoke_op(1, "r", None)])
+    h.index()
+    assert [o.index for o in h] == [0, 1, 2]
+    assert h.processes() == [0, 1]
+
+
+def test_pairs():
+    h = History([invoke_op(0, "w", 1), invoke_op(1, "r", None),
+                 ok_op(1, "r", 1), ok_op(0, "w", 1)]).index()
+    pairs = h.pairs()
+    assert len(pairs) == 2
+    by_proc = {inv.process: (inv, comp) for inv, comp in pairs}
+    assert by_proc[0][1].f == "w"
+    assert by_proc[1][1].value == 1
+
+
+def test_pairs_unmatched_invoke():
+    h = History([invoke_op(0, "w", 1)]).index()
+    pairs = h.pairs()
+    assert pairs == [(h[0], None)]
+
+
+def test_complete_backfills_reads_and_info():
+    h = History([invoke_op(0, "read", None), ok_op(0, "read", 42),
+                 invoke_op(1, "write", 3), info_op(1, "write", 3)]).index()
+    c = h.complete()
+    assert c[0].value == 42
+    assert c[2].type == "info"
+
+
+def test_jsonl_roundtrip():
+    h = History([invoke_op(0, "cas", [1, 2], time=5),
+                 fail_op(0, "cas", [1, 2], time=9)]).index()
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert len(h2) == 2
+    assert h2[0].value == [1, 2]
+    assert h2[1].type == "fail"
+
+
+def test_pack_columnar():
+    h = History([invoke_op(0, "read", None), ok_op(0, "read", 7),
+                 invoke_op(1, "cas", [1, 2]),
+                 Op(process="nemesis", type="info", f="start")]).index()
+    p = pack_history(h)
+    assert len(p) == 4
+    assert p.process[3] == NEMESIS
+    assert p.value[1, 0] == 7 and p.value_ok[1, 0]
+    assert not p.value_ok[0, 0]            # None encodes as not-ok
+    assert list(p.value[2]) == [1, 2]
+    o = p.unpack_op(2)
+    assert o.f == "cas" and o.value == [1, 2]
+
+
+def test_latencies_and_nemesis_intervals():
+    h = History([
+        invoke_op(0, "read", None, time=100),
+        Op(process=NEMESIS, type="invoke", f="start", time=150),
+        ok_op(0, "read", 3, time=400),
+        Op(process=NEMESIS, type="info", f="start", time=160),
+        Op(process=NEMESIS, type="invoke", f="stop", time=500),
+        Op(process=NEMESIS, type="info", f="stop", time=510),
+    ]).index()
+    lats = history_latencies(h)
+    assert len(lats) == 1 and lats[0][1] == 300
+    ivals = nemesis_intervals(h)
+    assert len(ivals) == 1
+    assert ivals[0][0].time == 150 and ivals[0][1].time == 510

@@ -1,0 +1,115 @@
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import invoke_op as op_
+from jepsen_tpu.models import (CASRegister, Register, Mutex, NoOp,
+                               UnorderedQueue, FIFOQueue, MultiRegister,
+                               is_inconsistent, model)
+
+
+def step(m, f, v):
+    return m.step(op_(0, f, v))
+
+
+def test_cas_register():
+    m = CASRegister(0)
+    assert step(m, "read", 0) is m
+    assert is_inconsistent(step(m, "read", 1))
+    assert step(m, "write", 5).value == 5
+    assert step(m, "cas", [0, 3]).value == 3
+    assert is_inconsistent(step(m, "cas", [9, 3]))
+    assert step(m, "read", None) is m  # unknown read matches anything
+
+
+def test_register():
+    m = Register(1)
+    assert step(m, "write", 2).value == 2
+    assert is_inconsistent(step(m, "read", 9))
+
+
+def test_mutex():
+    m = Mutex()
+    m2 = step(m, "acquire", None)
+    assert m2.locked
+    assert is_inconsistent(step(m, "release", None))
+    assert is_inconsistent(step(m2, "acquire", None))
+    assert not step(m2, "release", None).locked
+
+
+def test_noop():
+    m = NoOp()
+    assert step(m, "anything", 42) is m
+
+
+def test_unordered_queue():
+    m = UnorderedQueue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    assert step(step(m, "dequeue", 2), "dequeue", 1).items == ()
+    assert is_inconsistent(step(m, "dequeue", 3))
+
+
+def test_fifo_queue():
+    m = FIFOQueue()
+    m = step(m, "enqueue", 1)
+    m = step(m, "enqueue", 2)
+    assert is_inconsistent(step(m, "dequeue", 2))
+    m = step(m, "dequeue", 1)
+    assert m.items == (2,)
+
+
+def test_multi_register():
+    m = MultiRegister((("x", 0), ("y", 0)))
+    m = m.step(op_(0, "txn", [["w", "x", 1], ["r", "y", 0]]))
+    assert m.as_dict() == {"x": 1, "y": 0}
+    assert is_inconsistent(m.step(op_(0, "txn", [["r", "x", 0]])))
+
+
+def test_models_hashable_for_memoization():
+    assert len({CASRegister(1), CASRegister(1), CASRegister(2)}) == 2
+
+
+def test_registry():
+    assert model("cas-register", 3).value == 3
+
+
+def test_device_spec_register_step():
+    import jax.numpy as jnp
+    spec = CASRegister(0).device_spec()
+    state = jnp.asarray(spec.encode(CASRegister(0)))
+    # read 0 ok
+    s, legal = spec.step(state, jnp.int32(0), jnp.int64(0), jnp.int64(0),
+                         jnp.bool_(True))
+    assert bool(legal) and int(s[0]) == 0
+    # read 1 illegal
+    _, legal = spec.step(state, jnp.int32(0), jnp.int64(1), jnp.int64(0),
+                         jnp.bool_(True))
+    assert not bool(legal)
+    # unknown read legal
+    _, legal = spec.step(state, jnp.int32(0), jnp.int64(1), jnp.int64(0),
+                         jnp.bool_(False))
+    assert bool(legal)
+    # write 7
+    s, legal = spec.step(state, jnp.int32(1), jnp.int64(7), jnp.int64(0),
+                         jnp.bool_(True))
+    assert bool(legal) and int(s[0]) == 7
+    # cas 0->9 from state 0
+    s, legal = spec.step(state, jnp.int32(2), jnp.int64(0), jnp.int64(9),
+                         jnp.bool_(True))
+    assert bool(legal) and int(s[0]) == 9
+    # cas 5->9 from state 0 illegal
+    _, legal = spec.step(state, jnp.int32(2), jnp.int64(5), jnp.int64(9),
+                         jnp.bool_(True))
+    assert not bool(legal)
+
+
+def test_device_spec_mutex_step():
+    import jax.numpy as jnp
+    spec = Mutex().device_spec()
+    state = jnp.asarray(spec.encode(Mutex()))
+    s, legal = spec.step(state, jnp.int32(0), jnp.int64(0), jnp.int64(0),
+                         jnp.bool_(False))
+    assert bool(legal) and int(s[0]) == 1
+    _, legal = spec.step(s, jnp.int32(0), jnp.int64(0), jnp.int64(0),
+                         jnp.bool_(False))
+    assert not bool(legal)
