@@ -48,14 +48,22 @@ class DeviceSpec:
     state_size : words in the int32 state vector
     f_codes    : f tag -> small int used by step
     encode     : model -> np.int32[state_size] initial state
-    step       : jax fn (state i32[S], f i32, a i64, b i64, a_ok bool)
+    step       : jax fn (state i32[S], f i32, a i32, b i32, a_ok bool)
                  -> (state' i32[S], legal bool).  Must be jit/vmap-safe.
+    pure       : optional jax fn (f, a, b, a_ok) -> bool: True iff the op
+                 NEVER modifies state for ANY state (e.g. reads).  Enables
+                 the WGL kernel's sort-free fast path; must be a
+                 module-level function (it keys the kernel cache).
+    encode_op  : optional op -> (f, a, b, a_ok) override for models whose
+                 values don't fit the generic int/pair encoding.
     """
 
     state_size: int
     f_codes: dict
     encode: Callable[[Any], np.ndarray]
     step: Callable
+    pure: Optional[Callable] = None
+    encode_op: Optional[Callable] = None
 
 
 class Model:
@@ -71,6 +79,10 @@ class Model:
 # ---------------------------------------------------------------------------
 
 _REG_F = {"read": 0, "write": 1, "cas": 2}
+
+
+def _register_pure(f, a, b, a_ok):
+    return f == 0  # reads never modify the register
 
 
 def _register_step(state, f, a, b, a_ok):
@@ -122,7 +134,8 @@ class CASRegister(Model):
             return np.array(
                 [none_code if m.value is None else m.value], np.int32)
 
-        return DeviceSpec(1, dict(_REG_F), encode, _register_step)
+        return DeviceSpec(1, dict(_REG_F), encode, _register_step,
+                          pure=_register_pure)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -148,7 +161,8 @@ class Register(Model):
             return np.array(
                 [none_code if m.value is None else m.value], np.int32)
 
-        return DeviceSpec(1, dict(_REG_F), encode, _register_step)
+        return DeviceSpec(1, dict(_REG_F), encode, _register_step,
+                          pure=_register_pure)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,491 @@
+"""Batched WGL linearizability search on TPU — the centerpiece kernel.
+
+The reference delegates linearizability checking to knossos
+(`jepsen/src/jepsen/checker.clj:141-145`), a JVM depth-first search whose
+cost is "exponential in the number of concurrent operations"
+(`doc/tutorial/06-refining.md:7-10`) and which routinely needs a 32 GB
+heap (`jepsen/project.clj:30`).  Here the same search is a *breadth-first
+frontier* evolved by vectorized kernels:
+
+  configuration = (bitmask over open-call slots, model state int32[S])
+  frontier      = fixed-capacity arrays   masks u32[F, Wd], states i32[F, S]
+
+The search walks *return events* in history order (just-in-time
+linearization, equivalent to knossos :linear / Lowe's algorithm).  At the
+return of call `i`, configurations that have not yet linearized `i` are
+expanded by linearizing any currently-open call; expansion repeats (at
+most `C` rounds — each round linearizes one more op) until every
+surviving configuration contains `i`; configurations that cannot are
+pruned.  All expansion, exact dedupe (lexicographic sort over mask+state
+words — no hashing, no false merges), and compaction happen on device
+with static shapes, so the whole history check is ONE compiled XLA
+program (`lax.while_loop` over events).
+
+Per-event cost is adaptive:
+
+  * fast path (no sort): if the returning op is directly legal and
+    state-preserving on every configuration still lacking it, the event
+    is a pure filter — sound because any closure path that linearizes
+    other pending ops first can be *deferred* to a later forcing event
+    and reproduces the same (mask, state) configs;
+  * tiered closure: otherwise the closure runs in the smallest pool tier
+    that fits the live config count, escalating tiers on overflow inside
+    the event, so small frontiers sort hundreds — not tens of thousands
+    — of rows.
+
+Bitmask slots: a call occupies a slot only while *open* (invoked, return
+event not yet processed).  Once its return is processed every surviving
+configuration has it linearized, so its bit carries no information and
+the slot is recycled.  Crashed (:info) calls never return and hold their
+slot forever — the mask width is exactly `max_open` from prep.py, which
+is the reference's "a couple crashed processes can make the difference
+between seconds and days" cost model (`doc/tutorial/06-refining.md:12-19`)
+made explicit.
+
+Capacity policy: a fixed frontier can overflow (the search is NP-hard;
+worst case n!).  Overflow never corrupts results — it sets a flag, and:
+  * a *valid* verdict is always trustworthy (surviving configs are real
+    linearizations);
+  * an *invalid* verdict with overflow is reported `unknown`, and the
+    caller escalates to a larger frontier (check() retries through
+    `frontier_sizes`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.models import DeviceSpec
+from jepsen_tpu.ops.prep import PreparedHistory, prepare
+
+_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+# ---------------------------------------------------------------------------
+# Host-side planning: events -> dense per-return-event candidate tables
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class WGLPlan:
+    """Static arrays consumed by the kernel.  R return events, C max
+    candidates per event, W mask bits (= max simultaneously-open calls),
+    S model-state words."""
+
+    ret_call: np.ndarray     # int32 [R]   returning call id (-1 = padding)
+    ret_slot: np.ndarray     # int32 [R]
+    cand_call: np.ndarray    # int32 [R, C] open-call ids (-1 = none)
+    cand_slot: np.ndarray    # int32 [R, C]
+    f: np.ndarray            # int32 [n_calls]
+    a: np.ndarray            # int32 [n_calls]
+    b: np.ndarray            # int32 [n_calls]
+    a_ok: np.ndarray         # bool  [n_calls]
+    init_state: np.ndarray   # int32 [S]
+    n_calls: int
+    n_events: int            # real (unpadded) return events
+    max_open: int
+
+
+def _generic_encode_op(op, f_codes) -> tuple[int, int, int, bool]:
+    """Default op -> (f, a, b, a_ok) encoding: int values in slot a,
+    [a, b] pairs across both, None/unencodable marked not-ok (matches
+    the read-with-unknown-value rule in models._register_step)."""
+    fc = f_codes.get(op.f, -1)
+    v = op.value
+    if isinstance(v, bool):
+        return fc, int(v), 0, True
+    if isinstance(v, int):
+        return fc, v, 0, True
+    if (isinstance(v, (list, tuple)) and len(v) == 2
+            and all(isinstance(x, int) and not isinstance(x, bool) for x in v)):
+        return fc, v[0], v[1], True
+    return fc, 0, 0, False
+
+
+def plan(prep: PreparedHistory, spec: DeviceSpec, model,
+         pad_events_to: Optional[int] = None,
+         pad_cands_to: Optional[int] = None) -> WGLPlan:
+    calls = prep.calls
+    n = len(calls)
+
+    f = np.zeros(n, np.int32)
+    a = np.zeros(n, np.int32)
+    b = np.zeros(n, np.int32)
+    a_ok = np.zeros(n, bool)
+    encode_op = getattr(spec, "encode_op", None) or \
+        (lambda op: _generic_encode_op(op, spec.f_codes))
+    for c in calls:
+        fc, av, bv, okv = encode_op(c.op)
+        if fc < 0:
+            raise ValueError(f"model has no f-code for {c.op.f!r}")
+        if not (-2 ** 31 <= av < 2 ** 31 and -2 ** 31 <= bv < 2 ** 31):
+            raise ValueError(
+                f"op value {c.op.value!r} exceeds the device kernel's "
+                f"int32 range; use ops.wgl_cpu.check for this history")
+        f[c.id], a[c.id], b[c.id], a_ok[c.id] = fc, av, bv, okv
+
+    # Slot assignment + per-return-event open sets.
+    free: list[int] = []
+    next_slot = 0
+    slot_of: dict[int, int] = {}
+    open_calls: list[int] = []
+    rets: list[tuple[int, int, list[int]]] = []
+    for _, kind, cid in prep.events:
+        if kind == 0:
+            s = free.pop() if free else next_slot
+            if s == next_slot:
+                next_slot += 1
+            slot_of[cid] = s
+            open_calls.append(cid)
+        else:
+            rets.append((cid, slot_of[cid], list(open_calls)))
+            open_calls.remove(cid)
+            free.append(slot_of[cid])
+
+    R = len(rets)
+    C = max((len(cands) for _, _, cands in rets), default=1)
+    C = max(C, 1)
+    if pad_cands_to is not None:
+        C = max(C, pad_cands_to)
+    Rp = max(R, 1)
+    if pad_events_to is not None:
+        Rp = max(Rp, pad_events_to)
+
+    ret_call = np.full(Rp, -1, np.int32)
+    ret_slot = np.zeros(Rp, np.int32)
+    cand_call = np.full((Rp, C), -1, np.int32)
+    cand_slot = np.zeros((Rp, C), np.int32)
+    for r, (cid, slot, cands) in enumerate(rets):
+        ret_call[r] = cid
+        ret_slot[r] = slot
+        for k, j in enumerate(cands):
+            cand_call[r, k] = j
+            cand_slot[r, k] = slot_of[j]
+
+    return WGLPlan(ret_call, ret_slot, cand_call, cand_slot,
+                   f, a, b, a_ok, np.asarray(spec.encode(model), np.int32),
+                   n_calls=n, n_events=R, max_open=max(next_slot, 1))
+
+
+# ---------------------------------------------------------------------------
+# Device kernel
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
+    """Compile the frontier search for static shapes.  step_fn must be a
+    hashable (module-level or cached) pure function."""
+    import jax
+    import jax.numpy as jnp
+
+    Wd = max((W + 31) // 32, 1)
+    u32 = jnp.uint32
+    # Closure pool tiers: smallest tier that fits the live config count
+    # runs first; overflow escalates within the event.
+    TIERS = [t for t in (64, 512) if t < F] + [F]
+
+    def slot_word_bit(slot):
+        return slot // 32, (u32(1) << (slot % 32).astype(jnp.uint32))
+
+    def has_bit(masks, slot):
+        # masks [..., Wd], slot broadcastable to masks.shape[:-1]
+        w, bit = slot_word_bit(slot)
+        word = jnp.take_along_axis(
+            masks, jnp.broadcast_to(w[..., None], masks.shape[:-1] + (1,)),
+            axis=-1)[..., 0]
+        return (word & bit) != 0
+
+    def set_bit(masks, slot):
+        w, bit = slot_word_bit(slot)
+        word_idx = jnp.arange(Wd)
+        shape = masks.shape[:-1] + (Wd,)
+        return jnp.where(
+            jnp.broadcast_to(word_idx, shape) == w[..., None],
+            masks | bit[..., None], masks)
+
+    def clear_bit(masks, slot):
+        w, bit = slot_word_bit(slot)
+        word_idx = jnp.arange(Wd)
+        shape = masks.shape[:-1] + (Wd,)
+        return jnp.where(
+            jnp.broadcast_to(word_idx, shape) == w[..., None],
+            masks & ~bit[..., None], masks)
+
+    def dedupe_compact(masks, states, valid, out_rows: int):
+        """Exact dedupe + compaction of a pool of configs down to
+        out_rows.  masks u32[P, Wd], states i32[P, S], valid bool[P].
+        Exactness matters: dedupe compares full (mask, state) content —
+        never a hash — so distinct configurations are never merged."""
+        P = masks.shape[0]
+        st_keys = jax.lax.bitcast_convert_type(states, u32) \
+            ^ u32(0x80000000)
+        sent = ~valid
+        keys = [jnp.where(sent, u32(1), u32(0))]
+        for wi in range(Wd):
+            keys.append(jnp.where(sent, _SENTINEL, masks[:, wi]))
+        for si in range(S):
+            keys.append(jnp.where(sent, _SENTINEL, st_keys[:, si]))
+        # lexsort: last key is primary -> reverse so keys[0] is primary.
+        perm = jnp.lexsort(tuple(reversed(keys)))
+        s_masks = masks[perm]
+        s_states = states[perm]
+        s_valid = valid[perm]
+        content = [k[perm] for k in keys[1:]]
+        eq_prev = jnp.ones(s_valid.shape, bool)
+        for col in content:
+            eq_prev &= col == jnp.roll(col, 1)
+        eq_prev = eq_prev.at[0].set(False)
+        keep = s_valid & ~eq_prev
+        pos = jnp.cumsum(keep) - 1
+        count = pos[-1] + 1
+        pos = jnp.where(keep, pos, P + 1)
+        out_masks = jnp.zeros((out_rows, Wd), u32).at[pos].set(
+            s_masks, mode="drop")
+        out_states = jnp.zeros((out_rows, S), jnp.int32).at[pos].set(
+            s_states, mode="drop")
+        out_valid = jnp.arange(out_rows) < jnp.minimum(count, out_rows)
+        return out_masks, out_states, out_valid, count > out_rows, count
+
+    def compact(masks, states, valid):
+        """Re-pack valid configs to the front (cheap: no sort)."""
+        keep = valid
+        pos = jnp.cumsum(keep) - 1
+        count = pos[-1] + 1
+        pos = jnp.where(keep, pos, F + 1)
+        out_masks = jnp.zeros((F, Wd), u32).at[pos].set(masks, mode="drop")
+        out_states = jnp.zeros((F, S), jnp.int32).at[pos].set(
+            states, mode="drop")
+        out_valid = jnp.arange(F) < count
+        return out_masks, out_states, out_valid
+
+    def step_call(states, call, fv, av, bv, okv):
+        """Apply call's op to a batch of states.  states i32[..., S]."""
+        j = jnp.clip(call, 0, None)
+        flat = states.reshape(-1, S)
+        st2, legal = jax.vmap(
+            lambda st: step_fn(st, fv[j], av[j], bv[j], okv[j]))(flat)
+        return (st2.reshape(states.shape),
+                legal.reshape(states.shape[:-1]))
+
+    def closure_tier(Fb: int, masks, states, valid, tslot,
+                     cc, cs, cf, ca, cb, cok):
+        """Run the closure in a pool of Fb*(C+1); configs live in the
+        first Fb rows (caller guarantees count <= Fb).  Returns
+        full-F arrays + overflow flag."""
+        bm, bs, bv = masks[:Fb], states[:Fb], valid[:Fb]
+        open_c = cc >= 0
+
+        def ex_cond(c):
+            bm, bs, bv, ovf, rounds, progressed, _ = c
+            lacks = bv & ~has_bit(bm, jnp.broadcast_to(tslot, (Fb,)))
+            return jnp.any(lacks) & (rounds < C) & progressed & ~ovf
+
+        def ex_body(c):
+            bm, bs, bv, ovf, rounds, _, prev_count = c
+            lacks = bv & ~has_bit(bm, jnp.broadcast_to(tslot, (Fb,)))
+
+            def per_config(mask, state, lack):
+                def per_cand(slot, f_, a_, b_, ok_, is_open):
+                    st2, legal = step_fn(state, f_, a_, b_, ok_)
+                    not_lin = ~has_bit(mask[None, :], slot[None])[0]
+                    okc = lack & is_open & not_lin & legal
+                    m2 = set_bit(mask[None, :], slot[None])[0]
+                    return m2, st2, okc
+                return jax.vmap(per_cand)(cs, cf, ca, cb, cok, open_c)
+
+            chm, chs, chv = jax.vmap(per_config)(bm, bs, lacks)
+            pool_m = jnp.concatenate([bm, chm.reshape(Fb * C, Wd)])
+            pool_s = jnp.concatenate([bs, chs.reshape(Fb * C, S)])
+            pool_v = jnp.concatenate([bv, chv.reshape(Fb * C)])
+            nm, ns, nv, o2, count = dedupe_compact(
+                pool_m, pool_s, pool_v, Fb)
+            # Parents are all retained in the pool, so "a new config
+            # appeared" is exactly "the DEDUPED count grew vs the
+            # previous round's deduped count" — the loop must stop on
+            # saturation even while some configs still lack the target
+            # (they are pruned afterwards).  Comparing against a raw
+            # sum(valid) would be wrong: the frontier entering an event
+            # may hold duplicates (configs that differed only in the
+            # just-retired slot bit), so round 1 always runs
+            # (prev_count starts at -1) and later rounds compare
+            # distinct-to-distinct.
+            return (nm, ns, nv, ovf | o2, rounds + 1,
+                    count > prev_count, count)
+
+        bm, bs, bv, ovf, _, _, _ = jax.lax.while_loop(
+            ex_cond, ex_body,
+            (bm, bs, bv, jnp.bool_(False), jnp.int32(0), jnp.bool_(True),
+             jnp.int32(-1)))
+
+        if Fb == F:
+            return bm, bs, bv, ovf
+        pm = jnp.zeros((F, Wd), u32).at[:Fb].set(bm)
+        ps = jnp.zeros((F, S), jnp.int32).at[:Fb].set(bs)
+        pv = jnp.zeros(F, bool).at[:Fb].set(bv)
+        return pm, ps, pv, ovf
+
+    def kernel(ret_call, ret_slot, cand_call, cand_slot, fv, av, bv, okv,
+               init_state, n_events):
+        masks0 = jnp.zeros((F, Wd), u32)
+        states0 = jnp.zeros((F, S), jnp.int32).at[0].set(init_state)
+        valid0 = jnp.zeros(F, bool).at[0].set(True)
+
+        def ev_cond(carry):
+            r, _, _, _, dead, _ = carry
+            return (r < n_events) & ~dead
+
+        def ev_body(carry):
+            r, masks, states, valid, dead, overflow = carry
+            tslot = ret_slot[r]
+            tcall = ret_call[r]
+            cc = cand_call[r]
+            cs = cand_slot[r]
+            jc = jnp.clip(cc, 0, None)
+            cf, ca, cb, cok = fv[jc], av[jc], bv[jc], okv[jc]
+
+            # ---- fast path: the returning op is *pure* (never changes
+            # state, e.g. a read) and directly legal on every config
+            # still lacking it.  Sound because a pure op's closure
+            # variants (linearize pending ops first) produce the same
+            # (mask, state) configs as deferring those pending ops to a
+            # later forcing event; purity must hold for ALL states (a
+            # write that happens to rewrite the current value does NOT
+            # qualify — its closure variants diverge). ----
+            has = has_bit(masks, jnp.broadcast_to(tslot, (F,)))
+            lacking = valid & ~has
+            if pure_fn is not None:
+                jt = jnp.clip(tcall, 0, None)
+                is_pure = pure_fn(fv[jt], av[jt], bv[jt], okv[jt])
+                _, legal = step_call(states, tcall, fv, av, bv, okv)
+                fast_ok = is_pure & jnp.all(~lacking | legal)
+            else:
+                fast_ok = jnp.bool_(False)
+
+            def fast(_):
+                # every lacking config linearizes the op in place; masks
+                # are unchanged after the retire-clear below.
+                return masks, states, valid, jnp.bool_(False)
+
+            def slow(_):
+                count = jnp.sum(valid)
+                # Flattened escalation chain: each tier is traced exactly
+                # once (a recursive cond-nest would trace the largest
+                # tier 2^(n-1) times).  A tier runs iff no smaller tier
+                # succeeded and it can hold the current config count;
+                # overflow falls through to the next tier, which reruns
+                # the closure from the same event-start frontier.
+                out = (masks, states, valid, jnp.bool_(False))
+                settled = jnp.bool_(False)
+                for i, Fb in enumerate(TIERS):
+                    is_last = i == len(TIERS) - 1
+                    should = ~settled & ((count <= Fb) | is_last)
+                    res = jax.lax.cond(
+                        should,
+                        functools.partial(
+                            lambda Fb, _: closure_tier(
+                                Fb, masks, states, valid, tslot,
+                                cc, cs, cf, ca, cb, cok), Fb),
+                        lambda _: out, operand=None)
+                    accept = should & (~res[3] | is_last)
+                    out = tuple(
+                        jnp.where(accept, n, o) for n, o in zip(res, out))
+                    settled = settled | accept
+                m, s, v, ovf = out
+                # prune configs that never linearized the returning call
+                sat = has_bit(m, jnp.broadcast_to(tslot, (F,)))
+                v = v & sat
+                m, s, v = compact(m, s, v)
+                return m, s, v, ovf
+
+            masks, states, valid, ovf = jax.lax.cond(
+                fast_ok, fast, slow, operand=None)
+            # retire the returning call's slot
+            masks = clear_bit(masks, jnp.broadcast_to(tslot, (F,)))
+            dead = ~jnp.any(valid)
+            return r + 1, masks, states, valid, dead, overflow | ovf
+
+        r, masks, states, valid, dead, overflow = jax.lax.while_loop(
+            ev_cond, ev_body,
+            (jnp.int32(0), masks0, states0, valid0, jnp.bool_(False),
+             jnp.bool_(False)))
+        return {"ok": ~dead, "failed_event": jnp.where(dead, r - 1, -1),
+                "overflow": overflow, "frontier": jnp.sum(valid),
+                "final_states": states, "final_valid": valid}
+
+    return jax.jit(kernel)
+
+
+def _bucket(x: int, minimum: int = 1) -> int:
+    b = minimum
+    while b < x:
+        b *= 2
+    return b
+
+
+def check(model, history, *,
+          frontier_sizes: Sequence[int] = (1024, 8192, 65536),
+          pad: bool = True) -> dict[str, Any]:
+    """Check linearizability of `history` against `model` on the default
+    JAX backend.  Returns a knossos-shaped analysis map (same keys as
+    ops.wgl_cpu.check) plus timing info."""
+    import jax
+
+    spec = model.device_spec()
+    if spec is None:
+        raise ValueError(
+            f"model {model!r} has no device spec; use ops.wgl_cpu.check")
+
+    t0 = time.monotonic()
+    prep = history if isinstance(history, PreparedHistory) else prepare(history)
+    backend_name = jax.default_backend()
+    if not prep.calls:
+        return {"valid?": True, "op_count": 0, "backend": backend_name}
+
+    # Bucket trace-shapes so repeated checks reuse compiled kernels.
+    n_events = sum(1 for _, kind, _ in prep.events if kind == 1)
+    pl = plan(prep, spec, model,
+              pad_events_to=_bucket(n_events) if pad else None,
+              pad_cands_to=_bucket(prep.max_open, 4) if pad else None)
+    C = pl.cand_call.shape[1]
+    W = C  # slots range over [0, max_open) and C >= max_open
+    S = pl.init_state.shape[0]
+    t_plan = time.monotonic() - t0
+
+    for F in frontier_sizes:
+        if F < 1:
+            continue
+        kern = _build_kernel(spec.step, spec.pure, int(F), int(C), int(W),
+                             int(S))
+        t1 = time.monotonic()
+        out = kern(pl.ret_call, pl.ret_slot, pl.cand_call, pl.cand_slot,
+                   pl.f, pl.a, pl.b, pl.a_ok, pl.init_state,
+                   np.int32(pl.n_events))
+        ok = bool(out["ok"])
+        overflow = bool(out["overflow"])
+        t_kernel = time.monotonic() - t1
+        if ok or not overflow:
+            result: dict[str, Any] = {
+                "valid?": ok,
+                "op_count": pl.n_calls,
+                "backend": backend_name,
+                "frontier_size": F,
+                "final_frontier": int(out["frontier"]),
+                "time_plan_s": t_plan,
+                "time_kernel_s": t_kernel,
+            }
+            if not ok:
+                ev = int(out["failed_event"])
+                cid = int(pl.ret_call[ev]) if ev >= 0 else -1
+                if 0 <= cid < len(prep.calls):
+                    call = prep.calls[cid]
+                    result["op"] = call.op.to_dict()
+                    result["op_index"] = call.op.index
+                result["anomaly"] = "nonlinearizable"
+            return result
+    return {"valid?": "unknown", "cause": "frontier-overflow",
+            "op_count": pl.n_calls, "backend": backend_name,
+            "frontier_size": frontier_sizes[-1]}

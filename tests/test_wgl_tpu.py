@@ -1,0 +1,141 @@
+"""Differential tests: the batched device WGL kernel must agree with the
+CPU oracle on every history (SURVEY.md §4: "same history => identical
+verdicts")."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+from jepsen_tpu.models import CASRegister, Mutex, Register
+from jepsen_tpu.ops import wgl, wgl_cpu
+from tests.test_wgl_cpu import H, simulate_register_history
+
+
+def both(model, h, **kw):
+    r_cpu = wgl_cpu.check(model, h)
+    r_tpu = wgl.check(model, h, **kw)
+    assert r_cpu["valid?"] == r_tpu["valid?"], \
+        f"cpu={r_cpu} tpu={r_tpu}"
+    return r_tpu
+
+
+def test_empty():
+    assert wgl.check(CASRegister(None), H())["valid?"] is True
+
+
+def test_sequential_valid():
+    both(CASRegister(None),
+         H(invoke_op(0, "write", 3), ok_op(0, "write", 3),
+           invoke_op(0, "read", None), ok_op(0, "read", 3)))
+
+
+def test_sequential_invalid_with_witness():
+    r = both(CASRegister(None),
+             H(invoke_op(0, "write", 3), ok_op(0, "write", 3),
+               invoke_op(0, "read", None), ok_op(0, "read", 4)))
+    assert r["valid?"] is False
+    assert r["op"]["value"] == 4
+    assert r["op_index"] == 2
+
+
+def test_concurrent_writes_read_either():
+    for seen in (1, 2):
+        both(CASRegister(None),
+             H(invoke_op(0, "write", 1), invoke_op(1, "write", 2),
+               ok_op(0, "write", 1), ok_op(1, "write", 2),
+               invoke_op(0, "read", None), ok_op(0, "read", seen)))
+
+
+def test_real_time_order_enforced():
+    r = both(CASRegister(None),
+             H(invoke_op(0, "write", 1), ok_op(0, "write", 1),
+               invoke_op(0, "write", 2), ok_op(0, "write", 2),
+               invoke_op(0, "read", None), ok_op(0, "read", 1)))
+    assert r["valid?"] is False
+
+
+def test_crashed_write_semantics():
+    for seen, expect in ((9, True), (0, True), (5, False)):
+        r = both(CASRegister(0),
+                 H(invoke_op(1, "write", 9), info_op(1, "write", 9),
+                   invoke_op(0, "read", None), ok_op(0, "read", seen)))
+        assert r["valid?"] is expect, (seen, r)
+
+
+def test_crashed_op_surfaces_late():
+    both(CASRegister(0),
+         H(invoke_op(9, "write", 7), info_op(9, "write", 7),
+           invoke_op(0, "write", 1), ok_op(0, "write", 1),
+           invoke_op(0, "read", None), ok_op(0, "read", 1),
+           invoke_op(0, "read", None), ok_op(0, "read", 7)))
+
+
+def test_failed_ops_never_happened():
+    r = both(CASRegister(None),
+             H(invoke_op(0, "write", 3), ok_op(0, "write", 3),
+               invoke_op(1, "write", 9), fail_op(1, "write", 9),
+               invoke_op(0, "read", None), ok_op(0, "read", 9)))
+    assert r["valid?"] is False
+
+
+def test_cas_and_mutex():
+    both(CASRegister(0),
+         H(invoke_op(0, "cas", [0, 1]), ok_op(0, "cas", [0, 1]),
+           invoke_op(1, "cas", [1, 2]), ok_op(1, "cas", [1, 2]),
+           invoke_op(0, "read", None), ok_op(0, "read", 2)))
+    r = both(Mutex(),
+             H(invoke_op(0, "acquire", None), ok_op(0, "acquire", None),
+               invoke_op(1, "acquire", None), ok_op(1, "acquire", None)))
+    assert r["valid?"] is False
+
+
+def test_differential_random_valid():
+    rng = random.Random(1234)
+    for i in range(15):
+        h = simulate_register_history(rng, n_procs=4, n_ops=50)
+        both(CASRegister(0), h)
+
+
+def test_differential_random_mutated():
+    rng = random.Random(99)
+    for i in range(15):
+        h = simulate_register_history(rng, n_procs=3, n_ops=40,
+                                      crash_p=0.02)
+        ok_reads = [j for j, o in enumerate(h)
+                    if o.f == "read" and o.is_ok]
+        if ok_reads and rng.random() < 0.7:
+            h[rng.choice(ok_reads)].value = rng.randrange(10)
+        both(CASRegister(0), h)
+
+
+def test_frontier_escalation_on_overflow():
+    """Tiny frontier forces overflow + escalation; verdict must match."""
+    rng = random.Random(5)
+    h = simulate_register_history(rng, n_procs=6, n_ops=40, crash_p=0.15)
+    r_cpu = wgl_cpu.check(CASRegister(0), h)
+    r = wgl.check(CASRegister(0), h, frontier_sizes=(4, 64, 1024))
+    assert r["valid?"] == r_cpu["valid?"]
+
+
+def test_overflow_reports_unknown_not_false():
+    """With only a tiny frontier available, a non-valid result must be
+    'unknown', never a (possibly spurious) False."""
+    rng = random.Random(11)
+    h = simulate_register_history(rng, n_procs=8, n_ops=60, crash_p=0.3)
+    r = wgl.check(CASRegister(0), h, frontier_sizes=(2,))
+    assert r["valid?"] in (True, "unknown")
+
+
+def test_compiled_kernel_reuse():
+    """Same shape buckets reuse the compiled kernel (no per-history
+    recompilation): run several same-sized histories and check the
+    cache has a single entry per shape."""
+    wgl._build_kernel.cache_clear()
+    rng = random.Random(3)
+    for _ in range(3):
+        h = simulate_register_history(rng, n_procs=3, n_ops=30,
+                                      crash_p=0.0)
+        wgl.check(CASRegister(0), h, frontier_sizes=(64,))
+    info = wgl._build_kernel.cache_info()
+    assert info.misses <= 2, info
