@@ -1,0 +1,653 @@
+"""Checkers: validate that a history is correct.
+
+Mirrors the reference's `jepsen/src/jepsen/checker.clj` — the `Checker`
+protocol (:49-69), `check-safe` (:77), the `merge-valid` priority lattice
+(:26-47), `compose` (:90), and all twelve built-in checkers — with the
+heavy set algebra running as JAX kernels (`jepsen_tpu.ops.fold`) when
+values are integers, and the linearizability checker delegating to the
+TPU WGL frontier search (`jepsen_tpu.ops.wgl`) instead of knossos.
+
+Every checker returns a dict with at least a `"valid?"` key whose value
+is True, False, or "unknown".
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from collections import Counter
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Optional
+
+from jepsen_tpu.history import History, Op
+
+UNKNOWN = "unknown"
+
+# checker.clj:26-31 — larger numbers dominate when checkers compose.
+VALID_PRIORITIES = {True: 0, False: 1, UNKNOWN: 0.5}
+
+
+def merge_valid(valids) -> Any:
+    """Merge n valid? values, yielding the highest-priority one
+    (checker.clj:33-47)."""
+    out = True
+    for v in valids:
+        if v not in VALID_PRIORITIES:
+            raise ValueError(f"{v!r} is not a known valid? value")
+        if VALID_PRIORITIES[out] < VALID_PRIORITIES[v]:
+            out = v
+    return out
+
+
+class Checker:
+    """checker.clj:49-69.  `test` is the test map (may be None for pure
+    checkers); `opts` carries e.g. :subdirectory for artifact output."""
+
+    def check(self, test, history, opts=None) -> dict:
+        raise NotImplementedError
+
+
+def check_safe(checker, test, history, opts=None) -> dict:
+    """checker.clj:77-88: wrap checker exceptions into
+    {'valid?': 'unknown', 'error': ...}."""
+    try:
+        return checker.check(test, history, opts or {})
+    except Exception:
+        return {"valid?": UNKNOWN, "error": traceback.format_exc()}
+
+
+class Noop(Checker):
+    def check(self, test, history, opts=None):
+        return None
+
+
+def noop():
+    return Noop()
+
+
+class UnbridledOptimism(Checker):
+    """Everything is awesoooommmmme! (checker.clj:120-124)"""
+
+    def check(self, test, history, opts=None):
+        return {"valid?": True}
+
+
+def unbridled_optimism():
+    return UnbridledOptimism()
+
+
+class Compose(Checker):
+    """checker.clj:90-102: run a map of checkers in parallel; result map
+    plus a merged top-level valid?."""
+
+    def __init__(self, checker_map: dict):
+        self.checker_map = dict(checker_map)
+
+    def check(self, test, history, opts=None):
+        if not self.checker_map:
+            return {"valid?": True}
+        with ThreadPoolExecutor(max_workers=len(self.checker_map)) as ex:
+            futs = {k: ex.submit(check_safe, c, test, history, opts)
+                    for k, c in self.checker_map.items()}
+            results = {k: f.result() for k, f in futs.items()}
+        out: dict = dict(results)
+        out["valid?"] = merge_valid(
+            r["valid?"] for r in results.values() if r is not None)
+        return out
+
+
+def compose(checker_map: dict) -> Checker:
+    return Compose(checker_map)
+
+
+class ConcurrencyLimit(Checker):
+    """checker.clj:104-119: bound concurrent executions of a memory-heavy
+    checker."""
+
+    def __init__(self, limit: int, checker: Checker):
+        self.sem = threading.Semaphore(limit)
+        self.checker = checker
+
+    def check(self, test, history, opts=None):
+        with self.sem:
+            return self.checker.check(test, history, opts)
+
+
+def concurrency_limit(limit: int, checker: Checker) -> Checker:
+    return ConcurrencyLimit(limit, checker)
+
+
+# ---------------------------------------------------------------------------
+# Linearizability — delegates to the TPU WGL kernel (ops/wgl.py) or the
+# CPU oracle (ops/wgl_cpu.py); replaces knossos (checker.clj:127-158).
+# ---------------------------------------------------------------------------
+
+class Linearizable(Checker):
+    """algorithm: 'auto' uses the device kernel when the model provides a
+    DeviceSpec and falls back to the CPU oracle (the reference's
+    'competition' slot, checker.clj:141-145); 'device'/'cpu' force one."""
+
+    def __init__(self, model=None, algorithm: str = "auto", **kw):
+        if model is None:
+            raise ValueError(
+                "The linearizable checker requires a model. It received: "
+                "None instead.")
+        self.model = model
+        self.algorithm = algorithm
+        self.kw = kw
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.ops import wgl, wgl_cpu
+
+        algo = self.algorithm
+        spec = self.model.device_spec()
+        if algo == "auto":
+            algo = "device" if spec is not None else "cpu"
+        if algo == "device":
+            a = wgl.check(self.model, history, **self.kw)
+        elif algo == "cpu":
+            a = wgl_cpu.check(self.model, history, **self.kw)
+        else:
+            raise ValueError(f"unknown algorithm {algo!r}")
+        # Truncation parity (checker.clj:155-158): writing full configs
+        # "can take *hours*".
+        if "configs" in a:
+            a["configs"] = a["configs"][:10]
+        if "final-paths" in a:
+            a["final-paths"] = a["final-paths"][:10]
+        return a
+
+
+def linearizable(opts_or_model=None, **kw) -> Checker:
+    """Accepts linearizable({'model': m, 'algorithm': ...}) like the
+    reference (checker.clj:127), or linearizable(model, ...)."""
+    if isinstance(opts_or_model, dict):
+        o = dict(opts_or_model)
+        return Linearizable(o.pop("model", None), o.pop("algorithm", "auto"),
+                            **o, **kw)
+    return Linearizable(opts_or_model, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Queue (model-reduction) — checker.clj:160-180
+# ---------------------------------------------------------------------------
+
+class Queue(Checker):
+    """Every dequeue must come from somewhere: assume every non-failing
+    enqueue succeeded and only ok dequeues happened; reduce the model."""
+
+    def __init__(self, model):
+        self.model = model
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.models import is_inconsistent
+
+        m = self.model
+        for o in History(history):
+            if (o.f == "enqueue" and o.is_invoke) or \
+                    (o.f == "dequeue" and o.is_ok):
+                if m is None:
+                    continue
+                m = m.step(o)
+                if is_inconsistent(m):
+                    return {"valid?": False, "error": m.msg}
+        return {"valid?": True, "final-queue": m}
+
+
+def queue(model):
+    return Queue(model)
+
+
+# ---------------------------------------------------------------------------
+# Set — checker.clj:182-233
+# ---------------------------------------------------------------------------
+
+def integer_interval_set_str(xs) -> str:
+    """Compact sorted representation: #{1..3 5} (util.clj:528-553)."""
+    xs = sorted(xs)
+    if any(not isinstance(x, int) or isinstance(x, bool) for x in xs):
+        return "#{" + " ".join(str(x) for x in xs) + "}"
+    runs = []
+    start = end = None
+    for cur in xs:
+        if start is None:
+            start = end = cur
+        elif cur == end + 1:
+            end = cur
+        else:
+            runs.append((start, end))
+            start = end = cur
+    if start is not None:
+        runs.append((start, end))
+    return "#{" + " ".join(
+        str(s) if s == e else f"{s}..{e}" for s, e in runs) + "}"
+
+
+class Set(Checker):
+    """Adds followed by a final read: every acknowledged add must be
+    present, nothing unattempted may appear.  Large integer histories run
+    the membership algebra on device (ops/fold.py)."""
+
+    DEVICE_THRESHOLD = 4096
+
+    def check(self, test, history, opts=None):
+        attempts, adds, final_read = [], [], None
+        for o in History(history):
+            if o.f == "add" and o.is_invoke:
+                attempts.append(o.value)
+            elif o.f == "add" and o.is_ok:
+                adds.append(o.value)
+            elif o.f == "read" and o.is_ok:
+                final_read = o.value
+        if final_read is None:
+            return {"valid?": UNKNOWN, "error": "Set was never read"}
+
+        final_read = list(set(final_read))
+        from jepsen_tpu.ops import fold
+
+        if (fold.all_ints(attempts) and fold.all_ints(adds)
+                and fold.all_ints(final_read)
+                and len(attempts) + len(final_read) >= self.DEVICE_THRESHOLD):
+            ok_m, unexpected_m, lost_m, recovered_m = fold.set_masks(
+                attempts, adds, final_read)
+            ok = {v for v, m in zip(final_read, ok_m) if m}
+            unexpected = {v for v, m in zip(final_read, unexpected_m) if m}
+            lost = {v for v, m in zip(adds, lost_m) if m}
+            recovered = {v for v, m in zip(final_read, recovered_m) if m}
+        else:
+            attempts_s, adds_s, read_s = \
+                set(attempts), set(adds), set(final_read)
+            ok = read_s & attempts_s
+            unexpected = read_s - attempts_s
+            lost = adds_s - read_s
+            recovered = ok - adds_s
+
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": len(attempts),
+            "acknowledged-count": len(adds),
+            "ok-count": len(ok),
+            "lost-count": len(lost),
+            "recovered-count": len(recovered),
+            "unexpected-count": len(unexpected),
+            "ok": integer_interval_set_str(ok),
+            "lost": integer_interval_set_str(lost),
+            "unexpected": integer_interval_set_str(unexpected),
+            "recovered": integer_interval_set_str(recovered),
+        }
+
+
+def set_checker():
+    return Set()
+
+
+# ---------------------------------------------------------------------------
+# Set-full — checker.clj:364-533
+# ---------------------------------------------------------------------------
+
+class _SetFullElement:
+    """Per-element timeline state (checker.clj SetFullElement :255-282)."""
+
+    __slots__ = ("element", "known", "last_present", "last_absent")
+
+    def __init__(self, element):
+        self.element = element
+        self.known: Optional[Op] = None
+        self.last_present: Optional[Op] = None
+        self.last_absent: Optional[Op] = None
+
+    def add(self, op: Op):
+        if op.is_ok and self.known is None:
+            self.known = op
+
+    def read_present(self, inv: Op, op: Op):
+        if self.known is None:
+            self.known = op
+        if self.last_present is None or \
+                self.last_present.index < inv.index:
+            self.last_present = inv
+
+    def read_absent(self, inv: Op, op: Op):
+        if self.last_absent is None or self.last_absent.index < inv.index:
+            self.last_absent = inv
+
+    def results(self) -> dict:
+        def idx(o, default=-1):
+            return o.index if o is not None else default
+
+        stable = self.last_present is not None and \
+            idx(self.last_absent) < idx(self.last_present)
+        lost = (self.known is not None and self.last_absent is not None
+                and idx(self.last_present) < idx(self.last_absent)
+                and idx(self.known) < idx(self.last_absent))
+        never_read = not (stable or lost)
+        known_time = self.known.time if self.known is not None else None
+        stable_time = ((self.last_absent.time + 1)
+                       if stable and self.last_absent is not None else
+                       0 if stable else None)
+        lost_time = ((self.last_present.time + 1)
+                     if lost and self.last_present is not None else
+                     0 if lost else None)
+        stable_latency = (max(stable_time - known_time, 0) // 1_000_000
+                          if stable and known_time is not None else None)
+        lost_latency = (max(lost_time - known_time, 0) // 1_000_000
+                        if lost and known_time is not None else None)
+        return {"element": self.element,
+                "outcome": ("stable" if stable else
+                            "lost" if lost else "never-read"),
+                "stable-latency": stable_latency,
+                "lost-latency": lost_latency,
+                "known": self.known,
+                "last-absent": self.last_absent}
+
+
+def frequency_distribution(points, xs):
+    """Percentile map (0-1) of a collection (checker.clj:305-316)."""
+    xs = sorted(xs)
+    if not xs:
+        return None
+    n = len(xs)
+    return {p: xs[min(n - 1, int(n * p))] for p in points}
+
+
+class SetFull(Checker):
+    """Rigorous per-element stable/lost timeline analysis
+    (checker.clj:364-533)."""
+
+    def __init__(self, checker_opts=None):
+        self.opts = {"linearizable?": False}
+        self.opts.update(checker_opts or {})
+
+    def check(self, test, history, opts=None):
+        elements: dict = {}
+        reads: dict = {}
+        dups: dict = {}
+        for o in History(history):
+            if not isinstance(o.process, int) or isinstance(o.process, bool) \
+                    or o.process < 0:
+                continue
+            if o.f == "add":
+                if o.is_invoke:
+                    elements.setdefault(o.value, _SetFullElement(o.value))
+                elif o.value in elements:
+                    elements[o.value].add(o)
+            elif o.f == "read":
+                if o.is_invoke:
+                    reads[o.process] = o
+                elif o.is_fail:
+                    reads.pop(o.process, None)
+                elif o.is_info:
+                    pass
+                elif o.is_ok:
+                    inv = reads.get(o.process)
+                    v = o.value or []
+                    for el, n in Counter(v).items():
+                        if n > 1:
+                            dups[el] = max(dups.get(el, 0), n)
+                    vs = set(v)
+                    for el, state in elements.items():
+                        if el in vs:
+                            state.read_present(inv, o)
+                        else:
+                            state.read_absent(inv, o)
+
+        rs = [e.results() for e in elements.values()]
+        outcomes: dict = {}
+        for r in rs:
+            outcomes.setdefault(r["outcome"], []).append(r)
+        stable = outcomes.get("stable", [])
+        lost = outcomes.get("lost", [])
+        never_read = outcomes.get("never-read", [])
+        stale = [r for r in stable if r["stable-latency"]]
+        worst_stale = sorted(stale, key=lambda r: r["stable-latency"],
+                             reverse=True)[:8]
+        stable_latencies = [r["stable-latency"] for r in rs
+                            if r["stable-latency"] is not None]
+        lost_latencies = [r["lost-latency"] for r in rs
+                          if r["lost-latency"] is not None]
+        if lost:
+            valid: Any = False
+        elif not stable:
+            valid = UNKNOWN
+        elif self.opts.get("linearizable?") and stale:
+            valid = False
+        else:
+            valid = True
+        out = {
+            "valid?": valid if not dups else False,
+            "attempt-count": len(rs),
+            "stable-count": len(stable),
+            "lost-count": len(lost),
+            "lost": sorted(r["element"] for r in lost),
+            "never-read-count": len(never_read),
+            "never-read": sorted(r["element"] for r in never_read),
+            "stale-count": len(stale),
+            "stale": sorted(r["element"] for r in stale),
+            "worst-stale": worst_stale,
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items())),
+        }
+        points = (0, 0.5, 0.95, 0.99, 1)
+        if stable_latencies:
+            out["stable-latencies"] = frequency_distribution(
+                points, stable_latencies)
+        if lost_latencies:
+            out["lost-latencies"] = frequency_distribution(
+                points, lost_latencies)
+        return out
+
+
+def set_full(checker_opts=None):
+    return SetFull(checker_opts)
+
+
+# ---------------------------------------------------------------------------
+# Total queue — checker.clj:534-628
+# ---------------------------------------------------------------------------
+
+def expand_queue_drain_ops(history) -> History:
+    """Expand ok :drain ops into dequeue invoke/ok pairs
+    (checker.clj:534-564)."""
+    out = []
+    for o in History(history):
+        if o.f != "drain":
+            out.append(o)
+        elif o.is_invoke or o.is_fail:
+            continue
+        elif o.is_ok:
+            for el in o.value or []:
+                out.append(o.assoc(type="invoke", f="dequeue", value=None))
+                out.append(o.assoc(type="ok", f="dequeue", value=el))
+        else:
+            raise ValueError(
+                f"Not sure how to handle a crashed drain operation: {o}")
+    return History(out)
+
+
+class TotalQueue(Checker):
+    """What goes in must come out (checker.clj:566-628).  Multiset algebra
+    runs on device for large integer-valued histories."""
+
+    DEVICE_THRESHOLD = 4096
+
+    def check(self, test, history, opts=None):
+        h = expand_queue_drain_ops(history)
+        attempts: Counter = Counter()
+        enqueues: Counter = Counter()
+        dequeues: Counter = Counter()
+        for o in h:
+            if o.f == "enqueue" and o.is_invoke:
+                attempts[o.value] += 1
+            elif o.f == "enqueue" and o.is_ok:
+                enqueues[o.value] += 1
+            elif o.f == "dequeue" and o.is_ok:
+                dequeues[o.value] += 1
+
+        ok = dequeues & attempts
+        unexpected = Counter({k: v for k, v in dequeues.items()
+                              if k not in attempts})
+        duplicated = dequeues - attempts - unexpected
+        lost = enqueues - dequeues
+        recovered = ok - enqueues
+
+        def total(c):
+            return sum(c.values())
+
+        return {
+            "valid?": not lost and not unexpected,
+            "attempt-count": total(attempts),
+            "acknowledged-count": total(enqueues),
+            "ok-count": total(ok),
+            "unexpected-count": total(unexpected),
+            "duplicated-count": total(duplicated),
+            "lost-count": total(lost),
+            "recovered-count": total(recovered),
+            "lost": dict(lost),
+            "unexpected": dict(unexpected),
+            "duplicated": dict(duplicated),
+            "recovered": dict(recovered),
+        }
+
+
+def total_queue():
+    return TotalQueue()
+
+
+# ---------------------------------------------------------------------------
+# Unique IDs — checker.clj:630-676
+# ---------------------------------------------------------------------------
+
+class UniqueIds(Checker):
+    DEVICE_THRESHOLD = 4096
+
+    def check(self, test, history, opts=None):
+        attempted = 0
+        acks = []
+        for o in History(history):
+            if o.f == "generate" and o.is_invoke:
+                attempted += 1
+            elif o.f == "generate" and o.is_ok:
+                acks.append(o.value)
+
+        from jepsen_tpu.ops import fold
+
+        if fold.all_ints(acks) and len(acks) >= self.DEVICE_THRESHOLD:
+            counts, mask = fold.duplicate_counts(acks)
+            dups = {v: int(c) for v, c, m in zip(acks, counts, mask) if m}
+        else:
+            dups = {k: v for k, v in Counter(acks).items() if v > 1}
+        rng = [min(acks), max(acks)] if acks else [None, None]
+        return {
+            "valid?": not dups,
+            "attempted-count": attempted,
+            "acknowledged-count": len(acks),
+            "duplicated-count": len(dups),
+            "duplicated": dict(sorted(dups.items(), key=lambda kv: -kv[1])[:48]),
+            "range": rng,
+        }
+
+
+def unique_ids():
+    return UniqueIds()
+
+
+# ---------------------------------------------------------------------------
+# Counter — checker.clj:678-755
+# ---------------------------------------------------------------------------
+
+class CounterChecker(Checker):
+    """Interval-bound counter analysis (checker.clj:678-755): at each
+    read, the value must lie within [lower, upper] where `lower` tracks
+    ok'd increments + attempted decrements and `upper` attempted
+    increments + ok'd decrements, unioned over the read's concurrency
+    window — a read tuple is [min-lower-in-window, v,
+    max-upper-in-window], matching the reference's golden fixtures
+    (checker_test.clj:88-163).  Bounds are prefix sums; the device
+    kernel ops/fold.counter_bounds computes them for long histories."""
+
+    def check(self, test, history, opts=None):
+        h = History(history)
+        # Pair ops; drop failed pairs entirely (reference removes :fails?
+        # invocations and fail completions, checker.clj:696-699).
+        failed_inv = set()
+        open_inv: dict = {}
+        for pos, o in enumerate(h):
+            if o.is_invoke:
+                open_inv[o.process] = pos
+            elif o.is_fail and o.process in open_inv:
+                failed_inv.add(open_inv.pop(o.process))
+
+        lower = upper = 0
+        pending_reads: dict = {}  # process -> [min_lower, max_upper]
+        reads = []
+        for pos, o in enumerate(h):
+            if pos in failed_inv or o.is_fail:
+                continue
+            if o.f == "read" and o.is_invoke:
+                pending_reads[o.process] = [lower, upper]
+            elif o.f == "read" and o.is_ok:
+                lo, hi = pending_reads.pop(o.process, [lower, upper])
+                reads.append((lo, o.value, hi))
+            elif o.f == "add" and (o.is_invoke or o.is_ok):
+                v = o.value
+                if o.is_invoke:
+                    lower, upper = ((lower, upper + v) if v > 0 else
+                                    (lower + v, upper))
+                else:
+                    lower, upper = ((lower + v, upper) if v > 0 else
+                                    (lower, upper + v))
+                for rs in pending_reads.values():
+                    rs[0] = min(rs[0], lower)
+                    rs[1] = max(rs[1], upper)
+        errors = [r for r in reads if not r[0] <= r[1] <= r[2]]
+        return {"valid?": not errors,
+                "reads": [list(r) for r in reads],
+                "errors": [list(r) for r in errors]}
+
+
+def counter():
+    return CounterChecker()
+
+
+# ---------------------------------------------------------------------------
+# Graph checkers (latency/rate/clock plots) — wired to checker.perf
+# ---------------------------------------------------------------------------
+
+class LatencyGraph(Checker):
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.checker import perf as perf_mod
+        perf_mod.point_graph(test, history, opts or {})
+        perf_mod.quantiles_graph(test, history, opts or {})
+        return {"valid?": True}
+
+
+class RateGraph(Checker):
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.checker import perf as perf_mod
+        perf_mod.rate_graph(test, history, opts or {})
+        return {"valid?": True}
+
+
+def latency_graph():
+    return LatencyGraph()
+
+
+def rate_graph():
+    return RateGraph()
+
+
+def perf():
+    """Assorted performance statistics (checker.clj:774-778)."""
+    return compose({"latency-graph": latency_graph(),
+                    "rate-graph": rate_graph()})
+
+
+class ClockPlot(Checker):
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.checker import clock as clock_mod
+        clock_mod.plot(test, history, opts or {})
+        return {"valid?": True}
+
+
+def clock_plot():
+    return ClockPlot()

@@ -1,0 +1,127 @@
+"""Masked segmented folds on device — the O(n) checker kernels.
+
+The reference's commutative checkers (`set`, `total-queue`, `unique-ids`,
+`counter` — `jepsen/src/jepsen/checker.clj:182-233,569-755`) are O(n)
+folds over histories.  On TPU these become sort-based set algebra over
+packed int64 columns: membership, multiset difference/intersection, and
+duplicate detection all reduce to one `sort` plus vectorized compares,
+which XLA maps onto the VPU with no host round-trips.
+
+Every kernel here is shape-polymorphic via jit caching and takes plain
+int64 arrays (produced by `history.pack()` / the checkers' column
+extraction).  Checkers fall back to pure-Python multisets when values
+aren't integers; these kernels are the large-history fast path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+@functools.cache
+def _kernels():
+    import jax
+    import jax.numpy as jnp
+
+    def member_counts(xs, ys):
+        """For each x in xs: multiplicity of x in ys.  Both int64[...]."""
+        order = jnp.argsort(ys)
+        ys_s = ys[order]
+        lo = jnp.searchsorted(ys_s, xs, side="left")
+        hi = jnp.searchsorted(ys_s, xs, side="right")
+        return hi - lo
+
+    @jax.jit
+    def set_kernel(attempts, adds, final_read):
+        """The `set` checker's algebra (checker.clj:182-233) in one fused
+        program.  attempts/adds: values of invoked / ok'd :add ops;
+        final_read: elements of the last ok :read.  Returns boolean masks
+        over the inputs (host side maps them back to elements)."""
+        read_attempted = member_counts(final_read, attempts) > 0
+        # ok = final_read ∩ attempts ; unexpected = final_read \ attempts
+        ok_mask = read_attempted
+        unexpected_mask = ~read_attempted
+        # lost = adds \ final_read
+        lost_mask = member_counts(adds, final_read) == 0
+        # recovered = ok \ adds
+        in_adds = member_counts(final_read, adds) > 0
+        recovered_mask = ok_mask & ~in_adds
+        return ok_mask, unexpected_mask, lost_mask, recovered_mask
+
+    @jax.jit
+    def dup_kernel(xs):
+        """Duplicate detection: for each x, count>1?  Returns (multiplicity
+        per element, duplicate mask)."""
+        counts = member_counts(xs, xs)
+        return counts, counts > 1
+
+    @jax.jit
+    def multiset_minus_mask(xs, ys):
+        """Multiset difference xs ∸ ys as a keep-mask over xs: the k-th
+        occurrence (in sorted order) of value v in xs survives iff
+        k >= count(v in ys)."""
+        order = jnp.argsort(xs, stable=True)
+        s = xs[order]
+        n = s.shape[0]
+        idx = jnp.arange(n)
+        first = jnp.searchsorted(s, s, side="left")
+        occurrence = idx - first  # 0-based occurrence number within its run
+        cut = member_counts(s, ys)
+        keep_sorted = occurrence >= cut
+        keep = jnp.zeros(n, bool).at[order].set(keep_sorted)
+        return keep
+
+    @jax.jit
+    def counter_bounds(is_inv_add, is_ok_add, values):
+        """Prefix lower/upper counter bounds after each event
+        (checker.clj:678-755): an attempted decrement / ok'd increment
+        moves `lower`; an attempted increment / ok'd decrement moves
+        `upper`."""
+        v = values
+        dl = jnp.where(is_inv_add & (v < 0), v, 0) + \
+            jnp.where(is_ok_add & (v > 0), v, 0)
+        du = jnp.where(is_inv_add & (v > 0), v, 0) + \
+            jnp.where(is_ok_add & (v < 0), v, 0)
+        return jnp.cumsum(dl), jnp.cumsum(du)
+
+    return {
+        "set": set_kernel,
+        "dups": dup_kernel,
+        "multiset_minus_mask": multiset_minus_mask,
+        "counter_bounds": counter_bounds,
+    }
+
+
+def _i64(xs) -> np.ndarray:
+    return np.asarray(list(xs), np.int64).reshape(-1)
+
+
+def all_ints(xs) -> bool:
+    return all(isinstance(x, int) and not isinstance(x, bool) for x in xs)
+
+
+def set_masks(attempts, adds, final_read):
+    """Device-evaluated masks for the set checker; see set_kernel."""
+    k = _kernels()["set"]
+    out = k(_i64(attempts), _i64(adds), _i64(final_read))
+    return tuple(np.asarray(m) for m in out)
+
+
+def duplicate_counts(xs):
+    k = _kernels()["dups"]
+    counts, mask = k(_i64(xs))
+    return np.asarray(counts), np.asarray(mask)
+
+
+def multiset_minus_mask(xs, ys):
+    k = _kernels()["multiset_minus_mask"]
+    return np.asarray(k(_i64(xs), _i64(ys)))
+
+
+def counter_bounds(is_inv_add, is_ok_add, values):
+    k = _kernels()["counter_bounds"]
+    lo, hi = k(np.asarray(is_inv_add, bool), np.asarray(is_ok_add, bool),
+               _i64(values))
+    return np.asarray(lo), np.asarray(hi)

@@ -1,0 +1,475 @@
+"""Checker tests — ports the reference's golden fixtures
+(`jepsen/test/jepsen/checker_test.clj`): queue-test :11, total-queue-test
+:33, counter-test :88, compose-test :166, set-full-test :249, plus set /
+unique-ids / linearizable coverage and the device (JAX fold) fast paths.
+"""
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import models
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+
+
+def indexed(ops):
+    """knossos `history` test-helper parity: assign index i and
+    time i * 1e6 ns."""
+    h = History(ops)
+    for i, o in enumerate(h):
+        o.index = i
+        o.time = i * 1_000_000
+    return h
+
+
+def check(c, h, test=None, opts=None):
+    return c.check(test, indexed(h), opts or {})
+
+
+# ---------------------------------------------------------------------------
+# merge-valid / compose / check-safe
+# ---------------------------------------------------------------------------
+
+def test_merge_valid():
+    assert ck.merge_valid([]) is True
+    assert ck.merge_valid([True, True]) is True
+    assert ck.merge_valid([True, "unknown"]) == "unknown"
+    assert ck.merge_valid([True, "unknown", False]) is False
+    with pytest.raises(ValueError):
+        ck.merge_valid([None])
+
+
+def test_compose():
+    r = check(ck.compose({"a": ck.unbridled_optimism(),
+                          "b": ck.unbridled_optimism()}), [])
+    assert r == {"a": {"valid?": True}, "b": {"valid?": True},
+                 "valid?": True}
+
+
+def test_compose_merges_invalid():
+    class Bad(ck.Checker):
+        def check(self, test, history, opts=None):
+            return {"valid?": False}
+
+    r = check(ck.compose({"good": ck.unbridled_optimism(), "bad": Bad()}), [])
+    assert r["valid?"] is False
+
+
+def test_check_safe_wraps_errors():
+    class Boom(ck.Checker):
+        def check(self, test, history, opts=None):
+            raise RuntimeError("kaboom")
+
+    r = ck.check_safe(Boom(), None, History([]))
+    assert r["valid?"] == "unknown"
+    assert "kaboom" in r["error"]
+
+
+# ---------------------------------------------------------------------------
+# queue-test (checker_test.clj:11-31)
+# ---------------------------------------------------------------------------
+
+class TestQueue:
+    def test_empty(self):
+        assert check(ck.queue(None), [])["valid?"] is True
+
+    def test_possible_enqueue_no_dequeue(self):
+        r = check(ck.queue(models.unordered_queue()),
+                  [invoke_op(1, "enqueue", 1)])
+        assert r["valid?"] is True
+
+    def test_definite_enqueue_no_dequeue(self):
+        r = check(ck.queue(models.unordered_queue()),
+                  [ok_op(1, "enqueue", 1)])
+        assert r["valid?"] is True
+
+    def test_concurrent_enqueue_dequeue(self):
+        r = check(ck.queue(models.unordered_queue()),
+                  [invoke_op(2, "dequeue", None),
+                   invoke_op(1, "enqueue", 1),
+                   ok_op(2, "dequeue", 1)])
+        assert r["valid?"] is True
+
+    def test_dequeue_no_enqueue(self):
+        r = check(ck.queue(models.unordered_queue()),
+                  [ok_op(1, "dequeue", 1)])
+        assert r["valid?"] is False
+
+
+# ---------------------------------------------------------------------------
+# total-queue-test (checker_test.clj:33-86)
+# ---------------------------------------------------------------------------
+
+class TestTotalQueue:
+    def test_empty(self):
+        assert check(ck.total_queue(), [])["valid?"] is True
+
+    def test_sane(self):
+        r = check(ck.total_queue(),
+                  [invoke_op(1, "enqueue", 1),
+                   invoke_op(2, "enqueue", 2),
+                   ok_op(2, "enqueue", 2),
+                   invoke_op(3, "dequeue", 1),
+                   ok_op(3, "dequeue", 1),
+                   invoke_op(3, "dequeue", 2),
+                   ok_op(3, "dequeue", 2)])
+        assert r == {"valid?": True,
+                     "duplicated": {}, "lost": {}, "unexpected": {},
+                     "recovered": {1: 1},
+                     "attempt-count": 2, "acknowledged-count": 1,
+                     "ok-count": 2, "unexpected-count": 0,
+                     "lost-count": 0, "duplicated-count": 0,
+                     "recovered-count": 1}
+
+    def test_pathological(self):
+        r = check(ck.total_queue(),
+                  [invoke_op(1, "enqueue", "hung"),
+                   invoke_op(2, "enqueue", "enqueued"),
+                   ok_op(2, "enqueue", "enqueued"),
+                   invoke_op(3, "enqueue", "dup"),
+                   ok_op(3, "enqueue", "dup"),
+                   invoke_op(4, "dequeue", None),
+                   invoke_op(5, "dequeue", None),
+                   ok_op(5, "dequeue", "wtf"),
+                   invoke_op(6, "dequeue", None),
+                   ok_op(6, "dequeue", "dup"),
+                   invoke_op(7, "dequeue", None),
+                   ok_op(7, "dequeue", "dup")])
+        assert r == {"valid?": False,
+                     "lost": {"enqueued": 1},
+                     "unexpected": {"wtf": 1},
+                     "recovered": {},
+                     "duplicated": {"dup": 1},
+                     "acknowledged-count": 2, "attempt-count": 3,
+                     "ok-count": 1, "lost-count": 1, "unexpected-count": 1,
+                     "duplicated-count": 1, "recovered-count": 0}
+
+    def test_drain_expansion(self):
+        r = check(ck.total_queue(),
+                  [invoke_op(1, "enqueue", 1),
+                   ok_op(1, "enqueue", 1),
+                   invoke_op(2, "drain", None),
+                   ok_op(2, "drain", [1])])
+        assert r["valid?"] is True
+        assert r["ok-count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# counter-test (checker_test.clj:88-163)
+# ---------------------------------------------------------------------------
+
+class TestCounter:
+    def test_empty(self):
+        assert check(ck.counter(), []) == \
+            {"valid?": True, "reads": [], "errors": []}
+
+    def test_initial_read(self):
+        assert check(ck.counter(),
+                     [invoke_op(0, "read", None), ok_op(0, "read", 0)]) == \
+            {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+    def test_ignore_failed_ops(self):
+        assert check(ck.counter(),
+                     [invoke_op(0, "add", 1),
+                      fail_op(0, "add", 1),
+                      invoke_op(0, "read", None),
+                      ok_op(0, "read", 0)]) == \
+            {"valid?": True, "reads": [[0, 0, 0]], "errors": []}
+
+    def test_initial_invalid_read(self):
+        assert check(ck.counter(),
+                     [invoke_op(0, "read", None), ok_op(0, "read", 1)]) == \
+            {"valid?": False, "reads": [[0, 1, 0]], "errors": [[0, 1, 0]]}
+
+    def test_interleaved(self):
+        r = check(ck.counter(),
+                  [invoke_op(0, "read", None),
+                   invoke_op(1, "add", 1),
+                   invoke_op(2, "read", None),
+                   invoke_op(3, "add", 2),
+                   invoke_op(4, "read", None),
+                   invoke_op(5, "add", 4),
+                   invoke_op(6, "read", None),
+                   invoke_op(7, "add", 8),
+                   invoke_op(8, "read", None),
+                   ok_op(0, "read", 6),
+                   ok_op(1, "add", 1),
+                   ok_op(2, "read", 0),
+                   ok_op(3, "add", 2),
+                   ok_op(4, "read", 3),
+                   ok_op(5, "add", 4),
+                   ok_op(6, "read", 100),
+                   ok_op(7, "add", 8),
+                   ok_op(8, "read", 15)])
+        assert r == {"valid?": False,
+                     "reads": [[0, 6, 15], [0, 0, 15], [0, 3, 15],
+                               [0, 100, 15], [0, 15, 15]],
+                     "errors": [[0, 100, 15]]}
+
+    def test_rolling(self):
+        r = check(ck.counter(),
+                  [invoke_op(0, "read", None),
+                   invoke_op(1, "add", 1),
+                   ok_op(0, "read", 0),
+                   invoke_op(0, "read", None),
+                   ok_op(1, "add", 1),
+                   invoke_op(1, "add", 2),
+                   ok_op(0, "read", 3),
+                   invoke_op(0, "read", None),
+                   ok_op(1, "add", 2),
+                   ok_op(0, "read", 5)])
+        assert r == {"valid?": False,
+                     "reads": [[0, 0, 1], [0, 3, 3], [1, 5, 3]],
+                     "errors": [[1, 5, 3]]}
+
+
+# ---------------------------------------------------------------------------
+# set (checker.clj:182-233)
+# ---------------------------------------------------------------------------
+
+class TestSet:
+    def test_never_read(self):
+        r = check(ck.set_checker(), [invoke_op(0, "add", 0)])
+        assert r["valid?"] == "unknown"
+
+    def test_ok(self):
+        r = check(ck.set_checker(),
+                  [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                   invoke_op(0, "add", 1), ok_op(0, "add", 1),
+                   invoke_op(1, "read", None), ok_op(1, "read", [0, 1])])
+        assert r["valid?"] is True
+        assert r["ok-count"] == 2
+        assert r["ok"] == "#{0..1}"
+
+    def test_lost_and_unexpected(self):
+        r = check(ck.set_checker(),
+                  [invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                   invoke_op(0, "add", 1), ok_op(0, "add", 1),
+                   invoke_op(1, "read", None), ok_op(1, "read", [1, 5])])
+        assert r["valid?"] is False
+        assert r["lost"] == "#{0}"
+        assert r["unexpected"] == "#{5}"
+
+    def test_recovered(self):
+        # An add we never saw complete, but whose element appears.
+        r = check(ck.set_checker(),
+                  [invoke_op(0, "add", 3),
+                   invoke_op(1, "read", None), ok_op(1, "read", [3])])
+        assert r["valid?"] is True
+        assert r["recovered-count"] == 1
+
+    def test_device_path_matches_host(self):
+        n = ck.Set.DEVICE_THRESHOLD
+        ops = []
+        for i in range(n):
+            ops.append(invoke_op(0, "add", i))
+            if i % 3 != 0:
+                ops.append(ok_op(0, "add", i))
+        final = [i for i in range(n) if i % 5 != 0] + [n + 17]
+        ops += [invoke_op(1, "read", None), ok_op(1, "read", final)]
+        r = check(ck.set_checker(), ops)
+        lost = [i for i in range(n) if i % 3 != 0 and i % 5 == 0]
+        assert r["valid?"] is False
+        assert r["lost-count"] == len(lost)
+        assert r["unexpected-count"] == 1
+        assert r["unexpected"] == "#{%d}" % (n + 17)
+
+
+def test_integer_interval_set_str():
+    assert ck.integer_interval_set_str([1, 2, 3, 5]) == "#{1..3 5}"
+    assert ck.integer_interval_set_str([]) == "#{}"
+    assert ck.integer_interval_set_str([7]) == "#{7}"
+
+
+# ---------------------------------------------------------------------------
+# unique-ids (checker.clj:630-676)
+# ---------------------------------------------------------------------------
+
+class TestUniqueIds:
+    def test_unique(self):
+        r = check(ck.unique_ids(),
+                  [invoke_op(0, "generate", None), ok_op(0, "generate", 1),
+                   invoke_op(0, "generate", None), ok_op(0, "generate", 2)])
+        assert r["valid?"] is True
+        assert r["range"] == [1, 2]
+
+    def test_dups(self):
+        r = check(ck.unique_ids(),
+                  [invoke_op(0, "generate", None), ok_op(0, "generate", 1),
+                   invoke_op(0, "generate", None), ok_op(0, "generate", 1)])
+        assert r["valid?"] is False
+        assert r["duplicated"] == {1: 2}
+
+    def test_device_path(self):
+        n = ck.UniqueIds.DEVICE_THRESHOLD
+        ops = []
+        for i in range(n):
+            ops.append(invoke_op(0, "generate", None))
+            ops.append(ok_op(0, "generate", i if i != 7 else 6))
+        r = check(ck.unique_ids(), ops)
+        assert r["valid?"] is False
+        assert r["duplicated"] == {6: 2}
+
+
+# ---------------------------------------------------------------------------
+# set-full-test (checker_test.clj:249-420)
+# ---------------------------------------------------------------------------
+
+def set_full_check(h):
+    return check(ck.set_full(), h)
+
+
+class TestSetFull:
+    def test_never_read(self):
+        r = set_full_check([invoke_op(0, "add", 0), ok_op(0, "add", 0)])
+        assert r["valid?"] == "unknown"
+        assert r["never-read"] == [0]
+        assert r["attempt-count"] == 1
+        assert r["lost"] == []
+
+    def test_never_confirmed_never_read(self):
+        r = set_full_check([invoke_op(0, "add", 0),
+                            invoke_op(1, "read", None),
+                            ok_op(1, "read", [])])
+        assert r["valid?"] == "unknown"
+        assert r["never-read"] == [0]
+
+    def test_successful_read_concurrent_or_after(self):
+        a, a_ok = invoke_op(0, "add", 0), ok_op(0, "add", 0)
+        r, r_pos = invoke_op(1, "read", None), ok_op(1, "read", [0])
+        for h in ([r, a, r_pos, a_ok],
+                  [r, a, a_ok, r_pos],
+                  [a, r, r_pos, a_ok],
+                  [a, r, a_ok, r_pos],
+                  [a, a_ok, r, r_pos]):
+            res = set_full_check([invoke_op(o.process, o.f, o.value)
+                                  if o.is_invoke else
+                                  ok_op(o.process, o.f, o.value)
+                                  for o in h])
+            assert res["valid?"] is True, h
+            assert res["stable-count"] == 1
+            assert res["stable-latencies"] == \
+                {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+    def test_absent_read_after(self):
+        r = set_full_check([invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                            invoke_op(1, "read", None),
+                            ok_op(1, "read", [])])
+        assert r["valid?"] is False
+        assert r["lost"] == [0]
+        assert r["lost-latencies"] == {0: 0, 0.5: 0, 0.95: 0, 0.99: 0, 1: 0}
+
+    def test_absent_read_concurrent(self):
+        a, a_ok = invoke_op(0, "add", 0), ok_op(0, "add", 0)
+        r, r_neg = invoke_op(1, "read", None), ok_op(1, "read", [])
+        for h in ([r, a, r_neg, a_ok],
+                  [r, a, a_ok, r_neg],
+                  [a, r, r_neg, a_ok],
+                  [a, r, a_ok, r_neg]):
+            res = set_full_check([invoke_op(o.process, o.f, o.value)
+                                  if o.is_invoke else
+                                  ok_op(o.process, o.f, o.value)
+                                  for o in h])
+            assert res["valid?"] == "unknown", h
+            assert res["never-read"] == [0]
+
+    def test_write_present_missing(self):
+        r = set_full_check(
+            [invoke_op(0, "add", 0),            # 0
+             invoke_op(1, "add", 1),            # 1
+             invoke_op(2, "read", None),        # 2
+             ok_op(2, "read", [1]),             # 3
+             ok_op(0, "add", 0),                # 4
+             ok_op(1, "add", 1),                # 5
+             invoke_op(2, "read", None),        # 6
+             ok_op(2, "read", [0, 1]),          # 7
+             invoke_op(2, "read", None),        # 8
+             ok_op(2, "read", [0]),             # 9
+             invoke_op(2, "read", None),        # 10
+             ok_op(2, "read", [])])             # 11
+        assert r["valid?"] is False
+        assert r["lost"] == [0, 1]
+        assert r["lost-count"] == 2
+        assert r["lost-latencies"] == {0: 3, 0.5: 4, 0.95: 4, 0.99: 4, 1: 4}
+
+    def test_write_flutter_stable_lost(self):
+        r = set_full_check(
+            [invoke_op(0, "add", 0),            # 0
+             ok_op(0, "add", 0),                # 1
+             invoke_op(1, "add", 1),            # 2
+             invoke_op(2, "read", None),        # 3
+             ok_op(2, "read", [1]),             # 4
+             ok_op(1, "add", 1),                # 5
+             invoke_op(2, "read", None),        # 6
+             invoke_op(3, "read", None),        # 7
+             ok_op(3, "read", [1]),             # 8
+             ok_op(2, "read", [0])])            # 9
+        assert r["valid?"] is False
+        assert r["lost"] == [0]
+        assert r["stale"] == [1]
+        assert r["stale-count"] == 1
+        assert r["lost-latencies"] == {0: 5, 0.5: 5, 0.95: 5, 0.99: 5, 1: 5}
+        assert r["stable-latencies"] == {0: 2, 0.5: 2, 0.95: 2, 0.99: 2, 1: 2}
+        ws = r["worst-stale"]
+        assert len(ws) == 1
+        assert ws[0]["element"] == 1
+        assert ws[0]["known"].index == 4
+        assert ws[0]["last-absent"].index == 6
+        assert ws[0]["stable-latency"] == 2
+
+    def test_duplicates(self):
+        r = set_full_check([invoke_op(0, "add", 0), ok_op(0, "add", 0),
+                            invoke_op(1, "read", None),
+                            ok_op(1, "read", [0, 0])])
+        assert r["valid?"] is False
+        assert r["duplicated"] == {0: 2}
+
+
+# ---------------------------------------------------------------------------
+# linearizable (checker.clj:127-158) — device and cpu algorithms
+# ---------------------------------------------------------------------------
+
+class TestLinearizable:
+    GOOD = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read", 1), ok_op(1, "read", 1)]
+    BAD = [invoke_op(0, "write", 1), ok_op(0, "write", 1),
+           invoke_op(1, "read", 2), ok_op(1, "read", 2)]
+
+    @pytest.mark.parametrize("algorithm", ["auto", "cpu", "device"])
+    def test_good(self, algorithm):
+        c = ck.linearizable({"model": models.cas_register(),
+                             "algorithm": algorithm})
+        assert check(c, self.GOOD)["valid?"] is True
+
+    @pytest.mark.parametrize("algorithm", ["auto", "cpu", "device"])
+    def test_bad(self, algorithm):
+        c = ck.linearizable({"model": models.cas_register(),
+                             "algorithm": algorithm})
+        r = check(c, self.BAD)
+        assert r["valid?"] is False
+
+    def test_requires_model(self):
+        with pytest.raises(ValueError):
+            ck.linearizable({})
+
+    def test_rich_model_falls_back_to_cpu(self):
+        c = ck.linearizable({"model": models.unordered_queue()})
+        r = check(c, [invoke_op(0, "enqueue", 1), ok_op(0, "enqueue", 1),
+                      invoke_op(1, "dequeue", None), ok_op(1, "dequeue", 1)])
+        assert r["valid?"] is True
+
+    def test_truncates_configs(self):
+        c = ck.linearizable({"model": models.cas_register(),
+                             "algorithm": "cpu"})
+        r = check(c, self.GOOD)
+        assert len(r.get("configs", [])) <= 10
+
+
+def test_info_ops_stay_concurrent():
+    # A crashed write may linearize later — or never.
+    h = [invoke_op(0, "write", 1), info_op(0, "write", 1),
+         invoke_op(1, "read", None), ok_op(1, "read", 1),
+         invoke_op(1, "read", None), ok_op(1, "read", None)]
+    for algo in ("cpu", "device"):
+        c = ck.linearizable({"model": models.cas_register(),
+                             "algorithm": algo})
+        assert check(c, h)["valid?"] is True
