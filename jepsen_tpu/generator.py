@@ -1,0 +1,730 @@
+"""Generators: composable, stateful operation sources.
+
+Port of the reference DSL (`jepsen/src/jepsen/generator.clj`): every
+object may act as a generator (constantly yielding itself), generators
+may sleep to pace the test, and ~30 combinators compose them.  "Big ol
+box of monads, really."
+
+Concurrency model: generators are called concurrently from worker
+threads; all shared state is lock-guarded.  The dynamic `*threads*`
+binding (generator.clj:56-73) becomes a thread-local stack bound by
+`with_threads`.  The reference implements `time-limit` by interrupting
+JVM threads (generator.clj:415-530, with a 100-line essay on interrupt
+races); Python threads can't be interrupted, so here a thread-local
+*deadline* stack bounds every sleep inside the limit — the observable
+semantics (ops stop at the deadline, nested limits compose via min,
+enclosing limits win) are preserved without the races.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time as time_mod
+from typing import Any, Callable, Iterable, Optional
+
+from jepsen_tpu.history import Op
+
+NEMESIS = "nemesis"
+
+
+# ---------------------------------------------------------------------------
+# Dynamic bindings: *threads* and the time-limit deadline stack
+# ---------------------------------------------------------------------------
+
+class _Dyn(threading.local):
+    def __init__(self):
+        self.threads: Optional[tuple] = None
+        self.deadlines: tuple = ()
+
+
+_dyn = _Dyn()
+
+
+def sort_processes(ps):
+    """knossos.history/sort-processes: integers ascending, then named
+    processes (like :nemesis) alphabetically."""
+    return tuple(sorted(ps, key=lambda p: (isinstance(p, str), p)))
+
+
+class with_threads:
+    """Bind *threads* for the duration of a block (generator.clj:65-73).
+    Asserts the collection is sorted."""
+
+    def __init__(self, threads):
+        threads = tuple(threads)
+        assert threads == sort_processes(threads), \
+            f"threads must be sorted: {threads}"
+        self.threads = threads
+
+    def __enter__(self):
+        self.saved = _dyn.threads
+        _dyn.threads = self.threads
+        return self.threads
+
+    def __exit__(self, *exc):
+        _dyn.threads = self.saved
+        return False
+
+
+def current_threads() -> tuple:
+    if _dyn.threads is None:
+        raise RuntimeError("*threads* is unbound; wrap in with_threads")
+    return _dyn.threads
+
+
+def process_to_thread(test, process):
+    """process mod concurrency, or the named thread itself
+    (generator.clj:74-80)."""
+    if isinstance(process, int) and not isinstance(process, bool):
+        return process % test["concurrency"]
+    return process
+
+
+def process_to_node(test, process):
+    """The node this process is likely talking to (generator.clj:82-88)."""
+    thread = process_to_thread(test, process)
+    if isinstance(thread, int):
+        nodes = test["nodes"]
+        return nodes[thread % len(nodes)]
+    return None
+
+
+def _now() -> float:
+    return time_mod.monotonic()
+
+
+def _deadline() -> Optional[float]:
+    return min(_dyn.deadlines) if _dyn.deadlines else None
+
+
+def sleep_seconds(dt: float) -> bool:
+    """Sleep up to dt seconds, truncated at the innermost enclosing
+    time-limit deadline.  Returns False if the deadline cut us short."""
+    d = _deadline()
+    if d is not None:
+        remaining = d - _now()
+        if remaining <= 0:
+            return False
+        if dt > remaining:
+            time_mod.sleep(remaining)
+            return False
+    if dt > 0:
+        time_mod.sleep(dt)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# The protocol: anything can generate
+# ---------------------------------------------------------------------------
+
+class Generator:
+    def op(self, test, process):
+        """Yield an operation (dict/Op), or None when exhausted."""
+        raise NotImplementedError
+
+
+def op(gen, test, process):
+    """Draw an operation from anything generator-shaped
+    (generator.clj:27-54): None yields None; Generator delegates;
+    callables are tried as f(test, process) then f(); any other object
+    yields itself."""
+    if gen is None:
+        return None
+    if isinstance(gen, Generator):
+        return gen.op(test, process)
+    if callable(gen):
+        try:
+            return gen(test, process)
+        except TypeError as e:
+            if "positional argument" not in str(e):
+                raise
+            return gen()
+    return gen
+
+
+def op_and_validate(gen, test, process):
+    """generator.clj:30-39: ensure the generator produced an op-shaped
+    value (dict/Op) or None."""
+    o = op(gen, test, process)
+    if o is not None and not isinstance(o, (dict, Op)):
+        raise TypeError(f"invalid op from generator {gen!r}: {o!r}")
+    return o
+
+
+class _Fn(Generator):
+    def __init__(self, f):
+        self.f = f
+
+    def op(self, test, process):
+        return self.f(test, process)
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+
+class Void(Generator):
+    """Terminates immediately (generator.clj GVoid)."""
+
+    def op(self, test, process):
+        return None
+
+
+void = Void()
+
+
+class Map(Generator):
+    """Transform ops with f(op, test, process) or f(op)
+    (generator.clj:142-155)."""
+
+    def __init__(self, f, gen):
+        self.f, self.gen = f, gen
+
+    def op(self, test, process):
+        o = op(self.gen, test, process)
+        if o is None:
+            return None
+        try:
+            return self.f(o, test, process)
+        except TypeError as e:
+            if "positional argument" not in str(e):
+                raise
+            return self.f(o)
+
+
+def gmap(f, gen):
+    return Map(f, gen)
+
+
+def _op_get(o, k, default=None):
+    return o.get(k, default) if isinstance(o, (dict, Op)) else default
+
+
+def _op_assoc(o, **kw):
+    if isinstance(o, Op):
+        return o.assoc(**kw)
+    o = dict(o)
+    o.update(kw)
+    return o
+
+
+def f_map(fmap: dict, gen):
+    """Rewrite op :f tags through a map — for composed nemeses
+    (generator.clj:157-163)."""
+    return Map(lambda o: _op_assoc(o, f=fmap.get(_op_get(o, "f"),
+                                                 _op_get(o, "f"))), gen)
+
+
+class DelayFn(Generator):
+    """Every op takes f() extra seconds (generator.clj:177-185)."""
+
+    def __init__(self, f, gen):
+        self.f, self.gen = f, gen
+
+    def op(self, test, process):
+        if not sleep_seconds(self.f()):
+            return None  # deadline hit mid-delay
+        return op(self.gen, test, process)
+
+
+def delay_fn(f, gen):
+    return DelayFn(f, gen)
+
+
+def delay(dt, gen):
+    assert dt > 0
+    return DelayFn(lambda: dt, gen)
+
+
+def sleep(dt):
+    """dt seconds of nothing (generator.clj:192-195)."""
+    return delay(dt, void)
+
+
+def stagger(dt, gen):
+    """Uniform random delay in [0, 2dt) — mean dt (generator.clj:197-202)."""
+    assert dt > 0
+    return DelayFn(lambda: random.uniform(0, 2 * dt), gen)
+
+
+class DelayTil(Generator):
+    """Emit as close as possible to multiples of dt from an anchor — 'for
+    triggering race conditions' (generator.clj:226-240)."""
+
+    def __init__(self, dt, gen, precache=True):
+        self.dt = dt
+        self.gen = gen
+        self.precache = precache
+        self.anchor = _now()
+
+    def _sleep_til_tick(self) -> bool:
+        now = _now()
+        tick = now + (self.dt - ((now - self.anchor) % self.dt))
+        return sleep_seconds(tick - now)
+
+    def op(self, test, process):
+        if self.precache:
+            o = op(self.gen, test, process)
+            if not self._sleep_til_tick():
+                return None
+            return o
+        if not self._sleep_til_tick():
+            return None
+        return op(self.gen, test, process)
+
+
+def delay_til(dt, gen, precache=True):
+    return DelayTil(dt, gen, precache)
+
+
+class Once(Generator):
+    """generator.clj:249-257."""
+
+    def __init__(self, source):
+        self.source = source
+        self.emitted = False
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            if self.emitted:
+                return None
+            self.emitted = True
+        return op(self.source, test, process)
+
+
+def once(source):
+    return Once(source)
+
+
+class Derefer(Generator):
+    """Build the generator later: deref a zero-arg fn on every op
+    (generator.clj:260-276)."""
+
+    def __init__(self, dgen: Callable):
+        self.dgen = dgen
+
+    def op(self, test, process):
+        return op(self.dgen(), test, process)
+
+
+def derefer(dgen):
+    return Derefer(dgen)
+
+
+class Log(Generator):
+    def __init__(self, msg):
+        self.msg = msg
+
+    def op(self, test, process):
+        import logging
+        logging.getLogger("jepsen").info(self.msg)
+        return None
+
+
+def log_every(msg):
+    return Log(msg)
+
+
+def log(msg):
+    return once(Log(msg))
+
+
+class Each(Generator):
+    """An independent copy of the underlying generator per process
+    (generator.clj:301-313)."""
+
+    def __init__(self, gen_fn: Callable):
+        self.gen_fn = gen_fn
+        self.gens: dict = {}
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            g = self.gens.get(process)
+            if g is None:
+                g = self.gens[process] = self.gen_fn()
+        return op(g, test, process)
+
+
+def each(gen_fn):
+    return Each(gen_fn)
+
+
+class Seq(Generator):
+    """One op from each generator in sequence; a nil moves to the next
+    (generator.clj:327-345).  Accepts (possibly infinite) iterables."""
+
+    def __init__(self, coll: Iterable):
+        self.it = iter(coll)
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        while True:
+            with self.lock:
+                g = next(self.it, None)
+            if g is None:
+                return None
+            o = op(g, test, process)
+            if o is not None:
+                return o
+
+
+def gseq(coll):
+    return Seq(coll)
+
+
+def start_stop(t1, t2):
+    """start after t1 s, stop after t2 s, forever (generator.clj:347-355)."""
+    def cycle():
+        while True:
+            yield sleep(t1)
+            yield {"type": "info", "f": "start"}
+            yield sleep(t2)
+            yield {"type": "info", "f": "stop"}
+    return Seq(cycle())
+
+
+class Mix(Generator):
+    """Uniform random choice between generators (generator.clj:348-366)."""
+
+    def __init__(self, gens):
+        self.gens = list(gens)
+
+    def op(self, test, process):
+        return op(random.choice(self.gens), test, process)
+
+
+def mix(gens):
+    gens = list(gens)
+    return Mix(gens) if gens else void
+
+
+class _Cas(Generator):
+    """Random cas/read/write over a small integer field
+    (generator.clj:358-372)."""
+
+    def op(self, test, process):
+        r = random.random()
+        if r > 0.66:
+            return {"type": "invoke", "f": "read", "value": None}
+        if r > 0.33:
+            return {"type": "invoke", "f": "write",
+                    "value": random.randint(0, 4)}
+        return {"type": "invoke", "f": "cas",
+                "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+cas = _Cas()
+
+
+class QueueGen(Generator):
+    """Random enqueue/dequeue over consecutive ints
+    (generator.clj:373-385)."""
+
+    def __init__(self):
+        self.i = -1
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        if random.random() < 0.5:
+            with self.lock:
+                self.i += 1
+                v = self.i
+            return {"type": "invoke", "f": "enqueue", "value": v}
+        return {"type": "invoke", "f": "dequeue", "value": None}
+
+
+def queue_gen():
+    return QueueGen()
+
+
+class DrainQueue(Generator):
+    """After the source is exhausted, emit enough dequeues to cover every
+    attempted enqueue (generator.clj:387-403)."""
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.outstanding = 0
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        o = op(self.gen, test, process)
+        if o is not None:
+            if _op_get(o, "f") == "enqueue":
+                with self.lock:
+                    self.outstanding += 1
+            return o
+        with self.lock:
+            self.outstanding -= 1
+            remaining = self.outstanding
+        if remaining >= 0:
+            return {"type": "invoke", "f": "dequeue", "value": None}
+        return None
+
+
+def drain_queue(gen):
+    return DrainQueue(gen)
+
+
+class Limit(Generator):
+    """Only n operations (generator.clj:405-413)."""
+
+    def __init__(self, n, gen):
+        self.remaining = n
+        self.gen = gen
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            if self.remaining <= 0:
+                return None
+            self.remaining -= 1
+        return op(self.gen, test, process)
+
+
+def limit(n, gen):
+    return Limit(n, gen)
+
+
+class TimeLimit(Generator):
+    """Ops from the source until dt seconds elapse
+    (generator.clj:415-530).  The deadline starts at the first op draw;
+    it also bounds sleeps inside the source via the deadline stack, so a
+    staggered generator can't overshoot."""
+
+    def __init__(self, dt, source):
+        self.dt = dt
+        self.source = source
+        self.deadline: Optional[float] = None
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            if self.deadline is None:
+                self.deadline = _now() + self.dt
+        if _now() > self.deadline:
+            return None
+        saved = _dyn.deadlines
+        _dyn.deadlines = saved + (self.deadline,)
+        try:
+            return op(self.source, test, process)
+        finally:
+            _dyn.deadlines = saved
+
+
+def time_limit(dt, source):
+    return TimeLimit(dt, source)
+
+
+class Filter(Generator):
+    """Only ops satisfying f (generator.clj:541-552)."""
+
+    def __init__(self, f, gen):
+        self.f, self.gen = f, gen
+
+    def op(self, test, process):
+        while True:
+            o = op(self.gen, test, process)
+            if o is None:
+                return None
+            if self.f(o):
+                return o
+
+
+def gfilter(f, gen):
+    return Filter(f, gen)
+
+
+class On(Generator):
+    """Forward ops iff f(thread); rebind *threads* to the matching subset
+    (generator.clj:554-566)."""
+
+    def __init__(self, f, source):
+        self.f, self.source = f, source
+
+    def op(self, test, process):
+        if not self.f(process_to_thread(test, process)):
+            return None
+        sub = tuple(t for t in current_threads() if self.f(t))
+        with with_threads(sub):
+            return op(self.source, test, process)
+
+
+def on(f, source):
+    if isinstance(f, (set, frozenset)):
+        members = frozenset(f)
+        return On(lambda t: t in members, source)
+    return On(f, source)
+
+
+class Reserve(Generator):
+    """Partition threads into dedicated generator ranges with a default
+    (generator.clj:568-607)."""
+
+    def __init__(self, ranges, default):
+        self.ranges = ranges  # [(lower, upper, gen)] in thread-index space
+        self.default = default
+
+    def op(self, test, process):
+        threads = list(current_threads())
+        thread = process_to_thread(test, process)
+        idx = threads.index(thread)
+        for lower, upper, gen in self.ranges:
+            if idx < upper:
+                with with_threads(tuple(threads[lower:upper])):
+                    return op(gen, test, process)
+        lower = self.ranges[-1][1] if self.ranges else 0
+        with with_threads(sort_processes(threads[lower:])):
+            return op(self.default, test, process)
+
+
+def reserve(*args):
+    """reserve(5, write_gen, 10, cas_gen, read_gen): first 5 threads run
+    write_gen, next 10 cas_gen, the rest the default."""
+    assert args, "reserve requires a default generator"
+    *pairs, default = args
+    assert len(pairs) % 2 == 0, "reserve takes count/generator pairs"
+    ranges = []
+    n = 0
+    for i in range(0, len(pairs), 2):
+        count, gen = pairs[i], pairs[i + 1]
+        ranges.append((n, n + count, gen))
+        n += count
+    return Reserve(ranges, default)
+
+
+class Concat(Generator):
+    """First non-nil op from each source in order; each process advances
+    through sources independently (generator.clj:609-630)."""
+
+    def __init__(self, *sources):
+        self.sources = list(sources)
+        self.processes: dict = {}
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        while True:
+            with self.lock:
+                i = self.processes.get(process, 0)
+            if i >= len(self.sources):
+                return None
+            o = op(self.sources[i], test, process)
+            if o is not None:
+                return o
+            with self.lock:
+                if self.processes.get(process, 0) == i:
+                    self.processes[process] = i + 1
+
+
+def concat(*sources):
+    return Concat(*sources)
+
+
+def nemesis(nemesis_gen, client_gen=None):
+    """Route the :nemesis thread to nemesis_gen, clients to client_gen
+    (generator.clj:632-641)."""
+    if client_gen is None:
+        return on({NEMESIS}, nemesis_gen)
+    return concat(on({NEMESIS}, nemesis_gen),
+                  on(lambda t: t != NEMESIS, client_gen))
+
+
+def clients(client_gen):
+    """Executes generator only on clients (generator.clj:643-646)."""
+    return on(lambda t: t != NEMESIS, client_gen)
+
+
+class Await(Generator):
+    """Block until f returns (once), then delegate
+    (generator.clj:648-663)."""
+
+    def __init__(self, f, gen=None):
+        self.f, self.gen = f, gen
+        self.state = "waiting"
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        if self.state == "waiting":
+            with self.lock:
+                if self.state == "waiting":
+                    self.f()
+                    self.state = "ready"
+        return op(self.gen, test, process)
+
+
+def gawait(f, gen=None):
+    return Await(f, gen)
+
+
+class Synchronize(Generator):
+    """Block until every thread in *threads* is waiting on this
+    generator, then proceed; synchronizes once (generator.clj:664-688)."""
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.state: Any = "fresh"
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        if self.state != "clear":
+            with self.lock:
+                if self.state == "fresh":
+                    self.state = threading.Barrier(
+                        len(current_threads()),
+                        action=lambda: setattr(self, "state", "clear"))
+            barrier = self.state
+            if barrier != "clear":
+                # Bound the wait by any enclosing time-limit deadline: the
+                # reference interrupts barrier-blocked threads at the
+                # deadline (generator.clj:515-524, BrokenBarrierException
+                # -> nil); we time the wait out instead, which breaks the
+                # barrier for every wait-er identically.
+                d = _deadline()
+                try:
+                    barrier.wait(None if d is None else
+                                 max(d - _now(), 0.001))
+                except threading.BrokenBarrierError:
+                    if _deadline() is not None and _deadline() <= _now():
+                        return None
+                    raise
+        return op(self.gen, test, process)
+
+
+def synchronize(gen):
+    return Synchronize(gen)
+
+
+def phases(*generators):
+    """concat, but all threads finish each phase before the next
+    (generator.clj:690-694)."""
+    return concat(*[synchronize(g) for g in generators])
+
+
+def then(a, b):
+    """b, synchronize, then a — backwards so it reads well in pipelines
+    (generator.clj:696-700)."""
+    return concat(b, synchronize(a))
+
+
+class SingleThreaded(Generator):
+    """Exclusive lock around the underlying generator
+    (generator.clj:702-709)."""
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            return op(self.gen, test, process)
+
+
+def singlethreaded(gen):
+    return SingleThreaded(gen)
+
+
+def barrier(gen):
+    """When gen completes, synchronize, then nil (generator.clj:706-709)."""
+    return then(void, gen)

@@ -1,0 +1,239 @@
+"""Generator DSL tests — ports `jepsen/test/jepsen/generator_test.clj`:
+the `ops` harness (:12-27), object/fn generators (:29-35), seq/complex/
+log/then/each/nemesis-phase semantics (:37-99), and the time-limit
+behaviors (:102-151)."""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import generator as gen
+
+NODES = ("a", "b", "c", "d", "e")
+A_TEST = {"nodes": list(NODES)}
+
+
+def ops(threads, g):
+    """Drive a generator with one real thread per logical thread id,
+    collecting ops until exhaustion (generator_test.clj:12-27)."""
+    threads = gen.sort_processes(threads)
+    out = []
+    lock = threading.Lock()
+    test = dict(A_TEST)
+    test["concurrency"] = sum(1 for t in threads if isinstance(t, int))
+    errors = []
+
+    def worker(p):
+        try:
+            with gen.with_threads(threads):
+                while True:
+                    o = gen.op(g, test, p)
+                    if o is None:
+                        return
+                    with lock:
+                        out.append(o)
+        except Exception as e:  # surfaced below
+            errors.append(e)
+
+    ts = [threading.Thread(target=worker, args=(p,), daemon=True)
+          for p in threads]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+        assert not t.is_alive(), "generator worker hung"
+    if errors:
+        raise errors[0]
+    return out
+
+
+def test_objects_as_generators():
+    assert gen.op(2, A_TEST, 1) == 2
+    assert gen.op({"foo": 2}, A_TEST, 1) == {"foo": 2}
+
+
+def test_fns_as_generators():
+    assert gen.op(lambda a, b: [a, b], "test", "process") == \
+        ["test", "process"]
+    assert gen.op(lambda: {"f": "x"}, A_TEST, 1) == {"f": "x"}
+
+
+def test_none_generator():
+    assert gen.op(None, A_TEST, 1) is None
+
+
+def test_op_and_validate():
+    with pytest.raises(TypeError):
+        gen.op_and_validate(42, A_TEST, 1)
+    assert gen.op_and_validate({"f": "read"}, A_TEST, 1) == {"f": "read"}
+
+
+def test_seq():
+    got = ops(NODES, gen.gseq(list(range(100))))
+    assert set(got) == set(range(100))
+
+
+def test_complex():
+    """generator_test.clj:42-53: queue limited to 100 then four onces."""
+    g = gen.then(gen.once({"value": "d"}),
+                 gen.then(gen.once({"value": "c"}),
+                          gen.then(gen.once({"value": "b"}),
+                                   gen.then(gen.once({"value": "a"}),
+                                            gen.limit(100, gen.queue_gen())))))
+    got = ops(NODES, g)
+    assert len(got) == 104
+    assert [o["value"] for o in got[-4:]] == ["a", "b", "c", "d"]
+    values = {o.get("value") for o in got}
+    assert values <= set(range(99)) | {None, "a", "b", "c", "d"}
+
+
+def test_log_phases():
+    got = ops(NODES, gen.phases(gen.log("start"),
+                                gen.limit(len(NODES), {"value": "hi"}),
+                                gen.log("stop")))
+    assert got == [{"value": "hi"}] * len(NODES)
+
+
+def test_then_on_subset():
+    got = ops(NODES,
+              gen.phases(gen.on({"c", "d"},
+                                gen.then(gen.once(2), gen.once(1)))))
+    assert got == [1, 2]
+
+
+def test_each():
+    got = ops(NODES, gen.each(lambda: gen.once("a")))
+    assert got == ["a"] * 5
+
+
+def test_nemesis_phases():
+    """nemesis can take part in synchronization barriers."""
+    got = ops(("nemesis",) + NODES,
+              gen.phases(gen.once("a"), gen.once("b")))
+    assert got == ["a", "b"]
+
+
+def test_nemesis_filtered():
+    """generator_test.clj:83-99."""
+    got = ops(("nemesis",) + NODES,
+              gen.phases(
+                  gen.nemesis(gen.once("start"), gen.once("start")),
+                  gen.nemesis(gen.once("nem")),
+                  gen.on(lambda t: t != "nemesis",
+                         gen.synchronize(gen.each(lambda: gen.once("*")))),
+                  gen.on({"c", "d"},
+                         gen.then(gen.once("d"), gen.once("c")))))
+    assert got == ["start", "start", "nem", "*", "*", "*", "*", "*",
+                   "c", "d"]
+
+
+def test_mix_and_filter():
+    g = gen.limit(50, gen.gfilter(lambda o: o["f"] == "read",
+                                  gen.mix([{"f": "read"}, {"f": "read"}])))
+    got = ops((0, 1), g)
+    assert len(got) == 50
+    assert all(o["f"] == "read" for o in got)
+
+
+def test_f_map():
+    g = gen.limit(3, gen.f_map({"start": "begin"}, {"f": "start"}))
+    got = ops((0,), g)
+    assert got == [{"f": "begin"}] * 3
+
+
+def test_reserve():
+    seen = {}
+    lock = threading.Lock()
+
+    def tag(name):
+        def f(test, process):
+            with lock:
+                seen.setdefault(name, set()).add(process)
+            return None  # exhaust immediately
+        return f
+
+    g = gen.reserve(2, tag("w"), 2, tag("c"), tag("r"))
+    ops((0, 1, 2, 3, 4, 5), g)
+    assert seen["w"] == {0, 1}
+    assert seen["c"] == {2, 3}
+    assert seen["r"] == {4, 5}
+
+
+def test_stagger_and_delay_produce():
+    g = gen.time_limit(5, gen.limit(5, gen.stagger(0.001, gen.cas)))
+    got = ops((0, 1), g)
+    assert len(got) == 5
+    assert all(o["type"] == "invoke" for o in got)
+
+
+def test_drain_queue():
+    g = gen.drain_queue(gen.limit(10, gen.queue_gen()))
+    got = ops((0,), g)
+    enq = sum(1 for o in got if o["f"] == "enqueue")
+    deq = sum(1 for o in got if o["f"] == "dequeue")
+    assert deq >= enq
+
+
+def test_once_is_once():
+    got = ops(NODES, gen.once({"f": "x"}))
+    assert got == [{"f": "x"}]
+
+
+def test_await():
+    calls = []
+    got = ops((0, 1), gen.gawait(lambda: calls.append(1), gen.once("z")))
+    assert calls == [1]
+    assert got == ["z"]
+
+
+class TestTimeLimit:
+    def test_short_delays(self):
+        got = ops(NODES, gen.time_limit(
+            1, gen.delay(0.1, gen.gseq(iter(range(10**6))))))
+        n = len(NODES) * (1 / 0.1)
+        assert 0.7 * n <= len(got) <= 1.3 * n
+
+    def test_long_delays(self):
+        t1 = time.monotonic()
+        got = ops(NODES, gen.time_limit(
+            0.1, gen.delay(1, gen.gseq(iter(range(10**6))))))
+        t2 = time.monotonic()
+        assert got == []
+        assert 0.08 < t2 - t1 < 0.3
+
+    def test_long_inside_short(self):
+        t1 = time.monotonic()
+        got = ops(NODES, gen.time_limit(
+            0.2, gen.time_limit(
+                10, gen.delay(0.15, gen.gseq(iter(range(10**6)))))))
+        t2 = time.monotonic()
+        assert sorted(got) == list(range(len(NODES)))
+        assert 0.18 <= t2 - t1 <= 0.4
+
+    def test_short_inside_long(self):
+        t1 = time.monotonic()
+        got = ops(NODES, gen.time_limit(
+            10, gen.time_limit(
+                0.2, gen.delay(0.15, gen.gseq(iter(range(10**6)))))))
+        t2 = time.monotonic()
+        assert sorted(got) == list(range(len(NODES)))
+        assert 0.18 <= t2 - t1 <= 0.4
+
+    def test_around_a_barrier(self):
+        t1 = time.monotonic()
+        got = ops(NODES, gen.time_limit(
+            0.2, gen.phases(
+                gen.delay(0.1, gen.each(lambda: gen.once("a"))),
+                gen.delay(1, "b"))))
+        t2 = time.monotonic()
+        assert got == ["a"] * len(NODES)
+        assert 0.18 <= t2 - t1 <= 0.5
+
+
+def test_process_to_node():
+    test = {"nodes": ["n1", "n2", "n3"], "concurrency": 6}
+    assert gen.process_to_node(test, 0) == "n1"
+    assert gen.process_to_node(test, 4) == "n2"  # thread 4 -> node 4 mod 3
+    assert gen.process_to_node(test, 7) == "n2"  # process 7 -> thread 1
+    assert gen.process_to_node(test, "nemesis") is None
