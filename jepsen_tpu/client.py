@@ -1,0 +1,49 @@
+"""Client protocol: applies operations to a database
+(reference: `jepsen/src/jepsen/client.clj:8-35`)."""
+
+from __future__ import annotations
+
+
+class Client:
+    """DB client lifecycle.  `open` binds to a node and must not affect
+    logical test state; `setup` prepares DB state once; `invoke` applies
+    one op and returns the completion; `close`/`teardown` mirror them."""
+
+    def open(self, test, node) -> "Client":
+        return self
+
+    def close(self, test) -> None:
+        pass
+
+    def setup(self, test) -> None:
+        pass
+
+    def invoke(self, test, op):
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+
+class Noop(Client):
+    """client.clj:29-35: acks everything."""
+
+    def invoke(self, test, op):
+        return op.assoc(type="ok")
+
+
+noop = Noop()
+
+
+def open_client(client: Client, test, node) -> Client:
+    """open! + setup! (client.clj open-compat! :37-50)."""
+    c = client.open(test, node)
+    assert c is not None, f"client.open returned None from {client!r}"
+    c.setup(test)
+    return c
+
+
+def close_client(client: Client, test) -> None:
+    """teardown! + close! (client.clj close-compat! :60-70)."""
+    client.teardown(test)
+    client.close(test)

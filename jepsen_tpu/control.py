@@ -1,0 +1,394 @@
+"""Remote execution / control plane — L0 of the framework.
+
+Port of `jepsen/src/jepsen/control.clj`: dynamic-scoped remote execution
+over SSH (`*host* *session* *dir* *sudo* *password* *trace* *dummy*`
+:16-27), shell escaping :54-97, sudo/cd wrapping :99-114, retries
+:141-161, exec :176, SCP upload/download :199-231, sessions :296-312,
+and the parallel node fan-out `on-nodes` :369-385.
+
+The transport is the system `ssh`/`scp` binaries with a persistent
+ControlMaster socket per node (the reference holds persistent JSch
+sessions wrapped in reconnectors).  The `dummy` transport (control.clj
+`*dummy*` :16,300) skips SSH entirely and records commands — that is
+what in-process tests and the fake DB use.
+"""
+
+from __future__ import annotations
+
+import logging
+import os as _os
+import shlex
+import subprocess
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from jepsen_tpu.util import real_pmap
+
+log = logging.getLogger("jepsen.control")
+
+DEFAULT_SSH = {
+    "username": "root",
+    "password": None,
+    "port": 22,
+    "private-key-path": None,
+    "strict-host-key-checking": False,
+    "dummy": False,
+}
+
+
+class RemoteError(Exception):
+    """Nonzero exit (control.clj throws :type ::nonzero-exit)."""
+
+    def __init__(self, cmd, exit, out, err, host=None):
+        super().__init__(
+            f"command {cmd!r} on {host} exited {exit}: {err or out}")
+        self.cmd, self.exit, self.out, self.err, self.host = \
+            cmd, exit, out, err, host
+
+
+class _Dyn(threading.local):
+    """The dynamic vars of control.clj:16-27."""
+
+    def __init__(self):
+        self.host: Optional[str] = None
+        self.session: Optional["Session"] = None
+        self.dir: str = "/"
+        self.sudo: Optional[str] = None
+        self.password: Optional[str] = None
+        self.trace: bool = False
+        self.retries: int = 5
+
+
+_dyn = _Dyn()
+_ssh_opts = dict(DEFAULT_SSH)
+_ssh_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Shell escaping + command wrapping (control.clj:54-114)
+# ---------------------------------------------------------------------------
+
+class Literal:
+    """An unescaped shell fragment (control.clj lit)."""
+
+    def __init__(self, s: str):
+        self.s = s
+
+    def __str__(self):
+        return self.s
+
+
+def lit(s: str) -> Literal:
+    return Literal(s)
+
+
+def escape(arg: Any) -> str:
+    """Escape one argument for the remote shell (control.clj:54-97)."""
+    if isinstance(arg, Literal):
+        return str(arg)
+    if isinstance(arg, (list, tuple)):
+        return " ".join(escape(a) for a in arg)
+    s = str(arg)
+    if s == "":
+        return "\"\""
+    return shlex.quote(s)
+
+
+def wrap_cd(cmd: str) -> str:
+    if _dyn.dir and _dyn.dir != "/":
+        return f"cd {shlex.quote(_dyn.dir)}; {cmd}"
+    return cmd
+
+
+def wrap_sudo(cmd: str) -> str:
+    if _dyn.sudo:
+        return f"sudo -S -u {_dyn.sudo} bash -c {shlex.quote(cmd)}"
+    return cmd
+
+
+# ---------------------------------------------------------------------------
+# Sessions
+# ---------------------------------------------------------------------------
+
+class Session:
+    node: str
+
+    def run(self, cmd: str, stdin: Optional[str] = None
+            ) -> tuple[int, str, str]:
+        raise NotImplementedError
+
+    def upload(self, local: str, remote: str) -> None:
+        raise NotImplementedError
+
+    def download(self, remote: str, local: str) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class DummySession(Session):
+    """No-SSH transport: records commands, returns '' (control.clj:16,300).
+    An optional `handler(node, cmd, stdin)` fakes output."""
+
+    def __init__(self, node, handler: Optional[Callable] = None):
+        self.node = node
+        self.handler = handler
+        self.commands: list[tuple[str, Optional[str]]] = []
+        self.lock = threading.Lock()
+
+    def run(self, cmd, stdin=None):
+        with self.lock:
+            self.commands.append((cmd, stdin))
+        if self.handler is not None:
+            out = self.handler(self.node, cmd, stdin)
+            if isinstance(out, tuple):
+                return out
+            return 0, out or "", ""
+        return 0, "", ""
+
+    def upload(self, local, remote):
+        with self.lock:
+            self.commands.append((f"<upload {local} {remote}>", None))
+
+    def download(self, remote, local):
+        with self.lock:
+            self.commands.append((f"<download {remote} {local}>", None))
+
+
+class SSHSession(Session):
+    """Persistent SSH via the system binary + ControlMaster socket."""
+
+    def __init__(self, node: str, opts: dict):
+        self.node = node
+        self.opts = opts
+        self.ctl_dir = tempfile.mkdtemp(prefix="jepsen-ssh-")
+        self.ctl_path = _os.path.join(self.ctl_dir, "ctl")
+
+    def _base(self, prog: str) -> list[str]:
+        o = self.opts
+        args = [prog,
+                "-o", f"ControlPath={self.ctl_path}",
+                "-o", "ControlMaster=auto",
+                "-o", "ControlPersist=60",
+                "-o", "BatchMode=yes",
+                "-o", ("StrictHostKeyChecking=yes"
+                       if o.get("strict-host-key-checking")
+                       else "StrictHostKeyChecking=no"),
+                "-o", "UserKnownHostsFile=/dev/null",
+                "-o", "LogLevel=ERROR"]
+        if o.get("private-key-path"):
+            args += ["-i", o["private-key-path"]]
+        port = o.get("port", 22)
+        args += (["-P", str(port)] if prog == "scp" else ["-p", str(port)])
+        return args
+
+    def _target(self) -> str:
+        user = self.opts.get("username", "root")
+        return f"{user}@{self.node}" if user else self.node
+
+    def run(self, cmd, stdin=None):
+        argv = self._base("ssh") + [self._target(), cmd]
+        p = subprocess.run(argv, input=stdin, capture_output=True,
+                           text=True, timeout=self.opts.get("timeout", 600))
+        return p.returncode, p.stdout, p.stderr
+
+    def upload(self, local, remote):
+        argv = self._base("scp") + [local, f"{self._target()}:{remote}"]
+        p = subprocess.run(argv, capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"scp {local}", p.returncode, p.stdout,
+                              p.stderr, self.node)
+
+    def download(self, remote, local):
+        argv = self._base("scp") + [f"{self._target()}:{remote}", local]
+        p = subprocess.run(argv, capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"scp {remote}", p.returncode, p.stdout,
+                              p.stderr, self.node)
+
+    def close(self):
+        subprocess.run(self._base("ssh") + ["-O", "exit", self._target()],
+                       capture_output=True, text=True)
+
+
+_dummy_handler: Optional[Callable] = None
+
+
+def set_dummy_handler(handler: Optional[Callable]) -> None:
+    """Install a global fake-output handler for dummy sessions (tests)."""
+    global _dummy_handler
+    _dummy_handler = handler
+
+
+def session(node: str) -> Session:
+    """Opens a session to the given node (control.clj:296-312)."""
+    if _ssh_opts.get("dummy"):
+        return DummySession(node, _dummy_handler)
+    return SSHSession(node, dict(_ssh_opts))
+
+
+def disconnect(s: Session) -> None:
+    s.close()
+
+
+class with_ssh:
+    """Bind global SSH options for a test run (control.clj with-ssh)."""
+
+    def __init__(self, ssh: Optional[dict] = None):
+        self.ssh = dict(DEFAULT_SSH)
+        self.ssh.update(ssh or {})
+
+    def __enter__(self):
+        global _ssh_opts
+        with _ssh_lock:
+            self.saved = dict(_ssh_opts)
+            _ssh_opts = self.ssh
+        return self
+
+    def __exit__(self, *exc):
+        global _ssh_opts
+        with _ssh_lock:
+            _ssh_opts = self.saved
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Dynamic scope helpers (su / cd / with-session)
+# ---------------------------------------------------------------------------
+
+class _Binding:
+    def __init__(self, **kw):
+        self.kw = kw
+
+    def __enter__(self):
+        self.saved = {k: getattr(_dyn, k) for k in self.kw}
+        for k, v in self.kw.items():
+            setattr(_dyn, k, v)
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            setattr(_dyn, k, v)
+        return False
+
+
+def su(user: str = "root"):
+    """Run body commands as user (control.clj su :245)."""
+    return _Binding(sudo=user)
+
+
+def cd(directory: str):
+    """Run body commands within a directory (control.clj cd :260)."""
+    return _Binding(dir=directory)
+
+
+def with_session(node: str, sess: Session):
+    return _Binding(host=node, session=sess)
+
+
+def trace_on():
+    return _Binding(trace=True)
+
+
+# ---------------------------------------------------------------------------
+# Execution (control.clj:141-231)
+# ---------------------------------------------------------------------------
+
+def ssh_star(cmd: str, stdin: Optional[str] = None) -> tuple[int, str, str]:
+    """Run a raw command on the current session with retry on transient
+    transport failures (control.clj ssh* :141-161)."""
+    sess = _dyn.session
+    if sess is None:
+        raise RuntimeError("no session bound; use with_session/on")
+    last: Any = None
+    for attempt in range(max(_dyn.retries, 1)):
+        try:
+            rc, out, err = sess.run(cmd, stdin)
+            if rc == 255 and "corrupt" in (err or "").lower():
+                raise ConnectionError(err)  # "Packet corrupt" retry
+            return rc, out, err
+        except (ConnectionError, subprocess.TimeoutExpired) as e:
+            last = e
+            log.warning("ssh error on %s (attempt %d): %s",
+                        _dyn.host, attempt, e)
+            time.sleep(min(2 ** attempt * 0.1, 2.0))
+    raise RemoteError(cmd, -1, "", str(last), _dyn.host)
+
+
+def execute(*args, stdin: Optional[str] = None, check: bool = True) -> str:
+    """Execute a shell command built from escaped args; returns trimmed
+    stdout (control.clj exec :176)."""
+    cmd = wrap_sudo(wrap_cd(" ".join(escape(a) for a in args)))
+    if _dyn.trace:
+        log.info("trace: [%s] %s", _dyn.host, cmd)
+    if _dyn.sudo and _dyn.password and stdin is None:
+        stdin = _dyn.password + "\n"
+    rc, out, err = ssh_star(cmd, stdin)
+    if check and rc != 0:
+        raise RemoteError(cmd, rc, out, err, _dyn.host)
+    return out.strip()
+
+
+# Clojure-style alias: jepsen code reads c/exec everywhere.
+exec_ = execute
+
+
+def upload(local: str, remote: str) -> None:
+    """SCP a local file to the current node (control.clj:199)."""
+    assert _dyn.session is not None
+    _dyn.session.upload(local, remote)
+
+
+def upload_str(content: str, remote: str) -> None:
+    """Write a string to a remote file."""
+    import tempfile as tf
+    with tf.NamedTemporaryFile("w", delete=False) as f:
+        f.write(content)
+        path = f.name
+    try:
+        upload(path, remote)
+    finally:
+        _os.unlink(path)
+
+
+def download(remote: str, local: str) -> None:
+    """SCP a remote file to a local path (control.clj:220)."""
+    assert _dyn.session is not None
+    _os.makedirs(_os.path.dirname(local) or ".", exist_ok=True)
+    _dyn.session.download(remote, local)
+
+
+# ---------------------------------------------------------------------------
+# Node fan-out (control.clj:346-393)
+# ---------------------------------------------------------------------------
+
+def on(node: str, f: Callable, test: Optional[dict] = None):
+    """Run f() with the session for `node` bound (control.clj on :346).
+    Uses the test's session table when given, else opens a fresh one."""
+    sess = None
+    opened = False
+    if test is not None:
+        sess = (test.get("sessions") or {}).get(node)
+    if sess is None:
+        sess = session(node)
+        opened = True
+    try:
+        with with_session(node, sess):
+            return f()
+    finally:
+        if opened:
+            sess.close()
+
+
+def on_nodes(test: dict, f: Callable, nodes=None) -> dict:
+    """Evaluate f(test, node) in parallel on each node, with that node's
+    session bound; returns {node: result} (control.clj on-nodes :369-385)."""
+    nodes = list(test.get("nodes") or []) if nodes is None else list(nodes)
+
+    def run_one(node):
+        return node, on(node, lambda: f(test, node), test)
+
+    return dict(real_pmap(run_one, nodes))

@@ -1,0 +1,400 @@
+"""Test orchestration — L5, the core runtime.
+
+Port of `jepsen/src/jepsen/core.clj`: `run()` coordinates SSH sessions,
+OS/DB setup, worker threads (one logically-single-threaded *process* per
+concurrency slot plus a *nemesis*), history collection, analysis, and
+teardown.  The analysis phase (`analyze`) hands the history to the
+checker — where this framework swaps knossos for the TPU kernels.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control, db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History, Op, op as to_op
+from jepsen_tpu.util import (fcatch, log_op, real_pmap, relative_time_nanos,
+                             with_relative_time)
+
+log = logging.getLogger("jepsen")
+
+NO_BARRIER = "::no-barrier"
+
+
+class WorkerAbort(Exception):
+    pass
+
+
+def synchronize(test, timeout_s: float = 60) -> None:
+    """Block until all nodes arrive (core.clj:40-53); used by IO-heavy DB
+    setup code."""
+    b = test.get("barrier")
+    if b is None or b == NO_BARRIER:
+        return
+    b.wait(timeout=timeout_s)
+
+
+def conj_op(test, op: Op) -> Op:
+    """Append an op to the test's history (core.clj:55-59)."""
+    history, lock = test["history"], test["history_lock"]
+    with lock:
+        history.append(op)
+    return op
+
+
+def primary(test):
+    """core.clj:61-64."""
+    return test["nodes"][0]
+
+
+# ---------------------------------------------------------------------------
+# Workers (core.clj:161-401)
+# ---------------------------------------------------------------------------
+
+class Worker:
+    """Synchronized setup/run/teardown lifecycle (core.clj:161-169)."""
+
+    name = "worker"
+
+    def __init__(self):
+        self.abort = threading.Event()
+
+    def abort_worker(self):
+        self.abort.set()
+
+    def setup_worker(self):
+        pass
+
+    def run_worker(self):
+        pass
+
+    def teardown_worker(self):
+        pass
+
+
+def invoke_op(op: Op, test, client, abort) -> Op:
+    """Apply an op to a client, converting exceptions to :info completions
+    — 'indeterminate' (core.clj:199-232)."""
+    try:
+        completion = client.invoke(test, op)
+        completion = to_op(completion).assoc(time=relative_time_nanos())
+    except BaseException as e:
+        if abort.is_set():
+            raise
+        log.warning("Process %s crashed", op.process, exc_info=True)
+        completion = op.assoc(type="info", time=relative_time_nanos(),
+                              error=f"indeterminate: {e}")
+    assert completion.type in ("ok", "fail", "info"), \
+        (f"Expected client.invoke to return an op with type ok, fail or "
+         f"info, but received {completion!r} instead")
+    assert completion.process == op.process
+    assert completion.f == op.f
+    return completion
+
+
+class ClientWorker(Worker):
+    """The op loop (core.clj ClientWorker :280-358): draw op, journal
+    invocation, invoke client, journal completion; on an indeterminate
+    (:info) completion the process is hung — renumber it by +concurrency
+    and reopen the client."""
+
+    def __init__(self, test, process_id: int, node):
+        super().__init__()
+        self.test = test
+        self.worker_number = process_id
+        self.process = process_id
+        self.node = node
+        self.client: Optional[client_mod.Client] = None
+        self.name = f"worker {process_id}"
+
+    def setup_worker(self):
+        self.client = client_mod.open_client(
+            self.test["client"], self.test, self.node)
+
+    def run_worker(self):
+        test = self.test
+        g = test["generator"]
+        with gen.with_threads(test["threads"]):
+            while True:
+                if self.abort.is_set():
+                    raise WorkerAbort()
+                op = gen.op_and_validate(g, test, self.process)
+                if op is None:
+                    return
+                op = to_op(op).assoc(process=self.process,
+                                     time=relative_time_nanos())
+                log_op(op)
+                if self.client is None:
+                    try:
+                        self.client = test["client"].open(test, self.node)
+                    except Exception as e:
+                        log.warning("Error opening client", exc_info=True)
+                        fail = op.assoc(type="fail",
+                                        error=["no-client", str(e)],
+                                        time=relative_time_nanos())
+                        conj_op(test, op)
+                        conj_op(test, fail)
+                        log_op(fail)
+                        self.client = None
+                        continue
+                conj_op(test, op)
+                completion = invoke_op(op, test, self.client, self.abort)
+                conj_op(test, completion)
+                log_op(completion)
+                if completion.is_info:
+                    # This process is hung: it cannot initiate another op
+                    # without violating the single-threaded process
+                    # constraint.  Cycle to a new process id; the
+                    # invocation stays concurrent forever
+                    # (core.clj:338-355).
+                    self.process += test["concurrency"]
+                    try:
+                        self.client.close(test)
+                    except Exception:
+                        pass
+                    self.client = None
+
+    def teardown_worker(self):
+        if self.client is not None:
+            client_mod.close_client(self.client, self.test)
+            self.client = None
+
+
+class NemesisWorker(Worker):
+    """core.clj NemesisWorker :370-396: runs the generator as process
+    :nemesis, journaling ops into every active history."""
+
+    name = "nemesis"
+
+    def __init__(self, test):
+        super().__init__()
+        self.test = test
+        self.nemesis = None
+
+    def setup_worker(self):
+        from jepsen_tpu import nemesis as nemesis_mod
+        self.nemesis = nemesis_mod.setup(self.test.get("nemesis"), self.test)
+
+    def _journal(self, op: Op):
+        log_op(op)
+        with self.test["active_histories_lock"]:
+            entries = list(self.test["active_histories"])
+        for history, lock in entries:
+            with lock:
+                history.append(op)
+
+    def run_worker(self):
+        from jepsen_tpu import nemesis as nemesis_mod
+        test = self.test
+        g = test["generator"]
+        with gen.with_threads(test["threads"]):
+            while True:
+                if self.abort.is_set():
+                    raise WorkerAbort()
+                op = gen.op_and_validate(g, test, gen.NEMESIS)
+                if op is None:
+                    return
+                op = to_op(op).assoc(process=gen.NEMESIS,
+                                     time=relative_time_nanos())
+                self._journal(op)
+                try:
+                    completion = self.nemesis.invoke(test, op)
+                    completion = to_op(completion).assoc(
+                        time=relative_time_nanos())
+                except Exception as e:
+                    if self.abort.is_set():
+                        raise
+                    log.warning("Nemesis crashed", exc_info=True)
+                    completion = op.assoc(
+                        type="info", time=relative_time_nanos(),
+                        error=f"indeterminate: {e}")
+                self._journal(completion)
+
+    def teardown_worker(self):
+        if self.nemesis is not None:
+            from jepsen_tpu import nemesis as nemesis_mod
+            nemesis_mod.teardown(self.nemesis, self.test)
+
+
+def run_workers(workers: list[Worker], test=None) -> None:
+    """Setup ∥, run ∥, teardown ∥ (core.clj run-workers! :171-197).  A
+    worker failure aborts its peers (and breaks generator barriers), like
+    the reference's real-pmap interrupt cascade."""
+
+    def phase(fn_name):
+        def call(w):
+            try:
+                getattr(w, fn_name)()
+            except (WorkerAbort, gen.Aborted):
+                pass
+            except BaseException:
+                if test is not None and "abort_event" in test:
+                    test["abort_event"].set()
+                for other in workers:
+                    other.abort_worker()
+                gen.abort_barriers()
+                raise
+        try:
+            real_pmap(call, workers)
+        except threading.BrokenBarrierError:
+            # secondary casualty of an abort cascade; the primary error
+            # already propagated from its own worker
+            pass
+
+    try:
+        phase("setup_worker")
+        phase("run_worker")
+    except BaseException:
+        # best-effort teardown that can't mask the original error
+        real_pmap(fcatch(lambda w: w.teardown_worker()), workers)
+        raise
+    else:
+        # teardown errors propagate (core.clj:190-196)
+        real_pmap(lambda w: w.teardown_worker(), workers)
+
+
+# ---------------------------------------------------------------------------
+# Cases + analysis (core.clj:403-465)
+# ---------------------------------------------------------------------------
+
+def run_case(test) -> History:
+    """Spawn nemesis + clients, run one case, return its history
+    (core.clj:403-432)."""
+    history = History()
+    lock = threading.RLock()
+    test["history"] = history
+    test["history_lock"] = lock
+    with test["active_histories_lock"]:
+        test["active_histories"].add((history, lock))
+    try:
+        nodes = test.get("nodes") or []
+        n = test["concurrency"]
+        client_nodes = [nodes[i % len(nodes)] if nodes else None
+                        for i in range(n)]
+        clients = [ClientWorker(test, i, node)
+                   for i, node in enumerate(client_nodes)]
+        workers = [NemesisWorker(test)] + clients
+        run_workers(workers, test)
+    finally:
+        with test["active_histories_lock"]:
+            test["active_histories"].discard((history, lock))
+    return history
+
+
+def analyze(test) -> dict:
+    """Index the history, run the checker, write results
+    (core.clj:434-451)."""
+    log.info("Analyzing...")
+    history = History(test["history"]).index()
+    test["history"] = history
+    test["results"] = checker_mod.check_safe(
+        test["checker"], test, history)
+    log.info("Analysis complete")
+    if test.get("name"):
+        from jepsen_tpu import store
+        store.save_2(test)
+    return test
+
+
+def log_results(test) -> dict:
+    """core.clj:453-465."""
+    r = test.get("results") or {}
+    ok = r.get("valid?") is True
+    log.info("%s\n\n%s", r,
+             "Everything looks good! ヽ(‘ー`)ノ" if ok
+             else "Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+    return test
+
+
+def run(test: dict) -> dict:
+    """Run a complete test (core.clj run! :467-570): provision OS + DB
+    over SSH, drive the generator through workers, collect the history,
+    analyze, tear down.  Returns the test map with :history and
+    :results."""
+    test = dict(test)
+    test["start-time"] = __import__("datetime").datetime.now().isoformat()
+    test.setdefault("concurrency", len(test.get("nodes") or []))
+    nodes = test.get("nodes") or []
+    test["barrier"] = threading.Barrier(len(nodes)) if nodes else NO_BARRIER
+    test["active_histories"] = set()
+    test["active_histories_lock"] = threading.Lock()
+    test["abort_event"] = threading.Event()
+    test["threads"] = gen.sort_processes(
+        [gen.NEMESIS] + list(range(test["concurrency"])))
+
+    if test.get("name"):
+        from jepsen_tpu import store
+        store.start_logging(test)
+    log.info("Running test: %s", test.get("name"))
+    try:
+        with control.with_ssh(test.get("ssh")):
+            sessions = dict(zip(nodes, real_pmap(control.session, nodes)))
+            test["sessions"] = sessions
+            try:
+                _with_os_db_run(test)
+            finally:
+                for s in sessions.values():
+                    fcatch(s.close)()
+                test.pop("sessions", None)
+        log_results(test)
+        return test
+    finally:
+        if test.get("name"):
+            from jepsen_tpu import store
+            store.stop_logging()
+
+
+def _snarf_logs(test) -> None:
+    """Download DB log files into the store (core.clj snarf-logs! :98)."""
+    db = test.get("db")
+    if not isinstance(db, db_mod.LogFiles) or not test.get("name"):
+        return
+    from jepsen_tpu import store
+
+    def snarf(tst, node):
+        for remote in db.log_files(tst, node):
+            local = store.path(tst, node, remote.lstrip("/"))
+            try:
+                control.download(remote, str(local))
+            except Exception:
+                log.info("could not download %s from %s", remote, node)
+
+    control.on_nodes(test, snarf)
+
+
+def _with_os_db_run(test) -> None:
+    os_obj = test.get("os")
+    db_obj = test.get("db")
+    try:
+        if os_obj is not None:
+            control.on_nodes(test, lambda t, n: os_obj.setup(t, n))
+        try:
+            if db_obj is not None:
+                db_mod.cycle(test)
+            _run_case_and_analyze(test)
+        finally:
+            _snarf_logs(test)
+            if db_obj is not None:
+                control.on_nodes(
+                    test, fcatch(lambda t, n: db_obj.teardown(t, n)))
+    finally:
+        if os_obj is not None:
+            control.on_nodes(test, fcatch(lambda t, n: os_obj.teardown(t, n)))
+
+
+def _run_case_and_analyze(test) -> None:
+    with with_relative_time():
+        history = run_case(test)
+        test["history"] = history
+        for k in ("barrier",):
+            test.pop(k, None)
+        log.info("Run complete, writing")
+        if test.get("name"):
+            from jepsen_tpu import store
+            store.save_1(test)
+        analyze(test)

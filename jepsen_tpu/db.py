@@ -1,0 +1,72 @@
+"""DB protocol + cycle (reference: `jepsen/src/jepsen/db.clj`)."""
+
+from __future__ import annotations
+
+import logging
+
+from jepsen_tpu import control
+from jepsen_tpu.util import fcatch
+
+log = logging.getLogger("jepsen")
+
+CYCLE_TRIES = 3  # db.clj:23
+
+
+class SetupFailed(Exception):
+    """Throw from DB.setup to request a teardown+setup retry
+    (db.clj ::setup-failed)."""
+
+
+class DB:
+    def setup(self, test, node) -> None:
+        pass
+
+    def teardown(self, test, node) -> None:
+        pass
+
+
+class Primary:
+    """Mixin: one-time setup on the primary (first) node (db.clj:12)."""
+
+    def setup_primary(self, test, node) -> None:
+        pass
+
+
+class LogFiles:
+    """Mixin: which files to snarf from each node (db.clj:15)."""
+
+    def log_files(self, test, node) -> list[str]:
+        return []
+
+
+class Noop(DB):
+    pass
+
+
+noop = Noop()
+
+
+def cycle(test) -> None:
+    """Teardown, then setup, the database on all nodes concurrently;
+    retry the whole dance up to CYCLE_TRIES times on SetupFailed
+    (db.clj:28-67)."""
+    db = test["db"]
+    tries = CYCLE_TRIES
+    while True:
+        log.info("Tearing down DB")
+        control.on_nodes(test, fcatch(lambda tst, node: db.teardown(tst, node)))
+        try:
+            log.info("Setting up DB")
+            control.on_nodes(test, lambda tst, node: db.setup(tst, node))
+            if isinstance(db, Primary) and test.get("nodes"):
+                primary = test["nodes"][0]
+                log.info("Setting up primary %s", primary)
+                control.on_nodes(
+                    test, lambda tst, node: db.setup_primary(tst, node),
+                    [primary])
+            return
+        except SetupFailed:
+            tries -= 1
+            if tries <= 0:
+                raise
+            log.warning("Unable to set up database; retrying...")

@@ -658,6 +658,26 @@ def gawait(f, gen=None):
     return Await(f, gen)
 
 
+# Live Synchronize barriers, so a crashed worker can unblock its peers
+# (the reference interrupts barrier-waiters: core_test.clj
+# generator-recovery-test).  abort_barriers() breaks them all.
+_live_barriers: set = set()
+_live_barriers_lock = threading.Lock()
+
+
+class Aborted(Exception):
+    """Raised from a generator when the test run is aborting."""
+
+
+def abort_barriers() -> None:
+    """Break every live generator barrier: waiters see
+    BrokenBarrierError and propagate it as a worker abort."""
+    with _live_barriers_lock:
+        barriers = list(_live_barriers)
+    for b in barriers:
+        b.abort()
+
+
 class Synchronize(Generator):
     """Block until every thread in *threads* is waiting on this
     generator, then proceed; synchronizes once (generator.clj:664-688)."""
@@ -669,13 +689,23 @@ class Synchronize(Generator):
 
     def op(self, test, process):
         if self.state != "clear":
+            abort_ev = (test or {}).get("abort_event")
+            if abort_ev is not None and abort_ev.is_set():
+                raise Aborted("test run aborting")
             with self.lock:
                 if self.state == "fresh":
-                    self.state = threading.Barrier(
+                    b = threading.Barrier(
                         len(current_threads()),
                         action=lambda: setattr(self, "state", "clear"))
+                    with _live_barriers_lock:
+                        _live_barriers.add(b)
+                    self.state = b
             barrier = self.state
             if barrier != "clear":
+                # close the register-vs-abort race: a barrier created
+                # after abort_barriers() iterated must still break
+                if abort_ev is not None and abort_ev.is_set():
+                    barrier.abort()
                 # Bound the wait by any enclosing time-limit deadline: the
                 # reference interrupts barrier-blocked threads at the
                 # deadline (generator.clj:515-524, BrokenBarrierException

@@ -1,0 +1,281 @@
+"""Key-independent scaling: lift a single-key test to a map of keys
+(reference: `jepsen/src/jepsen/independent.clj`).
+
+Linearizability is expensive to check, so histories must be short — but
+short histories can't reveal enough concurrency errors.  This layer
+splits a test into independent per-key components: generators shard
+threads into groups (one key per group), and the checker splits the
+history into per-key subhistories.
+
+This is the framework's **data-parallel axis**: `checker()` fans
+per-key subhistories out host-side (bounded_pmap, like the reference's
+independent.clj:247-298), and `batch_checker()` packs every per-key
+history into one columnar device program — `vmap` of the WGL kernel over
+keys, shardable over a TPU mesh (SURVEY.md §2.4, BASELINE config 3).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable, Iterable, Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History, Op
+from jepsen_tpu.util import bounded_pmap
+
+log = logging.getLogger("jepsen")
+
+DIR = "independent"
+
+
+class KV(tuple):
+    """A key/value tuple marking an op value as belonging to an
+    independent key (independent.clj tuple :21-29)."""
+
+    def __new__(cls, k, v):
+        return super().__new__(cls, (k, v))
+
+    @property
+    def key(self):
+        return self[0]
+
+    @property
+    def value(self):
+        return self[1]
+
+    def __repr__(self):
+        return f"[{self[0]!r} {self[1]!r}]"
+
+
+def tuple_(k, v) -> KV:
+    return KV(k, v)
+
+
+def is_tuple(v) -> bool:
+    return isinstance(v, KV)
+
+
+class SequentialGenerator(gen.Generator):
+    """One key at a time: exhaust fgen(k1), move to k2, ...
+    (independent.clj:31-64).  Op values are wrapped in KV tuples."""
+
+    def __init__(self, keys: Iterable, fgen: Callable):
+        import threading
+        self.lock = threading.Lock()
+        self.keys = list(keys)
+        self.i = 0
+        self.gen = fgen(self.keys[0]) if self.keys else None
+        self.fgen = fgen
+
+    def op(self, test, process):
+        while True:
+            with self.lock:
+                i, g = self.i, self.gen
+            if i >= len(self.keys):
+                return None
+            o = gen.op(g, test, process)
+            if o is not None:
+                k = self.keys[i]
+                v = o.get("value") if isinstance(o, dict) else o.value
+                return gen._op_assoc(o, value=KV(k, v))
+            with self.lock:
+                if self.i == i:  # we advance
+                    self.i += 1
+                    self.gen = (self.fgen(self.keys[self.i])
+                                if self.i < len(self.keys) else None)
+
+
+def sequential_generator(keys, fgen):
+    return SequentialGenerator(keys, fgen)
+
+
+class ConcurrentGenerator(gen.Generator):
+    """n threads per key, running keys concurrently in disjoint thread
+    groups; each group moves to a fresh key when its generator is
+    exhausted (independent.clj:66-220).  The nemesis does not enter
+    subgenerators."""
+
+    def __init__(self, n: int, keys: Iterable, fgen: Callable):
+        import threading
+        assert isinstance(n, int) and n > 0
+        self.n = n
+        self.keys = iter(keys)
+        self.fgen = fgen
+        self.lock = threading.Lock()
+        self.state: Optional[dict] = None
+
+    def _init_state(self, test):
+        threads = [t for t in gen.current_threads()
+                   if isinstance(t, int) and not isinstance(t, bool)]
+        thread_count = len(threads)
+        assert sorted(threads) == list(range(thread_count)), \
+            "concurrent-generator expects integer threads 0..n"
+        assert test["concurrency"] == thread_count, (
+            f"Expected test concurrency ({test['concurrency']}) to equal "
+            f"the number of integer threads ({thread_count})")
+        group_size = self.n
+        group_count = thread_count // group_size
+        assert group_size <= thread_count, (
+            f"With {thread_count} worker threads, this concurrent-generator"
+            f" cannot run a key with {group_size} threads concurrently."
+            f" Consider raising your test's concurrency to at least"
+            f" {group_size}.")
+        assert thread_count == group_size * group_count, (
+            f"This concurrent-generator has {thread_count} threads but can"
+            f" only use {group_size * group_count} of them to run"
+            f" {group_count} concurrent keys with {group_size} threads"
+            f" apiece. Consider a concurrency that is a multiple of"
+            f" {group_size}.")
+        active = []
+        for _ in range(group_count):
+            k = next(self.keys, _DONE)
+            active.append(None if k is _DONE else (k, self.fgen(k)))
+        self.state = {
+            "active": active,
+            "group_threads": [tuple(threads[g * group_size:
+                                            (g + 1) * group_size])
+                              for g in range(group_count)],
+            "group_size": group_size,
+        }
+
+    def op(self, test, process):
+        with self.lock:
+            if self.state is None:
+                self._init_state(test)
+            s = self.state
+        thread = gen.process_to_thread(test, process)
+        assert isinstance(thread, int), (
+            f"Only worker threads with numeric ids can ask for operations"
+            f" from concurrent-generator; got {thread!r}")
+        group = thread // s["group_size"]
+        while True:
+            with self.lock:
+                pair = s["active"][group]
+            if pair is None:
+                return None
+            k, g = pair
+            threads2 = s["group_threads"][group]
+            assert thread in threads2, (
+                f"Probably a bug: thread {thread} in group {group} isn't in"
+                f" that group's thread list {threads2}")
+            with gen.with_threads(threads2):
+                o = gen.op(g, test, process)
+            if o is not None:
+                v = o.get("value") if isinstance(o, dict) else o.value
+                return gen._op_assoc(o, value=KV(k, v))
+            with self.lock:
+                if self.state["active"][group] is pair:
+                    k2 = next(self.keys, _DONE)
+                    self.state["active"][group] = \
+                        None if k2 is _DONE else (k2, self.fgen(k2))
+
+
+_DONE = object()
+
+
+def concurrent_generator(n, keys, fgen):
+    return ConcurrentGenerator(n, keys, fgen)
+
+
+# ---------------------------------------------------------------------------
+# History splitting (independent.clj:222-245)
+# ---------------------------------------------------------------------------
+
+def history_keys(history) -> set:
+    return {o.value.key for o in History(history) if is_tuple(o.value)}
+
+
+def subhistory(k, history) -> History:
+    """All ops without a differing key; KV values unwrapped.  Un-keyed
+    ops (nemesis, info) appear in every subhistory."""
+    out = []
+    for o in History(history):
+        v = o.value
+        if not is_tuple(v):
+            out.append(o)
+        elif v.key == k:
+            out.append(o.assoc(value=v.value))
+    return History(out)
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+# ---------------------------------------------------------------------------
+
+class IndependentChecker(ck.Checker):
+    """Host-parallel per-key checking (independent.clj:247-298): valid
+    iff the underlying checker is valid for every subhistory; writes
+    per-key artifacts under independent/<k>/."""
+
+    def __init__(self, checker: ck.Checker):
+        self.checker = checker
+
+    def _check_key(self, test, history, opts, k):
+        h = subhistory(k, history)
+        subdir = list((opts or {}).get("subdirectory") or []) + [DIR, str(k)]
+        results = ck.check_safe(self.checker, test, h,
+                                {"subdirectory": subdir, "history-key": k})
+        if test and test.get("name") and test.get("start-time"):
+            from jepsen_tpu import store
+            try:
+                with open(store.make_path(test, *subdir, "results.json"),
+                          "w") as f:
+                    json.dump(store._jsonable_tree(results), f, indent=2,
+                              default=repr)
+                with open(store.make_path(test, *subdir, "history.jsonl"),
+                          "w") as f:
+                    f.write(h.to_jsonl())
+            except OSError:
+                log.warning("could not write independent results for %r", k)
+        return k, results
+
+    def check(self, test, history, opts=None):
+        ks = sorted(history_keys(history), key=repr)
+        results = dict(bounded_pmap(
+            lambda k: self._check_key(test, history, opts, k), ks))
+        failures = [k for k, r in results.items() if r["valid?"] is not True]
+        return {"valid?": ck.merge_valid(r["valid?"]
+                                         for r in results.values()),
+                "results": results,
+                "failures": failures}
+
+
+def checker(sub_checker: ck.Checker) -> IndependentChecker:
+    return IndependentChecker(sub_checker)
+
+
+class BatchedLinearizableChecker(ck.Checker):
+    """The TPU-native independent checker: every per-key subhistory is
+    packed into one columnar batch and the WGL frontier search runs as a
+    single `vmap`-over-keys device program, shardable over a mesh
+    (ops/wgl_batch.py).  Keys whose frontier overflows the batched
+    kernel's fixed size escalate automatically to the adaptive
+    single-history kernel."""
+
+    def __init__(self, model, frontier_size: int = 256, mesh=None):
+        self.model = model
+        self.frontier_size = frontier_size
+        self.mesh = mesh
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.ops import wgl_batch
+
+        ks = sorted(history_keys(history), key=repr)
+        if not ks:
+            return {"valid?": True, "results": {}, "failures": []}
+        subs = [subhistory(k, history) for k in ks]
+        per_key = wgl_batch.check_many(
+            self.model, subs, frontier_size=self.frontier_size,
+            mesh=self.mesh)
+        results = dict(zip(ks, per_key))
+        failures = [k for k, r in results.items() if r["valid?"] is not True]
+        return {"valid?": ck.merge_valid(r["valid?"]
+                                         for r in results.values()),
+                "results": results,
+                "failures": failures}
+
+
+def batch_checker(model, frontier_size: int = 256, mesh=None):
+    return BatchedLinearizableChecker(model, frontier_size, mesh)

@@ -1,0 +1,342 @@
+"""Fault injection: the nemesis layer
+(reference: `jepsen/src/jepsen/nemesis.clj`)."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import net as net_mod
+from jepsen_tpu import util
+from jepsen_tpu.history import Op
+
+
+class Nemesis:
+    """nemesis.clj:9-14."""
+
+    def setup(self, test) -> "Nemesis":
+        return self
+
+    def invoke(self, test, op: Op) -> Op:
+        raise NotImplementedError
+
+    def teardown(self, test) -> None:
+        pass
+
+
+class Noop(Nemesis):
+    def invoke(self, test, op):
+        return op
+
+
+noop = Noop()
+
+
+def setup(nemesis: Optional[Nemesis], test) -> Nemesis:
+    if nemesis is None:
+        return noop
+    return nemesis.setup(test) or nemesis
+
+
+def teardown(nemesis: Optional[Nemesis], test) -> None:
+    if nemesis is not None:
+        nemesis.teardown(test)
+
+
+class Timeout(Nemesis):
+    """Bound unreliable nemesis ops; timed-out ops get value 'timeout'
+    (nemesis.clj:56-70)."""
+
+    def __init__(self, timeout_ms: float, nemesis: Nemesis):
+        self.timeout_ms = timeout_ms
+        self.nemesis = nemesis
+
+    def setup(self, test):
+        self.nemesis = self.nemesis.setup(test) or self.nemesis
+        return self
+
+    def invoke(self, test, op):
+        return util.timeout(self.timeout_ms / 1000,
+                            op.assoc(value="timeout"),
+                            lambda: self.nemesis.invoke(test, op))
+
+    def teardown(self, test):
+        self.nemesis.teardown(test)
+
+
+def timeout(timeout_ms, nemesis):
+    return Timeout(timeout_ms, nemesis)
+
+
+# ---------------------------------------------------------------------------
+# Grudge topologies (pure; nemesis_test.clj:19-48 covers these)
+# ---------------------------------------------------------------------------
+
+def bisect(coll):
+    """Cut a sequence in half; smaller half first (nemesis.clj:72-75)."""
+    coll = list(coll)
+    mid = len(coll) // 2
+    return [coll[:mid], coll[mid:]]
+
+
+def split_one(coll, loner=None):
+    """Split one node off from the rest (nemesis.clj:77-82)."""
+    coll = list(coll)
+    if loner is None:
+        loner = random.choice(coll)
+    return [[loner], [x for x in coll if x != loner]]
+
+
+def complete_grudge(components) -> dict:
+    """No node may talk to any node outside its component
+    (nemesis.clj:84-96)."""
+    components = [set(comp) for comp in components]
+    universe = set().union(*components) if components else set()
+    grudge = {}
+    for comp in components:
+        for node in comp:
+            grudge[node] = universe - comp
+    return grudge
+
+
+def bridge(nodes) -> dict:
+    """Cut the network in half, preserving one bidirectional bridge node
+    (nemesis.clj:98-109)."""
+    components = bisect(nodes)
+    bridge_node = components[1][0]
+    grudge = complete_grudge(components)
+    grudge.pop(bridge_node, None)
+    return {node: others - {bridge_node}
+            for node, others in grudge.items()}
+
+
+def majorities_ring(nodes) -> dict:
+    """Every node sees a majority, but no two nodes see the same majority
+    (nemesis.clj:151-168)."""
+    nodes = list(nodes)
+    universe = set(nodes)
+    n = len(nodes)
+    m = util.majority(n)
+    shuffled = list(nodes)
+    random.shuffle(shuffled)
+    ring = shuffled * 2  # cycle
+    grudge = {}
+    for i in range(n):
+        maj = ring[i:i + m]
+        center = maj[len(maj) // 2]
+        grudge[center] = universe - set(maj)
+    return grudge
+
+
+# ---------------------------------------------------------------------------
+# Partitioner (nemesis.clj:111-172)
+# ---------------------------------------------------------------------------
+
+class Partitioner(Nemesis):
+    """:start cuts links per (grudge nodes); :stop heals."""
+
+    def __init__(self, grudge: Optional[Callable] = None):
+        self.grudge = grudge
+
+    def setup(self, test):
+        test["net"].heal(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            grudge = op.value or self.grudge(test["nodes"])
+            net_mod.drop_all(test, grudge)
+            return op.assoc(value=["isolated", {k: sorted(v) for k, v in
+                                                grudge.items()}])
+        if op.f == "stop":
+            test["net"].heal(test)
+            return op.assoc(value="network-healed")
+        raise ValueError(f"partitioner can't handle {op.f!r}")
+
+    def teardown(self, test):
+        test["net"].heal(test)
+
+
+def partitioner(grudge=None):
+    return Partitioner(grudge)
+
+
+def partition_halves():
+    """First half vs second half (nemesis.clj:134-139)."""
+    return Partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+
+
+def partition_random_halves():
+    """Randomly chosen halves (nemesis.clj:141-144)."""
+    def grudge(nodes):
+        nodes = list(nodes)
+        random.shuffle(nodes)
+        return complete_grudge(bisect(nodes))
+    return Partitioner(grudge)
+
+
+def partition_random_node():
+    """Isolate a single random node (nemesis.clj:146-149)."""
+    return Partitioner(lambda nodes: complete_grudge(split_one(nodes)))
+
+
+def partition_majorities_ring():
+    """nemesis.clj:170-172."""
+    return Partitioner(majorities_ring)
+
+
+# ---------------------------------------------------------------------------
+# Compose (nemesis.clj:174-212)
+# ---------------------------------------------------------------------------
+
+class Compose(Nemesis):
+    """Route ops to child nemeses by :f.  Keys are either sets of fs
+    (routed unchanged) or dicts rewriting outer f -> inner f."""
+
+    def __init__(self, nemeses: dict):
+        self.nemeses = dict(nemeses)
+
+    def _route(self, fs, f):
+        if isinstance(fs, dict):
+            return fs.get(f)
+        if callable(fs) and not isinstance(fs, (set, frozenset)):
+            return fs(f)
+        return f if f in fs else None
+
+    def setup(self, test):
+        self.nemeses = {fs: n.setup(test) or n
+                        for fs, n in self.nemeses.items()}
+        return self
+
+    def invoke(self, test, op):
+        for fs, nemesis in self.nemeses.items():
+            f2 = self._route(fs, op.f)
+            if f2 is not None:
+                return nemesis.invoke(test, op.assoc(f=f2)).assoc(f=op.f)
+        raise ValueError(f"no nemesis can handle {op.f!r}")
+
+    def teardown(self, test):
+        for n in self.nemeses.values():
+            n.teardown(test)
+
+
+def compose(nemeses: dict):
+    return Compose(nemeses)
+
+
+# ---------------------------------------------------------------------------
+# Clock, process, and file nemeses (nemesis.clj:214-323)
+# ---------------------------------------------------------------------------
+
+def set_time(t: float) -> None:
+    """Set the local node time in POSIX seconds (nemesis.clj:214-217)."""
+    with c.su():
+        c.execute("date", "+%s", "-s", f"@{int(t)}")
+
+
+class ClockScrambler(Nemesis):
+    """Randomizes node clocks within a ±dt-second window
+    (nemesis.clj:219-234)."""
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def invoke(self, test, op):
+        def f(tst, node):
+            set_time(time.time() + random.randint(-self.dt, self.dt))
+        return op.assoc(value=c.on_nodes(test, f))
+
+    def teardown(self, test):
+        c.on_nodes(test, lambda tst, node: set_time(time.time()))
+
+
+def clock_scrambler(dt):
+    return ClockScrambler(dt)
+
+
+class NodeStartStopper(Nemesis):
+    """Generic start!/stop! on targeted nodes (nemesis.clj:236-279)."""
+
+    def __init__(self, targeter, start, stop):
+        self.targeter = targeter
+        self.start = start
+        self.stop = stop
+        self.nodes = None
+        self.lock = threading.Lock()
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f == "start":
+                try:
+                    ns = self.targeter(test, test["nodes"])
+                except TypeError:
+                    ns = self.targeter(test["nodes"])
+                if ns is None:
+                    return op.assoc(type="info", value="no-target")
+                if not isinstance(ns, (list, tuple, set)):
+                    ns = [ns]
+                ns = list(ns)
+                if self.nodes is not None:
+                    return op.assoc(
+                        type="info",
+                        value=f"nemesis already disrupting {self.nodes}")
+                self.nodes = ns
+                value = {node: c.on(node,
+                                    lambda n=node: self.start(test, n),
+                                    test)
+                         for node in ns}
+                return op.assoc(type="info", value=value)
+            if op.f == "stop":
+                if self.nodes is None:
+                    return op.assoc(type="info", value="not-started")
+                value = {node: c.on(node,
+                                    lambda n=node: self.stop(test, n),
+                                    test)
+                         for node in self.nodes}
+                self.nodes = None
+                return op.assoc(type="info", value=value)
+        raise ValueError(f"node-start-stopper can't handle {op.f!r}")
+
+
+def node_start_stopper(targeter, start, stop):
+    return NodeStartStopper(targeter, start, stop)
+
+
+def hammer_time(process: str, targeter=None):
+    """SIGSTOP/SIGCONT a process on targeted nodes (nemesis.clj:281-295)."""
+    targeter = targeter or (lambda nodes: random.choice(list(nodes)))
+
+    def start(test, node):
+        with c.su():
+            c.execute("killall", "-s", "STOP", process)
+        return ["paused", process]
+
+    def stop(test, node):
+        with c.su():
+            c.execute("killall", "-s", "CONT", process)
+        return ["resumed", process]
+
+    return NodeStartStopper(targeter, start, stop)
+
+
+class TruncateFile(Nemesis):
+    """Drop the last :drop bytes of :file per node (nemesis.clj:297-321)."""
+
+    def invoke(self, test, op):
+        assert op.f == "truncate"
+        plan = op.value or {}
+
+        def f(tst, node):
+            spec = plan[node]
+            with c.su():
+                c.execute("truncate", "-c", "-s", f"-{spec['drop']}",
+                          spec["file"])
+        c.on_nodes(test, f, list(plan.keys()))
+        return op
+
+
+def truncate_file():
+    return TruncateFile()

@@ -1,0 +1,138 @@
+"""Network manipulation: partitions, latency, loss
+(reference: `jepsen/src/jepsen/net.clj` + `net/proto.clj`)."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu.util import real_pmap
+
+TC = "/sbin/tc"
+
+
+class Net:
+    """net.clj:14-25."""
+
+    def drop(self, test, src, dest) -> None:
+        """Drop traffic from src as seen by dest."""
+
+    def heal(self, test) -> None:
+        """End all traffic drops."""
+
+    def slow(self, test, mean=50, variance=10, distribution="normal") -> None:
+        """Delay packets (netem)."""
+
+    def flaky(self, test) -> None:
+        """Randomized packet loss."""
+
+    def fast(self, test) -> None:
+        """Remove loss and delays."""
+
+
+class PartitionAll:
+    """Optional fast path: all drops in one call (net/proto.clj:5-12)."""
+
+    def drop_all(self, test, grudge: dict) -> None:
+        raise NotImplementedError
+
+
+def drop_all(test, grudge: dict) -> None:
+    """Apply a grudge — {node: set of nodes it should drop messages
+    from} — to the test's network (net.clj:28-43)."""
+    net = test["net"]
+    if isinstance(net, PartitionAll):
+        net.drop_all(test, grudge)
+        return
+    pairs = [(src, dst) for dst, srcs in grudge.items() for src in srcs]
+    real_pmap(lambda p: net.drop(test, p[0], p[1]), pairs)
+
+
+class Noop(Net):
+    pass
+
+
+noop = Noop()
+
+
+def _ip(node: str) -> str:
+    """Resolve a node name to an IP on the remote host
+    (control/net.clj ip)."""
+    return c.execute("getent", "hosts", node, check=False).split()[0] \
+        if not c._ssh_opts.get("dummy") else node
+
+
+class IPTables(Net, PartitionAll):
+    """iptables/tc backend (net.clj:57-109)."""
+
+    def drop(self, test, src, dest):
+        c.on(dest, lambda: self._drop_from(src), test)
+
+    def _drop_from(self, src):
+        with c.su():
+            c.execute("iptables", "-A", "INPUT", "-s", _ip(src),
+                      "-j", "DROP", "-w")
+
+    def heal(self, test):
+        def f(tst, node):
+            with c.su():
+                c.execute("iptables", "-F", "-w")
+                c.execute("iptables", "-X", "-w")
+        c.on_nodes(test, f)
+
+    def slow(self, test, mean=50, variance=10, distribution="normal"):
+        def f(tst, node):
+            with c.su():
+                c.execute(TC, "qdisc", "add", "dev", "eth0", "root",
+                          "netem", "delay", f"{mean}ms", f"{variance}ms",
+                          "distribution", distribution)
+        c.on_nodes(test, f)
+
+    def flaky(self, test):
+        def f(tst, node):
+            with c.su():
+                c.execute(TC, "qdisc", "add", "dev", "eth0", "root",
+                          "netem", "loss", "20%", "75%")
+        c.on_nodes(test, f)
+
+    def fast(self, test):
+        def f(tst, node):
+            with c.su():
+                try:
+                    c.execute(TC, "qdisc", "del", "dev", "eth0", "root")
+                except c.RemoteError as e:
+                    if "No such file or directory" not in str(e):
+                        raise
+        c.on_nodes(test, f)
+
+    def drop_all(self, test, grudge):
+        def snub(tst, node):
+            srcs = grudge.get(node) or ()
+            if not srcs:
+                return
+            with c.su():
+                c.execute("iptables", "-A", "INPUT", "-s",
+                          ",".join(_ip(s) for s in srcs), "-j", "DROP",
+                          "-w")
+        c.on_nodes(test, snub, list(grudge.keys()))
+
+
+iptables = IPTables()
+
+
+class IPFilter(Net):
+    """ipfilter backend (net.clj:111-143)."""
+
+    def drop(self, test, src, dest):
+        def f():
+            with c.su():
+                c.execute(c.lit(f"echo block in from {src} to any | "
+                                f"ipf -f -"))
+        c.on(dest, f, test)
+
+    def heal(self, test):
+        def f(tst, node):
+            with c.su():
+                c.execute("ipf", "-Fa")
+        c.on_nodes(test, f)
+
+
+ipfilter = IPFilter()

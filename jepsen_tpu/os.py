@@ -1,0 +1,19 @@
+"""OS protocol: operating system setup/teardown on db nodes
+(reference: `jepsen/src/jepsen/os.clj`)."""
+
+from __future__ import annotations
+
+
+class OS:
+    def setup(self, test, node) -> None:
+        pass
+
+    def teardown(self, test, node) -> None:
+        pass
+
+
+class Noop(OS):
+    pass
+
+
+noop = Noop()

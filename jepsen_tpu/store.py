@@ -1,0 +1,254 @@
+"""Persistence: tests, histories, and results on disk
+(reference: `jepsen/src/jepsen/store.clj`).
+
+Layout mirrors the reference's `store/<test-name>/<timestamp>/`
+(store.clj:125-154) with JSON/JSONL in place of Fressian/EDN:
+
+    store/<name>/<date>/
+        test.json       serializable test map (save_1, store.clj:367)
+        history.txt     TSV op log       (write-history! store.clj:346)
+        history.jsonl   op records
+        results.json    checker results  (save_2, store.clj:380)
+        jepsen.log      per-test log     (start-logging! store.clj:398)
+    store/<name>/latest -> <date>
+    store/latest        -> <name>/<date>
+    store/current       -> the running test's dir
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import shutil
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+from jepsen_tpu.history import History
+
+log = logging.getLogger("jepsen")
+
+BASE = Path("store")
+
+# Live, non-serializable runtime state stripped before writing
+# (store.clj nonserializable-keys :167-175).
+NONSERIALIZABLE_KEYS = {
+    "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
+    "barrier", "active_histories", "active_histories_lock", "history_lock",
+    "sessions", "remote", "store", "abort_event",
+}
+
+
+def _sanitize(name: str) -> str:
+    return name.replace("/", "_")
+
+
+def test_dir(test) -> Path:
+    return BASE / _sanitize(test["name"]) / test["start-time"]
+
+
+def path(test, *components) -> Path:
+    """Path inside the test's store directory (store.clj path :125)."""
+    return test_dir(test).joinpath(*[str(c) for c in components])
+
+
+def make_path(test, *components) -> Path:
+    """path!: ensures parent directories exist (store.clj:149-154)."""
+    p = path(test, *components)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _jsonable(x: Any):
+    try:
+        json.dumps(x)
+        return x
+    except (TypeError, ValueError):
+        return repr(x)
+
+
+def serializable_test(test) -> dict:
+    out = {}
+    for k, v in test.items():
+        if k in NONSERIALIZABLE_KEYS or k == "history" or k == "results":
+            continue
+        out[k] = _jsonable(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Writes (store.clj:340-392)
+# ---------------------------------------------------------------------------
+
+def write_results(test) -> None:
+    p = make_path(test, "results.json")
+    with open(p, "w") as f:
+        json.dump(_jsonable_tree(test.get("results")), f, indent=2,
+                  default=repr)
+
+
+def _jsonable_tree(x):
+    if isinstance(x, dict):
+        return {str(k): _jsonable_tree(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple, set, frozenset)):
+        return [_jsonable_tree(v) for v in x]
+    return _jsonable(x)
+
+
+def write_history(test) -> None:
+    """Parallel txt + jsonl history writes (store.clj:346-357; the
+    reference parallelizes chunks above 16384 ops, util.clj:184-206 —
+    here both files stream in one pass each)."""
+    h = History(test.get("history") or [])
+    with open(make_path(test, "history.txt"), "w") as f:
+        for op in h:
+            f.write(str(op) + "\n")
+    with open(make_path(test, "history.jsonl"), "w") as f:
+        f.write(h.to_jsonl())
+
+
+def write_test(test) -> None:
+    with open(make_path(test, "test.json"), "w") as f:
+        json.dump(serializable_test(test), f, indent=2, default=repr)
+
+
+def save_1(test) -> dict:
+    """Post-run phase 1: history + test (store.clj:367-378)."""
+    write_test(test)
+    write_history(test)
+    update_symlinks(test)
+    return test
+
+
+def save_2(test) -> dict:
+    """Post-analysis phase 2: results (store.clj:380-392)."""
+    write_results(test)
+    write_test(test)
+    update_symlinks(test)
+    return test
+
+
+# ---------------------------------------------------------------------------
+# Reads (store.clj:177-300)
+# ---------------------------------------------------------------------------
+
+def load(name: str, timestamp: str) -> dict:
+    """Load a stored test map + history (store.clj load :177)."""
+    d = BASE / _sanitize(name) / timestamp
+    with open(d / "test.json") as f:
+        test = json.load(f)
+    hist_file = d / "history.jsonl"
+    if hist_file.exists():
+        test["history"] = History.from_jsonl(hist_file.read_text())
+    results_file = d / "results.json"
+    if results_file.exists():
+        with open(results_file) as f:
+            test["results"] = json.load(f)
+    return test
+
+
+def load_results(name: str, timestamp: str) -> Optional[dict]:
+    p = BASE / _sanitize(name) / timestamp / "results.json"
+    if not p.exists():
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def tests(name: Optional[str] = None) -> dict:
+    """Map of test-name -> {timestamp: loader} (store.clj tests :270)."""
+    out: dict = {}
+    if not BASE.exists():
+        return out
+    names = [name] if name else [p.name for p in BASE.iterdir()
+                                 if p.is_dir() and p.name not in
+                                 ("latest", "current")]
+    for n in names:
+        d = BASE / _sanitize(n)
+        if not d.is_dir():
+            continue
+        stamps = {}
+        for ts in sorted(p.name for p in d.iterdir()
+                         if p.is_dir() and p.name != "latest"):
+            stamps[ts] = (lambda n=n, ts=ts: load(n, ts))
+        out[n] = stamps
+    return out
+
+
+def latest() -> Optional[dict]:
+    """Loads the latest test (store.clj latest :291-300)."""
+    link = BASE / "latest"
+    if link.is_symlink() or link.exists():
+        d = link.resolve()
+        return load(d.parent.name, d.name)
+    best = None
+    for n, stamps in tests().items():
+        for ts in stamps:
+            if best is None or ts > best[1]:
+                best = (n, ts)
+    return load(*best) if best else None
+
+
+def update_symlinks(test) -> None:
+    """current/latest symlinks (store.clj:302-328)."""
+    d = test_dir(test)
+    if not d.exists():
+        return
+    _relink(BASE / _sanitize(test["name"]) / "latest", Path(d.name))
+    _relink(BASE / "latest", Path(_sanitize(test["name"])) / d.name)
+    _relink(BASE / "current", Path(_sanitize(test["name"])) / d.name)
+
+
+def _relink(link: Path, target: Path) -> None:
+    link.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        if link.is_symlink() or link.exists():
+            if link.is_dir() and not link.is_symlink():
+                shutil.rmtree(link)
+            else:
+                link.unlink()
+        link.symlink_to(target)
+    except OSError as e:  # filesystems without symlinks
+        log.debug("could not update symlink %s: %s", link, e)
+
+
+# ---------------------------------------------------------------------------
+# Logging (store.clj:394-422)
+# ---------------------------------------------------------------------------
+
+_log_lock = threading.Lock()
+_handlers: list[logging.Handler] = []
+
+
+def start_logging(test) -> None:
+    """Per-test jepsen.log file + console (store.clj start-logging!)."""
+    with _log_lock:
+        stop_logging_unlocked()
+        test.setdefault("start-time",
+                        datetime.datetime.now().strftime("%Y%m%dT%H%M%S"))
+        logfile = make_path(test, "jepsen.log")
+        fh = logging.FileHandler(logfile)
+        fh.setFormatter(logging.Formatter(
+            "%(asctime)s{%(threadName)s} %(levelname)s %(name)s - "
+            "%(message)s"))
+        root = logging.getLogger("jepsen")
+        root.setLevel(
+            getattr(logging, (test.get("logging") or {}).get(
+                "level", "INFO").upper(), logging.INFO))
+        root.addHandler(fh)
+        _handlers.append(fh)
+
+
+def stop_logging_unlocked() -> None:
+    root = logging.getLogger("jepsen")
+    while _handlers:
+        h = _handlers.pop()
+        root.removeHandler(h)
+        h.close()
+
+
+def stop_logging() -> None:
+    with _log_lock:
+        stop_logging_unlocked()

@@ -1,0 +1,117 @@
+"""Test scaffolding: the noop test map and the in-memory fake DB
+(reference: `jepsen/src/jepsen/tests.clj`).
+
+`atom_db`/`atom_client` replicate the reference's atom-backed CAS
+register (tests.clj:27-58) — the zero-dependency end-to-end path
+(core_test.clj:40-52) that exercises the whole run loop in-process with
+the dummy SSH transport.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from jepsen_tpu import checker as checker_mod
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net as net_mod
+from jepsen_tpu import nemesis as nemesis_mod
+from jepsen_tpu import os as os_mod
+
+
+def noop_test() -> dict:
+    """Boring test stub (tests.clj:12-24); merge over it to build real
+    tests."""
+    return {
+        "name": "noop",
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "os": os_mod.noop,
+        "db": db_mod.noop,
+        "net": net_mod.noop,
+        "client": client_mod.noop,
+        "nemesis": nemesis_mod.noop,
+        "generator": gen.void,
+        "checker": checker_mod.unbridled_optimism(),
+        "ssh": {"dummy": True},
+    }
+
+
+class Atom:
+    """A tiny clojure-atom: lock-guarded mutable box."""
+
+    def __init__(self, value: Any = None):
+        self.value = value
+        self.lock = threading.Lock()
+
+    def reset(self, v):
+        with self.lock:
+            self.value = v
+        return v
+
+    def deref(self):
+        with self.lock:
+            return self.value
+
+    def swap(self, f):
+        with self.lock:
+            self.value = f(self.value)
+            return self.value
+
+
+class AtomDB(db_mod.DB):
+    """tests.clj:27-32."""
+
+    def __init__(self, state: Atom):
+        self.state = state
+
+    def setup(self, test, node):
+        self.state.reset(0)
+
+    def teardown(self, test, node):
+        self.state.reset("done")
+
+
+def atom_db(state: Atom) -> AtomDB:
+    return AtomDB(state)
+
+
+class CASFailed(Exception):
+    pass
+
+
+class AtomClient(client_mod.Client):
+    """A CAS register on an atom (tests.clj:34-58)."""
+
+    def __init__(self, state: Atom):
+        self.state = state
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        f = op.f
+        if f == "write":
+            self.state.reset(op.value)
+            return op.assoc(type="ok")
+        if f == "cas":
+            cur, new = op.value
+
+            def swap(v):
+                if v != cur:
+                    raise CASFailed()
+                return new
+
+            try:
+                self.state.swap(swap)
+                return op.assoc(type="ok")
+            except CASFailed:
+                return op.assoc(type="fail")
+        if f == "read":
+            return op.assoc(type="ok", value=self.state.deref())
+        raise ValueError(f"unknown f {f!r}")
+
+
+def atom_client(state: Atom) -> AtomClient:
+    return AtomClient(state)

@@ -1,0 +1,158 @@
+"""Kitchen sink utilities (reference: `jepsen/src/jepsen/util.clj`)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Iterable, Optional
+
+log = logging.getLogger("jepsen")
+
+# ---------------------------------------------------------------------------
+# Relative time (util.clj:279-288): one origin per test run, shared by all
+# worker threads (the reference conveys a dynamic var into futures).
+# ---------------------------------------------------------------------------
+
+_time_lock = threading.Lock()
+_origins: list[int] = []
+
+
+class with_relative_time:
+    """Context manager establishing the time origin for relative-time."""
+
+    def __enter__(self):
+        with _time_lock:
+            _origins.append(time.monotonic_ns())
+        return self
+
+    def __exit__(self, *exc):
+        with _time_lock:
+            _origins.pop()
+        return False
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the innermost with_relative_time origin."""
+    with _time_lock:
+        origin = _origins[-1] if _origins else 0
+    return time.monotonic_ns() - origin
+
+
+def nanos_to_ms(ns) -> float:
+    return ns / 1e6
+
+
+def nanos_to_secs(ns) -> float:
+    return ns / 1e9
+
+
+def secs_to_nanos(s) -> int:
+    return int(s * 1e9)
+
+
+# ---------------------------------------------------------------------------
+# Parallel map with exception propagation (dom-top real-pmap, used at
+# core.clj:171-197 and control.clj:369)
+# ---------------------------------------------------------------------------
+
+def real_pmap(f: Callable, xs: Iterable) -> list:
+    """Map f over xs with one thread per element; re-raises the first
+    exception after all complete."""
+    xs = list(xs)
+    if not xs:
+        return []
+    if len(xs) == 1:
+        return [f(xs[0])]
+    with ThreadPoolExecutor(max_workers=len(xs)) as ex:
+        futs = [ex.submit(f, x) for x in xs]
+        results, first_err = [], None
+        for fut in futs:
+            try:
+                results.append(fut.result())
+            except Exception as e:
+                if first_err is None:
+                    first_err = e
+                results.append(e)
+        if first_err is not None:
+            raise first_err
+        return results
+
+
+def bounded_pmap(f: Callable, xs: Iterable, bound: Optional[int] = None) -> list:
+    """Parallel map with bounded worker count (dom-top bounded-pmap,
+    used by independent/checker independent.clj:247)."""
+    import os
+    xs = list(xs)
+    if not xs:
+        return []
+    bound = bound or min(32, (os.cpu_count() or 4) + 2)
+    with ThreadPoolExecutor(max_workers=min(bound, len(xs))) as ex:
+        return list(ex.map(f, xs))
+
+
+def fcatch(f: Callable) -> Callable:
+    """Returns a fn returning, rather than throwing, exceptions
+    (util.clj meh/fcatch)."""
+
+    def wrapper(*a, **kw):
+        try:
+            return f(*a, **kw)
+        except Exception as e:
+            return e
+
+    return wrapper
+
+
+class with_retry:
+    """Retry decorator-ish helper: with_retry(tries)(f, *args)."""
+
+    def __init__(self, tries: int = 3, backoff: float = 0.0):
+        self.tries = tries
+        self.backoff = backoff
+
+    def __call__(self, f, *args, **kw):
+        err = None
+        for i in range(self.tries):
+            try:
+                return f(*args, **kw)
+            except Exception as e:
+                err = e
+                if self.backoff:
+                    time.sleep(self.backoff)
+        raise err
+
+
+def timeout(seconds: float, default, f: Callable, *args):
+    """Run f in a thread with a wall-clock bound; yields default on
+    timeout (util.clj:311 — the thread is abandoned, not killed, which
+    is also true of the reference's variant)."""
+    result = [default]
+    done = threading.Event()
+
+    def run():
+        try:
+            result[0] = f(*args)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    done.wait(seconds)
+    return result[0]
+
+
+def log_op(op) -> None:
+    """TSV op log line (util.clj:208-212, called from core.clj:311,337)."""
+    log.info("%s", op)
+
+
+def majority(n: int) -> int:
+    """Smallest majority of n (util.clj)."""
+    return n // 2 + 1
+
+
+def chunk_vec(n: int, xs: list) -> list[list]:
+    """Partition xs into chunks of size n (util.clj:117-126)."""
+    return [xs[i:i + n] for i in range(0, len(xs), n)]

@@ -1,0 +1,253 @@
+"""End-to-end runner tests — ports `jepsen/test/jepsen/core_test.clj`:
+basic-cas-test :40, ssh-test :54 (against the dummy transport),
+worker-recovery-test :110, generator-recovery-test :130,
+worker-error-test :154.  All run fully in-process: dummy SSH + the
+atom-backed fake DB (tests.clj:27-58)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import models
+from jepsen_tpu import nemesis as nemesis_mod
+from jepsen_tpu import os as os_mod
+from jepsen_tpu import tests as tst
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def test_basic_cas():
+    """core_test.clj:40-52 — the reference's smallest full loop, with
+    the checker swapped for the TPU linearizability path."""
+    state = tst.Atom()
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "basic cas",
+        "db": tst.atom_db(state),
+        "client": tst.atom_client(state),
+        "generator": gen.nemesis(gen.void, gen.limit(10, gen.cas)),
+        "checker": ck.linearizable({"model": models.CASRegister(0)}),
+    })
+    result = core.run(test)
+    assert result["results"]["valid?"] is True
+    assert len(result["history"]) == 20  # 10 invokes + 10 completions
+
+
+def test_ssh_dummy_roundtrip():
+    """core_test.clj ssh-test :54-108 against the dummy transport with a
+    fake hostname handler."""
+    from jepsen_tpu import control
+
+    os_startups, os_teardowns = {}, {}
+    db_startups, db_teardowns = {}, {}
+    db_primaries = []
+    lock = threading.Lock()
+
+    control.set_dummy_handler(
+        lambda node, cmd, stdin: node if cmd == "hostname" else "")
+    try:
+        class TrackOS(os_mod.OS):
+            def setup(self, test, node):
+                with lock:
+                    os_startups[node] = control.execute("hostname")
+
+            def teardown(self, test, node):
+                with lock:
+                    os_teardowns[node] = control.execute("hostname")
+
+        class TrackDB(db_mod.DB, db_mod.Primary, db_mod.LogFiles):
+            def setup(self, test, node):
+                with lock:
+                    db_startups[node] = control.execute("hostname")
+
+            def teardown(self, test, node):
+                with lock:
+                    db_teardowns[node] = control.execute("hostname")
+
+            def setup_primary(self, test, node):
+                with lock:
+                    db_primaries.append(control.execute("hostname"))
+
+            def log_files(self, test, node):
+                return ["/tmp/jepsen-test"]
+
+        test = dict(tst.noop_test())
+        test.update({"name": "ssh test", "os": TrackOS(), "db": TrackDB()})
+        result = core.run(test)
+    finally:
+        control.set_dummy_handler(None)
+
+    assert result["results"]["valid?"] is True
+    expected = {n: n for n in ("n1", "n2", "n3", "n4", "n5")}
+    assert os_startups == expected
+    assert os_teardowns == expected
+    assert db_startups == expected
+    assert db_teardowns == expected
+    assert db_primaries == ["n1"]
+
+
+def test_worker_recovery():
+    """Workers consume exactly n ops even when every op crashes
+    (core_test.clj:110-128): info completions renumber the process but
+    never replay ops."""
+    invocations = []
+    lock = threading.Lock()
+
+    class Crashing(client_mod.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            with lock:
+                invocations.append(op)
+            raise ZeroDivisionError("div by zero")
+
+    n = 12
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "worker recovery",
+        "client": Crashing(),
+        "generator": gen.nemesis(gen.void, gen.limit(n, gen.queue_gen())),
+    })
+    result = core.run(test)
+    assert len(invocations) == n
+    # Every completion is info and processes were renumbered.
+    infos = [o for o in result["history"] if o.is_info]
+    assert len(infos) == n
+    procs = {o.process for o in result["history"]}
+    assert any(p >= result["concurrency"] for p in procs)
+
+
+class TrackingClient(client_mod.Client):
+    """core_test.clj tracking-client :19-37."""
+
+    def __init__(self, conns, uid=0):
+        self.conns = conns
+        self.uid = uid
+        self.lock = threading.Lock()
+        self.counter = [0]
+
+    def open(self, test, node):
+        with self.lock:
+            self.counter[0] += 1
+            uid = self.counter[0]
+        c = TrackingClient(self.conns, uid)
+        c.counter = self.counter
+        c.lock = self.lock
+        self.conns.add(uid)
+        return c
+
+    def invoke(self, test, op):
+        return op.assoc(type="ok")
+
+    def close(self, test):
+        self.conns.discard(self.uid)
+
+
+def test_generator_recovery():
+    """A generator exception must knock other workers out of barrier
+    waits and abort cleanly (core_test.clj:130-152)."""
+    conns = set()
+
+    class Boom(gen.Generator):
+        def op(self, test, process):
+            if process == 0:
+                raise ZeroDivisionError("div by zero")
+            return {"type": "invoke", "f": "meow"}
+
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "generator recovery",
+        "client": TrackingClient(conns),
+        "generator": gen.clients(
+            gen.phases(gen.each(lambda: gen.once(Boom())),
+                       gen.once({"type": "invoke", "f": "done"}))),
+    })
+    with pytest.raises(ZeroDivisionError):
+        core.run(test)
+    assert conns == set()
+
+
+@pytest.mark.parametrize("phase", ["open", "setup", "teardown", "close"])
+def test_worker_error_client(phase):
+    """Errors in client lifecycle hooks are rethrown
+    (core_test.clj:154-178)."""
+
+    class Failing(client_mod.Client):
+        def open(self, test, node):
+            if phase == "open":
+                raise AssertionError("false")
+            return self
+
+        def setup(self, test):
+            if phase == "setup":
+                raise AssertionError("false")
+
+        def invoke(self, test, op):
+            return op.assoc(type="ok")
+
+        def teardown(self, test):
+            if phase == "teardown":
+                raise AssertionError("false")
+
+        def close(self, test):
+            if phase == "close":
+                raise AssertionError("false")
+
+    test = dict(tst.noop_test())
+    test.update({"name": None, "client": Failing(),
+                 "generator": gen.nemesis(
+                     gen.void, gen.limit(2, {"type": "invoke", "f": "x"}))})
+    if phase in ("open", "setup"):
+        with pytest.raises(AssertionError):
+            core.run(test)
+    else:
+        # teardown/close run in the finally path; reference rethrows.
+        with pytest.raises(AssertionError):
+            core.run(test)
+
+
+def test_worker_error_nemesis_setup():
+    class FailingNemesis(nemesis_mod.Nemesis):
+        def setup(self, test):
+            raise AssertionError("false")
+
+        def invoke(self, test, op):
+            return op
+
+    test = dict(tst.noop_test())
+    test.update({"name": None, "nemesis": FailingNemesis()})
+    with pytest.raises(AssertionError):
+        core.run(test)
+
+
+def test_store_artifacts_written():
+    state = tst.Atom()
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "artifacts",
+        "db": tst.atom_db(state),
+        "client": tst.atom_client(state),
+        "generator": gen.nemesis(gen.void, gen.limit(5, gen.cas)),
+        "checker": ck.linearizable({"model": models.CASRegister(0)}),
+    })
+    result = core.run(test)
+    from jepsen_tpu import store
+    d = store.test_dir(result)
+    assert (d / "test.json").exists()
+    assert (d / "history.jsonl").exists()
+    assert (d / "results.json").exists()
+    assert (d / "history.txt").exists()
+    loaded = store.load("artifacts", result["start-time"])
+    assert loaded["results"]["valid?"] is True
+    assert len(loaded["history"]) == len(result["history"])
+    assert store.latest()["name"] == "artifacts"
