@@ -1,0 +1,221 @@
+"""Independent-layer tests — ports
+`jepsen/test/jepsen/independent_test.clj` (sequential/concurrent
+generator key-sharding incl. the 1000-key concurrency test :34-40, the
+lifted checker :76-97) and adds device coverage: the batched
+vmap-over-keys WGL checker, sharded over an 8-device CPU mesh."""
+
+import random
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu import models
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op, info_op
+from tests.test_generator import ops
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def values(os_):
+    return [o["value"] for o in os_]
+
+
+class TestSequentialGenerator:
+    def test_empty_keys(self):
+        assert ops(("a", "b"), ind.sequential_generator([], lambda k: "x")) \
+            == []
+
+    def test_one_key(self):
+        got = ops(("a",), ind.sequential_generator(
+            ["k1"], lambda k: gen.gseq([{"value": "ashley"},
+                                        {"value": "katchadourian"}])))
+        assert values(got) == [ind.KV("k1", "ashley"),
+                               ind.KV("k1", "katchadourian")]
+
+    def test_n_keys(self):
+        got = ops(("a",), ind.sequential_generator(
+            [1, 2, 3],
+            lambda k: gen.gseq([{"value": v} for v in range(k)])))
+        assert values(got) == [ind.KV(1, 0),
+                               ind.KV(2, 0), ind.KV(2, 1),
+                               ind.KV(3, 0), ind.KV(3, 1), ind.KV(3, 2)]
+
+    def test_concurrency(self):
+        kmax, vmax = 1000, 10
+        got = ops(tuple(range(10)), ind.sequential_generator(
+            range(kmax),
+            lambda k: gen.gseq([{"value": v} for v in range(vmax)])))
+        assert set(map(tuple, values(got))) == \
+            {(k, v) for k in range(kmax) for v in range(vmax)}
+
+
+class TestConcurrentGenerator:
+    def test_empty_keys(self):
+        assert ops(tuple(range(10)),
+                   ind.concurrent_generator(1, [], lambda k: k)) == []
+
+    def test_too_few_threads(self):
+        with pytest.raises(AssertionError, match="at least 12"):
+            ops(tuple(range(10)),
+                ind.concurrent_generator(12, [], lambda k: k))
+
+    def test_uneven_threads(self):
+        with pytest.raises(AssertionError, match="multiple of 2"):
+            ops(tuple(range(11)),
+                ind.concurrent_generator(2, [], lambda k: k))
+
+    def test_fully_concurrent(self):
+        kmax, vmax, n, threads = 10, 5, 5, 100
+        got = ops(tuple(range(threads)), ind.concurrent_generator(
+            n, range(kmax),
+            lambda k: gen.gseq([{"value": v} for v in range(vmax)])))
+        assert set(map(tuple, values(got))) == \
+            {(k, v) for k in range(kmax) for v in range(vmax)}
+
+
+def test_history_keys_and_subhistory():
+    h = History([
+        invoke_op(0, "read", ind.KV(1, None)),
+        ok_op(0, "read", ind.KV(1, 5)),
+        info_op("nemesis", "start", None),
+        invoke_op(1, "write", ind.KV(2, 7)),
+        ok_op(1, "write", ind.KV(2, 7)),
+    ]).index()
+    assert ind.history_keys(h) == {1, 2}
+    sub1 = ind.subhistory(1, h)
+    assert [o.value for o in sub1] == [None, 5, None]
+    assert sub1[2].f == "start"  # un-keyed nemesis ops appear everywhere
+
+
+def test_checker():
+    """independent_test.clj:76-97: even-length subhistories are valid."""
+
+    class EvenChecker(ck.Checker):
+        def check(self, test, history, opts=None):
+            return {"valid?": len(history) % 2 == 0}
+
+    history = ops(("a", "b", "c"), ind.sequential_generator(
+        [0, 1, 2, 3],
+        lambda k: gen.gseq([{"value": v} for v in range(k)])))
+    history = [{"value": "not-sharded"}] + history
+    r = ind.checker(EvenChecker()).check(
+        {"name": "independent-checker-test", "start-time": "0"},
+        History(history), {})
+    assert r == {"valid?": False,
+                 "results": {1: {"valid?": True},
+                             2: {"valid?": False},
+                             3: {"valid?": True}},
+                 "failures": [2]}
+
+
+def test_checker_writes_artifacts(tmp_path):
+    from jepsen_tpu import store
+
+    class TinyChecker(ck.Checker):
+        def check(self, test, history, opts=None):
+            return {"valid?": True}
+
+    h = History([invoke_op(0, "read", ind.KV(1, None)),
+                 ok_op(0, "read", ind.KV(1, None))]).index()
+    test = {"name": "indep-artifacts", "start-time": "t0"}
+    ind.checker(TinyChecker()).check(test, h, {})
+    assert (store.BASE / "indep-artifacts" / "t0" / "independent" / "1" /
+            "results.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Batched device checking
+# ---------------------------------------------------------------------------
+
+def make_register_history(key, n_ops, seed, bad=False):
+    """A linearizable single-register history from a sequential run with
+    concurrency-2 interleaving; optionally corrupted."""
+    rng = random.Random(seed)
+    ops_, value = [], None
+    for i in range(n_ops):
+        p = rng.randint(0, 1)
+        f = rng.choice(["read", "write", "cas"])
+        if f == "read":
+            ops_.append(invoke_op(p, "read", None))
+            ops_.append(ok_op(p, "read", value))
+        elif f == "write":
+            v = rng.randint(0, 4)
+            ops_.append(invoke_op(p, "write", v))
+            value = v
+            ops_.append(ok_op(p, "write", v))
+        else:
+            old, new = rng.randint(0, 4), rng.randint(0, 4)
+            ops_.append(invoke_op(p, "cas", [old, new]))
+            if value == old:
+                value = new
+                ops_.append(ok_op(p, "cas", [old, new]))
+            elif i % 7 == 3:
+                # occasional crashed op: stays concurrent forever —
+                # frequent crashes explode the search (06-refining.md:12-19)
+                ops_.append(info_op(p, "cas", [old, new]))
+            else:
+                ops_.append(fail_op(p, "cas", [old, new]))
+    if bad:
+        ops_.append(invoke_op(7, "read", None))
+        ops_.append(ok_op(7, "read", 99))
+    return History(ops_).index()
+
+
+def test_check_many_matches_cpu_oracle():
+    from jepsen_tpu.ops import wgl_batch, wgl_cpu
+
+    hists = [make_register_history(k, 30, seed=k, bad=(k % 3 == 2))
+             for k in range(9)]
+    model = models.CASRegister()
+    batch = wgl_batch.check_many(model, hists, frontier_size=128)
+    for k, (h, r) in enumerate(zip(hists, batch)):
+        expected = wgl_cpu.check(models.CASRegister(), h)
+        assert r["valid?"] == expected["valid?"], f"key {k}"
+        assert r["valid?"] == (k % 3 != 2)
+
+
+def test_check_many_on_mesh():
+    import jax
+    from jax.sharding import Mesh
+    from jepsen_tpu.ops import wgl_batch
+
+    devices = jax.devices()
+    assert len(devices) == 8, "conftest should provide 8 virtual devices"
+    mesh = Mesh(devices, ("keys",))
+    hists = [make_register_history(k, 40, seed=100 + k, bad=(k == 5))
+             for k in range(13)]  # deliberately not a multiple of 8
+    out = wgl_batch.check_many(models.CASRegister(), hists,
+                               frontier_size=128, mesh=mesh)
+    assert [r["valid?"] for r in out] == [k != 5 for k in range(13)]
+
+
+def test_batched_independent_checker():
+    h = []
+    for k in range(4):
+        sub = make_register_history(k, 20, seed=k, bad=(k == 3))
+        for o in sub:
+            h.append(o.assoc(value=ind.KV(k, o.value)))
+    h = History(h).index()
+    c = ind.batch_checker(models.CASRegister())
+    r = c.check({}, h, {})
+    assert r["valid?"] is False
+    assert r["failures"] == [3]
+    assert r["results"][0]["valid?"] is True
+
+
+def test_batched_escalation_on_overflow():
+    """A frontier of 1 overflows instantly; lanes must escalate to the
+    adaptive kernel and still produce correct verdicts."""
+    from jepsen_tpu.ops import wgl_batch
+
+    hists = [make_register_history(k, 25, seed=7 + k, bad=(k == 1))
+             for k in range(3)]
+    out = wgl_batch.check_many(models.CASRegister(), hists, frontier_size=1)
+    assert [r["valid?"] for r in out] == [True, False, True]
