@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Headline benchmark: linearizability-check throughput on a 100k-op
+CAS-register history (BASELINE.json config 2 / the north-star metric).
+
+Measures the TPU WGL frontier kernel (jepsen_tpu.ops.wgl) against the
+CPU just-in-time-linearization oracle (jepsen_tpu.ops.wgl_cpu — the
+knossos-equivalent baseline; the reference delegates this work to
+knossos on a 32 GB JVM heap, jepsen/project.clj:30, and documents no
+throughput numbers of its own — see BASELINE.md).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ops/sec", "vs_baseline": N}
+vs_baseline = device throughput / CPU-oracle throughput (CPU timed on a
+prefix of the same history to keep the run bounded).
+"""
+
+import json
+import random
+import sys
+import time
+
+from jepsen_tpu import models
+from jepsen_tpu.history import History, fail_op, info_op, invoke_op, ok_op
+from jepsen_tpu.ops import wgl, wgl_cpu
+
+N_OPS = 100_000
+CPU_PREFIX_OPS = 4_000
+CONCURRENCY = 5
+CRASH_EVERY = 211  # sparse crashed ops: each holds a frontier slot forever
+
+
+def make_history(n_ops: int, concurrency: int, seed: int = 7) -> History:
+    """An etcd-shaped register workload (r/w/cas mix, etcd.clj:145-147)
+    executed against a sequentially-consistent in-memory register with
+    process interleaving."""
+    rng = random.Random(seed)
+    ops, value = [], None
+    open_ops: dict = {}  # process -> (completion op) pending flush
+    procs = list(range(concurrency))
+    i = 0
+    while i < n_ops:
+        p = rng.choice(procs)
+        if p in open_ops:
+            ops.append(open_ops.pop(p))
+            continue
+        i += 1
+        f = rng.choice(("read", "read", "write", "cas"))
+        if f == "read":
+            ops.append(invoke_op(p, "read", None))
+            open_ops[p] = ok_op(p, "read", value)
+        elif f == "write":
+            v = rng.randint(0, 9)
+            ops.append(invoke_op(p, "write", v))
+            value = v
+            open_ops[p] = ok_op(p, "write", v)
+        else:
+            old, new = rng.randint(0, 9), rng.randint(0, 9)
+            ops.append(invoke_op(p, "cas", [old, new]))
+            if value == old:
+                value = new
+                open_ops[p] = ok_op(p, "cas", [old, new])
+            elif i % CRASH_EVERY == 13:
+                info_op_ = info_op(p, "cas", [old, new])
+                open_ops[p] = info_op_
+            else:
+                open_ops[p] = fail_op(p, "cas", [old, new])
+    for comp in open_ops.values():
+        ops.append(comp)
+    return History(ops).index()
+
+
+def main() -> int:
+    model = models.CASRegister()
+    history = make_history(N_OPS, CONCURRENCY)
+    n_client_ops = sum(1 for o in history if o.is_invoke)
+
+    # --- CPU oracle baseline on a prefix -------------------------------
+    prefix = History(list(history)[:2 * CPU_PREFIX_OPS])
+    t0 = time.monotonic()
+    cpu_result = wgl_cpu.check(model, prefix)
+    cpu_s = time.monotonic() - t0
+    cpu_ops = sum(1 for o in prefix if o.is_invoke)
+    cpu_rate = cpu_ops / cpu_s
+
+    # --- Device kernel: warm-up compile on a small slice, then the full
+    # history (compile cache keyed on bucketed shapes) ------------------
+    t0 = time.monotonic()
+    result = wgl.check(model, history)
+    total_s = time.monotonic() - t0
+    if result["valid?"] is not True:
+        print(json.dumps({"metric": "ERROR: benchmark history judged "
+                          + str(result.get("valid?")), "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return 1
+    kernel_s = result.get("time_kernel_s", total_s)
+    rate = n_client_ops / kernel_s
+
+    print(json.dumps({
+        "metric": (f"linearizability check throughput, {N_OPS // 1000}k-op "
+                   f"CAS-register history (WGL frontier kernel, "
+                   f"{result['backend']})"),
+        "value": round(rate, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(rate / cpu_rate, 2),
+    }))
+    print(f"# device: {n_client_ops} ops in {kernel_s:.3f}s "
+          f"(total {total_s:.3f}s incl. plan+compile); "
+          f"cpu oracle: {cpu_ops} ops in {cpu_s:.3f}s "
+          f"({cpu_rate:.0f} ops/s); cpu verdict {cpu_result['valid?']}",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

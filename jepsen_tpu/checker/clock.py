@@ -1,0 +1,49 @@
+"""Clock-offset plot (reference: `jepsen/src/jepsen/checker/clock.clj`):
+renders the :clock-offsets values journaled by the clock nemesis
+(nemesis/time.clj:89-135) over time."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu.history import History
+
+
+def history_to_datasets(history) -> dict:
+    """{node: [[t, offset] ...]} (clock.clj history->datasets :14)."""
+    out: dict = {}
+    for op in History(history):
+        offsets = op.extra.get("clock-offsets") if hasattr(op, "extra") \
+            else None
+        if not offsets:
+            continue
+        t = (op.time or 0) / 1e9
+        for node, offset in offsets.items():
+            out.setdefault(node, []).append([t, offset])
+    return out
+
+
+def plot(test, history, opts=None) -> Optional[str]:
+    """clock.clj plot! :47-73."""
+    if not (test and test.get("name") and test.get("start-time")):
+        return None
+    datasets = history_to_datasets(history)
+    from jepsen_tpu import store
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    sub = list((opts or {}).get("subdirectory") or [])
+    path = str(store.make_path(test, *sub, "clock-skew.png"))
+    fig, ax = plt.subplots(figsize=(10, 4))
+    for node, pts in sorted(datasets.items()):
+        xs, ys = zip(*pts)
+        ax.plot(xs, ys, label=str(node), drawstyle="steps-post")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("clock offset (s)")
+    ax.set_title(f"{test.get('name')} clock skew")
+    if datasets:
+        ax.legend(loc="upper right")
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    return path

@@ -1,0 +1,167 @@
+"""Performance graphs: latency and throughput over time
+(reference: `jepsen/src/jepsen/checker/perf.clj`, which shells out to
+gnuplot; here matplotlib renders the same artifacts).
+
+Artifacts land in the test's store directory: latency-raw.png,
+latency-quantiles.png, rate.png — with nemesis activity windows shaded
+(perf.clj nemesis-regions :193-232).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from jepsen_tpu.history import History, history_latencies, nemesis_intervals
+
+log = logging.getLogger("jepsen")
+
+QUANTILES = (0.5, 0.95, 0.99, 1.0)
+TYPE_COLORS = {"ok": "#81BFFC", "info": "#FFA400", "fail": "#FF1E90"}
+
+
+def bucket_points(dt: float, points):
+    """Groups [x, y] points into buckets of width dt centered on
+    midpoints (perf.clj bucket-points :16-44)."""
+    out: dict = {}
+    for x, y in points:
+        b = int(x // dt)
+        center = dt * b + dt / 2
+        out.setdefault(center, []).append([x, y])
+    return out
+
+
+def quantiles(qs, xs):
+    """Extract quantile values from a collection (perf.clj:46-56)."""
+    xs = sorted(xs)
+    if not xs:
+        return {}
+    n = len(xs)
+    return {q: xs[min(n - 1, int(q * n))] for q in qs}
+
+
+def latencies_to_quantiles(dt: float, qs, points):
+    """{quantile: [[bucket-time, latency] ...]} (perf.clj:58-77)."""
+    buckets = bucket_points(dt, points)
+    out = {q: [] for q in qs}
+    for t in sorted(buckets):
+        lat = quantiles(qs, [y for _, y in buckets[t]])
+        for q in qs:
+            out[q].append([t, lat.get(q)])
+    return out
+
+
+def _ensure_path(test, opts, filename: str) -> Optional[str]:
+    if not (test and test.get("name") and test.get("start-time")):
+        return None
+    from jepsen_tpu import store
+    sub = list((opts or {}).get("subdirectory") or [])
+    return str(store.make_path(test, *sub, filename))
+
+
+def _plt():
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    return plt
+
+
+def _shade_nemesis(ax, history):
+    for start, stop in nemesis_intervals(history):
+        t0 = (start.time or 0) / 1e9
+        t1 = (stop.time or 0) / 1e9 if stop is not None else ax.get_xlim()[1]
+        ax.axvspan(t0, t1, color="#888888", alpha=0.15, zorder=0)
+
+
+def point_graph(test, history, opts=None) -> Optional[str]:
+    """Raw latency scatter, colored by completion type
+    (perf.clj point-graph! :251)."""
+    path = _ensure_path(test, opts, "latency-raw.png")
+    if path is None:
+        return None
+    h = History(history)
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(10, 5))
+    by_type: dict = {}
+    for inv, latency in history_latencies(h):
+        comp = inv.extra.get("completion")
+        t = comp.type if comp is not None else "info"
+        by_type.setdefault(t, []).append(
+            ((inv.time or 0) / 1e9, latency / 1e6))
+    for t, pts in by_type.items():
+        xs, ys = zip(*pts)
+        ax.scatter(xs, ys, s=4, label=t,
+                   color=TYPE_COLORS.get(t, "#555555"))
+    ax.set_yscale("log")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title(f"{test.get('name')} latency")
+    if by_type:
+        ax.legend(loc="upper right")
+    _shade_nemesis(ax, h)
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    return path
+
+
+def quantiles_graph(test, history, opts=None, dt: float = 10,
+                    qs=QUANTILES) -> Optional[str]:
+    """Latency quantiles over time (perf.clj quantiles-graph! :305)."""
+    path = _ensure_path(test, opts, "latency-quantiles.png")
+    if path is None:
+        return None
+    h = History(history)
+    pts = [((inv.time or 0) / 1e9, latency / 1e6)
+           for inv, latency in history_latencies(h)]
+    data = latencies_to_quantiles(dt, qs, pts)
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(10, 5))
+    for q in qs:
+        series = [(t, v) for t, v in data[q] if v is not None]
+        if series:
+            xs, ys = zip(*series)
+            ax.plot(xs, ys, marker="o", markersize=3, label=f"p{q}")
+    ax.set_yscale("log")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title(f"{test.get('name')} latency quantiles")
+    ax.legend(loc="upper right")
+    _shade_nemesis(ax, h)
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    return path
+
+
+def rate_graph(test, history, opts=None, dt: float = 10) -> Optional[str]:
+    """Throughput of completions per f over time
+    (perf.clj rate-graph! :356)."""
+    path = _ensure_path(test, opts, "rate.png")
+    if path is None:
+        return None
+    h = History(history)
+    plt = _plt()
+    fig, ax = plt.subplots(figsize=(10, 5))
+    series: dict = {}
+    for o in h:
+        if o.is_invoke or not isinstance(o.process, int) or o.process < 0:
+            continue
+        series.setdefault((o.f, o.type), []).append((o.time or 0) / 1e9)
+    for (f, t), times in sorted(series.items(), key=repr):
+        if not times:
+            continue
+        hi = max(times) + dt
+        bins = np.arange(0, hi + dt, dt)
+        counts, edges = np.histogram(times, bins=bins)
+        ax.plot(edges[:-1] + dt / 2, counts / dt, label=f"{f} {t}",
+                color=TYPE_COLORS.get(t), alpha=0.8)
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("throughput (hz)")
+    ax.set_title(f"{test.get('name')} rate")
+    if series:
+        ax.legend(loc="upper right", fontsize=7)
+    _shade_nemesis(ax, h)
+    fig.savefig(path, dpi=100)
+    plt.close(fig)
+    return path

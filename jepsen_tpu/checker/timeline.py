@@ -1,0 +1,99 @@
+"""HTML timeline: a Gantt chart of operations by process
+(reference: `jepsen/src/jepsen/checker/timeline.clj`)."""
+
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu.history import History
+
+TIMESCALE = 1e6  # ns per pixel (timeline.clj:19)
+COL_WIDTH = 100
+GUTTER_WIDTH = 6
+HEIGHT = 16
+
+STYLESHEET = """
+.ops        { position: absolute; }
+.op         { position: absolute; padding: 2px; border-radius: 2px;
+              overflow: hidden; font-size: 10px;
+              font-family: sans-serif; }
+.op.ok      { background: #6DB6FE; }
+.op.info    { background: #FFAA26; }
+.op.fail    { background: #FEB5DA; }
+.process    { position: absolute; top: 0; font-weight: bold;
+              font-family: sans-serif; font-size: 12px; }
+""".strip()
+
+
+def pairs(history) -> list:
+    """Pair invocations with completions (timeline.clj pairs :33-56)."""
+    return History(history).pairs()
+
+
+def processes(history) -> list:
+    return History(history).processes()
+
+
+def render_op(op_index: dict, inv, comp) -> str:
+    t0 = inv.time or 0
+    t1 = comp.time if comp is not None and comp.time is not None \
+        else t0 + int(1e7)
+    p_idx = op_index[inv.process]
+    typ = comp.type if comp is not None else "info"
+    left = p_idx * (COL_WIDTH + GUTTER_WIDTH)
+    top = t0 / TIMESCALE + HEIGHT
+    height = max((t1 - t0) / TIMESCALE, HEIGHT)
+    title = (f"{inv.f} {inv.value}\n"
+             + (f"-> {comp.type} {comp.value}" if comp is not None
+                else "(no completion)"))
+    body = f"{inv.f} {inv.value}"
+    if comp is not None and comp.value is not None and \
+            comp.value != inv.value:
+        body += f" → {comp.value}"
+    return (f'<div class="op {typ}" style="left:{left}px; top:{top:.0f}px; '
+            f'width:{COL_WIDTH}px; height:{height:.0f}px" '
+            f'title="{html.escape(title)}">{html.escape(str(body))}</div>')
+
+
+def render(test, history) -> str:
+    h = History(history)
+    ps = [p for p in h.processes()]
+    op_index = {p: i for i, p in enumerate(ps)}
+    parts = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        f"<title>{html.escape(str(test.get('name') or 'timeline'))}</title>",
+        f"<style>{STYLESHEET}</style></head><body>",
+        f"<h1>{html.escape(str(test.get('name') or ''))}</h1>",
+        "<div class='ops'>",
+    ]
+    for i, p in enumerate(ps):
+        left = i * (COL_WIDTH + GUTTER_WIDTH)
+        parts.append(f'<div class="process" style="left:{left}px">'
+                     f'{html.escape(str(p))}</div>')
+    for inv, comp in h.pairs():
+        if inv.process in op_index:
+            parts.append(render_op(op_index, inv, comp))
+    parts.append("</div></body></html>")
+    return "\n".join(parts)
+
+
+class HtmlTimeline(ck.Checker):
+    """Renders timeline.html into the store dir (timeline.clj html :159)."""
+
+    def check(self, test, history, opts=None):
+        if test and test.get("name") and test.get("start-time"):
+            from jepsen_tpu import store
+            sub = list((opts or {}).get("subdirectory") or [])
+            p = store.make_path(test, *sub, "timeline.html")
+            p.write_text(render(test, history))
+        return {"valid?": True}
+
+
+def html_timeline() -> HtmlTimeline:
+    return HtmlTimeline()
+
+
+# reference naming parity: timeline/html
+html_checker = html_timeline

@@ -1,0 +1,32 @@
+"""Micro-operation helpers for transactional workloads
+(reference: `txn/src/jepsen/txn/micro_op.clj`).
+
+A micro-op is a 3-element sequence [f, k, v] with f in {"r", "w"}; a
+transaction is a list of micro-ops carried in an op's value.
+"""
+
+from __future__ import annotations
+
+
+def f(mop):
+    return mop[0]
+
+
+def key(mop):
+    return mop[1]
+
+
+def value(mop):
+    return mop[2]
+
+
+def is_read(mop) -> bool:
+    return f(mop) in ("r", "read")
+
+
+def is_write(mop) -> bool:
+    return f(mop) in ("w", "write")
+
+
+def is_op(mop) -> bool:
+    return len(mop) == 3 and f(mop) in ("r", "w", "read", "write")

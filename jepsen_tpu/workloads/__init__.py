@@ -1,0 +1,22 @@
+"""Workload library: packaged generator + checker (+ model) bundles
+(reference: `jepsen/src/jepsen/tests/*.clj`).
+
+Each module exposes `workload(opts) -> dict` fragments that merge into a
+test map, mirroring how per-DB suites compose workloads
+(e.g. cockroachdb runner.clj:25-34, dgraph core.clj:25-37).
+"""
+
+from jepsen_tpu.workloads import (adya, bank, causal,  # noqa: F401
+                                  linearizable_register, long_fork)
+
+WORKLOADS = {
+    "bank": bank.workload,
+    "linearizable-register": linearizable_register.workload,
+    "long-fork": long_fork.workload,
+    "adya-g2": adya.workload,
+    "causal": causal.workload,
+}
+
+
+def workload(name: str, opts=None) -> dict:
+    return WORKLOADS[name](opts or {})

@@ -1,0 +1,75 @@
+"""Adya anomaly workloads (reference: `jepsen/src/jepsen/tests/adya.clj`;
+see Adya's thesis for G2/G-single): anti-dependency-cycle detection via
+predicate reads.
+
+G2: with concurrent unique keys, two txns race to insert under a
+predicate guard; at most one insert per key may succeed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.history import History
+
+
+def g2_gen():
+    """adya.clj g2-gen :12-50: pairs of inserts [key [a-id, b-id]] with
+    globally unique ids, two per key."""
+    counter = itertools.count(1)
+    lock = threading.Lock()
+
+    def next_id():
+        with lock:
+            return next(counter)
+
+    def fgen(k):
+        return gen.gseq([
+            lambda t, p: {"type": "invoke", "f": "insert",
+                          "value": [None, next_id()]},
+            lambda t, p: {"type": "invoke", "f": "insert",
+                          "value": [next_id(), None]},
+        ])
+
+    return independent.concurrent_generator(2, _naturals(), fgen)
+
+
+def _naturals():
+    k = 0
+    while True:
+        yield k
+        k += 1
+
+
+class G2Checker(ck.Checker):
+    """At most one insert completes per key (adya.clj g2-checker
+    :52-88)."""
+
+    def check(self, test, history, opts=None):
+        keys: dict = {}
+        for o in History(history):
+            if o.f == "insert" and independent.is_tuple(o.value):
+                k = o.value.key
+                keys.setdefault(k, 0)
+                if o.is_ok:
+                    keys[k] += 1
+        insert_count = sum(1 for c in keys.values() if c > 0)
+        illegal = {k: c for k, c in sorted(keys.items(), key=repr)
+                   if c > 1}
+        return {"valid?": not illegal,
+                "key-count": len(keys),
+                "legal-count": insert_count - len(illegal),
+                "illegal-count": len(illegal),
+                "illegal": illegal}
+
+
+def g2_checker():
+    return G2Checker()
+
+
+def workload(opts=None) -> dict:
+    return {"checker": g2_checker(), "generator": g2_gen()}

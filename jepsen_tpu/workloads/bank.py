@@ -1,0 +1,174 @@
+"""Bank workload: transfers between accounts under snapshot isolation —
+every read must observe the same total balance
+(reference: `jepsen/src/jepsen/tests/bank.clj`).
+
+Test-map options: accounts, total-amount, max-transfer,
+negative-balances?.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+def read_gen(test, process):
+    """bank.clj read :20."""
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def transfer_gen(test, process):
+    """bank.clj transfer :25."""
+    accounts = test["accounts"]
+    return {"type": "invoke", "f": "transfer",
+            "value": {"from": random.choice(accounts),
+                      "to": random.choice(accounts),
+                      "amount": 1 + random.randrange(test["max-transfer"])}}
+
+
+diff_transfer = gen.gfilter(
+    lambda op: op["value"]["from"] != op["value"]["to"], transfer_gen)
+
+
+def generator():
+    """A mixture of reads and transfers (bank.clj:44-47)."""
+    return gen.mix([diff_transfer, read_gen])
+
+
+def err_badness(test, err: dict) -> float:
+    """Bigger numbers = more egregious errors (bank.clj:49-57)."""
+    t = err["type"]
+    if t == "unexpected-key":
+        return len(err["unexpected"])
+    if t == "nil-balance":
+        return len(err["nils"])
+    if t == "wrong-total":
+        return abs((err["total"] - test["total-amount"])
+                   / test["total-amount"])
+    if t == "negative-value":
+        return -sum(err["negative"])
+    return 0
+
+
+def check_op(accts: set, total: int, negative_balances: bool,
+             op) -> Optional[dict]:
+    """Errors in a single read's balances (bank.clj check-op :58-82)."""
+    value = op.value or {}
+    ks = list(value.keys())
+    balances = list(value.values())
+    if not all(k in accts for k in ks):
+        return {"type": "unexpected-key",
+                "unexpected": [k for k in ks if k not in accts],
+                "op": op}
+    if any(b is None for b in balances):
+        return {"type": "nil-balance",
+                "nils": {k: v for k, v in value.items() if v is None},
+                "op": op}
+    if sum(balances) != total:
+        return {"type": "wrong-total", "total": sum(balances), "op": op}
+    if not negative_balances and any(b < 0 for b in balances):
+        return {"type": "negative-value",
+                "negative": [b for b in balances if b < 0], "op": op}
+    return None
+
+
+class BankChecker(ck.Checker):
+    """All reads sum to total-amount; balances non-negative unless
+    negative-balances? (bank.clj checker :84-126)."""
+
+    def __init__(self, checker_opts=None):
+        self.opts = dict(checker_opts or {})
+
+    def check(self, test, history, opts=None):
+        accts = set(test["accounts"])
+        total = test["total-amount"]
+        neg_ok = self.opts.get("negative-balances?", False)
+        reads = [o for o in History(history)
+                 if o.is_ok and o.f == "read"]
+        errors: dict = {}
+        for op in reads:
+            err = check_op(accts, total, neg_ok, op)
+            if err is not None:
+                errors.setdefault(err["type"], []).append(err)
+        first_error = None
+        firsts = [errs[0] for errs in errors.values()]
+        if firsts:
+            first_error = min(
+                firsts, key=lambda e: e["op"].index
+                if e["op"].index is not None else 0)
+        out_errors = {}
+        for t, errs in errors.items():
+            entry = {"count": len(errs), "first": errs[0],
+                     "worst": max(errs,
+                                  key=lambda e: err_badness(test, e)),
+                     "last": errs[-1]}
+            if t == "wrong-total":
+                entry["lowest"] = min(errs, key=lambda e: e["total"])
+                entry["highest"] = max(errs, key=lambda e: e["total"])
+            out_errors[t] = entry
+        return {"valid?": not errors,
+                "read-count": len(reads),
+                "error-count": sum(len(v) for v in errors.values()),
+                "first-error": first_error,
+                "errors": out_errors}
+
+
+def checker(checker_opts=None):
+    return BankChecker(checker_opts)
+
+
+class BalancePlotter(ck.Checker):
+    """Graph of total balance over time by node (bank.clj plotter
+    :139-171; matplotlib in place of gnuplot)."""
+
+    def check(self, test, history, opts=None):
+        if not (test and test.get("name") and test.get("start-time")):
+            return {"valid?": True}
+        from jepsen_tpu import store
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        nodes = test.get("nodes") or []
+        by_node: dict = {}
+        for o in History(history):
+            if (o.is_ok and o.f == "read" and isinstance(o.process, int)
+                    and o.process >= 0 and o.value):
+                node = nodes[o.process % len(nodes)] if nodes else "-"
+                total = sum(v for v in o.value.values() if v is not None)
+                by_node.setdefault(node, []).append(
+                    ((o.time or 0) / 1e9, total))
+        sub = list((opts or {}).get("subdirectory") or [])
+        path = store.make_path(test, *sub, "bank.png")
+        fig, ax = plt.subplots(figsize=(10, 4))
+        for node, pts in sorted(by_node.items()):
+            xs, ys = zip(*pts)
+            ax.scatter(xs, ys, s=6, label=str(node))
+        ax.set_xlabel("time (s)")
+        ax.set_ylabel("Total of all accounts")
+        ax.set_title(f"{test.get('name')} bank")
+        if by_node:
+            ax.legend(loc="upper right")
+        fig.savefig(path, dpi=100)
+        plt.close(fig)
+        return {"valid?": True}
+
+
+def plotter():
+    return BalancePlotter()
+
+
+def workload(opts=None) -> dict:
+    """bank.clj test :173-186."""
+    opts = dict(opts or {})
+    return {
+        "max-transfer": 5,
+        "total-amount": 100,
+        "accounts": list(range(8)),
+        "checker": ck.compose({"SI": checker(opts), "plot": plotter()}),
+        "generator": generator(),
+    }

@@ -1,0 +1,126 @@
+"""Causal-consistency register workload
+(reference: `jepsen/src/jepsen/tests/causal.clj`): a causal order of
+(read-init, w1, read, w2, read) per key must execute in issue order;
+ops carry position/link metadata tying each to the last-seen position.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu.history import History
+from jepsen_tpu.models import Inconsistent, inconsistent, is_inconsistent
+
+
+class CausalRegister:
+    """causal.clj CausalRegister :32-87: value, op counter, last
+    position."""
+
+    def __init__(self, value=0, counter=0, last_pos=None):
+        self.value = value
+        self.counter = counter
+        self.last_pos = last_pos
+
+    def step(self, op):
+        c = self.counter + 1
+        v = op.value
+        pos = op.get("position")
+        link = op.get("link")
+        if link != "init" and link != self.last_pos:
+            return inconsistent(
+                f"Cannot link {link!r} to last-seen position "
+                f"{self.last_pos!r}")
+        if op.f == "write":
+            if v == c:
+                return CausalRegister(v, c, pos)
+            return inconsistent(
+                f"expected value {c} attempting to write {v} instead")
+        if op.f == "read-init":
+            if self.counter == 0 and v not in (0, None):
+                return inconsistent(f"expected init value 0, read {v}")
+            if v is None or v == self.value:
+                return CausalRegister(self.value, self.counter, pos)
+            return inconsistent(
+                f"can't read {v} from register {self.value}")
+        if op.f == "read":
+            if v is None or v == self.value:
+                return CausalRegister(self.value, self.counter, pos)
+            return inconsistent(
+                f"can't read {v} from register {self.value}")
+        return inconsistent(f"unknown f {op.f!r}")
+
+    def __repr__(self):
+        return f"CausalRegister({self.value})"
+
+
+def causal_register():
+    return CausalRegister()
+
+
+class CausalChecker(ck.Checker):
+    """Fold ok ops through the causal register (causal.clj check
+    :89-116)."""
+
+    def __init__(self, model=None):
+        self.model = model or causal_register()
+
+    def check(self, test, history, opts=None):
+        s = self.model
+        for op in History(history):
+            if not op.is_ok:
+                continue
+            s2 = s.step(op)
+            if is_inconsistent(s2):
+                return {"valid?": False, "error": s2.msg}
+            s = s2
+        return {"valid?": True, "model": s}
+
+
+def check(model=None):
+    return CausalChecker(model)
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def ri(test, process):
+    return {"type": "invoke", "f": "read-init", "value": None}
+
+
+def cw1(test, process):
+    return {"type": "invoke", "f": "write", "value": 1}
+
+
+def cw2(test, process):
+    return {"type": "invoke", "f": "write", "value": 2}
+
+
+def workload(opts=None) -> dict:
+    """causal.clj test :118-130."""
+    opts = dict(opts or {})
+    g = independent.concurrent_generator(
+        1, _naturals(), lambda k: gen.gseq([ri, cw1, r, cw2, r]))
+    g = gen.stagger(1, g)
+    g = gen.nemesis(
+        gen.gseq(_nemesis_cycle()), g)
+    if opts.get("time-limit"):
+        g = gen.time_limit(opts["time-limit"], g)
+    return {"checker": independent.checker(check(causal_register())),
+            "generator": g}
+
+
+def _naturals():
+    k = 0
+    while True:
+        yield k
+        k += 1
+
+
+def _nemesis_cycle():
+    while True:
+        yield gen.sleep(10)
+        yield {"type": "info", "f": "start"}
+        yield gen.sleep(10)
+        yield {"type": "info", "f": "stop"}

@@ -1,0 +1,80 @@
+"""Canonical independent-keys linearizability workload
+(reference: `jepsen/src/jepsen/tests/linearizable_register.clj`):
+CAS-register model + timeline per key, concurrent-generator with 2n
+threads per key, ~128 ops/key.
+
+Ops:  {type: invoke, f: write, value: [k, v]}
+      {type: invoke, f: read,  value: [k, None]}
+      {type: invoke, f: cas,   value: [k, [v, v']]}
+
+The checker is this framework's flagship path: the batched
+vmap-over-keys WGL kernel by default (`device` mode), with the
+reference-shaped host-parallel `independent.checker` composition
+available as `host` mode.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent
+from jepsen_tpu import models
+from jepsen_tpu.checker import timeline
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def workload(opts=None) -> dict:
+    """linearizable_register.clj test :22-45.  Options: nodes (for
+    thread-count), per-key-limit (default 128), checker-mode
+    ('device' = batched TPU kernel | 'host' = per-key compose with
+    timeline)."""
+    opts = dict(opts or {})
+    n = len(opts.get("nodes") or [1])
+    per_key_limit = opts.get("per-key-limit", 128)
+    mode = opts.get("checker-mode", "device")
+
+    if mode == "device":
+        checker = ck.compose({
+            "linearizable": independent.batch_checker(
+                models.cas_register()),
+            "timeline": independent.checker(timeline.html_timeline()),
+        })
+    else:
+        checker = independent.checker(ck.compose({
+            "linearizable": ck.linearizable(
+                {"model": models.cas_register()}),
+            "timeline": timeline.html_timeline(),
+        }))
+
+    def fgen(k):
+        # Randomized limit so keys drift off Significant Event
+        # Boundaries (linearizable_register.clj:38-44).
+        lim = int((0.9 + random.random() * 0.1) * per_key_limit)
+        return gen.limit(lim, gen.reserve(n, r, gen.mix([w, cas, cas])))
+
+    return {
+        "checker": checker,
+        "generator": independent.concurrent_generator(
+            2 * n, _naturals(), fgen),
+    }
+
+
+def _naturals():
+    k = 0
+    while True:
+        yield k
+        k += 1

@@ -1,0 +1,275 @@
+"""Workload library tests: bank, long-fork, adya G2, causal,
+linearizable-register (reference semantics from
+`jepsen/src/jepsen/tests/*.clj`)."""
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent as ind
+from jepsen_tpu.history import History, invoke_op, ok_op, fail_op
+from jepsen_tpu.workloads import adya, bank, causal, long_fork
+from jepsen_tpu.workloads import linearizable_register as linreg
+from tests.test_generator import ops
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    from jepsen_tpu import store
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+BANK_TEST = {"accounts": [0, 1, 2], "total-amount": 30,
+             "max-transfer": 5, "nodes": ["n1"], "name": None}
+
+
+class TestBank:
+    def check(self, history):
+        return bank.checker().check(BANK_TEST, History(history).index(), {})
+
+    def test_valid(self):
+        r = self.check([invoke_op(0, "read", None),
+                        ok_op(0, "read", {0: 10, 1: 10, 2: 10})])
+        assert r["valid?"] is True
+        assert r["read-count"] == 1
+
+    def test_wrong_total(self):
+        r = self.check([invoke_op(0, "read", None),
+                        ok_op(0, "read", {0: 10, 1: 10, 2: 11})])
+        assert r["valid?"] is False
+        assert "wrong-total" in r["errors"]
+        assert r["errors"]["wrong-total"]["first"]["total"] == 31
+
+    def test_unexpected_key(self):
+        r = self.check([invoke_op(0, "read", None),
+                        ok_op(0, "read", {0: 10, 1: 10, 9: 10})])
+        assert r["valid?"] is False
+        assert "unexpected-key" in r["errors"]
+
+    def test_nil_balance(self):
+        r = self.check([invoke_op(0, "read", None),
+                        ok_op(0, "read", {0: 10, 1: 10, 2: None})])
+        assert r["valid?"] is False
+        assert "nil-balance" in r["errors"]
+
+    def test_negative_value(self):
+        r = self.check([invoke_op(0, "read", None),
+                        ok_op(0, "read", {0: 35, 1: -5, 2: 0})])
+        assert r["valid?"] is False
+        assert "negative-value" in r["errors"]
+
+    def test_negative_ok_when_allowed(self):
+        r = bank.checker({"negative-balances?": True}).check(
+            BANK_TEST,
+            History([invoke_op(0, "read", None),
+                     ok_op(0, "read", {0: 35, 1: -5, 2: 0})]).index(), {})
+        assert r["valid?"] is True
+
+    def test_generator_emits_reads_and_transfers(self):
+        test = dict(BANK_TEST)
+        test["concurrency"] = 2
+        g = bank.generator()
+        got = [gen.op(g, test, 0) for _ in range(40)]
+        fs = {o["f"] for o in got}
+        assert fs == {"read", "transfer"}
+        for o in got:
+            if o["f"] == "transfer":
+                assert o["value"]["from"] != o["value"]["to"]
+
+    def test_workload_shape(self):
+        w = bank.workload()
+        assert w["accounts"] == list(range(8))
+        assert isinstance(w["checker"], ck.Compose)
+
+
+class TestLongFork:
+    def lf(self, h, n=2):
+        return long_fork.checker(n).check({}, History(h).index(), {})
+
+    def test_valid_order(self):
+        r = self.lf([
+            invoke_op(0, "write", [["w", 0, 1]]),
+            ok_op(0, "write", [["w", 0, 1]]),
+            invoke_op(1, "read", [["r", 0, None], ["r", 1, None]]),
+            ok_op(1, "read", [["r", 0, 1], ["r", 1, None]]),
+            invoke_op(2, "read", [["r", 0, None], ["r", 1, None]]),
+            ok_op(2, "read", [["r", 0, 1], ["r", 1, 1]]),
+        ])
+        assert r["valid?"] is True
+        assert r["reads-count"] == 2
+
+    def test_long_fork_detected(self):
+        # T3 sees y=1, x=nil; T4 sees x=1, y=nil: conflicting orders.
+        r = self.lf([
+            invoke_op(0, "read", None),
+            ok_op(0, "read", [["r", 0, None], ["r", 1, 1]]),
+            invoke_op(1, "read", None),
+            ok_op(1, "read", [["r", 0, 1], ["r", 1, None]]),
+        ])
+        assert r["valid?"] is False
+        assert len(r["forks"]) == 1
+
+    def test_multiple_writes_unknown(self):
+        r = self.lf([
+            invoke_op(0, "write", [["w", 0, 1]]),
+            ok_op(0, "write", [["w", 0, 1]]),
+            invoke_op(1, "write", [["w", 0, 1]]),
+            ok_op(1, "write", [["w", 0, 1]]),
+        ])
+        assert r["valid?"] == "unknown"
+
+    def test_matrix_path_matches_pairwise(self):
+        # >8 reads triggers the dominance-matrix path; same verdict.
+        h = []
+        for i in range(10):
+            h.append(invoke_op(i, "read", None))
+            h.append(ok_op(i, "read", [["r", 0, 1 if i % 2 else None],
+                                       ["r", 1, None if i % 2 else 1]]))
+        r = self.lf(h)
+        assert r["valid?"] is False
+        assert len(r["forks"]) == 25  # 5 evens x 5 odds
+
+    def test_read_compare(self):
+        assert long_fork.read_compare({0: 1, 1: None}, {0: 1, 1: None}) == 0
+        assert long_fork.read_compare({0: 1, 1: 1}, {0: 1, 1: None}) == -1
+        assert long_fork.read_compare({0: 1, 1: None}, {0: 1, 1: 1}) == 1
+        assert long_fork.read_compare(
+            {0: 1, 1: None}, {0: None, 1: 1}) is None
+
+    def test_group_for(self):
+        assert list(long_fork.group_for(2, 5)) == [4, 5]
+        assert list(long_fork.group_for(3, 7)) == [6, 7, 8]
+
+    def test_generator(self):
+        got = ops((0, 1, 2), gen.limit(30, long_fork.generator(2)))
+        fs = [o["f"] for o in got]
+        assert "write" in fs and "read" in fs
+        for o in got:
+            if o["f"] == "read":
+                assert len(o["value"]) == 2
+
+
+class TestAdya:
+    def test_g2_checker_valid(self):
+        h = History([
+            invoke_op(0, "insert", ind.KV(1, [None, 1])),
+            ok_op(0, "insert", ind.KV(1, [None, 1])),
+            invoke_op(1, "insert", ind.KV(1, [2, None])),
+            fail_op(1, "insert", ind.KV(1, [2, None])),
+        ]).index()
+        r = adya.g2_checker().check({}, h, {})
+        assert r["valid?"] is True
+        assert r["key-count"] == 1
+        assert r["legal-count"] == 1
+
+    def test_g2_checker_violation(self):
+        h = History([
+            invoke_op(0, "insert", ind.KV(1, [None, 1])),
+            ok_op(0, "insert", ind.KV(1, [None, 1])),
+            invoke_op(1, "insert", ind.KV(1, [2, None])),
+            ok_op(1, "insert", ind.KV(1, [2, None])),
+        ]).index()
+        r = adya.g2_checker().check({}, h, {})
+        assert r["valid?"] is False
+        assert r["illegal"] == {1: 2}
+
+    def test_g2_gen_unique_ids(self):
+        test = {"concurrency": 4}
+        got = ops((0, 1, 2, 3), gen.limit(8, adya.g2_gen()))
+        ids = [x for o in got for x in o["value"].value if x is not None]
+        assert len(ids) == len(set(ids))
+
+
+class TestCausal:
+    def step_all(self, ops_):
+        return causal.check().check({}, History(ops_).index(), {})
+
+    def test_valid_sequence(self):
+        r = self.step_all([
+            ok_op(0, "read-init", None, extra={"position": 1,
+                                               "link": "init"}),
+            ok_op(0, "write", 1, extra={"position": 2, "link": 1}),
+            ok_op(0, "read", 1, extra={"position": 3, "link": 2}),
+            ok_op(0, "write", 2, extra={"position": 4, "link": 3}),
+            ok_op(0, "read", 2, extra={"position": 5, "link": 4}),
+        ])
+        assert r["valid?"] is True
+
+    def test_broken_link(self):
+        r = self.step_all([
+            ok_op(0, "read-init", None, extra={"position": 1,
+                                               "link": "init"}),
+            ok_op(0, "write", 1, extra={"position": 2, "link": 99}),
+        ])
+        assert r["valid?"] is False
+        assert "Cannot link" in r["error"]
+
+    def test_bad_write_value(self):
+        r = self.step_all([
+            ok_op(0, "read-init", None, extra={"position": 1,
+                                               "link": "init"}),
+            ok_op(0, "write", 7, extra={"position": 2, "link": 1}),
+        ])
+        assert r["valid?"] is False
+
+    def test_bad_init_read(self):
+        r = self.step_all([
+            ok_op(0, "read-init", 5, extra={"position": 1,
+                                            "link": "init"}),
+        ])
+        assert r["valid?"] is False
+
+
+class TestLinearizableRegister:
+    def test_workload_shape(self):
+        w = linreg.workload({"nodes": ["n1", "n2"]})
+        assert "checker" in w and "generator" in w
+
+    def test_device_checker_on_generated_history(self):
+        """Drive the workload's generator end-to-end and check with the
+        batched device path."""
+        from jepsen_tpu import core, tests as tst
+
+        state_by_key = {}
+        import threading
+        lock = threading.Lock()
+
+        from jepsen_tpu import client as client_mod
+
+        class MultiKeyClient(client_mod.Client):
+            def open(self, test, node):
+                return self
+
+            def invoke(self, test, op):
+                k, v = op.value
+                with lock:
+                    cur = state_by_key.get(k)
+                    if op.f == "write":
+                        state_by_key[k] = v
+                        return op.assoc(type="ok")
+                    if op.f == "read":
+                        return op.assoc(type="ok",
+                                        value=ind.KV(k, cur))
+                    old, new = v
+                    if cur == old:
+                        state_by_key[k] = new
+                        return op.assoc(type="ok")
+                    return op.assoc(type="fail")
+
+        test = dict(tst.noop_test())
+        w = linreg.workload({"nodes": test["nodes"],
+                             "per-key-limit": 20})
+        test.update(w)
+        test.update({
+            "name": "linreg-device",
+            "concurrency": 2 * len(test["nodes"]),  # 2n threads per key
+            "client": MultiKeyClient(),
+            "generator": gen.nemesis(
+                gen.void,
+                gen.time_limit(30, gen.limit(200, w["generator"]))),
+        })
+        result = core.run(test)
+        assert result["results"]["valid?"] is True
+        assert result["results"]["linearizable"]["valid?"] is True
+        assert len(result["results"]["linearizable"]["results"]) >= 2
