@@ -1,0 +1,158 @@
+"""Cycle / SCC kernels on device — dependency-graph analysis as matmul.
+
+The reference detects serializability anomalies by order/graph reasoning
+on the host (`jepsen/src/jepsen/tests/long_fork.clj:216-271`, the
+cockroach `monotonic` checker, `jepsen/src/jepsen/tests/adya.clj`), and
+its checker complexity notes single out graph search as a scaling wall.
+Here the dependency graph of a transaction history becomes a boolean
+adjacency matrix, and reachability / strongly-connected components are
+computed by **iterated boolean matrix squaring** — ⌈log2 n⌉ matmuls that
+XLA tiles straight onto the MXU (BASELINE.json config 4).
+
+    closure:  R ← R ∨ R·R            (log-squaring transitive closure)
+    on-cycle: diag(R⁺)               (node reaches itself in ≥1 step)
+    SCC:      label i = min { j : R⁺[i,j] ∧ R⁺[j,i] }  (∨ i itself)
+
+Matrices are padded to 128×128 tiles so the matmuls land on the systolic
+array at full utilisation; 0/1 values make bf16×bf16→f32 accumulation
+exact, so `> 0` thresholds are safe.
+
+Host-side helpers recover one *explicit* cycle path per SCC for error
+reporting, walking the closure greedily — O(cycle length) host work only
+after the device has proved a cycle exists.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import numpy as np
+
+_TILE = 128
+
+
+def _pad_to_tile(n: int) -> int:
+    return max(_TILE, _TILE * math.ceil(n / _TILE))
+
+
+@functools.cache
+def _kernels(n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    # After k squarings R covers paths of length ≤ 2^k; n_pad-1 hops max.
+    steps = max(1, math.ceil(math.log2(max(n_pad - 1, 2))))
+
+    def _closure(adj):
+        def body(_, r):
+            rf = r.astype(jnp.float32)
+            return r | (jnp.dot(rf, rf) > 0.5)
+
+        return jax.lax.fori_loop(0, steps, body, adj)
+
+    @jax.jit
+    def closure(adj):
+        return _closure(adj)
+
+    @jax.jit
+    def scc(adj):
+        r = _closure(adj)
+        idx = jnp.arange(n_pad)
+        both = (r & r.T) | (idx[:, None] == idx[None, :])
+        labels = jnp.min(jnp.where(both, idx[None, :], n_pad), axis=1)
+        return labels, jnp.diagonal(r), r
+
+    return {"closure": closure, "scc": scc}
+
+
+def _pad(adj: np.ndarray) -> np.ndarray:
+    n = adj.shape[0]
+    n_pad = _pad_to_tile(n)
+    out = np.zeros((n_pad, n_pad), bool)
+    out[:n, :n] = np.asarray(adj, bool)
+    return out
+
+
+def transitive_closure(adj: np.ndarray) -> np.ndarray:
+    """R⁺ (paths of length ≥ 1) of a boolean adjacency matrix."""
+    n = adj.shape[0]
+    if n == 0:
+        return np.zeros((0, 0), bool)
+    k = _kernels(_pad_to_tile(n))["closure"]
+    return np.asarray(k(_pad(adj)))[:n, :n]
+
+
+def scc(adj: np.ndarray):
+    """(labels, on_cycle, closure): SCC label per node (min node index of
+    its component), mask of nodes on some ≥1-length cycle, and R⁺."""
+    n = adj.shape[0]
+    if n == 0:
+        return (np.zeros(0, np.int64), np.zeros(0, bool),
+                np.zeros((0, 0), bool))
+    k = _kernels(_pad_to_tile(n))["scc"]
+    labels, diag, r = k(_pad(adj))
+    return (np.asarray(labels)[:n], np.asarray(diag)[:n],
+            np.asarray(r)[:n, :n])
+
+
+def find_cycle(adj: np.ndarray,
+               closure: Optional[np.ndarray] = None) -> Optional[list]:
+    """One explicit cycle [v0, v1, …, v0] if the graph has any, else
+    None.  BFS from the lowest-indexed on-cycle node back to itself
+    (shortest such loop; parent pointers guarantee termination)."""
+    adj = np.asarray(adj, bool)
+    n = adj.shape[0]
+    if n == 0:
+        return None
+    if closure is None:
+        closure = transitive_closure(adj)
+    diag = np.diagonal(closure)
+    if not diag.any():
+        return None
+    start = int(np.argmax(diag))
+    if adj[start, start]:
+        return [start, start]
+    parent = {}
+    frontier = [start]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in map(int, np.nonzero(adj[u])[0]):
+                if v == start:
+                    path = [u]
+                    while path[-1] != start:
+                        path.append(parent[path[-1]])
+                    path.reverse()
+                    path.append(start)
+                    return path
+                if v not in parent:
+                    parent[v] = u
+                    nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def cycles_by_component(adj: np.ndarray) -> list:
+    """One explicit cycle per non-trivial SCC (for reporting every
+    independent anomaly, not just the first)."""
+    adj = np.asarray(adj, bool)
+    labels, on_cycle, closure = scc(adj)
+    out = []
+    for comp in np.unique(labels[on_cycle]):
+        members = np.nonzero(labels == comp)[0]
+        sub = adj[np.ix_(members, members)]
+        cyc = find_cycle(sub, closure[np.ix_(members, members)])
+        if cyc is not None:
+            out.append([int(members[i]) for i in cyc])
+    return out
+
+
+def reachability_from(adj: np.ndarray, sources: np.ndarray) -> np.ndarray:
+    """Boolean reachability of every node from a set of sources in one
+    closure pass — the building block for monotonicity / precedes
+    queries."""
+    closure = transitive_closure(adj)
+    src = np.asarray(sources, bool)
+    return src @ closure | src

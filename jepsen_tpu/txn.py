@@ -29,4 +29,5 @@ def is_write(mop) -> bool:
 
 
 def is_op(mop) -> bool:
-    return len(mop) == 3 and f(mop) in ("r", "w", "read", "write")
+    return (isinstance(mop, (list, tuple)) and len(mop) == 3
+            and f(mop) in ("r", "w", "read", "write"))

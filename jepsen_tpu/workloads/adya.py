@@ -35,14 +35,7 @@ def g2_gen():
                           "value": [next_id(), None]},
         ])
 
-    return independent.concurrent_generator(2, _naturals(), fgen)
-
-
-def _naturals():
-    k = 0
-    while True:
-        yield k
-        k += 1
+    return independent.concurrent_generator(2, itertools.count(), fgen)
 
 
 class G2Checker(ck.Checker):

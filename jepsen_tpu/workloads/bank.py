@@ -163,12 +163,13 @@ def plotter():
 
 
 def workload(opts=None) -> dict:
-    """bank.clj test :173-186."""
+    """bank.clj test :173-186; accounts / total-amount / max-transfer
+    options override the defaults and flow into the test map."""
     opts = dict(opts or {})
     return {
-        "max-transfer": 5,
-        "total-amount": 100,
-        "accounts": list(range(8)),
+        "max-transfer": opts.get("max-transfer", 5),
+        "total-amount": opts.get("total-amount", 100),
+        "accounts": list(opts.get("accounts", range(8))),
         "checker": ck.compose({"SI": checker(opts), "plot": plotter()}),
         "generator": generator(),
     }

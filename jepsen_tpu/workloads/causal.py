@@ -6,6 +6,7 @@ ops carry position/link metadata tying each to the last-seen position.
 
 from __future__ import annotations
 
+import itertools
 from jepsen_tpu import checker as ck
 from jepsen_tpu import generator as gen
 from jepsen_tpu import independent
@@ -101,7 +102,7 @@ def workload(opts=None) -> dict:
     """causal.clj test :118-130."""
     opts = dict(opts or {})
     g = independent.concurrent_generator(
-        1, _naturals(), lambda k: gen.gseq([ri, cw1, r, cw2, r]))
+        1, itertools.count(), lambda k: gen.gseq([ri, cw1, r, cw2, r]))
     g = gen.stagger(1, g)
     g = gen.nemesis(
         gen.gseq(_nemesis_cycle()), g)
@@ -109,13 +110,6 @@ def workload(opts=None) -> dict:
         g = gen.time_limit(opts["time-limit"], g)
     return {"checker": independent.checker(check(causal_register())),
             "generator": g}
-
-
-def _naturals():
-    k = 0
-    while True:
-        yield k
-        k += 1
 
 
 def _nemesis_cycle():

@@ -15,6 +15,7 @@ available as `host` mode.
 
 from __future__ import annotations
 
+import itertools
 import random
 
 from jepsen_tpu import checker as ck
@@ -69,12 +70,5 @@ def workload(opts=None) -> dict:
     return {
         "checker": checker,
         "generator": independent.concurrent_generator(
-            2 * n, _naturals(), fgen),
+            2 * n, itertools.count(), fgen),
     }
-
-
-def _naturals():
-    k = 0
-    while True:
-        yield k
-        k += 1
