@@ -187,9 +187,8 @@ def completed_txns(history) -> list:
     for o in hist:
         if not isinstance(o.value, (list, tuple)):
             continue
-        if not all(mop.is_op(m) for m in o.value or []):
-            if o.value:
-                continue
+        if o.value and not all(mop.is_op(m) for m in o.value):
+            continue
         if o.is_invoke:
             inv[o.process] = o
         elif o.is_ok and o.process in inv:

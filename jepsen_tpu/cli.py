@@ -1,0 +1,206 @@
+"""Command-line runner — L7 (reference: `jepsen/src/jepsen/cli.clj`).
+
+A test binary is a map of subcommands; `single_test_cmd` wires the
+standard trio the reference ships (`cli.clj:229,306,323`):
+
+    test     build a test map from CLI options and run it
+    analyze  reload the latest stored history, merge a *fresh* checker
+             from the current options, and re-run analysis only —
+             the checkpoint/resume path (cli.clj:366-397)
+    serve    the web dashboard over store/
+
+Exit codes follow `cli.clj:110-119`: 0 all tests valid, 1 some test
+invalid, 254 validity unknown (or crashed mid-run), 255 usage/setup
+error.
+
+Option conventions mirror `test-opt-spec` (cli.clj:54-92): repeatable
+`--node`, `--nodes-file`, concurrency as an integer or `"3n"` meaning
+3 × #nodes (cli.clj:130-145), `--time-limit`, `--test-count`, and SSH
+options collected into an `ssh` submap (cli.clj:200-216).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import traceback
+from typing import Callable, Optional
+
+from jepsen_tpu import core, store
+
+log = logging.getLogger("jepsen.cli")
+
+DEFAULT_NODES = ["n1", "n2", "n3", "n4", "n5"]
+
+
+def parse_concurrency(s: str, n_nodes: int) -> int:
+    """'10' -> 10; '3n' -> 3 * n_nodes (cli.clj:130-145)."""
+    s = str(s).strip()
+    if s.endswith("n"):
+        mult = s[:-1] or "1"
+        return int(mult) * n_nodes
+    return int(s)
+
+
+def test_opt_spec(parser: argparse.ArgumentParser) -> None:
+    """The standard test options (cli.clj:54-92)."""
+    parser.add_argument("-n", "--node", action="append", dest="nodes",
+                        metavar="HOST",
+                        help="node to run against (repeatable)")
+    parser.add_argument("--nodes-file", metavar="FILE",
+                        help="file with one node hostname per line")
+    parser.add_argument("--username", default="root",
+                        help="SSH username")
+    parser.add_argument("--password", default=None, help="SSH password")
+    parser.add_argument("--ssh-private-key", default=None,
+                        metavar="FILE", help="path to an SSH identity file")
+    parser.add_argument("--strict-host-key-checking", action="store_true",
+                        help="verify host keys")
+    parser.add_argument("--dummy", action="store_true",
+                        help="no-SSH dummy transport (control.clj *dummy*)")
+    parser.add_argument("--concurrency", default="1n", metavar="INT|INTn",
+                        help="number of workers; '3n' = 3 x #nodes")
+    parser.add_argument("--time-limit", type=float, default=60,
+                        metavar="SECONDS",
+                        help="how long to run the test for")
+    parser.add_argument("--test-count", type=int, default=1,
+                        help="how many times to run the test")
+    parser.add_argument("--leave-db-running", action="store_true",
+                        help="skip DB teardown for post-mortem inspection")
+
+
+def options_to_test_opts(opts: argparse.Namespace) -> dict:
+    """Namespace -> the option map handed to the user's test_fn, with
+    nodes resolved, concurrency expanded, and ssh submap collected
+    (rename-ssh-options, cli.clj:200-216)."""
+    nodes = list(opts.nodes or [])
+    if opts.nodes_file:
+        with open(opts.nodes_file) as f:
+            nodes += [ln.strip() for ln in f if ln.strip()]
+    nodes = nodes or list(DEFAULT_NODES)
+    return {
+        "nodes": nodes,
+        "concurrency": parse_concurrency(opts.concurrency, len(nodes)),
+        "time-limit": opts.time_limit,
+        "test-count": opts.test_count,
+        "leave-db-running": opts.leave_db_running,
+        "ssh": {
+            "username": opts.username,
+            "password": opts.password,
+            "private-key-path": opts.ssh_private_key,
+            "strict-host-key-checking": opts.strict_host_key_checking,
+            "dummy": opts.dummy,
+        },
+        "argv-options": vars(opts),
+    }
+
+
+def _validity(results: Optional[dict]):
+    return (results or {}).get("valid?")
+
+
+def run_test_cmd(test_fn: Callable[[dict], dict], opts) -> int:
+    """Run test-count tests; worst validity wins (cli.clj:110-119)."""
+    topts = options_to_test_opts(opts)
+    worst = 0
+    for i in range(topts["test-count"]):
+        test = test_fn(topts)
+        try:
+            completed = core.run(test)
+        except Exception:
+            # Crashed mid-run: outcome unknown, distinct from a usage
+            # error (255) so callers can route to analyze-resume.
+            traceback.print_exc()
+            return 254
+        v = _validity(completed.get("results"))
+        code = 0 if v is True else (1 if v is False else 254)
+        worst = max(worst, code)
+    return worst
+
+
+def analyze_cmd(test_fn: Callable[[dict], dict], opts) -> int:
+    """Re-check the latest stored history against a fresh test map built
+    from the current options (cli.clj:366-397)."""
+    topts = options_to_test_opts(opts)
+    fresh = test_fn(topts)
+    stored = store.latest()
+    if stored is None:
+        print("no stored test to analyze", file=sys.stderr)
+        return 255
+    merged = dict(fresh)
+    merged.update({k: v for k, v in stored.items()
+                   if k in ("history", "name", "start-time", "nodes")})
+    merged["history"] = stored.get("history") or []
+    completed = core.analyze(merged)   # writes save_2 for named tests
+    core.log_results(completed)
+    v = _validity(completed.get("results"))
+    return 0 if v is True else (1 if v is False else 254)
+
+
+def serve_cmd_run(opts) -> int:
+    from jepsen_tpu import web
+    web.serve(host=opts.host, port=opts.port, block=True)
+    return 0
+
+
+def single_test_cmd(test_fn: Callable[[dict], dict],
+                    opt_fn: Optional[Callable] = None) -> dict:
+    """The standard command map for a suite with one test constructor
+    (cli.clj:323-397): test / analyze share the test options."""
+
+    def add_opts(parser):
+        test_opt_spec(parser)
+        if opt_fn:
+            opt_fn(parser)
+
+    return {
+        "test": {"opts": add_opts,
+                 "run": lambda opts: run_test_cmd(test_fn, opts),
+                 "help": "Run a test from CLI options."},
+        "analyze": {"opts": add_opts,
+                    "run": lambda opts: analyze_cmd(test_fn, opts),
+                    "help": "Re-check the latest stored history with a "
+                            "fresh checker."},
+        **serve_cmd(),
+    }
+
+
+def serve_cmd() -> dict:
+    def add_opts(parser):
+        parser.add_argument("-b", "--host", default="0.0.0.0")
+        parser.add_argument("-p", "--port", type=int, default=8080)
+
+    return {"serve": {"opts": add_opts, "run": serve_cmd_run,
+                      "help": "Serve the web dashboard over store/."}}
+
+
+def run(commands: dict, argv: Optional[list] = None) -> None:
+    """Top-level dispatch; exits the process (cli.clj run! :229)."""
+    sys.exit(main(commands, argv))
+
+
+def main(commands: dict, argv: Optional[list] = None) -> int:
+    """Like run() but returns the exit code (for tests / embedding)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(prog="jepsen")
+    sub = parser.add_subparsers(dest="command")
+    for name, spec in commands.items():
+        p = sub.add_parser(name, help=spec.get("help"))
+        if spec.get("opts"):
+            spec["opts"](p)
+    try:
+        opts = parser.parse_args(argv)
+    except SystemExit as e:
+        return 255 if e.code not in (0, None) else 0
+    if not opts.command:
+        parser.print_help()
+        return 255
+    try:
+        code = commands[opts.command]["run"](opts)
+        return int(code or 0)
+    except KeyboardInterrupt:
+        return 255
+    except Exception:
+        traceback.print_exc()
+        return 255

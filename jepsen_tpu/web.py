@@ -1,0 +1,179 @@
+"""Web dashboard — L7 (reference: `jepsen/src/jepsen/web.clj`).
+
+A dependency-free HTTP dashboard over the store/ directory: a test
+table colored by validity (web.clj:25-34,122), a file browser rooted at
+the store (web.clj app :328), and zip export of a whole test run
+(web.clj:336 zip handler).  Built on http.server so it runs anywhere
+the framework does.
+"""
+
+from __future__ import annotations
+
+import html
+import io
+import json
+import threading
+import zipfile
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import quote, unquote
+
+from jepsen_tpu import store
+
+VALID_COLORS = {True: "#ADF6B0", False: "#F3BBBC", None: "#EAEAEA"}
+UNKNOWN_COLOR = "#F3EABB"
+
+
+def _color(valid):
+    if valid in VALID_COLORS:
+        return VALID_COLORS[valid]
+    return UNKNOWN_COLOR
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!DOCTYPE html><html><head><title>{html.escape(title)}"
+            "</title><style>"
+            "body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}"
+            "td,th{padding:.3em .8em;border:1px solid #ccc;text-align:left}"
+            "a{text-decoration:none}"
+            "</style></head><body>" + body + "</body></html>").encode()
+
+
+def _test_rows() -> list:
+    rows = []
+    for name, stamps in sorted(store.tests().items()):
+        for ts in sorted(stamps, reverse=True):
+            res = store.load_results(name, ts)
+            rows.append((name, ts, (res or {}).get("valid?")))
+    rows.sort(key=lambda r: r[1], reverse=True)
+    return rows
+
+
+def home_html() -> bytes:
+    rows = []
+    for name, ts, valid in _test_rows():
+        base = f"/files/{quote(name)}/{quote(ts)}"
+        rows.append(
+            f"<tr style='background:{_color(valid)}'>"
+            f"<td>{html.escape(name)}</td>"
+            f"<td><a href='{base}/'>{html.escape(ts)}</a></td>"
+            f"<td>{html.escape(json.dumps(valid))}</td>"
+            f"<td><a href='{base}/results.json'>results</a></td>"
+            f"<td><a href='{base}/history.txt'>history</a></td>"
+            f"<td><a href='/zip/{quote(name)}/{quote(ts)}'>zip</a></td>"
+            "</tr>")
+    body = ("<h1>Jepsen</h1><table><tr><th>Test</th><th>Time</th>"
+            "<th>Valid?</th><th>Results</th><th>History</th><th>Zip</th>"
+            "</tr>" + "".join(rows) + "</table>")
+    return _page("Jepsen", body)
+
+
+def _safe_path(rel: str) -> Path:
+    """Resolve an already-decoded path under the store root, refusing
+    traversal (containment via relative_to, not string prefix — a
+    sibling like store-backup/ must not pass)."""
+    base = store.BASE.resolve()
+    p = (base / rel.lstrip("/")).resolve()
+    try:
+        p.relative_to(base)
+    except ValueError:
+        raise PermissionError(rel)
+    return p
+
+
+def dir_html(rel: str, p: Path) -> bytes:
+    """rel is the decoded store-relative path; links re-encode it."""
+    ents = []
+    rel = rel.strip("/")
+    for child in sorted(p.iterdir()):
+        slash = "/" if child.is_dir() else ""
+        href = "/files/" + quote(f"{rel}/{child.name}" if rel
+                                 else child.name) + slash
+        ents.append(f"<li><a href='{href}'>"
+                    f"{html.escape(child.name)}{slash}</a></li>")
+    return _page(rel or "store",
+                 f"<h1>{html.escape(rel or 'store')}</h1><p>"
+                 "<a href='/'>&larr; tests</a></p><ul>"
+                 + "".join(ents) + "</ul>")
+
+
+def zip_bytes(name: str, ts: str) -> bytes:
+    d = _safe_path(f"{name}/{ts}")
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for f in sorted(d.rglob("*")):
+            if f.is_file():
+                z.write(f, f.relative_to(d.parent))
+    return buf.getvalue()
+
+
+_CONTENT_TYPES = {".json": "application/json", ".txt": "text/plain",
+                  ".log": "text/plain", ".jsonl": "text/plain",
+                  ".html": "text/html", ".png": "image/png",
+                  ".svg": "image/svg+xml"}
+
+
+class Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _send(self, code: int, body: bytes,
+              ctype: str = "text/html; charset=utf-8",
+              extra: dict = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        try:
+            path = self.path.split("?", 1)[0]
+            if path == "/" or path == "":
+                return self._send(200, home_html())
+            if path.startswith("/files/"):
+                rel = unquote(path[len("/files/"):])
+                p = _safe_path(rel)
+                if p.is_dir():
+                    return self._send(200, dir_html(rel, p))
+                if p.is_file():
+                    ctype = _CONTENT_TYPES.get(p.suffix,
+                                               "application/octet-stream")
+                    return self._send(200, p.read_bytes(), ctype)
+                return self._send(404, b"not found", "text/plain")
+            if path.startswith("/zip/"):
+                parts = [unquote(x) for x in
+                         path[len("/zip/"):].strip("/").split("/")]
+                if len(parts) == 2:
+                    data = zip_bytes(*parts)
+                    fname = f"{parts[0]}-{parts[1]}.zip"
+                    return self._send(
+                        200, data, "application/zip",
+                        {"Content-Disposition":
+                         f"attachment; filename=\"{fname}\""})
+            return self._send(404, b"not found", "text/plain")
+        except PermissionError:
+            return self._send(403, b"forbidden", "text/plain")
+        except (FileNotFoundError, NotADirectoryError):
+            return self._send(404, b"not found", "text/plain")
+        except Exception as e:  # pragma: no cover
+            return self._send(500, str(e).encode(), "text/plain")
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080, block: bool = True):
+    """Start the dashboard (web.clj serve! :336).  Non-blocking mode
+    returns the server; call .shutdown() to stop."""
+    srv = ThreadingHTTPServer((host, port), Handler)
+    if block:
+        print(f"Serving store on http://{host}:{srv.server_address[1]}/")
+        try:
+            srv.serve_forever()
+        finally:
+            srv.server_close()
+        return srv
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv

@@ -1,0 +1,217 @@
+"""CLI (cli.py) and web dashboard (web.py) tests — exit codes, option
+parsing, analyze-resume, and the HTTP surface over store/."""
+
+import json
+import urllib.request
+import zipfile
+import io
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli, core, generator as gen, models, store, web
+from jepsen_tpu import tests as tst
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def make_test_fn(lie: bool = False):
+    """test_fn(opts) -> test map over the in-memory atom DB; lie=True
+    produces a non-linearizable history (reads always return 42)."""
+
+    def test_fn(opts):
+        state = tst.Atom()
+        client = tst.atom_client(state)
+        if lie:
+            base_invoke = client.invoke
+
+            def bad_invoke(test, op):
+                out = base_invoke(test, op)
+                if op.f == "read" and out.type == "ok":
+                    return out.assoc(value=42)
+                return out
+
+            client.invoke = bad_invoke
+        return dict(tst.noop_test(), **{
+            "name": "cli-test",
+            "nodes": opts["nodes"],
+            "concurrency": min(opts["concurrency"], 4),
+            "db": tst.atom_db(state),
+            "client": client,
+            "generator": gen.nemesis(gen.void, gen.limit(12, gen.cas)),
+            "checker": ck.linearizable({"model": models.CASRegister(0)}),
+        })
+
+    return test_fn
+
+
+class TestConcurrency:
+    def test_plain_int(self):
+        assert cli.parse_concurrency("10", 5) == 10
+
+    def test_n_multiplier(self):
+        assert cli.parse_concurrency("3n", 5) == 15
+
+    def test_bare_n(self):
+        assert cli.parse_concurrency("n", 4) == 4
+
+
+class TestCli:
+    def test_valid_run_exits_0(self):
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["test", "--concurrency", "2",
+                               "--node", "a", "--node", "b"]) == 0
+
+    def test_invalid_run_exits_1(self):
+        cmds = cli.single_test_cmd(make_test_fn(lie=True))
+        assert cli.main(cmds, ["test", "--concurrency", "2"]) == 1
+
+    def test_usage_error_exits_255(self):
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["bogus-subcommand"]) == 255
+        assert cli.main(cmds, []) == 255
+
+    def test_nodes_file(self, tmp_path):
+        nf = tmp_path / "nodes"
+        nf.write_text("h1\nh2\nh3\n")
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["test", "--nodes-file", str(nf),
+                               "--concurrency", "1n"]) == 0
+        t = store.latest()
+        assert t["nodes"] == ["h1", "h2", "h3"]
+
+    def test_analyze_resume(self):
+        # Run once (valid), then re-analyze the stored history with a
+        # checker that rejects everything: resume path, exit 1.
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["test", "--concurrency", "2"]) == 0
+
+        class Rejector(ck.Checker):
+            def check(self, test, history, opts=None):
+                return {"valid?": False, "ops": len(history)}
+
+        def strict_fn(opts):
+            t = make_test_fn()(opts)
+            t["checker"] = Rejector()
+            return t
+
+        cmds2 = cli.single_test_cmd(strict_fn)
+        assert cli.main(cmds2, ["analyze"]) == 1
+        res = store.latest()["results"]
+        assert res["valid?"] is False
+        assert res["ops"] > 0
+
+    def test_analyze_without_store_exits_255(self):
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["analyze"]) == 255
+
+    def test_crashing_test_fn_exits_255(self):
+        def boom(opts):
+            raise RuntimeError("nope")
+        assert cli.main(cli.single_test_cmd(boom), ["test"]) == 255
+
+    def test_crash_mid_run_exits_254(self):
+        # DB setup failure: outcome unknown (254), not usage error (255).
+        from jepsen_tpu import db as db_mod
+
+        class BadDB(db_mod.DB):
+            def setup(self, test, node):
+                raise RuntimeError("disk on fire")
+
+        def test_fn(opts):
+            t = make_test_fn()(opts)
+            t["db"] = BadDB()
+            return t
+
+        assert cli.main(cli.single_test_cmd(test_fn),
+                        ["test", "--concurrency", "2"]) == 254
+
+
+class TestWeb:
+    @pytest.fixture()
+    def served(self):
+        # Two stored tests: one valid, one invalid.
+        cli.main(cli.single_test_cmd(make_test_fn()),
+                 ["test", "--concurrency", "2"])
+        cli.main(cli.single_test_cmd(make_test_fn(lie=True)),
+                 ["test", "--concurrency", "2"])
+        srv = web.serve(host="127.0.0.1", port=0, block=False)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        yield base
+        srv.shutdown()
+        srv.server_close()
+
+    def get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+
+    def test_home_lists_tests_with_colors(self, served):
+        status, body, _ = self.get(served + "/")
+        assert status == 200
+        text = body.decode()
+        assert "cli-test" in text
+        assert web.VALID_COLORS[True] in text
+        assert web.VALID_COLORS[False] in text
+
+    def test_file_browser_and_results(self, served):
+        t = store.latest()
+        name, ts = t["name"], store.test_dir(t).name
+        status, body, _ = self.get(f"{served}/files/{name}/{ts}/")
+        assert status == 200 and b"results.json" in body
+        status, body, hdrs = self.get(
+            f"{served}/files/{name}/{ts}/results.json")
+        assert status == 200
+        assert hdrs["Content-Type"] == "application/json"
+        assert json.loads(body)["valid?"] is False
+
+    def test_zip_download(self, served):
+        t = store.latest()
+        name, ts = t["name"], store.test_dir(t).name
+        status, body, hdrs = self.get(f"{served}/zip/{name}/{ts}")
+        assert status == 200 and hdrs["Content-Type"] == "application/zip"
+        z = zipfile.ZipFile(io.BytesIO(body))
+        names = z.namelist()
+        assert any(n.endswith("results.json") for n in names)
+        assert any(n.endswith("history.jsonl") for n in names)
+
+    def test_click_through_links_resolve(self, served):
+        # Follow hrefs exactly as a browser would: home -> timestamp dir
+        # (colon-encoded) -> file link from the listing.
+        import re
+        _, body, _ = self.get(served + "/")
+        m = re.search(r"href='(/files/[^']*/)'", body.decode())
+        assert m, "no directory link on home page"
+        status, listing, _ = self.get(served + m.group(1))
+        assert status == 200
+        m2 = re.search(r"href='(/files/[^']*results\.json)'",
+                       listing.decode())
+        assert m2, "no results.json link in listing"
+        status, res, _ = self.get(served + m2.group(1))
+        assert status == 200 and b"valid?" in res
+
+    def test_sibling_of_store_root_refused(self, served, tmp_path):
+        # A sibling dir sharing the store name as prefix must 403.
+        sibling = store.BASE.parent / (store.BASE.name + "-backup")
+        sibling.mkdir(exist_ok=True)
+        (sibling / "creds").write_text("secret")
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(served + "/files/..%2F" + store.BASE.name
+                     + "-backup%2Fcreds")
+        assert ei.value.code == 403
+
+    def test_traversal_refused(self, served):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(served + "/files/..%2f..%2fetc%2fpasswd")
+        assert ei.value.code in (403, 404)
+
+    def test_missing_file_404(self, served):
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(served + "/files/nope/nope/nope.txt")
+        assert ei.value.code == 404
