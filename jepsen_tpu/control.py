@@ -150,12 +150,18 @@ class DummySession(Session):
         return 0, "", ""
 
     def upload(self, local, remote):
+        cmd = f"<upload {local} {remote}>"
         with self.lock:
-            self.commands.append((f"<upload {local} {remote}>", None))
+            self.commands.append((cmd, None))
+        if self.handler is not None:
+            self.handler(self.node, cmd, None)
 
     def download(self, remote, local):
+        cmd = f"<download {remote} {local}>"
         with self.lock:
-            self.commands.append((f"<download {remote} {local}>", None))
+            self.commands.append((cmd, None))
+        if self.handler is not None:
+            self.handler(self.node, cmd, None)
 
 
 class SSHSession(Session):

@@ -1,0 +1,170 @@
+"""Node scripting helpers (reference: `jepsen/src/jepsen/control/util.clj`):
+file tests, temp dirs, cached downloads, archive installs, daemon
+management — everything a DB impl needs to provision a node, all built
+on the bound `control` session.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu.control import lit
+
+log = logging.getLogger("jepsen.control.util")
+
+WGET_CACHE = "/tmp/jepsen/wget-cache"
+
+
+def exists(path: str) -> bool:
+    """Does a file/dir exist on the node? (util.clj exists? :18)"""
+    out = c.execute(lit(f"test -e {c.escape(path)} && echo true "
+                        "|| echo false"))
+    return out.strip() == "true"
+
+
+def file_mode(path: str) -> str:
+    return c.execute("stat", "-c", "%a", path)
+
+
+def tmp_dir() -> str:
+    """Fresh temp dir on the node (util.clj tmp-dir! :42)."""
+    return c.execute("mktemp", "-d", "-t", "jepsen.XXXXXXXX")
+
+
+def tmp_file(suffix: str = "") -> str:
+    return c.execute("mktemp", "-t", f"jepsen.XXXXXXXX{suffix}")
+
+
+def _cache_path(url: str) -> str:
+    key = base64.urlsafe_b64encode(url.encode()).decode().rstrip("=")
+    return f"{WGET_CACHE}/{key}"
+
+
+def cached_wget(url: str, force: bool = False) -> str:
+    """Download url on the node into a base64-keyed cache; returns the
+    cached path (util.clj cached-wget! :79)."""
+    path = _cache_path(url)
+    if force:
+        c.execute("rm", "-f", path, check=False)
+    if not exists(path):
+        log.info("downloading %s", url)
+        c.execute("mkdir", "-p", WGET_CACHE)
+        tmp = path + ".tmp"
+        c.execute("wget", "--tries", "20", "--waitretry", "60",
+                  "--retry-connrefused", "-O", tmp, url)
+        c.execute("mv", tmp, path)
+    return path
+
+
+def _archive_kind(url: str) -> str:
+    u = url.split("?", 1)[0].lower()
+    if u.endswith(".zip"):
+        return "zip"
+    return "tar"
+
+
+def install_archive(url: str, dest: str, force: bool = False,
+                    user: Optional[str] = None) -> str:
+    """Download + extract an archive to dest, flattening a single
+    top-level directory; retries once on a corrupt archive by busting
+    the cache (util.clj install-archive! :106)."""
+    for attempt in (0, 1):
+        path = (cached_wget(url, force=force or attempt > 0)
+                if url.startswith(("http://", "https://", "ftp://"))
+                else url)
+        c.execute("rm", "-rf", dest, check=False)
+        tmp = tmp_dir()
+        try:
+            if _archive_kind(url) == "zip":
+                rc_cmd = f"cd {c.escape(tmp)} && unzip {c.escape(path)}"
+            else:
+                rc_cmd = (f"cd {c.escape(tmp)} && "
+                          f"tar xf {c.escape(path)}")
+            try:
+                c.execute(lit(rc_cmd))
+            except c.RemoteError as e:
+                blob = f"{e.err or ''} {e.out or ''}"
+                corrupt = any(s in blob.lower() for s in
+                              ("unexpected end of file", "not in gzip",
+                               "corrupt", "end-of-central-directory"))
+                if corrupt and attempt == 0:
+                    log.warning("corrupt archive %s; re-downloading", url)
+                    continue
+                raise
+            # Flatten: if the archive made exactly one top dir, move it;
+            # else move the whole tmp dir.
+            entries = c.execute(lit(f"ls -A {c.escape(tmp)}")).split()
+            c.execute("mkdir", "-p", lit("$(dirname " + c.escape(dest) + ")"))
+            if len(entries) == 1:
+                c.execute("mv", f"{tmp}/{entries[0]}", dest)
+            else:
+                c.execute("mv", tmp, dest)
+            if user:
+                c.execute("chown", "-R", user, dest)
+            return dest
+        finally:
+            c.execute("rm", "-rf", tmp, check=False)
+    raise AssertionError("unreachable")
+
+
+# ---------------------------------------------------------------------------
+# Processes and daemons (util.clj:191-253)
+# ---------------------------------------------------------------------------
+
+def grepkill(pattern: str, signal: str = "9") -> None:
+    """Kill processes matching a pattern (util.clj grepkill! :191)."""
+    c.execute("pkill", f"-{signal}", "-f", pattern, check=False)
+
+
+def signal(pattern: str, sig: str) -> None:
+    grepkill(pattern, sig)
+
+
+def start_daemon(bin_path: str, *args, chdir: Optional[str] = None,
+                 logfile: str = "/dev/null",
+                 pidfile: str = "/var/run/jepsen-daemon.pid",
+                 make_pidfile: bool = True,
+                 env: Optional[dict] = None) -> None:
+    """Start a background daemon with a pidfile
+    (util.clj start-daemon! :208: start-stop-daemon --start --background
+    --make-pidfile --pidfile --chdir --exec … >> logfile)."""
+    parts = []
+    if env:
+        parts += ["env"] + [c.escape(f"{k}={v}") for k, v in env.items()]
+    parts += ["start-stop-daemon", "--start", "--background",
+              "--no-close", "--oknodo"]
+    if make_pidfile:
+        parts += ["--make-pidfile"]
+    parts += ["--pidfile", c.escape(pidfile)]
+    if chdir:
+        parts += ["--chdir", c.escape(chdir)]
+    parts += ["--exec", c.escape(bin_path), "--"]
+    parts += [c.escape(a) for a in args]
+    parts += [">>", c.escape(logfile), "2>&1"]
+    c.execute(lit(" ".join(parts)))
+
+
+def stop_daemon(pidfile: str = "/var/run/jepsen-daemon.pid",
+                bin_path: Optional[str] = None) -> None:
+    """Kill a daemon by pidfile (+ optional exec match), wait for it to
+    die, remove the pidfile (util.clj stop-daemon! :238)."""
+    parts = ["start-stop-daemon", "--stop", "--oknodo", "--retry", "5",
+             "--pidfile", c.escape(pidfile)]
+    if bin_path:
+        parts += ["--exec", c.escape(bin_path)]
+    c.execute(lit(" ".join(parts)), check=False)
+    c.execute("rm", "-f", pidfile, check=False)
+
+
+def daemon_running(pidfile: str) -> Optional[bool]:
+    """True/False if the pidfile's process is/isn't alive; None when
+    there is no pidfile (util.clj daemon-running? :253)."""
+    if not exists(pidfile):
+        return None
+    out = c.execute(lit(f"kill -0 $(cat {c.escape(pidfile)}) "
+                        "2>/dev/null && echo live || echo dead"),
+                    check=False)
+    return out.strip() == "live"

@@ -1,0 +1,55 @@
+"""libfaketime wrapper scripts (reference: `jepsen/src/jepsen/faketime.clj`):
+per-process clock-rate skew without touching the system clock — a
+daemon started through the wrapper sees time advancing at `rate` times
+real speed from a chosen epoch.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import control as c
+
+LIB_CANDIDATES = [
+    "/usr/lib/x86_64-linux-gnu/faketime/libfaketime.so.1",
+    "/usr/lib/faketime/libfaketime.so.1",
+    "/usr/lib64/faketime/libfaketime.so.1",
+]
+
+
+def script(bin_path: str, offset_s: float = 0, rate: float = 1.0) -> str:
+    """A wrapper script body execing bin_path under libfaketime
+    (faketime.clj script :8-18).  The library path is probed at run
+    time so one script works across debian/centos layouts; the
+    JEPSEN_LIBFAKETIME env var overrides."""
+    spec = f"{offset_s:+f}s x{rate:f}"
+    probe = (f"for _ft in {' '.join(LIB_CANDIDATES)}; do\n"
+             "  [ -e \"$_ft\" ] && break\ndone\n")
+    return ("#!/bin/bash\n" + probe +
+            "LD_PRELOAD=\"${JEPSEN_LIBFAKETIME:-$_ft}\" "
+            f"FAKETIME='{spec}' "
+            f"DONT_FAKE_MONOTONIC=1 exec {bin_path} \"$@\"\n")
+
+
+def wrap(bin_path: str, offset_s: float = 0, rate: float = 1.0) -> None:
+    """Replace bin_path with a faketime wrapper, keeping the original at
+    <bin>.real (faketime.clj wrap! :20-27).  Idempotent."""
+    real = bin_path + ".real"
+    c.execute(c.lit(
+        f"test -e {c.escape(real)} || mv {c.escape(bin_path)} "
+        f"{c.escape(real)}"))
+    c.upload_str(script(real, offset_s, rate), bin_path)
+    c.execute("chmod", "755", bin_path)
+
+
+def unwrap(bin_path: str) -> None:
+    """Restore the original binary (faketime.clj unwrap!)."""
+    real = bin_path + ".real"
+    c.execute(c.lit(
+        f"test -e {c.escape(real)} && mv {c.escape(real)} "
+        f"{c.escape(bin_path)} || true"))
+
+
+def rand_factor(mean: float = 1.0, spread: float = 0.1) -> float:
+    """A clock rate near mean (faketime.clj rand-factor)."""
+    return max(0.01, mean + (random.random() * 2 - 1) * spread)

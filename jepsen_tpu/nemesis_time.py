@@ -1,0 +1,162 @@
+"""Clock-manipulation nemesis (reference:
+`jepsen/src/jepsen/nemesis/time.clj`): upload C clock tools, compile
+them **on the db node** with gcc, and drive clock jumps / strobes /
+resets from the nemesis, recording observed per-node clock offsets onto
+ops so the clock checker can plot them.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import time
+from pathlib import Path
+
+from jepsen_tpu import control as c
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+
+log = logging.getLogger("jepsen.nemesis.time")
+
+RESOURCES = Path(__file__).parent / "resources"
+TOOL_DIR = "/opt/jepsen"
+TOOLS = ["bump_time", "strobe_time"]
+
+
+def compile_tool(source_name: str) -> None:
+    """Upload a .c source and build it on the node if the binary isn't
+    there yet (nemesis/time.clj compile! :14-41)."""
+    binary = f"{TOOL_DIR}/{source_name}"
+    out = c.execute(lit(f"test -x {c.escape(binary)} && echo built"),
+                    check=False)
+    if out.strip() == "built":
+        return
+    c.execute("mkdir", "-p", TOOL_DIR)
+    src = f"{binary}.c"
+    c.upload(str(RESOURCES / f"{source_name}.c"), src)
+    c.execute("gcc", "-O2", "-o", binary, src)
+
+
+def install(test=None, node=None) -> None:
+    """Compile all clock tools on the current node (time.clj install!
+    :43)."""
+    for t in TOOLS:
+        compile_tool(t)
+
+
+def bump_time(delta_ms: float) -> str:
+    """One-shot wall-clock jump by delta ms (time.clj bump-time! :77)."""
+    return c.execute(f"{TOOL_DIR}/bump_time", int(delta_ms))
+
+
+def strobe_time(delta_ms: float, period_ms: float, duration_s: float) -> str:
+    """Flip the clock between 0 and +delta every period, for duration
+    (time.clj strobe-time! :83)."""
+    return c.execute(f"{TOOL_DIR}/strobe_time", int(delta_ms),
+                     int(period_ms), int(duration_s))
+
+
+def reset_time(test=None) -> None:
+    """Snap the clock back to real time (time.clj reset-time! :70):
+    ntpdate against the test's ntp server when configured, else no-op
+    with a warning."""
+    server = (test or {}).get("ntp-server")
+    if server:
+        c.execute("ntpdate", "-b", server)
+    else:
+        c.execute("ntpdate", "-b", "pool.ntp.org", check=False)
+
+
+def clock_offset_s() -> float:
+    """Observed node wall clock minus control wall clock, seconds
+    (time.clj current-offset)."""
+    remote = float(c.execute("date", "+%s.%N"))
+    return remote - time.time()
+
+
+class ClockNemesis(nem.Nemesis):
+    """Drives :reset / :bump / :strobe / :check-offsets ops
+    (time.clj clock-nemesis :89-135).  Ops:
+
+        {f: "reset",  value: [nodes...] or None}
+        {f: "bump",   value: {node: delta_ms}}
+        {f: "strobe", value: {"delta": ms, "period": ms, "duration": s}}
+        {f: "check-offsets"}
+
+    Every completion gets a {node: offset_s} map under
+    op.extra["clock-offsets"]."""
+
+    def setup(self, test):
+        c.on_nodes(test, lambda t, n: install(t, n))
+        try:
+            c.on_nodes(test, lambda t, n: reset_time(t))
+        except Exception as e:
+            log.warning("initial clock reset failed: %s", e)
+        return self
+
+    def invoke(self, test, op):
+        f = op.f
+        if f == "reset":
+            nodes = op.value or test.get("nodes")
+            c.on_nodes(test, lambda t, n: reset_time(t), nodes)
+        elif f == "bump":
+            deltas = op.value or {}
+            c.on_nodes(test,
+                       lambda t, n: bump_time(deltas.get(n, 0)),
+                       list(deltas))
+        elif f == "strobe":
+            v = op.value or {}
+            c.on_nodes(test, lambda t, n: strobe_time(
+                v.get("delta", 200), v.get("period", 10),
+                v.get("duration", 10)))
+        elif f == "check-offsets":
+            pass
+        else:
+            raise ValueError(f"unknown clock op {f!r}")
+        offsets = c.on_nodes(test, lambda t, n: _safe_offset())
+        return op.assoc(**{"clock-offsets": offsets})
+
+    def teardown(self, test):
+        try:
+            c.on_nodes(test, lambda t, n: reset_time(t))
+        except Exception as e:
+            log.warning("clock reset on teardown failed: %s", e)
+
+
+def _safe_offset():
+    try:
+        return clock_offset_s()
+    except Exception:
+        return None
+
+
+def clock_nemesis() -> ClockNemesis:
+    return ClockNemesis()
+
+
+# ---------------------------------------------------------------------------
+# Generators (time.clj:137-173)
+# ---------------------------------------------------------------------------
+
+def reset_gen(test, process):
+    return {"type": "info", "f": "reset", "value": None}
+
+
+def bump_gen(test, process):
+    nodes = test.get("nodes") or []
+    deltas = {n: random.randrange(-262144, 262144)
+              for n in random.sample(nodes, max(1, len(nodes) // 2))}
+    return {"type": "info", "f": "bump", "value": deltas}
+
+
+def strobe_gen(test, process):
+    return {"type": "info", "f": "strobe",
+            "value": {"delta": random.randrange(1, 262144),
+                      "period": random.randrange(1, 1024),
+                      "duration": random.randrange(1, 32)}}
+
+
+def clock_gen():
+    """Mix of resets, bumps and strobes (time.clj clock-gen :165-173)."""
+    return gen.mix([reset_gen, bump_gen, strobe_gen])

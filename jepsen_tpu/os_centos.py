@@ -1,0 +1,58 @@
+"""CentOS provisioning (reference: `jepsen/src/jepsen/os/centos.clj`):
+yum equivalents of the debian layer.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from jepsen_tpu import os as os_mod
+from jepsen_tpu import control as c
+from jepsen_tpu.control import lit
+from jepsen_tpu.os_debian import setup_hostfile
+
+log = logging.getLogger("jepsen.os.centos")
+
+BASE_PACKAGES = ["wget", "curl", "unzip", "iptables", "psmisc", "tar",
+                 "bzip2", "iputils", "iproute", "rsyslog", "logrotate",
+                 "ntpdate",
+                 # the clock nemesis compiles its tools on the node
+                 "gcc"]
+
+
+def installed(pkgs: Iterable[str]) -> set:
+    pkgs = list(pkgs)
+    out = c.execute(lit("rpm -q --qf '%{NAME}\\n' "
+                        + " ".join(c.escape(p) for p in pkgs)
+                        + " 2>/dev/null"), check=False)
+    return {line.strip() for line in out.splitlines()
+            if line.strip() in pkgs}
+
+
+def install(pkgs: Iterable[str], force: bool = False) -> None:
+    pkgs = list(pkgs)
+    have = set() if force else installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
+    if not missing:
+        return
+    c.execute(lit("yum install -y "
+                  + " ".join(c.escape(p) for p in missing)))
+
+
+class CentOS(os_mod.OS):
+    """centos.clj CentOS deftype :133-161."""
+
+    def setup(self, test, node):
+        log.info("%s setting up centos", node)
+        setup_hostfile(test, node)
+        install(BASE_PACKAGES)
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = CentOS()

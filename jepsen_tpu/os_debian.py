@@ -1,0 +1,95 @@
+"""Debian provisioning (reference: `jepsen/src/jepsen/os/debian.clj`):
+apt package management and the standard node baseline (tooling the
+nemeses and control utils rely on), plus hostfile setup and network
+healing on OS setup.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable, Optional
+
+from jepsen_tpu import os as os_mod
+from jepsen_tpu import control as c
+from jepsen_tpu.control import lit
+
+log = logging.getLogger("jepsen.os.debian")
+
+# debian.clj Debian deftype :138-169's package baseline.
+BASE_PACKAGES = ["wget", "curl", "unzip", "iptables", "psmisc", "tar",
+                 "bzip2", "iputils-ping", "iproute2", "rsyslog",
+                 "logrotate", "ntpdate", "faketime",
+                 # the clock nemesis compiles its tools on the node
+                 # (nemesis_time.compile_tool), so ship a compiler
+                 "build-essential"]
+
+
+def setup_hostfile(test, node) -> None:
+    """Write /etc/hosts mapping every test node (debian.clj:12-30)."""
+    lines = ["127.0.0.1 localhost"]
+    for n in test.get("nodes") or []:
+        ip = c.execute(lit(f"getent hosts {c.escape(n)} | head -n1 "
+                           "| cut -d' ' -f1"), check=False) or n
+        lines.append(f"{ip.strip() or n} {n}")
+    c.upload_str("\n".join(lines) + "\n", "/etc/hosts.jepsen")
+    c.execute(lit("cp /etc/hosts.jepsen /etc/hosts"))
+
+
+def installed(pkgs: Iterable[str]) -> set:
+    """Subset of pkgs already installed (debian.clj installed? :44)."""
+    pkgs = list(pkgs)
+    out = c.execute(lit("dpkg-query -W -f '${Package} ${Status}\\n' "
+                        + " ".join(c.escape(p) for p in pkgs)
+                        + " 2>/dev/null"), check=False)
+    have = set()
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) >= 4 and parts[-1] == "installed":
+            have.add(parts[0])
+    return have
+
+
+def update() -> None:
+    c.execute(lit("env DEBIAN_FRONTEND=noninteractive apt-get update"))
+
+
+def install(pkgs: Iterable[str], force: bool = False) -> None:
+    """apt-get install missing packages (debian.clj install :78)."""
+    pkgs = list(pkgs)
+    have = set() if force else installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
+    if not missing:
+        return
+    c.execute(lit("env DEBIAN_FRONTEND=noninteractive apt-get install -y "
+                  "--allow-downgrades "
+                  + " ".join(c.escape(p) for p in missing)))
+
+
+def add_repo(name: str, line: str, keyserver: Optional[str] = None,
+             key: Optional[str] = None) -> None:
+    """Add an apt source + optional key (debian.clj add-repo! :109)."""
+    path = f"/etc/apt/sources.list.d/{name}.list"
+    if key and keyserver:
+        c.execute("apt-key", "adv", "--keyserver", keyserver,
+                  "--recv-keys", key)
+    c.upload_str(line + "\n", path)
+    update()
+
+
+class Debian(os_mod.OS):
+    """The stock Debian OS (debian.clj Debian deftype :138-169):
+    hostfile, baseline packages, network heal."""
+
+    def setup(self, test, node):
+        log.info("%s setting up debian", node)
+        setup_hostfile(test, node)
+        install(BASE_PACKAGES)
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = Debian()

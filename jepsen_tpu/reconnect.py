@@ -1,0 +1,147 @@
+"""Auto-reconnecting client wrapper (reference:
+`jepsen/src/jepsen/reconnect.clj`).
+
+Wraps a stateful connection in a reader/writer-locked holder: normal
+use shares the connection under the read lock; when an operation
+throws, `with_conn` closes and reopens the connection (write lock) so
+the *next* user gets a fresh one, then rethrows — the caller still sees
+the failure, exactly like `with-conn` (reconnect.clj:92-129).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import Any, Callable, Optional
+
+log = logging.getLogger("jepsen.reconnect")
+
+
+class _RWLock:
+    """Writer-preferring reader/writer lock."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self):
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self):
+        with self._cond:
+            self._readers -= 1
+            if not self._readers:
+                self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def read(self):
+        self.acquire_read()
+        try:
+            yield
+        finally:
+            self.release_read()
+
+    @contextlib.contextmanager
+    def write(self):
+        with self._cond:
+            self._writers_waiting += 1
+            while self._writer or self._readers:
+                self._cond.wait()
+            self._writers_waiting -= 1
+            self._writer = True
+        try:
+            yield
+        finally:
+            with self._cond:
+                self._writer = False
+                self._cond.notify_all()
+
+
+class Wrapper:
+    """Connection holder (reconnect.clj wrapper :16-49).
+
+    open_fn() -> conn; close_fn(conn); optional name for logs."""
+
+    def __init__(self, open_fn: Callable[[], Any],
+                 close_fn: Optional[Callable[[Any], None]] = None,
+                 name: Any = None):
+        self.open_fn = open_fn
+        self.close_fn = close_fn
+        self.name = name
+        self.lock = _RWLock()
+        self._conn: Any = None
+        self._open = False
+
+    @property
+    def conn(self):
+        return self._conn
+
+    def open(self) -> "Wrapper":
+        """Open the underlying conn (reconnect.clj open! :51)."""
+        with self.lock.write():
+            if not self._open:
+                self._conn = self.open_fn()
+                self._open = True
+        return self
+
+    def close(self) -> "Wrapper":
+        with self.lock.write():
+            self._close_locked()
+        return self
+
+    def _close_locked(self):
+        if self._open:
+            try:
+                if self.close_fn:
+                    self.close_fn(self._conn)
+            except Exception as e:
+                log.warning("error closing conn %s: %s", self.name, e)
+            self._conn = None
+            self._open = False
+
+    def reopen(self) -> "Wrapper":
+        """Close (ignoring errors) and open a fresh conn
+        (reconnect.clj reopen! :78-90)."""
+        with self.lock.write():
+            self._close_locked()
+            self._conn = self.open_fn()
+            self._open = True
+        return self
+
+    @contextlib.contextmanager
+    def with_conn(self):
+        """Yield the live conn with the read lock held across the whole
+        body, so reopen() (write lock) waits for in-flight users.  If
+        the body throws, release the lock, reopen the conn for future
+        users, and rethrow (reconnect.clj with-conn :92-129)."""
+        self.lock.acquire_read()
+        try:
+            if not self._open:
+                raise RuntimeError(f"conn {self.name!r} not open")
+            conn = self._conn
+        except BaseException:
+            self.lock.release_read()
+            raise
+        try:
+            yield conn
+        except Exception:
+            self.lock.release_read()
+            try:
+                self.reopen()
+            except Exception as e:
+                log.warning("error reopening conn %s: %s", self.name, e)
+            raise
+        else:
+            self.lock.release_read()
+
+
+def wrapper(open_fn: Callable[[], Any],
+            close_fn: Optional[Callable[[Any], None]] = None,
+            name: Any = None) -> Wrapper:
+    return Wrapper(open_fn, close_fn, name)

@@ -1,0 +1,57 @@
+/* bump_time: jump the system wall clock by a signed number of
+ * milliseconds, once, and print the resulting epoch time in ms.
+ *
+ * Usage: bump_time <delta-ms>
+ *
+ * TPU-framework equivalent of the reference's one-shot clock-jump tool
+ * (jepsen/resources/bump-time.c); independent implementation using
+ * clock_gettime/clock_settime on CLOCK_REALTIME.  Compiled on the db
+ * node by jepsen_tpu/nemesis_time.py, mirroring nemesis/time.clj:14-41.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+#define NS_PER_MS 1000000L
+#define NS_PER_S  1000000000L
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s <delta-ms>\n", argv[0]);
+    return 2;
+  }
+
+  char *end = NULL;
+  long long delta_ms = strtoll(argv[1], &end, 10);
+  if (end == argv[1] || *end != '\0') {
+    fprintf(stderr, "bad delta: %s\n", argv[1]);
+    return 2;
+  }
+
+  struct timespec now;
+  if (clock_gettime(CLOCK_REALTIME, &now) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+
+  long long total_ns = (long long)now.tv_sec * NS_PER_S + now.tv_nsec
+      + delta_ms * NS_PER_MS;
+  struct timespec target;
+  target.tv_sec = total_ns / NS_PER_S;
+  target.tv_nsec = total_ns % NS_PER_S;
+  if (target.tv_nsec < 0) {
+    target.tv_nsec += NS_PER_S;
+    target.tv_sec -= 1;
+  }
+
+  if (clock_settime(CLOCK_REALTIME, &target) != 0) {
+    perror("clock_settime");
+    return 1;
+  }
+
+  printf("%lld\n", (long long)target.tv_sec * 1000LL
+         + target.tv_nsec / NS_PER_MS);
+  return 0;
+}

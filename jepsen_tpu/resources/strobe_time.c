@@ -1,0 +1,95 @@
+/* strobe_time: flip the wall clock between its true value and
+ * (true + delta ms) every period ms, for duration seconds, then restore.
+ *
+ * Usage: strobe_time <delta-ms> <period-ms> <duration-s>
+ *
+ * TPU-framework equivalent of the reference's clock strobe tool
+ * (jepsen/resources/strobe-time.c); independent implementation.  The
+ * schedule is anchored on CLOCK_MONOTONIC so the strobing cadence is
+ * immune to the very jumps it creates: on each tick we compute which
+ * phase we *should* be in from monotonic time and apply the difference
+ * between the desired and currently-applied offset to CLOCK_REALTIME.
+ */
+#include <errno.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+
+#define NS_PER_MS 1000000LL
+#define NS_PER_S  1000000000LL
+
+static long long ts_ns(struct timespec t) {
+  return (long long)t.tv_sec * NS_PER_S + t.tv_nsec;
+}
+
+static struct timespec ns_ts(long long ns) {
+  struct timespec t;
+  t.tv_sec = ns / NS_PER_S;
+  t.tv_nsec = ns % NS_PER_S;
+  if (t.tv_nsec < 0) {
+    t.tv_nsec += NS_PER_S;
+    t.tv_sec -= 1;
+  }
+  return t;
+}
+
+static int shift_wall_clock(long long delta_ns) {
+  struct timespec now;
+  if (clock_gettime(CLOCK_REALTIME, &now) != 0) return -1;
+  struct timespec target = ns_ts(ts_ns(now) + delta_ns);
+  return clock_settime(CLOCK_REALTIME, &target);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    fprintf(stderr, "usage: %s <delta-ms> <period-ms> <duration-s>\n",
+            argv[0]);
+    return 2;
+  }
+  long long delta_ns = strtoll(argv[1], NULL, 10) * NS_PER_MS;
+  long long period_ns = strtoll(argv[2], NULL, 10) * NS_PER_MS;
+  long long duration_ns = strtoll(argv[3], NULL, 10) * NS_PER_S;
+  if (period_ns <= 0 || duration_ns < 0) {
+    fprintf(stderr, "period must be > 0, duration >= 0\n");
+    return 2;
+  }
+
+  struct timespec start;
+  if (clock_gettime(CLOCK_MONOTONIC, &start) != 0) {
+    perror("clock_gettime");
+    return 1;
+  }
+  long long start_ns = ts_ns(start);
+  long long applied = 0; /* offset currently added to the wall clock */
+
+  for (;;) {
+    struct timespec mono;
+    clock_gettime(CLOCK_MONOTONIC, &mono);
+    long long elapsed = ts_ns(mono) - start_ns;
+    if (elapsed >= duration_ns) break;
+
+    long long phase = (elapsed / period_ns) % 2;
+    long long desired = phase ? delta_ns : 0;
+    if (desired != applied) {
+      if (shift_wall_clock(desired - applied) != 0) {
+        perror("clock_settime");
+        return 1;
+      }
+      applied = desired;
+    }
+
+    /* sleep until the next phase boundary (monotonic, absolute) */
+    long long next = start_ns + ((elapsed / period_ns) + 1) * period_ns;
+    struct timespec until = ns_ts(next);
+    while (clock_nanosleep(CLOCK_MONOTONIC, TIMER_ABSTIME, &until, NULL)
+           == EINTR) {
+    }
+  }
+
+  /* restore the true clock */
+  if (applied != 0 && shift_wall_clock(-applied) != 0) {
+    perror("clock_settime");
+    return 1;
+  }
+  return 0;
+}

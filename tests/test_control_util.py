@@ -1,0 +1,320 @@
+"""Node-plumbing tests: reconnect wrapper, control_util daemon/archive
+helpers, debian/centos OS provisioning, clock nemesis + faketime — all
+driven through the dummy transport with scripted outputs."""
+
+import re
+import subprocess
+import threading
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import faketime, nemesis_time, os_centos, os_debian
+from jepsen_tpu import reconnect
+from jepsen_tpu.history import info_op
+
+
+class Fake:
+    """Scripted dummy node: maps regex -> output (str or (rc,out,err));
+    records every command."""
+
+    def __init__(self, rules=None):
+        self.rules = rules or []
+        self.commands = []
+        self.lock = threading.Lock()
+
+    def __call__(self, node, cmd, stdin):
+        with self.lock:
+            self.commands.append((node, cmd))
+        for pat, out in self.rules:
+            if re.search(pat, cmd):
+                return out(node, cmd) if callable(out) else out
+        return ""
+
+    def ran(self, pat):
+        return [cmd for _, cmd in self.commands if re.search(pat, cmd)]
+
+
+@pytest.fixture()
+def fake():
+    f = Fake()
+    c.set_dummy_handler(f)
+    with c.with_ssh({"dummy": True}):
+        with c.with_session("n1", c.session("n1")):
+            yield f
+    c.set_dummy_handler(None)
+
+
+class TestReconnect:
+    def test_open_close(self):
+        opens, closes = [], []
+        w = reconnect.wrapper(lambda: opens.append(1) or len(opens),
+                              closes.append, name="db")
+        w.open()
+        assert w.conn == 1
+        with w.with_conn() as conn:
+            assert conn == 1
+        w.close()
+        assert closes == [1]
+        assert w.conn is None
+
+    def test_error_triggers_reopen(self):
+        opens = []
+        w = reconnect.wrapper(lambda: opens.append(1) or len(opens))
+        w.open()
+        with pytest.raises(ValueError):
+            with w.with_conn():
+                raise ValueError("net down")
+        # next user sees a fresh conn
+        with w.with_conn() as conn:
+            assert conn == 2
+        assert len(opens) == 2
+
+    def test_with_conn_requires_open(self):
+        w = reconnect.wrapper(lambda: 1)
+        with pytest.raises(RuntimeError):
+            with w.with_conn():
+                pass
+
+    def test_reopen_waits_for_inflight_reader(self):
+        import time
+        w = reconnect.wrapper(lambda: object()).open()
+        in_body = threading.Event()
+        release = threading.Event()
+        reopened_at = []
+
+        def reader():
+            with w.with_conn():
+                in_body.set()
+                release.wait(5)
+
+        def reopener():
+            in_body.wait(5)
+            w.reopen()
+            reopened_at.append(time.monotonic())
+
+        t1 = threading.Thread(target=reader)
+        t2 = threading.Thread(target=reopener)
+        t1.start(); t2.start()
+        in_body.wait(5)
+        time.sleep(0.1)
+        assert not reopened_at, "reopen must wait for in-flight reader"
+        released = time.monotonic()
+        release.set()
+        t1.join(5); t2.join(5)
+        assert reopened_at and reopened_at[0] >= released
+
+    def test_concurrent_readers_share(self):
+        w = reconnect.wrapper(lambda: object()).open()
+        seen = []
+
+        def reader():
+            with w.with_conn() as conn:
+                seen.append(conn)
+
+        ts = [threading.Thread(target=reader) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(set(map(id, seen))) == 1
+
+
+class TestControlUtil:
+    def test_exists(self, fake):
+        fake.rules = [(r"test -e /yes", "true"), (r"test -e", "false")]
+        assert cu.exists("/yes") is True
+        assert cu.exists("/no") is False
+
+    def test_cached_wget_miss_then_hit(self, fake):
+        state = {"cached": False}
+
+        def probe(node, cmd):
+            return "true" if state["cached"] else "false"
+
+        def dl(node, cmd):
+            state["cached"] = True
+            return ""
+
+        fake.rules = [(r"test -e .*wget-cache", probe), (r"wget ", dl)]
+        p1 = cu.cached_wget("http://x.test/a.tar")
+        p2 = cu.cached_wget("http://x.test/a.tar")
+        assert p1 == p2 and p1.startswith(cu.WGET_CACHE)
+        assert len(fake.ran(r"wget ")) == 1  # second call was a cache hit
+
+    def test_install_archive_flattens_single_dir(self, fake):
+        fake.rules = [(r"test -e", "true"),
+                      (r"mktemp -d", "/tmp/jepsen.X1"),
+                      (r"ls -A", "etcd-v3.1\n")]
+        dest = cu.install_archive("http://x.test/etcd.tar.gz", "/opt/etcd")
+        assert dest == "/opt/etcd"
+        assert fake.ran(r"tar xf")
+        assert fake.ran(r"mv /tmp/jepsen.X1/etcd-v3.1 /opt/etcd")
+
+    def test_install_archive_corrupt_retries_fresh_download(self, fake):
+        calls = {"n": 0}
+
+        def tar(node, cmd):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return (2, "", "tar: Unexpected end of file")
+            return ""
+
+        fake.rules = [(r"test -e", "true"),
+                      (r"mktemp -d", "/tmp/jepsen.X2"),
+                      (r"tar xf", tar),
+                      (r"ls -A", "d\n")]
+        cu.install_archive("http://x.test/db.tar.gz", "/opt/db")
+        assert calls["n"] == 2
+        assert fake.ran(r"rm -f .*wget-cache")  # cache busted between tries
+
+    def test_daemon_lifecycle(self, fake):
+        cu.start_daemon("/opt/db/bin/db", "--port", 2379,
+                        chdir="/opt/db", logfile="/opt/db/db.log",
+                        pidfile="/opt/db/db.pid")
+        [start] = fake.ran(r"start-stop-daemon --start")
+        assert "--make-pidfile" in start and "--chdir /opt/db" in start
+        assert ">> /opt/db/db.log" in start and "--port 2379" in start
+        cu.stop_daemon("/opt/db/db.pid")
+        [stop] = fake.ran(r"start-stop-daemon --stop")
+        assert "--pidfile /opt/db/db.pid" in stop
+        assert fake.ran(r"rm -f /opt/db/db.pid")
+
+    def test_daemon_env_prefix(self, fake):
+        cu.start_daemon("/opt/db/bin/db", env={"ETCD_NAME": "n1"})
+        [start] = fake.ran(r"start-stop-daemon --start")
+        assert start.startswith("env ETCD_NAME=n1 start-stop-daemon")
+        assert "--env" not in start  # start-stop-daemon has no such flag
+
+    def test_daemon_running_states(self, fake):
+        fake.rules = [(r"test -e", "false")]
+        assert cu.daemon_running("/x.pid") is None
+        fake.rules = [(r"test -e", "true"), (r"kill -0", "live")]
+        assert cu.daemon_running("/x.pid") is True
+        fake.rules = [(r"test -e", "true"), (r"kill -0", "dead")]
+        assert cu.daemon_running("/x.pid") is False
+
+    def test_grepkill(self, fake):
+        cu.grepkill("etcd")
+        assert fake.ran(r"pkill -9 -f etcd")
+
+
+class TestDebian:
+    def test_installed_parses_dpkg(self, fake):
+        fake.rules = [(r"dpkg-query",
+                       "wget install ok installed\n"
+                       "curl deinstall ok config-files\n")]
+        assert os_debian.installed(["wget", "curl"]) == {"wget"}
+
+    def test_install_only_missing(self, fake):
+        fake.rules = [(r"dpkg-query", "wget install ok installed\n")]
+        os_debian.install(["wget", "curl"])
+        [cmd] = fake.ran(r"apt-get install")
+        assert "curl" in cmd and "wget" not in cmd.split("install -y")[1]
+
+    def test_setup_installs_baseline_and_heals(self, fake):
+        healed = []
+
+        class FakeNet:
+            def heal(self, test):
+                healed.append(True)
+
+        test = {"nodes": ["n1", "n2"], "net": FakeNet()}
+        fake.rules = [(r"dpkg-query", "")]
+        os_debian.Debian().setup(test, "n1")
+        assert fake.ran(r"apt-get install")
+        assert fake.ran(r"cp /etc/hosts.jepsen /etc/hosts")
+        assert healed == [True]
+
+    def test_centos_uses_yum(self, fake):
+        fake.rules = [(r"rpm -q", "")]
+        os_centos.install(["wget"])
+        assert fake.ran(r"yum install -y wget")
+
+
+class TestClockNemesis:
+    def make_test(self, fake):
+        return {"nodes": ["n1", "n2"],
+                "ssh": {"dummy": True}}
+
+    def test_setup_compiles_tools_on_each_node(self, fake):
+        fake.rules = [(r"test -x", "")]  # not built yet
+        test = self.make_test(fake)
+        nemesis_time.clock_nemesis().setup(test)
+        gcc = fake.ran(r"gcc -O2")
+        assert len(gcc) == 4  # 2 tools x 2 nodes
+        uploads = fake.ran(r"<upload .*bump_time\.c")
+        assert uploads
+
+    def test_bump_targets_selected_nodes(self, fake):
+        fake.rules = [(r"date \+", "0.0")]
+        test = self.make_test(fake)
+        op = info_op("nemesis", "bump", {"n2": 2500})
+        out = nemesis_time.clock_nemesis().invoke(test, op)
+        [bump] = fake.ran(r"bump_time 2500")
+        assert "clock-offsets" in out.extra
+        assert set(out.extra["clock-offsets"]) == {"n1", "n2"}
+
+    def test_strobe_and_reset(self, fake):
+        fake.rules = [(r"date \+", "0.0")]
+        test = self.make_test(fake)
+        n = nemesis_time.clock_nemesis()
+        n.invoke(test, info_op("nemesis", "strobe",
+                               {"delta": 100, "period": 5, "duration": 3}))
+        assert len(fake.ran(r"strobe_time 100 5 3")) == 2
+        n.invoke(test, info_op("nemesis", "reset", None))
+        assert fake.ran(r"ntpdate")
+
+    def test_unknown_op_raises(self, fake):
+        with pytest.raises(ValueError):
+            nemesis_time.clock_nemesis().invoke(
+                self.make_test(fake), info_op("nemesis", "warp", None))
+
+
+class TestCTools:
+    @pytest.fixture(scope="class")
+    def built(self, tmp_path_factory):
+        d = tmp_path_factory.mktemp("ctools")
+        for tool in nemesis_time.TOOLS:
+            src = nemesis_time.RESOURCES / f"{tool}.c"
+            out = d / tool
+            r = subprocess.run(["gcc", "-O2", "-o", str(out), str(src)],
+                               capture_output=True, text=True)
+            assert r.returncode == 0, r.stderr
+        return d
+
+    def test_bump_usage_error(self, built):
+        r = subprocess.run([str(built / "bump_time")],
+                           capture_output=True, text=True)
+        assert r.returncode == 2
+        r = subprocess.run([str(built / "bump_time"), "abc"],
+                           capture_output=True, text=True)
+        assert r.returncode == 2
+
+    def test_strobe_zero_duration_is_noop(self, built):
+        # duration 0: exits immediately without touching the clock.
+        r = subprocess.run([str(built / "strobe_time"), "100", "10", "0"],
+                           capture_output=True, text=True, timeout=10)
+        assert r.returncode == 0
+
+    def test_strobe_usage_error(self, built):
+        r = subprocess.run([str(built / "strobe_time"), "5"],
+                           capture_output=True, text=True)
+        assert r.returncode == 2
+
+
+class TestFaketime:
+    def test_script_contents(self):
+        s = faketime.script("/opt/db/bin/db.real", offset_s=-3, rate=5.0)
+        assert "LD_PRELOAD" in s and "FAKETIME=" in s
+        assert "x5.0" in s and "exec /opt/db/bin/db.real" in s
+
+    def test_wrap_moves_binary_once(self, fake):
+        faketime.wrap("/opt/db/bin/db", rate=2.0)
+        assert fake.ran(r"test -e /opt/db/bin/db\.real \|\| mv")
+        assert fake.ran(r"<upload .* /opt/db/bin/db>")
+        assert fake.ran(r"chmod 755 /opt/db/bin/db")
+
+    def test_rand_factor_positive(self):
+        for _ in range(100):
+            assert faketime.rand_factor() > 0
