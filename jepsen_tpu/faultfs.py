@@ -1,0 +1,149 @@
+"""Disk-fault injection control plane (reference:
+`charybdefs/src/jepsen/charybdefs.clj`).
+
+The reference mounts a C++ FUSE passthrough filesystem (CharybdeFS)
+over the DB's data dir and flips fault behavior over Thrift RPC
+(charybdefs.clj:41-84).  Here the native component is
+`resources/fault_inject.cpp`: an LD_PRELOAD interposer compiled to
+`libfaultinject.so` — on the node, by `install()`, exactly like the
+reference builds charybdefs on the node — that injects probabilistic
+errno faults and latency at the libc boundary of the faulted process,
+controlled over a line-oriented TCP protocol.
+
+Fault recipes mirror charybdefs.clj:
+
+    break_all(node)          every read/write/fsync fails EIO (:72)
+    break_one_percent(node)  1% of ops fail EIO (:77)
+    clear(node)              stop injecting (:82)
+"""
+
+from __future__ import annotations
+
+import errno as errno_mod
+import logging
+import socket
+from pathlib import Path
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+
+log = logging.getLogger("jepsen.faultfs")
+
+RESOURCES = Path(__file__).parent / "resources"
+LIB_DIR = "/opt/jepsen"
+LIB = f"{LIB_DIR}/libfaultinject.so"
+DEFAULT_PORT = 7678
+
+
+def install(test=None, node=None) -> None:
+    """Upload the interposer source and build it on the node
+    (charybdefs.clj setup! builds C++ on the node, :8-66)."""
+    out = c.execute(lit(f"test -e {c.escape(LIB)} && echo built"),
+                    check=False)
+    if out.strip() == "built":
+        return
+    c.execute("mkdir", "-p", LIB_DIR)
+    src = f"{LIB_DIR}/fault_inject.cpp"
+    c.upload(str(RESOURCES / "fault_inject.cpp"), src)
+    c.execute("g++", "-O2", "-shared", "-fPIC", "-o", LIB, src,
+              "-ldl", "-pthread")
+
+
+def preload_env(data_dir: str, port: int = DEFAULT_PORT) -> dict:
+    """Env for start_daemon so the DB process runs under the
+    interposer, faulting ops on its data dir."""
+    return {"LD_PRELOAD": LIB, "FAULTFS_PATH": data_dir,
+            "FAULTFS_PORT": str(port)}
+
+
+# ---------------------------------------------------------------------------
+# Control client
+# ---------------------------------------------------------------------------
+
+def command(host: str, cmd: str, port: int = DEFAULT_PORT,
+            timeout: float = 10.0) -> str:
+    """Send one control command; returns the reply line."""
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        s.sendall(cmd.encode() + b"\n")
+        return s.makefile().readline().strip()
+
+
+def set_fault(host: str, errno: int = errno_mod.EIO,
+              prob_per_100k: int = 100000, delay_us: int = 0,
+              ops: str = "read,write,fsync",
+              port: int = DEFAULT_PORT) -> str:
+    return command(host, f"set {errno} {prob_per_100k} {delay_us} {ops}",
+                   port)
+
+
+def break_all(host: str, port: int = DEFAULT_PORT) -> str:
+    """All reads/writes/fsyncs fail EIO (charybdefs.clj break-all :72)."""
+    return set_fault(host, prob_per_100k=100000, port=port)
+
+
+def break_one_percent(host: str, port: int = DEFAULT_PORT) -> str:
+    """1% of ops fail EIO (charybdefs.clj break-one-percent :77)."""
+    return set_fault(host, prob_per_100k=1000, port=port)
+
+
+def clear(host: str, port: int = DEFAULT_PORT) -> str:
+    """Stop injecting (charybdefs.clj clear :82)."""
+    return command(host, "clear", port)
+
+
+def get_config(host: str, port: int = DEFAULT_PORT) -> str:
+    return command(host, "get", port)
+
+
+# ---------------------------------------------------------------------------
+# Nemesis
+# ---------------------------------------------------------------------------
+
+class DiskFaultNemesis(nem.Nemesis):
+    """Ops:
+        {f: "break",       value: None|{prob, delay_us, ops, nodes}}
+        {f: "heal-disk",   value: None|[nodes...]}
+    """
+
+    def __init__(self, port: int = DEFAULT_PORT):
+        self.port = port
+
+    def setup(self, test):
+        c.on_nodes(test, lambda t, n: install(t, n))
+        return self
+
+    def invoke(self, test, op):
+        v = op.value if isinstance(op.value, dict) else {}
+        nodes = (v.get("nodes") or
+                 (op.value if isinstance(op.value, list) else None) or
+                 test.get("nodes") or [])
+        results = {}
+        for node in nodes:
+            try:
+                if op.f == "break":
+                    results[node] = set_fault(
+                        node,
+                        prob_per_100k=v.get("prob", 100000),
+                        delay_us=v.get("delay_us", 0),
+                        ops=v.get("ops", "read,write,fsync"),
+                        port=self.port)
+                elif op.f == "heal-disk":
+                    results[node] = clear(node, port=self.port)
+                else:
+                    raise ValueError(f"unknown disk op {op.f!r}")
+            except OSError as e:
+                results[node] = f"error: {e}"
+        return op.assoc(**{"disk-results": results})
+
+    def teardown(self, test):
+        for node in test.get("nodes") or []:
+            try:
+                clear(node, port=self.port)
+            except OSError:
+                pass
+
+
+def disk_fault_nemesis(port: int = DEFAULT_PORT) -> DiskFaultNemesis:
+    return DiskFaultNemesis(port)

@@ -1,0 +1,449 @@
+// fault_inject: disk-fault injection for processes under test.
+//
+// TPU-framework equivalent of the reference's CharybdeFS component
+// (charybdefs/src/jepsen/charybdefs.clj: a C++ FUSE passthrough
+// filesystem whose fault behavior is driven over Thrift RPC).  This
+// implementation reaches the same capability — per-syscall-class
+// probabilistic errno injection and latency on a chosen directory
+// subtree, controlled remotely at runtime — as an LD_PRELOAD
+// interposer with a TCP control plane, which needs no FUSE kernel
+// support and injects at the libc boundary of the faulted process.
+//
+// Usage:
+//   FAULTFS_PATH=/var/lib/db FAULTFS_PORT=7678 \
+//     LD_PRELOAD=/opt/jepsen/libfaultinject.so db-server ...
+//
+// Control protocol (line-oriented over TCP, one command per line):
+//   set <errno> <prob_per_100k> <delay_us> <ops-csv>   e.g.
+//       set 5 100000 0 read,write,fsync     (all reads/writes/fsyncs EIO)
+//       set 5 1000 500000 read,write        (1% EIO + 500ms delay)
+//   clear                                   (stop injecting)
+//   get                                     (report current config)
+//
+// Interposed symbols cover both the 32-bit and LFS ABIs
+// (open/open64/openat/openat64/creat/creat64, read/pread/pread64,
+// write/pwrite/pwrite64, fsync/fdatasync): binaries built with
+// -D_FILE_OFFSET_BITS=64 — virtually every Linux DB — resolve to the
+// *64 names.  dirfd-relative openat paths are resolved through
+// /proc/self/fd so directory-anchored opens are tracked too.
+
+#include <arpa/inet.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+
+namespace {
+
+// Fault classes, bitmask.
+enum OpClass : unsigned {
+  OP_READ = 1u << 0,
+  OP_WRITE = 1u << 1,
+  OP_FSYNC = 1u << 2,
+  OP_OPEN = 1u << 3,
+};
+
+std::atomic<int> g_errno{0};
+std::atomic<unsigned> g_prob{0};      // per 100,000 calls
+std::atomic<unsigned> g_delay_us{0};
+std::atomic<unsigned> g_ops{0};
+std::atomic<unsigned long> g_seed{88172645463325252ull};
+
+// fd -> is the fd under the faulted subtree?  Fixed-size table; fds
+// above the cap are never faulted (servers keep few data-dir fds).
+constexpr int kMaxFd = 4096;
+std::atomic<bool> g_tracked[kMaxFd];
+
+char g_prefix[4096];
+size_t g_prefix_len = 0;
+
+typedef int (*open_fn)(const char *, int, ...);
+typedef int (*openat_fn)(int, const char *, int, ...);
+typedef int (*creat_fn)(const char *, mode_t);
+typedef ssize_t (*read_fn)(int, void *, size_t);
+typedef ssize_t (*write_fn)(int, const void *, size_t);
+typedef ssize_t (*pread_fn)(int, void *, size_t, off_t);
+typedef ssize_t (*pwrite_fn)(int, const void *, size_t, off_t);
+typedef ssize_t (*pread64_fn)(int, void *, size_t, off64_t);
+typedef ssize_t (*pwrite64_fn)(int, const void *, size_t, off64_t);
+typedef int (*fsync_fn)(int);
+typedef int (*close_fn)(int);
+
+// Lazy resolution: other preloaded/linked libraries' ELF constructors
+// can call into these wrappers before our own constructor has run, so
+// every wrapper resolves its real symbol on first use.
+#define RESOLVE(slot, type, name)                        \
+  do {                                                   \
+    if (!(slot)) (slot) = (type)dlsym(RTLD_NEXT, name);  \
+  } while (0)
+
+open_fn real_open = nullptr;
+open_fn real_open64 = nullptr;
+openat_fn real_openat = nullptr;
+openat_fn real_openat64 = nullptr;
+creat_fn real_creat = nullptr;
+creat_fn real_creat64 = nullptr;
+read_fn real_read = nullptr;
+write_fn real_write = nullptr;
+pread_fn real_pread = nullptr;
+pwrite_fn real_pwrite = nullptr;
+pread64_fn real_pread64 = nullptr;
+pwrite64_fn real_pwrite64 = nullptr;
+fsync_fn real_fsync = nullptr;
+fsync_fn real_fdatasync = nullptr;
+close_fn real_close = nullptr;
+
+unsigned long xorshift() {
+  // xorshift64star; racy updates are fine for fault dice.
+  unsigned long x = g_seed.load(std::memory_order_relaxed);
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  g_seed.store(x, std::memory_order_relaxed);
+  return x * 2685821657736338717ull;
+}
+
+bool should_fault(unsigned op) {
+  if (!(g_ops.load(std::memory_order_relaxed) & op)) return false;
+  unsigned prob = g_prob.load(std::memory_order_relaxed);
+  if (prob == 0) return false;
+  unsigned delay = g_delay_us.load(std::memory_order_relaxed);
+  bool hit = (xorshift() % 100000) < prob;
+  if (hit && delay) {
+    struct timespec ts;
+    ts.tv_sec = delay / 1000000;
+    ts.tv_nsec = (delay % 1000000) * 1000L;
+    nanosleep(&ts, nullptr);
+  }
+  return hit;
+}
+
+bool tracked(int fd) {
+  return fd >= 0 && fd < kMaxFd &&
+         g_tracked[fd].load(std::memory_order_relaxed);
+}
+
+// Component-boundary prefix match: /var/lib/db matches /var/lib/db and
+// /var/lib/db/x but NOT /var/lib/db-backup/x.
+bool prefix_match(const char *abs_path) {
+  if (g_prefix_len == 0) return false;
+  if (strncmp(abs_path, g_prefix, g_prefix_len) != 0) return false;
+  char next = abs_path[g_prefix_len];
+  return next == '\0' || next == '/';
+}
+
+// Resolve `path` (absolute, cwd-relative, or dirfd-relative) into
+// `out`; returns false when it can't be resolved or doesn't fit.
+bool resolve_path(int dirfd, const char *path, char *out, size_t cap) {
+  if (path[0] == '/') {
+    if (strlen(path) + 1 > cap) return false;
+    strcpy(out, path);
+    return true;
+  }
+  char base[4096];
+  if (dirfd == AT_FDCWD) {
+    if (!getcwd(base, sizeof base)) return false;
+  } else {
+    char link[64];
+    snprintf(link, sizeof link, "/proc/self/fd/%d", dirfd);
+    ssize_t n = readlink(link, base, sizeof(base) - 1);
+    if (n <= 0) return false;
+    base[n] = '\0';
+  }
+  size_t blen = strlen(base), plen = strlen(path);
+  if (blen + 1 + plen + 1 > cap) return false;
+  memcpy(out, base, blen);
+  out[blen] = '/';
+  memcpy(out + blen + 1, path, plen + 1);
+  return true;
+}
+
+bool path_in_prefix(int dirfd, const char *path) {
+  if (g_prefix_len == 0) return false;
+  char full[8192];
+  if (!resolve_path(dirfd, path, full, sizeof full)) return false;
+  return prefix_match(full);
+}
+
+void track(int fd, int dirfd, const char *path) {
+  if (fd < 0 || fd >= kMaxFd || g_prefix_len == 0) return;
+  g_tracked[fd].store(path_in_prefix(dirfd, path),
+                      std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------- control
+
+unsigned parse_ops(const char *csv) {
+  unsigned ops = 0;
+  if (strstr(csv, "read")) ops |= OP_READ;
+  if (strstr(csv, "write")) ops |= OP_WRITE;
+  if (strstr(csv, "fsync")) ops |= OP_FSYNC;
+  if (strstr(csv, "open")) ops |= OP_OPEN;
+  return ops;
+}
+
+void handle_line(char *line, int conn) {
+  char buf[256];
+  int e, n = 0;
+  unsigned prob, delay;
+  char opscsv[128];
+  if (sscanf(line, "set %d %u %u %127s%n", &e, &prob, &delay, opscsv,
+             &n) == 4) {
+    g_errno.store(e);
+    g_prob.store(prob > 100000 ? 100000 : prob);
+    g_delay_us.store(delay);
+    g_ops.store(parse_ops(opscsv));
+    dprintf(conn, "ok\n");
+  } else if (strncmp(line, "clear", 5) == 0) {
+    g_prob.store(0);
+    g_ops.store(0);
+    g_errno.store(0);
+    g_delay_us.store(0);
+    dprintf(conn, "ok\n");
+  } else if (strncmp(line, "get", 3) == 0) {
+    snprintf(buf, sizeof buf, "errno=%d prob=%u delay_us=%u ops=%u\n",
+             g_errno.load(), g_prob.load(), g_delay_us.load(),
+             g_ops.load());
+    dprintf(conn, "%s", buf);
+  } else {
+    dprintf(conn, "err unknown command\n");
+  }
+}
+
+void *control_loop(void *) {
+  const char *port_s = getenv("FAULTFS_PORT");
+  int port = port_s ? atoi(port_s) : 7678;
+  if (port <= 0) return nullptr;
+  RESOLVE(real_close, close_fn, "close");
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) return nullptr;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(srv, (struct sockaddr *)&addr, sizeof addr) != 0 ||
+      listen(srv, 4) != 0) {
+    real_close(srv);
+    return nullptr;
+  }
+  for (;;) {
+    int conn = accept(srv, nullptr, nullptr);
+    if (conn < 0) continue;
+    char line[512];
+    size_t off = 0;
+    for (;;) {
+      ssize_t r = recv(conn, line + off, sizeof(line) - 1 - off, 0);
+      if (r <= 0) break;
+      off += (size_t)r;
+      line[off] = 0;
+      char *nl;
+      char *start = line;
+      while ((nl = strchr(start, '\n')) != nullptr) {
+        *nl = 0;
+        handle_line(start, conn);
+        start = nl + 1;
+      }
+      off = strlen(start);
+      memmove(line, start, off + 1);
+    }
+    real_close(conn);
+  }
+  return nullptr;
+}
+
+__attribute__((constructor)) void init() {
+  const char *prefix = getenv("FAULTFS_PATH");
+  if (prefix) {
+    strncpy(g_prefix, prefix, sizeof(g_prefix) - 1);
+    g_prefix_len = strlen(g_prefix);
+    // Strip trailing slashes so boundary matching works.
+    while (g_prefix_len > 1 && g_prefix[g_prefix_len - 1] == '/')
+      g_prefix[--g_prefix_len] = '\0';
+  }
+  pthread_t tid;
+  pthread_create(&tid, nullptr, control_loop, nullptr);
+  pthread_detach(tid);
+}
+
+mode_t va_mode(int flags, va_list ap) {
+  return (flags & O_CREAT) ? va_arg(ap, mode_t) : 0;
+}
+
+int do_open(open_fn &slot, const char *name, const char *path, int flags,
+            mode_t mode) {
+  RESOLVE(slot, open_fn, name);
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  int fd = slot(path, flags, mode);
+  if (fd >= 0) track(fd, AT_FDCWD, path);
+  return fd;
+}
+
+int do_openat(openat_fn &slot, const char *name, int dirfd,
+              const char *path, int flags, mode_t mode) {
+  RESOLVE(slot, openat_fn, name);
+  if (path_in_prefix(dirfd, path) && should_fault(OP_OPEN)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  int fd = slot(dirfd, path, flags, mode);
+  if (fd >= 0) track(fd, dirfd, path);
+  return fd;
+}
+
+}  // namespace
+
+extern "C" {
+
+int open(const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = va_mode(flags, ap);
+  va_end(ap);
+  return do_open(real_open, "open", path, flags, mode);
+}
+
+int open64(const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = va_mode(flags, ap);
+  va_end(ap);
+  return do_open(real_open64, "open64", path, flags, mode);
+}
+
+int openat(int dirfd, const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = va_mode(flags, ap);
+  va_end(ap);
+  return do_openat(real_openat, "openat", dirfd, path, flags, mode);
+}
+
+int openat64(int dirfd, const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = va_mode(flags, ap);
+  va_end(ap);
+  return do_openat(real_openat64, "openat64", dirfd, path, flags, mode);
+}
+
+int creat(const char *path, mode_t mode) {
+  RESOLVE(real_creat, creat_fn, "creat");
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  int fd = real_creat(path, mode);
+  if (fd >= 0) track(fd, AT_FDCWD, path);
+  return fd;
+}
+
+int creat64(const char *path, mode_t mode) {
+  RESOLVE(real_creat64, creat_fn, "creat64");
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  int fd = real_creat64(path, mode);
+  if (fd >= 0) track(fd, AT_FDCWD, path);
+  return fd;
+}
+
+ssize_t read(int fd, void *buf, size_t n) {
+  RESOLVE(real_read, read_fn, "read");
+  if (tracked(fd) && should_fault(OP_READ)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_read(fd, buf, n);
+}
+
+ssize_t pread(int fd, void *buf, size_t n, off_t off) {
+  RESOLVE(real_pread, pread_fn, "pread");
+  if (tracked(fd) && should_fault(OP_READ)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_pread(fd, buf, n, off);
+}
+
+ssize_t pread64(int fd, void *buf, size_t n, off64_t off) {
+  RESOLVE(real_pread64, pread64_fn, "pread64");
+  if (tracked(fd) && should_fault(OP_READ)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_pread64(fd, buf, n, off);
+}
+
+ssize_t write(int fd, const void *buf, size_t n) {
+  RESOLVE(real_write, write_fn, "write");
+  if (tracked(fd) && should_fault(OP_WRITE)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_write(fd, buf, n);
+}
+
+ssize_t pwrite(int fd, const void *buf, size_t n, off_t off) {
+  RESOLVE(real_pwrite, pwrite_fn, "pwrite");
+  if (tracked(fd) && should_fault(OP_WRITE)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_pwrite(fd, buf, n, off);
+}
+
+ssize_t pwrite64(int fd, const void *buf, size_t n, off64_t off) {
+  RESOLVE(real_pwrite64, pwrite64_fn, "pwrite64");
+  if (tracked(fd) && should_fault(OP_WRITE)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_pwrite64(fd, buf, n, off);
+}
+
+int fsync(int fd) {
+  RESOLVE(real_fsync, fsync_fn, "fsync");
+  if (tracked(fd) && should_fault(OP_FSYNC)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_fsync(fd);
+}
+
+int fdatasync(int fd) {
+  RESOLVE(real_fdatasync, fsync_fn, "fdatasync");
+  if (tracked(fd) && should_fault(OP_FSYNC)) {
+    errno = g_errno.load();
+    return -1;
+  }
+  return real_fdatasync(fd);
+}
+
+int close(int fd) {
+  RESOLVE(real_close, close_fn, "close");
+  if (fd >= 0 && fd < kMaxFd)
+    g_tracked[fd].store(false, std::memory_order_relaxed);
+  return real_close(fd);
+}
+
+}  // extern "C"
