@@ -613,9 +613,25 @@ def counter():
 # Graph checkers (latency/rate/clock plots) — wired to checker.perf
 # ---------------------------------------------------------------------------
 
+def _perf_mod():
+    # NOT `from jepsen_tpu.checker import perf`: the factory function
+    # `perf()` below shadows the submodule as a package attribute, and
+    # importing the submodule in turn sets that attribute to the module
+    # — so restore the factory afterwards or ck.perf() stops being
+    # callable.
+    import importlib
+    import sys
+    pkg = sys.modules[__name__]
+    factory = getattr(pkg, "perf", None)
+    mod = importlib.import_module("jepsen_tpu.checker.perf")
+    if callable(factory) and getattr(pkg, "perf", None) is mod:
+        setattr(pkg, "perf", factory)
+    return mod
+
+
 class LatencyGraph(Checker):
     def check(self, test, history, opts=None):
-        from jepsen_tpu.checker import perf as perf_mod
+        perf_mod = _perf_mod()
         perf_mod.point_graph(test, history, opts or {})
         perf_mod.quantiles_graph(test, history, opts or {})
         return {"valid?": True}
@@ -623,8 +639,7 @@ class LatencyGraph(Checker):
 
 class RateGraph(Checker):
     def check(self, test, history, opts=None):
-        from jepsen_tpu.checker import perf as perf_mod
-        perf_mod.rate_graph(test, history, opts or {})
+        _perf_mod().rate_graph(test, history, opts or {})
         return {"valid?": True}
 
 

@@ -18,6 +18,7 @@ enclosing limits win) are preserved without the races.
 
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time as time_mod
@@ -399,6 +400,26 @@ class Mix(Generator):
 def mix(gens):
     gens = list(gens)
     return Mix(gens) if gens else void
+
+
+class CounterSource(Generator):
+    """Invocations of `f` carrying values from a shared monotonically
+    increasing counter — the common shape of unique-element workloads
+    (set adds, dirty-read writes, unique-ids)."""
+
+    def __init__(self, f: str, start: int = 0):
+        self.f = f
+        self.counter = itertools.count(start)
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            v = next(self.counter)
+        return {"type": "invoke", "f": self.f, "value": v}
+
+
+def counter_source(f: str, start: int = 0) -> CounterSource:
+    return CounterSource(f, start)
 
 
 class _Cas(Generator):

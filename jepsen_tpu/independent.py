@@ -151,6 +151,13 @@ class ConcurrentGenerator(gen.Generator):
             f" from concurrent-generator; got {thread!r}")
         group = thread // s["group_size"]
         while True:
+            # An enclosing time-limit may expire while we rotate keys;
+            # with an infinite key iterator every fresh subgenerator
+            # then yields None immediately and this loop would spin
+            # forever.  Re-check the deadline each turn.
+            d = gen._deadline()
+            if d is not None and gen._now() > d:
+                return None
             with self.lock:
                 pair = s["active"][group]
             if pair is None:

@@ -1,0 +1,5 @@
+"""Per-database test suites (reference: the 24 suite projects, e.g.
+`etcd/src/jepsen/etcd.clj`, `cockroachdb/src/jepsen/cockroach/runner.clj`).
+
+Each suite packages DB automation + a client + workloads + a nemesis
+menu + a CLI main.  `etcd` is the canonical template."""

@@ -1,0 +1,260 @@
+"""etcd test suite — the canonical complete suite template
+(reference: `etcd/src/jepsen/etcd.clj`, the reference's smallest full
+suite at 188 LoC and the shape every other per-DB suite follows):
+
+  * EtcdDB        — install from a release tarball, run as a daemon
+                    with a static initial cluster, teardown + log files
+                    (etcd.clj:55-91)
+  * EtcdClient    — v3 HTTP/JSON kv gateway client with the standard
+                    error taxonomy: indeterminate failures (timeouts)
+                    -> :info, definite failures (connection refused,
+                    compare-failed) -> :fail (etcd.clj:93-143)
+  * workload/test — independent-keys register: r/w/cas mix, 10 threads
+                    and ~300 ops per key, stagger 1/30 s, linearizable
+                    + timeline per key, partition-random-halves nemesis
+                    on a 5s/5s cadence (etcd.clj:145-180)
+  * main          — CLI entry: test / analyze / serve (etcd.clj:182-188)
+
+The transport/HTTP boundaries are injectable so the whole suite runs
+in-process against the dummy transport + an in-memory etcd for tests.
+"""
+
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import random
+import socket
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, models, nemesis, net
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.control import lit
+
+VERSION = "3.5.12"
+URL = ("https://github.com/etcd-io/etcd/releases/download/"
+       f"v{VERSION}/etcd-v{VERSION}-linux-amd64.tar.gz")
+DIR = "/opt/etcd"
+DATA_DIR = f"{DIR}/data"
+LOGFILE = f"{DIR}/etcd.log"
+PIDFILE = f"{DIR}/etcd.pid"
+PEER_PORT = 2380
+CLIENT_PORT = 2379
+
+
+def node_url(node: str, port: int) -> str:
+    return f"http://{node}:{port}"
+
+
+def initial_cluster(test) -> str:
+    """etcd.clj initial-cluster :43-50."""
+    return ",".join(f"{n}={node_url(n, PEER_PORT)}"
+                    for n in test.get("nodes") or [])
+
+
+class EtcdDB(db_mod.DB, db_mod.LogFiles):
+    """etcd.clj db :55-91."""
+
+    def setup(self, test, node):
+        cu.install_archive(URL, DIR)
+        cu.start_daemon(
+            f"{DIR}/etcd",
+            "--name", node,
+            "--listen-peer-urls", node_url(node, PEER_PORT),
+            "--listen-client-urls", node_url(node, CLIENT_PORT),
+            "--advertise-client-urls", node_url(node, CLIENT_PORT),
+            "--initial-advertise-peer-urls", node_url(node, PEER_PORT),
+            "--initial-cluster", initial_cluster(test),
+            "--initial-cluster-state", "new",
+            "--data-dir", DATA_DIR,
+            chdir=DIR, logfile=LOGFILE, pidfile=PIDFILE)
+        # wait for the member to come up before letting clients loose
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"curl -sf {node_url(node, CLIENT_PORT)}/health "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(PIDFILE, f"{DIR}/etcd")
+        c.execute("rm", "-rf", DATA_DIR, check=False)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+def b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+def unb64(s: str) -> str:
+    return base64.b64decode(s).decode()
+
+
+class EtcdHttp:
+    """Minimal etcd v3 kv gateway client (range / put / txn-CAS).
+    Swappable so tests can drop in an in-memory etcd."""
+
+    def __init__(self, node: str, timeout: float = 5.0):
+        self.base = node_url(node, CLIENT_PORT)
+        self.timeout = timeout
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.base + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.load(r)
+
+    def get(self, key: str) -> Optional[int]:
+        out = self._post("/v3/kv/range", {"key": b64(key)})
+        kvs = out.get("kvs") or []
+        return int(unb64(kvs[0]["value"])) if kvs else None
+
+    def put(self, key: str, value: int) -> None:
+        self._post("/v3/kv/put", {"key": b64(key),
+                                  "value": b64(str(value))})
+
+    def cas(self, key: str, old: int, new: int) -> bool:
+        out = self._post("/v3/kv/txn", {
+            "compare": [{"key": b64(key), "target": "VALUE",
+                         "result": "EQUAL", "value": b64(str(old))}],
+            "success": [{"requestPut": {"key": b64(key),
+                                        "value": b64(str(new))}}],
+        })
+        return bool(out.get("succeeded"))
+
+
+class EtcdClient(client_mod.Client):
+    """etcd.clj client :93-143.  Ops carry independent [k, v] tuples.
+    Error taxonomy: timeouts are indeterminate (:info — the op may have
+    happened); connection refused / CAS-compare-failed are definite
+    (:fail)."""
+
+    def __init__(self, http_factory=EtcdHttp):
+        self.http_factory = http_factory
+        self.http: Optional[EtcdHttp] = None
+
+    def open(self, test, node):
+        out = EtcdClient(self.http_factory)
+        out.http = self.http_factory(node)
+        return out
+
+    def invoke(self, test, op):
+        k, v = op.value
+        key = f"r{k}"
+        try:
+            if op.f == "read":
+                val = self.http.get(key)
+                return op.assoc(type="ok",
+                                value=independent.tuple_(k, val))
+            if op.f == "write":
+                self.http.put(key, v)
+                return op.assoc(type="ok")
+            if op.f == "cas":
+                old, new = v
+                ok = self.http.cas(key, old, new)
+                return op.assoc(type="ok" if ok else "fail")
+            raise ValueError(f"unknown f {op.f!r}")
+        except socket.timeout:
+            # Indeterminate: the server may have applied it.
+            return op.assoc(type="info", error="timeout")
+        except ConnectionRefusedError as e:
+            # Definite: the op never reached the server.
+            return op.assoc(type="fail", error=str(e))
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, socket.timeout):
+                return op.assoc(type="info", error="timeout")
+            if isinstance(reason, ConnectionRefusedError):
+                return op.assoc(type="fail", error=str(reason))
+            if op.f == "read":
+                # reads are safe to fail definitively
+                return op.assoc(type="fail", error=str(reason))
+            return op.assoc(type="info", error=str(reason))
+
+
+# ---------------------------------------------------------------------------
+# Workload (etcd.clj:145-180)
+# ---------------------------------------------------------------------------
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write",
+            "value": random.randint(0, 4)}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def etcd_test(opts) -> dict:
+    """Build the test map from CLI options (etcd.clj etcd-test
+    :149-180)."""
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    per_key = opts.get("ops-per-key", 300)
+    checker_mode = opts.get("checker-mode", "device")
+    tpk = opts.get("threads-per-key", 10)
+    # concurrent-generator needs concurrency to be a positive multiple
+    # of threads-per-key; round the requested concurrency up.
+    conc = max(opts.get("concurrency", len(nodes)), tpk)
+    conc += (-conc) % tpk
+
+    if checker_mode == "device":
+        reg_checker = independent.batch_checker(models.cas_register())
+    else:
+        reg_checker = independent.checker(
+            ck.linearizable({"model": models.cas_register()}))
+
+    from jepsen_tpu import tests as tst
+    return dict(tst.noop_test(), **{
+        "name": "etcd",
+        "nodes": nodes,
+        "concurrency": conc,
+        "ssh": opts.get("ssh", {}),
+        "db": EtcdDB(),
+        "client": EtcdClient(),
+        "net": net.iptables,
+        "nemesis": nemesis.partition_random_halves(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                independent.concurrent_generator(
+                    tpk,
+                    itertools.count(),
+                    lambda k: gen.limit(per_key,
+                                        gen.stagger(1 / 30,
+                                                    gen.mix([r, w, cas])))))),
+        "checker": ck.compose({
+            "perf": ck.perf(),
+            "indep": ck.compose({
+                "linear": reg_checker,
+                "timeline": independent.checker(timeline.html_timeline()),
+            }),
+        }),
+    })
+
+
+def main(argv=None):
+    """etcd.clj -main :182-188."""
+    cli.run(cli.single_test_cmd(etcd_test), argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,8 @@ test map, mirroring how per-DB suites compose workloads
 """
 
 from jepsen_tpu.workloads import (adya, bank, causal,  # noqa: F401
-                                  linearizable_register, long_fork)
+                                  dirty_reads, linearizable_register,
+                                  long_fork, monotonic, sets)
 
 WORKLOADS = {
     "bank": bank.workload,
@@ -15,6 +16,9 @@ WORKLOADS = {
     "long-fork": long_fork.workload,
     "adya-g2": adya.workload,
     "causal": causal.workload,
+    "monotonic": monotonic.workload,
+    "sets": sets.workload,
+    "dirty-reads": dirty_reads.workload,
 }
 
 

@@ -1,0 +1,69 @@
+"""Dirty-reads workload (reference:
+`galera/src/jepsen/galera/dirty_reads.clj`, also percona): writer txns
+set EVERY row to one value inside a single transaction; a reader that
+observes two different values in one read saw a half-applied (dirty)
+transaction; a reader that observes a value no writer committed saw an
+aborted write.
+
+Ops:
+    {f: "write", value: v}      -> sets all rows to v in one txn
+    {f: "read",  value: None}   -> ok value [v_row0, v_row1, …]
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+def WriteSource():
+    return gen.counter_source("write", start=1)
+
+
+def read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def generator():
+    return gen.mix([WriteSource()] + [read] * 3)
+
+
+class DirtyReadsChecker(ck.Checker):
+    """dirty_reads.clj checker: mixed-value reads = dirty; values never
+    ok-written = aborted reads."""
+
+    def check(self, test, history, opts=None):
+        committed = set()
+        failed = set()
+        dirty = []
+        for o in History(history):
+            if o.f == "write":
+                if o.is_ok:
+                    committed.add(o.value)
+                elif o.is_fail:
+                    # Only definite :fail writes are provably aborted;
+                    # :info (timeout) writes may have committed.
+                    failed.add(o.value)
+        aborted_seen = set()
+        for o in History(history):
+            if o.is_ok and o.f == "read" and o.value is not None:
+                vals = {v for v in o.value if v is not None}
+                if len(vals) > 1:
+                    dirty.append(o.to_dict())
+                for v in vals:
+                    if v in failed and v not in committed:
+                        aborted_seen.add(v)
+        valid = not dirty and not aborted_seen
+        return {"valid?": valid,
+                "dirty-reads": dirty,
+                "aborted-read-values": sorted(aborted_seen),
+                "writes-committed": len(committed)}
+
+
+def checker():
+    return DirtyReadsChecker()
+
+
+def workload(opts=None) -> dict:
+    return {"checker": checker(), "generator": generator()}

@@ -1,0 +1,90 @@
+"""Monotonic-inserts workload (reference:
+`cockroachdb/src/jepsen/cockroach/monotonic.clj:1-80`): clients insert
+strictly increasing values, each stamped with the database's own
+transaction timestamp; if the DB's timestamp order ever disagrees with
+the insertion order, causality ran backwards.
+
+Ops:
+    {f: "add",  value: None}       -> ok value [val, ts, node-idx]
+    {f: "read", value: None}       -> ok value [[val, ts, node-idx], …]
+
+The client supplies `val` from a shared monotonically increasing
+source and `ts` from the DB.  The checker sorts rows by ts on device
+and verifies vals are strictly increasing, reporting every inversion
+pair plus duplicate/skipped values.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+def add(test, process):
+    return {"type": "invoke", "f": "add", "value": None}
+
+
+def read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def generator():
+    return gen.mix([add] * 9 + [read])
+
+
+class MonotonicChecker(ck.Checker):
+    """Timestamp order must match value order (monotonic.clj checker)."""
+
+    def check(self, test, history, opts=None):
+        rows = None
+        for o in History(history):
+            if o.is_ok and o.f == "read" and o.value is not None:
+                rows = o.value          # last read wins
+        if rows is None:
+            return {"valid?": "unknown", "error": "no reads"}
+
+        arr = np.asarray([[r[0], r[1]] for r in rows], dtype=np.int64
+                         ) if rows else np.zeros((0, 2), np.int64)
+        if len(arr) == 0:
+            return {"valid?": True, "count": 0, "errors": []}
+
+        order = np.argsort(arr[:, 1], kind="stable")
+        vals = arr[order, 0]
+        diffs = np.diff(vals)
+        bad = np.nonzero(diffs <= 0)[0]
+        errors = [{"prev": [int(arr[order[i], 0]), int(arr[order[i], 1])],
+                   "next": [int(arr[order[i + 1], 0]),
+                            int(arr[order[i + 1], 1])]}
+                  for i in bad]
+        dup_vals, counts = np.unique(arr[:, 0], return_counts=True)
+        dups = dup_vals[counts > 1].tolist()
+        valid = not errors and not dups
+        return {"valid?": valid, "count": int(len(arr)),
+                "errors": errors, "duplicates": dups}
+
+
+def checker():
+    return MonotonicChecker()
+
+
+class MonotonicSource:
+    """Shared strictly-increasing value source for clients."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.n = 0
+
+    def next(self) -> int:
+        with self.lock:
+            self.n += 1
+            return self.n
+
+
+def workload(opts=None) -> dict:
+    return {"checker": checker(), "generator": generator(),
+            "source": MonotonicSource()}

@@ -1,0 +1,48 @@
+"""Set workload (reference: the `sets` workloads across suites, e.g.
+cockroachdb runner.clj:25-34, checked by `checker.clj set/set-full
+:182-233,364-533`): clients add unique integers; reads return the set;
+lost or resurrected elements are consistency violations.
+
+Ops:
+    {f: "add",  value: i}
+    {f: "read", value: None}   -> ok value [i, …]
+
+The workload fragment carries both the main generator (staggered adds
+with occasional reads) and a `final-generator` (one quiesced read) for
+suites to schedule after healing, the yugabyte core.clj:33-45 pattern.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+
+
+def AddSource():
+    """Unique-element add ops from a shared counter."""
+    return gen.counter_source("add")
+
+
+def read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def generator(read_fraction: float = 0.1):
+    """Mostly adds, a `read_fraction` sprinkle of reads so set-full can
+    time elements' visibility.  Suites add stagger/time limits on top."""
+    reads = max(1, round(read_fraction * 10))
+    return gen.mix([AddSource()] * (10 - reads) + [read] * reads)
+
+
+def final_generator():
+    """One read after the dust settles (yugabyte core.clj:33-45)."""
+    return gen.once(read)
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    full = opts.get("set-full", True)
+    checker = ck.set_full(opts) if full else ck.set_checker()
+    return {"checker": checker,
+            "generator": generator(),
+            "final-generator": final_generator()}

@@ -1,0 +1,263 @@
+"""Suite + new-workload tests: the etcd suite end-to-end against an
+in-memory etcd over the dummy transport, and the monotonic / sets /
+dirty-reads workload checkers on literal + generated histories."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen, independent, store
+from jepsen_tpu import tests as tst
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.suites import etcd
+from jepsen_tpu.workloads import dirty_reads, monotonic, sets
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+class MemEtcd:
+    """In-memory linearizable etcd cluster shared by all 'nodes'."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv = {}
+
+    def client(self, node):
+        mem = self
+
+        class C:
+            def get(self, key):
+                with mem.lock:
+                    return mem.kv.get(key)
+
+            def put(self, key, value):
+                with mem.lock:
+                    mem.kv[key] = value
+
+            def cas(self, key, old, new):
+                with mem.lock:
+                    if mem.kv.get(key) == old:
+                        mem.kv[key] = new
+                        return True
+                    return False
+
+        return C()
+
+
+class TestEtcdSuite:
+    def run_suite(self, time_limit=3):
+        mem = MemEtcd()
+        cmds = []
+
+        def handler(node, cmd, stdin):
+            cmds.append((node, cmd))
+            if "mktemp -d" in cmd:
+                return "/tmp/jepsen.X"
+            if "test -e" in cmd:
+                return "true"
+            if "ls -A" in cmd:
+                return "etcd-dir\n"
+            return ""
+
+        control.set_dummy_handler(handler)
+        try:
+            test = etcd.etcd_test({
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 4,
+                "threads-per-key": 2,
+                "ops-per-key": 30,
+                "time-limit": time_limit,
+                "nemesis-interval": 0.5,
+                "ssh": {"dummy": True},
+            })
+            test["client"] = etcd.EtcdClient(http_factory=mem.client)
+            result = core.run(test)
+        finally:
+            control.set_dummy_handler(None)
+        return result, cmds
+
+    def test_end_to_end_valid(self):
+        result, cmds = self.run_suite()
+        res = result["results"]
+        assert res["valid?"] is True
+        assert res["indep"]["linear"]["valid?"] is True
+        # the independent layer actually sharded keys
+        hist = result["history"]
+        keys = independent.history_keys(hist)
+        assert len(keys) >= 1
+        # DB provisioning flowed through the control plane
+        assert any("etcd" in c and "start-stop-daemon --start" in c
+                   for _, c in cmds)
+        assert any("--initial-cluster" in c for _, c in cmds)
+        # nemesis partitioned and healed via iptables
+        assert any("iptables" in c and "DROP" in c for _, c in cmds)
+        assert any("iptables -F" in c for _, c in cmds)
+
+    def test_client_error_taxonomy(self):
+        class Timeouty:
+            def get(self, key):
+                import socket
+                raise socket.timeout("read timed out")
+
+            def put(self, key, value):
+                import socket
+                raise socket.timeout("put timed out")
+
+            def cas(self, key, old, new):
+                raise ConnectionRefusedError("refused")
+
+        cl = etcd.EtcdClient(http_factory=lambda node: Timeouty())
+        cl = cl.open({}, "n1")
+        out = cl.invoke({}, invoke_op(0, "write",
+                                      independent.tuple_(0, 3)))
+        assert out.type == "info"        # indeterminate
+        out = cl.invoke({}, invoke_op(0, "cas",
+                                      independent.tuple_(0, [1, 2])))
+        assert out.type == "fail"        # refused: never reached server
+        out = cl.invoke({}, invoke_op(0, "read",
+                                      independent.tuple_(0, None)))
+        assert out.type == "info"        # timeout read: indeterminate
+
+    def test_default_concurrency_satisfies_threads_per_key(self):
+        # default opts (5 nodes, tpk 10) must produce a runnable test
+        t = etcd.etcd_test({})
+        assert t["concurrency"] % 10 == 0 and t["concurrency"] >= 10
+        t = etcd.etcd_test({"concurrency": 13, "threads-per-key": 5})
+        assert t["concurrency"] == 15
+
+    def test_perf_factory_survives_graph_checks(self):
+        # importing checker.perf inside the graph checkers must not
+        # clobber the ck.perf() factory (package-attribute shadowing)
+        from jepsen_tpu import checker as ck
+        h = History([invoke_op(0, "read", None),
+                     ok_op(0, "read", 1)]).index()
+        ck.perf().check({"name": None}, h, {})
+        assert callable(ck.perf)
+        ck.perf().check({"name": None}, h, {})
+
+    def test_db_teardown_removes_data(self):
+        cmds = []
+        control.set_dummy_handler(lambda n, c, s: cmds.append(c) or "")
+        try:
+            with control.with_ssh({"dummy": True}):
+                with control.with_session("n1", control.session("n1")):
+                    etcd.EtcdDB().teardown({}, "n1")
+        finally:
+            control.set_dummy_handler(None)
+        assert any("start-stop-daemon --stop" in c for c in cmds)
+        assert any("rm -rf /opt/etcd/data" in c for c in cmds)
+
+
+class TestMonotonic:
+    def check(self, rows):
+        h = History([invoke_op(0, "read", None),
+                     ok_op(0, "read", rows)]).index()
+        return monotonic.checker().check({}, h, {})
+
+    def test_valid(self):
+        r = self.check([[1, 100, 0], [2, 200, 1], [3, 300, 0]])
+        assert r["valid?"] is True and r["count"] == 3
+
+    def test_inversion(self):
+        # value 3 got an earlier timestamp than value 2
+        r = self.check([[1, 100, 0], [3, 150, 1], [2, 200, 0]])
+        assert r["valid?"] is False
+        assert r["errors"]
+
+    def test_duplicates(self):
+        r = self.check([[1, 100, 0], [1, 200, 1]])
+        assert r["valid?"] is False
+        assert r["duplicates"] == [1]
+
+    def test_no_reads_unknown(self):
+        h = History([invoke_op(0, "add", None),
+                     ok_op(0, "add", [1, 100, 0])]).index()
+        r = monotonic.checker().check({}, h, {})
+        assert r["valid?"] == "unknown"
+
+    def test_end_to_end_run(self):
+        src = monotonic.MonotonicSource()
+        lock = threading.Lock()
+        rows = []
+
+        class Client(tst.AtomClient.__mro__[1]):  # client_mod.Client
+            def invoke(self, test, op):
+                if op.f == "add":
+                    with lock:
+                        v = src.next()
+                        rows.append([v, len(rows) * 10, 0])
+                    return op.assoc(type="ok", value=rows[-1])
+                return op.assoc(type="ok", value=list(rows))
+
+        test = dict(tst.noop_test(), **{
+            "name": "monotonic-e2e", "concurrency": 3,
+            "client": Client(),
+            "generator": gen.limit(40, monotonic.generator()),
+            "checker": monotonic.checker(),
+        })
+        result = core.run(test)
+        assert result["results"]["valid?"] in (True, "unknown")
+
+
+class TestSets:
+    def test_workload_shape(self):
+        w = sets.workload({})
+        assert "generator" in w and "final-generator" in w
+
+    def test_adds_are_unique(self):
+        g = sets.AddSource()
+        vals = [g.op({}, 0)["value"] for _ in range(100)]
+        assert len(set(vals)) == 100
+
+    def test_lost_element_detected(self):
+        from jepsen_tpu import checker as ck
+        h = History([
+            invoke_op(0, "add", 0), ok_op(0, "add", 0),
+            invoke_op(0, "add", 1), ok_op(0, "add", 1),
+            invoke_op(0, "read", None), ok_op(0, "read", [0]),
+        ]).index()
+        r = ck.set_full().check({}, h, {})
+        assert r["valid?"] is False
+        assert 1 in r.get("lost", [1])
+
+
+class TestDirtyReads:
+    def check(self, history):
+        return dirty_reads.checker().check({}, History(history).index(), {})
+
+    def test_valid(self):
+        r = self.check([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read", None), ok_op(1, "read", [1, 1, 1]),
+        ])
+        assert r["valid?"] is True
+
+    def test_mixed_read_is_dirty(self):
+        r = self.check([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(2, "write", 2), ok_op(2, "write", 2),
+            invoke_op(1, "read", None), ok_op(1, "read", [1, 2, 1]),
+        ])
+        assert r["valid?"] is False
+        assert len(r["dirty-reads"]) == 1
+
+    def test_aborted_read(self):
+        from jepsen_tpu.history import fail_op
+        r = self.check([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(2, "write", 9), fail_op(2, "write", 9),
+            invoke_op(1, "read", None), ok_op(1, "read", [9, 9, 9]),
+        ])
+        assert r["valid?"] is False
+        assert r["aborted-read-values"] == [9]
+
+    def test_registry_has_new_workloads(self):
+        from jepsen_tpu import workloads
+        for name in ("monotonic", "sets", "dirty-reads"):
+            assert name in workloads.WORKLOADS
+            w = workloads.workload(name)
+            assert "checker" in w and "generator" in w
