@@ -1,0 +1,240 @@
+"""Serialization codec for ops and test data (reference:
+`jepsen/src/jepsen/codec.clj:9-17` — EDN bytes `encode`/`decode`).
+
+The reference speaks EDN because it is Clojure; our canonical in-memory
+form is Python dicts/lists.  This module provides:
+
+  * `encode`/`decode`     — bytes round-trip of op/test data (EDN text,
+                            matching the reference's wire format)
+  * `edn_str`/`read_edn`  — a small EDN printer/reader covering the
+                            subset Jepsen actually serializes: nil,
+                            booleans, ints, floats, strings, keywords,
+                            symbols, vectors, lists, sets, and maps
+                            (store.clj:185-225 reads histories back with
+                            exactly this shape)
+
+Python-side conventions: EDN keywords `:foo` decode to strings `"foo"`;
+maps with string keys encode with keyword keys (the op format
+`{:process 0 :type :invoke :f :read :value nil}` from util.clj:146-165).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Printer
+# ---------------------------------------------------------------------------
+
+_KEYWORD_SAFE = set("abcdefghijklmnopqrstuvwxyz"
+                    "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                    "0123456789*+!-_?<>=./#")
+
+
+def _keyword_ok(s: str) -> bool:
+    return bool(s) and not s[0].isdigit() and all(c in _KEYWORD_SAFE
+                                                  for c in s)
+
+
+def edn_str(x: Any) -> str:
+    """Print x as EDN.  Dict keys that look like keywords become
+    keywords; everything else stays a string."""
+    if x is None:
+        return "nil"
+    if x is True:
+        return "true"
+    if x is False:
+        return "false"
+    if isinstance(x, str):
+        return '"' + x.replace("\\", "\\\\").replace('"', '\\"') \
+                      .replace("\n", "\\n").replace("\t", "\\t") + '"'
+    if isinstance(x, bool):  # pragma: no cover — caught above
+        return "true" if x else "false"
+    if isinstance(x, int):
+        return str(x)
+    if isinstance(x, float):
+        return repr(x)
+    if isinstance(x, (list, tuple)):
+        return "[" + " ".join(edn_str(v) for v in x) + "]"
+    if isinstance(x, (set, frozenset)):
+        return "#{" + " ".join(sorted(edn_str(v) for v in x)) + "}"
+    if isinstance(x, dict):
+        parts = []
+        for k, v in x.items():
+            if isinstance(k, str) and _keyword_ok(k):
+                ks = ":" + k
+            else:
+                ks = edn_str(k)
+            # op maps: :type/:f values are keywords in the reference's
+            # history format ({:type :ok :f :cas}, util.clj:146-165)
+            if (k in ("type", "f") and isinstance(v, str)
+                    and _keyword_ok(v)):
+                parts.append(ks + " :" + v)
+            else:
+                parts.append(ks + " " + edn_str(v))
+        return "{" + ", ".join(parts) + "}"
+    # ops and other objects that know how to render themselves
+    to_map = getattr(x, "to_map", None)
+    if callable(to_map):
+        return edn_str(to_map())
+    return edn_str(str(x))
+
+
+# ---------------------------------------------------------------------------
+# Reader
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    _WS = set(" \t\n\r,")
+    _DELIM = set(" \t\n\r,()[]{}\"")
+
+    def __init__(self, s: str):
+        self.s = s
+        self.i = 0
+
+    def _skip_ws(self) -> None:
+        s, n = self.s, len(self.s)
+        while self.i < n:
+            c = s[self.i]
+            if c in self._WS:
+                self.i += 1
+            elif c == ";":  # comment to EOL
+                while self.i < n and s[self.i] != "\n":
+                    self.i += 1
+            else:
+                return
+
+    def _peek(self) -> str:
+        return self.s[self.i] if self.i < len(self.s) else ""
+
+    def read(self) -> Any:
+        self._skip_ws()
+        c = self._peek()
+        if c == "":
+            raise ValueError("unexpected EOF in EDN")
+        if c == "{":
+            self.i += 1
+            return self._read_map()
+        if c == "[":
+            self.i += 1
+            return self._read_seq("]")
+        if c == "(":
+            self.i += 1
+            return self._read_seq(")")
+        if c == "#":
+            if self.s.startswith("#{", self.i):
+                self.i += 2
+                return set(self._read_seq("}"))
+            # tagged literal: read and drop the tag, keep the value
+            self.i += 1
+            self._read_token()
+            return self.read()
+        if c == '"':
+            return self._read_string()
+        if c == ":":
+            self.i += 1
+            return self._read_token()  # keywords -> plain strings
+        return self._read_atom()
+
+    def _read_map(self) -> dict:
+        out = {}
+        while True:
+            self._skip_ws()
+            if self._peek() == "}":
+                self.i += 1
+                return out
+            k = self.read()
+            v = self.read()
+            if isinstance(k, (list, set)):
+                k = tuple(k)  # hashable
+            out[k] = v
+
+    def _read_seq(self, close: str) -> list:
+        out = []
+        while True:
+            self._skip_ws()
+            if self._peek() == close:
+                self.i += 1
+                return out
+            out.append(self.read())
+
+    def _read_string(self) -> str:
+        assert self.s[self.i] == '"'
+        self.i += 1
+        out = []
+        s, n = self.s, len(self.s)
+        while self.i < n:
+            c = s[self.i]
+            if c == "\\":
+                if self.i + 1 >= n:
+                    raise ValueError("unterminated string in EDN")
+                nxt = s[self.i + 1]
+                out.append({"n": "\n", "t": "\t", "r": "\r",
+                            '"': '"', "\\": "\\"}.get(nxt, nxt))
+                self.i += 2
+            elif c == '"':
+                self.i += 1
+                return "".join(out)
+            else:
+                out.append(c)
+                self.i += 1
+        raise ValueError("unterminated string in EDN")
+
+    def _read_token(self) -> str:
+        start = self.i
+        s, n = self.s, len(self.s)
+        while self.i < n and s[self.i] not in self._DELIM:
+            self.i += 1
+        return s[start:self.i]
+
+    def _read_atom(self) -> Any:
+        tok = self._read_token()
+        if tok == "nil":
+            return None
+        if tok == "true":
+            return True
+        if tok == "false":
+            return False
+        try:
+            return int(tok)
+        except ValueError:
+            pass
+        try:
+            return float(tok)
+        except ValueError:
+            pass
+        return tok  # symbol -> string
+
+
+def read_edn(s: str) -> Any:
+    """Parse one EDN form from s."""
+    return _Reader(s).read()
+
+
+def read_edn_all(s: str) -> list:
+    """Parse every top-level EDN form in s (e.g. a history file of one
+    op map per line, store.clj write-history!)."""
+    r = _Reader(s)
+    out = []
+    while True:
+        r._skip_ws()
+        if r.i >= len(r.s):
+            return out
+        out.append(r.read())
+
+
+# ---------------------------------------------------------------------------
+# Bytes API (codec.clj:9-17)
+# ---------------------------------------------------------------------------
+
+def encode(x: Any) -> bytes:
+    """Object -> EDN bytes (codec.clj encode :9-12)."""
+    return edn_str(x).encode("utf-8")
+
+
+def decode(b: bytes) -> Any:
+    """EDN bytes -> object (codec.clj decode :14-17); b'' -> None like
+    the reference's nil-on-empty behavior."""
+    if not b:
+        return None
+    return read_edn(b.decode("utf-8"))

@@ -143,7 +143,17 @@ class ClientWorker(Worker):
                         self.client = None
                         continue
                 conj_op(test, op)
-                completion = invoke_op(op, test, self.client, self.abort)
+                tr = test.get("tracer")
+                if tr is not None and tr.enabled:
+                    # dgraph trace.clj:52-63 wraps client ops in spans
+                    with tr.span("client/invoke", f=str(op.f),
+                                 process=op.process):
+                        completion = invoke_op(op, test, self.client,
+                                               self.abort)
+                        tr.attribute("type", str(completion.type))
+                else:
+                    completion = invoke_op(op, test, self.client,
+                                           self.abort)
                 conj_op(test, completion)
                 log_op(completion)
                 if completion.is_info:
@@ -330,6 +340,8 @@ def run(test: dict) -> dict:
     if test.get("name"):
         from jepsen_tpu import store
         store.start_logging(test)
+    from jepsen_tpu import trace as trace_mod
+    test["tracer"] = trace_mod.tracer(test)
     log.info("Running test: %s", test.get("name"))
     try:
         with control.with_ssh(test.get("ssh")):
@@ -398,3 +410,8 @@ def _run_case_and_analyze(test) -> None:
             from jepsen_tpu import store
             store.save_1(test)
         analyze(test)
+        tr = test.get("tracer")
+        if tr is not None:
+            if test.get("name"):  # file export needs a store dir
+                tr.write(test)
+            tr.flush_http()       # HTTP export only needs an endpoint

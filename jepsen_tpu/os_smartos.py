@@ -1,0 +1,81 @@
+"""SmartOS provisioning (reference: `jepsen/src/jepsen/os/smartos.clj`):
+pkgin package management and the node baseline, the illumos sibling of
+the debian/centos OSes.  Used by the mongodb-smartos suite.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from jepsen_tpu import os as os_mod
+from jepsen_tpu import control as c
+from jepsen_tpu.control import lit
+
+log = logging.getLogger("jepsen.os.smartos")
+
+# smartos.clj setup! package baseline (:88-106): the same tool envelope
+# the nemeses and control utils need, under pkgsrc names.
+BASE_PACKAGES = ["wget", "curl", "unzip", "gtar", "bzip2", "rsyslog",
+                 "logrotate", "gcc13"]
+
+
+def setup_hostfile(test, node) -> None:
+    """Write /etc/hosts mapping every test node (smartos.clj
+    setup-hostfile! — same contract as debian.clj:12-30)."""
+    lines = ["127.0.0.1 localhost"]
+    for n in test.get("nodes") or []:
+        ip = c.execute(lit(f"getent hosts {c.escape(n)} | head -n1 "
+                           "| cut -d' ' -f1"), check=False) or n
+        lines.append(f"{ip.strip() or n} {n}")
+    c.upload_str("\n".join(lines) + "\n", "/etc/hosts.jepsen")
+    c.execute(lit("cp /etc/hosts.jepsen /etc/hosts"))
+
+
+def installed(pkgs: Iterable[str]) -> set:
+    """Subset of pkgs already installed (smartos.clj installed? :29-38,
+    via `pkgin list`)."""
+    out = c.execute(lit("pkgin list 2>/dev/null | awk '{print $1}'"),
+                    check=False)
+    have = set()
+    for line in out.splitlines():
+        # pkgin lists name-version; strip only the trailing -version so
+        # curl-ca-bundle-1.2 -> curl-ca-bundle, never a bare curl
+        name = line.rsplit("-", 1)[0] if "-" in line else line
+        have.add(name)
+    return {p for p in pkgs if p in have}
+
+
+def update() -> None:
+    """Refresh the pkgin database (smartos.clj update! :41-43)."""
+    c.execute(lit("pkgin -y update"))
+
+
+def install(pkgs: Iterable[str], force: bool = False) -> None:
+    """pkgin install missing packages (smartos.clj install :45-55)."""
+    pkgs = list(pkgs)
+    have = set() if force else installed(pkgs)
+    missing = [p for p in pkgs if p not in have]
+    if not missing:
+        return
+    c.execute(lit("pkgin -y install "
+                  + " ".join(c.escape(p) for p in missing)))
+
+
+class SmartOS(os_mod.OS):
+    """The stock SmartOS (smartos.clj os :109-130): hostfile, baseline
+    packages, network heal."""
+
+    def setup(self, test, node):
+        log.info("%s setting up smartos", node)
+        setup_hostfile(test, node)
+        install(BASE_PACKAGES)
+        net = test.get("net")
+        if net is not None:
+            net.heal(test)
+
+    def teardown(self, test, node):
+        pass
+
+
+os = SmartOS()

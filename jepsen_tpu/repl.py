@@ -1,0 +1,32 @@
+"""Interactive-session helpers (reference: `jepsen/src/jepsen/repl.clj`,
+13 LoC): convenience accessors for poking at stored tests from a Python
+REPL or notebook.
+
+    >>> from jepsen_tpu import repl
+    >>> t = repl.last_test()
+    >>> t["results"]["valid?"]
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import store
+
+
+def last_test() -> Optional[dict]:
+    """The most recently run test, loaded from the store
+    (repl.clj last-test :7-12)."""
+    return store.latest()
+
+
+def last_history() -> Optional[list]:
+    """The most recent test's history, or None."""
+    t = last_test()
+    return t.get("history") if t else None
+
+
+def last_results() -> Optional[dict]:
+    """The most recent test's checker results, or None."""
+    t = last_test()
+    return t.get("results") if t else None

@@ -1,0 +1,36 @@
+"""Report rendering helpers (reference: `jepsen/src/jepsen/report.clj`,
+16 LoC — the `to` macro that tees human-readable output into a file
+under the test's store directory while also printing it).
+
+    with report.to(test, "linearizability.txt") as out:
+        out.write(...)
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+
+from jepsen_tpu import store
+
+
+@contextlib.contextmanager
+def to(test, filename: str, echo: bool = True):
+    """Write a report file under the test's store dir, echoing to
+    stdout like the reference's `to` macro (report.clj:7-16)."""
+    path = store.make_path(test, filename)
+    buf = io.StringIO()
+    yield buf
+    text = buf.getvalue()
+    with open(path, "w") as f:
+        f.write(text)
+    if echo:
+        sys.stdout.write(text)
+
+
+def write(test, filename: str, text: str, echo: bool = False) -> str:
+    """One-shot report write; returns the path."""
+    with to(test, filename, echo=echo) as out:
+        out.write(text)
+    return str(store.path(test, filename))

@@ -37,7 +37,7 @@ BASE = Path("store")
 NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "barrier", "active_histories", "active_histories_lock", "history_lock",
-    "sessions", "remote", "store", "abort_event",
+    "sessions", "remote", "store", "abort_event", "tracer",
 }
 
 

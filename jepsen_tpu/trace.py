@@ -1,0 +1,207 @@
+"""Distributed tracing (reference: `dgraph/src/jepsen/dgraph/trace.clj`
+:1-75 — OpenCensus spans with a Jaeger exporter, a `with-trace` macro
+wrapping client ops, and span annotations/attributes, enabled per-test
+by an endpoint option).
+
+TPU-native build keeps the same shape without the OpenCensus dependency:
+spans are plain dicts collected by a `Tracer`, written as JSONL into the
+test's store directory (and optionally POSTed to a Jaeger-style HTTP
+collector if `endpoint` is set).  The `span` context manager nests via a
+thread-local stack, so client `invoke` bodies can open child spans
+exactly like dgraph's `with-trace` (trace.clj:52-63).
+
+Usage (suite-side, mirroring dgraph client.clj):
+
+    tracer = trace.tracer(test)           # no-op unless test["trace"]
+    with tracer.span("client/invoke", f=op.f):
+        tracer.annotate("sending txn")
+        ...
+
+Core wiring: `core.run` calls `trace.tracer(test)` once and stores it at
+test["tracer"]; workers wrap every client invoke in a span when tracing
+is enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Optional
+
+_local = threading.local()
+
+
+def _span_stack() -> list:
+    st = getattr(_local, "spans", None)
+    if st is None:
+        st = _local.spans = []
+    return st
+
+
+def _id64() -> str:
+    return f"{random.getrandbits(64):016x}"
+
+
+class Span:
+    """One span: name, ids, wall-clock bounds, attributes, annotations
+    (the OpenCensus surface dgraph uses, trace.clj:52-75)."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_us",
+                 "end_us", "attributes", "annotations")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 attributes: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _id64()
+        self.parent_id = parent_id
+        self.start_us = int(time.time() * 1e6)
+        self.end_us: Optional[int] = None
+        self.attributes = dict(attributes)
+        self.annotations: list = []
+
+    def to_map(self) -> dict:
+        return {"name": self.name,
+                "traceId": self.trace_id,
+                "spanId": self.span_id,
+                "parentId": self.parent_id,
+                "startUs": self.start_us,
+                "endUs": self.end_us,
+                "attributes": self.attributes,
+                "annotations": self.annotations}
+
+
+class _SpanCtx:
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Optional[Span]:
+        if not self.tracer.enabled:
+            return None
+        stack = _span_stack()
+        parent = stack[-1] if stack else None
+        trace_id = parent.trace_id if parent else _id64() + _id64()
+        self.span = Span(self.name, trace_id,
+                         parent.span_id if parent else None, self.attrs)
+        stack.append(self.span)
+        return self.span
+
+    def __exit__(self, etype, e, tb):
+        if self.span is None:
+            return False
+        stack = _span_stack()
+        if stack and stack[-1] is self.span:
+            stack.pop()
+        self.span.end_us = int(time.time() * 1e6)
+        if etype is not None:
+            self.span.attributes["error"] = True
+            self.span.attributes["error.message"] = str(e)
+        self.tracer._emit(self.span)
+        return False
+
+
+class Tracer:
+    """Collects spans for one test.  `enabled=False` makes every call a
+    no-op (the default, like dgraph's nil-endpoint guard
+    trace.clj:36-49)."""
+
+    def __init__(self, enabled: bool = False, service: str = "jepsen",
+                 sink=None, endpoint: Optional[str] = None):
+        self.enabled = enabled
+        self.service = service
+        self.endpoint = endpoint
+        self._sink = sink          # callable(span_map) | None
+        self._spans: list = []
+        self._lock = threading.Lock()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **attributes) -> _SpanCtx:
+        """Context manager opening a (possibly child) span — dgraph's
+        `with-trace` (trace.clj:52-63)."""
+        return _SpanCtx(self, name, attributes)
+
+    def annotate(self, message: str, **attributes) -> None:
+        """Annotate the innermost open span (trace.clj:65-69)."""
+        if not self.enabled:
+            return
+        stack = _span_stack()
+        if stack:
+            stack[-1].annotations.append(
+                {"timeUs": int(time.time() * 1e6),
+                 "message": message, **attributes})
+
+    def attribute(self, key: str, value: Any) -> None:
+        """Set an attribute on the innermost open span
+        (trace.clj:71-75)."""
+        if not self.enabled:
+            return
+        stack = _span_stack()
+        if stack:
+            stack[-1].attributes[key] = value
+
+    def _emit(self, span: Span) -> None:
+        m = span.to_map()
+        with self._lock:
+            self._spans.append(m)
+            if self._sink is not None:
+                self._sink(m)
+
+    # -- export ------------------------------------------------------------
+
+    def spans(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def write(self, test) -> Optional[str]:
+        """Write collected spans as JSONL under the test's store dir;
+        returns the path (or None when disabled/empty)."""
+        if not self.enabled or not self._spans:
+            return None
+        from jepsen_tpu import store
+        path = store.make_path(test, "trace.jsonl")
+        with self._lock, open(path, "w") as f:
+            for m in self._spans:
+                f.write(json.dumps(m) + "\n")
+        return str(path)
+
+    def flush_http(self) -> bool:
+        """POST spans to a Jaeger-style JSON collector if `endpoint` is
+        configured (the exporter half of trace.clj:36-49).  Returns
+        True on success; network failures are swallowed — tracing must
+        never fail a test."""
+        if not (self.enabled and self.endpoint and self._spans):
+            return False
+        import urllib.request
+        body = json.dumps({"process": {"serviceName": self.service},
+                           "spans": self.spans()}).encode()
+        try:
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5):
+                return True
+        except Exception:
+            return False
+
+
+_NOOP = Tracer(enabled=False)
+
+
+def tracer(test_or_opts=None) -> Tracer:
+    """Build a tracer from a test map: enabled iff `trace` is truthy
+    (dgraph enables on a --tracing endpoint option, core.clj:25-37).
+    `trace` may be True or a Jaeger collector URL."""
+    opts = test_or_opts or {}
+    t = opts.get("trace") if isinstance(opts, dict) else None
+    if not t:
+        return _NOOP
+    endpoint = t if isinstance(t, str) else None
+    return Tracer(enabled=True,
+                  service=str(opts.get("name", "jepsen")),
+                  endpoint=endpoint)

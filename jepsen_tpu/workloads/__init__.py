@@ -7,8 +7,11 @@ test map, mirroring how per-DB suites compose workloads
 """
 
 from jepsen_tpu.workloads import (adya, bank, causal,  # noqa: F401
-                                  dirty_reads, linearizable_register,
-                                  long_fork, monotonic, sets)
+                                  counter, dirty_reads,
+                                  linearizable_register, long_fork,
+                                  monotonic, multi_key_acid, queue,
+                                  sequential, sets, single_key_acid,
+                                  upsert)
 
 WORKLOADS = {
     "bank": bank.workload,
@@ -19,6 +22,12 @@ WORKLOADS = {
     "monotonic": monotonic.workload,
     "sets": sets.workload,
     "dirty-reads": dirty_reads.workload,
+    "counter": counter.workload,
+    "sequential": sequential.workload,
+    "upsert": upsert.workload,
+    "queue": queue.workload,
+    "single-key-acid": single_key_acid.workload,
+    "multi-key-acid": multi_key_acid.workload,
 }
 
 

@@ -1,0 +1,54 @@
+"""Counter workload (reference: the `counter` workloads in yugabyte
+`yugabyte/src/yugabyte/counter.clj` and aerospike, checked by
+`checker.clj counter :678-755`): clients concurrently increment (and
+optionally decrement) a shared counter and read it; every read must
+fall inside the interval of possible counter values given which
+increments had definitely/possibly taken effect.
+
+Ops:
+    {f: "add",  value: delta}   -> ok
+    {f: "read", value: None}    -> ok value n
+
+The interval-tracking checker is `ck.counter()` — a device-side scan
+over the packed history (ops/fold.py).
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+
+
+def incr(test, process):
+    return {"type": "invoke", "f": "add", "value": 1}
+
+
+def rand_add(test, process):
+    return {"type": "invoke", "f": "add", "value": random.randint(1, 5)}
+
+
+def read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def generator(dec: bool = False):
+    """Mostly adds with frequent reads (yugabyte counter.clj); `dec`
+    mixes in negative deltas for DBs that support decrement."""
+    adds = [incr, rand_add]
+    if dec:
+        adds.append(lambda t, p: {"type": "invoke", "f": "add",
+                                  "value": -random.randint(1, 5)})
+    return gen.mix(adds + [read] * 2)
+
+
+def final_generator():
+    return gen.once(read)
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    return {"checker": ck.counter(),
+            "generator": generator(dec=bool(opts.get("dec"))),
+            "final-generator": final_generator()}

@@ -11,7 +11,8 @@ Ops:
 The client supplies `val` from a shared monotonically increasing
 source and `ts` from the DB.  The checker sorts rows by ts on device
 and verifies vals are strictly increasing, reporting every inversion
-pair plus duplicate/skipped values.
+pair plus duplicate values; skipped values are reported informationally
+(failed adds legitimately leave gaps, so gaps alone don't fail).
 """
 
 from __future__ import annotations
@@ -63,9 +64,17 @@ class MonotonicChecker(ck.Checker):
                   for i in bad]
         dup_vals, counts = np.unique(arr[:, 0], return_counts=True)
         dups = dup_vals[counts > 1].tolist()
+        # gaps in the value sequence: informational only (failed adds
+        # legitimately skip values)
+        sorted_vals = np.unique(arr[:, 0])
+        gaps = np.nonzero(np.diff(sorted_vals) > 1)[0]
+        skipped = [int(v) for i in gaps
+                   for v in range(int(sorted_vals[i]) + 1,
+                                  int(sorted_vals[i + 1]))]
         valid = not errors and not dups
         return {"valid?": valid, "count": int(len(arr)),
-                "errors": errors, "duplicates": dups}
+                "errors": errors, "duplicates": dups,
+                "skipped": skipped}
 
 
 def checker():

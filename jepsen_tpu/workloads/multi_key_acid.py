@@ -1,0 +1,64 @@
+"""Multi-key ACID workload (reference: yugabyte's `multi-key-acid`
+test, `yugabyte/src/yugabyte/multi_key_acid.clj`): each write
+transaction sets BOTH keys of a fixed pair to the same value; reads
+fetch both keys in one transaction.  Because every committed txn leaves
+the pair equal, any read observing two different values is a fractured
+(non-atomic) read.
+
+Ops:
+    {f: "write", value: v}            (txn: k1=v, k2=v)
+    {f: "read",  value: None}  -> ok value [v1, v2]
+
+Checker: no ok read may return v1 != v2; additionally each observed
+value must correspond to some attempted write (no phantom values).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+def read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def generator():
+    # unique write values (shared counter) so phantom detection is exact
+    return gen.mix([gen.counter_source("write")] * 2 + [read])
+
+
+class MultiKeyAcidChecker(ck.Checker):
+    """Fractured-read and phantom-value detection
+    (multi_key_acid.clj checker)."""
+
+    def check(self, test, history, opts=None):
+        attempted = set()
+        fractured, phantoms = [], []
+        reads = 0
+        for o in History(history):
+            if o.f == "write" and o.is_invoke:
+                attempted.add(o.value)
+            elif o.f == "read" and o.is_ok and o.value is not None:
+                reads += 1
+                v1, v2 = o.value
+                if v1 != v2:
+                    fractured.append({"op-index": o.index,
+                                      "values": [v1, v2]})
+                for v in (v1, v2):
+                    if v is not None and v not in attempted:
+                        phantoms.append({"op-index": o.index,
+                                         "value": v})
+        return {"valid?": not fractured and not phantoms,
+                "read-count": reads,
+                "fractured-reads": fractured,
+                "phantoms": phantoms}
+
+
+def checker():
+    return MultiKeyAcidChecker()
+
+
+def workload(opts=None) -> dict:
+    return {"checker": checker(), "generator": generator()}

@@ -1,0 +1,51 @@
+"""Queue workload (reference: the rabbitmq suite's queue test,
+`rabbitmq/src/jepsen/rabbitmq.clj`, and disque — checked by
+`checker.clj total-queue :569-628` / `queue :160-180`): clients
+enqueue unique integers and dequeue; after the run every attempted
+enqueue is drained.  total-queue's multiset accounting flags lost
+(enqueued, never dequeued) and duplicated (dequeued more times than
+enqueued) elements.
+
+Ops:
+    {f: "enqueue", value: i}
+    {f: "dequeue", value: None}  -> ok value i
+    {f: "drain",   value: None}  -> ok value [i…]   (optional bulk form,
+                                    expanded by the checker)
+
+The `linear` option swaps in the knossos-style linearizable queue
+checker over an unordered-queue model (rabbitmq.clj uses both).
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import models
+
+
+def generator(time_limit=None, ops=5000):
+    """Random enqueue/dequeue, then a drain phase covering every
+    attempted enqueue (rabbitmq.clj:180-210).
+
+    The time/op bound must live INSIDE drain_queue: wrapping the whole
+    thing in an outer `gen.time_limit` would cut off the drain dequeues
+    and make total-queue report healthy elements as lost.  So the
+    source is always bounded here (by `ops`, and by `time_limit` when
+    given) and drain_queue runs to completion after it."""
+    src = gen.limit(ops, gen.queue_gen())
+    if time_limit:
+        src = gen.time_limit(time_limit, src)
+    return gen.drain_queue(src)
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    checker = ck.total_queue()
+    if opts.get("linear"):
+        checker = ck.compose({
+            "total": ck.total_queue(),
+            "linear": ck.queue(models.unordered_queue()),
+        })
+    return {"checker": checker,
+            "generator": generator(opts.get("time-limit"),
+                                   opts.get("ops", 5000))}

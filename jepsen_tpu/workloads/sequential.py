@@ -1,0 +1,114 @@
+"""Sequential-consistency workload (reference: cockroachdb's
+`sequential` and `comments` workloads,
+`cockroachdb/src/jepsen/cockroach/sequential.clj` and `comments.clj`,
+registry runner.clj:25-34): a writer creates keys k0, k1, k2, … of a
+chain *in order*; concurrent readers scan the chain in *reverse* order.
+Under sequential consistency any snapshot must contain a prefix of the
+chain — observing a later key while an earlier one is absent means some
+process saw writes out of program order (the "comments problem": a
+reply visible before the post it answers).
+
+Ops:
+    {f: "write", value: [chain, i]}        -> ok     (create key i)
+    {f: "read",  value: [chain, None]}     -> ok value [chain, [i…]]
+                                              (indices found, scanning
+                                               high → low)
+
+Checker: for every read, the set of observed indices must be downward
+closed (a prefix).  Gap detection is a vectorized mask comparison over
+the padded per-read index matrix.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+class ChainSource:
+    """Per-chain next-index counters; chains are sharded over writer
+    threads by the suite (sequential.clj splits keys over tables)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.next = {}
+
+    def take(self, chain) -> int:
+        with self.lock:
+            i = self.next.get(chain, 0)
+            self.next[chain] = i + 1
+            return i
+
+
+def writes(source: ChainSource, n_chains: int = 5):
+    def w(test, process):
+        chain = process % n_chains
+        return {"type": "invoke", "f": "write",
+                "value": [chain, source.take(chain)]}
+    return w
+
+
+def reads(n_chains: int = 5):
+    def r(test, process):
+        return {"type": "invoke", "f": "read",
+                "value": [process % n_chains, None]}
+    return r
+
+
+def generator(n_chains: int = 5):
+    src = ChainSource()
+    return gen.mix([writes(src, n_chains)] * 4 + [reads(n_chains)])
+
+
+class SequentialChecker(ck.Checker):
+    """Every read's index set must be a prefix of the chain
+    (sequential.clj checker / comments.clj checker)."""
+
+    def check(self, test, history, opts=None):
+        reads_ = [o for o in History(history)
+                  if o.is_ok and o.f == "read" and o.value is not None
+                  and o.value[1] is not None]
+        if not reads_:
+            return {"valid?": True, "read-count": 0, "errors": []}
+
+        width = max((len(o.value[1]) for o in reads_), default=0)
+        hi = max((max(o.value[1]) for o in reads_ if o.value[1]),
+                 default=-1)
+        if hi < 0:  # only empty reads: trivially prefixes
+            return {"valid?": True, "read-count": len(reads_),
+                    "errors": [], "width": 0}
+        # presence matrix: rows = reads, cols = chain indices
+        pres = np.zeros((len(reads_), hi + 1), dtype=bool)
+        for row, o in enumerate(reads_):
+            for i in o.value[1]:
+                pres[row, i] = True
+        counts = pres.sum(axis=1)
+        maxidx = np.where(counts > 0,
+                          (hi - np.argmax(pres[:, ::-1], axis=1)), -1)
+        # prefix <=> count == maxidx + 1
+        bad = np.nonzero(counts != maxidx + 1)[0]
+        errors = []
+        for row in bad:
+            o = reads_[row]
+            seen = sorted(o.value[1])
+            missing = [i for i in range(int(maxidx[row]) + 1)
+                       if not pres[row, i]]
+            errors.append({"op-index": o.index, "chain": o.value[0],
+                           "seen": seen, "missing": missing})
+        return {"valid?": not errors, "read-count": len(reads_),
+                "errors": errors, "width": int(width)}
+
+
+def checker():
+    return SequentialChecker()
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    n_chains = int(opts.get("chains", 5))
+    return {"checker": checker(), "generator": generator(n_chains)}

@@ -1,0 +1,57 @@
+"""Single-key ACID workload (reference: yugabyte's `single-key-acid`
+test, `yugabyte/src/yugabyte/single_key_acid.clj`, registry
+core.clj:1-60): per-key linearizable register driven through
+single-row transactional updates — write, read, and a CAS-style
+update-if-equals — over a small fixed key set, checked for
+linearizability per key.
+
+Ops carry independent [k, v] tuples like linearizable-register; the
+checker is the batched vmap-over-keys WGL kernel.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, models
+from jepsen_tpu.checker import timeline
+
+
+def w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    n = len(opts.get("nodes") or [1])
+    n_keys = int(opts.get("keys", 2))       # yugabyte uses a tiny key set
+    per_key_limit = opts.get("per-key-limit", 128)
+    mode = opts.get("checker-mode", "device")
+
+    if mode == "device":
+        checker = independent.batch_checker(models.cas_register())
+    else:
+        checker = independent.checker(ck.compose({
+            "linearizable": ck.linearizable(
+                {"model": models.cas_register()}),
+            "timeline": timeline.html_timeline(),
+        }))
+
+    return {
+        "checker": checker,
+        "generator": independent.concurrent_generator(
+            2 * n, iter(range(n_keys)),
+            lambda k: gen.limit(per_key_limit,
+                                gen.mix([w, r, r, cas]))),
+    }

@@ -1,0 +1,71 @@
+"""Upsert workload (reference: dgraph's `upsert` workload,
+`dgraph/src/jepsen/dgraph/upsert.clj`, registry core.clj:25-37):
+many clients concurrently upsert the *same* logical key; an upsert
+reads-or-creates, so for each key at most ONE entity may ever be
+created — two distinct ids for one key means the read-check-create
+raced.
+
+Ops:
+    {f: "upsert", value: [k, None]}   -> ok value [k, id] (id created
+                                         or found)
+    {f: "read",   value: [k, None]}   -> ok value [k, [id…]]
+
+Checker: per key, the union of ids seen by reads and returned by
+upserts must have cardinality ≤ 1.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+
+
+def upsert_op(k):
+    def g(test, process):
+        return {"type": "invoke", "f": "upsert", "value": [k, None]}
+    return g
+
+
+def read_op(k):
+    def g(test, process):
+        return {"type": "invoke", "f": "read", "value": [k, None]}
+    return g
+
+
+def generator(keys=range(8)):
+    gens = []
+    for k in keys:
+        gens += [upsert_op(k)] * 3 + [read_op(k)]
+    return gen.mix(gens)
+
+
+class UpsertChecker(ck.Checker):
+    """At most one distinct id per key (upsert.clj checker)."""
+
+    def check(self, test, history, opts=None):
+        ids = defaultdict(set)
+        from jepsen_tpu.history import History
+        for o in History(history):
+            if not o.is_ok or o.value is None:
+                continue
+            k, v = o.value
+            if o.f == "upsert" and v is not None:
+                ids[k].add(v)
+            elif o.f == "read" and v:
+                ids[k].update(v)
+        dups = {k: sorted(v) for k, v in ids.items() if len(v) > 1}
+        return {"valid?": not dups,
+                "key-count": len(ids),
+                "duplicates": dups}
+
+
+def checker():
+    return UpsertChecker()
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    keys = range(int(opts.get("keys", 8)))
+    return {"checker": checker(), "generator": generator(keys)}

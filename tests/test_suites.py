@@ -222,7 +222,7 @@ class TestSets:
         ]).index()
         r = ck.set_full().check({}, h, {})
         assert r["valid?"] is False
-        assert 1 in r.get("lost", [1])
+        assert 1 in r["lost"]
 
 
 class TestDirtyReads:
