@@ -137,14 +137,30 @@ class Linearizable(Checker):
         self.kw = kw
 
     def check(self, test, history, opts=None):
-        from jepsen_tpu.ops import wgl, wgl_cpu
+        from jepsen_tpu.ops import wgl, wgl_cpu, wgl_seg
 
         algo = self.algorithm
         spec = self.model.device_spec()
         if algo == "auto":
             algo = "device" if spec is not None else "cpu"
         if algo == "device":
-            a = wgl.check(self.model, history, **self.kw)
+            # Fastest engine first: the segment-parallel transfer-matrix
+            # kernel (crash-free histories, enumerable state spaces),
+            # then the serial frontier kernel for everything else.
+            seg_keys = ("max_states", "max_open_bits", "localize",
+                        "target_returns_per_segment")
+            ser_keys = ("frontier_sizes", "pad")
+            unknown = set(self.kw) - set(seg_keys) - set(ser_keys)
+            if unknown:
+                raise TypeError(
+                    f"unknown linearizable checker option(s): "
+                    f"{sorted(unknown)}")
+            seg_kw = {k: v for k, v in self.kw.items() if k in seg_keys}
+            ser_kw = {k: v for k, v in self.kw.items() if k in ser_keys}
+            try:
+                a = wgl_seg.check(self.model, history, **seg_kw)
+            except wgl_seg.Unsupported:
+                a = wgl.check(self.model, history, **ser_kw)
         elif algo == "cpu":
             a = wgl_cpu.check(self.model, history, **self.kw)
         else:

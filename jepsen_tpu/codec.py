@@ -189,6 +189,12 @@ class _Reader:
 
     def _read_atom(self) -> Any:
         tok = self._read_token()
+        if not tok:
+            # A delimiter where an atom was expected (e.g. "[1 2)") —
+            # raising here keeps malformed input from looping forever.
+            raise ValueError(
+                f"unexpected {self.s[self.i:self.i + 1]!r} at "
+                f"position {self.i}")
         if tok == "nil":
             return None
         if tok == "true":

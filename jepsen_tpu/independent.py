@@ -255,27 +255,35 @@ def checker(sub_checker: ck.Checker) -> IndependentChecker:
 
 class BatchedLinearizableChecker(ck.Checker):
     """The TPU-native independent checker: every per-key subhistory is
-    packed into one columnar batch and the WGL frontier search runs as a
-    single `vmap`-over-keys device program, shardable over a mesh
-    (ops/wgl_batch.py).  Keys whose frontier overflows the batched
-    kernel's fixed size escalate automatically to the adaptive
-    single-history kernel."""
+    one lane of a single device program, shardable over a mesh.
+
+    Engine order mirrors checker.Linearizable: the bitmap batch kernel
+    first (ops/wgl_seg.check_many — dense configuration space, no
+    sorting, exact; crash-free keys with small state spaces), whose
+    per-key fallback escalates out-of-scope keys to the sorted frontier
+    kernel (ops/wgl) and then the CPU oracle.  A model with no device
+    spec at all is checked entirely by the CPU oracle, key by key."""
 
     def __init__(self, model, frontier_size: int = 256, mesh=None):
         self.model = model
-        self.frontier_size = frontier_size
+        self.frontier_size = frontier_size  # advisory; kept for API compat
         self.mesh = mesh
 
     def check(self, test, history, opts=None):
-        from jepsen_tpu.ops import wgl_batch
+        from jepsen_tpu.ops import wgl_cpu, wgl_seg
 
         ks = sorted(history_keys(history), key=repr)
         if not ks:
             return {"valid?": True, "results": {}, "failures": []}
         subs = [subhistory(k, history) for k in ks]
-        per_key = wgl_batch.check_many(
-            self.model, subs, frontier_size=self.frontier_size,
-            mesh=self.mesh)
+        try:
+            per_key = wgl_seg.check_many(
+                self.model, subs, mesh=self.mesh,
+                mesh_axis=self.mesh.axis_names[0] if self.mesh else None)
+        except wgl_seg.Unsupported:
+            # Only raised when the model has no device spec (wgl_batch
+            # would need one too) — exact CPU oracle per key.
+            per_key = [wgl_cpu.check(self.model, s) for s in subs]
         results = dict(zip(ks, per_key))
         failures = [k for k, r in results.items() if r["valid?"] is not True]
         return {"valid?": ck.merge_valid(r["valid?"]

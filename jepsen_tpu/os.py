@@ -4,6 +4,22 @@
 from __future__ import annotations
 
 
+def setup_hostfile(test, node) -> None:
+    """Write /etc/hosts mapping every test node — the shared contract of
+    debian.clj:12-30 / smartos.clj setup-hostfile! (one implementation;
+    the per-OS modules re-export it)."""
+    from jepsen_tpu import control as c
+    from jepsen_tpu.control import lit
+
+    lines = ["127.0.0.1 localhost"]
+    for n in test.get("nodes") or []:
+        ip = c.execute(lit(f"getent hosts {c.escape(n)} | head -n1 "
+                           "| cut -d' ' -f1"), check=False) or n
+        lines.append(f"{ip.strip() or n} {n}")
+    c.upload_str("\n".join(lines) + "\n", "/etc/hosts.jepsen")
+    c.execute(lit("cp /etc/hosts.jepsen /etc/hosts"))
+
+
 class OS:
     def setup(self, test, node) -> None:
         pass

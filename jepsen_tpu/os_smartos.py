@@ -20,16 +20,10 @@ BASE_PACKAGES = ["wget", "curl", "unzip", "gtar", "bzip2", "rsyslog",
                  "logrotate", "gcc13"]
 
 
-def setup_hostfile(test, node) -> None:
-    """Write /etc/hosts mapping every test node (smartos.clj
-    setup-hostfile! — same contract as debian.clj:12-30)."""
-    lines = ["127.0.0.1 localhost"]
-    for n in test.get("nodes") or []:
-        ip = c.execute(lit(f"getent hosts {c.escape(n)} | head -n1 "
-                           "| cut -d' ' -f1"), check=False) or n
-        lines.append(f"{ip.strip() or n} {n}")
-    c.upload_str("\n".join(lines) + "\n", "/etc/hosts.jepsen")
-    c.execute(lit("cp /etc/hosts.jepsen /etc/hosts"))
+# Write /etc/hosts mapping every test node (smartos.clj setup-hostfile!
+# — same contract as debian.clj:12-30); shared implementation in
+# jepsen_tpu.os.
+from jepsen_tpu.os import setup_hostfile  # noqa: F401,E402
 
 
 def installed(pkgs: Iterable[str]) -> set:

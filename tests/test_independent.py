@@ -210,6 +210,21 @@ def test_batched_independent_checker():
     assert r["results"][0]["valid?"] is True
 
 
+def test_batched_independent_checker_no_device_spec():
+    """A model without a device spec degrades to the per-key CPU
+    oracle instead of raising."""
+    h = []
+    for k in range(2):
+        sub = make_register_history(k, 12, seed=k)
+        for o in sub:
+            h.append(o.assoc(value=ind.KV(k, o.value)))
+    h = History(h).index()
+    c = ind.batch_checker(models.NoOp())
+    r = c.check({}, h, {})
+    assert r["valid?"] is True
+    assert set(r["results"]) == {0, 1}
+
+
 def test_batched_escalation_on_overflow():
     """A frontier of 1 overflows instantly; lanes must escalate to the
     adaptive kernel and still produce correct verdicts."""
