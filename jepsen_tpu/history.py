@@ -95,8 +95,16 @@ class Op:
         return self.type == INFO
 
     def to_dict(self) -> dict:
+        v = self.value
+        if type(v).__name__ == "KV" and isinstance(v, tuple):
+            # Tag independent-key tuples so they survive the JSON
+            # round-trip — the reference registers a custom Fressian
+            # handler for MapEntry for exactly this (store.clj:28-123);
+            # without it, `analyze` on a stored keyed history finds no
+            # keys and trivially passes.
+            v = {"__kv__": [v[0], v[1]]}
         d = {"index": self.index, "process": self.process, "type": self.type,
-             "f": self.f, "value": self.value, "time": self.time}
+             "f": self.f, "value": v, "time": self.time}
         if self.error is not None:
             d["error"] = self.error
         d.update(self.extra)
@@ -108,6 +116,10 @@ class Op:
         kw = {k: d.pop(k) for k in
               ("process", "type", "f", "value", "time", "index", "error")
               if k in d}
+        v = kw.get("value")
+        if isinstance(v, dict) and set(v) == {"__kv__"}:
+            from jepsen_tpu.independent import KV
+            kw["value"] = KV(*v["__kv__"])
         return cls(extra=d, **kw)
 
     def __str__(self):

@@ -2,4 +2,27 @@
 `etcd/src/jepsen/etcd.clj`, `cockroachdb/src/jepsen/cockroach/runner.clj`).
 
 Each suite packages DB automation + a client + workloads + a nemesis
-menu + a CLI main.  `etcd` is the canonical template."""
+menu + a CLI main.  `etcd` is the canonical template; `cockroach` is
+the registry-driven template (workload + nemesis registries, named
+nemesis composition).
+
+`SUITES` maps suite names to the module path holding its `main`;
+modules import lazily so one suite's deps never block another."""
+
+from __future__ import annotations
+
+import importlib
+
+SUITES = {
+    "etcd": "jepsen_tpu.suites.etcd",
+    "cockroach": "jepsen_tpu.suites.cockroach",
+}
+
+
+def main_for(name: str):
+    """Resolve a suite's CLI entry point by name."""
+    try:
+        mod = SUITES[name]
+    except KeyError:
+        raise ValueError(f"unknown suite {name!r}; one of {sorted(SUITES)}")
+    return importlib.import_module(mod).main

@@ -1,0 +1,1254 @@
+"""CockroachDB test suite — the reference's richest suite (2,441 LoC
+across `cockroachdb/src/jepsen/cockroach/{runner,nemesis,client,auto,
+bank,register,comments,monotonic,sequential,sets,adya}.clj`), providing:
+
+  * auto          — cluster automation: tarball install, start/kill/
+                    wipe, clock reset (auto.clj)
+  * SQL client    — a thin connection boundary with the reference's
+                    transaction-retry semantics (client.clj
+                    with-txn-retry: retry on serialization-conflict
+                    "restart transaction" errors); the connection
+                    factory is injectable so the whole suite runs
+                    in-process against an in-memory SQL engine
+  * nemesis menu  — named nemesis maps {name during final client
+                    clocks} and their composition (nemesis.clj:62-107
+                    compose), with the full skew ladder: subcritical
+                    200 ms, critical 250 ms, big 500 ms, huge 5 s,
+                    strobe (nemesis.clj:252-266), plus parts/majring/
+                    startstop/startkill/split and the slowing/
+                    restarting wrappers (nemesis.clj:153-200)
+  * workloads     — bank, bank-multitable, register, comments,
+                    monotonic, sequential, sets, g2 — the registry of
+                    runner.clj:25-34
+  * runner        — CLI with test/nemesis registries and --nemesis2
+                    mixing (runner.clj:42-56,70-76)
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from typing import Callable, Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, models, nemesis as nem, net
+from jepsen_tpu import nemesis_time as nt
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.control import lit
+from jepsen_tpu.history import History
+from jepsen_tpu.workloads import adya as adya_wl
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import monotonic as monotonic_wl
+from jepsen_tpu.workloads import sequential as sequential_wl
+from jepsen_tpu.workloads import sets as sets_wl
+
+# ---------------------------------------------------------------------------
+# auto — cluster automation (auto.clj)
+# ---------------------------------------------------------------------------
+
+VERSION = "23.1.11"
+URL = (f"https://binaries.cockroachdb.com/"
+       f"cockroach-v{VERSION}.linux-amd64.tgz")
+DIR = "/opt/cockroach"
+STORE = f"{DIR}/data"
+LOGFILE = f"{DIR}/cockroach.log"
+PIDFILE = f"{DIR}/cockroach.pid"
+PORT = 26257
+HTTP_PORT = 8080
+BIN = f"{DIR}/cockroach"
+
+nemesis_delay = 5       # seconds between interruptions (nemesis.clj:20)
+nemesis_duration = 5    # seconds of an interruption (nemesis.clj:23)
+
+
+def install(test, node) -> None:
+    """Fetch + unpack the release tarball (auto.clj install!)."""
+    cu.install_archive(URL, DIR)
+
+
+def start(test, node) -> None:
+    """Start the server daemon joined to every node (auto.clj start!)."""
+    join = ",".join(f"{n}:{PORT}" for n in test.get("nodes") or [])
+    cu.start_daemon(
+        BIN, "start", "--insecure",
+        "--store", STORE,
+        "--listen-addr", f"{node}:{PORT}",
+        "--http-addr", f"{node}:{HTTP_PORT}",
+        "--join", join,
+        "--background",
+        chdir=DIR, logfile=LOGFILE, pidfile=PIDFILE)
+
+
+def kill(test, node) -> None:
+    """SIGKILL the server (auto.clj kill!)."""
+    cu.grepkill("cockroach")
+
+
+def wipe(test, node) -> None:
+    c.execute("rm", "-rf", STORE, check=False)
+
+
+def reset_clocks(test) -> None:
+    """auto.clj reset-clocks! — fan a clock reset to every node."""
+    c.on_nodes(test, lambda t, n: nt.reset_time())
+
+
+class CockroachDB(db_mod.DB, db_mod.LogFiles):
+    """DB lifecycle (auto.clj + cockroach.clj db)."""
+
+    def setup(self, test, node):
+        install(test, node)
+        nt.install(test, node)
+        start(test, node)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"curl -sf http://{node}:{HTTP_PORT}/health "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+        # One node initialises the cluster (auto.clj init!).
+        if node == (test.get("nodes") or [node])[0]:
+            c.execute(BIN, "init", "--insecure",
+                      "--host", f"{node}:{PORT}", check=False)
+
+    def teardown(self, test, node):
+        kill(test, node)
+        wipe(test, node)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# ---------------------------------------------------------------------------
+# SQL client boundary (client.clj)
+# ---------------------------------------------------------------------------
+
+class Retryable(Exception):
+    """A serialization conflict the client should retry — cockroach
+    signals these with SQLSTATE 40001 / "restart transaction"
+    (client.clj retryable?)."""
+
+
+class Indeterminate(Exception):
+    """The op may or may not have been applied (timeouts, node died
+    mid-commit) — becomes an :info op."""
+
+
+class Definite(Exception):
+    """The op definitely did not happen — becomes a :fail op."""
+
+
+class ShellConn:
+    """Production connection: drives `cockroach sql` on the node over
+    the control plane.  Tests inject an in-memory engine instead.
+
+    The connection protocol the workload clients consume:
+      sql(stmt, params) -> rows         one autocommitted statement
+      txn([stmts])      -> rows         statements applied atomically
+      atomically(body)  -> result       OPTIONAL interactive txn:
+                                        body(run) issues statements via
+                                        run(sql) inside one txn that
+                                        rolls back on exception.
+                                        One-shot conns (this one) omit
+                                        it; clients fall back to
+                                        single-statement SQL forms.
+      ts_expr           (attr)          SQL expression for the DB's
+                                        own txn timestamp
+      close()
+    """
+
+    ts_expr = "cluster_logical_timestamp()::INT8"
+
+    def __init__(self, node: str):
+        self.node = node
+        # Client invokes run on worker threads with no control session
+        # bound; hold one open for this connection's lifetime.
+        self._session = c.session(node)
+
+    def sql(self, stmt: str, params: tuple = ()) -> list:
+        # Single-pass placeholder substitution: splitting first means a
+        # '?' inside a parameter value can't be mistaken for a later
+        # placeholder.
+        parts = stmt.split("?")
+        if len(parts) - 1 != len(params) and params:
+            raise ValueError(
+                f"{len(parts) - 1} placeholders, {len(params)} params")
+        out = [parts[0]]
+        for p, nxt in zip(params, parts[1:]):
+            v = "NULL" if p is None else (
+                str(p) if isinstance(p, (int, float))
+                else "'" + str(p).replace("'", "''") + "'")
+            out += [v, nxt]
+        q = "".join(out) if params else stmt
+        with c.with_session(self.node, self._session):
+            out = c.execute(BIN, "sql", "--insecure",
+                            "--host", f"{self.node}:{PORT}",
+                            "--format", "tsv", "-e", q)
+        rows = [line.split("\t")
+                for line in (out or "").splitlines()[1:] if line]
+        return rows
+
+    def txn(self, stmts: list) -> list:
+        """Run statements atomically; cockroach retries internally when
+        possible, else surfaces a 40001 we map to Retryable."""
+        try:
+            return self.sql("BEGIN; " + "; ".join(stmts) + "; COMMIT")
+        except c.RemoteError as e:  # pragma: no cover - needs cluster
+            msg = str(e)
+            if "40001" in msg or "restart transaction" in msg:
+                raise Retryable(msg) from e
+            raise
+
+    def close(self):
+        self._session.close()
+
+
+txn_retry_delay = 0.001
+txn_retry_max = 30.0
+
+
+def with_txn_retry(f: Callable):
+    """client.clj with-txn-retry — exponential backoff with jitter on
+    serialization conflicts, bounded by txn_retry_max seconds."""
+    deadline = time.monotonic() + txn_retry_max
+    delay = txn_retry_delay
+    while True:
+        try:
+            return f()
+        except Retryable:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(delay * (1 + random.random()))
+            delay = min(delay * 2, 1.0)
+
+
+def exception_to_op(op, e: Exception):
+    """client.clj with-exception->op: map client exceptions onto the
+    op-type taxonomy.  Only provably-not-applied failures may become
+    :fail — a connection that dies mid-flight is indeterminate (the
+    write may have committed server-side), so generic ConnectionError/
+    OSError degrade to :info, matching the runner's default for unknown
+    exceptions (core.clj:204-220)."""
+    if isinstance(e, Indeterminate):
+        return op.assoc(type="info", error=str(e))
+    if isinstance(e, (Definite, Retryable)):
+        return op.assoc(type="fail", error=str(e))
+    if isinstance(e, ConnectionRefusedError):
+        # refused: the request never reached the server
+        return op.assoc(type="fail", error=str(e))
+    if isinstance(e, (ConnectionError, OSError)):
+        return op.assoc(type="info", error=str(e))
+    raise e
+
+
+_keyrange_lock = threading.Lock()
+
+
+def update_keyrange(test, table: str, k) -> None:
+    """Track the live key range per table so the split nemesis can aim
+    (cockroach.clj update-keyrange!)."""
+    with _keyrange_lock:
+        kr = test.setdefault("keyrange", {})
+        kr.setdefault(table, set()).add(k)
+
+
+class SQLClient(client_mod.Client):
+    """Base for every workload client: holds a connection built by the
+    injectable factory (test["sql-factory"] or the constructor's),
+    wraps invoke in the exception taxonomy."""
+
+    def __init__(self, conn_factory=ShellConn):
+        self.conn_factory = conn_factory
+        self.conn = None
+        self.node = None
+
+    def open(self, test, node):
+        out = type(self)(test.get("sql-factory") or self.conn_factory)
+        out.node = node
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            return self._invoke(test, op)
+        except Exception as e:           # noqa: BLE001 - taxonomy map
+            return exception_to_op(op, e)
+
+    def _invoke(self, test, op):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Named nemesis maps + composition (nemesis.clj)
+# ---------------------------------------------------------------------------
+
+def nemesis_no_gen() -> dict:
+    return {"during": gen.void, "final": gen.void}
+
+
+def nemesis_single_gen() -> dict:
+    """sleep delay / start / sleep duration / stop, forever
+    (nemesis.clj:32-38)."""
+    return {"during": gen.start_stop(nemesis_delay, nemesis_duration),
+            "final": gen.once({"type": "info", "f": "stop"})}
+
+
+def nemesis_double_gen() -> dict:
+    """Interleaved start1/start2/stop1/stop2 ladder (nemesis.clj:40-60)."""
+    half = nemesis_duration / 2
+
+    def steps():
+        while True:
+            for s in ({"sleep": nemesis_delay},
+                      {"type": "info", "f": "start1"}, {"sleep": half},
+                      {"type": "info", "f": "start2"}, {"sleep": half},
+                      {"type": "info", "f": "stop1"}, {"sleep": half},
+                      {"type": "info", "f": "stop2"},
+                      {"sleep": nemesis_delay},
+                      {"type": "info", "f": "start2"}, {"sleep": half},
+                      {"type": "info", "f": "start1"}, {"sleep": half},
+                      {"type": "info", "f": "stop2"}, {"sleep": half},
+                      {"type": "info", "f": "stop1"}):
+                yield (gen.sleep(s["sleep"]) if "sleep" in s
+                       else lambda t, p, _s=s: dict(_s))
+
+    return {"during": gen.gseq(steps()),
+            "final": gen.gseq([
+                lambda t, p: {"type": "info", "f": "stop1"},
+                lambda t, p: {"type": "info", "f": "stop2"}])}
+
+
+def _tag_f(name: str, source):
+    """Wrap a generator so emitted ops carry f=(name, inner-f) — the
+    namespacing compose() uses for routing (nemesis.clj:80-103)."""
+    def retag(op):
+        if op is None:
+            return None
+        if isinstance(op, dict):
+            out = dict(op)
+            out["f"] = (name, out.get("f"))
+            return out
+        return op.assoc(f=(name, op.f))
+    return gen.gmap(retag, source)
+
+
+def compose_named(nemeses) -> dict:
+    """nemesis.clj compose :62-107: merge named nemesis maps into one
+    {name clocks client during final}, ops tagged (name, f) and routed
+    back to their owners."""
+    nemeses = [n for n in nemeses if n]
+    names = [n["name"] for n in nemeses]
+    assert len(set(names)) == len(names), f"duplicate nemeses: {names}"
+    routes = {}
+    for nm in nemeses:
+        def route(f, _name=nm["name"]):
+            if isinstance(f, tuple) and len(f) == 2 and f[0] == _name:
+                return f[1]
+            return None
+        routes[route] = nm["client"]
+    return {
+        "name": "+".join(names),
+        "clocks": any(n.get("clocks") for n in nemeses),
+        "client": nem.compose(routes),
+        "during": gen.mix([_tag_f(n["name"], n["during"])
+                           for n in nemeses]),
+        "final": gen.concat(*[_tag_f(n["name"], n["final"])
+                              for n in nemeses]),
+    }
+
+
+def none() -> dict:
+    """nemesis.clj none :111-115."""
+    return dict(nemesis_no_gen(), name="blank", client=nem.Noop(),
+                clocks=False)
+
+
+def parts() -> dict:
+    """Random-halves partition (nemesis.clj parts :119-124)."""
+    return dict(nemesis_single_gen(), name="parts",
+                client=nem.partition_random_halves(), clocks=False)
+
+
+def majring() -> dict:
+    """nemesis.clj majring :145-150."""
+    return dict(nemesis_single_gen(), name="majring",
+                client=nem.partition_majorities_ring(), clocks=False)
+
+
+def _take_random(n: int):
+    return lambda nodes: random.sample(list(nodes), min(n, len(nodes)))
+
+
+def startstop(n: int = 1) -> dict:
+    """SIGSTOP/SIGCONT n random servers (nemesis.clj startstop
+    :127-133)."""
+    return dict(nemesis_single_gen(),
+                name="startstop" + (str(n) if n > 1 else ""),
+                client=nem.hammer_time("cockroach",
+                                       targeter=_take_random(n)),
+                clocks=False)
+
+
+def startkill(n: int = 1) -> dict:
+    """Kill + restart n random servers (nemesis.clj startkill
+    :135-142).  On the :start op the nemesis KILLS the targets; the
+    :stop op restarts them — node_start_stopper's args are
+    (targeter, fn-on-start, fn-on-stop)."""
+    return dict(nemesis_single_gen(),
+                name="startkill" + (str(n) if n > 1 else ""),
+                client=nem.node_start_stopper(_take_random(n),
+                                              kill, start),
+                clocks=False)
+
+
+class Slowing(nem.Nemesis):
+    """Wrap a nemesis: slow the network before :start, restore after
+    :stop (nemesis.clj slowing :153-175)."""
+
+    def __init__(self, inner: nem.Nemesis, dt: float):
+        self.inner = inner
+        self.dt = dt
+
+    def setup(self, test):
+        net_ = test.get("net")
+        if net_:
+            net_.fast(test)
+        self.inner = self.inner.setup(test) or self.inner
+        return self
+
+    def invoke(self, test, op):
+        net_ = test.get("net")
+        if op.f == "start":
+            if net_:
+                net_.slow(test, mean=self.dt * 1000, variance=1)
+            return self.inner.invoke(test, op)
+        if op.f == "stop":
+            try:
+                return self.inner.invoke(test, op)
+            finally:
+                if net_:
+                    net_.fast(test)
+        return self.inner.invoke(test, op)
+
+    def teardown(self, test):
+        net_ = test.get("net")
+        if net_:
+            net_.fast(test)
+        self.inner.teardown(test)
+
+
+class Restarting(nem.Nemesis):
+    """Wrap a nemesis: after :stop completes, restart servers on every
+    node (nemesis.clj restarting :178-200)."""
+
+    def __init__(self, inner: nem.Nemesis):
+        self.inner = inner
+
+    def setup(self, test):
+        self.inner = self.inner.setup(test) or self.inner
+        return self
+
+    def invoke(self, test, op):
+        out = self.inner.invoke(test, op)
+        if op.f == "stop":
+            def restart(t, node):
+                try:
+                    start(t, node)
+                    return "started"
+                except Exception as e:   # noqa: BLE001
+                    return str(e)
+            statuses = c.on_nodes(test, restart)
+            return out.assoc(value=[out.value, statuses])
+        return out
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+
+class BumpTime(nem.Nemesis):
+    """On :start, jump the clock by dt seconds on a random half of the
+    nodes; on :stop, reset clocks (nemesis.clj bump-time :231-250)."""
+
+    def __init__(self, dt: float):
+        self.dt = dt
+
+    def setup(self, test):
+        reset_clocks(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            def bump(t, node):
+                if random.random() < 0.5:
+                    nt.bump_time(self.dt * 1000)
+                    return self.dt
+                return 0
+            return op.assoc(value=c.on_nodes(test, bump))
+        if op.f == "stop":
+            return op.assoc(value=c.on_nodes(
+                test, lambda t, n: nt.reset_time()))
+        return op
+
+    def teardown(self, test):
+        reset_clocks(test)
+
+
+class StrobeTime(nem.Nemesis):
+    """Strobe the clock between now and now+delta every period ms for
+    duration s (nemesis.clj strobe-time :203-224)."""
+
+    def __init__(self, delta_ms: float, period_ms: float,
+                 duration_s: float):
+        self.args = (delta_ms, period_ms, duration_s)
+
+    def setup(self, test):
+        reset_clocks(test)
+        return self
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            return op.assoc(value=c.on_nodes(
+                test, lambda t, n: nt.strobe_time(*self.args)))
+        return op.assoc(value=None)
+
+    def teardown(self, test):
+        reset_clocks(test)
+
+
+def skew(name: str, offset_s: float) -> dict:
+    """nemesis.clj skew :259-264."""
+    return dict(nemesis_single_gen(), name=name,
+                client=Restarting(BumpTime(offset_s)), clocks=True)
+
+
+def small_skews() -> dict:
+    return skew("small-skews", 0.100)
+
+
+def subcritical_skews() -> dict:
+    return skew("subcritical-skews", 0.200)
+
+
+def critical_skews() -> dict:
+    """250 ms ≈ cockroach's default max clock offset (nemesis.clj)."""
+    return skew("critical-skews", 0.250)
+
+
+def big_skews() -> dict:
+    out = skew("big-skews", 0.5)
+    out["client"] = Slowing(out["client"], 0.5)
+    return out
+
+
+def huge_skews() -> dict:
+    out = skew("huge-skews", 5.0)
+    out["client"] = Slowing(out["client"], 5.0)
+    return out
+
+
+def strobe_skews() -> dict:
+    """nemesis.clj strobe-skews :252-258 — no sleeps: the strobe itself
+    takes time."""
+    def steps():
+        while True:
+            yield lambda t, p: {"type": "info", "f": "start"}
+            yield lambda t, p: {"type": "info", "f": "stop"}
+    return {"name": "strobe-skews",
+            "during": gen.gseq(steps()),
+            "final": gen.once({"type": "info", "f": "stop"}),
+            "client": Restarting(StrobeTime(200, 10, 10)),
+            "clocks": True}
+
+
+class SplitNemesis(nem.Nemesis):
+    """Split a range just below a recently-written key, using the
+    keyrange the clients report (nemesis.clj split-nemesis :268-305)."""
+
+    def __init__(self, conn_factory=ShellConn):
+        self.conn_factory = conn_factory
+        self.already: dict = {}
+
+    def setup(self, test):
+        self.conn_factory = test.get("sql-factory") or self.conn_factory
+        return self
+
+    def invoke(self, test, op):
+        kr = dict(test.get("keyrange") or {})
+        if not kr:
+            return op.assoc(value="no-keyrange")
+        table, ks = random.choice(list(kr.items()))
+        ks = set(ks) - self.already.get(table, set())
+        if not ks:
+            return op.assoc(value="nothing-to-split")
+        k = next(iter(ks))
+        conn = self.conn_factory(random.choice(test["nodes"]))
+        try:
+            split = getattr(conn, "split", None)
+            if split is not None:
+                split(table, k)
+            else:
+                conn.sql(f"ALTER TABLE {table} SPLIT AT VALUES (?)",
+                         (k,))
+            self.already.setdefault(table, set()).add(k)
+            return op.assoc(value=["split", table, k])
+        finally:
+            conn.close()
+
+    def teardown(self, test):
+        pass
+
+
+def split() -> dict:
+    """nemesis.clj split :307-313."""
+    return dict(nemesis_single_gen(), name="split",
+                client=SplitNemesis(), clocks=False)
+
+
+nemeses = {
+    "none": none,
+    "parts": parts,
+    "majority-ring": majring,
+    "small-skews": small_skews,
+    "subcritical-skews": subcritical_skews,
+    "critical-skews": critical_skews,
+    "big-skews": big_skews,
+    "huge-skews": huge_skews,
+    "strobe-skews": strobe_skews,
+    "split": split,
+    "start-stop": lambda: startstop(1),
+    "start-stop-2": lambda: startstop(2),
+    "start-kill": lambda: startkill(1),
+    "start-kill-2": lambda: startkill(2),
+}
+
+
+# ---------------------------------------------------------------------------
+# Workload clients
+# ---------------------------------------------------------------------------
+
+_table_lock = threading.Lock()
+
+
+def _once(test, tag: str) -> bool:
+    """True exactly once per (test run, tag) — the table-created? atom
+    pattern every cockroach client uses.  State lives in the shared
+    test map itself, so back-to-back runs in one process can't collide
+    (an id(test)-keyed global would break when a later test dict reuses
+    a garbage-collected address)."""
+    done = test.setdefault("_once-tags", set())
+    if tag in done:
+        return False
+    done.add(tag)
+    return True
+
+
+def ensure_table(conn, test, ddl: str, table: str) -> None:
+    """Create a table exactly once per test run."""
+    with _table_lock:
+        if _once(test, f"table:{table}"):
+            conn.sql(ddl)
+
+
+class RegisterClient(SQLClient):
+    """register.clj: independent keyed registers in one `test` table;
+    read / write / cas with txn retry."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS test (id INT PRIMARY KEY, val INT)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "test")
+        k, v = op.value
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT val FROM test WHERE id = ?", (k,)))
+            val = int(rows[0][0]) if rows else None
+            return op.assoc(type="ok", value=independent.tuple_(k, val))
+        if op.f == "write":
+            def w():
+                self.conn.txn([
+                    f"UPSERT INTO test (id, val) VALUES ({k}, {v})"])
+            with_txn_retry(w)
+            update_keyrange(test, "test", k)
+            return op.assoc(type="ok")
+        if op.f == "cas":
+            old, new = v
+
+            def do_cas():
+                rows = self.conn.txn([
+                    f"UPDATE test SET val = {new} "
+                    f"WHERE id = {k} AND val = {old} RETURNING val"])
+                return bool(rows)
+            ok = with_txn_retry(do_cas)
+            return op.assoc(type="ok" if ok else "fail")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class BankClient(SQLClient):
+    """bank.clj client: transfers move balance between account rows in
+    one serializable txn.  The single-table and multitable variants
+    differ only in where an account's row lives, so `_loc` is the one
+    point of variation (bank.clj vs its multitable-test)."""
+
+    def _loc(self, a) -> tuple:
+        """(table, where-clause) of account a's balance row."""
+        return "accounts", f"id = {a}"
+
+    def _ddl(self, test):
+        ensure_table(self.conn, test,
+                     "CREATE TABLE IF NOT EXISTS accounts "
+                     "(id INT PRIMARY KEY, balance INT)", "accounts")
+
+    def _read_stmts(self, test) -> list:
+        return ["SELECT id, balance FROM accounts"]
+
+    def _seed_stmt(self, a, bal) -> str:
+        return (f"INSERT INTO accounts (id, balance) VALUES ({a}, {bal}) "
+                "ON CONFLICT (id) DO NOTHING")
+
+    def _invoke(self, test, op):
+        self._ddl(test)
+        self._seed(test)
+        if op.f == "read":
+            rows = with_txn_retry(
+                lambda: self.conn.txn(self._read_stmts(test)))
+            return op.assoc(type="ok",
+                            value={int(r[0]): int(r[1]) for r in rows})
+        if op.f == "transfer":
+            v = op.value
+            frm, to, amt = v["from"], v["to"], v["amount"]
+            neg_ok = bool(test.get("negative-balances?"))
+            tf, wf = self._loc(frm)
+            tt, wt = self._loc(to)
+
+            def xfer():
+                atomically = getattr(self.conn, "atomically", None)
+                if atomically is not None:
+                    # Interactive txn (the reference's with-txn JDBC
+                    # path): read, check, debit, credit — one txn.
+                    def body(run):
+                        rows = run(f"SELECT balance FROM {tf} "
+                                   f"WHERE {wf}")
+                        bal = int(rows[0][0]) if rows else None
+                        if bal is None or (bal < amt and not neg_ok):
+                            raise Definite(
+                                f"insufficient balance {bal}")
+                        run(f"UPDATE {tf} SET balance = balance - {amt} "
+                            f"WHERE {wf}")
+                        run(f"UPDATE {tt} SET balance = balance + {amt} "
+                            f"WHERE {wt}")
+                    atomically(body)
+                else:
+                    # One-shot conns (cockroach sql -e): a single CTE
+                    # statement where the credit applies only if the
+                    # guarded debit matched.
+                    guard = ("" if neg_ok
+                             else f" AND balance >= {amt}")
+                    rows = self.conn.txn([
+                        f"WITH debit AS (UPDATE {tf} "
+                        f"SET balance = balance - {amt} "
+                        f"WHERE {wf}{guard} RETURNING id) "
+                        f"UPDATE {tt} SET balance = balance + {amt} "
+                        f"WHERE {wt} "
+                        "AND EXISTS (SELECT 1 FROM debit) RETURNING id"])
+                    if not rows:
+                        raise Definite("insufficient balance")
+            with_txn_retry(xfer)
+            return op.assoc(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+    def _seed(self, test):
+        with _table_lock:
+            if not _once(test, "bank-seed"):
+                return
+            accounts = test["accounts"]
+            per = test["total-amount"] // len(accounts)
+            rem = test["total-amount"] - per * len(accounts)
+            for i, a in enumerate(accounts):
+                self.conn.sql(
+                    self._seed_stmt(a, per + (rem if i == 0 else 0)))
+
+
+class MultiTableBankClient(BankClient):
+    """bank.clj multitable variant: one table per account — transfers
+    cross table boundaries (and thus shard ranges)."""
+
+    def _loc(self, a) -> tuple:
+        return f"accounts{a}", "id = 0"
+
+    def _ddl(self, test):
+        for a in test["accounts"]:
+            ensure_table(
+                self.conn, test,
+                f"CREATE TABLE IF NOT EXISTS accounts{a} "
+                "(id INT PRIMARY KEY, balance INT)", f"accounts{a}")
+
+    def _read_stmts(self, test) -> list:
+        return [f"SELECT {a}, balance FROM accounts{a}"
+                for a in test["accounts"]]
+
+    def _seed_stmt(self, a, bal) -> str:
+        return (f"INSERT INTO accounts{a} (id, balance) "
+                f"VALUES (0, {bal}) ON CONFLICT (id) DO NOTHING")
+
+
+class SetsClient(SQLClient):
+    """sets.clj: blind inserts of unique ints; one final read."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS sets (val INT PRIMARY KEY)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "sets")
+        if op.f == "add":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO sets (val) VALUES ({op.value})"))
+            update_keyrange(test, "sets", op.value)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = with_txn_retry(
+                lambda: self.conn.txn(["SELECT val FROM sets"]))
+            return op.assoc(type="ok",
+                            value=sorted(int(r[0]) for r in rows))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class MonotonicClient(SQLClient):
+    """monotonic.clj: inserts stamped with the DB's own transaction
+    timestamp; checker verifies timestamp order matches value order."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS mono "
+           "(val INT PRIMARY KEY, ts BIGINT, node INT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "mono")
+        if op.f == "add":
+            node_idx = (test["nodes"].index(self.node)
+                        if self.node in test["nodes"] else -1)
+
+            # The val MUST be assigned in the same atomic statement
+            # that inserts it (monotonic.clj invoke! :111-126): two
+            # clients may otherwise commit in the opposite order of
+            # their val acquisition and fake an inversion.  A single
+            # INSERT..SELECT reads max(val) and the DB's own timestamp
+            # atomically under serializable isolation.
+            ts_expr = getattr(self.conn, "ts_expr",
+                              "cluster_logical_timestamp()::INT8")
+            with_txn_retry(lambda: self.conn.txn([
+                "INSERT INTO mono (val, ts, node) "
+                f"SELECT COALESCE(MAX(val), 0) + 1, {ts_expr}, "
+                f"{node_idx} FROM mono"]))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.txn(
+                ["SELECT val, ts, node FROM mono"]))
+            return op.assoc(type="ok",
+                            value=[[int(r[0]), int(r[1]), int(r[2])]
+                                   for r in rows])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SequentialClient(SQLClient):
+    """sequential.clj: a writer inserts chain keys k_0..k_n in order
+    across `table_count` tables; readers scan in reverse — any
+    non-prefix read breaks sequential consistency."""
+
+    table_count = 5
+
+    def _tables(self, test):
+        for i in range(self.table_count):
+            ensure_table(
+                self.conn, test,
+                f"CREATE TABLE IF NOT EXISTS seq_{i} "
+                "(key VARCHAR(255) PRIMARY KEY)", f"seq_{i}")
+
+    def _table_for(self, subkey: str) -> str:
+        return f"seq_{hash(subkey) % self.table_count}"
+
+    def _invoke(self, test, op):
+        self._tables(test)
+        chain, i = op.value
+        if op.f == "write":
+            subkey = f"{chain}_{i}"
+            t = self._table_for(subkey)
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO {t} (key) VALUES (?)", (subkey,)))
+            update_keyrange(test, t, subkey)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            # Each subkey read is its own txn, scanning high -> low
+            # (sequential.clj invoke! :72-90).  '_' is a single-char
+            # SQL wildcard, so escape it or chain 1 would also match
+            # '10_3', '12_5', ...
+            hi = -1
+            for t in range(self.table_count):
+                rows = self.conn.sql(
+                    f"SELECT key FROM seq_{t} WHERE key LIKE ? "
+                    "ESCAPE '#'", (f"{chain}#_%",))
+                for (k,) in rows:
+                    hi = max(hi, int(k.split("_")[1]))
+            found = []
+            for j in range(hi, -1, -1):
+                subkey = f"{chain}_{j}"
+                rows = with_txn_retry(
+                    lambda sk=subkey: self.conn.sql(
+                        f"SELECT key FROM {self._table_for(sk)} "
+                        "WHERE key = ?", (sk,)))
+                if rows:
+                    found.append(j)
+            return op.assoc(type="ok", value=[chain, sorted(found)])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class CommentsClient(SQLClient):
+    """comments.clj: blind inserts across tables + full-scan reads in a
+    txn; checker hunts strict-serializability violations (T2 visible
+    without an earlier completed T1)."""
+
+    table_count = 5
+
+    def _tables(self, test):
+        for i in range(self.table_count):
+            ensure_table(
+                self.conn, test,
+                f"CREATE TABLE IF NOT EXISTS comment_{i} "
+                "(id INT PRIMARY KEY, key INT)", f"comment_{i}")
+
+    def _invoke(self, test, op):
+        self._tables(test)
+        k, ident = op.value
+        if op.f == "write":
+            t = f"comment_{ident % self.table_count}"
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO {t} (id, key) VALUES ({ident}, {k})"))
+            update_keyrange(test, t, ident)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            def read_all():
+                stmts = [f"SELECT id FROM comment_{i} WHERE key = {k}"
+                         for i in range(self.table_count)]
+                return self.conn.txn(stmts)
+            rows = with_txn_retry(read_all)
+            ids = sorted(int(r[0]) for r in rows)
+            return op.assoc(type="ok",
+                            value=independent.tuple_(k, ids))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class G2Client(SQLClient):
+    """adya.clj G2: two tables; each txn predicate-reads both, then
+    inserts into its own if both empty for its key."""
+
+    def _invoke(self, test, op):
+        for t in ("g2a", "g2b"):
+            ensure_table(
+                self.conn, test,
+                f"CREATE TABLE IF NOT EXISTS {t} "
+                "(id INT PRIMARY KEY, k INT)", t)
+        k, v = op.value
+        a_id, b_id = v
+        ident = a_id if a_id is not None else b_id
+        table = "g2a" if a_id is not None else "g2b"
+
+        def txn():
+            # Predicate-read both tables and insert in ONE atomic
+            # statement — the guard and the write must share a txn or
+            # two racers both see "empty" and both insert (the exact G2
+            # anomaly this workload hunts, manufactured by the client).
+            rows = self.conn.txn([
+                f"INSERT INTO {table} (id, k) SELECT {ident}, {k} "
+                f"WHERE NOT EXISTS (SELECT 1 FROM g2a WHERE k = {k}) "
+                f"AND NOT EXISTS (SELECT 1 FROM g2b WHERE k = {k}) "
+                "RETURNING id"])
+            if not rows:
+                raise Definite("predicate found a row")
+        with_txn_retry(txn)
+        return op.assoc(type="ok")
+
+
+# ---------------------------------------------------------------------------
+# Comments checker (comments.clj checker)
+# ---------------------------------------------------------------------------
+
+class CommentsChecker(ck.Checker):
+    """Replay the history tracking writes completed before each write's
+    invocation; a read seeing w_i but missing some completed-earlier
+    w_j breaks strict serializability (comments.clj checker)."""
+
+    def check(self, test, history, opts=None):
+        completed: set = set()
+        expected: dict = {}
+        errors = []
+        for op in History(history):
+            if op.f == "write":
+                if op.is_invoke:
+                    expected[op.value] = set(completed)
+                elif op.is_ok:
+                    completed.add(op.value)
+            elif op.f == "read" and op.is_ok and op.value is not None:
+                seen = set(op.value)
+                for w in seen:
+                    missing = expected.get(w, set()) - seen
+                    if missing:
+                        errors.append({"op": op, "seen": w,
+                                       "missing": sorted(missing)})
+        return {"valid?": not errors, "errors": errors}
+
+
+# ---------------------------------------------------------------------------
+# Test constructors (runner.clj tests :25-34)
+# ---------------------------------------------------------------------------
+
+def base_test(opts, nemesis_map: dict, name: str) -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    return dict(tst.noop_test(), **{
+        "name": f"cockroachdb {name} {nemesis_map['name']}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "os": opts.get("os"),
+        "db": CockroachDB(),
+        "net": net.iptables,
+        "nemesis": nemesis_map["client"],
+        "sql-factory": opts.get("sql-factory"),
+    })
+
+
+def _with_nemesis(opts, test, workload_gen, nemesis_map: dict,
+                  final_gen=None) -> None:
+    """Wire the during/final split: workload under the nemesis' during
+    gen, then heal + quiesce + final reads."""
+    during = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.nemesis(nemesis_map["during"], workload_gen))
+    phases = [during,
+              gen.nemesis(nemesis_map["final"], gen.void)]
+    if final_gen is not None:
+        phases += [gen.sleep(opts.get("quiesce", 3)),
+                   gen.clients(final_gen)]
+    test["generator"] = gen.phases(*phases)
+
+
+def _rounded_concurrency(opts, tpk: int) -> int:
+    """concurrent-generator needs concurrency to be a positive multiple
+    of threads-per-key; round the requested concurrency up."""
+    conc = max(opts.get("concurrency", 10), tpk)
+    return conc + (-conc) % tpk
+
+
+def _nemesis_for(opts) -> dict:
+    chosen = [nemeses[nm]() for nm in (opts.get("nemesis") or ["none"])]
+    extra = [nemeses[nm]() for nm in (opts.get("nemesis2") or [])]
+    if len(chosen) + len(extra) > 1:
+        return compose_named(chosen + extra)
+    return (chosen + extra)[0]
+
+
+def bank_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = bank_wl.workload(opts)
+    test = base_test(opts, nm, "bank")
+    test.update({k: wl[k] for k in
+                 ("accounts", "total-amount", "max-transfer")})
+    test["client"] = BankClient()
+    test["checker"] = ck.compose({"bank": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 10, wl["generator"]), nm)
+    return test
+
+
+def multitable_bank_test(opts) -> dict:
+    test = bank_test(opts)
+    test["name"] = test["name"].replace(" bank ", " bank-multitable ")
+    test["client"] = MultiTableBankClient()
+    return test
+
+
+def register_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    test = base_test(opts, nm, "register")
+    test["client"] = RegisterClient()
+    tpk = opts.get("threads-per-key", 2)
+    test["concurrency"] = _rounded_concurrency(opts, tpk)
+
+    def r(t, p):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    def w(t, p):
+        return {"type": "invoke", "f": "write",
+                "value": random.randint(0, 4)}
+
+    def cas(t, p):
+        return {"type": "invoke", "f": "cas",
+                "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+    wl_gen = independent.concurrent_generator(
+        tpk, itertools.count(),
+        lambda k: gen.limit(opts.get("ops-per-key", 100),
+                            gen.stagger(1 / 10, gen.mix([r, w, cas]))))
+    if opts.get("checker-mode", "device") == "device":
+        reg = independent.batch_checker(models.cas_register())
+    else:
+        reg = independent.checker(
+            ck.linearizable({"model": models.cas_register()}))
+    test["checker"] = ck.compose({
+        "linear": reg,
+        "timeline": independent.checker(timeline.html_timeline()),
+        "perf": ck.perf()})
+    _with_nemesis(opts, test, wl_gen, nm)
+    return test
+
+
+def sets_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = sets_wl.workload(opts)
+    test = base_test(opts, nm, "sets")
+    test["client"] = SetsClient()
+    test["checker"] = ck.compose({"set": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 10, wl["generator"]), nm,
+                  final_gen=wl["final-generator"])
+    return test
+
+
+def monotonic_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = monotonic_wl.workload(opts)
+    test = base_test(opts, nm, "monotonic")
+    test["client"] = MonotonicClient()
+    test["checker"] = ck.compose({"monotonic": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 10, wl["generator"]), nm,
+                  final_gen=gen.once(monotonic_wl.read))
+    return test
+
+
+def sequential_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = sequential_wl.workload(opts)
+    test = base_test(opts, nm, "sequential")
+    test["client"] = SequentialClient()
+    test["checker"] = ck.compose({"sequential": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 10, wl["generator"]), nm)
+    return test
+
+
+def comments_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    test = base_test(opts, nm, "comments")
+    test["client"] = CommentsClient()
+    ids = itertools.count(1)
+    lock = threading.Lock()
+
+    def next_id():
+        with lock:
+            return next(ids)
+
+    def fgen(k):
+        def w(t, p):
+            return {"type": "invoke", "f": "write",
+                    "value": next_id()}
+
+        def r(t, p):
+            return {"type": "invoke", "f": "read", "value": None}
+        return gen.limit(opts.get("ops-per-key", 50),
+                         gen.stagger(1 / 10, gen.mix([w, w, r])))
+
+    test["checker"] = ck.compose({
+        "comments": independent.checker(CommentsChecker()),
+        "perf": ck.perf()})
+    tpk = opts.get("threads-per-key", 2)
+    test["concurrency"] = _rounded_concurrency(opts, tpk)
+    _with_nemesis(opts, test,
+                  independent.concurrent_generator(
+                      tpk, itertools.count(), fgen), nm)
+    return test
+
+
+def g2_test(opts) -> dict:
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = adya_wl.workload(opts)
+    test = base_test(opts, nm, "g2")
+    test["client"] = G2Client()
+    test["checker"] = ck.compose({"g2": wl["checker"],
+                                  "perf": ck.perf()})
+    test["concurrency"] = max(2, opts.get("concurrency", 10) // 2 * 2)
+    _with_nemesis(opts, test, wl["generator"], nm)
+    return test
+
+
+tests = {
+    "bank": bank_test,
+    "bank-multitable": multitable_bank_test,
+    "comments": comments_test,
+    "register": register_test,
+    "monotonic": monotonic_test,
+    "sets": sets_test,
+    "sequential": sequential_test,
+    "g2": g2_test,
+}
+
+
+# ---------------------------------------------------------------------------
+# Runner (runner.clj)
+# ---------------------------------------------------------------------------
+
+def test_for(opts) -> dict:
+    """Look up the workload by name and build its test map.  Suite
+    options may come in directly or via the CLI's argv-options submap."""
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    for key in ("workload", "nemesis", "nemesis2"):
+        if key not in opts and av.get(key) is not None:
+            opts[key] = av[key]
+    name = opts.get("workload") or "register"
+    try:
+        ctor = tests[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; one of {sorted(tests)}")
+    return ctor(opts)
+
+
+def _opt_fn(parser):
+    """runner.clj opt-spec: workload + repeatable nemesis registries
+    (runner.clj:42-76)."""
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(tests),
+                        help="which workload to run")
+    parser.add_argument("--nemesis", action="append", dest="nemesis",
+                        choices=sorted(nemeses), metavar="NAME",
+                        help="nemesis to use (repeat to mix): "
+                        + ", ".join(sorted(nemeses)))
+    parser.add_argument("--nemesis2", action="append", dest="nemesis2",
+                        choices=sorted(nemeses), metavar="NAME",
+                        help="an additional nemesis to mix in")
+
+
+def main(argv=None):
+    """runner.clj -main: test / analyze / serve with workload +
+    nemesis registries."""
+    cli.run(cli.single_test_cmd(test_for, _opt_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

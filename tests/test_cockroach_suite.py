@@ -1,0 +1,286 @@
+"""Cockroach suite end-to-end over the dummy transport with an
+in-memory serializable SQL engine (sqlite3 under one global lock), plus
+unit tests for the named-nemesis composition, the txn-retry wrapper,
+and the comments checker."""
+
+import sqlite3
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen, store
+from jepsen_tpu.history import History, Op, invoke_op, ok_op
+from jepsen_tpu.suites import cockroach as cr
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+# once-per-test guards (table creation, bank seeding) live in the test
+# map itself ("_once-tags"), so no cross-test cleanup is needed here
+
+
+class MemSQL:
+    """One shared in-memory SQL engine for all 'nodes': sqlite3 under a
+    global lock = a strictly serializable single store.  Conn objects
+    satisfy the suite's injectable boundary (sql/txn/close)."""
+
+    def __init__(self):
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.Lock()
+        self.ts = 0
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            # sqlite has no cluster_logical_timestamp(); _run swaps it
+            # for a monotonic counter
+            ts_expr = "cluster_logical_timestamp()"
+
+            def sql(self, stmt, params=()):
+                with mem.lock:
+                    out = self._run(stmt, params)
+                    mem.db.commit()
+                    return out
+
+            def txn(self, stmts):
+                with mem.lock:
+                    rows = []
+                    for s in stmts:
+                        rows.extend(self._run(s, ()))
+                    mem.db.commit()
+                    return rows
+
+            def atomically(self, body):
+                # Interactive txn: body(run) executes statements under
+                # one lock hold; any exception rolls the txn back.
+                with mem.lock:
+                    try:
+                        out = body(lambda s, p=(): self._run(s, p))
+                        mem.db.commit()
+                        return out
+                    except BaseException:
+                        mem.db.rollback()
+                        raise
+
+            def _run(self, stmt, params):
+                s = stmt.replace("UPSERT INTO", "REPLACE INTO")
+                s = s.replace("::INT8", "")
+                if "cluster_logical_timestamp()" in s:
+                    mem.ts += 1
+                    s = s.replace("cluster_logical_timestamp()",
+                                  str(mem.ts))
+                cur = mem.db.execute(s, params)
+                return [tuple(r) for r in cur.fetchall()]
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def run_suite(workload, time_limit=2, extra=None):
+    mem = MemSQL()
+    cmds = []
+
+    def handler(node, cmd, stdin):
+        cmds.append((node, cmd))
+        if "mktemp -d" in cmd:
+            return "/tmp/jepsen.X"
+        if "test -e" in cmd:
+            return "true"
+        if "ls -A" in cmd:
+            return "cockroach-dir\n"
+        return ""
+
+    control.set_dummy_handler(handler)
+    try:
+        opts = {
+            "nodes": ["n1", "n2", "n3"],
+            "concurrency": 4,
+            "time-limit": time_limit,
+            "workload": workload,
+            "ssh": {"dummy": True},
+            "sql-factory": mem.factory,
+            "ops-per-key": 20,
+            "quiesce": 0.1,
+        }
+        opts.update(extra or {})
+        test = cr.test_for(opts)
+        result = core.run(test)
+    finally:
+        control.set_dummy_handler(None)
+    return result, cmds
+
+
+class TestWorkloadsEndToEnd:
+    @pytest.mark.parametrize("workload,key", [
+        ("bank", "bank"),
+        ("register", "linear"),
+        ("sets", "set"),
+        ("monotonic", "monotonic"),
+        ("sequential", "sequential"),
+        ("comments", "comments"),
+        ("g2", "g2"),
+    ])
+    def test_valid_against_memsql(self, workload, key):
+        result, _ = run_suite(workload)
+        res = result["results"]
+        assert res[key]["valid?"] is True, res[key]
+        assert res["valid?"] is True
+
+    def test_bank_multitable(self):
+        result, _ = run_suite("bank-multitable")
+        assert result["results"]["valid?"] is True
+
+    def test_db_provisioning_flows_through_control(self):
+        _, cmds = run_suite("register", time_limit=1)
+        assert any("cockroach" in c and "start-stop-daemon --start" in c
+                   for _, c in cmds)
+        assert any("--join" in c for _, c in cmds)
+
+    def test_nemesis_parts(self):
+        result, cmds = run_suite(
+            "register", time_limit=2,
+            extra={"nemesis": ["parts"], "quiesce": 0})
+        assert result["results"]["valid?"] is True
+        assert any("iptables" in c and "DROP" in c for _, c in cmds)
+        assert any("iptables -F" in c for _, c in cmds)
+
+
+class TestNamedNemeses:
+    def test_compose_named_routes_and_tags(self):
+        log = []
+
+        class Rec(cr.nem.Nemesis):
+            def __init__(self, tag):
+                self.tag = tag
+
+            def invoke(self, test, op):
+                log.append((self.tag, op.f))
+                return op
+
+        a = dict(cr.nemesis_single_gen(), name="a", client=Rec("a"),
+                 clocks=False)
+        b = dict(cr.nemesis_single_gen(), name="b", client=Rec("b"),
+                 clocks=True)
+        m = cr.compose_named([a, b, None])
+        assert m["name"] == "a+b"
+        assert m["clocks"] is True
+        m["client"].invoke({}, Op(process="nemesis", type="info",
+                                  f=("a", "start"), value=None))
+        m["client"].invoke({}, Op(process="nemesis", type="info",
+                                  f=("b", "stop"), value=None))
+        assert log == [("a", "start"), ("b", "stop")]
+
+    def test_tagged_generator_ops(self):
+        m = cr.compose_named([dict(cr.nemesis_single_gen(), name="x",
+                                   client=cr.nem.Noop(), clocks=False)])
+        o = gen.op(m["final"], {}, "nemesis")
+        assert o["f"] == ("x", "stop")
+
+    def test_registry_complete(self):
+        for name, ctor in cr.nemeses.items():
+            nm = ctor()
+            assert {"name", "during", "final", "client",
+                    "clocks"} <= set(nm), name
+
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(AssertionError):
+            cr.compose_named([cr.parts(), cr.parts()])
+
+    def test_double_gen_ladder(self, monkeypatch):
+        # nemesis.clj:40-60 — interleaved start1/start2/stop1/stop2;
+        # sleeps shrunk so the test reads the whole first cycle
+        monkeypatch.setattr(cr, "nemesis_delay", 0.01)
+        monkeypatch.setattr(cr, "nemesis_duration", 0.01)
+        g = cr.nemesis_double_gen()
+        fs = [gen.op(g["during"], {}, "nemesis")["f"] for _ in range(8)]
+        assert fs == ["start1", "start2", "stop1", "stop2",
+                      "start2", "start1", "stop2", "stop1"]
+        finals = [gen.op(g["final"], {}, "nemesis")["f"]
+                  for _ in range(2)]
+        assert finals == ["stop1", "stop2"]
+
+
+class TestShellConn:
+    def test_binds_node_session_on_worker_threads(self):
+        # Client invokes run on worker threads where no control session
+        # is bound; ShellConn must hold one itself or every op becomes
+        # :info "no session bound".
+        seen = []
+
+        def handler(node, cmd, stdin):
+            seen.append((node, cmd))
+            return "val\n4"
+
+        control.set_dummy_handler(handler)
+        try:
+            with control.with_ssh({"dummy": True}):
+                conn = cr.ShellConn("n2")
+                rows = conn.sql("SELECT val FROM test WHERE id = ?",
+                                (1,))
+                conn.close()
+        finally:
+            control.set_dummy_handler(None)
+        assert rows == [["4"]]
+        assert seen and seen[0][0] == "n2"
+        assert "SELECT val FROM test WHERE id = 1" in seen[0][1]
+
+
+class TestTxnRetry:
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise cr.Retryable("restart transaction")
+            return "done"
+
+        assert cr.with_txn_retry(flaky) == "done"
+        assert len(calls) == 3
+
+    def test_gives_up_after_deadline(self, monkeypatch):
+        monkeypatch.setattr(cr, "txn_retry_max", 0.05)
+
+        def always():
+            raise cr.Retryable("restart transaction")
+
+        with pytest.raises(cr.Retryable):
+            cr.with_txn_retry(always)
+
+
+class TestCommentsChecker:
+    def test_valid_prefix_reads(self):
+        h = History([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "write", 2), ok_op(0, "write", 2),
+            invoke_op(1, "read", None), ok_op(1, "read", [1, 2]),
+        ]).index()
+        assert cr.CommentsChecker().check({}, h)["valid?"] is True
+
+    def test_later_visible_without_earlier(self):
+        # w1 completed before w2 was invoked; a read sees 2 but not 1
+        h = History([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(0, "write", 2), ok_op(0, "write", 2),
+            invoke_op(1, "read", None), ok_op(1, "read", [2]),
+        ]).index()
+        r = cr.CommentsChecker().check({}, h)
+        assert r["valid?"] is False
+        assert r["errors"][0]["missing"] == [1]
+
+    def test_concurrent_writes_not_ordered(self):
+        # w1 and w2 concurrent: seeing only 2 is fine
+        h = History([
+            invoke_op(0, "write", 1),
+            invoke_op(2, "write", 2), ok_op(2, "write", 2),
+            ok_op(0, "write", 1),
+            invoke_op(1, "read", None), ok_op(1, "read", [2]),
+        ]).index()
+        assert cr.CommentsChecker().check({}, h)["valid?"] is True

@@ -60,6 +60,20 @@ def test_jsonl_roundtrip():
     assert h2[1].type == "fail"
 
 
+def test_jsonl_roundtrip_preserves_independent_kv():
+    # The reference round-trips MapEntry independent keys through
+    # custom Fressian handlers (store.clj:28-123); losing the KV type
+    # makes `analyze` on a stored keyed history find no keys and
+    # trivially pass.
+    from jepsen_tpu import independent
+
+    h = History([invoke_op(0, "read", independent.tuple_(3, None)),
+                 ok_op(0, "read", independent.tuple_(3, 7))]).index()
+    h2 = History.from_jsonl(h.to_jsonl())
+    assert independent.history_keys(h2) == {3}
+    assert h2[1].value.value == 7
+
+
 def test_pack_columnar():
     h = History([invoke_op(0, "read", None), ok_op(0, "read", 7),
                  invoke_op(1, "cas", [1, 2]),
