@@ -227,6 +227,15 @@ def compose(nemeses: dict):
     return Compose(nemeses)
 
 
+class fdict(dict):
+    """A hashable f-routing map for compose() keys: outer f -> inner f
+    (plain dicts can't be dict keys; identity hashing is fine since
+    each routing map is unique)."""
+
+    def __hash__(self):
+        return id(self)
+
+
 # ---------------------------------------------------------------------------
 # Clock, process, and file nemeses (nemesis.clj:214-323)
 # ---------------------------------------------------------------------------

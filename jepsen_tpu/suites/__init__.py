@@ -16,6 +16,9 @@ import importlib
 SUITES = {
     "etcd": "jepsen_tpu.suites.etcd",
     "cockroach": "jepsen_tpu.suites.cockroach",
+    "yugabyte": "jepsen_tpu.suites.yugabyte",
+    "aerospike": "jepsen_tpu.suites.aerospike",
+    "dgraph": "jepsen_tpu.suites.dgraph",
 }
 
 

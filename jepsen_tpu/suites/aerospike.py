@@ -1,0 +1,486 @@
+"""Aerospike test suite (reference: `aerospike/src/aerospike/` — 1,262
+LoC: support.clj, nemesis.clj, cas_register.clj, counter.clj, set.clj),
+whose distinctive feature is the **capped-kill nemesis**: at most
+`max-dead-nodes` may be down at once (dead-node accounting in a shared
+set, nemesis.clj capped-conj :12-16), with `revive`/`recluster` ops
+that resurrect data on dead nodes (nemesis.clj kill-nemesis :17-57,
+full :128-140).
+
+Workloads: cas-register (independent keys), counter, set
+(aerospike/src/aerospike/{cas_register,counter,set}.clj).
+
+The client boundary is injectable (test["aero-factory"]): an object
+with read/write/cas/add/read_all per key, so the whole suite runs
+in-process against an in-memory namespace for tests; the production
+conn shells `aql` over the control plane.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, nemesis as nem, net
+from jepsen_tpu import nemesis_time as nt
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites.cockroach import _rounded_concurrency
+from jepsen_tpu.workloads import counter as counter_wl
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+from jepsen_tpu.workloads import sets as sets_wl
+
+# ---------------------------------------------------------------------------
+# support (support.clj)
+# ---------------------------------------------------------------------------
+
+DIR = "/opt/aerospike"
+CONF = "/etc/aerospike/aerospike.conf"
+LOGFILE = "/var/log/aerospike/aerospike.log"
+NAMESPACE = "jepsen"
+
+
+def revive(node: Optional[str] = None) -> str:
+    """support.clj revive! — re-adopt data on a previously dead node."""
+    return c.execute("asinfo", "-v", "revive:namespace=" + NAMESPACE,
+                     check=False)
+
+
+def recluster(node: Optional[str] = None) -> str:
+    """support.clj recluster!"""
+    return c.execute("asinfo", "-v", "recluster:", check=False)
+
+
+class AerospikeDB(db_mod.DB, db_mod.LogFiles):
+    """support.clj db: install server package, configure the jepsen
+    namespace in strong-consistency mode, run as a service."""
+
+    def setup(self, test, node):
+        nt.install(test, node)
+        c.execute("service", "aerospike", "restart", check=False)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            "asinfo -v status >/dev/null 2>&1 && exit 0; sleep 1; done; "
+            "exit 1"), check=False)
+
+    def teardown(self, test, node):
+        c.execute("service", "aerospike", "stop", check=False)
+        c.execute(lit("rm -rf /opt/aerospike/data/*"), check=False)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# ---------------------------------------------------------------------------
+# Capped-kill nemesis (nemesis.clj)
+# ---------------------------------------------------------------------------
+
+def capped_conj(s: set, x, cap: int) -> set:
+    """Add x to s unless that would exceed cap (nemesis.clj:12-16)."""
+    s2 = s | {x}
+    return s if cap < len(s2) else s2
+
+
+def random_nonempty_subset(nodes) -> list:
+    nodes = list(nodes)
+    n = random.randint(1, len(nodes))
+    return random.sample(nodes, n)
+
+
+class KillNemesis(nem.Nemesis):
+    """Kills asd with :f :kill (as long as at most max_dead nodes are
+    down), restarts with :restart, revives with :revive, reclusters
+    with :recluster (nemesis.clj kill-nemesis :17-57).  `dead` is a
+    shared set so composed nemeses see one accounting."""
+
+    def __init__(self, signal: str, max_dead: int, dead: set,
+                 lock: Optional[threading.Lock] = None):
+        self.signal = signal
+        self.max_dead = max_dead
+        self.dead = dead
+        self.lock = lock or threading.Lock()
+
+    def invoke(self, test, op):
+        targets = op.value or test["nodes"]
+
+        def per_node(t, node):
+            if op.f == "kill":
+                with self.lock:
+                    allowed = node in capped_conj(
+                        self.dead, node, self.max_dead)
+                    if allowed:
+                        self.dead.add(node)
+                if not allowed:
+                    return "still-alive"
+                cu.grepkill("asd", signal=self.signal)
+                return "killed"
+            if op.f == "restart":
+                c.execute("service", "aerospike", "restart",
+                          check=False)
+                with self.lock:
+                    self.dead.discard(node)
+                return "started"
+            if op.f == "revive":
+                return revive(node) or "revived"
+            if op.f == "recluster":
+                return recluster(node) or "reclustered"
+            raise ValueError(f"kill-nemesis can't handle {op.f!r}")
+
+        return op.assoc(value=c.on_nodes(test, per_node, targets))
+
+    def teardown(self, test):
+        pass
+
+
+def kill_gen(test, process):
+    """nemesis.clj kill-gen :60-63."""
+    return {"type": "info", "f": "kill",
+            "value": random_nonempty_subset(test["nodes"])}
+
+
+def restart_gen(test, process):
+    return {"type": "info", "f": "restart",
+            "value": random_nonempty_subset(test["nodes"])}
+
+
+def revive_gen(test, process):
+    return {"type": "info", "f": "revive", "value": None}
+
+
+def recluster_gen(test, process):
+    return {"type": "info", "f": "recluster", "value": None}
+
+
+class KillerGen(gen.Generator):
+    """Random pattern of kills / restarts / (revive then recluster)
+    (nemesis.clj killer-gen-seq :80-95)."""
+
+    def __init__(self, no_revives: bool = False):
+        self.no_revives = no_revives
+        self.queue: list = []
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            if not self.queue:
+                patterns = [[kill_gen], [restart_gen]]
+                if not self.no_revives:
+                    patterns.append([revive_gen, recluster_gen])
+                self.queue = list(random.choice(patterns))
+            g = self.queue.pop(0)
+        return gen.op(g, test, process)
+
+
+def full_nemesis(opts: dict) -> nem.Nemesis:
+    """Partitions + capped kills + clock skew in one composed nemesis
+    (nemesis.clj full-nemesis :97-112).  Dict compose keys rewrite the
+    outer f to each child's vocabulary (nemesis.compose)."""
+    return nem.compose({
+        # fdict key: outer f -> inner f, rewritten+restored by Compose
+        nem.fdict({"partition-start": "start",
+                   "partition-stop": "stop"}):
+            nem.partition_random_halves(),
+        frozenset({"kill", "restart", "revive", "recluster"}):
+            KillNemesis("15" if opts.get("clean-kill") else "9",
+                        opts.get("max-dead-nodes", 1),
+                        opts["dead"]),
+        nem.fdict({"clock-reset": "reset", "clock-bump": "bump",
+                   "clock-strobe": "strobe"}):
+            nt.clock_nemesis(),
+    })
+
+
+def full_gen(opts: dict):
+    """nemesis.clj full-gen :114-126."""
+    sources = []
+    if not opts.get("no-clocks"):
+        sources.append(gen.f_map({"strobe": "clock-strobe",
+                                  "reset": "clock-reset",
+                                  "bump": "clock-bump"},
+                                 nt.clock_gen()))
+    if not opts.get("no-kills"):
+        sources.append(KillerGen(opts.get("no-revives", False)))
+    if not opts.get("no-partitions"):
+        def parts():
+            while True:
+                yield lambda t, p: {"type": "info",
+                                    "f": "partition-start"}
+                yield lambda t, p: {"type": "info",
+                                    "f": "partition-stop"}
+        sources.append(gen.gseq(parts()))
+    return gen.stagger(opts.get("nemesis-interval", 5),
+                       gen.mix(sources))
+
+
+def full(opts: Optional[dict] = None) -> dict:
+    """nemesis.clj full :128-140: {nemesis, generator,
+    final-generator} with shared dead-node accounting."""
+    opts = dict(opts or {})
+    opts["dead"] = opts.get("dead", set())
+    return {
+        "nemesis": full_nemesis(opts),
+        "generator": full_gen(opts),
+        "final-generator": gen.gseq([
+            lambda t, p: {"type": "info", "f": "partition-stop"},
+            lambda t, p: {"type": "info", "f": "clock-reset"},
+            lambda t, p: {"type": "info", "f": "restart",
+                          "value": list(t["nodes"])},
+            lambda t, p: {"type": "info", "f": "revive"},
+            lambda t, p: {"type": "info", "f": "recluster"},
+        ]),
+        "dead": opts["dead"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Clients (cas_register.clj, counter.clj, set.clj)
+# ---------------------------------------------------------------------------
+
+class AqlShellConn:
+    """Production client boundary: aql over the control plane.  Tests
+    inject an in-memory namespace instead (same read/write/cas/add/
+    read_all surface)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+        self._lock = threading.Lock()
+
+    def _aql(self, stmt: str) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("aql", "-h", self.node, "-c", stmt)
+
+    def read(self, k):
+        out = self._aql(f"SELECT value FROM test.{NAMESPACE} "
+                        f"WHERE PK = '{k}'")
+        for line in (out or "").splitlines():
+            line = line.strip()
+            if line.isdigit() or (line.startswith("-")
+                                  and line[1:].isdigit()):
+                return int(line)
+        return None
+
+    def write(self, k, v):
+        self._aql(f"INSERT INTO test.{NAMESPACE} (PK, value) "
+                  f"VALUES ('{k}', {v})")
+
+    def cas(self, k, old, new) -> bool:
+        # aerospike CAS goes through generation predicates; aql has no
+        # single-statement CAS, so production uses the record UDF path.
+        out = self._aql(f"EXECUTE jepsen.cas('{k}', {old}, {new}) "
+                        f"ON test.{NAMESPACE} WHERE PK = '{k}'")
+        return "ok" in (out or "").lower()
+
+    def add(self, k, delta):
+        self._aql(f"EXECUTE jepsen.add('{k}', {delta}) "
+                  f"ON test.{NAMESPACE} WHERE PK = '{k}'")
+
+    def read_all(self, k) -> list:
+        out = self._aql(f"SELECT * FROM test.{NAMESPACE}")
+        vals = []
+        for line in (out or "").splitlines():
+            line = line.strip()
+            if line.isdigit():
+                vals.append(int(line))
+        return vals
+
+    def close(self):
+        self._session.close()
+
+
+class AeroClient(client_mod.Client):
+    """Shared base: connection factory injection + the aerospike error
+    taxonomy (support.clj: timeouts -> :info)."""
+
+    def __init__(self, conn_factory=AqlShellConn):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = type(self)(test.get("aero-factory") or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            return self._invoke(test, op)
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except ConnectionRefusedError as e:
+            return op.assoc(type="fail", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="info", error=str(e))
+
+    def _invoke(self, test, op):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class CasRegisterClient(AeroClient):
+    """cas_register.clj: independent keyed registers."""
+
+    def _invoke(self, test, op):
+        k, v = op.value
+        if op.f == "read":
+            val = self.conn.read(k)
+            return op.assoc(type="ok", value=independent.tuple_(k, val))
+        if op.f == "write":
+            self.conn.write(k, v)
+            return op.assoc(type="ok")
+        if op.f == "cas":
+            old, new = v
+            ok = self.conn.cas(k, old, new)
+            return op.assoc(type="ok" if ok else "fail")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class CounterClient(AeroClient):
+    """counter.clj: increments on one record."""
+
+    KEY = "counter"
+
+    def _invoke(self, test, op):
+        if op.f == "add":
+            self.conn.add(self.KEY, op.value if op.value is not None
+                          else 1)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            val = self.conn.read(self.KEY)
+            return op.assoc(type="ok", value=val or 0)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SetClient(AeroClient):
+    """set.clj: unique adds as separate records, one scan read."""
+
+    def _invoke(self, test, op):
+        if op.f == "add":
+            self.conn.write(f"set-{op.value}", op.value)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            return op.assoc(type="ok",
+                            value=sorted(self.conn.read_all("set")))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+# ---------------------------------------------------------------------------
+# Tests
+# ---------------------------------------------------------------------------
+
+def base_test(opts, name: str) -> dict:
+    from jepsen_tpu import tests as tst
+
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    nm = full({**opts, "max-dead-nodes":
+               opts.get("max-dead-nodes",
+                        (len(nodes) - 1) // 2)})
+    test = dict(tst.noop_test(), **{
+        "name": f"aerospike {name}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": AerospikeDB(),
+        "net": net.iptables,
+        "nemesis": nm["nemesis"],
+        "aero-factory": opts.get("aero-factory"),
+        "dead": nm["dead"],
+    })
+    return test, nm
+
+
+def _schedule(opts, test, nm, workload_gen, final_gen=None) -> None:
+    during = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.nemesis(nm["generator"], workload_gen))
+    phases = [during,
+              gen.log("Healing cluster"),
+              gen.nemesis(nm["final-generator"], gen.void)]
+    if final_gen is not None:
+        phases += [gen.sleep(opts.get("quiesce", 3)),
+                   gen.clients(final_gen)]
+    test["generator"] = gen.phases(*phases)
+
+
+def cas_register_test(opts) -> dict:
+    opts = dict(opts or {})
+    test, nm = base_test(opts, "cas-register")
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
+    test["client"] = CasRegisterClient()
+    test["checker"] = ck.compose({"linear": wl["checker"],
+                                  "perf": ck.perf()})
+    _schedule(opts, test, nm, wl["generator"])
+    return test
+
+
+def counter_test(opts) -> dict:
+    opts = dict(opts or {})
+    test, nm = base_test(opts, "counter")
+    wl = counter_wl.workload(opts)
+    test["client"] = CounterClient()
+    test["checker"] = ck.compose({"counter": wl["checker"],
+                                  "perf": ck.perf()})
+    _schedule(opts, test, nm, gen.stagger(1 / 10, wl["generator"]),
+              final_gen=wl["final-generator"])
+    return test
+
+
+def set_test(opts) -> dict:
+    opts = dict(opts or {})
+    test, nm = base_test(opts, "set")
+    wl = sets_wl.workload(opts)
+    test["client"] = SetClient()
+    test["checker"] = ck.compose({"set": wl["checker"],
+                                  "perf": ck.perf()})
+    _schedule(opts, test, nm, gen.stagger(1 / 10, wl["generator"]),
+              final_gen=wl["final-generator"])
+    return test
+
+
+tests = {
+    "cas-register": cas_register_test,
+    "counter": counter_test,
+    "set": set_test,
+}
+
+
+def test_for(opts) -> dict:
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    for key in ("workload", "max-dead-nodes", "clean-kill"):
+        if key not in opts and av.get(key) is not None:
+            opts[key] = av[key]
+    name = opts.get("workload") or "cas-register"
+    try:
+        ctor = tests[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; one of {sorted(tests)}")
+    return ctor(opts)
+
+
+def _opt_fn(parser):
+    parser.add_argument("--workload", default="cas-register",
+                        choices=sorted(tests))
+    parser.add_argument("--max-dead-nodes", type=int, default=None,
+                        help="max simultaneously-killed nodes")
+    parser.add_argument("--clean-kill", action="store_true",
+                        help="SIGTERM instead of SIGKILL")
+
+
+def main(argv=None):
+    cli.run(cli.single_test_cmd(test_for, _opt_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

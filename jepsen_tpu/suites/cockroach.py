@@ -30,7 +30,7 @@ import itertools
 import random
 import threading
 import time
-from typing import Callable, Optional
+from typing import Callable
 
 from jepsen_tpu import checker as ck
 from jepsen_tpu import cli
@@ -46,6 +46,7 @@ from jepsen_tpu.control import lit
 from jepsen_tpu.history import History
 from jepsen_tpu.workloads import adya as adya_wl
 from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
 from jepsen_tpu.workloads import monotonic as monotonic_wl
 from jepsen_tpu.workloads import sequential as sequential_wl
 from jepsen_tpu.workloads import sets as sets_wl
@@ -171,6 +172,18 @@ class ShellConn:
         # bound; hold one open for this connection's lifetime.
         self._session = c.session(node)
 
+    def _cmd(self, q: str) -> list:
+        """The shell command executing one query — the subclass hook
+        (yugabyte's ysqlsh conn overrides this and _parse)."""
+        return [BIN, "sql", "--insecure",
+                "--host", f"{self.node}:{PORT}",
+                "--format", "tsv", "-e", q]
+
+    def _parse(self, text: str) -> list:
+        """Command output -> rows (first line is the TSV header)."""
+        return [line.split("\t")
+                for line in (text or "").splitlines()[1:] if line]
+
     def sql(self, stmt: str, params: tuple = ()) -> list:
         # Single-pass placeholder substitution: splitting first means a
         # '?' inside a parameter value can't be mistaken for a later
@@ -187,12 +200,8 @@ class ShellConn:
             out += [v, nxt]
         q = "".join(out) if params else stmt
         with c.with_session(self.node, self._session):
-            out = c.execute(BIN, "sql", "--insecure",
-                            "--host", f"{self.node}:{PORT}",
-                            "--format", "tsv", "-e", q)
-        rows = [line.split("\t")
-                for line in (out or "").splitlines()[1:] if line]
-        return rows
+            text = c.execute(*self._cmd(q))
+        return self._parse(text)
 
     def txn(self, stmts: list) -> list:
         """Run statements atomically; cockroach retries internally when
@@ -1081,34 +1090,14 @@ def register_test(opts) -> dict:
     nm = _nemesis_for(opts)
     test = base_test(opts, nm, "register")
     test["client"] = RegisterClient()
-    tpk = opts.get("threads-per-key", 2)
-    test["concurrency"] = _rounded_concurrency(opts, tpk)
-
-    def r(t, p):
-        return {"type": "invoke", "f": "read", "value": None}
-
-    def w(t, p):
-        return {"type": "invoke", "f": "write",
-                "value": random.randint(0, 4)}
-
-    def cas(t, p):
-        return {"type": "invoke", "f": "cas",
-                "value": [random.randint(0, 4), random.randint(0, 4)]}
-
-    wl_gen = independent.concurrent_generator(
-        tpk, itertools.count(),
-        lambda k: gen.limit(opts.get("ops-per-key", 100),
-                            gen.stagger(1 / 10, gen.mix([r, w, cas]))))
-    if opts.get("checker-mode", "device") == "device":
-        reg = independent.batch_checker(models.cas_register())
-    else:
-        reg = independent.checker(
-            ck.linearizable({"model": models.cas_register()}))
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
     test["checker"] = ck.compose({
-        "linear": reg,
+        "linear": wl["checker"],
         "timeline": independent.checker(timeline.html_timeline()),
         "perf": ck.perf()})
-    _with_nemesis(opts, test, wl_gen, nm)
+    _with_nemesis(opts, test, wl["generator"], nm)
     return test
 
 

@@ -1,0 +1,723 @@
+"""Dgraph test suite (reference: `dgraph/src/jepsen/dgraph/` — 2,358
+LoC: core.clj, support.clj, nemesis.clj, trace.clj plus per-workload
+files), whose distinctive features are:
+
+  * two-daemon automation — a `zero` coordinator quorum plus an `alpha`
+                            data server per node (support.clj)
+  * distributed tracing   — every client op runs in a span; spans
+                            export to a Jaeger-style collector or the
+                            store dir (trace.clj:36-75; here via
+                            jepsen_tpu.trace)
+  * nemesis menu by flags — kill/fix alpha, kill zero, tablet-mover
+                            (rebalances predicate tablets between
+                            groups mid-test), partitions, clock skew
+                            (nemesis.clj:14-120)
+  * workload registry     — bank, delete, long-fork,
+                            linearizable-register, upsert, set,
+                            sequential (core.clj:25-37)
+
+The client boundary is injectable (test["dgraph-factory"]): an object
+with get/set_kv/delete/cas/upsert/read_keys; production conns drive
+alpha's HTTP API over the control plane.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import random
+import threading
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, nemesis as nem, net
+from jepsen_tpu import nemesis_time as nt
+from jepsen_tpu import trace as trace_mod
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites.cockroach import _rounded_concurrency
+from jepsen_tpu.workloads import (bank as bank_wl,
+                                  linearizable_register as linreg_wl,
+                                  long_fork as long_fork_wl,
+                                  sequential as sequential_wl,
+                                  sets as sets_wl,
+                                  upsert as upsert_wl)
+
+# ---------------------------------------------------------------------------
+# support (support.clj)
+# ---------------------------------------------------------------------------
+
+DIR = "/opt/dgraph"
+BIN = f"{DIR}/dgraph"
+ZERO_PID = f"{DIR}/zero.pid"
+ALPHA_PID = f"{DIR}/alpha.pid"
+ZERO_LOG = f"{DIR}/zero.log"
+ALPHA_LOG = f"{DIR}/alpha.log"
+ZERO_HTTP = 6080
+ALPHA_HTTP = 8080
+ALPHA_GRPC = 9080
+
+
+def zero_nodes(test) -> list:
+    return (test.get("nodes") or [])[:3]
+
+
+def start_zero(test, node) -> None:
+    """support.clj start-zero!"""
+    idx = zero_nodes(test).index(node) + 1
+    peer = zero_nodes(test)[0]
+    args = [BIN, "zero", "--my", f"{node}:5080", "--raft",
+            f"idx={idx}", "--replica", "3"]
+    if node != peer:
+        args += ["--peer", f"{peer}:5080"]
+    cu.start_daemon(*args, chdir=DIR, logfile=ZERO_LOG,
+                    pidfile=ZERO_PID)
+
+
+def stop_zero(test, node) -> str:
+    cu.stop_daemon(ZERO_PID, BIN)
+    return "killed"
+
+
+def start_alpha(test, node) -> None:
+    """support.clj start-alpha!"""
+    zeros = ",".join(f"{n}:5080" for n in zero_nodes(test))
+    cu.start_daemon(BIN, "alpha", "--my", f"{node}:7080",
+                    "--zero", zeros,
+                    chdir=DIR, logfile=ALPHA_LOG, pidfile=ALPHA_PID)
+
+
+def stop_alpha(test, node) -> str:
+    cu.stop_daemon(ALPHA_PID, BIN)
+    return "killed"
+
+
+def zero_state(node: str) -> dict:
+    """GET /state from a zero: group/tablet topology
+    (support.clj zero-state)."""
+    out = c.execute("curl", "-sf",
+                    f"http://{node}:{ZERO_HTTP}/state", check=False)
+    try:
+        return json.loads(out or "{}")
+    except ValueError:
+        return {}
+
+
+def move_tablet(node: str, predicate: str, group) -> str:
+    """support.clj move-tablet!"""
+    return c.execute(
+        "curl", "-sf",
+        f"http://{node}:{ZERO_HTTP}/moveTablet?tablet={predicate}"
+        f"&group={group}", check=False)
+
+
+class DgraphDB(db_mod.DB, db_mod.LogFiles):
+    """support.clj db: zero quorum on the first 3 nodes, alpha
+    everywhere."""
+
+    def setup(self, test, node):
+        cu.install_archive(
+            "https://github.com/dgraph-io/dgraph/releases/latest/"
+            "download/dgraph-linux-amd64.tar.gz", DIR)
+        nt.install(test, node)
+        if node in zero_nodes(test):
+            start_zero(test, node)
+        start_alpha(test, node)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"curl -sf http://{node}:{ALPHA_HTTP}/health "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        stop_alpha(test, node)
+        stop_zero(test, node)
+        c.execute("rm", "-rf", f"{DIR}/p", f"{DIR}/w", f"{DIR}/zw",
+                  check=False)
+
+    def log_files(self, test, node):
+        return [ZERO_LOG, ALPHA_LOG]
+
+
+# ---------------------------------------------------------------------------
+# Nemeses (nemesis.clj)
+# ---------------------------------------------------------------------------
+
+def random_nonempty_subset(nodes) -> list:
+    nodes = list(nodes)
+    return random.sample(nodes, random.randint(1, len(nodes)))
+
+
+def alpha_killer() -> nem.Nemesis:
+    """Kill alpha on random nodes at :start, restart at :stop
+    (nemesis.clj:14-20)."""
+    return nem.node_start_stopper(random_nonempty_subset,
+                                  stop_alpha, start_alpha)
+
+
+def zero_killer() -> nem.Nemesis:
+    """nemesis.clj:40-46."""
+    return nem.node_start_stopper(
+        lambda test, nodes: random_nonempty_subset(zero_nodes(test)),
+        stop_zero, start_zero)
+
+
+class AlphaFixer(nem.Nemesis):
+    """Speculatively restart alphas that have fallen over
+    (nemesis.clj alpha-fixer :22-37)."""
+
+    def invoke(self, test, op):
+        def fix(t, node):
+            if cu.daemon_running(ALPHA_PID):
+                return "already-running"
+            start_alpha(t, node)
+            return "restarted"
+        targets = random_nonempty_subset(test["nodes"])
+        return op.assoc(value=c.on_nodes(test, fix, targets))
+
+    def teardown(self, test):
+        pass
+
+
+class TabletMover(nem.Nemesis):
+    """Move predicate tablets between groups at random
+    (nemesis.clj tablet-mover :48-77)."""
+
+    def invoke(self, test, op):
+        node = random.choice(test["nodes"])
+        state = zero_state(node)
+        groups = list((state.get("groups") or {}).keys())
+        moves: dict = {}
+        if groups:
+            tablets = [t for g in (state.get("groups") or {}).values()
+                       for t in (g.get("tablets") or {}).values()]
+            random.shuffle(tablets)
+            for tablet in tablets:
+                pred = tablet.get("predicate")
+                group = str(tablet.get("groupId"))
+                group2 = random.choice(groups)
+                if group != group2 and pred is not None:
+                    move_tablet(random.choice(test["nodes"]), pred,
+                                group2)
+                    moves[pred] = [group, group2]
+        return op.assoc(value=moves or "no-tablets")
+
+    def teardown(self, test):
+        pass
+
+
+def nemesis_for(opts: dict) -> dict:
+    """Build the composed nemesis + generator from boolean flags
+    (nemesis.clj nemesis/full: kill-alpha?, kill-zero?, fix-alpha?,
+    move-tablets?, partition?, clock-skew?).  Returns {nemesis,
+    generator, final-generator}."""
+    flags = {k: opts.get(k) for k in
+             ("kill-alpha?", "kill-zero?", "fix-alpha?",
+              "move-tablets?", "partition?", "clock-skew?")}
+    parts: dict = {}
+    sources: list = []
+    finals: list = []
+
+    if flags["kill-alpha?"]:
+        parts[nem.fdict({"kill-alpha": "start",
+                         "restart-alpha": "stop"})] = alpha_killer()
+        sources.append(_cycle_fs("kill-alpha", "restart-alpha"))
+        finals.append(lambda t, p: {"type": "info",
+                                    "f": "restart-alpha"})
+    if flags["kill-zero?"]:
+        parts[nem.fdict({"kill-zero": "start",
+                         "restart-zero": "stop"})] = zero_killer()
+        sources.append(_cycle_fs("kill-zero", "restart-zero"))
+        finals.append(lambda t, p: {"type": "info",
+                                    "f": "restart-zero"})
+    if flags["fix-alpha?"]:
+        parts[frozenset({"fix-alpha"})] = AlphaFixer()
+        sources.append(gen.gseq(itertools.repeat(
+            lambda t, p: {"type": "info", "f": "fix-alpha"})))
+    if flags["move-tablets?"]:
+        parts[frozenset({"move-tablets"})] = TabletMover()
+        sources.append(gen.gseq(itertools.repeat(
+            lambda t, p: {"type": "info", "f": "move-tablets"})))
+    if flags["partition?"]:
+        parts[nem.fdict({"partition-start": "start",
+                         "partition-stop": "stop"})] = \
+            nem.partition_random_halves()
+        sources.append(_cycle_fs("partition-start", "partition-stop"))
+        finals.append(lambda t, p: {"type": "info",
+                                    "f": "partition-stop"})
+    if flags["clock-skew?"]:
+        parts[frozenset({"reset", "bump", "strobe",
+                         "check-offsets"})] = nt.clock_nemesis()
+        sources.append(nt.clock_gen())
+        finals.append(lambda t, p: {"type": "info", "f": "reset"})
+
+    if not parts:
+        return {"nemesis": nem.Noop(), "generator": gen.void,
+                "final-generator": gen.void}
+    return {
+        "nemesis": nem.compose(parts),
+        "generator": gen.stagger(opts.get("nemesis-interval", 5),
+                                 gen.mix(sources)),
+        "final-generator": gen.gseq(list(finals)),
+    }
+
+
+def _cycle_fs(*fs):
+    def steps():
+        while True:
+            for f in fs:
+                yield lambda t, p, _f=f: {"type": "info", "f": _f}
+    return gen.gseq(steps())
+
+
+# ---------------------------------------------------------------------------
+# Client boundary + tracing
+# ---------------------------------------------------------------------------
+
+class HttpConn:
+    """Production conn: alpha's HTTP mutate/query API driven over the
+    control plane.  Tests inject an in-memory store with the same
+    surface (get/set_kv/delete/cas/upsert/read_keys)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _post(self, path: str, body: str,
+              content_type: str = "application/rdf") -> dict:
+        with c.with_session(self.node, self._session):
+            out = c.execute(
+                "curl", "-sf", "-X", "POST",
+                "-H", f"Content-Type: {content_type}",
+                "-d", body,
+                f"http://{self.node}:{ALPHA_HTTP}{path}")
+        try:
+            return json.loads(out or "{}")
+        except ValueError:
+            return {}
+
+    def get(self, k) -> Optional[int]:
+        out = self._post(
+            "/query",
+            '{ q(func: eq(key, %s)) { value } }' % json.dumps(str(k)),
+            "application/dql")
+        vals = [row.get("value")
+                for row in (out.get("data") or {}).get("q") or []]
+        return vals[0] if vals else None
+
+    def set_kv(self, k, v) -> None:
+        self._post("/mutate?commitNow=true",
+                   json.dumps({"set": [{"key": str(k), "value": v}]}),
+                   "application/json")
+
+    def delete(self, k) -> None:
+        self._post("/mutate?commitNow=true",
+                   json.dumps({"delete": [{"key": str(k)}]}),
+                   "application/json")
+
+    def cas(self, k, old, new) -> bool:  # pragma: no cover - cluster
+        cur = self.get(k)
+        if cur != old:
+            return False
+        self.set_kv(k, new)
+        return True
+
+    def upsert(self, k, candidate):  # pragma: no cover - cluster
+        """Read-or-create: returns the winning id for key k."""
+        cur = self.get(k)
+        if cur is None:
+            self.set_kv(k, candidate)
+            return candidate
+        return cur
+
+    def read_keys(self, ks) -> list:
+        return [self.get(k) for k in ks]
+
+    def close(self):
+        self._session.close()
+
+
+class DgraphClient(client_mod.Client):
+    """Base client: conn factory injection + per-op tracing spans
+    (core.clj wraps invoke! in with-trace; trace.clj:52-63)."""
+
+    def __init__(self, conn_factory=HttpConn):
+        self.conn_factory = conn_factory
+        self.conn = None
+        self.tracer = trace_mod._NOOP
+
+    def open(self, test, node):
+        out = type(self)(test.get("dgraph-factory")
+                         or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        out.tracer = test.setdefault("tracer",
+                                     trace_mod.tracer(test))
+        return out
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op):
+        with self.tracer.span(f"client:{op.f}", process=op.process):
+            try:
+                out = self._invoke(test, op)
+                self.tracer.attribute("type", out.type)
+                return out
+            except TimeoutError as e:
+                return op.assoc(type="info", error=str(e))
+            except ConnectionRefusedError as e:
+                return op.assoc(type="fail", error=str(e))
+            except (ConnectionError, OSError) as e:
+                return op.assoc(type="info", error=str(e))
+
+    def _invoke(self, test, op):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class RegisterClient(DgraphClient):
+    """linearizable-register: independent keyed registers
+    (dgraph/src/jepsen/dgraph/linearizable_register.clj)."""
+
+    def _invoke(self, test, op):
+        k, v = op.value
+        if op.f == "read":
+            return op.assoc(type="ok",
+                            value=independent.tuple_(k,
+                                                     self.conn.get(k)))
+        if op.f == "write":
+            self.conn.set_kv(k, v)
+            return op.assoc(type="ok")
+        if op.f == "cas":
+            old, new = v
+            return op.assoc(
+                type="ok" if self.conn.cas(k, old, new) else "fail")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class BankClient(DgraphClient):
+    """bank.clj (dgraph): account balances under predicate `balance`."""
+
+    def _seed(self, test):
+        # The whole seed runs under _tag_lock: concurrent clients block
+        # here until every account exists, or their first reads would
+        # observe a partially-seeded (wrong-total) state.
+        with _tag_lock:
+            done = test.setdefault("_once-tags", set())
+            if "bank-seed" in done:
+                return
+            accounts = test["accounts"]
+            per = test["total-amount"] // len(accounts)
+            rem = test["total-amount"] - per * len(accounts)
+            for i, a in enumerate(accounts):
+                self.conn.set_kv(f"acct-{a}",
+                                 per + (rem if i == 0 else 0))
+            done.add("bank-seed")
+
+    def _invoke(self, test, op):
+        accounts = test["accounts"]
+        self._seed(test)
+        if op.f == "read":
+            vals = self.conn.read_keys([f"acct-{a}" for a in accounts])
+            return op.assoc(type="ok",
+                            value={a: v for a, v in
+                                   zip(accounts, vals)})
+        if op.f == "transfer":
+            v = op.value
+            txn = getattr(self.conn, "transfer", None)
+            if txn is None:
+                raise TimeoutError("no transactional transfer support")
+            ok = txn(f"acct-{v['from']}", f"acct-{v['to']}",
+                     v["amount"],
+                     bool(test.get("negative-balances?")))
+            if not ok:
+                return op.assoc(type="fail",
+                                error="insufficient balance")
+            return op.assoc(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class DeleteClient(DgraphClient):
+    """delete.clj: concurrent upserts + deletes of one key; reads must
+    see either nothing or a fully-indexed record (the delete workload
+    hunts half-deleted records)."""
+
+    def _invoke(self, test, op):
+        if op.f == "write":
+            self.conn.set_kv("del-key", op.value)
+            return op.assoc(type="ok")
+        if op.f == "delete":
+            self.conn.delete("del-key")
+            return op.assoc(type="ok")
+        if op.f == "read":
+            return op.assoc(type="ok", value=self.conn.get("del-key"))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class UpsertClient(DgraphClient):
+    """upsert.clj: read-or-create — at most one id per key may ever
+    win; the op returns [k, winning-id] and reads return [k, [ids]]."""
+
+    _ids = itertools.count(1)
+    _ids_lock = threading.Lock()
+
+    def _invoke(self, test, op):
+        k, _ = op.value
+        if op.f == "upsert":
+            with self._ids_lock:
+                cand = next(self._ids)
+            got = self.conn.upsert(f"ups-{k}", cand)
+            return op.assoc(type="ok", value=[k, got])
+        if op.f == "read":
+            v = self.conn.get(f"ups-{k}")
+            return op.assoc(type="ok",
+                            value=[k, [] if v is None else [v]])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SetClient(DgraphClient):
+    """set.clj: unique adds, one scan read."""
+
+    def _invoke(self, test, op):
+        if op.f == "add":
+            self.conn.set_kv(f"set-{op.value}", op.value)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            ks = getattr(self.conn, "all_values", None)
+            vals = (ks() if ks is not None else [])
+            return op.assoc(type="ok", value=sorted(
+                v for v in vals if v is not None))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SequentialClient(DgraphClient):
+    """sequential.clj (via cockroach's chain semantics): chain writes
+    in order, reverse reads."""
+
+    def _invoke(self, test, op):
+        chain, i = op.value
+        if op.f == "write":
+            self.conn.set_kv(f"chain-{chain}-{i}", i)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            # The probe must continue PAST gaps: the anomaly this
+            # workload exists to catch is a later key visible while an
+            # earlier one is absent — stopping at the first miss would
+            # make the checker structurally unable to fail.  Scan
+            # upward until a run of consecutive misses, then re-read
+            # high -> low (sequential.clj's reverse order).
+            hi = -1
+            probe = 0
+            misses = 0
+            while misses < 8:
+                if self.conn.get(f"chain-{chain}-{probe}") is not None:
+                    hi = probe
+                    misses = 0
+                else:
+                    misses += 1
+                probe += 1
+            found = [j for j in range(hi, -1, -1)
+                     if self.conn.get(f"chain-{chain}-{j}") is not None]
+            return op.assoc(type="ok", value=[chain, sorted(found)])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class LongForkClient(DgraphClient):
+    """long_fork.clj: micro-op txns over keyed records."""
+
+    def _invoke(self, test, op):
+        txn = op.value
+        if op.f == "write":
+            (_, k, v), = txn
+            self.conn.set_kv(f"lf-{k}", v)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            vals = self.conn.read_keys([f"lf-{k}" for _, k, _ in txn])
+            return op.assoc(type="ok",
+                            value=[["r", k, v] for (_, k, _), v in
+                                   zip(txn, vals)])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+_tag_lock = threading.Lock()
+
+
+def _once_tag(test, tag: str) -> bool:
+    with _tag_lock:
+        done = test.setdefault("_once-tags", set())
+        if tag in done:
+            return False
+        done.add(tag)
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Test construction (core.clj:25-60)
+# ---------------------------------------------------------------------------
+
+def dgraph_test(opts) -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    for key in ("workload", "nemesis", "trace"):
+        if key not in opts and av.get(key) is not None:
+            opts[key] = av[key]
+    wname = opts.get("workload") or "linearizable-register"
+    try:
+        builder = workloads[wname]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {wname!r}; one of {sorted(workloads)}")
+
+    nemesis_flags = opts.get("nemesis") or []
+    if isinstance(nemesis_flags, str):
+        nemesis_flags = [nemesis_flags]
+    nopts = dict(opts)
+    for f in nemesis_flags:
+        nopts[f if f.endswith("?") else f + "?"] = True
+    nm = nemesis_for(nopts)
+
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    test = dict(tst.noop_test(), **{
+        "name": f"dgraph {wname}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": DgraphDB(),
+        "net": net.iptables,
+        "nemesis": nm["nemesis"],
+        "trace": opts.get("trace"),
+        "dgraph-factory": opts.get("dgraph-factory"),
+    })
+    wl = builder(opts, test)
+    during = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.nemesis(nm["generator"], wl["generator"]))
+    phases = [during, gen.nemesis(nm["final-generator"], gen.void)]
+    if wl.get("final-generator") is not None:
+        phases += [gen.sleep(opts.get("quiesce", 3)),
+                   gen.clients(wl["final-generator"])]
+    test["generator"] = gen.phases(*phases)
+    test["client"] = wl["client"]
+    test["checker"] = wl["checker"]
+    test.update(wl.get("test-keys") or {})
+    return test
+
+
+def _register(opts, test) -> dict:
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
+    return {"client": RegisterClient(), "generator": wl["generator"],
+            "checker": ck.compose({
+                "linear": wl["checker"],
+                "timeline": independent.checker(
+                    timeline.html_timeline()),
+                "perf": ck.perf()})}
+
+
+def _bank(opts, test) -> dict:
+    wl = bank_wl.workload(opts)
+    return {"client": BankClient(), "generator": wl["generator"],
+            "final-generator": gen.once(bank_wl.read_gen),
+            "checker": ck.compose({"bank": wl["checker"],
+                                   "perf": ck.perf()}),
+            "test-keys": {k: wl[k] for k in
+                          ("accounts", "total-amount", "max-transfer")}}
+
+
+def _delete(opts, test) -> dict:
+    """delete.clj: writes/deletes/reads of one record; any read must
+    be either nil or a value some write produced."""
+    vals = gen.counter_source("write")
+
+    def delete(t, p):
+        return {"type": "invoke", "f": "delete", "value": None}
+
+    def read(t, p):
+        return {"type": "invoke", "f": "read", "value": None}
+
+    class DeleteChecker(ck.Checker):
+        def check(self, tst_, history, opts_=None):
+            from jepsen_tpu.history import History
+            written, errs = set(), []
+            for o in History(history):
+                if o.f == "write" and o.is_invoke:
+                    written.add(o.value)
+                elif o.f == "read" and o.is_ok and o.value is not None:
+                    if o.value not in written:
+                        errs.append({"op-index": o.index,
+                                     "value": o.value})
+            return {"valid?": not errs, "phantoms": errs}
+
+    return {"client": DeleteClient(),
+            "generator": gen.mix([vals, delete, read]),
+            "checker": ck.compose({"delete": DeleteChecker(),
+                                   "perf": ck.perf()})}
+
+
+def _upsert(opts, test) -> dict:
+    wl = upsert_wl.workload(opts)
+    return {"client": UpsertClient(), "generator": wl["generator"],
+            "checker": ck.compose({"upsert": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _set(opts, test) -> dict:
+    wl = sets_wl.workload(opts)
+    return {"client": SetClient(), "generator": wl["generator"],
+            "final-generator": wl["final-generator"],
+            "checker": ck.compose({"set": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _sequential(opts, test) -> dict:
+    wl = sequential_wl.workload(opts)
+    return {"client": SequentialClient(), "generator": wl["generator"],
+            "checker": ck.compose({"sequential": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _long_fork(opts, test) -> dict:
+    wl = long_fork_wl.workload(opts)
+    return {"client": LongForkClient(), "generator": wl["generator"],
+            "checker": ck.compose({"long-fork": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+workloads = {
+    "bank": _bank,
+    "delete": _delete,
+    "long-fork": _long_fork,
+    "linearizable-register": _register,
+    "upsert": _upsert,
+    "set": _set,
+    "sequential": _sequential,
+}
+
+
+def _opt_fn(parser):
+    parser.add_argument("--workload", default="linearizable-register",
+                        choices=sorted(workloads))
+    parser.add_argument("--nemesis", action="append", metavar="FLAG",
+                        choices=["kill-alpha", "kill-zero", "fix-alpha",
+                                 "move-tablets", "partition",
+                                 "clock-skew"],
+                        help="nemesis flags (repeatable)")
+    parser.add_argument("--trace", default=None, metavar="ENDPOINT",
+                        help="enable tracing (optionally a Jaeger "
+                        "collector URL)")
+
+
+def main(argv=None):
+    cli.run(cli.single_test_cmd(dgraph_test, _opt_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

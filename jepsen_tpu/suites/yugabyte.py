@@ -1,0 +1,506 @@
+"""YugabyteDB test suite (reference: `yugabyte/src/yugabyte/` — 1,700
+LoC: core.clj, nemesis.clj, auto.clj plus per-workload files), whose
+distinctive features are:
+
+  * two-daemon automation  — every node runs a yb-master (first 3
+                             nodes) and a yb-tserver; killers target
+                             each daemon separately (nemesis.clj:28-58)
+  * string-keyed nemesis registry — each entry bundles {nemesis,
+                             generator, final-generator,
+                             max-clock-skew-ms} (nemesis.clj:122-166)
+  * strobe-rest cadence    — bursts of 3 start/stop pairs then a long
+                             pause (nemesis.clj:60-83)
+  * healing + quiescence   — tests with a final client generator heal
+                             the cluster, wait for quiescence, then run
+                             the final reads (core.clj:33-45)
+  * workloads              — bank, counter, long-fork, multi-key-acid,
+                             set, single-key-acid (core.clj:1-60)
+
+YSQL speaks the postgres wire protocol, so the SQL client machinery is
+shared with the cockroach suite (suites/cockroach.py SQLClient /
+with_txn_retry / the injectable conn boundary); only the shell driver
+and the automation differ.
+"""
+
+from __future__ import annotations
+
+import random
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem, net
+from jepsen_tpu import nemesis_time as nt
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites.cockroach import (BankClient, RegisterClient,
+                                         SQLClient, SetsClient,
+                                         ShellConn, ensure_table,
+                                         with_txn_retry,
+                                         _rounded_concurrency)
+from jepsen_tpu.workloads import (bank as bank_wl, counter as counter_wl,
+                                  linearizable_register as linreg_wl,
+                                  long_fork as long_fork_wl,
+                                  multi_key_acid as mka_wl,
+                                  sets as sets_wl)
+
+# ---------------------------------------------------------------------------
+# auto — two-daemon cluster automation (auto.clj)
+# ---------------------------------------------------------------------------
+
+VERSION = "2.20.1.0"
+URL = (f"https://downloads.yugabyte.com/releases/{VERSION}/"
+       f"yugabyte-{VERSION}-b97-linux-x86_64.tar.gz")
+DIR = "/opt/yugabyte"
+MASTER_LOG = f"{DIR}/master.log"
+TSERVER_LOG = f"{DIR}/tserver.log"
+MASTER_PID = f"{DIR}/master.pid"
+TSERVER_PID = f"{DIR}/tserver.pid"
+MASTER_RPC_PORT = 7100
+TSERVER_RPC_PORT = 9100
+YSQL_PORT = 5433
+N_MASTERS = 3
+
+
+def master_nodes(test) -> list:
+    """The first three nodes host masters (auto.clj master quorum)."""
+    return (test.get("nodes") or [])[:N_MASTERS]
+
+
+def master_addresses(test) -> str:
+    return ",".join(f"{n}:{MASTER_RPC_PORT}" for n in master_nodes(test))
+
+
+def start_master(test, node) -> None:
+    """auto.clj start-master!"""
+    cu.start_daemon(
+        f"{DIR}/bin/yb-master",
+        "--master_addresses", master_addresses(test),
+        "--rpc_bind_addresses", f"{node}:{MASTER_RPC_PORT}",
+        "--fs_data_dirs", f"{DIR}/data/master",
+        chdir=DIR, logfile=MASTER_LOG, pidfile=MASTER_PID)
+
+
+def start_tserver(test, node) -> None:
+    """auto.clj start-tserver!"""
+    cu.start_daemon(
+        f"{DIR}/bin/yb-tserver",
+        "--tserver_master_addrs", master_addresses(test),
+        "--rpc_bind_addresses", f"{node}:{TSERVER_RPC_PORT}",
+        "--enable_ysql",
+        "--pgsql_proxy_bind_address", f"{node}:{YSQL_PORT}",
+        "--fs_data_dirs", f"{DIR}/data/tserver",
+        chdir=DIR, logfile=TSERVER_LOG, pidfile=TSERVER_PID)
+
+
+def kill_daemon(process: str, signal: str = "9") -> str:
+    """nemesis.clj kill! :14-20 — pkill then verify it's gone: the
+    verification must raise if the process survived (e.g. respawned by
+    a supervisor), or kill-based nemeses silently inject nothing."""
+    cu.grepkill(process, signal=signal)
+    c.execute(lit(f"! ps -ce | grep {process}"))
+    return "killed"
+
+
+def stop_master(test, node) -> str:
+    return kill_daemon("yb-master")
+
+
+def stop_tserver(test, node) -> str:
+    return kill_daemon("yb-tserver")
+
+
+class YugabyteDB(db_mod.DB, db_mod.LogFiles):
+    """Community-edition DB: master (first 3 nodes) + tserver per node
+    (auto.clj community-edition)."""
+
+    def setup(self, test, node):
+        cu.install_archive(URL, DIR)
+        nt.install(test, node)
+        if node in master_nodes(test):
+            start_master(test, node)
+        start_tserver(test, node)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"{DIR}/bin/ysqlsh -h {node} -p {YSQL_PORT} -c 'select 1' "
+            "> /dev/null 2>&1 && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        kill_daemon("yb-tserver")
+        kill_daemon("yb-master")
+        c.execute("rm", "-rf", f"{DIR}/data", check=False)
+
+    def log_files(self, test, node):
+        return [MASTER_LOG, TSERVER_LOG]
+
+
+class YsqlShellConn(ShellConn):
+    """ysqlsh-over-control-plane connection: cockroach's ShellConn with
+    the command + row-parsing hooks swapped.  -q -At suppresses command
+    tags (BEGIN/COMMIT/UPDATE n) and headers so every output line is a
+    data row."""
+
+    ts_expr = "(EXTRACT(EPOCH FROM clock_timestamp()) * 1e6)::BIGINT"
+
+    def _cmd(self, q: str) -> list:
+        return [f"{DIR}/bin/ysqlsh", "-h", self.node,
+                "-p", str(YSQL_PORT), "-q", "-At", "-c", q]
+
+    def _parse(self, text: str) -> list:
+        return [line.split("|")
+                for line in (text or "").splitlines() if line]
+
+
+# ---------------------------------------------------------------------------
+# Workload clients beyond the shared SQL ones
+# ---------------------------------------------------------------------------
+
+class CounterClient(SQLClient):
+    """counter workload: blind increments + reads of one row."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS counter (id INT PRIMARY KEY, c INT)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "counter")
+        if op.f == "add":
+            amt = op.value if op.value is not None else 1
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO counter (id, c) VALUES (0, {amt}) "
+                f"ON CONFLICT (id) DO UPDATE SET c = counter.c + {amt}"))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = with_txn_retry(
+                lambda: self.conn.sql("SELECT c FROM counter WHERE id = 0"))
+            val = int(rows[0][0]) if rows else 0
+            return op.assoc(type="ok", value=val)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class LongForkClient(SQLClient):
+    """long-fork workload: micro-op txns [["w", k, v]] /
+    [["r", k, None], ...] over one table — reads of a group must agree
+    on write order (long_fork.clj)."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS lf (key INT PRIMARY KEY, val INT)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "lf")
+        txn = op.value
+        if op.f == "write":
+            (_, k, v), = txn
+            with_txn_retry(lambda: self.conn.txn([
+                f"INSERT INTO lf (key, val) VALUES ({k}, {v}) "
+                f"ON CONFLICT (key) DO UPDATE SET val = {v}"]))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            # The whole group read MUST be one atomic snapshot (the
+            # point of long-fork); a single statement is atomic on any
+            # conn, so never fall back to per-key transactions.
+            ks = [k for _, k, _ in txn]
+            in_list = ", ".join(str(k) for k in ks)
+            rows = with_txn_retry(lambda: self.conn.txn(
+                [f"SELECT key, val FROM lf WHERE key IN ({in_list})"]))
+            got = {int(r[0]): int(r[1]) for r in rows}
+            filled = [["r", k, got.get(k)] for k in ks]
+            return op.assoc(type="ok", value=filled)
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class MultiKeyAcidClient(SQLClient):
+    """multi-key-acid: one txn writes BOTH keys of a pair to the same
+    value; reads fetch both in one txn
+    (yugabyte/src/yugabyte/multi_key_acid.clj)."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS mka (k INT PRIMARY KEY, v INT)"
+    KEYS = (0, 1)
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "mka")
+        if op.f == "write":
+            v = op.value
+            stmts = [f"INSERT INTO mka (k, v) VALUES ({k}, {v}) "
+                     f"ON CONFLICT (k) DO UPDATE SET v = {v}"
+                     for k in self.KEYS]
+            with_txn_retry(lambda: self.conn.txn(stmts))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            # Both keys in ONE statement — separate per-key txns would
+            # let a write commit between them and fake a fractured read
+            # on a healthy database.
+            in_list = ", ".join(str(k) for k in self.KEYS)
+            rows = with_txn_retry(lambda: self.conn.txn(
+                [f"SELECT k, v FROM mka WHERE k IN ({in_list})"]))
+            got = {int(r[0]): int(r[1]) for r in rows}
+            return op.assoc(type="ok",
+                            value=[got.get(k) for k in self.KEYS])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SingleKeyAcidClient(RegisterClient):
+    """single-key-acid = independent keyed registers; the shared SQL
+    register client already speaks [k, v] KV ops."""
+
+
+# ---------------------------------------------------------------------------
+# Nemesis registry (nemesis.clj:122-166)
+# ---------------------------------------------------------------------------
+
+nemesis_delay = 5     # scaled-down from the reference's 50s for CI
+nemesis_duration = 5
+
+
+def strobe_rest():
+    """3 × (sleep, start, sleep, stop) then a long rest
+    (nemesis.clj strobe/strobe-rest :60-75)."""
+    t = nemesis_delay / 5
+    while True:
+        for _ in range(3):
+            yield gen.sleep(t)
+            yield lambda tst, p: {"type": "info", "f": "start"}
+            yield gen.sleep(t)
+            yield lambda tst, p: {"type": "info", "f": "stop"}
+        yield gen.sleep(2 * t)
+
+
+def gen_start_stop():
+    """nemesis.clj gen-start-stop :77-83."""
+    return gen.gseq(strobe_rest())
+
+
+def _rand_node(nodes):
+    return [random.choice(list(nodes))]
+
+
+def tserver_killer(signal: str = "TERM"):
+    """Kills a random node's tserver on start, restarts on stop
+    (nemesis.clj:28-34)."""
+    return nem.node_start_stopper(
+        _rand_node,
+        lambda test, node: kill_daemon("yb-tserver", signal),
+        lambda test, node: start_tserver(test, node))
+
+
+def master_killer(signal: str = "TERM"):
+    """nemesis.clj:36-42 — only targets master-bearing nodes."""
+    return nem.node_start_stopper(
+        lambda test, nodes: _rand_node(master_nodes(test)),
+        lambda test, node: kill_daemon("yb-master", signal),
+        lambda test, node: start_master(test, node))
+
+
+def node_killer(signal: str = "TERM"):
+    """nemesis.clj:44-58 — both daemons."""
+    def stop_all(test, node):
+        kill_daemon("yb-tserver", signal)
+        kill_daemon("yb-master", signal)
+        return "killed"
+
+    def start_all(test, node):
+        if node in master_nodes(test):
+            start_master(test, node)
+        start_tserver(test, node)
+        return "started"
+    return nem.node_start_stopper(_rand_node, stop_all, start_all)
+
+
+def clock_nemesis_entry(max_skew_ms: int) -> dict:
+    """nemesis.clj clock-nemesis :116-127: random resets/bumps capped
+    to max_skew_ms, clock nemesis client, reset on final."""
+    def bump(test, process):
+        o = nt.bump_gen(test, process)
+        val = {n: max(-max_skew_ms, min(max_skew_ms, int(d)))
+               for n, d in (o.get("value") or {}).items()}
+        o = dict(o)
+        o["value"] = val
+        return o
+
+    return {
+        "nemesis": lambda: nt.clock_nemesis(),
+        "generator": lambda: gen.delay(
+            nemesis_delay, gen.mix([nt.reset_gen] + [bump] * 3)),
+        "final-generator": lambda: gen.once(nt.reset_gen),
+        "max-clock-skew-ms": max_skew_ms,
+    }
+
+
+def start_stop_entry(nemesis_fn) -> dict:
+    """nemesis.clj start-stop :85-91."""
+    return {
+        "nemesis": nemesis_fn,
+        "generator": gen_start_stop,
+        "final-generator": lambda: gen.once(
+            {"type": "info", "f": "stop"}),
+        "max-clock-skew-ms": 0,
+    }
+
+
+nemeses = {
+    "none": {"nemesis": lambda: nem.Noop(),
+             "generator": lambda: gen.void,
+             "final-generator": lambda: gen.void,
+             "max-clock-skew-ms": 0},
+    "start-stop-tserver": start_stop_entry(lambda: tserver_killer()),
+    "start-kill-tserver": start_stop_entry(lambda: tserver_killer("9")),
+    "start-stop-master": start_stop_entry(lambda: master_killer()),
+    "start-kill-master": start_stop_entry(lambda: master_killer("9")),
+    "start-stop-node": start_stop_entry(lambda: node_killer()),
+    "start-kill-node": start_stop_entry(lambda: node_killer("9")),
+    "partition-random-halves": start_stop_entry(
+        nem.partition_random_halves),
+    "partition-random-node": start_stop_entry(
+        nem.partition_random_node),
+    "partition-majorities-ring": start_stop_entry(
+        nem.partition_majorities_ring),
+    "small-skew": clock_nemesis_entry(100),
+    "medium-skew": clock_nemesis_entry(250),
+    "large-skew": clock_nemesis_entry(500),
+    "xlarge-skew": clock_nemesis_entry(1000),
+}
+
+
+# ---------------------------------------------------------------------------
+# Test construction (core.clj yugabyte-test :29-57)
+# ---------------------------------------------------------------------------
+
+def yugabyte_test(opts) -> dict:
+    """Merge a workload's client generator with the nemesis schedule;
+    when the workload has a final generator, append the reference's
+    heal -> quiesce -> final-read phases (core.clj:33-45)."""
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    for key in ("workload", "nemesis"):
+        if key not in opts and av.get(key) is not None:
+            opts[key] = av[key]
+    wname = opts.get("workload") or "single-key-acid"
+    nname = opts.get("nemesis") or "none"
+    if isinstance(nname, list):
+        nname = nname[0]
+    try:
+        builder = workloads[wname]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {wname!r}; one of {sorted(workloads)}")
+    try:
+        nentry = nemeses[nname]
+    except KeyError:
+        raise ValueError(
+            f"unknown nemesis {nname!r}; one of {sorted(nemeses)}")
+
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    test = dict(tst.noop_test(), **{
+        "name": f"yugabyte {wname} {nname}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": YugabyteDB(),
+        "net": net.iptables,
+        "nemesis": nentry["nemesis"](),
+        "max-clock-skew-ms": nentry["max-clock-skew-ms"],
+        "sql-factory": opts.get("sql-factory") or YsqlShellConn,
+    })
+    wl = builder(opts, test)
+
+    during = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.nemesis(nentry["generator"](), wl["generator"]))
+    if wl.get("final-generator") is not None:
+        test["generator"] = gen.phases(
+            during,
+            gen.log("Healing cluster"),
+            gen.nemesis(nentry["final-generator"](), gen.void),
+            gen.log("Waiting for quiescence"),
+            gen.sleep(opts.get("quiesce", 3)),
+            gen.clients(wl["final-generator"]))
+    else:
+        test["generator"] = gen.phases(
+            during,
+            gen.nemesis(nentry["final-generator"](), gen.void))
+    test["client"] = wl["client"]
+    test["checker"] = wl["checker"]
+    test.update(wl.get("test-keys") or {})
+    return test
+
+
+def _bank(opts, test) -> dict:
+    wl = bank_wl.workload(opts)
+    return {"client": BankClient(), "generator": wl["generator"],
+            "final-generator": gen.once(bank_wl.read_gen),
+            "checker": ck.compose({"bank": wl["checker"],
+                                   "perf": ck.perf()}),
+            "test-keys": {k: wl[k] for k in
+                          ("accounts", "total-amount", "max-transfer")}}
+
+
+def _counter(opts, test) -> dict:
+    wl = counter_wl.workload(opts)
+    return {"client": CounterClient(), "generator": wl["generator"],
+            "final-generator": wl["final-generator"],
+            "checker": ck.compose({"counter": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _long_fork(opts, test) -> dict:
+    wl = long_fork_wl.workload(opts)
+    return {"client": LongForkClient(), "generator": wl["generator"],
+            "final-generator": None,
+            "checker": ck.compose({"long-fork": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _multi_key_acid(opts, test) -> dict:
+    wl = mka_wl.workload(opts)
+    return {"client": MultiKeyAcidClient(), "generator": wl["generator"],
+            "final-generator": gen.once(mka_wl.read),
+            "checker": ck.compose({"mka": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _set(opts, test) -> dict:
+    wl = sets_wl.workload(opts)
+    return {"client": SetsClient(), "generator": wl["generator"],
+            "final-generator": wl["final-generator"],
+            "checker": ck.compose({"set": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+def _single_key_acid(opts, test) -> dict:
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
+    return {"client": SingleKeyAcidClient(),
+            "generator": wl["generator"],
+            "final-generator": None,
+            "checker": ck.compose({"linear": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+workloads = {
+    "bank": _bank,
+    "counter": _counter,
+    "long-fork": _long_fork,
+    "multi-key-acid": _multi_key_acid,
+    "set": _set,
+    "single-key-acid": _single_key_acid,
+}
+
+
+def _opt_fn(parser):
+    parser.add_argument("--workload", default="single-key-acid",
+                        choices=sorted(workloads),
+                        help="which workload to run")
+    parser.add_argument("--nemesis", default="none",
+                        choices=sorted(nemeses), metavar="NAME",
+                        help="nemesis: " + ", ".join(sorted(nemeses)))
+
+
+def main(argv=None):
+    cli.run(cli.single_test_cmd(yugabyte_test, _opt_fn), argv)
+
+
+if __name__ == "__main__":
+    main()

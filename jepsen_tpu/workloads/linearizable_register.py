@@ -38,6 +38,32 @@ def cas(test, process):
             "value": [random.randint(0, 4), random.randint(0, 4)]}
 
 
+def suite_workload(opts=None) -> dict:
+    """The register workload shaped the way per-DB suites consume it
+    (etcd.clj:145-180 and the register/cas-register/single-key-acid
+    workloads of the cockroach, aerospike, yugabyte, and dgraph
+    suites): threads-per-key groups over an unbounded key stream,
+    ops-per-key ops staggered 1/10 s, device or host checker.
+
+    Returns {generator, checker, threads-per-key}; the suite supplies
+    its own client and must round test concurrency to a multiple of
+    threads-per-key."""
+    opts = dict(opts or {})
+    tpk = opts.get("threads-per-key", 2)
+    if opts.get("checker-mode", "device") == "device":
+        checker = independent.batch_checker(models.cas_register())
+    else:
+        checker = independent.checker(
+            ck.linearizable({"model": models.cas_register()}))
+    generator = independent.concurrent_generator(
+        tpk, itertools.count(),
+        lambda k: gen.limit(opts.get("ops-per-key", 100),
+                            gen.stagger(1 / 10,
+                                        gen.mix([r, w, cas]))))
+    return {"generator": generator, "checker": checker,
+            "threads-per-key": tpk}
+
+
 def workload(opts=None) -> dict:
     """linearizable_register.clj test :22-45.  Options: nodes (for
     thread-count), per-key-limit (default 128), checker-mode

@@ -1,0 +1,391 @@
+"""Yugabyte / Aerospike / Dgraph suites end-to-end over the dummy
+transport with in-memory backends, plus unit tests for the capped-kill
+nemesis, the healing/quiescence phases, and tracing spans."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, generator as gen, store
+from jepsen_tpu.history import Op
+from jepsen_tpu.suites import aerospike as aero
+from jepsen_tpu.suites import dgraph as dg
+from jepsen_tpu.suites import yugabyte as yb
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def dummy_handler(cmds):
+    def handler(node, cmd, stdin):
+        cmds.append((node, cmd))
+        if "mktemp -d" in cmd:
+            return "/tmp/jepsen.X"
+        if "test -e" in cmd:
+            return "true"
+        if "ls -A" in cmd:
+            return "unpacked\n"
+        return ""
+    return handler
+
+
+# ---------------------------------------------------------------------------
+# Yugabyte: reuses the cockroach SQL machinery, so run it against the
+# same kind of locked-sqlite engine.
+# ---------------------------------------------------------------------------
+
+class MemSQL:
+    def __init__(self):
+        import sqlite3
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.Lock()
+        self.ts = 0
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            ts_expr = "cluster_logical_timestamp()"
+
+            def sql(self, stmt, params=()):
+                with mem.lock:
+                    out = self._run(stmt, params)
+                    mem.db.commit()
+                    return out
+
+            def txn(self, stmts):
+                with mem.lock:
+                    rows = []
+                    for s in stmts:
+                        rows.extend(self._run(s, ()))
+                    mem.db.commit()
+                    return rows
+
+            def atomically(self, body):
+                with mem.lock:
+                    try:
+                        out = body(lambda s, p=(): self._run(s, p))
+                        mem.db.commit()
+                        return out
+                    except BaseException:
+                        mem.db.rollback()
+                        raise
+
+            def _run(self, stmt, params):
+                s = stmt.replace("UPSERT INTO", "REPLACE INTO")
+                s = s.replace("::INT8", "")
+                if "cluster_logical_timestamp()" in s:
+                    mem.ts += 1
+                    s = s.replace("cluster_logical_timestamp()",
+                                  str(mem.ts))
+                cur = mem.db.execute(s, params)
+                return [tuple(r) for r in cur.fetchall()]
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def run_yb(workload, time_limit=2, extra=None):
+    mem = MemSQL()
+    cmds = []
+    control.set_dummy_handler(dummy_handler(cmds))
+    try:
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 4,
+                "time-limit": time_limit, "workload": workload,
+                "ssh": {"dummy": True}, "sql-factory": mem.factory,
+                "ops-per-key": 20, "quiesce": 0.1}
+        opts.update(extra or {})
+        result = core.run(yb.yugabyte_test(opts))
+    finally:
+        control.set_dummy_handler(None)
+    return result, cmds
+
+
+class TestYugabyte:
+    @pytest.mark.parametrize("workload,key", [
+        ("bank", "bank"),
+        ("counter", "counter"),
+        ("long-fork", "long-fork"),
+        ("multi-key-acid", "mka"),
+        ("set", "set"),
+        ("single-key-acid", "linear"),
+    ])
+    def test_workloads_valid(self, workload, key):
+        result, _ = run_yb(workload)
+        res = result["results"]
+        assert res[key]["valid?"] is True, res[key]
+        assert res["valid?"] is True
+
+    def test_healing_phase_runs_final_reads(self):
+        result, _ = run_yb("set")
+        # the final quiesced read happens after the nemesis heal phase
+        reads = [o for o in result["history"]
+                 if o.is_ok and o.f == "read"]
+        assert reads, "final read phase must produce a read"
+
+    def test_two_daemon_provisioning(self):
+        _, cmds = run_yb("counter", time_limit=1)
+        assert any("yb-master" in c for _, c in cmds)
+        assert any("yb-tserver" in c for _, c in cmds)
+        # masters only on the first 3 nodes
+        master_nodes = {n for n, c in cmds
+                        if "yb-master" in c and "start-stop-daemon" in c}
+        assert master_nodes <= {"n1", "n2", "n3"}
+
+    def test_nemesis_registry_complete(self):
+        for name, entry in yb.nemeses.items():
+            assert {"nemesis", "generator", "final-generator",
+                    "max-clock-skew-ms"} <= set(entry), name
+            assert entry["nemesis"]() is not None
+
+    def test_kill_nemesis_run(self):
+        result, cmds = run_yb(
+            "counter", time_limit=2,
+            extra={"nemesis": "start-kill-tserver"})
+        assert result["results"]["valid?"] is True
+        assert any("pkill" in c or "kill" in c for _, c in cmds)
+
+
+# ---------------------------------------------------------------------------
+# Aerospike
+# ---------------------------------------------------------------------------
+
+class MemAero:
+    """In-memory aerospike namespace shared by all nodes."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv = {}
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            def read(self, k):
+                with mem.lock:
+                    return mem.kv.get(k)
+
+            def write(self, k, v):
+                with mem.lock:
+                    mem.kv[k] = v
+
+            def cas(self, k, old, new):
+                with mem.lock:
+                    if mem.kv.get(k) == old:
+                        mem.kv[k] = new
+                        return True
+                    return False
+
+            def add(self, k, delta):
+                with mem.lock:
+                    mem.kv[k] = mem.kv.get(k, 0) + delta
+
+            def read_all(self, k):
+                with mem.lock:
+                    return [v for kk, v in mem.kv.items()
+                            if str(kk).startswith("set-")]
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def run_aero(workload, time_limit=2, extra=None):
+    mem = MemAero()
+    cmds = []
+    control.set_dummy_handler(dummy_handler(cmds))
+    try:
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 4,
+                "time-limit": time_limit, "workload": workload,
+                "ssh": {"dummy": True}, "aero-factory": mem.factory,
+                "ops-per-key": 20, "quiesce": 0.1,
+                "nemesis-interval": 0.3}
+        opts.update(extra or {})
+        result = core.run(aero.test_for(opts))
+    finally:
+        control.set_dummy_handler(None)
+    return result, cmds
+
+
+class TestAerospike:
+    @pytest.mark.parametrize("workload,key", [
+        ("cas-register", "linear"),
+        ("counter", "counter"),
+        ("set", "set"),
+    ])
+    def test_workloads_valid(self, workload, key):
+        result, _ = run_aero(workload)
+        res = result["results"]
+        assert res[key]["valid?"] is True, res[key]
+        assert res["valid?"] is True
+
+    def test_capped_conj(self):
+        s = set()
+        s = aero.capped_conj(s, "n1", 1)
+        assert s == {"n1"}
+        assert aero.capped_conj(s, "n2", 1) == {"n1"}  # at cap
+        assert aero.capped_conj(s, "n1", 1) == {"n1"}  # re-add ok
+
+    def test_kill_nemesis_caps_dead_nodes(self):
+        cmds = []
+        control.set_dummy_handler(dummy_handler(cmds))
+        try:
+            with control.with_ssh({"dummy": True}):
+                dead: set = set()
+                nm = aero.KillNemesis("9", 1, dead)
+                test = {"nodes": ["n1", "n2", "n3"], "sessions": {}}
+                out = nm.invoke(test, Op(
+                    process="nemesis", type="info", f="kill",
+                    value=["n1", "n2"]))
+                vals = out.value
+                # only one node may die (cap 1)
+                assert sorted(vals.values()) == ["killed",
+                                                "still-alive"]
+                assert len(dead) == 1
+                # restart revives the dead node
+                target = next(iter(dead))
+                out = nm.invoke(test, Op(
+                    process="nemesis", type="info", f="restart",
+                    value=[target]))
+                assert out.value[target] == "started"
+                assert not dead
+        finally:
+            control.set_dummy_handler(None)
+
+    def test_full_nemesis_runs(self):
+        result, cmds = run_aero("set", time_limit=2)
+        assert result["results"]["valid?"] is True
+        # the killer actually issued service restarts or kills
+        assert any("aerospike" in c or "killall" in c or "pkill" in c
+                   for _, c in cmds)
+
+
+# ---------------------------------------------------------------------------
+# Dgraph
+# ---------------------------------------------------------------------------
+
+class MemDgraph:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv = {}
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            def get(self, k):
+                with mem.lock:
+                    return mem.kv.get(k)
+
+            def set_kv(self, k, v):
+                with mem.lock:
+                    mem.kv[k] = v
+
+            def delete(self, k):
+                with mem.lock:
+                    mem.kv.pop(k, None)
+
+            def cas(self, k, old, new):
+                with mem.lock:
+                    if mem.kv.get(k) == old:
+                        mem.kv[k] = new
+                        return True
+                    return False
+
+            def upsert(self, k, cand):
+                with mem.lock:
+                    if k in mem.kv:
+                        return mem.kv[k]
+                    mem.kv[k] = cand
+                    return cand
+
+            def read_keys(self, ks):
+                with mem.lock:
+                    return [mem.kv.get(k) for k in ks]
+
+            def all_values(self):
+                with mem.lock:
+                    return [v for k, v in mem.kv.items()
+                            if str(k).startswith("set-")]
+
+            def transfer(self, frm, to, amt, neg_ok):
+                with mem.lock:
+                    bal = mem.kv.get(frm)
+                    if bal is None or (bal < amt and not neg_ok):
+                        return False
+                    mem.kv[frm] = bal - amt
+                    mem.kv[to] = mem.kv.get(to, 0) + amt
+                    return True
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def run_dg(workload, time_limit=2, extra=None):
+    mem = MemDgraph()
+    cmds = []
+    control.set_dummy_handler(dummy_handler(cmds))
+    try:
+        opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 4,
+                "time-limit": time_limit, "workload": workload,
+                "ssh": {"dummy": True}, "dgraph-factory": mem.factory,
+                "ops-per-key": 20, "quiesce": 0.1}
+        opts.update(extra or {})
+        result = core.run(dg.dgraph_test(opts))
+    finally:
+        control.set_dummy_handler(None)
+    return result, cmds
+
+
+class TestDgraph:
+    @pytest.mark.parametrize("workload,key", [
+        ("bank", "bank"),
+        ("delete", "delete"),
+        ("long-fork", "long-fork"),
+        ("linearizable-register", "linear"),
+        ("upsert", "upsert"),
+        ("set", "set"),
+        ("sequential", "sequential"),
+    ])
+    def test_workloads_valid(self, workload, key):
+        result, _ = run_dg(workload)
+        res = result["results"]
+        assert res[key]["valid?"] is True, res[key]
+        assert res["valid?"] is True
+
+    def test_tracing_spans_collected(self):
+        result, _ = run_dg("set", extra={"trace": True})
+        tracer = result.get("tracer")
+        spans = tracer.spans()
+        assert spans, "tracing enabled must collect client spans"
+        assert any(s["name"].startswith("client:") for s in spans)
+
+    def test_two_daemon_provisioning(self):
+        _, cmds = run_dg("set", time_limit=1)
+        assert any("dgraph zero" in c or
+                   ("zero" in c and "start-stop-daemon" in c)
+                   for _, c in cmds)
+        assert any("alpha" in c for _, c in cmds)
+
+    def test_nemesis_flags(self):
+        nm = dg.nemesis_for({"kill-alpha?": True, "partition?": True})
+        fs = set()
+        for _ in range(40):
+            o = gen.op(nm["generator"],
+                       {"nodes": ["n1", "n2", "n3"]}, "nemesis")
+            if o is not None:
+                fs.add(o["f"] if isinstance(o, dict) else o.f)
+        assert "kill-alpha" in fs or "restart-alpha" in fs
+        assert "partition-start" in fs or "partition-stop" in fs
+
+    def test_nemesis_none(self):
+        nm = dg.nemesis_for({})
+        assert gen.op(nm["generator"], {}, "nemesis") is None
