@@ -19,6 +19,24 @@ SUITES = {
     "yugabyte": "jepsen_tpu.suites.yugabyte",
     "aerospike": "jepsen_tpu.suites.aerospike",
     "dgraph": "jepsen_tpu.suites.dgraph",
+    "zookeeper": "jepsen_tpu.suites.zookeeper",
+    "consul": "jepsen_tpu.suites.consul",
+    "rabbitmq": "jepsen_tpu.suites.rabbitmq",
+    "chronos": "jepsen_tpu.suites.chronos",
+    "galera": "jepsen_tpu.suites.galera",
+    "percona": "jepsen_tpu.suites.percona",
+    "tidb": "jepsen_tpu.suites.tidb",
+    "mongodb": "jepsen_tpu.suites.mongodb",
+    "postgres-rds": "jepsen_tpu.suites.postgres_rds",
+    "raftis": "jepsen_tpu.suites.raftis",
+    "logcabin": "jepsen_tpu.suites.logcabin",
+    "disque": "jepsen_tpu.suites.disque",
+    "rethinkdb": "jepsen_tpu.suites.rethinkdb",
+    "mysql-cluster": "jepsen_tpu.suites.mysql_cluster",
+    "hazelcast": "jepsen_tpu.suites.hazelcast",
+    "elasticsearch": "jepsen_tpu.suites.elasticsearch",
+    "crate": "jepsen_tpu.suites.crate",
+    "robustirc": "jepsen_tpu.suites.robustirc",
 }
 
 

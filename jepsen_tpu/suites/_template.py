@@ -1,0 +1,242 @@
+"""Shared scaffolding for the small per-DB suites (reference: the
+~100-500 LoC suites — zookeeper 137, consul 146, raftis 142, logcabin
+246, disque 321, rabbitmq 263, postgres-rds 294 ... — which all follow
+the same shape: DB automation + one client + one workload + a
+partition nemesis + a CLI main, `zookeeper/src/jepsen/zookeeper.clj`
+being the canonical example).
+
+Two client templates:
+
+  * KVRegisterClient — independent-keys register over an injectable
+    conn with get/put/cas (zookeeper's avout atom, consul's KV HTTP
+    API, mongo documents, redis keys ... all reduce to this)
+  * QueueClient — enqueue/dequeue/drain over an injectable conn
+    (rabbitmq channels, disque jobs)
+
+and two test builders wiring them to the standard checkers + the
+reference's default partitioner nemesis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, nemesis as nem, net
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+from jepsen_tpu.workloads import queue as queue_wl
+from jepsen_tpu.suites.cockroach import _rounded_concurrency
+
+
+class KVRegisterClient(client_mod.Client):
+    """Register ops over a conn with get(k) / put(k, v) /
+    cas(k, old, new) -> bool.  Ops carry independent [k, v] tuples;
+    the standard error taxonomy applies (timeouts indeterminate,
+    refused definite)."""
+
+    factory_key = "kv-factory"
+
+    def __init__(self, conn_factory: Optional[Callable] = None):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = type(self)(test.get(self.factory_key)
+                         or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            k, v = op.value
+            if op.f == "read":
+                return op.assoc(type="ok",
+                                value=independent.tuple_(
+                                    k, self.conn.get(k)))
+            if op.f == "write":
+                self.conn.put(k, v)
+                return op.assoc(type="ok")
+            if op.f == "cas":
+                old, new = v
+                ok = self.conn.cas(k, old, new)
+                return op.assoc(type="ok" if ok else "fail")
+            raise ValueError(f"unknown f {op.f!r}")
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except ConnectionRefusedError as e:
+            return op.assoc(type="fail", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="info", error=str(e))
+
+
+class QueueClient(client_mod.Client):
+    """Queue ops over a conn with enqueue(v) / dequeue() -> v|None /
+    drain() -> [v...]."""
+
+    factory_key = "queue-factory"
+
+    def __init__(self, conn_factory: Optional[Callable] = None):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = type(self)(test.get(self.factory_key)
+                         or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "enqueue":
+                self.conn.enqueue(op.value)
+                return op.assoc(type="ok")
+            if op.f == "dequeue":
+                v = self.conn.dequeue()
+                if v is None:
+                    return op.assoc(type="fail", error="empty")
+                return op.assoc(type="ok", value=v)
+            if op.f == "drain":
+                return op.assoc(type="ok", value=self.conn.drain())
+            raise ValueError(f"unknown f {op.f!r}")
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except ConnectionRefusedError as e:
+            return op.assoc(type="fail", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="info", error=str(e))
+
+
+def register_test(name: str, db, client: client_mod.Client,
+                  opts: dict, nemesis: Optional[nem.Nemesis] = None,
+                  factory_key: str = "kv-factory") -> dict:
+    """The zookeeper.clj test shape: independent-keys register checked
+    for per-key linearizability, partition-random-halves nemesis on
+    the standard cadence."""
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = linreg_wl.suite_workload(opts)
+    test = dict(tst.noop_test(), **{
+        "name": name,
+        "nodes": nodes,
+        "concurrency": _rounded_concurrency(opts,
+                                            wl["threads-per-key"]),
+        "ssh": opts.get("ssh", {}),
+        "db": db,
+        "client": client,
+        "net": net.iptables,
+        "nemesis": (nemesis if nemesis is not None
+                    else nem.partition_random_halves()),
+        factory_key: opts.get(factory_key),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                wl["generator"])),
+        "checker": ck.compose({
+            "linear": wl["checker"],
+            "timeline": independent.checker(timeline.html_timeline()),
+            "perf": ck.perf()}),
+    })
+    return test
+
+
+def queue_test(name: str, db, client: client_mod.Client,
+               opts: dict, nemesis: Optional[nem.Nemesis] = None,
+               factory_key: str = "queue-factory") -> dict:
+    """The rabbitmq.clj test shape: enqueue/dequeue + full drain,
+    total-queue multiset accounting (plus the linearizable queue
+    checker with `linear`)."""
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = queue_wl.workload(opts)
+    test = dict(tst.noop_test(), **{
+        "name": name,
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": db,
+        "client": client,
+        "net": net.iptables,
+        "nemesis": (nemesis if nemesis is not None
+                    else nem.partition_random_halves()),
+        factory_key: opts.get(factory_key),
+        # the workload bounds itself (time-limit inside drain_queue) —
+        # an OUTER gen.time_limit would cut off the drain dequeues.
+        # Only the nemesis side gets the deadline, or its endless
+        # start/stop cycle would keep the run alive forever.
+        "generator": gen.nemesis(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5))),
+            wl["generator"]),
+        "checker": ck.compose({
+            "queue": wl["checker"],
+            "perf": ck.perf()}),
+    })
+    return test
+
+
+def simple_main(test_fn: Callable, opt_fn: Optional[Callable] = None):
+    """Build the standard -main for a small suite."""
+    def main(argv=None):
+        cli.run(cli.single_test_cmd(test_fn, opt_fn), argv)
+    return main
+
+
+def workload_main(tests: dict, default: str):
+    """The registry-dispatch boilerplate shared by every multi-workload
+    suite: (test_for, opt_fn, main) resolving --workload through the
+    CLI's argv-options submap."""
+    def test_for(opts) -> dict:
+        opts = dict(opts or {})
+        av = opts.get("argv-options") or {}
+        if "workload" not in opts and av.get("workload"):
+            opts["workload"] = av["workload"]
+        name = opts.get("workload") or default
+        try:
+            ctor = tests[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown workload {name!r}; one of {sorted(tests)}")
+        return ctor(opts)
+
+    def opt_fn(parser):
+        parser.add_argument("--workload", default=default,
+                            choices=sorted(tests))
+
+    return test_for, opt_fn, simple_main(test_for, opt_fn)
+
+
+def nemesis_schedule(opts, test, wl_gen, final_gen=None) -> None:
+    """The standard phase wiring: time-limited workload under a
+    start/stop nemesis cadence, heal, then (optionally) quiesce +
+    final client reads."""
+    during = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.nemesis(gen.start_stop(opts.get("nemesis-interval", 5),
+                                   opts.get("nemesis-interval", 5)),
+                    wl_gen))
+    phases = [during,
+              gen.nemesis(gen.once({"type": "info", "f": "stop"}))]
+    if final_gen is not None:
+        phases += [gen.sleep(opts.get("quiesce", 3)),
+                   gen.clients(final_gen)]
+    test["generator"] = gen.phases(*phases)

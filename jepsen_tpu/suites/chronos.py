@@ -1,0 +1,389 @@
+"""Chronos test suite (reference: `chronos/src/jepsen/chronos.clj` +
+`chronos/checker.clj`, 750 LoC): a cron-scheduler correctness test.
+Jobs are submitted with an ISO8601 repeating schedule {start, count,
+interval, epsilon, duration}; each run logs its start/end times on the
+node; after healing + a long quiescent wait, one final read collects
+every run log and the checker matches **expected targets** (the
+schedule unrolled up to the read time) against **actual runs**,
+reporting missed and extra executions per job (checker.clj:30-120).
+
+Jobs are constructed with non-overlapping windows
+(interval > duration + 2*epsilon, chronos.clj add-job :196-215), so
+the disjoint greedy riffle matcher is exact."""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem, net
+from jepsen_tpu.control import lit
+from jepsen_tpu.history import History
+from jepsen_tpu.suites._template import simple_main
+
+EPSILON_FORGIVENESS = 5  # seconds of grace (checker.clj:26-28)
+JOB_DIR = "/tmp/chronos-test"
+
+
+# ---------------------------------------------------------------------------
+# Checker (chronos/checker.clj)
+# ---------------------------------------------------------------------------
+
+def job_targets(read_time: float, job: dict) -> list:
+    """[(start, latest-allowed-start)] for every scheduled execution
+    that MUST have begun by read_time (checker.clj job->targets
+    :30-47: runs may start up to epsilon late and take duration to
+    finish, so the cutoff is read_time - epsilon - duration)."""
+    finish = read_time - job["epsilon"] - job["duration"]
+    forgive = job.get("epsilon-forgiveness", EPSILON_FORGIVENESS)
+    out = []
+    t = job["start"]
+    for _ in range(job["count"]):
+        if t >= finish:
+            break
+        out.append((t, t + job["epsilon"] + forgive))
+        t += job["interval"]
+    return out
+
+
+def complete_incomplete(runs: list) -> tuple:
+    """Partition runs into completed (have an end time) and incomplete,
+    both sorted by start (checker.clj:59-77)."""
+    complete = sorted((r for r in runs if r.get("end") is not None),
+                      key=lambda r: r["start"])
+    incomplete = sorted((r for r in runs if r.get("end") is None),
+                        key=lambda r: r["start"])
+    return complete, incomplete
+
+
+def disjoint_job_solution(targets: list, runs: list) -> dict:
+    """Riffle sorted targets and runs into {target: run-or-None}
+    (checker.clj disjoint-job-solution :79-115).  Requires disjoint
+    target windows — guaranteed by the generator's interval choice."""
+    for (s1, e1), (s2, _) in zip(targets, targets[1:]):
+        assert e1 < s2, "targets must be disjoint"
+    out = {}
+    ti, ri = 0, 0
+    while ti < len(targets):
+        target = targets[ti]
+        if ri >= len(runs):
+            out[target] = None
+            ti += 1
+            continue
+        run = runs[ri]
+        if run["start"] < target[0]:
+            ri += 1
+        elif target[1] < run["start"]:
+            out[target] = None
+            ti += 1
+        else:
+            out[target] = run
+            ti += 1
+            ri += 1
+    return out
+
+
+def job_solution(read_time: float, job: dict, runs: list) -> dict:
+    """Match one job's targets to its runs (checker.clj job-solution)."""
+    targets = job_targets(read_time, job)
+    complete, incomplete = complete_incomplete(runs)
+    sol = disjoint_job_solution(targets, complete)
+    missed = [t for t, r in sol.items() if r is None]
+    # an incomplete run can excuse a missed target (it started)
+    for r in incomplete:
+        for t in list(missed):
+            if t[0] <= r["start"] <= t[1]:
+                missed.remove(t)
+                break
+    extra = max(0, len(complete) - (len(targets) - len(missed)))
+    return {"valid?": not missed,
+            "job": job["name"],
+            "target-count": len(targets),
+            "run-count": len(runs),
+            "missed": [list(t) for t in sorted(missed)],
+            "extra-count": extra}
+
+
+class ChronosChecker(ck.Checker):
+    """checker.clj checker :294-321: last read supplies the runs; all
+    ok add-jobs supply the schedules."""
+
+    def check(self, test, history, opts=None):
+        h = History(history)
+        read_time = None
+        runs = None
+        for o in reversed(list(h)):
+            if o.f == "read" and o.is_ok and runs is None:
+                runs = o.value
+                # preferred: the client's wall-clock stamp; fallback:
+                # relative op time off the test's start epoch
+                read_time = o.get("wall_invoke") or read_time
+            if o.f == "read" and o.is_invoke and read_time is None:
+                read_time = ((test.get("start-epoch") or 0)
+                             + (o.time or 0) / 1e9)
+        jobs = [o.value for o in h
+                if o.f == "add-job" and o.is_ok]
+        if runs is None:
+            return {"valid?": "unknown", "error": "no read completed"}
+        by_job: dict = {}
+        for r in runs:
+            by_job.setdefault(r["name"], []).append(r)
+        solutions = [job_solution(read_time, job,
+                                  by_job.get(job["name"], []))
+                     for job in jobs]
+        return {"valid?": all(s["valid?"] for s in solutions),
+                "job-count": len(jobs),
+                "solutions": solutions}
+
+
+# ---------------------------------------------------------------------------
+# DB + client (chronos.clj)
+# ---------------------------------------------------------------------------
+
+class ChronosDB(db_mod.DB, db_mod.LogFiles):
+    """mesos master+slave plus chronos per node (chronos.clj db)."""
+
+    def setup(self, test, node):
+        c.execute("mkdir", "-p", JOB_DIR, check=False)
+        c.execute("service", "mesos-master", "restart", check=False)
+        c.execute("service", "mesos-slave", "restart", check=False)
+        c.execute("service", "chronos", "restart", check=False)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"curl -sf http://{node}:4400/scheduler/jobs "
+            "> /dev/null && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        for svc in ("chronos", "mesos-slave", "mesos-master"):
+            c.execute("service", svc, "stop", check=False)
+        c.execute("rm", "-rf", JOB_DIR, check=False)
+
+    def log_files(self, test, node):
+        return ["/var/log/mesos/mesos-master.INFO",
+                "/var/log/chronos/chronos.log"]
+
+
+class HttpScheduler:
+    """Production conn: the Chronos HTTP scheduler API + run-log
+    collection over the control plane (chronos.clj add-job!/read-runs).
+    Tests inject an in-memory scheduler with the same surface."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def add_job(self, job: dict) -> None:
+        import json
+        body = {
+            "name": str(job["name"]),
+            "command": (f"MEW=$(mktemp -p {JOB_DIR}); "
+                        f"echo {job['name']} >> $MEW; "
+                        "date -u +%s.%N >> $MEW; "
+                        f"sleep {job['duration']}; "
+                        "date -u +%s.%N >> $MEW;"),
+            "schedule": (f"R{job['count']}/"
+                         + time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime(job["start"]))
+                         + f"/PT{job['interval']}S"),
+            "scheduleTimeZone": "UTC",
+            "epsilon": f"PT{job['epsilon']}S",
+            "owner": "jepsen@jepsen.io",
+            "mem": 1, "disk": 1, "cpus": 0.001, "async": False,
+        }
+        with c.with_session(self.node, self._session):
+            c.execute("curl", "-sf", "-X", "POST",
+                      "-H", "Content-Type: application/json",
+                      "-d", json.dumps(body),
+                      f"http://{self.node}:4400/scheduler/iso8601")
+
+    def read_runs(self, test) -> list:
+        """Collect every run log from every node
+        (chronos.clj read-runs :160-172)."""
+        def collect(t, node):
+            out = c.execute(lit(
+                f"cat {JOB_DIR}/* 2>/dev/null || true"))
+            runs = []
+            lines = (out or "").splitlines()
+            for i in range(0, len(lines) - 1, 3):
+                chunk = lines[i:i + 3]
+                try:
+                    runs.append({
+                        "node": node,
+                        "name": int(chunk[0]),
+                        "start": float(chunk[1]),
+                        "end": (float(chunk[2])
+                                if len(chunk) > 2 and chunk[2]
+                                else None)})
+                except (ValueError, IndexError):
+                    continue
+            return runs
+        per_node = c.on_nodes(test, collect)
+        return [r for rs in per_node.values() for r in rs]
+
+    def close(self):
+        self._session.close()
+
+
+class ChronosClient(client_mod.Client):
+    def __init__(self, conn_factory=HttpScheduler):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = ChronosClient(test.get("chronos-factory")
+                            or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None:
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add-job":
+                self.conn.add_job(op.value)
+                return op.assoc(type="ok")
+            if op.f == "read":
+                # Stamp the absolute invocation time: op.time is
+                # relative to the post-setup origin, so deriving the
+                # read time from start-epoch + op.time would be early
+                # by the whole setup duration and shrink the target
+                # cutoff (silent false negatives).
+                wall = time.time()
+                return op.assoc(type="ok",
+                                value=self.conn.read_runs(test),
+                                wall_invoke=wall)
+            raise ValueError(f"unknown f {op.f!r}")
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="fail", error=str(e))
+
+
+class AddJobGen(gen.Generator):
+    """chronos.clj add-job :196-215: schedules start slightly in the
+    future; interval > duration + 2*epsilon so targets never overlap."""
+
+    def __init__(self, scale: float = 1.0):
+        self.ids = 0
+        self.lock = threading.Lock()
+        self.scale = scale
+
+    def op(self, test, process):
+        with self.lock:
+            self.ids += 1
+            name = self.ids
+        s = self.scale
+        head_start = 10 * s
+        duration = random.randint(0, 10) * s
+        epsilon = (10 + random.randint(0, 20)) * s
+        interval = (1 + duration + epsilon + EPSILON_FORGIVENESS * s
+                    + random.randint(0, 30) * s)
+        return {"type": "invoke", "f": "add-job",
+                "value": {"name": name,
+                          "start": time.time() + head_start,
+                          "count": 1 + random.randint(0, 99),
+                          "duration": duration,
+                          "epsilon": epsilon,
+                          # scaled with the schedule so target windows
+                          # stay disjoint at any scale
+                          "epsilon-forgiveness":
+                              EPSILON_FORGIVENESS * s,
+                          "interval": interval}}
+
+
+class ResurrectionHub(nem.Nemesis):
+    """chronos.clj resurrection-hub :218-236: wraps a nemesis; on
+    :resurrect, restarts mesos + chronos everywhere (they crash
+    constantly)."""
+
+    def __init__(self, inner: nem.Nemesis):
+        self.inner = inner
+
+    def setup(self, test):
+        self.inner = self.inner.setup(test) or self.inner
+        return self
+
+    def invoke(self, test, op):
+        if op.f != "resurrect":
+            return self.inner.invoke(test, op)
+
+        def res(t, node):
+            for svc in ("mesos-master", "mesos-slave", "chronos"):
+                c.execute("service", svc, "restart", check=False)
+            return "resurrection-complete"
+        return op.assoc(value=c.on_nodes(test, res))
+
+    def teardown(self, test):
+        self.inner.teardown(test)
+
+
+def chronos_test(opts) -> dict:
+    """chronos.clj simple-test :240-270, time constants scaled by
+    `scale` so CI runs don't take 850 s."""
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    if "scale" not in opts and av.get("scale") is not None:
+        opts["scale"] = av["scale"]
+    scale = float(opts.get("scale", 1.0))
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    test = dict(tst.noop_test(), **{
+        "name": "chronos",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": ChronosDB(),
+        "net": net.iptables,
+        "chronos-factory": opts.get("chronos-factory"),
+        "start-epoch": time.time(),
+        "nemesis": ResurrectionHub(nem.partition_random_halves()),
+        "checker": ck.compose({"chronos": ChronosChecker(),
+                               "perf": ck.perf()}),
+    })
+
+    def nemesis_steps():
+        while True:
+            yield gen.sleep(200 * scale)
+            yield lambda t, p: {"type": "info", "f": "start"}
+            yield gen.sleep(200 * scale)
+            yield lambda t, p: {"type": "info", "f": "stop"}
+            yield lambda t, p: {"type": "info", "f": "resurrect"}
+
+    test["generator"] = gen.phases(
+        gen.time_limit(
+            opts.get("time-limit", 450 * scale),
+            gen.nemesis(
+                gen.gseq(nemesis_steps()),
+                gen.stagger(30 * scale,
+                            gen.delay(30 * scale, AddJobGen(scale))))),
+        gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+        gen.nemesis(gen.once({"type": "info", "f": "resurrect"})),
+        gen.log("Waiting for executions"),
+        gen.sleep(opts.get("quiesce", 400 * scale)),
+        gen.clients(gen.once(
+            lambda t, p: {"type": "invoke", "f": "read",
+                          "value": None})))
+    test["client"] = ChronosClient()
+    return test
+
+
+def _opt_fn(parser):
+    parser.add_argument("--scale", type=float, default=1.0,
+                        help="scale every schedule/wait constant (the "
+                        "reference's run takes ~850 s at scale 1)")
+
+
+main = simple_main(chronos_test, _opt_fn)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Consul test suite (reference: `consul/src/jepsen/consul.clj`,
+146 LoC): single-binary agent with one bootstrap server, linearizable
+register over the KV HTTP API (`?cas=<ModifyIndex>` conditional
+writes), partition nemesis."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+VERSION = "1.17.0"
+URL = (f"https://releases.hashicorp.com/consul/{VERSION}/"
+       f"consul_{VERSION}_linux_amd64.zip")
+DIR = "/opt/consul"
+DATA = f"{DIR}/data"
+PIDFILE = f"{DIR}/consul.pid"
+LOGFILE = f"{DIR}/consul.log"
+HTTP_PORT = 8500
+
+
+class ConsulDB(db_mod.DB, db_mod.LogFiles):
+    """consul.clj db: first node bootstraps, the rest join it."""
+
+    def setup(self, test, node):
+        cu.install_archive(URL, DIR)
+        first = (test.get("nodes") or [node])[0]
+        args = [f"{DIR}/consul", "agent", "-server",
+                "-data-dir", DATA, "-bind", node,
+                "-client", "0.0.0.0", "-node", node]
+        if node == first:
+            args += ["-bootstrap-expect", "1"]
+        else:
+            args += ["-retry-join", first]
+        cu.start_daemon(*args, chdir=DIR, logfile=LOGFILE,
+                        pidfile=PIDFILE)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"curl -sf http://{node}:{HTTP_PORT}/v1/status/leader "
+            "| grep -q : && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(PIDFILE, f"{DIR}/consul")
+        c.execute("rm", "-rf", DATA, check=False)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class ConsulHttpConn:
+    """KV API over the control plane: GET /v1/kv/<k>, PUT with
+    ?cas=<ModifyIndex> for the conditional write (consul.clj cas!)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _curl(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("curl", "-sf", *args, check=False)
+
+    def _kv(self, k) -> Optional[dict]:
+        out = self._curl(
+            f"http://{self.node}:{HTTP_PORT}/v1/kv/jepsen-r{k}")
+        try:
+            rows = json.loads(out or "[]")
+        except ValueError:
+            return None
+        return rows[0] if rows else None
+
+    def get(self, k) -> Optional[int]:
+        import base64
+        kv = self._kv(k)
+        if not kv or kv.get("Value") is None:
+            return None
+        return int(base64.b64decode(kv["Value"]).decode())
+
+    def put(self, k, v) -> None:
+        self._curl("-X", "PUT", "-d", str(v),
+                   f"http://{self.node}:{HTTP_PORT}/v1/kv/jepsen-r{k}")
+
+    def cas(self, k, old, new) -> bool:
+        kv = self._kv(k)
+        if kv is None:
+            return False
+        import base64
+        cur = (int(base64.b64decode(kv["Value"]).decode())
+               if kv.get("Value") is not None else None)
+        if cur != old:
+            return False
+        out = self._curl(
+            "-X", "PUT", "-d", str(new),
+            f"http://{self.node}:{HTTP_PORT}/v1/kv/jepsen-r{k}"
+            f"?cas={kv['ModifyIndex']}")
+        return (out or "").strip() == "true"
+
+    def close(self):
+        self._session.close()
+
+
+def consul_test(opts) -> dict:
+    return register_test("consul", ConsulDB(), KVRegisterClient(
+        (opts or {}).get("kv-factory") or ConsulHttpConn), opts)
+
+
+main = simple_main(consul_test)
+
+if __name__ == "__main__":
+    main()

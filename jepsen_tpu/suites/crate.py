@@ -1,0 +1,187 @@
+"""CrateDB test suite (reference: `crate/src/jepsen/crate.clj` +
+workloads, 1,060 LoC): SQL over an elasticsearch core — the
+lost-updates hunt via `_version`-guarded UPDATEs (optimistic CC
+register) and the sets workload over refreshed reads.  Speaks the
+postgres wire protocol, so the conn reuses the cockroach shell-conn
+hooks with crate's `crash` CLI."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (nemesis_schedule,
+                                         workload_main)
+from jepsen_tpu.suites.cockroach import (SQLClient, ShellConn,
+                                         ensure_table, with_txn_retry,
+                                         _rounded_concurrency)
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+from jepsen_tpu.workloads import sets as sets_wl
+
+DIR = "/opt/crate"
+PSQL_PORT = 5432
+HTTP_PORT = 4200
+
+
+class CrateDB(db_mod.DB, db_mod.LogFiles):
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        cfg = (f"cluster.name: jepsen\n"
+               f"node.name: {node}\n"
+               f"network.host: {node}\n"
+               "discovery.seed_hosts: ["
+               + ", ".join(nodes) + "]\n"
+               "cluster.initial_master_nodes: ["
+               + ", ".join(nodes[:3]) + "]\n")
+        c.upload_str(cfg, f"{DIR}/config/crate.yml")
+        cu.start_daemon(f"{DIR}/bin/crate", "-d",
+                        "-p", f"{DIR}/crate.pid",
+                        chdir=DIR, logfile=f"{DIR}/logs/jepsen.log",
+                        pidfile=f"{DIR}/crate.pid")
+        c.execute(lit(
+            "for i in $(seq 1 120); do "
+            f"curl -sf http://{node}:{HTTP_PORT}/ "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/crate.pid", "crate")
+        c.execute("rm", "-rf", f"{DIR}/data", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/logs/jepsen.log"]
+
+
+class CrateShellConn(ShellConn):
+    """crash (crate shell) conn; crate has no multi-statement txns, so
+    txn() degrades to sequential statements — the workloads used here
+    (versioned register, sets) only need single statements."""
+
+    def _cmd(self, q: str) -> list:
+        return [f"{DIR}/bin/crash", "--hosts",
+                f"http://{self.node}:{HTTP_PORT}", "--format", "tabular",
+                "-c", q]
+
+    def _parse(self, text: str) -> list:
+        return [line.split("|")
+                for line in (text or "").splitlines()
+                if line and not line.startswith(("+", "SELECT",
+                                                 "CREATE", "INSERT",
+                                                 "UPDATE"))]
+
+    def txn(self, stmts: list) -> list:
+        rows = []
+        for s in stmts:
+            rows.extend(self.sql(s))
+        return rows
+
+
+class VersionedRegisterClient(SQLClient):
+    """crate.clj lost-updates client: CAS via _version-guarded UPDATE
+    (optimistic concurrency — the anomaly crate exhibited)."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS registers "
+           "(id INT PRIMARY KEY, val INT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "registers")
+        k, v = op.value
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT val FROM registers WHERE id = ?", (k,)))
+            return op.assoc(type="ok", value=independent.tuple_(
+                k, int(rows[0][0]) if rows else None))
+        if op.f == "write":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO registers (id, val) VALUES ({k}, {v}) "
+                f"ON CONFLICT (id) DO UPDATE SET val = {v}"))
+            return op.assoc(type="ok")
+        if op.f == "cas":
+            old, new = v
+            versioned = getattr(self.conn, "cas", None)
+            if versioned is not None:
+                return op.assoc(
+                    type="ok" if versioned(k, old, new) else "fail")
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT _version FROM registers "
+                f"WHERE id = {k} AND val = {old}"))
+            if not rows:
+                return op.assoc(type="fail")
+            ver = rows[0][0]
+            out = with_txn_retry(lambda: self.conn.sql(
+                f"UPDATE registers SET val = {new} "
+                f"WHERE id = {k} AND _version = {ver} "
+                "RETURNING val"))
+            return op.assoc(type="ok" if out else "fail")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class SetsClient(SQLClient):
+    DDL = "CREATE TABLE IF NOT EXISTS sets (val INT PRIMARY KEY)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "sets")
+        if op.f == "add":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO sets (val) VALUES ({op.value})"))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            self.conn.sql("REFRESH TABLE sets")
+            rows = with_txn_retry(
+                lambda: self.conn.sql("SELECT val FROM sets"))
+            return op.assoc(type="ok",
+                            value=sorted(int(r[0]) for r in rows))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def base(opts, name) -> dict:
+    from jepsen_tpu import tests as tst
+
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    return dict(tst.noop_test(), **{
+        "name": f"crate {name}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": CrateDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "sql-factory": opts.get("sql-factory") or CrateShellConn,
+    })
+
+
+def register_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "register")
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
+    test["client"] = VersionedRegisterClient()
+    test["checker"] = ck.compose({"linear": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, wl["generator"])
+    return test
+
+
+def sets_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "sets")
+    wl = sets_wl.workload(opts)
+    test["client"] = SetsClient()
+    test["checker"] = ck.compose({"set": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 10, wl["generator"]),
+                     final_gen=wl["final-generator"])
+    return test
+
+
+tests = {"register": register_test, "sets": sets_test}
+
+test_for, _opt_fn, main = workload_main(tests, "register")
+
+if __name__ == "__main__":
+    main()

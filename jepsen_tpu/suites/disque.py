@@ -1,0 +1,95 @@
+"""Disque test suite (reference: `disque/src/jepsen/disque.clj`,
+321 LoC): the redis-family distributed job queue — enqueue/dequeue
+with acks (ADDJOB/GETJOB/ACKJOB), total-queue multiset accounting over
+a full post-run drain."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (QueueClient, queue_test,
+                                         simple_main)
+
+DIR = "/opt/disque"
+PORT = 7711
+QUEUE = "jepsen"
+
+
+class DisqueDB(db_mod.DB, db_mod.LogFiles):
+    """disque.clj db: build/install the server, CLUSTER MEET the first
+    node."""
+
+    def setup(self, test, node):
+        cu.start_daemon(f"{DIR}/disque-server",
+                        "--port", str(PORT),
+                        "--appendonly", "yes",
+                        chdir=DIR, logfile=f"{DIR}/disque.log",
+                        pidfile=f"{DIR}/disque.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"disque -h {node} -p {PORT} ping | grep -q PONG "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+        first = (test.get("nodes") or [node])[0]
+        if node != first:
+            c.execute("disque", "-h", node, "-p", str(PORT),
+                      "cluster", "meet", first, str(PORT),
+                      check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/disque.pid", f"{DIR}/disque-server")
+        c.execute("rm", "-f", f"{DIR}/appendonly.aof", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/disque.log"]
+
+
+class DisqueConn:
+    """ADDJOB/GETJOB/ACKJOB over the disque CLI
+    (disque.clj client :150-220)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _cli(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("disque", "-h", self.node,
+                             "-p", str(PORT), *args, check=False)
+
+    def enqueue(self, v) -> None:
+        self._cli("addjob", QUEUE, str(v), "100",
+                  "replicate", "3", "retry", "1")
+
+    def dequeue(self):
+        out = self._cli("getjob", "nohang", "from", QUEUE)
+        lines = [ln.strip() for ln in (out or "").splitlines()
+                 if ln.strip()]
+        # GETJOB returns queue, job-id, body triples
+        if len(lines) >= 3 and lines[2].lstrip("-").isdigit():
+            self._cli("ackjob", lines[1])
+            return int(lines[2])
+        return None
+
+    def drain(self) -> list:
+        vals = []
+        while True:
+            v = self.dequeue()
+            if v is None:
+                return vals
+            vals.append(v)
+
+    def close(self):
+        self._session.close()
+
+
+def disque_test(opts) -> dict:
+    return queue_test("disque", DisqueDB(), QueueClient(
+        (opts or {}).get("queue-factory") or DisqueConn), opts)
+
+
+main = simple_main(disque_test)
+
+if __name__ == "__main__":
+    main()

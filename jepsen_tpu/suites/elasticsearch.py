@@ -1,0 +1,200 @@
+"""Elasticsearch test suite (reference: `elasticsearch/src/jepsen/
+system/elasticsearch.clj`, 862 LoC): the canonical lost-documents
+hunt — unique docs indexed with wait-for-active-shards, one refreshed
+final read that must find every acknowledged doc (set workload /
+set-full timeline accounting), plus a versioned-update CAS register
+(`_version` conditional writes)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, workload_main)
+from jepsen_tpu.workloads import sets as sets_wl
+
+DIR = "/opt/elasticsearch"
+PORT = 9200
+INDEX = "jepsen"
+
+
+class ElasticsearchDB(db_mod.DB, db_mod.LogFiles):
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        cfg = {
+            "cluster.name": "jepsen",
+            "node.name": node,
+            "network.host": node,
+            "discovery.seed_hosts": nodes,
+            "cluster.initial_master_nodes": nodes[:3],
+        }
+        c.upload_str(
+            "\n".join(f"{k}: {json.dumps(v)}" for k, v in cfg.items())
+            + "\n", f"{DIR}/config/elasticsearch.yml")
+        cu.start_daemon(f"{DIR}/bin/elasticsearch", "-d",
+                        "-p", f"{DIR}/es.pid",
+                        chdir=DIR, logfile=f"{DIR}/logs/jepsen.log",
+                        pidfile=f"{DIR}/es.pid")
+        c.execute(lit(
+            "for i in $(seq 1 120); do "
+            f"curl -sf http://{node}:{PORT}/_cluster/health "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/es.pid", "elasticsearch")
+        c.execute("rm", "-rf", f"{DIR}/data", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/logs/jepsen.log"]
+
+
+class EsHttpConn:
+    """Documents + versioned CAS over the HTTP API."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _curl(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("curl", "-sf", *args, check=False)
+
+    # -- set workload ------------------------------------------------------
+    def add(self, v) -> None:
+        self._curl("-X", "PUT",
+                   "-H", "Content-Type: application/json",
+                   "-d", json.dumps({"value": v}),
+                   f"http://{self.node}:{PORT}/{INDEX}/_doc/{v}"
+                   "?wait_for_active_shards=all")
+
+    def read_all(self) -> list:
+        self._curl("-X", "POST",
+                   f"http://{self.node}:{PORT}/{INDEX}/_refresh")
+        out = self._curl(
+            f"http://{self.node}:{PORT}/{INDEX}/_search"
+            "?size=10000&_source=false")
+        try:
+            hits = json.loads(out or "{}")["hits"]["hits"]
+        except (ValueError, KeyError):
+            return []
+        return sorted(int(h["_id"]) for h in hits)
+
+    # -- register ----------------------------------------------------------
+    def get(self, k) -> Optional[int]:
+        out = self._curl(
+            f"http://{self.node}:{PORT}/{INDEX}-reg/_doc/r{k}")
+        try:
+            return json.loads(out or "{}")["_source"]["value"]
+        except (ValueError, KeyError):
+            return None
+
+    def put(self, k, v) -> None:
+        self._curl("-X", "PUT",
+                   "-H", "Content-Type: application/json",
+                   "-d", json.dumps({"value": v}),
+                   f"http://{self.node}:{PORT}/{INDEX}-reg/_doc/r{k}")
+
+    def cas(self, k, old, new) -> bool:
+        out = self._curl(
+            f"http://{self.node}:{PORT}/{INDEX}-reg/_doc/r{k}")
+        try:
+            doc = json.loads(out or "{}")
+            if doc["_source"]["value"] != old:
+                return False
+            seq, term = doc["_seq_no"], doc["_primary_term"]
+        except (ValueError, KeyError):
+            return False
+        out = self._curl(
+            "-X", "PUT", "-H", "Content-Type: application/json",
+            "-d", json.dumps({"value": new}),
+            f"http://{self.node}:{PORT}/{INDEX}-reg/_doc/r{k}"
+            f"?if_seq_no={seq}&if_primary_term={term}")
+        return "\"result\":\"updated\"" in (out or "")
+
+    def close(self):
+        self._session.close()
+
+
+def set_test(opts) -> dict:
+    from jepsen_tpu import client as client_mod
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = sets_wl.workload(opts)
+
+    class Client(client_mod.Client):
+        def __init__(self, conn_factory=EsHttpConn):
+            self.conn_factory = conn_factory
+            self.conn = None
+
+        def open(self, test, node):
+            out = Client(test.get("es-factory") or self.conn_factory)
+            out.conn = out.conn_factory(node)
+            return out
+
+        def close(self, test):
+            if self.conn is not None and hasattr(self.conn, "close"):
+                self.conn.close()
+
+        def invoke(self, test, op):
+            try:
+                if op.f == "add":
+                    self.conn.add(op.value)
+                    return op.assoc(type="ok")
+                if op.f == "read":
+                    return op.assoc(type="ok",
+                                    value=self.conn.read_all())
+                raise ValueError(f"unknown f {op.f!r}")
+            except TimeoutError as e:
+                return op.assoc(type="info", error=str(e))
+            except (ConnectionError, OSError) as e:
+                return op.assoc(type="info", error=str(e))
+
+    return dict(tst.noop_test(), **{
+        "name": "elasticsearch set",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": ElasticsearchDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "es-factory": opts.get("es-factory"),
+        "client": Client(),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.nemesis(
+                    gen.start_stop(opts.get("nemesis-interval", 5),
+                                   opts.get("nemesis-interval", 5)),
+                    gen.stagger(1 / 10, wl["generator"]))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("quiesce", 3)),
+            gen.clients(wl["final-generator"])),
+        "checker": ck.compose({"set": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+
+
+def reg_test(opts) -> dict:
+    return register_test("elasticsearch register", ElasticsearchDB(),
+                         KVRegisterClient(
+                             (opts or {}).get("kv-factory")
+                             or EsHttpConn), opts)
+
+
+tests = {"set": set_test, "register": reg_test}
+
+test_for, _opt_fn, main = workload_main(tests, "set")
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Galera (MariaDB) test suite (reference: `galera/src/jepsen/galera/`
+— 503 LoC; the percona suite, 482 LoC, is the same shape over Percona
+XtraDB and reuses this module with a different DB): the dirty-reads
+workload — writer txns set every row to one value, readers scanning
+mid-txn must never observe a mix, nor values from aborted writes
+(dirty_reads.clj)."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import simple_main
+from jepsen_tpu.suites.cockroach import (Definite, SQLClient,
+                                         ensure_table, with_txn_retry)
+from jepsen_tpu.suites.tidb import MysqlShellConn
+from jepsen_tpu.workloads import dirty_reads as dr_wl
+
+N_ROWS = 2  # rows the writer txn spans (dirty_reads.clj:40-47)
+
+GALERA_CNF = """[mysqld]
+wsrep_on=ON
+wsrep_provider=/usr/lib/galera/libgalera_smm.so
+wsrep_cluster_address=gcomm://{peers}
+wsrep_cluster_name=jepsen
+binlog_format=ROW
+default_storage_engine=InnoDB
+innodb_autoinc_lock_mode=2
+"""
+
+
+class GaleraDB(db_mod.DB, db_mod.LogFiles):
+    """galera/db.clj: mariadb-server + galera provider; the first node
+    bootstraps a new cluster."""
+
+    def setup(self, test, node):
+        os_debian.install(["mariadb-server", "galera-4"])
+        peers = ",".join(n for n in (test.get("nodes") or [])
+                         if n != node)
+        c.upload_str(GALERA_CNF.format(peers=peers),
+                     "/etc/mysql/conf.d/galera.cnf")
+        first = (test.get("nodes") or [node])[0]
+        if node == first:
+            c.execute("galera_new_cluster", check=False)
+        else:
+            c.execute("service", "mysql", "restart", check=False)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            "mysql -u root -e 'select 1' > /dev/null 2>&1 "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        c.execute("service", "mysql", "stop", check=False)
+
+    def log_files(self, test, node):
+        return ["/var/log/mysql/error.log"]
+
+
+class GaleraShellConn(MysqlShellConn):
+    def _cmd(self, q: str) -> list:
+        return ["mysql", "-h", self.node, "-u", "root",
+                "-N", "-B", "-e", q]
+
+
+class DirtyReadsClient(SQLClient):
+    """dirty_reads.clj client :30-70: one `dirty` table of N_ROWS
+    rows; a write txn sets every row to op.value; a read scans all
+    rows in one statement."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS dirty (id INT PRIMARY KEY, x INT)"
+
+    def _seed(self, test):
+        from jepsen_tpu.suites.cockroach import _once, _table_lock
+        with _table_lock:
+            if not _once(test, "dirty-seed"):
+                return
+            for i in range(N_ROWS):
+                self.conn.sql("INSERT IGNORE INTO dirty (id, x) "
+                              f"VALUES ({i}, -1)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "dirty")
+        self._seed(test)
+        if op.f == "write":
+            v = op.value
+            stmts = [f"UPDATE dirty SET x = {v} WHERE id = {i}"
+                     for i in range(N_ROWS)]
+
+            def w():
+                self.conn.txn(stmts)
+            try:
+                with_txn_retry(w)
+            except Definite as e:
+                return op.assoc(type="fail", error=str(e))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = self.conn.txn(["SELECT x FROM dirty ORDER BY id"])
+            return op.assoc(type="ok",
+                            value=[int(r[0]) for r in rows])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def dirty_reads_test(opts, db=None, name="galera dirty-reads") -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = dr_wl.workload(opts)
+    test = dict(tst.noop_test(), **{
+        "name": name,
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": db or GaleraDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "sql-factory": opts.get("sql-factory") or GaleraShellConn,
+        "client": DirtyReadsClient(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                gen.stagger(1 / 20, wl["generator"]))),
+        "checker": ck.compose({"dirty-reads": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+    return test
+
+
+main = simple_main(dirty_reads_test)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,161 @@
+"""Hazelcast test suite (reference: `hazelcast/src/jepsen/hazelcast.clj`
++ server/, 448 LoC): in-memory data grid — CAS over an AtomicReference
+(linearizable register), a distributed queue with total-queue
+accounting, and unique IDs from an IdGenerator (the reference's three
+workloads)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient, QueueClient,
+                                         queue_test, register_test,
+                                         workload_main)
+
+DIR = "/opt/hazelcast"
+PORT = 5701
+
+
+class HazelcastDB(db_mod.DB, db_mod.LogFiles):
+    """hazelcast.clj db: the jepsen server jar with a member list."""
+
+    def setup(self, test, node):
+        members = ",".join(test.get("nodes") or [])
+        cu.start_daemon("java", "-jar", f"{DIR}/hazelcast-server.jar",
+                        "--members", members,
+                        chdir=DIR, logfile=f"{DIR}/hazelcast.log",
+                        pidfile=f"{DIR}/hazelcast.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"nc -z {node} {PORT} && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/hazelcast.pid", "java")
+
+    def log_files(self, test, node):
+        return [f"{DIR}/hazelcast.log"]
+
+
+class HzShellConn:
+    """Console-driven AtomicReference + IQueue ops (the reference uses
+    a Java client; production here shells the hazelcast console)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _console(self, cmd: str) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute(f"{DIR}/bin/hz-cli", "--targets",
+                             f"jepsen@{self.node}:{PORT}", "sql",
+                             lit(cmd), check=False)
+
+    def get(self, k) -> Optional[int]:
+        out = (self._console(f"a.get r{k}") or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def put(self, k, v) -> None:
+        self._console(f"a.set r{k} {v}")
+
+    def cas(self, k, old, new) -> bool:
+        out = (self._console(f"a.compareAndSet r{k} {old} {new}")
+               or "").strip()
+        return out.endswith("true")
+
+    def enqueue(self, v) -> None:
+        self._console(f"q.offer {v}")
+
+    def dequeue(self):
+        out = (self._console("q.poll") or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def drain(self) -> list:
+        vals = []
+        while True:
+            v = self.dequeue()
+            if v is None:
+                return vals
+            vals.append(v)
+
+    def close(self):
+        self._session.close()
+
+
+def cas_test(opts) -> dict:
+    return register_test("hazelcast cas-register", HazelcastDB(),
+                         KVRegisterClient(
+                             (opts or {}).get("kv-factory")
+                             or HzShellConn), opts)
+
+
+def hz_queue_test(opts) -> dict:
+    return queue_test("hazelcast queue", HazelcastDB(), QueueClient(
+        (opts or {}).get("queue-factory") or HzShellConn), opts)
+
+
+def unique_ids_test(opts) -> dict:
+    """hazelcast.clj: every generated id must be globally unique
+    (checker.clj unique-ids :630-675)."""
+    from jepsen_tpu import client as client_mod
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+
+    class Client(client_mod.Client):
+        def __init__(self, conn_factory=None):
+            self.conn_factory = conn_factory
+            self.conn = None
+
+        def open(self, test, node):
+            out = Client(test.get("idgen-factory")
+                         or self.conn_factory)
+            if out.conn_factory:
+                out.conn = out.conn_factory(node)
+            return out
+
+        def invoke(self, test, op):
+            if self.conn is None:
+                return op.assoc(type="info", error="no idgen conn")
+            return op.assoc(type="ok", value=self.conn.new_id())
+
+    def gen_id(t, p):
+        return {"type": "invoke", "f": "generate", "value": None}
+
+    return dict(tst.noop_test(), **{
+        "name": "hazelcast unique-ids",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": HazelcastDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "idgen-factory": opts.get("idgen-factory"),
+        "client": Client(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                gen.stagger(1 / 50, gen_id))),
+        "checker": ck.compose({"unique-ids": ck.unique_ids(),
+                               "perf": ck.perf()}),
+    })
+
+
+tests = {"cas-register": cas_test, "queue": hz_queue_test,
+         "unique-ids": unique_ids_test}
+
+test_for, _opt_fn, main = workload_main(tests, "cas-register")
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,98 @@
+"""LogCabin test suite (reference: `logcabin/src/jepsen/logcabin.clj`,
+246 LoC): Raft's reference implementation — a linearizable register
+over its tree-structured keyspace, driven with the `logcabinctl`
+client (conditional write = read version + write-if-unchanged)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+DIR = "/opt/logcabin"
+PORT = 5254
+
+
+class LogCabinDB(db_mod.DB, db_mod.LogFiles):
+    """logcabin.clj db: bootstrap the first node's storage, then run
+    the daemon everywhere and grow the cluster."""
+
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        conf = (f"serverId = {nodes.index(node) + 1}\n"
+                f"listenAddresses = {node}:{PORT}\n"
+                f"storagePath = {DIR}/storage\n")
+        c.upload_str(conf, f"{DIR}/logcabin.conf")
+        if node == nodes[0]:
+            c.execute(f"{DIR}/LogCabin", "--config",
+                      f"{DIR}/logcabin.conf", "--bootstrap",
+                      check=False)
+        cu.start_daemon(f"{DIR}/LogCabin", "--config",
+                        f"{DIR}/logcabin.conf",
+                        chdir=DIR, logfile=f"{DIR}/logcabin.log",
+                        pidfile=f"{DIR}/logcabin.pid")
+        if node == nodes[0]:
+            servers = ";".join(f"{i + 1}={n}:{PORT}"
+                               for i, n in enumerate(nodes))
+            c.execute(f"{DIR}/Reconfigure", "--cluster",
+                      f"{nodes[0]}:{PORT}", "set", lit(servers),
+                      check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/logcabin.pid", f"{DIR}/LogCabin")
+        c.execute("rm", "-rf", f"{DIR}/storage", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/logcabin.log"]
+
+
+class LogCabinCtlConn:
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _ctl(self, *args, check: bool = False) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute(f"{DIR}/logcabinctl",
+                             "--cluster", f"{self.node}:{PORT}",
+                             *args, check=check)
+
+    def get(self, k) -> Optional[int]:
+        out = (self._ctl("read", f"/jepsen/r{k}") or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def put(self, k, v) -> None:
+        self._ctl("write", f"/jepsen/r{k}", str(v), check=True)
+
+    def cas(self, k, old, new) -> bool:
+        # Success must be POSITIVE evidence (a clean exit): with
+        # check=False a connection failure also produces empty output,
+        # and reporting that as a successful CAS fabricates
+        # linearizability violations.
+        try:
+            self._ctl("--condition", f"/jepsen/r{k}:{old}",
+                      "write", f"/jepsen/r{k}", str(new), check=True)
+            return True
+        except c.RemoteError as e:
+            if "condition" in str(e).lower():
+                return False          # definite: predicate failed
+            raise TimeoutError(str(e))  # indeterminate: may have won
+
+    def close(self):
+        self._session.close()
+
+
+def logcabin_test(opts) -> dict:
+    return register_test("logcabin", LogCabinDB(), KVRegisterClient(
+        (opts or {}).get("kv-factory") or LogCabinCtlConn), opts)
+
+
+main = simple_main(logcabin_test)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,130 @@
+"""MongoDB test suite (reference: `mongodb-smartos/` 788 LoC and
+`mongodb-rocks/` 169 LoC — replica-set automation, a linearizable
+compare-and-set document per key via findAndModify, read/write-concern
+options threaded through the test map)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+DIR = "/opt/mongodb"
+DBPATH = f"{DIR}/data"
+PIDFILE = f"{DIR}/mongod.pid"
+LOGFILE = f"{DIR}/mongod.log"
+PORT = 27017
+RS = "jepsen"
+
+
+class MongoDB(db_mod.DB, db_mod.LogFiles, db_mod.Primary):
+    """Replica-set DB: mongod per node; the first node initiates the
+    set over all members (mongodb core.clj)."""
+
+    def __init__(self, storage_engine: str = "wiredTiger"):
+        self.storage_engine = storage_engine
+
+    def setup(self, test, node):
+        c.execute("mkdir", "-p", DBPATH, check=False)
+        cu.start_daemon(
+            "mongod", "--replSet", RS, "--bind_ip_all",
+            "--port", str(PORT), "--dbpath", DBPATH,
+            "--storageEngine", self.storage_engine,
+            chdir=DIR, logfile=LOGFILE, pidfile=PIDFILE)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"mongosh --host {node} --eval 'db.runCommand({{ping: 1}})' "
+            "> /dev/null 2>&1 && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def setup_primary(self, test, node):
+        members = [{"_id": i, "host": f"{n}:{PORT}"}
+                   for i, n in enumerate(test.get("nodes") or [])]
+        cfg = json.dumps({"_id": RS, "members": members})
+        c.execute("mongosh", "--host", node, "--eval",
+                  f"rs.initiate({cfg})", check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(PIDFILE, "mongod")
+        c.execute("rm", "-rf", DBPATH, check=False)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+class MongoshConn:
+    """Register over one document per key: findAndModify gives atomic
+    CAS; read/write concerns come from the test options (the
+    mongodb suites' central knobs)."""
+
+    def __init__(self, node: str, write_concern: str = "majority",
+                 read_concern: str = "linearizable"):
+        self.node = node
+        self.wc = write_concern
+        self.rc = read_concern
+        self._session = c.session(node)
+
+    def _eval(self, js: str) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("mongosh", "--quiet", "--host", self.node,
+                             "jepsen", "--eval", js, check=False)
+
+    def get(self, k) -> Optional[int]:
+        out = self._eval(
+            "db.registers.find({_id: %r})"
+            ".readConcern(%r).toArray()[0]?.value ?? null"
+            % (f"r{k}", self.rc))
+        out = (out or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def put(self, k, v) -> None:
+        self._eval(
+            "db.registers.updateOne({_id: %r}, {$set: {value: %d}}, "
+            "{upsert: true, writeConcern: {w: %r}})"
+            % (f"r{k}", v, self.wc))
+
+    def cas(self, k, old, new) -> bool:
+        out = self._eval(
+            "db.registers.findAndModify({query: {_id: %r, value: %d}, "
+            "update: {$set: {value: %d}}, "
+            "writeConcern: {w: %r}}) !== null"
+            % (f"r{k}", old, new, self.wc))
+        return (out or "").strip() == "true"
+
+    def close(self):
+        self._session.close()
+
+
+def mongo_test(opts) -> dict:
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    engine = (opts.get("storage-engine")
+              or av.get("storage_engine") or "wiredTiger")
+    wc = opts.get("write-concern") or av.get("write_concern") or "majority"
+    rc = opts.get("read-concern") or av.get("read_concern") or "linearizable"
+    factory = (opts.get("kv-factory")
+               or (lambda node: MongoshConn(node, wc, rc)))
+    test = register_test(f"mongodb {engine}", MongoDB(engine),
+                         KVRegisterClient(factory), opts)
+    test.update({"write-concern": wc, "read-concern": rc})
+    return test
+
+
+def _opt_fn(parser):
+    parser.add_argument("--storage-engine", default="wiredTiger",
+                        help="wiredTiger (smartos suite) or rocksdb "
+                        "(mongodb-rocks suite)")
+    parser.add_argument("--write-concern", default="majority")
+    parser.add_argument("--read-concern", default="linearizable")
+
+
+main = simple_main(mongo_test, _opt_fn)
+
+if __name__ == "__main__":
+    main()

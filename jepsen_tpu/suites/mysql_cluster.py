@@ -1,0 +1,105 @@
+"""MySQL Cluster (NDB) test suite (reference:
+`mysql-cluster/src/jepsen/mysql_cluster.clj`, 227 LoC): management
+node + ndbd data nodes + mysqld SQL nodes; linearizable register over
+the NDB engine with the MySQL-dialect conn shared with tidb."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import simple_main
+from jepsen_tpu.suites.cockroach import _rounded_concurrency
+from jepsen_tpu.suites.tidb import MysqlShellConn, RegisterClient
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+
+NDB_DIR = "/var/lib/mysql-cluster"
+
+
+class NdbShellConn(MysqlShellConn):
+    def _cmd(self, q: str) -> list:
+        return ["mysql", "-h", self.node, "-u", "root",
+                "-N", "-B", "-e", q]
+
+
+class NdbRegisterClient(RegisterClient):
+    """The register table MUST use the NDBCLUSTER engine — the InnoDB
+    default is local to one mysqld and not replicated, so the suite
+    would be testing nothing (and reporting false violations)."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS test "
+           "(id INT PRIMARY KEY, val INT) ENGINE=NDBCLUSTER")
+
+
+class MySQLClusterDB(db_mod.DB, db_mod.LogFiles):
+    """mysql_cluster.clj db: ndb_mgmd on the first node, ndbd + mysqld
+    everywhere."""
+
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        first = nodes[0]
+        ini = "[ndbd default]\nNoOfReplicas=2\n"
+        ini += f"[ndb_mgmd]\nHostName={first}\n"
+        for n in nodes:
+            ini += f"[ndbd]\nHostName={n}\n"
+        for n in nodes:
+            ini += "[mysqld]\n"
+        c.upload_str(ini, f"{NDB_DIR}/config.ini")
+        if node == first:
+            c.execute("ndb_mgmd", "-f", f"{NDB_DIR}/config.ini",
+                      "--initial", check=False)
+        c.execute("ndbd", f"--ndb-connectstring={first}",
+                  check=False)
+        c.execute("service", "mysql", "restart", check=False)
+        c.execute(lit(
+            "for i in $(seq 1 120); do "
+            "mysql -u root -e 'select 1' > /dev/null 2>&1 "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        c.execute("service", "mysql", "stop", check=False)
+        cu.grepkill("ndbd")
+        cu.grepkill("ndb_mgmd")
+
+    def log_files(self, test, node):
+        return [f"{NDB_DIR}/ndb_1_cluster.log",
+                "/var/log/mysql/error.log"]
+
+
+def cluster_test(opts) -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = linreg_wl.suite_workload(opts)
+    return dict(tst.noop_test(), **{
+        "name": "mysql-cluster",
+        "nodes": nodes,
+        "concurrency": _rounded_concurrency(opts,
+                                            wl["threads-per-key"]),
+        "ssh": opts.get("ssh", {}),
+        "db": MySQLClusterDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "sql-factory": opts.get("sql-factory") or NdbShellConn,
+        "client": NdbRegisterClient(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                wl["generator"])),
+        "checker": ck.compose({"linear": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+
+
+main = simple_main(cluster_test)
+
+if __name__ == "__main__":
+    main()

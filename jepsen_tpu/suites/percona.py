@@ -1,0 +1,45 @@
+"""Percona XtraDB test suite (reference: `percona/src/jepsen/percona/`
+— 482 LoC): the same dirty-reads shape as galera over Percona's
+cluster packaging (dirty_reads.clj is shared between the two in the
+reference as well)."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import simple_main
+from jepsen_tpu.suites.galera import GaleraDB, dirty_reads_test
+
+
+class PerconaDB(GaleraDB):
+    """percona/db.clj: percona-xtradb-cluster instead of mariadb."""
+
+    def setup(self, test, node):
+        os_debian.install(["percona-xtradb-cluster-server"])
+        peers = ",".join(n for n in (test.get("nodes") or [])
+                         if n != node)
+        from jepsen_tpu.suites.galera import GALERA_CNF
+        c.upload_str(GALERA_CNF.format(peers=peers),
+                     "/etc/mysql/conf.d/galera.cnf")
+        first = (test.get("nodes") or [node])[0]
+        if node == first:
+            c.execute(lit("systemctl start mysql@bootstrap || "
+                          "galera_new_cluster || true"), check=False)
+        else:
+            c.execute("service", "mysql", "restart", check=False)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            "mysql -u root -e 'select 1' > /dev/null 2>&1 "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+
+def percona_test(opts) -> dict:
+    return dirty_reads_test(opts, db=PerconaDB(),
+                            name="percona dirty-reads")
+
+
+main = simple_main(percona_test)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Postgres-RDS test suite (reference: `postgres-rds/src/jepsen/`
+— 294 LoC): tests a *managed* single-endpoint Postgres (no DB
+automation — the reference's db is a noop against an RDS hostname),
+linearizable register over serializable transactions, with the network
+nemesis partitioning clients from the endpoint."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.suites._template import simple_main
+from jepsen_tpu.suites.cockroach import (RegisterClient, ShellConn,
+                                         _rounded_concurrency)
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+
+PORT = 5432
+
+
+class NoopDB(db_mod.DB):
+    """RDS is managed: nothing to install or tear down
+    (postgres-rds db)."""
+
+    def setup(self, test, node):
+        pass
+
+    def teardown(self, test, node):
+        pass
+
+
+class PsqlShellConn(ShellConn):
+    """psql conn against the RDS endpoint (test['endpoint'] overrides
+    the node name)."""
+
+    ts_expr = "(EXTRACT(EPOCH FROM clock_timestamp()) * 1e6)::BIGINT"
+
+    def __init__(self, node: str, endpoint=None):
+        super().__init__(node)
+        self.endpoint = endpoint or node
+
+    def _cmd(self, q: str) -> list:
+        return ["psql", "-h", self.endpoint, "-p", str(PORT),
+                "-U", "jepsen", "-q", "-At", "-c", q]
+
+    def _parse(self, text: str) -> list:
+        return [line.split("|")
+                for line in (text or "").splitlines() if line]
+
+
+def rds_test(opts) -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    endpoint = opts.get("endpoint") or av.get("endpoint")
+    nodes = opts.get("nodes") or ["n1"]
+    wl = linreg_wl.suite_workload(opts)
+    factory = (opts.get("sql-factory")
+               or (lambda node: PsqlShellConn(node, endpoint)))
+    return dict(tst.noop_test(), **{
+        "name": "postgres-rds",
+        "nodes": nodes,
+        "concurrency": _rounded_concurrency(opts,
+                                            wl["threads-per-key"]),
+        "ssh": opts.get("ssh", {}),
+        "db": NoopDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "sql-factory": factory,
+        "client": RegisterClient(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                wl["generator"])),
+        "checker": ck.compose({"linear": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+
+
+def _opt_fn(parser):
+    parser.add_argument("--endpoint", default=None,
+                        help="RDS hostname (defaults to the node name)")
+
+
+main = simple_main(rds_test, _opt_fn)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""RabbitMQ test suite (reference: `rabbitmq/src/jepsen/rabbitmq.clj`,
+263 LoC): deb-package install with erlang cookie clustering, the queue
+workload — unique enqueues, acked dequeues, full post-run drain —
+checked by total-queue multiset accounting (lost/duplicated elements)
+and optionally the knossos-style linearizable queue model."""
+
+from __future__ import annotations
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (QueueClient, queue_test,
+                                         simple_main)
+
+QUEUE = "jepsen.queue"
+COOKIE = "jepsen-rabbitmq"
+
+
+class RabbitDB(db_mod.DB, db_mod.LogFiles):
+    """rabbitmq.clj db :24-90: install server, share the erlang
+    cookie, cluster every node to the first."""
+
+    def setup(self, test, node):
+        os_debian.install(["rabbitmq-server"])
+        c.upload_str(COOKIE, "/var/lib/rabbitmq/.erlang.cookie")
+        c.execute("chmod", "600", "/var/lib/rabbitmq/.erlang.cookie",
+                  check=False)
+        c.execute("service", "rabbitmq-server", "restart")
+        first = (test.get("nodes") or [node])[0]
+        if node != first:
+            c.execute("rabbitmqctl", "stop_app", check=False)
+            c.execute("rabbitmqctl", "join_cluster",
+                      f"rabbit@{first}", check=False)
+            c.execute("rabbitmqctl", "start_app", check=False)
+        # mirrored queue policy (rabbitmq.clj ha-policy)
+        c.execute("rabbitmqctl", "set_policy", "ha-maj",
+                  "jepsen\\.", lit(
+                      "'{\"ha-mode\": \"exactly\", "
+                      "\"ha-params\": 3, "
+                      "\"ha-sync-mode\": \"automatic\"}'"),
+                  check=False)
+
+    def teardown(self, test, node):
+        c.execute("rabbitmqctl", "purge_queue", QUEUE, check=False)
+        c.execute("service", "rabbitmq-server", "stop", check=False)
+
+    def log_files(self, test, node):
+        return [f"/var/log/rabbitmq/rabbit@{node}.log"]
+
+
+class AmqpShellConn:
+    """Production conn via rabbitmqadmin over the control plane."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _admin(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("rabbitmqadmin", f"--host={self.node}",
+                             *args, check=False)
+
+    def enqueue(self, v) -> None:
+        self._admin("publish", "exchange=amq.default",
+                    f"routing_key={QUEUE}", f"payload={v}")
+
+    def dequeue(self):
+        # raw_json keeps the payload unambiguous — TSV puts
+        # message_count before payload, and grabbing the first numeric
+        # token would return the queue depth instead of the value.
+        import json
+        out = self._admin("get", f"queue={QUEUE}",
+                          "ackmode=ack_requeue_false", "count=1",
+                          "--format=raw_json")
+        try:
+            msgs = json.loads(out or "[]")
+        except ValueError:
+            return None
+        if not msgs:
+            return None
+        payload = str(msgs[0].get("payload", "")).strip()
+        return int(payload) if payload.lstrip("-").isdigit() else None
+
+    def drain(self) -> list:
+        vals = []
+        while True:
+            v = self.dequeue()
+            if v is None:
+                return vals
+            vals.append(v)
+
+    def close(self):
+        self._session.close()
+
+
+def rabbit_test(opts) -> dict:
+    return queue_test("rabbitmq", RabbitDB(), QueueClient(
+        (opts or {}).get("queue-factory") or AmqpShellConn), opts)
+
+
+main = simple_main(rabbit_test)
+
+if __name__ == "__main__":
+    main()

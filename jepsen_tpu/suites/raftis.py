@@ -1,0 +1,79 @@
+"""Raftis test suite (reference: `raftis/src/jepsen/system/raftis.clj`,
+142 LoC): redis protocol over a raft log — linearizable register via
+GET/SET and WATCH/MULTI-free server-side CAS (the reference drives
+redis clients; the shell conn uses redis-cli EVAL for atomic CAS)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+DIR = "/opt/raftis"
+PORT = 6379
+CAS_LUA = ("if redis.call('GET', KEYS[1]) == ARGV[1] then "
+           "redis.call('SET', KEYS[1], ARGV[2]); return 1 "
+           "else return 0 end")
+
+
+class RaftisDB(db_mod.DB, db_mod.LogFiles):
+    def setup(self, test, node):
+        peers = ",".join(f"{n}:{PORT + 1000}"
+                         for n in test.get("nodes") or [])
+        cu.start_daemon(f"{DIR}/raftis",
+                        "-addr", f"{node}:{PORT}",
+                        "-peers", peers,
+                        chdir=DIR, logfile=f"{DIR}/raftis.log",
+                        pidfile=f"{DIR}/raftis.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"redis-cli -h {node} -p {PORT} ping | grep -q PONG "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/raftis.pid", f"{DIR}/raftis")
+
+    def log_files(self, test, node):
+        return [f"{DIR}/raftis.log"]
+
+
+class RedisCliConn:
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _cli(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("redis-cli", "-h", self.node,
+                             "-p", str(PORT), *args, check=False)
+
+    def get(self, k) -> Optional[int]:
+        out = (self._cli("GET", f"r{k}") or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def put(self, k, v) -> None:
+        self._cli("SET", f"r{k}", str(v))
+
+    def cas(self, k, old, new) -> bool:
+        out = (self._cli("EVAL", CAS_LUA, "1", f"r{k}",
+                         str(old), str(new)) or "").strip()
+        return out == "1"
+
+    def close(self):
+        self._session.close()
+
+
+def raftis_test(opts) -> dict:
+    return register_test("raftis", RaftisDB(), KVRegisterClient(
+        (opts or {}).get("kv-factory") or RedisCliConn), opts)
+
+
+main = simple_main(raftis_test)
+
+if __name__ == "__main__":
+    main()

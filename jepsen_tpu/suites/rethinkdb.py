@@ -1,0 +1,96 @@
+"""RethinkDB test suite (reference: `rethinkdb/src/jepsen/rethinkdb/`,
+529 LoC): document store with per-table write-acks/read-mode knobs —
+a linearizable register per key via atomic update expressions
+(document CAS), read-mode `majority` for linearizable reads."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+DATA = "/var/lib/rethinkdb/jepsen"
+PORT = 28015
+
+
+class RethinkDB(db_mod.DB, db_mod.LogFiles):
+    """rethinkdb core.clj db: package install, join the first node."""
+
+    def setup(self, test, node):
+        os_debian.install(["rethinkdb"])
+        first = (test.get("nodes") or [node])[0]
+        args = ["rethinkdb", "--daemon", "--bind", "all",
+                "--directory", DATA,
+                "--server-name", node.replace("-", "_")]
+        if node != first:
+            args += ["--join", f"{first}:29015"]
+        cu.start_daemon(*args, logfile="/var/log/rethinkdb.log",
+                        pidfile="/var/run/rethinkdb.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"nc -z {node} {PORT} && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        cu.grepkill("rethinkdb")
+        c.execute("rm", "-rf", DATA, check=False)
+
+    def log_files(self, test, node):
+        return ["/var/log/rethinkdb.log"]
+
+
+class ReqlShellConn:
+    """ReQL over the admin `rethinkdb` python driver shell; CAS via
+    the atomic branch-update expression (rethinkdb client.clj)."""
+
+    def __init__(self, node: str, write_acks: str = "majority",
+                 read_mode: str = "majority"):
+        self.node = node
+        self.write_acks = write_acks
+        self.read_mode = read_mode
+        self._session = c.session(node)
+
+    def _reql(self, expr: str) -> str:
+        js = (f"r.connect({{host: '{self.node}', port: {PORT}}})"
+              f".then(c => {expr}.run(c)"
+              ".then(x => console.log(JSON.stringify(x))))")
+        with c.with_session(self.node, self._session):
+            return c.execute("rethinkdb-repl", "-e", js, check=False)
+
+    def get(self, k) -> Optional[int]:
+        out = (self._reql(
+            f"r.table('registers', {{readMode: '{self.read_mode}'}})"
+            f".get('r{k}')('value').default(null)") or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def put(self, k, v) -> None:
+        self._reql(
+            "r.table('registers').insert("
+            f"{{id: 'r{k}', value: {v}}}, {{conflict: 'replace'}})")
+
+    def cas(self, k, old, new) -> bool:
+        out = self._reql(
+            f"r.table('registers').get('r{k}').update(row => "
+            f"r.branch(row('value').eq({old}), {{value: {new}}}, "
+            "r.error('cas failed')))")
+        return "replaced\":1" in (out or "")
+
+    def close(self):
+        self._session.close()
+
+
+def rethink_test(opts) -> dict:
+    return register_test("rethinkdb", RethinkDB(), KVRegisterClient(
+        (opts or {}).get("kv-factory") or ReqlShellConn), opts)
+
+
+main = simple_main(rethink_test)
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,145 @@
+"""RobustIRC test suite (reference: `robustirc/src/jepsen/robustirc.clj`,
+217 LoC): a raft-replicated IRC network — every message posted to a
+channel must be delivered exactly once, in order, to every member.
+Modeled as the set workload (posted messages = unique adds; the final
+read collects the channel backlog) — message loss is the anomaly the
+reference hunted."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import simple_main
+from jepsen_tpu.workloads import sets as sets_wl
+
+DIR = "/opt/robustirc"
+PORT = 60667
+CHANNEL = "#jepsen"
+
+
+class RobustIrcDB(db_mod.DB, db_mod.LogFiles):
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        first = nodes[0]
+        args = [f"{DIR}/robustirc",
+                "-network_name", "jepsen.test",
+                "-peer_addr", f"{node}:{PORT}",
+                "-tls_cert_path", f"{DIR}/cert.pem",
+                "-tls_key_path", f"{DIR}/key.pem"]
+        if node != first:
+            args += ["-join", f"{first}:{PORT}"]
+        cu.start_daemon(*args, chdir=DIR,
+                        logfile=f"{DIR}/robustirc.log",
+                        pidfile=f"{DIR}/robustirc.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"nc -z {node} {PORT} && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(f"{DIR}/robustirc.pid", f"{DIR}/robustirc")
+
+    def log_files(self, test, node):
+        return [f"{DIR}/robustirc.log"]
+
+
+class IrcShellConn:
+    """Post/backlog over the robustirc HTTP bridge."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def post(self, v) -> None:
+        with c.with_session(self.node, self._session):
+            c.execute("curl", "-skf", "-X", "POST",
+                      "-d", f"PRIVMSG {CHANNEL} :{v}",
+                      f"https://{self.node}:{PORT}/robustirc/v1/jepsen")
+
+    def backlog(self) -> list:
+        with c.with_session(self.node, self._session):
+            out = c.execute("curl", "-skf",
+                            f"https://{self.node}:{PORT}"
+                            "/robustirc/v1/jepsen/messages",
+                            check=False)
+        vals = []
+        for line in (out or "").splitlines():
+            tail = line.rsplit(":", 1)[-1].strip()
+            if tail.isdigit():
+                vals.append(int(tail))
+        return sorted(vals)
+
+    def close(self):
+        self._session.close()
+
+
+class IrcClient(client_mod.Client):
+    def __init__(self, conn_factory=IrcShellConn):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = IrcClient(test.get("irc-factory") or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "add":
+                self.conn.post(op.value)
+                return op.assoc(type="ok")
+            if op.f == "read":
+                return op.assoc(type="ok", value=self.conn.backlog())
+            raise ValueError(f"unknown f {op.f!r}")
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="info", error=str(e))
+
+
+def irc_test(opts) -> dict:
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = sets_wl.workload(opts)
+    return dict(tst.noop_test(), **{
+        "name": "robustirc",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": RobustIrcDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "irc-factory": opts.get("irc-factory"),
+        "client": IrcClient(),
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.nemesis(
+                    gen.start_stop(opts.get("nemesis-interval", 5),
+                                   opts.get("nemesis-interval", 5)),
+                    gen.stagger(1 / 10, wl["generator"]))),
+            gen.nemesis(gen.once({"type": "info", "f": "stop"})),
+            gen.sleep(opts.get("quiesce", 3)),
+            gen.clients(wl["final-generator"])),
+        "checker": ck.compose({"messages": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+
+
+main = simple_main(irc_test)
+
+if __name__ == "__main__":
+    main()

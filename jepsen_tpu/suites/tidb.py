@@ -1,0 +1,267 @@
+"""TiDB test suite (reference: `tidb/src/tidb/` — 882 LoC: pd/kv/db
+three-daemon automation, bank / register / sets workloads over MySQL
+protocol).  The shell conn speaks the MySQL dialect (REPLACE, INSERT
+IGNORE, ROW_COUNT() instead of RETURNING); the injectable conn
+boundary is the same as the cockroach suite's."""
+
+from __future__ import annotations
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import independent, net
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (nemesis_schedule,
+                                         workload_main)
+from jepsen_tpu.suites.cockroach import (Definite, SQLClient,
+                                         ShellConn, ensure_table,
+                                         with_txn_retry,
+                                         _rounded_concurrency)
+from jepsen_tpu.workloads import bank as bank_wl
+from jepsen_tpu.workloads import linearizable_register as linreg_wl
+from jepsen_tpu.workloads import sets as sets_wl
+
+VERSION = "v7.5.0"
+DIR = "/opt/tidb"
+PD_PORT = 2379
+KV_PORT = 20160
+SQL_PORT = 4000
+
+
+class TiDB(db_mod.DB, db_mod.LogFiles):
+    """tidb/db.clj: pd quorum -> tikv -> tidb server on every node."""
+
+    def setup(self, test, node):
+        nodes = test.get("nodes") or [node]
+        pd_cluster = ",".join(f"pd-{n}=http://{n}:2380" for n in nodes)
+        cu.start_daemon(
+            f"{DIR}/bin/pd-server", "--name", f"pd-{node}",
+            "--client-urls", f"http://{node}:{PD_PORT}",
+            "--peer-urls", f"http://{node}:2380",
+            "--initial-cluster", pd_cluster,
+            chdir=DIR, logfile=f"{DIR}/pd.log",
+            pidfile=f"{DIR}/pd.pid")
+        pds = ",".join(f"{n}:{PD_PORT}" for n in nodes)
+        cu.start_daemon(
+            f"{DIR}/bin/tikv-server", "--pd", pds,
+            "--addr", f"{node}:{KV_PORT}", "--data-dir",
+            f"{DIR}/data/kv",
+            chdir=DIR, logfile=f"{DIR}/kv.log",
+            pidfile=f"{DIR}/kv.pid")
+        cu.start_daemon(
+            f"{DIR}/bin/tidb-server", "--path", pds,
+            "--store", "tikv", "-P", str(SQL_PORT),
+            chdir=DIR, logfile=f"{DIR}/db.log",
+            pidfile=f"{DIR}/db.pid")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"mysql -h {node} -P {SQL_PORT} -u root -e 'select 1' "
+            "> /dev/null 2>&1 && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        for svc in ("db", "kv", "pd"):
+            cu.stop_daemon(f"{DIR}/{svc}.pid", f"{DIR}/bin")
+        c.execute("rm", "-rf", f"{DIR}/data", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/pd.log", f"{DIR}/kv.log", f"{DIR}/db.log"]
+
+
+class MysqlShellConn(ShellConn):
+    """mysql-client conn: cockroach's ShellConn with command/parse
+    hooks swapped for the MySQL dialect."""
+
+    ts_expr = "CAST(UNIX_TIMESTAMP(NOW(6)) * 1000000 AS SIGNED)"
+
+    def _cmd(self, q: str) -> list:
+        return ["mysql", "-h", self.node, "-P", str(SQL_PORT),
+                "-u", "root", "-N", "-B", "-e", q]
+
+    def _parse(self, text: str) -> list:
+        return [line.split("\t")
+                for line in (text or "").splitlines() if line]
+
+
+class RegisterClient(SQLClient):
+    """tidb register: MySQL dialect — REPLACE for upsert, UPDATE +
+    ROW_COUNT() for cas (no RETURNING)."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS test (id INT PRIMARY KEY, val INT)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "test")
+        k, v = op.value
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT val FROM test WHERE id = ?", (k,)))
+            return op.assoc(type="ok", value=independent.tuple_(
+                k, int(rows[0][0]) if rows else None))
+        if op.f == "write":
+            with_txn_retry(lambda: self.conn.txn(
+                [f"REPLACE INTO test (id, val) VALUES ({k}, {v})"]))
+            return op.assoc(type="ok")
+        if op.f == "cas":
+            old, new = v
+
+            def do_cas():
+                rows = self.conn.txn([
+                    f"UPDATE test SET val = {new} "
+                    f"WHERE id = {k} AND val = {old}",
+                    "SELECT ROW_COUNT()"])
+                return bool(rows) and bool(int(rows[-1][0]))
+            return op.assoc(
+                type="ok" if with_txn_retry(do_cas) else "fail")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class BankClient(SQLClient):
+    """tidb bank: same invariant as bank.clj, MySQL dialect."""
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test,
+                     "CREATE TABLE IF NOT EXISTS accounts "
+                     "(id INT PRIMARY KEY, balance INT)", "accounts")
+        self._seed(test)
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.txn(
+                ["SELECT id, balance FROM accounts"]))
+            return op.assoc(type="ok",
+                            value={int(r[0]): int(r[1]) for r in rows})
+        if op.f == "transfer":
+            v = op.value
+            frm, to, amt = v["from"], v["to"], v["amount"]
+            neg_ok = bool(test.get("negative-balances?"))
+
+            def xfer():
+                atomically = getattr(self.conn, "atomically", None)
+                if atomically is None:
+                    # ONE txn() call — debit, conditional credit, and
+                    # the verdict all inside a single transaction.  A
+                    # separately-committed debit would expose a
+                    # wrong-total window to concurrent reads (and a
+                    # retry after the debit would debit twice).  MySQL
+                    # has no CTE UPDATE; ROW_COUNT() carries the
+                    # debit's match count into the credit's guard.
+                    guard = ("" if neg_ok
+                             else f" AND balance >= {amt}")
+                    rows = self.conn.txn([
+                        f"UPDATE accounts SET balance = balance - {amt}"
+                        f" WHERE id = {frm}{guard}",
+                        f"UPDATE accounts SET balance = balance + {amt}"
+                        f" WHERE id = {to} AND (SELECT ROW_COUNT()) > 0",
+                        "SELECT ROW_COUNT()"])
+                    if not (rows and int(rows[-1][0])):
+                        raise Definite("insufficient balance")
+                    return
+
+                def body(run):
+                    rows = run("SELECT balance FROM accounts "
+                               f"WHERE id = {frm}")
+                    bal = int(rows[0][0]) if rows else None
+                    if bal is None or (bal < amt and not neg_ok):
+                        raise Definite(f"insufficient balance {bal}")
+                    run(f"UPDATE accounts SET balance = balance - {amt}"
+                        f" WHERE id = {frm}")
+                    run(f"UPDATE accounts SET balance = balance + {amt}"
+                        f" WHERE id = {to}")
+                atomically(body)
+            with_txn_retry(xfer)
+            return op.assoc(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+    def _seed(self, test):
+        from jepsen_tpu.suites.cockroach import _once, _table_lock
+        with _table_lock:
+            if not _once(test, "bank-seed"):
+                return
+            accounts = test["accounts"]
+            per = test["total-amount"] // len(accounts)
+            rem = test["total-amount"] - per * len(accounts)
+            for i, a in enumerate(accounts):
+                self.conn.sql(
+                    "INSERT IGNORE INTO accounts (id, balance) "
+                    f"VALUES ({a}, {per + (rem if i == 0 else 0)})")
+
+
+class SetsClient(SQLClient):
+    DDL = "CREATE TABLE IF NOT EXISTS sets (val INT PRIMARY KEY)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "sets")
+        if op.f == "add":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO sets (val) VALUES ({op.value})"))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = with_txn_retry(
+                lambda: self.conn.txn(["SELECT val FROM sets"]))
+            return op.assoc(type="ok",
+                            value=sorted(int(r[0]) for r in rows))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def base(opts, name) -> dict:
+    from jepsen_tpu import tests as tst
+
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    return dict(tst.noop_test(), **{
+        "name": f"tidb {name}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": TiDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "sql-factory": opts.get("sql-factory") or MysqlShellConn,
+    })
+
+
+def register_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "register")
+    wl = linreg_wl.suite_workload(opts)
+    test["concurrency"] = _rounded_concurrency(
+        opts, wl["threads-per-key"])
+    test["client"] = RegisterClient()
+    test["checker"] = ck.compose({"linear": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, wl["generator"])
+    return test
+
+
+def bank_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "bank")
+    wl = bank_wl.workload(opts)
+    test.update({k: wl[k] for k in
+                 ("accounts", "total-amount", "max-transfer")})
+    test["client"] = BankClient()
+    test["checker"] = ck.compose({"bank": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 10, wl["generator"]))
+    return test
+
+
+def sets_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "sets")
+    wl = sets_wl.workload(opts)
+    test["client"] = SetsClient()
+    test["checker"] = ck.compose({"set": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 10, wl["generator"]),
+              final_gen=wl["final-generator"])
+    return test
+
+
+tests = {"register": register_test, "bank": bank_test,
+         "sets": sets_test}
+
+test_for, _opt_fn, main = workload_main(tests, "register")
+
+if __name__ == "__main__":
+    main()

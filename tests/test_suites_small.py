@@ -1,0 +1,442 @@
+"""Small-suite batch end-to-end (zookeeper, consul, rabbitmq, tidb,
+galera/percona, mongodb, postgres-rds) over the dummy transport with
+in-memory backends, plus unit tests for the chronos run-skipping
+checker."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import control, core, store
+from jepsen_tpu.history import History, invoke_op, ok_op
+from jepsen_tpu.suites import (SUITES, chronos, consul, galera,
+                               main_for, mongodb, percona,
+                               postgres_rds, rabbitmq, tidb, zookeeper)
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def dummy_handler(cmds):
+    def handler(node, cmd, stdin):
+        cmds.append((node, cmd))
+        if "mktemp -d" in cmd:
+            return "/tmp/jepsen.X"
+        if "test -e" in cmd:
+            return "true"
+        if "ls -A" in cmd:
+            return "unpacked\n"
+        return ""
+    return handler
+
+
+class MemKV:
+    """Linearizable in-memory KV with get/put/cas — backs every
+    register-shaped small suite."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.kv = {}
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            def get(self, k):
+                with mem.lock:
+                    return mem.kv.get(k)
+
+            def put(self, k, v):
+                with mem.lock:
+                    mem.kv[k] = v
+
+            def cas(self, k, old, new):
+                with mem.lock:
+                    if mem.kv.get(k) == old:
+                        mem.kv[k] = new
+                        return True
+                    return False
+
+        return Conn()
+
+
+class MemQueue:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.q = []
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            def enqueue(self, v):
+                with mem.lock:
+                    mem.q.append(v)
+
+            def dequeue(self):
+                with mem.lock:
+                    return mem.q.pop(0) if mem.q else None
+
+            def drain(self):
+                with mem.lock:
+                    out, mem.q = mem.q, []
+                    return out
+
+        return Conn()
+
+
+class MemSQL:
+    def __init__(self):
+        import sqlite3
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self.lock = threading.Lock()
+        self.ts = 0
+
+    def factory(self, node):
+        mem = self
+
+        class Conn:
+            ts_expr = "cluster_logical_timestamp()"
+
+            def sql(self, stmt, params=()):
+                with mem.lock:
+                    out = self._run(stmt, params)
+                    mem.db.commit()
+                    return out
+
+            def txn(self, stmts):
+                with mem.lock:
+                    rows = []
+                    for s in stmts:
+                        rows.extend(self._run(s, ()))
+                    mem.db.commit()
+                    return rows
+
+            def _run(self, stmt, params):
+                s = stmt.replace("REPLACE INTO", "INSERT OR REPLACE INTO")
+                s = s.replace("INSERT IGNORE", "INSERT OR IGNORE")
+                s = s.replace("SELECT ROW_COUNT()", "SELECT changes()")
+                s = s.replace("INSERT OR REPLACE INTO", "REPLACE INTO")
+                cur = mem.db.execute(s, params)
+                return [tuple(r) for r in cur.fetchall()]
+
+            def close(self):
+                pass
+
+        return Conn()
+
+
+def run_test(build, opts):
+    cmds = []
+    control.set_dummy_handler(dummy_handler(cmds))
+    try:
+        base = {"nodes": ["n1", "n2", "n3"], "concurrency": 4,
+                "time-limit": 2, "ssh": {"dummy": True},
+                "ops-per-key": 20, "nemesis-interval": 0.5}
+        base.update(opts)
+        result = core.run(build(base))
+    finally:
+        control.set_dummy_handler(None)
+    return result, cmds
+
+
+class TestRegisterSuites:
+    @pytest.mark.parametrize("build,fkey", [
+        (zookeeper.zk_test, "kv-factory"),
+        (consul.consul_test, "kv-factory"),
+        (mongodb.mongo_test, "kv-factory"),
+    ])
+    def test_valid_against_memkv(self, build, fkey):
+        mem = MemKV()
+        result, _ = run_test(build, {fkey: mem.factory})
+        res = result["results"]
+        assert res["linear"]["valid?"] is True, res["linear"]
+        assert res["valid?"] is True
+
+    def test_zookeeper_provisioning(self):
+        mem = MemKV()
+        _, cmds = run_test(zookeeper.zk_test,
+                           {"kv-factory": mem.factory})
+        assert any("myid" in c for _, c in cmds)
+        assert any("zoo.cfg" in c for _, c in cmds)
+
+    def test_sql_register_suites(self):
+        for build in (tidb.register_test, postgres_rds.rds_test):
+            mem = MemSQL()
+            result, _ = run_test(build, {"sql-factory": mem.factory})
+            assert result["results"]["linear"]["valid?"] is True
+            assert result["results"]["valid?"] is True
+
+
+class TestQueueSuite:
+    def test_rabbitmq_total_queue(self):
+        mem = MemQueue()
+        result, _ = run_test(
+            rabbitmq.rabbit_test,
+            {"queue-factory": mem.factory, "ops": 200})
+        res = result["results"]
+        assert res["queue"]["valid?"] is True, res["queue"]
+
+
+class TestSQLWorkloads:
+    def test_tidb_bank_and_sets(self):
+        for build, key in ((tidb.bank_test, "bank"),
+                           (tidb.sets_test, "set")):
+            mem = MemSQL()
+            result, _ = run_test(
+                build, {"sql-factory": mem.factory, "quiesce": 0.1})
+            assert result["results"][key]["valid?"] is True, \
+                result["results"][key]
+
+    def test_dirty_reads_galera_percona(self):
+        for build in (galera.dirty_reads_test, percona.percona_test):
+            mem = MemSQL()
+            result, _ = run_test(build, {"sql-factory": mem.factory})
+            res = result["results"]
+            assert res["dirty-reads"]["valid?"] is True, \
+                res["dirty-reads"]
+
+    def test_dirty_reads_detects_mixed_values(self):
+        h = History([
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read", None), ok_op(1, "read", [1, 2]),
+        ]).index()
+        from jepsen_tpu.workloads import dirty_reads
+        r = dirty_reads.checker().check({}, h)
+        assert r["valid?"] is False
+        assert r["dirty-reads"]
+
+
+class TestChronosChecker:
+    JOB = {"name": 1, "start": 100.0, "count": 3, "duration": 2,
+           "epsilon": 5, "interval": 30}
+
+    def test_all_targets_satisfied(self):
+        runs = [{"node": "n1", "name": 1, "start": s, "end": s + 2}
+                for s in (101.0, 131.0, 161.0)]
+        sol = chronos.job_solution(300.0, self.JOB, runs)
+        assert sol["valid?"] is True
+        assert sol["target-count"] == 3
+
+    def test_missed_target_detected(self):
+        runs = [{"node": "n1", "name": 1, "start": s, "end": s + 2}
+                for s in (101.0, 161.0)]  # second execution skipped
+        sol = chronos.job_solution(300.0, self.JOB, runs)
+        assert sol["valid?"] is False
+        assert sol["missed"] == [[130.0, 140.0]]
+
+    def test_late_run_does_not_satisfy(self):
+        # run starts after epsilon+forgiveness window closes
+        runs = [{"node": "n1", "name": 1, "start": 101.0, "end": 103},
+                {"node": "n1", "name": 1, "start": 145.0, "end": 147},
+                {"node": "n1", "name": 1, "start": 161.0, "end": 163}]
+        sol = chronos.job_solution(300.0, self.JOB, runs)
+        assert sol["valid?"] is False
+
+    def test_targets_cut_off_at_read_time(self):
+        # read at 130: cutoff = 130 - epsilon - duration = 123, so only
+        # the t=100 execution is demanded
+        sol = chronos.job_solution(130.0, self.JOB, [
+            {"node": "n1", "name": 1, "start": 101.0, "end": 103.0}])
+        assert sol["target-count"] == 1
+        assert sol["valid?"] is True
+
+    def test_incomplete_run_excuses_target(self):
+        runs = [{"node": "n1", "name": 1, "start": 101.0, "end": None}]
+        sol = chronos.job_solution(130.0, self.JOB, runs)
+        assert sol["valid?"] is True
+
+    def test_end_to_end_with_mem_scheduler(self):
+        import time as time_mod
+
+        class MemScheduler:
+            """Executes every scheduled run instantly (a perfect
+            cron)."""
+
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.jobs = []
+
+            def factory(self, node):
+                sched = self
+
+                class Conn:
+                    def add_job(self, job):
+                        with sched.lock:
+                            sched.jobs.append(job)
+
+                    def read_runs(self, test):
+                        now = time_mod.time()
+                        runs = []
+                        with sched.lock:
+                            for job in sched.jobs:
+                                t = job["start"]
+                                for _ in range(job["count"]):
+                                    if t > now:
+                                        break
+                                    runs.append(
+                                        {"node": "n1",
+                                         "name": job["name"],
+                                         "start": t,
+                                         "end": t + job["duration"]})
+                                    t += job["interval"]
+                        return runs
+
+                    def close(self):
+                        pass
+
+                return Conn()
+
+        sched = MemScheduler()
+        cmds = []
+        control.set_dummy_handler(dummy_handler(cmds))
+        try:
+            test = chronos.chronos_test({
+                "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "ssh": {"dummy": True}, "scale": 0.01,
+                "time-limit": 3, "quiesce": 1,
+                "chronos-factory": sched.factory})
+            result = core.run(test)
+        finally:
+            control.set_dummy_handler(None)
+        res = result["results"]
+        assert res["chronos"]["valid?"] is True, res["chronos"]
+        assert res["chronos"]["job-count"] >= 1
+
+
+class TestSecondBatch:
+    def test_kv_register_suites(self):
+        from jepsen_tpu.suites import (crate, hazelcast, logcabin,
+                                       mysql_cluster, raftis,
+                                       rethinkdb)
+        from jepsen_tpu.suites import elasticsearch as es
+
+        for build in (raftis.raftis_test, logcabin.logcabin_test,
+                      rethinkdb.rethink_test, hazelcast.cas_test,
+                      es.reg_test):
+            mem = MemKV()
+            result, _ = run_test(build, {"kv-factory": mem.factory})
+            assert result["results"]["linear"]["valid?"] is True, \
+                (build.__module__, result["results"]["linear"])
+        for build in (mysql_cluster.cluster_test,
+                      crate.register_test):
+            mem = MemSQL()
+            result, _ = run_test(build, {"sql-factory": mem.factory})
+            assert result["results"]["linear"]["valid?"] is True, \
+                (build.__module__, result["results"]["linear"])
+
+    def test_queue_suites(self):
+        from jepsen_tpu.suites import disque, hazelcast
+
+        for build in (disque.disque_test, hazelcast.hz_queue_test):
+            mem = MemQueue()
+            result, _ = run_test(build, {"queue-factory": mem.factory,
+                                         "ops": 150})
+            assert result["results"]["queue"]["valid?"] is True, \
+                (build.__module__, result["results"]["queue"])
+
+    def test_set_suites(self):
+        from jepsen_tpu.suites import robustirc
+        from jepsen_tpu.suites import elasticsearch as es
+
+        class MemSet:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.vals = set()
+
+            def factory(self, node):
+                mem = self
+
+                class Conn:
+                    def add(self, v):
+                        with mem.lock:
+                            mem.vals.add(v)
+
+                    post = add
+
+                    def read_all(self):
+                        with mem.lock:
+                            return sorted(mem.vals)
+
+                    backlog = read_all
+
+                return Conn()
+
+        mem = MemSet()
+        result, _ = run_test(es.set_test,
+                             {"es-factory": mem.factory,
+                              "quiesce": 0.1})
+        assert result["results"]["set"]["valid?"] is True
+        mem = MemSet()
+        result, _ = run_test(robustirc.irc_test,
+                             {"irc-factory": mem.factory,
+                              "quiesce": 0.1})
+        assert result["results"]["messages"]["valid?"] is True
+
+    def test_hazelcast_unique_ids(self):
+        from jepsen_tpu.suites import hazelcast
+        import itertools
+
+        class MemIdGen:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.it = itertools.count()
+
+            def factory(self, node):
+                mem = self
+
+                class Conn:
+                    def new_id(self):
+                        with mem.lock:
+                            return next(mem.it)
+
+                return Conn()
+
+        mem = MemIdGen()
+        result, _ = run_test(
+            hazelcast.unique_ids_test,
+            {"workload": "unique-ids", "idgen-factory": mem.factory})
+        assert result["results"]["unique-ids"]["valid?"] is True
+
+    def test_crate_versioned_cas_via_fallback(self):
+        # a conn without a native cas method exercises the _version
+        # SQL path far enough to fail definitively (no _version column
+        # in sqlite -> definite fail is NOT acceptable; so here we just
+        # check the native-cas path routes)
+        from jepsen_tpu.suites import crate
+
+        mem = MemKV()
+
+        class Conn:
+            def __init__(self, node):
+                self.kv = mem.factory(node)
+
+            def sql(self, stmt, params=()):
+                return []
+
+            def cas(self, k, old, new):
+                return self.kv.cas(k, old, new)
+
+            def close(self):
+                pass
+
+        cl = crate.VersionedRegisterClient(Conn)
+        cl = cl.open({}, "n1")
+        from jepsen_tpu import independent
+        from jepsen_tpu.history import invoke_op
+        mem.factory("n1").put(3, 1)
+        out = cl.invoke({}, invoke_op(0, "cas",
+                                      independent.tuple_(3, [1, 2])))
+        assert out.type == "ok"
+        out = cl.invoke({}, invoke_op(0, "cas",
+                                      independent.tuple_(3, [9, 5])))
+        assert out.type == "fail"
+
+
+class TestRegistry:
+    def test_all_suites_resolve(self):
+        for name in SUITES:
+            assert callable(main_for(name)), name
