@@ -166,11 +166,23 @@ class Linearizable(Checker):
         else:
             raise ValueError(f"unknown algorithm {algo!r}")
         # Truncation parity (checker.clj:155-158): writing full configs
-        # "can take *hours*".
-        if "configs" in a:
+        # "can take *hours*".  The config-explosion verdict sets
+        # 'configs' to a COUNT, not a list — only slice lists.
+        if isinstance(a.get("configs"), list):
             a["configs"] = a["configs"][:10]
-        if "final-paths" in a:
+        if isinstance(a.get("final-paths"), list):
             a["final-paths"] = a["final-paths"][:10]
+        if a.get("valid?") is False:
+            # checker.clj:147-154: render the failing window as
+            # linear.svg in the store dir.  Rendering must never fail
+            # the check itself.
+            try:
+                from jepsen_tpu.checker import linear_report
+                p = linear_report.write_to_store(test, history, a, opts)
+                if p:
+                    a["linear-svg"] = p
+            except Exception as e:      # noqa: BLE001
+                a["linear-svg-error"] = str(e)
         return a
 
 

@@ -1,0 +1,176 @@
+"""SVG rendering of a failed linearization window (reference: the
+external knossos library's `linear.report/render-analysis!`, invoked by
+`jepsen/src/jepsen/checker.clj:147-154` to write `linear.svg` whenever
+the linearizable checker finds an invalid history).
+
+The picture follows knossos' layout: time flows left to right, one
+horizontal lane per process, each op in the concurrent window drawn as
+a bar labelled `f value`, the op that could not linearize highlighted;
+the surviving configurations (model state + still-pending ops) are
+listed beneath the lanes."""
+
+from __future__ import annotations
+
+import html
+from typing import Any, Optional
+
+from jepsen_tpu.history import History
+
+BAR_H = 22
+LANE_GAP = 10
+LEFT_PAD = 90
+TOP_PAD = 34
+MIN_BAR_W = 60
+FOOTER_LINE_H = 16
+
+OK_FILL = "#a5d6a7"
+INFO_FILL = "#ffcc80"
+FAIL_FILL = "#ef9a9a"
+CULPRIT_STROKE = "#c62828"
+LANE_STROKE = "#dddddd"
+
+
+def _esc(s: Any) -> str:
+    return html.escape(str(s), quote=True)
+
+
+def window_ops(history, op_index: int) -> list:
+    """The concurrent window: every call whose [invoke, complete]
+    span overlaps the failing call's whole span — these are the
+    candidates the search could interleave with it, the ops knossos
+    shows.  'Crashes stay concurrent forever': an :info (or missing)
+    completion leaves the span open to the end of the history."""
+    h = History(history)
+    spans = []
+    fail_span = None
+    for inv, comp in h.pairs():
+        if not inv.is_invoke:
+            continue
+        start = inv.index
+        # info completions (and missing ones) stay concurrent forever
+        end = (comp.index if comp is not None
+               and comp.type in ("ok", "fail") else None)
+        spans.append((inv, comp, start, end))
+        if inv.index == op_index or (comp is not None
+                                     and comp.index == op_index):
+            fail_span = (start, end)
+    if fail_span is None:
+        return []
+    f_start, f_end = fail_span
+    out = []
+    for inv, comp, start, end in spans:
+        # span overlap with the culprit's full [invoke, complete]:
+        # starts before the culprit returns, ends after it invokes
+        starts_in_time = f_end is None or start <= f_end
+        ends_late_enough = end is None or end >= f_start
+        if starts_in_time and ends_late_enough:
+            out.append((inv, comp))
+    return out
+
+
+def render_analysis(history, analysis: dict,
+                    path: Optional[str] = None) -> Optional[str]:
+    """Build the SVG; write it to `path` when given.  Returns the SVG
+    text, or None when the analysis isn't an invalid one with a
+    located op."""
+    if analysis.get("valid?") is not False:
+        return None
+    op_index = analysis.get("op_index")
+    if op_index is None:
+        return None
+    ops = window_ops(history, op_index)
+    if not ops:
+        return None
+
+    procs = []
+    for inv, _ in ops:
+        if inv.process not in procs:
+            procs.append(inv.process)
+    lanes = {p: i for i, p in enumerate(procs)}
+
+    # x layout by op *index* (logical time — knossos plots real time,
+    # but index order is what the search reasons about)
+    idxs = [inv.index for inv, _ in ops]
+    idxs += [comp.index for _, comp in ops if comp is not None]
+    lo, hi = min(idxs), max(idxs)
+    span = max(1, hi - lo)
+    width = max(640, LEFT_PAD + (span + 1) * MIN_BAR_W + 40)
+    scale = (width - LEFT_PAD - 40) / span
+
+    def x(i: Optional[int]) -> float:
+        if i is None:
+            return width - 20                # open op: runs off the edge
+        return LEFT_PAD + (i - lo) * scale
+
+    configs = analysis.get("configs") or []
+    footer_h = (len(configs) + 2) * FOOTER_LINE_H + 10
+    height = TOP_PAD + len(procs) * (BAR_H + LANE_GAP) + footer_h
+
+    svg = [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+        f'height="{height}" font-family="sans-serif" font-size="11">',
+        f'<text x="{LEFT_PAD}" y="16" font-size="13" '
+        f'font-weight="bold">nonlinearizable window — op '
+        f'{op_index} cannot linearize</text>',
+    ]
+
+    for p, lane in lanes.items():
+        y = TOP_PAD + lane * (BAR_H + LANE_GAP)
+        svg.append(f'<text x="8" y="{y + BAR_H - 7}" '
+                   f'fill="#555">proc {_esc(p)}</text>')
+        svg.append(f'<line x1="{LEFT_PAD - 6}" y1="{y + BAR_H / 2}" '
+                   f'x2="{width - 10}" y2="{y + BAR_H / 2}" '
+                   f'stroke="{LANE_STROKE}"/>')
+
+    for inv, comp in ops:
+        lane = lanes[inv.process]
+        y = TOP_PAD + lane * (BAR_H + LANE_GAP)
+        x0 = x(inv.index)
+        x1 = x(comp.index if comp is not None else None)
+        w = max(MIN_BAR_W * 0.8, x1 - x0)
+        ctype = comp.type if comp is not None else "info"
+        fill = {"ok": OK_FILL, "fail": FAIL_FILL}.get(ctype, INFO_FILL)
+        culprit = (inv.index == op_index
+                   or (comp is not None and comp.index == op_index))
+        stroke = (f' stroke="{CULPRIT_STROKE}" stroke-width="2.5"'
+                  if culprit else ' stroke="#888"')
+        svg.append(f'<rect x="{x0:.1f}" y="{y}" width="{w:.1f}" '
+                   f'height="{BAR_H}" rx="3" fill="{fill}"{stroke}/>')
+        comp_val = (comp.value if comp is not None
+                    and comp.value is not None else inv.value)
+        label = f'{inv.f} {comp_val if comp_val is not None else ""}'
+        svg.append(f'<text x="{x0 + 4:.1f}" y="{y + BAR_H - 7}">'
+                   f'{_esc(label.strip())}</text>')
+
+    fy = TOP_PAD + len(procs) * (BAR_H + LANE_GAP) + FOOTER_LINE_H
+    svg.append(f'<text x="8" y="{fy}" font-weight="bold">surviving '
+               f'configurations just before the failing op:</text>')
+    if not configs:
+        svg.append(f'<text x="8" y="{fy + FOOTER_LINE_H}" '
+                   f'fill="#555">(none — every path is '
+                   f'inconsistent)</text>')
+    for i, cfg in enumerate(configs):
+        line = (f'model={cfg.get("model")!r} '
+                f'pending-linearized={cfg.get("pending-linearized")}')
+        svg.append(f'<text x="8" y="{fy + (i + 1) * FOOTER_LINE_H}" '
+                   f'fill="#555">{_esc(line)}</text>')
+    svg.append("</svg>")
+    text = "\n".join(svg)
+
+    if path is not None:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def write_to_store(test, history, analysis: dict, opts=None
+                   ) -> Optional[str]:
+    """checker.clj:147-154: render linear.svg into the test's store
+    directory (respecting the independent checker's subdirectory)."""
+    if not (test and test.get("name") and test.get("start-time")):
+        return None
+    from jepsen_tpu import store
+    sub = list((opts or {}).get("subdirectory") or [])
+    p = store.make_path(test, *sub, "linear.svg")
+    out = render_analysis(history, analysis, str(p))
+    return str(p) if out else None

@@ -286,6 +286,21 @@ class BatchedLinearizableChecker(ck.Checker):
             per_key = [wgl_cpu.check(self.model, s) for s in subs]
         results = dict(zip(ks, per_key))
         failures = [k for k, r in results.items() if r["valid?"] is not True]
+        # Failing-window SVGs under independent/<k>/, matching the
+        # host-parallel IndependentChecker path (checker.clj:147-154).
+        for k, sub in zip(ks, subs):
+            r = results[k]
+            if r.get("valid?") is False and r.get("op_index") is not None:
+                try:
+                    from jepsen_tpu.checker import linear_report
+                    subdir = (list((opts or {}).get("subdirectory")
+                                   or []) + [DIR, str(k)])
+                    p = linear_report.write_to_store(
+                        test, sub, r, {"subdirectory": subdir})
+                    if p:
+                        r["linear-svg"] = p
+                except Exception as e:          # noqa: BLE001
+                    r["linear-svg-error"] = str(e)
         return {"valid?": ck.merge_valid(r["valid?"]
                                          for r in results.values()),
                 "results": results,

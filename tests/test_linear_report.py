@@ -1,0 +1,125 @@
+"""SVG render of the failing linearization window (the knossos
+linear.report equivalent, checker.clj:147-154)."""
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import models, store
+from jepsen_tpu.checker import linear_report
+from jepsen_tpu.history import History, info_op, invoke_op, ok_op
+from jepsen_tpu.ops import wgl_cpu
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def bad_history():
+    return History([
+        invoke_op(0, "write", 1), ok_op(0, "write", 1),
+        invoke_op(1, "read", None),       # concurrent with write 2
+        invoke_op(2, "write", 2),
+        ok_op(2, "write", 2),
+        ok_op(1, "read", 7),              # never written: culprit
+    ]).index()
+
+
+class TestRender:
+    def test_invalid_analysis_renders_svg(self):
+        h = bad_history()
+        a = wgl_cpu.check(models.CASRegister(), h)
+        assert a["valid?"] is False
+        svg = linear_report.render_analysis(h, a)
+        assert svg is not None
+        assert svg.startswith("<svg")
+        assert "nonlinearizable window" in svg
+        assert "read 7" in svg            # culprit labelled
+        assert "proc 1" in svg
+        # the failing op's bar carries the culprit stroke
+        assert linear_report.CULPRIT_STROKE in svg
+
+    def test_valid_analysis_renders_nothing(self):
+        h = History([invoke_op(0, "write", 1),
+                     ok_op(0, "write", 1)]).index()
+        a = wgl_cpu.check(models.CASRegister(), h)
+        assert linear_report.render_analysis(h, a) is None
+
+    def test_window_includes_concurrent_info_op(self):
+        # a crashed op stays concurrent forever and must appear
+        h = History([
+            invoke_op(3, "cas", [0, 5]), info_op(3, "cas", [0, 5]),
+            invoke_op(0, "write", 1), ok_op(0, "write", 1),
+            invoke_op(1, "read", None), ok_op(1, "read", 9),
+        ]).index()
+        a = wgl_cpu.check(models.CASRegister(), h)
+        assert a["valid?"] is False
+        svg = linear_report.render_analysis(h, a)
+        assert "cas" in svg
+
+    def test_write_to_file(self, tmp_path):
+        h = bad_history()
+        a = wgl_cpu.check(models.CASRegister(), h)
+        p = tmp_path / "linear.svg"
+        linear_report.render_analysis(h, a, str(p))
+        assert p.read_text().startswith("<svg")
+
+
+class TestCheckerIntegration:
+    def test_linearizable_writes_linear_svg(self):
+        test = {"name": "linear-svg-test", "start-time": "2026",
+                "nodes": []}
+        h = bad_history()
+        c = ck.linearizable({"model": models.CASRegister()})
+        a = c.check(test, h, {})
+        assert a["valid?"] is False
+        assert "linear-svg" in a, a.get("linear-svg-error")
+        with open(a["linear-svg"]) as f:
+            assert f.read().startswith("<svg")
+
+    def test_no_store_dir_no_crash(self):
+        h = bad_history()
+        c = ck.linearizable({"model": models.CASRegister()})
+        a = c.check({}, h, {})
+        assert a["valid?"] is False
+        assert "linear-svg" not in a
+
+    def test_config_explosion_count_not_sliced(self):
+        # the explosion verdict's 'configs' is a COUNT; slicing it
+        # crashed the whole check
+        h = History([invoke_op(p, "write", p) for p in range(4)]
+                    + [ok_op(p, "write", p) for p in range(4)]).index()
+        c = ck.linearizable({"model": models.CASRegister(),
+                             "algorithm": "cpu", "max_configs": 1})
+        a = c.check({}, h, {})
+        assert a["valid?"] == "unknown"
+        assert a["cause"] == "config-explosion"
+
+    def test_window_spans_culprit_full_duration(self):
+        # write 2 is invoked AFTER the failing read's invocation but
+        # inside its [invoke, complete] span — it must be drawn: it is
+        # exactly the candidate the search interleaves
+        h = bad_history()
+        a = wgl_cpu.check(models.CASRegister(), h)
+        ops = linear_report.window_ops(h, a["op_index"])
+        fs = sorted((inv.f, inv.value) for inv, _ in ops)
+        assert ("write", 2) in fs
+        svg = linear_report.render_analysis(h, a)
+        assert "write 2" in svg
+
+    def test_batched_independent_checker_renders_svg(self):
+        from jepsen_tpu import independent as ind
+
+        test = {"name": "batch-svg", "start-time": "2026", "nodes": []}
+        h = []
+        for o in bad_history():
+            h.append(o.assoc(value=ind.KV(5, o.value)))
+        h = History(h).index()
+        r = ind.batch_checker(models.CASRegister()).check(test, h, {})
+        assert r["valid?"] is False
+        key_result = r["results"][5]
+        assert "linear-svg" in key_result, key_result
+        assert "independent" in key_result["linear-svg"]
+        with open(key_result["linear-svg"]) as f:
+            assert f.read().startswith("<svg")
