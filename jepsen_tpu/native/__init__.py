@@ -1,0 +1,73 @@
+"""Native (C) runtime accelerators, compiled lazily on first use.
+
+The reference keeps its hot paths on the JVM and its fault injectors in
+C (SURVEY.md §2.2); here the compute path is JAX/XLA and the native
+layer accelerates the *host* runtime around it — currently `_histscan`,
+the fused history scan feeding the batched device kernels
+(ops/wgl_seg).  Everything degrades gracefully: if no compiler is
+available the pure-Python twin runs instead, bit-identically.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_BUILD = os.path.join(_DIR, "build")
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+def _so_path(name: str) -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(_BUILD, name + suffix)
+
+
+def _build(name: str, source: str) -> Optional[str]:
+    """cc -shared -fPIC — rebuilt whenever the source is newer."""
+    out = _so_path(name)
+    src = os.path.join(_DIR, source)
+    try:
+        if (os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        os.makedirs(_BUILD, exist_ok=True)
+        include = sysconfig.get_paths()["include"]
+        cc = os.environ.get("CC", "cc")
+        cmd = [cc, "-shared", "-fPIC", "-O2", f"-I{include}",
+               src, "-o", out]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return None
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _load(name: str, source: str):
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        mod = None
+        path = _build(name, source)
+        if path is not None:
+            try:
+                spec = importlib.util.spec_from_file_location(name, path)
+                mod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(mod)
+            except Exception:       # noqa: BLE001 - fall back to Python
+                mod = None
+        _cache[name] = mod
+        return mod
+
+
+def histscan():
+    """The _histscan extension module, or None (Python fallback)."""
+    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+        return None
+    return _load("_histscan", "histscan.c")

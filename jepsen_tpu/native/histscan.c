@@ -1,0 +1,358 @@
+/* Native history-scan accelerator (CPython extension).
+ *
+ * One fused pass over a history's ops doing invoke/completion pairing,
+ * slot assignment, and op interning — the C twin of
+ * jepsen_tpu/ops/wgl_seg._fast_scan, which is the host-side hot path
+ * when batching thousands of independent keys for the device kernel
+ * (SURVEY.md §2.5: "history transport to device").  ~8x the Python
+ * scan; results are bit-identical (differential tests enforce it).
+ *
+ * fast_scan(ops, f_codes, seen, rows, max_open_bits)
+ *   ops           list of Op objects (attrs: process/type/f/value)
+ *   f_codes       dict: f -> int code
+ *   seen          dict: (f, a, b, ok) -> uop id   (shared, updated)
+ *   rows          list of (f, a, b, ok) rows       (shared, updated)
+ *   max_open_bits max simultaneously-open calls
+ * returns None when the key is outside the batch engine's scope
+ * (crashed calls, deep concurrency, non-int32 values, double-invoke),
+ * else a tuple:
+ *   (n_calls, max_open,
+ *    ret_slots  bytes of int32[n_rets],
+ *    cand_counts bytes of int32[n_rets],
+ *    cand_slots bytes of int32[total],
+ *    cand_uops  bytes of int32[total])
+ * Shared seen/rows are only mutated on success.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+#define MAX_OPEN_HARD 64
+
+typedef struct {
+    int32_t *data;
+    Py_ssize_t len, cap;
+} vec;
+
+static int vec_push(vec *v, int32_t x) {
+    if (v->len == v->cap) {
+        Py_ssize_t ncap = v->cap ? v->cap * 2 : 256;
+        int32_t *nd = PyMem_Realloc(v->data, ncap * sizeof(int32_t));
+        if (!nd) return -1;
+        v->data = nd;
+        v->cap = ncap;
+    }
+    v->data[v->len++] = x;
+    return 0;
+}
+
+static PyObject *s_process, *s_type, *s_f, *s_value;
+static PyObject *t_invoke, *t_ok, *t_fail, *t_info;
+
+/* -1 error, 0 not-a-client, 1 client (proc written) */
+static int client_process(PyObject *op, long *proc) {
+    PyObject *p = PyObject_GetAttr(op, s_process);
+    if (!p) return -1;
+    if (!PyLong_CheckExact(p)) {        /* bool is not exact long */
+        Py_DECREF(p);
+        return 0;
+    }
+    long v = PyLong_AsLong(p);
+    Py_DECREF(p);
+    if (v == -1 && PyErr_Occurred()) return -1;
+    if (v < 0) return 0;
+    *proc = v;
+    return 1;
+}
+
+/* op type as 0=invoke 1=ok 2=fail 3=info, -1 other, -2 error */
+static int op_type(PyObject *op) {
+    PyObject *t = PyObject_GetAttr(op, s_type);
+    if (!t) return -2;
+    int out = -1;
+    if (t == t_invoke) out = 0;
+    else if (t == t_ok) out = 1;
+    else if (t == t_fail) out = 2;
+    else if (t == t_info) out = 3;
+    else {
+        int r;
+        if ((r = PyObject_RichCompareBool(t, t_invoke, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 0;
+        else if ((r = PyObject_RichCompareBool(t, t_ok, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 1;
+        else if ((r = PyObject_RichCompareBool(t, t_fail, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 2;
+        else if ((r = PyObject_RichCompareBool(t, t_info, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 3;
+    }
+    Py_DECREF(t);
+    return out;
+}
+
+/* encode value like _generic_encode_op; 1 ok, 0 out-of-scope, -1 err */
+static int encode_value(PyObject *v, long *a, long *b, int *ok) {
+    *a = 0; *b = 0; *ok = 0;
+    if (v == Py_None) return 1;                  /* unencodable: ok=0 */
+    if (PyBool_Check(v)) {
+        *a = (v == Py_True);
+        *ok = 1;
+        return 1;
+    }
+    if (PyLong_Check(v)) {          /* subclasses too (IntEnum ...) */
+        int overflow = 0;
+        long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+        if (x == -1 && PyErr_Occurred()) return -1;
+        if (overflow || x < -2147483648LL || x >= 2147483648LL)
+            return 0;                            /* outside int32 */
+        *a = (long)x;
+        *ok = 1;
+        return 1;
+    }
+    if (PyList_CheckExact(v) || PyTuple_CheckExact(v)) {
+        Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+        if (n != 2) return 1;                    /* unencodable: ok=0 */
+        PyObject *x0 = PySequence_Fast_GET_ITEM(v, 0);
+        PyObject *x1 = PySequence_Fast_GET_ITEM(v, 1);
+        if (!PyLong_Check(x0) || !PyLong_Check(x1)
+            || PyBool_Check(x0) || PyBool_Check(x1))
+            return 1;                            /* unencodable: ok=0 */
+        int ov0 = 0, ov1 = 0;
+        long long a0 = PyLong_AsLongLongAndOverflow(x0, &ov0);
+        long long b0 = PyLong_AsLongLongAndOverflow(x1, &ov1);
+        if ((a0 == -1 || b0 == -1) && PyErr_Occurred()) return -1;
+        if (ov0 || ov1 || a0 < -2147483648LL || a0 >= 2147483648LL
+            || b0 < -2147483648LL || b0 >= 2147483648LL)
+            return 0;
+        *a = (long)a0;
+        *b = (long)b0;
+        *ok = 1;
+        return 1;
+    }
+    return 1;                                    /* unencodable: ok=0 */
+}
+
+static PyObject *fast_scan(PyObject *self, PyObject *args) {
+    PyObject *ops, *f_codes, *seen, *rows;
+    long max_open_bits;
+    if (!PyArg_ParseTuple(args, "O!O!O!O!l", &PyList_Type, &ops,
+                          &PyDict_Type, &f_codes, &PyDict_Type, &seen,
+                          &PyList_Type, &rows, &max_open_bits))
+        return NULL;
+    if (max_open_bits > MAX_OPEN_HARD) max_open_bits = MAX_OPEN_HARD;
+
+    Py_ssize_t n = PyList_GET_SIZE(ops);
+    /* fate[i] = completion index for the invoke at position i, or -1 */
+    Py_ssize_t *fate = PyMem_Malloc((n ? n : 1) * sizeof(Py_ssize_t));
+    int8_t *kinds = PyMem_Malloc((n ? n : 1) * sizeof(int8_t));
+    if (!fate || !kinds) {
+        PyMem_Free(fate); PyMem_Free(kinds);
+        return PyErr_NoMemory();
+    }
+    for (Py_ssize_t i = 0; i < n; i++) { fate[i] = -1; kinds[i] = -1; }
+
+    /* pass 1: pair completions with invokes (open dict: proc -> pos) */
+    PyObject *open_by_proc = PyDict_New();
+    PyObject *result = NULL;         /* set to None for fallback */
+    PyObject *new_seen = NULL, *new_rows = NULL;
+    vec ret_slots = {0}, cand_counts = {0}, cand_slots = {0},
+        cand_uops = {0};
+    long *slot_of = NULL, *uop_of = NULL, *open_procs = NULL;
+    if (!open_by_proc) goto fail;
+
+    long n_client = 0;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *op = PyList_GET_ITEM(ops, i);
+        long proc;
+        int c = client_process(op, &proc);
+        if (c < 0) goto fail;
+        if (c == 0) continue;
+        n_client++;
+        int t = op_type(op);
+        if (t == -2) goto fail;
+        kinds[i] = (int8_t)t;
+        PyObject *pk = PyLong_FromLong(proc);
+        if (!pk) goto fail;
+        if (t == 0) {
+            if (PyDict_GetItem(open_by_proc, pk)) {   /* double invoke */
+                Py_DECREF(pk);
+                goto fallback;
+            }
+            PyObject *pos = PyLong_FromSsize_t(i);
+            int r = pos ? PyDict_SetItem(open_by_proc, pk, pos) : -1;
+            Py_XDECREF(pos);
+            Py_DECREF(pk);
+            if (r < 0) goto fail;
+        } else {
+            PyObject *ip = PyDict_GetItem(open_by_proc, pk);
+            if (ip) {
+                fate[PyLong_AsSsize_t(ip)] = i;
+                if (PyDict_DelItem(open_by_proc, pk) < 0) {
+                    Py_DECREF(pk);
+                    goto fail;
+                }
+            }
+            Py_DECREF(pk);
+        }
+    }
+    if (PyDict_GET_SIZE(open_by_proc) > 0)
+        goto fallback;               /* unpaired invokes: crashed */
+
+    /* pass 2: slots + interning + returns */
+    new_seen = PyDict_New();
+    new_rows = PyList_New(0);
+    if (!new_seen || !new_rows) goto fail;
+    slot_of = PyMem_Malloc(MAX_OPEN_HARD * sizeof(long));
+    uop_of = PyMem_Malloc(MAX_OPEN_HARD * sizeof(long));
+    open_procs = PyMem_Malloc(MAX_OPEN_HARD * sizeof(long));
+    long free_slots[MAX_OPEN_HARD];
+    long n_free = 0, next_slot = 0, n_open = 0;
+    long max_open = 0, n_calls = 0;
+    if (!slot_of || !uop_of || !open_procs) goto fail;
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        int8_t t = kinds[i];
+        if (t < 0) continue;
+        PyObject *op = PyList_GET_ITEM(ops, i);
+        long proc;
+        int c2 = client_process(op, &proc);
+        if (c2 < 0) goto fail;
+        if (c2 == 0) continue;
+        if (t == 0) {
+            Py_ssize_t ci = fate[i];
+            if (ci < 0 || kinds[ci] == 3) goto fallback; /* crashed */
+            if (kinds[ci] == 2) continue;                /* fail pair */
+            PyObject *v = PyObject_GetAttr(op, s_value);
+            if (!v) goto fail;
+            if (v == Py_None) {
+                Py_DECREF(v);
+                v = PyObject_GetAttr(PyList_GET_ITEM(ops, ci), s_value);
+                if (!v) goto fail;
+            }
+            PyObject *f = PyObject_GetAttr(op, s_f);
+            if (!f) { Py_DECREF(v); goto fail; }
+            PyObject *fco = PyDict_GetItem(f_codes, f);
+            Py_DECREF(f);
+            if (!fco) { Py_DECREF(v); goto fallback; }   /* no f-code */
+            long fc = PyLong_AsLong(fco);
+            long a, b; int okv;
+            int e = encode_value(v, &a, &b, &okv);
+            Py_DECREF(v);
+            if (e < 0) goto fail;
+            if (e == 0) goto fallback;                   /* non-int32 */
+            PyObject *key = Py_BuildValue("(llli)", fc, a, b, okv);
+            if (!key) goto fail;
+            PyObject *uo = PyDict_GetItem(seen, key);
+            if (!uo) uo = PyDict_GetItem(new_seen, key);
+            long u;
+            if (uo) {
+                u = PyLong_AsLong(uo);
+                Py_DECREF(key);
+            } else {
+                u = PyList_GET_SIZE(rows) + PyList_GET_SIZE(new_rows);
+                PyObject *uu = PyLong_FromLong(u);
+                int r = uu ? PyDict_SetItem(new_seen, key, uu) : -1;
+                if (r == 0) r = PyList_Append(new_rows, key);
+                Py_XDECREF(uu);
+                Py_DECREF(key);
+                if (r < 0) goto fail;
+            }
+            long s = n_free ? free_slots[--n_free] : next_slot++;
+            if (n_open >= MAX_OPEN_HARD) goto fallback;
+            open_procs[n_open] = proc;
+            slot_of[n_open] = s;
+            uop_of[n_open] = u;
+            n_open++;
+            if (n_open > max_open) {
+                max_open = n_open;
+                if (max_open > max_open_bits) goto fallback;
+            }
+            n_calls++;
+        } else if (t == 1) {
+            long idx = -1;
+            for (long j = 0; j < n_open; j++)
+                if (open_procs[j] == proc) { idx = j; break; }
+            if (idx < 0) continue;
+            if (vec_push(&ret_slots, (int32_t)slot_of[idx]) < 0 ||
+                vec_push(&cand_counts, (int32_t)n_open) < 0)
+                goto fail;
+            for (long j = 0; j < n_open; j++) {
+                if (vec_push(&cand_slots, (int32_t)slot_of[j]) < 0 ||
+                    vec_push(&cand_uops, (int32_t)uop_of[j]) < 0)
+                    goto fail;
+            }
+            free_slots[n_free++] = slot_of[idx];
+            for (long j = idx; j < n_open - 1; j++) {
+                open_procs[j] = open_procs[j + 1];
+                slot_of[j] = slot_of[j + 1];
+                uop_of[j] = uop_of[j + 1];
+            }
+            n_open--;
+        }
+        /* t==2/3 completions: nothing to do (handled via fate) */
+    }
+
+    /* success: merge staged interning into the shared tables */
+    if (PyDict_Update(seen, new_seen) < 0) goto fail;
+    {
+        Py_ssize_t m = PyList_GET_SIZE(new_rows);
+        for (Py_ssize_t i2 = 0; i2 < m; i2++) {
+            if (PyList_Append(rows, PyList_GET_ITEM(new_rows, i2)) < 0)
+                goto fail;
+        }
+    }
+    result = Py_BuildValue(
+        "(lly#y#y#y#)", n_calls, max_open,
+        (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),
+        (char *)cand_counts.data, cand_counts.len * sizeof(int32_t),
+        (char *)cand_slots.data, cand_slots.len * sizeof(int32_t),
+        (char *)cand_uops.data, cand_uops.len * sizeof(int32_t));
+    goto done;
+
+fallback:
+    result = Py_None;
+    Py_INCREF(Py_None);
+    goto done;
+
+fail:
+    /* result stays NULL: propagate the Python error */
+done:
+    Py_XDECREF(open_by_proc);
+    Py_XDECREF(new_seen);
+    Py_XDECREF(new_rows);
+    PyMem_Free(fate);
+    PyMem_Free(kinds);
+    PyMem_Free(slot_of);
+    PyMem_Free(uop_of);
+    PyMem_Free(open_procs);
+    PyMem_Free(ret_slots.data);
+    PyMem_Free(cand_counts.data);
+    PyMem_Free(cand_slots.data);
+    PyMem_Free(cand_uops.data);
+    return result;
+}
+
+static PyMethodDef methods[] = {
+    {"fast_scan", fast_scan, METH_VARARGS,
+     "Fused pairing/slotting/interning scan over one history."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_histscan", NULL, -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__histscan(void) {
+    s_process = PyUnicode_InternFromString("process");
+    s_type = PyUnicode_InternFromString("type");
+    s_f = PyUnicode_InternFromString("f");
+    s_value = PyUnicode_InternFromString("value");
+    t_invoke = PyUnicode_InternFromString("invoke");
+    t_ok = PyUnicode_InternFromString("ok");
+    t_fail = PyUnicode_InternFromString("fail");
+    t_info = PyUnicode_InternFromString("info");
+    if (!s_process || !s_type || !s_f || !s_value || !t_invoke ||
+        !t_ok || !t_fail || !t_info)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

@@ -62,6 +62,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from jepsen_tpu.history import History
 from jepsen_tpu.models import DeviceSpec
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
 
@@ -138,6 +139,28 @@ def _encode_calls(calls, spec: DeviceSpec, seen: Optional[dict] = None,
     return np.asarray(rows, np.int32).reshape(len(rows), 4), call_uop
 
 
+@functools.lru_cache(maxsize=32)
+def _expand_fn(step):
+    """Jitted state-space expansion, cached per model step function —
+    defining it inside _enumerate_states re-traced and re-compiled on
+    EVERY check call."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def expand(states, uops):
+        # [n, S], [U, 4] -> ([U, n, S] states', [U, n] legal)
+        def one(st):
+            def per_op(u):
+                st2, legal = step(st, u[0], u[1], u[2], u[3] != 0)
+                return st2.astype(jnp.int32), legal
+            return jax.vmap(per_op)(uops)
+        st2, legal = jax.vmap(one)(states)  # [n, U, S], [n, U]
+        return st2.transpose(1, 0, 2), legal.transpose(1, 0)
+
+    return expand
+
+
 def _enumerate_states(spec: DeviceSpec, init_state: np.ndarray,
                       uops: np.ndarray, max_states: int):
     """Close {init} under every distinct op's legal transition.  Returns
@@ -152,16 +175,10 @@ def _enumerate_states(spec: DeviceSpec, init_state: np.ndarray,
     # compile latency (tens of seconds on a tunneled chip) would dwarf
     # the work.
     cpu = jax.devices("cpu")[0]
+    base = _expand_fn(step)
 
-    @jax.jit
-    def expand(states):  # [n, S] -> ([U, n, S] states', [U, n] legal)
-        def one(st):
-            def per_op(u):
-                st2, legal = step(st, u[0], u[1], u[2], u[3] != 0)
-                return st2.astype(jnp.int32), legal
-            return jax.vmap(per_op)(jnp.asarray(uops))
-        st2, legal = jax.vmap(one)(states)  # [n, U, S], [n, U]
-        return st2.transpose(1, 0, 2), legal.transpose(1, 0)
+    def expand(states):
+        return base(states, uops)
 
     table: dict[bytes, int] = {}
     states: list[np.ndarray] = []
@@ -279,6 +296,165 @@ def _next_pow2(x: int) -> int:
     while b < x:
         b *= 2
     return b
+
+
+class _FastKey:
+    """One batchable key, produced by a single fused host pass:
+    rets[r] = (slot, [(open_slot, open_uop), ...]) per return event —
+    or, from the native scanner, the same data as flat int32 arrays
+    (ret_slots, cand_counts, cand_slots, cand_uops)."""
+
+    __slots__ = ("rets", "max_open", "n_calls", "arrays")
+
+    def __init__(self, rets, max_open, n_calls, arrays=None):
+        self.rets = rets
+        self.max_open = max_open
+        self.n_calls = n_calls
+        self.arrays = arrays
+
+    @property
+    def n_rets(self):
+        return (len(self.arrays[0]) if self.arrays is not None
+                else len(self.rets))
+
+
+def _native_scan(ops: list, spec, seen: dict, rows: list,
+                 max_open_bits: int):
+    """The C twin of _fast_scan (native/histscan.c) — ~8x faster on
+    the host; returns None for out-of-scope keys just like it."""
+    from jepsen_tpu import native
+
+    mod = native.histscan()
+    if mod is None:
+        return False                 # extension unavailable
+    out = mod.fast_scan(ops, spec.f_codes, seen, rows, max_open_bits)
+    if out is None:
+        return None
+    n_calls, max_open, rs, counts, cs, cu = out
+    # Py_BuildValue turns a NULL pointer (empty vec) into None
+    return _FastKey(None, max_open, n_calls,
+                    arrays=(np.frombuffer(rs or b"", np.int32),
+                            np.frombuffer(counts or b"", np.int32),
+                            np.frombuffer(cs or b"", np.int32),
+                            np.frombuffer(cu or b"", np.int32)))
+
+
+def _fast_scan(history, spec, seen: dict, rows: list,
+               max_open_bits: int):
+    """Fused pairing + slot assignment + op interning for one key —
+    ONE pass over the ops instead of prepare() + _assign_slots() +
+    _encode_calls() building per-op objects (the host side dominated
+    multi-key bench wall time).  Returns a _FastKey, or None when the
+    key is outside the batch engine's scope (crashed calls, too-deep
+    concurrency, un-internable ops, custom encode_op) — the caller
+    sends those through the slow path.  Shared seen/rows are only
+    touched on success."""
+    if getattr(spec, "encode_op", None) is not None:
+        return None                  # custom encodings take the slow path
+    ops = history.ops if isinstance(history, History) else \
+        History(history).ops
+    f_codes = spec.f_codes
+
+    # Pass 1: completion for each invocation position.
+    open_by_process: dict = {}
+    fate: dict = {}
+    n_client = 0
+    for pos, o in enumerate(ops):
+        p = o.process
+        if not (type(p) is int and p >= 0):
+            continue
+        n_client += 1
+        if o.type == "invoke":
+            if p in open_by_process:
+                # malformed history: send it to the slow path, whose
+                # prepare() raises the descriptive ValueError (the C
+                # twin does the same)
+                return None
+            open_by_process[p] = pos
+        else:
+            ip = open_by_process.pop(p, None)
+            if ip is not None:
+                fate[ip] = o
+    if open_by_process:
+        return None                  # unpaired invokes stay open: crashed
+    if n_client == 0:
+        return _FastKey([], 0, 0)
+
+    # Pass 2: slots + interning + return records.
+    new_seen: dict = {}
+    new_rows: list = []
+    free: list = []
+    next_slot = 0
+    slot_of: dict = {}
+    uop_of: dict = {}
+    open_list: list = []
+    rets: list = []
+    max_open = 0
+    n_calls = 0
+    INT32 = 2 ** 31
+    for pos, o in enumerate(ops):
+        p = o.process
+        if not (type(p) is int and p >= 0):
+            continue
+        t = o.type
+        if t == "invoke":
+            comp = fate.get(pos)
+            if comp is None or comp.type == "info":
+                return None          # crashed call
+            if comp.type == "fail":
+                continue             # the pair never happened: dropped
+            v = o.value if o.value is not None else comp.value
+            fc = f_codes.get(o.f, -1)
+            if fc < 0:
+                return None          # model has no f-code for this op
+            # _generic_encode_op, inlined — isinstance (not exact-type)
+            # checks so int subclasses (IntEnum, ...) encode by VALUE
+            # exactly as the serial engines do
+            if isinstance(v, bool):
+                av, bv, okv = int(v), 0, True
+            elif isinstance(v, int):
+                av, bv, okv = v, 0, True
+            elif isinstance(v, (list, tuple)) and len(v) == 2 \
+                    and isinstance(v[0], int) and isinstance(v[1], int) \
+                    and not isinstance(v[0], bool) \
+                    and not isinstance(v[1], bool):
+                av, bv, okv = v[0], v[1], True
+            else:
+                av, bv, okv = 0, 0, False
+            if not (-INT32 <= av < INT32 and -INT32 <= bv < INT32):
+                return None          # outside the int32 device range
+            key = (fc, av, bv, okv)
+            u = seen.get(key)
+            if u is None:
+                u = new_seen.get(key)
+            if u is None:
+                u = new_seen[key] = len(rows) + len(new_rows)
+                new_rows.append(key)
+            s = free.pop() if free else next_slot
+            if s == next_slot:
+                next_slot += 1
+            slot_of[p] = s
+            uop_of[p] = u
+            open_list.append(p)
+            if len(open_list) > max_open:
+                max_open = len(open_list)
+                if max_open > max_open_bits:
+                    return None      # too many simultaneously-open calls
+            n_calls += 1
+        elif t == "ok":
+            s = slot_of.get(p)
+            if s is None:
+                continue
+            rets.append((s, [(slot_of[q], uop_of[q])
+                             for q in open_list]))
+            open_list.remove(p)
+            del slot_of[p]
+            del uop_of[p]
+            free.append(s)
+
+    seen.update(new_seen)
+    rows.extend(new_rows)
+    return _FastKey(rets, max_open, n_calls)
 
 
 def _assign_slots(events):
@@ -862,30 +1038,34 @@ def check_many(model, histories, *, max_states: int = 64,
         raise Unsupported(f"model {model!r} has no device spec")
 
     t0 = time.monotonic()
-    preps = [h if isinstance(h, PreparedHistory) else prepare(h)
-             for h in histories]
     backend_name = jax.default_backend()
-    results: list = [None] * len(preps)
+    results: list = [None] * len(histories)
 
-    # Partition keys: batchable vs fallback.
+    # Partition keys: batchable vs fallback — one fused host pass per
+    # key (no per-op objects).
     seen: dict = {}
     rows: list = []
-    batch: list = []        # (key index, prep, call_uop)
+    batch: list = []        # (key index, _FastKey)
     fall: list = []
-    for i, p in enumerate(preps):
-        if not p.calls:
+    native_ok = getattr(spec, "encode_op", None) is None
+    for i, h in enumerate(histories):
+        if isinstance(h, PreparedHistory):
+            fall.append(i)  # pre-prepped callers take the slow path
+            continue
+        fk = False
+        if native_ok:
+            ops = h.ops if isinstance(h, History) else History(h).ops
+            fk = _native_scan(ops, spec, seen, rows, max_open_bits)
+        if fk is False:              # no extension: Python twin
+            fk = _fast_scan(h, spec, seen, rows, max_open_bits)
+        if fk is None:
+            fall.append(i)
+        elif fk.n_calls == 0:
             results[i] = {"valid?": True, "op_count": 0,
-                          "backend": backend_name, "engine": "wgl_seg_batch"}
-            continue
-        if any(c.is_crashed for c in p.calls) or p.max_open > max_open_bits:
-            fall.append(i)
-            continue
-        try:
-            _, call_uop = _encode_calls(p.calls, spec, seen, rows)
-        except Unsupported:
-            fall.append(i)
-            continue
-        batch.append((i, p, call_uop))
+                          "backend": backend_name,
+                          "engine": "wgl_seg_batch"}
+        else:
+            batch.append((i, fk))
 
     if batch:
         uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
@@ -894,16 +1074,15 @@ def check_many(model, histories, *, max_states: int = 64,
             states, legal, next_state = _enumerate_states(
                 spec, init, uops, max_states)
         except Unsupported:
-            fall.extend(i for i, _, _ in batch)
+            fall.extend(i for i, _ in batch)
             batch = []
 
     if batch:
         Sn = states.shape[0]
-        R = max(p.max_open for _, p, _ in batch)
+        R = max(fk.max_open for _, fk in batch)
         M = 1 << R
-        L = _next_pow2(max(len([e for e in p.events if e[1] == 1])
-                           for _, p, _ in batch))
-        C = _next_pow2(max(p.max_open for _, p, _ in batch))
+        L = _next_pow2(max(fk.n_rets for _, fk in batch))
+        C = _next_pow2(R)
         # Pad the key axis for lane alignment (and even mesh sharding).
         Kk = len(batch)
         mult = 128
@@ -914,13 +1093,25 @@ def check_many(model, histories, *, max_states: int = 64,
         ret_slot = np.full((Kp, L), -1, np.int32)
         cand_slot = np.zeros((Kp, L, C), np.int32)
         cand_uop = np.full((Kp, L, C), -1, np.int32)
-        for kk, (_, p, call_uop) in enumerate(batch):
-            rets, _, _ = _assign_slots(p.events)
-            for r, (cid, slot, cands) in enumerate(rets):
+        for kk, (_, fk) in enumerate(batch):
+            if fk.arrays is not None:
+                # native form: vectorized scatter from the flat arrays
+                rs, counts, cs, cu = fk.arrays
+                nr = len(rs)
+                ret_slot[kk, :nr] = rs
+                if len(cs):
+                    ends = np.cumsum(counts)
+                    r_idx = np.repeat(np.arange(nr), counts)
+                    j_idx = (np.arange(ends[-1])
+                             - np.repeat(ends - counts, counts))
+                    cand_slot[kk, r_idx, j_idx] = cs
+                    cand_uop[kk, r_idx, j_idx] = cu
+                continue
+            for r, (slot, cands) in enumerate(fk.rets):
                 ret_slot[kk, r] = slot
-                for j, (c2, s2) in enumerate(cands):
+                for j, (s2, u2) in enumerate(cands):
                     cand_slot[kk, r, j] = s2
-                    cand_uop[kk, r, j] = call_uop[c2]
+                    cand_uop[kk, r, j] = u2
 
         diag_w, const_w, const_t0 = _decompose(legal, next_state)
         ret_t = np.ascontiguousarray(ret_slot.T)             # [L, K]
@@ -943,10 +1134,10 @@ def check_many(model, histories, *, max_states: int = 64,
         T = np.asarray(kern(*args))                      # [Kp, 1, Sn]
         t_kernel = time.monotonic() - t1
         ok_k = (T[:, 0, :] > 0.5).any(axis=1)
-        for kk, (i, p, _) in enumerate(batch):
+        for kk, (i, fk) in enumerate(batch):
             results[i] = {
                 "valid?": bool(ok_k[kk]),
-                "op_count": len(p.calls),
+                "op_count": fk.n_calls,
                 "backend": backend_name,
                 "engine": "wgl_seg_batch",
                 "time_kernel_s": t_kernel,
@@ -974,7 +1165,9 @@ def check_many(model, histories, *, max_states: int = 64,
                     # exact CPU oracle handles anything.
                     return wgl_cpu.check(m, h)
         for i in fall:
-            results[i] = fallback(model, preps[i])
+            h = histories[i]
+            p = h if isinstance(h, PreparedHistory) else prepare(h)
+            results[i] = fallback(model, p)
             results[i].setdefault("engine", "fallback")
 
     t_total = time.monotonic() - t0

@@ -201,6 +201,69 @@ class TestBatch:
         assert len(rows) == n_rows
         assert len(seen) == n_rows
 
+    def test_native_scan_matches_python_scan(self):
+        # the C scanner must be bit-identical to the Python twin on
+        # every in-scope key and agree on out-of-scope verdicts
+        from jepsen_tpu import native
+
+        mod = native.histscan()
+        if mod is None:
+            pytest.skip("no C toolchain")
+        spec = models.CASRegister().device_spec()
+        for s in range(25):
+            h = rand_history(400 + s, n_ops=40,
+                             crash_at=(12 if s % 5 == 0 else None),
+                             conc=2 + s % 4)
+            seen_p, rows_p = {}, []
+            seen_c, rows_c = {}, []
+            fk_p = wgl_seg._fast_scan(h, spec, seen_p, rows_p, 10)
+            fk_c = wgl_seg._native_scan(h.ops, spec, seen_c, rows_c, 10)
+            assert (fk_p is None) == (fk_c is None), s
+            assert [tuple(int(x) for x in r) for r in rows_c] == \
+                [tuple(int(x) for x in r) for r in rows_p], s
+            if fk_p is None:
+                continue
+            assert fk_c.n_calls == fk_p.n_calls
+            assert fk_c.max_open == fk_p.max_open
+            rs, counts, cs, cu = fk_c.arrays
+            flat_p = [(slot, s2, u2) for slot, cands in fk_p.rets
+                      for s2, u2 in cands]
+            flat_c = []
+            k = 0
+            for r, (slot, cnt) in enumerate(zip(rs, counts)):
+                assert slot == fk_p.rets[r][0]
+                for j in range(cnt):
+                    flat_c.append((int(slot), int(cs[k]), int(cu[k])))
+                    k += 1
+            assert flat_c == flat_p
+
+    def test_int_subclass_values_encode_by_value(self):
+        # IntEnum-style values must encode by VALUE in both scanners,
+        # exactly like the serial engines' isinstance-based encoder —
+        # encoding them as "unknown" changes verdicts
+        import enum
+
+        class V(enum.IntEnum):
+            A = 1
+            B = 2
+
+        good = History([invoke_op(0, "write", V.A),
+                        ok_op(0, "write", V.A),
+                        invoke_op(1, "read", None),
+                        ok_op(1, "read", 1)]).index()
+        bad = History([invoke_op(0, "write", V.A),
+                       ok_op(0, "write", V.A),
+                       invoke_op(1, "read", None),
+                       ok_op(1, "read", 2)]).index()
+        res = wgl_seg.check_many(models.CASRegister(), [good, bad])
+        assert [r["valid?"] for r in res] == [True, False]
+        assert all(r["engine"] == "wgl_seg_batch" for r in res)
+
+    def test_native_disabled_env(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_NO_NATIVE", "1")
+        from jepsen_tpu import native
+        assert native.histscan() is None
+
     def test_empty_key(self):
         hists = [History([]), rand_history(1, n_ops=20)]
         res = wgl_seg.check_many(models.CASRegister(), hists)
