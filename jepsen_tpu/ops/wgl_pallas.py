@@ -1,0 +1,213 @@
+"""Pallas TPU megakernel for the batched bitmap frontier scan.
+
+The XLA kernel (ops/wgl_seg._build_kernel_bits) runs the L-event scan
+as a lax.scan whose carry round-trips memory and whose per-event
+while_loop dispatches as separate fusions.  This Pallas variant keeps
+the frontier in VMEM **scratch that persists across grid steps**: the
+grid is (L,) — one step per event, the ENTIRE key axis in lanes (the
+event axis is inherently serial, so all parallelism comes from K) —
+and the pipeline streams each event's tables into VMEM while the
+previous event computes.  Scratch is [SN_PAD, K] uint32 (~2 MB at the
+K <= 2^16 cap).
+
+Scope (the multi-key batch hot path, exactly the bench shape): J=1
+start state, R <= 5 open slots (the 2^R mask axis fits ONE uint32
+word), decomposed transitions, Sn <= 8 states.  Everything else takes
+the XLA kernel; verdicts are bit-identical (differential tests).
+
+Host->device transfer stays at the XLA path's narrow-table budget: the
+four per-candidate tables (diag bitmask, const bitmask, const target,
+slot) pack into ONE uint32 word per (event, candidate, key):
+
+    bits 0-7   aux1  (diagonal state bitmask)
+    bits 8-15  aux2  (rank-1 state bitmask)
+    bits 16-19 t0    (rank-1 target state)
+    bits 20-23 slot  (candidate's open slot)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Intra-word "lacks bit b" patterns (wgl_seg._INTRA)
+_INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
+
+SN_PAD = 8        # frontier sublane padding
+KT_MAX = 1 << 16  # beyond this the frontier would stress VMEM
+
+
+def supported(Wd: int, Sn: int, J: int, decomposed: bool,
+              L: int, C: int, K: int) -> bool:
+    return (Wd == 1 and J == 1 and decomposed and Sn <= SN_PAD
+            and C <= SN_PAD and K % 128 == 0 and K <= KT_MAX)
+
+
+def pack_tables(cslot_t: np.ndarray, aux1: np.ndarray,
+                aux2: np.ndarray, t0c: np.ndarray) -> np.ndarray:
+    """[L, K, C] narrow tables -> [L, C, K] uint32 packed words."""
+    w = (aux1.astype(np.uint32)
+         | (aux2.astype(np.uint32) << 8)
+         | ((t0c.astype(np.uint32) & 0xF) << 16)
+         | ((cslot_t.astype(np.uint32) & 0xF) << 20))
+    return np.ascontiguousarray(w.transpose(0, 2, 1))
+
+
+@functools.lru_cache(maxsize=16)
+def build(K: int, L: int, C: int, Sn: int, R: int,
+          interpret: bool = False):
+    """kern(rs_i32 [L, 1, K], packed_u32 [L, C, K]) -> [SN_PAD, K]
+    uint32 with fr & 1 — whether mask-0 survives at each state."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    u32 = jnp.uint32
+    FULL = np.uint32(0xFFFFFFFF)
+    # one grid step per event, the whole key axis in lanes: the L axis
+    # is inherently serial, so all parallelism must come from K
+    KT = K
+
+    def popcount_sum(x):
+        return jax.lax.population_count(x).astype(jnp.int32).sum()
+
+    def sel32(cond):
+        return jnp.where(cond, jnp.asarray(FULL, u32),
+                         jnp.asarray(np.uint32(0), u32))
+
+    def kernel(rs_ref, packed_ref, out_ref, fr_ref):
+        l = pl.program_id(0)
+        s_iota = jax.lax.broadcasted_iota(jnp.int32, (SN_PAD, KT), 0)
+
+        @pl.when(l == 0)
+        def _init():
+            # J=1: only mask 0 (bit 0 of the word) at start state 0
+            fr_ref[:, :] = jnp.where(
+                s_iota == 0, jnp.asarray(np.uint32(1), u32),
+                jnp.asarray(np.uint32(0), u32))
+
+        rs = rs_ref[0, 0, :]                               # [KT] i32
+        packed = packed_ref[0]                             # [C, KT] u32
+        aux1 = packed & np.uint32(0xFF)
+        aux2 = (packed >> 8) & np.uint32(0xFF)
+        ct0 = ((packed >> 16) & np.uint32(0xF)).astype(jnp.int32)
+        cslot = ((packed >> 20) & np.uint32(0xF)).astype(jnp.int32)
+
+        def lacking(fr, b):
+            return fr & np.uint32(_INTRA[b])
+
+        def set_slot(fr, b):
+            return (fr & np.uint32(_INTRA[b])) << (1 << b)
+
+        def retire_slot(fr, b):
+            return (fr & np.uint32(~np.uint32(_INTRA[b]))) >> (1 << b)
+
+        def expand_candidate(fr, c):
+            slot_kc = cslot[c, :]                          # [KT]
+            contrib = jnp.zeros_like(fr)
+            for b in range(R):
+                contrib = contrib | (
+                    lacking(fr, b) & sel32(slot_kc == b)[None, :])
+            # decomposed transition: the diagonal part stays put; the
+            # rank-1 part ORs over source states onto row t0
+            dsel = sel32(((aux1[c, :][None, :].astype(jnp.int32)
+                           >> s_iota) & 1) == 1)
+            moved = contrib & dsel
+            csel = sel32(((aux2[c, :][None, :].astype(jnp.int32)
+                           >> s_iota) & 1) == 1)
+            red = contrib & csel
+            red_or = jnp.zeros((KT,), u32)
+            for s in range(Sn):
+                red_or = red_or | red[s, :]
+            at_t0 = sel32(s_iota == ct0[c, :][None, :])
+            moved = moved | (red_or[None, :] & at_t0)
+            out = jnp.zeros_like(fr)
+            for b in range(R):
+                out = out | (set_slot(moved, b)
+                             & sel32(slot_kc == b)[None, :])
+            return out
+
+        def lack_target(fr):
+            lt = jnp.zeros_like(fr)
+            for b in range(R):
+                lt = lt | (lacking(fr, b) & sel32(rs == b)[None, :])
+            return lt & sel32(rs >= 0)[None, :]
+
+        def round_(carry):
+            fr, _, prev = carry
+            add = jnp.zeros_like(fr)
+            for c in range(C):
+                add = add | expand_candidate(fr, c)
+            fr2 = fr | add
+            cnt = popcount_sum(fr2)
+            return (fr2,
+                    (cnt > prev) & (popcount_sum(lack_target(fr2)) > 0),
+                    cnt)
+
+        fr = fr_ref[:, :]
+        fr, _, _ = jax.lax.while_loop(
+            lambda cy: cy[1], round_,
+            (fr, popcount_sum(lack_target(fr)) > 0, jnp.int32(-1)))
+
+        cleared = jnp.zeros_like(fr)
+        for b in range(R):
+            cleared = cleared | (retire_slot(fr, b)
+                                 & sel32(rs == b)[None, :])
+        fr = jnp.where((rs >= 0)[None, :], cleared, fr)
+        fr_ref[:, :] = fr
+
+        @pl.when(l == L - 1)
+        def _finish():
+            out_ref[:, :] = fr_ref[:, :] & np.uint32(1)
+
+    def kern(rs_i32, packed_u32):
+        import jax
+
+        return pl.pallas_call(
+            kernel,
+            grid=(L,),
+            in_specs=[
+                # sublane dims must divide 8 or equal the array dim —
+                # hence the size-1 middle axis on rs
+                pl.BlockSpec((1, 1, KT), lambda l: (l, 0, 0)),
+                pl.BlockSpec((1, C, KT), lambda l: (l, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((SN_PAD, KT), lambda l: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((SN_PAD, K), np.uint32),
+            scratch_shapes=[pltpu.VMEM((SN_PAD, KT), np.uint32)],
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(rs_i32, packed_u32)
+
+    import jax
+    return jax.jit(kern)
+
+
+def run_packed(ret_t: np.ndarray, packed: np.ndarray, K: int, L: int,
+               C: int, Sn: int, R: int):
+    """Run on pre-packed tables (see pack_tables); returns [K, 1, Sn]
+    bool like the XLA kernel's thresholded output.  The interpreter is
+    used ONLY on CPU (the test backend) — on any other non-TPU backend
+    (e.g. GPU) this raises so callers fall back to the fast XLA
+    kernel instead of silently interpreting."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend not in ("tpu", "cpu"):
+        raise RuntimeError(f"no pallas lowering for {backend}")
+    kern = build(K, L, C, Sn, R, interpret=(backend == "cpu"))
+    rs = np.ascontiguousarray(ret_t.astype(np.int32)[:, None, :])
+    out = np.asarray(kern(rs, packed))                # [SN_PAD, K]
+    return (out.T[:, None, :Sn] > 0)                  # [K, 1, Sn]
+
+
+def run(ret_t: np.ndarray, cslot_t: np.ndarray, aux1: np.ndarray,
+        aux2: np.ndarray, t0c: np.ndarray, K: int, L: int, C: int,
+        Sn: int, R: int):
+    """Adapt the XLA bits-kernel argument layout ([L, K] + [L, K, C])
+    to the packed Pallas layout and run."""
+    return run_packed(ret_t, pack_tables(cslot_t, aux1, aux2, t0c),
+                      K, L, C, Sn, R)
