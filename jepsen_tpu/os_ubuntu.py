@@ -1,0 +1,17 @@
+"""Ubuntu provisioning (reference: `jepsen/src/jepsen/os/ubuntu.clj`,
+registered alongside debian in the cockroach runner's OS registry,
+`cockroachdb/src/jepsen/cockroach/runner.clj:36-40`): apt-based like
+debian with Ubuntu's package set differences."""
+
+from __future__ import annotations
+
+from jepsen_tpu import os_debian
+from jepsen_tpu.os import setup_hostfile  # noqa: F401
+
+
+class Ubuntu(os_debian.Debian):
+    """ubuntu.clj os — the debian flow over Ubuntu images (same apt
+    machinery; Ubuntu ships ntpdate/faketime from universe)."""
+
+
+os = Ubuntu()

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import logging
+import contextlib
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -156,3 +157,31 @@ def majority(n: int) -> int:
 def chunk_vec(n: int, xs: list) -> list[list]:
     """Partition xs into chunks of size n (util.clj:117-126)."""
     return [xs[i:i + n] for i in range(0, len(xs), n)]
+
+
+class NamedLocks:
+    """A keyed lock table: `with locks.hold(key):` serializes on a lock
+    unique to that key (util.clj named-locks :729-768 — the reference
+    uses them to guard per-resource critical sections without one
+    global lock)."""
+
+    def __init__(self):
+        self._guard = threading.Lock()
+        self._locks: dict = {}
+
+    def get(self, key) -> threading.RLock:
+        with self._guard:
+            lock = self._locks.get(key)
+            if lock is None:
+                lock = self._locks[key] = threading.RLock()
+            return lock
+
+    @contextlib.contextmanager
+    def hold(self, key):
+        lock = self.get(key)
+        with lock:
+            yield lock
+
+
+def named_locks() -> NamedLocks:
+    return NamedLocks()

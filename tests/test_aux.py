@@ -292,3 +292,47 @@ class TestWorkloadRegistry:
 # info op used implicitly by queue drain bookkeeping elsewhere; keep the
 # import exercised so fixture histories can extend later.
 _ = info_op
+
+
+def test_named_locks():
+    """util.clj named-locks :729-768: one lock per key, reentrant."""
+    from jepsen_tpu import util
+
+    nl = util.named_locks()
+    assert nl.get("a") is nl.get("a")
+    assert nl.get("a") is not nl.get("b")
+    with nl.hold("a"):
+        with nl.hold("a"):      # RLock: reentrant within a thread
+            pass
+    # contention: a second thread blocks until release
+    import threading
+    import time as time_mod
+    order = []
+
+    def worker():
+        with nl.hold("a"):
+            order.append("t2")
+
+    with nl.hold("a"):
+        t = threading.Thread(target=worker)
+        t.start()
+        time_mod.sleep(0.05)
+        order.append("t1")
+    t.join(2)
+    assert order == ["t1", "t2"]
+
+
+def test_ubuntu_os_provisions_like_debian():
+    """ubuntu.clj = the debian flow (cockroach runner.clj:36-40)."""
+    from jepsen_tpu import control, os_ubuntu
+
+    cmds = []
+    control.set_dummy_handler(lambda n, c, s: cmds.append((n, c)) or "")
+    try:
+        with control.with_ssh({"dummy": True}):
+            with control.with_session("n1", control.session("n1")):
+                os_ubuntu.os.setup({"nodes": ["n1"]}, "n1")
+    finally:
+        control.set_dummy_handler(None)
+    assert any("apt-get" in c for _, c in cmds)
+    assert any("hosts" in c for _, c in cmds)
