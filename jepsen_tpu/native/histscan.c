@@ -156,7 +156,7 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
     PyObject *result = NULL;         /* set to None for fallback */
     PyObject *new_seen = NULL, *new_rows = NULL;
     vec ret_slots = {0}, cand_counts = {0}, cand_slots = {0},
-        cand_uops = {0};
+        cand_uops = {0}, cut_flags = {0};
     long *slot_of = NULL, *uop_of = NULL, *open_procs = NULL;
     if (!open_by_proc) goto fail;
 
@@ -288,6 +288,8 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
                 uop_of[j] = uop_of[j + 1];
             }
             n_open--;
+            if (vec_push(&cut_flags, n_open == 0 ? 1 : 0) < 0)
+                goto fail;
         }
         /* t==2/3 completions: nothing to do (handled via fate) */
     }
@@ -302,11 +304,12 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
         }
     }
     result = Py_BuildValue(
-        "(lly#y#y#y#)", n_calls, max_open,
+        "(lly#y#y#y#y#)", n_calls, max_open,
         (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),
         (char *)cand_counts.data, cand_counts.len * sizeof(int32_t),
         (char *)cand_slots.data, cand_slots.len * sizeof(int32_t),
-        (char *)cand_uops.data, cand_uops.len * sizeof(int32_t));
+        (char *)cand_uops.data, cand_uops.len * sizeof(int32_t),
+        (char *)cut_flags.data, cut_flags.len * sizeof(int32_t));
     goto done;
 
 fallback:
@@ -329,6 +332,7 @@ done:
     PyMem_Free(cand_counts.data);
     PyMem_Free(cand_slots.data);
     PyMem_Free(cand_uops.data);
+    PyMem_Free(cut_flags.data);
     return result;
 }
 

@@ -303,15 +303,18 @@ class _FastKey:
     """One batchable key, produced by a single fused host pass:
     rets[r] = (slot, [(open_slot, open_uop), ...]) per return event —
     or, from the native scanner, the same data as flat int32 arrays
-    (ret_slots, cand_counts, cand_slots, cand_uops)."""
+    (ret_slots, cand_counts, cand_slots, cand_uops).  `cuts[r]` marks
+    returns after which the key is QUIESCENT (zero open calls) — the
+    segmentation points the batch engine parallelizes across."""
 
-    __slots__ = ("rets", "max_open", "n_calls", "arrays")
+    __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts")
 
-    def __init__(self, rets, max_open, n_calls, arrays=None):
+    def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None):
         self.rets = rets
         self.max_open = max_open
         self.n_calls = n_calls
         self.arrays = arrays
+        self.cuts = cuts
 
     @property
     def n_rets(self):
@@ -331,13 +334,14 @@ def _native_scan(ops: list, spec, seen: dict, rows: list,
     out = mod.fast_scan(ops, spec.f_codes, seen, rows, max_open_bits)
     if out is None:
         return None
-    n_calls, max_open, rs, counts, cs, cu = out
+    n_calls, max_open, rs, counts, cs, cu, cuts = out
     # Py_BuildValue turns a NULL pointer (empty vec) into None
     return _FastKey(None, max_open, n_calls,
                     arrays=(np.frombuffer(rs or b"", np.int32),
                             np.frombuffer(counts or b"", np.int32),
                             np.frombuffer(cs or b"", np.int32),
-                            np.frombuffer(cu or b"", np.int32)))
+                            np.frombuffer(cu or b"", np.int32)),
+                    cuts=np.frombuffer(cuts or b"", np.int32))
 
 
 def _fast_scan(history, spec, seen: dict, rows: list,
@@ -390,6 +394,7 @@ def _fast_scan(history, spec, seen: dict, rows: list,
     uop_of: dict = {}
     open_list: list = []
     rets: list = []
+    cuts: list = []
     max_open = 0
     n_calls = 0
     INT32 = 2 ** 31
@@ -452,10 +457,12 @@ def _fast_scan(history, spec, seen: dict, rows: list,
             del slot_of[p]
             del uop_of[p]
             free.append(s)
+            cuts.append(1 if not open_list else 0)
 
     seen.update(new_seen)
     rows.extend(new_rows)
-    return _FastKey(rets, max_open, n_calls)
+    return _FastKey(rets, max_open, n_calls,
+                    cuts=np.asarray(cuts, np.int32))
 
 
 def _assign_slots(events):
@@ -1010,6 +1017,167 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     return result
 
 
+def _fk_arrays(fk: "_FastKey"):
+    """Flat (ret_slots, cand_counts, cand_slots, cand_uops) arrays for
+    either scanner form."""
+    if fk.arrays is not None:
+        return fk.arrays
+    rs = np.fromiter((r[0] for r in fk.rets), np.int32,
+                     count=len(fk.rets))
+    counts = np.fromiter((len(r[1]) for r in fk.rets), np.int32,
+                         count=len(fk.rets))
+    cs = np.fromiter((s for _, cands in fk.rets for s, _ in cands),
+                     np.int32)
+    cu = np.fromiter((u for _, cands in fk.rets for _, u in cands),
+                     np.int32)
+    return rs, counts, cs, cu
+
+
+def _run_segmented(batch, legal, next_state, diag_w, const_w, const_t0,
+                   Sn: int, R: int, M: int, C: int):
+    """The segmented batch engine: each key's event stream is cut at
+    its quiescent points (the single-history engine's trick, applied
+    across the whole batch), segments become kernel lanes bucketed by
+    length, each lane yields a [Sn, Sn] transfer matrix (J=Sn), and
+    per-key verdicts come from composing each key's chain on host.
+
+    Serial depth per kernel drops from max-returns-per-KEY (~hundreds)
+    to the bucket's returns-per-SEGMENT (~8-32), with lanes multiplying
+    accordingly — the same wall-clock trade the module docstring
+    describes for one history, at batch scale.
+
+    Returns (ok_by_batch_index bool[Kk], t_kernel_s)."""
+    Kk = len(batch)
+
+    # --- flatten all keys' returns with global segment ids ------------
+    rs_parts, cnt_parts, cs_parts, cu_parts = [], [], [], []
+    seg_of_ret_parts, rank_parts = [], []
+    seg_sizes_parts = []
+    seg_base = 0
+    key_nseg = np.zeros(Kk, np.int64)
+    for bi, (_, fk) in enumerate(batch):
+        rs, counts, cs, cu = _fk_arrays(fk)
+        nr = len(rs)
+        cuts = np.asarray(fk.cuts, np.int32)
+        # a crash-free complete history always ends quiescent, but be
+        # safe: treat a non-quiescent tail as a final segment
+        if nr and (len(cuts) != nr or cuts[-1] != 1):
+            cuts = np.copy(cuts) if len(cuts) == nr else \
+                np.zeros(nr, np.int32)
+            cuts[-1] = 1
+        seg_end = np.nonzero(cuts)[0]                    # inclusive
+        sizes = np.diff(np.concatenate([[-1], seg_end]))
+        nseg = len(seg_end)
+        starts = np.concatenate([[0], seg_end[:-1] + 1])
+        seg_of_ret = np.repeat(np.arange(nseg), sizes) + seg_base
+        rank = np.arange(nr) - np.repeat(starts, sizes)
+        rs_parts.append(rs)
+        cnt_parts.append(counts)
+        cs_parts.append(cs)
+        cu_parts.append(cu)
+        seg_of_ret_parts.append(seg_of_ret)
+        rank_parts.append(rank)
+        seg_sizes_parts.append(sizes)
+        key_nseg[bi] = nseg
+        seg_base += nseg
+
+    rs_all = np.concatenate(rs_parts)
+    cnt_all = np.concatenate(cnt_parts)
+    cs_all = np.concatenate(cs_parts)
+    cu_all = np.concatenate(cu_parts)
+    seg_of_ret = np.concatenate(seg_of_ret_parts)
+    rank_all = np.concatenate(rank_parts)
+    seg_sizes = np.concatenate(seg_sizes_parts)
+    n_seg = seg_base
+
+    # candidate rows -> their return's segment/rank
+    ends = np.cumsum(cnt_all)
+    ret_of_cand = np.repeat(np.arange(len(rs_all)), cnt_all)
+    j_of_cand = np.arange(ends[-1] if len(ends) else 0) - \
+        np.repeat(ends - cnt_all, cnt_all)
+
+    # --- bucket segments by size (pow2 floors at 8) --------------------
+    Lb_of_seg = np.maximum(
+        8, 1 << np.ceil(np.log2(np.maximum(seg_sizes, 1))).astype(int))
+    t_kernel = 0.0
+    S_max = int(key_nseg.max()) if Kk else 0
+    # Ragged storage: one [Sn, Sn] matrix per segment — memory bounded
+    # by TOTAL segments, not Kk x the single deepest key.  Segments
+    # were appended key-by-key in order, so key bi's s-th segment lives
+    # at key_off[bi] + s.
+    T_all = np.empty((n_seg, Sn, Sn), bool)
+    key_off = np.concatenate([[0], np.cumsum(key_nseg)[:-1]])
+
+    for Lb in sorted(set(Lb_of_seg.tolist())):
+        in_b = Lb_of_seg == Lb
+        seg_ids = np.nonzero(in_b)[0]
+        lanes = len(seg_ids)
+        lane_of_seg = np.full(n_seg, -1, np.int64)
+        lane_of_seg[seg_ids] = np.arange(lanes)
+        # round lanes up through power-of-two tiers to bound the set of
+        # compiled kernel shapes
+        Kp = max(128, _next_pow2(lanes))
+
+        ret_in = in_b[seg_of_ret]
+        ret_slot = np.full((Kp, Lb), -1, np.int32)
+        ret_slot[lane_of_seg[seg_of_ret[ret_in]],
+                 rank_all[ret_in]] = rs_all[ret_in]
+        cand_slot = np.zeros((Kp, Lb, C), np.int32)
+        cand_uop = np.full((Kp, Lb, C), -1, np.int32)
+        if len(cu_all):
+            cand_in = ret_in[ret_of_cand]
+            seg_c = seg_of_ret[ret_of_cand[cand_in]]
+            cand_slot[lane_of_seg[seg_c],
+                      rank_all[ret_of_cand[cand_in]],
+                      j_of_cand[cand_in]] = cs_all[cand_in]
+            cand_uop[lane_of_seg[seg_c],
+                     rank_all[ret_of_cand[cand_in]],
+                     j_of_cand[cand_in]] = cu_all[cand_in]
+
+        ret_t = np.ascontiguousarray(ret_slot.T)
+        cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
+        cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
+        kern, args, _ = _dispatch_kernel(
+            Kp, int(Lb), int(C), int(M), int(Sn), int(R), int(Sn),
+            ret_t, cslot_t, cuop_t, legal, next_state,
+            diag_w, const_w, const_t0)
+        t1 = time.monotonic()
+        T = np.asarray(kern(*args)) > 0.5              # [Kp, Sn, Sn]
+        t_kernel += time.monotonic() - t1
+        T_all[seg_ids] = T[:lanes]
+
+    # --- compose each key's chain (entry state = enumeration index 0) -
+    v = np.zeros((Kk, Sn), bool)
+    v[:, 0] = True
+    for s in range(S_max):
+        act = np.nonzero(key_nseg > s)[0]
+        Ts = T_all[key_off[act] + s]                   # [A, Sn, Sn]
+        v[act] = (v[act][:, :, None] & Ts).any(axis=1)
+    return v.any(axis=1), t_kernel
+
+
+def _emit_batch_result(results, i, fk, ok: bool, backend_name: str,
+                       engine: str, t_kernel: float, model,
+                       histories, localize: bool) -> None:
+    """Per-key result dict + invalid-key localization via the CPU
+    oracle — shared by the segmented and single-lane batch paths."""
+    results[i] = {
+        "valid?": ok,
+        "op_count": fk.n_calls,
+        "backend": backend_name,
+        "engine": engine,
+        "time_kernel_s": t_kernel,
+    }
+    if not ok:
+        results[i]["anomaly"] = "nonlinearizable"
+        if localize and not isinstance(histories[i], PreparedHistory):
+            from jepsen_tpu.ops import wgl_cpu
+            oracle = wgl_cpu.check(model, histories[i])
+            for key in ("op", "op_index", "final_paths"):
+                if key in oracle:
+                    results[i][key] = oracle[key]
+
+
 # ---------------------------------------------------------------------------
 # Multi-key batch mode (jepsen.independent on device)
 # ---------------------------------------------------------------------------
@@ -1084,6 +1252,30 @@ def check_many(model, histories, *, max_states: int = 64,
         M = 1 << R
         L = _next_pow2(max(fk.n_rets for _, fk in batch))
         C = _next_pow2(R)
+
+        # Opt-in segmented engine (JEPSEN_TPU_SEGMENT=1): cutting at
+        # quiescent points turns returns-per-key serial depth into
+        # returns-per-segment.  Measured on a v5e-1 it LOSES to the
+        # single-lane layout at both bench shapes — 300-op keys
+        # (2.0s vs 0.83s kernel) and 3000-op keys (1.6s vs 0.96s) —
+        # because the J=Sn entry-state axis multiplies total work ~Sn x
+        # while XLA keeps per-step cost low even at depth 4096.  Kept
+        # verdict-identical (differential tests) as the scaling path
+        # for workloads whose per-key depth actually binds.
+        if (mesh is None
+                and os.environ.get("JEPSEN_TPU_SEGMENT") == "1"):
+            diag_w, const_w, const_t0 = _decompose(legal, next_state)
+            ok_b, t_kernel = _run_segmented(
+                batch, legal, next_state, diag_w, const_w, const_t0,
+                int(Sn), int(R), int(M), int(C))
+            for bi, (i, fk) in enumerate(batch):
+                _emit_batch_result(results, i, fk, bool(ok_b[bi]),
+                                   backend_name, "wgl_seg_batch",
+                                   t_kernel, model, histories,
+                                   localize)
+            batch = []
+
+    if batch:
         # Pad the key axis for lane alignment (and even mesh sharding).
         Kk = len(batch)
         mult = 128
@@ -1173,22 +1365,9 @@ def check_many(model, histories, *, max_states: int = 64,
             t_kernel = time.monotonic() - t1
         ok_k = (T[:, 0, :] > 0.5).any(axis=1)
         for kk, (i, fk) in enumerate(batch):
-            results[i] = {
-                "valid?": bool(ok_k[kk]),
-                "op_count": fk.n_calls,
-                "backend": backend_name,
-                "engine": engine_name,
-                "time_kernel_s": t_kernel,
-            }
-            if not ok_k[kk]:
-                results[i]["anomaly"] = "nonlinearizable"
-                if localize and not isinstance(histories[i],
-                                               PreparedHistory):
-                    from jepsen_tpu.ops import wgl_cpu
-                    oracle = wgl_cpu.check(model, histories[i])
-                    for key in ("op", "op_index", "final_paths"):
-                        if key in oracle:
-                            results[i][key] = oracle[key]
+            _emit_batch_result(results, i, fk, bool(ok_k[kk]),
+                               backend_name, engine_name, t_kernel,
+                               model, histories, localize)
 
     if fall:
         if fallback is None:

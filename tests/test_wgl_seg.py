@@ -225,6 +225,8 @@ class TestBatch:
                 continue
             assert fk_c.n_calls == fk_p.n_calls
             assert fk_c.max_open == fk_p.max_open
+            assert np.array_equal(np.asarray(fk_c.cuts),
+                                  np.asarray(fk_p.cuts))
             rs, counts, cs, cu = fk_c.arrays
             flat_p = [(slot, s2, u2) for slot, cands in fk_p.rets
                       for s2, u2 in cands]
@@ -258,6 +260,29 @@ class TestBatch:
         res = wgl_seg.check_many(models.CASRegister(), [good, bad])
         assert [r["valid?"] for r in res] == [True, False]
         assert all(r["engine"].startswith("wgl_seg_batch") for r in res)
+
+    def test_segmented_engine_matches_oracle(self, monkeypatch):
+        # force the segmented (quiescent-cut) batch engine and check
+        # verdict parity on a mix of valid/buggy keys
+        monkeypatch.setenv("JEPSEN_TPU_SEGMENT", "1")
+        hists = [rand_history(900 + s, n_ops=60, conc=3,
+                              buggy=(s % 4 == 1)) for s in range(24)]
+        res = wgl_seg.check_many(models.CASRegister(), hists)
+        for h, r in zip(hists, res):
+            assert r["valid?"] == wgl_cpu.check(
+                models.CASRegister(), h)["valid?"]
+
+    def test_segmented_engine_long_keys(self, monkeypatch):
+        # long keys through the segmented engine; verdicts still match
+        monkeypatch.setenv("JEPSEN_TPU_SEGMENT", "1")
+        hists = [rand_history(40 + s, n_ops=1400, conc=3)
+                 for s in range(3)]
+        bad = History(list(hists[1])
+                      + [invoke_op(9, "read", None),
+                         ok_op(9, "read", 77)]).index()
+        hists[1] = bad
+        res = wgl_seg.check_many(models.CASRegister(), hists)
+        assert [r["valid?"] for r in res] == [True, False, True]
 
     def test_pallas_and_xla_kernels_agree(self, monkeypatch):
         # same batch through both device kernels -> identical verdicts
