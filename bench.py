@@ -24,8 +24,11 @@ value       = steady-state device throughput over all keys: best of
               driver re-runs skip it)
 vs_baseline = device throughput / CPU-oracle throughput.
 
-A secondary line on stderr reports BASELINE config 2 (one 100k-op
-single-register history) via the segment-parallel transfer-matrix path.
+Secondary stderr lines report BASELINE config 2 (one 100k-op
+single-register history via the segment-parallel transfer-matrix
+path), config 4 (SCC cycle detection as bool-matmul reachability), and
+config 5 (1M-element commutative set folds) — each verified against a
+known-correct structure before the headline prints.
 """
 
 import json
@@ -124,6 +127,52 @@ def main() -> int:
         return 1
     rate = n_ops / kernel_s
 
+    # --- Secondary: config 4 (cycle detection as bool-matmul SCC) and
+    # config 5 (commutative folds), verified + measured before the
+    # headline prints so a regression fails the bench loudly ------------
+    import numpy as np
+    from jepsen_tpu.ops import cycle as cycle_ops
+    from jepsen_tpu.ops import fold as fold_ops
+
+    n = 2048
+    rng = random.Random(11)
+    adj = np.zeros((n, n), bool)
+    for _ in range(6 * n):                 # sparse random digraph...
+        adj[rng.randrange(n), rng.randrange(n)] = True
+    ring = np.arange(100)                  # ...with a known 100-cycle
+    adj[ring, (ring + 1) % 100] = True
+    cyc_s = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        labels, on_cycle, _ = cycle_ops.scc(adj)
+        cyc_s = min(cyc_s, time.monotonic() - t0)
+    if not (on_cycle[:100].all() and len(set(labels[:100])) == 1):
+        print(json.dumps({"metric": "ERROR: SCC kernel missed the "
+                          "embedded 100-cycle", "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return 1
+    print(f"# cycle/SCC: {n}-node dependency graph in {cyc_s:.3f}s "
+          f"({int(on_cycle.sum())} nodes on cycles)", file=sys.stderr)
+
+    adds = np.arange(1_000_000, dtype=np.int64)
+    final = adds[adds % 97 != 0]           # ~1% lost elements
+    fold_s = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        masks = fold_ops.set_masks(adds, adds, final)
+        fold_s = min(fold_s, time.monotonic() - t0)
+    n_lost = int(np.asarray(masks[2], bool).sum())
+    want_lost = (len(adds) - 1) // 97 + 1  # multiples of 97 in range
+    if n_lost != want_lost:
+        print(json.dumps({"metric": "ERROR: set fold counted "
+                          f"{n_lost} lost (expected {want_lost})",
+                          "value": 0, "unit": "ops/sec",
+                          "vs_baseline": 0}))
+        return 1
+    print(f"# folds: 1M-element set accounting in {fold_s:.3f}s "
+          f"({1_000_000 / fold_s / 1e6:.1f}M elems/s, {n_lost} lost "
+          "detected)", file=sys.stderr)
+
     # --- Secondary: config 2, one long history (measured before the
     # headline prints so a bad verdict fails the bench loudly) ----------
     single = make_history(SINGLE_N_OPS, CONCURRENCY, vmax=9)
@@ -157,6 +206,7 @@ def main() -> int:
           f"steady-state ({n1 / r1['time_kernel_s']:.0f} ops/s; "
           f"{r1['segments']} segments, valid={r1['valid?']})",
           file=sys.stderr)
+
     return 0
 
 

@@ -95,6 +95,10 @@ def _kernels():
 
 
 def _i64(xs) -> np.ndarray:
+    if isinstance(xs, np.ndarray):
+        # no list() round-trip: boxing 1M elements costs more than the
+        # kernel itself
+        return xs.astype(np.int64, copy=False).reshape(-1)
     return np.asarray(list(xs), np.int64).reshape(-1)
 
 
