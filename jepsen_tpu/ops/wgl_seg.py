@@ -930,18 +930,41 @@ def _dispatch_kernel(K, L, C, M, Sn, R, J, ret_t, cslot_t, cuop_t,
                   const_t0 if decomposed else dummy1], 3
 
 
+def _shard_args(mesh, mesh_axis: str, args: list, n_sharded: int):
+    """Shard _dispatch_kernel's argument list over the mesh: args[0] is
+    [L, K], args[1:n_sharded] are [L, K, C] (K = lane axis), the rest
+    replicated tables.  One definition so the layout contract cannot
+    diverge between check() and check_many()."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shard_k = NamedSharding(mesh, P(None, mesh_axis))
+    shard_kc = NamedSharding(mesh, P(None, mesh_axis, None))
+    repl = NamedSharding(mesh, P())
+    shardings = ([shard_k] + [shard_kc] * (n_sharded - 1)
+                 + [repl] * (len(args) - n_sharded))
+    return [jax.device_put(a, sh) for a, sh in zip(args, shardings)]
+
+
 # ---------------------------------------------------------------------------
 # Public API
 # ---------------------------------------------------------------------------
 
 def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
           target_returns_per_segment: int = 512,
-          localize: bool = True) -> dict[str, Any]:
+          localize: bool = True, mesh=None,
+          mesh_axis: Optional[str] = None) -> dict[str, Any]:
     """Segment-parallel linearizability check.  Returns a knossos-shaped
     analysis map (same keys as ops.wgl.check).  Raises Unsupported when
     the history/model falls outside this engine's scope (crashed calls,
     large state spaces, deep concurrency) — callers fall back to
-    ops.wgl.check / ops.wgl_cpu.check."""
+    ops.wgl.check / ops.wgl_cpu.check.
+
+    With `mesh`/`mesh_axis`, ONE history's segment axis is sharded over
+    the devices (SURVEY.md §5 long-context: "sharding the DFS/BFS
+    frontier of a single long history across devices") — every device
+    computes transfer matrices for its slice of the segments, and only
+    the [K, Sn, Sn] matrices come back for the host composition."""
     import jax
 
     spec = model.device_spec()
@@ -964,15 +987,36 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     M = 1 << pl.max_open
     t_plan = time.monotonic() - t0
 
-    ret_t = np.ascontiguousarray(pl.ret_slot.T)              # [L, K]
-    cslot_t = np.ascontiguousarray(pl.cand_slot.transpose(1, 0, 2))
-    cuop_t = np.ascontiguousarray(pl.cand_uop.transpose(1, 0, 2))
+    ret_slot, cand_slot, cand_uop = pl.ret_slot, pl.cand_slot, pl.cand_uop
+    sharded = False
+    if mesh is not None and mesh_axis is not None:
+        # pad the segment axis up to a mesh-size multiple — the plan
+        # does NOT guarantee divisibility, and all-padding segments
+        # (ret -1, no candidates) are identity transfer matrices
+        m = int(mesh.shape[mesh_axis])
+        Kp = ((K + m - 1) // m) * m
+        if Kp != K:
+            ret_slot = np.concatenate(
+                [ret_slot, np.full((Kp - K, L), -1, np.int32)])
+            cand_slot = np.concatenate(
+                [cand_slot, np.zeros((Kp - K, L, C), np.int32)])
+            cand_uop = np.concatenate(
+                [cand_uop, np.full((Kp - K, L, C), -1, np.int32)])
+        K_run = Kp
+        sharded = True
+    else:
+        K_run = K
+    ret_t = np.ascontiguousarray(ret_slot.T)                 # [L, K]
+    cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
+    cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
     t1 = time.monotonic()
-    kern, args, _ = _dispatch_kernel(
-        K, int(L), int(C), int(M), int(Sn), int(pl.max_open), int(Sn),
-        ret_t, cslot_t, cuop_t, pl.legal, pl.next_state,
+    kern, args, n_sharded = _dispatch_kernel(
+        K_run, int(L), int(C), int(M), int(Sn), int(pl.max_open),
+        int(Sn), ret_t, cslot_t, cuop_t, pl.legal, pl.next_state,
         pl.diag_w, pl.const_w, pl.const_t0)
-    T = np.asarray(kern(*args)) > 0.5                        # [K, Sn, Sn]
+    if sharded:
+        args = _shard_args(mesh, mesh_axis, args, n_sharded)
+    T = np.asarray(kern(*args))[:K] > 0.5                    # [K, Sn, Sn]
     t_kernel = time.monotonic() - t1
 
     # Compose transfer matrices left-to-right on host (K tiny matvecs).
@@ -992,6 +1036,7 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
         "engine": "wgl_seg",
         "segments": K,
         "states": Sn,
+        "sharded": sharded,
         "time_plan_s": t_plan,
         "time_kernel_s": t_kernel,
     }
@@ -1350,15 +1395,7 @@ def check_many(model, histories, *, max_states: int = 64,
                 ret_t, cslot_t, cuop_t, legal, next_state,
                 diag_w, const_w, const_t0)
             if mesh is not None and mesh_axis is not None:
-                from jax.sharding import NamedSharding, \
-                    PartitionSpec as P
-                shard_k = NamedSharding(mesh, P(None, mesh_axis))
-                shard_kc = NamedSharding(mesh, P(None, mesh_axis, None))
-                repl = NamedSharding(mesh, P())
-                shardings = ([shard_k] + [shard_kc] * (kc_shaped - 1)
-                             + [repl] * (len(args) - kc_shaped))
-                args = [jax.device_put(a, s)
-                        for a, s in zip(args, shardings)]
+                args = _shard_args(mesh, mesh_axis, args, kc_shaped)
 
             t1 = time.monotonic()
             T = np.asarray(kern(*args))                  # [Kp, 1, Sn]

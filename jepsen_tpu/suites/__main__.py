@@ -1,0 +1,29 @@
+"""Top-level suite dispatcher: `python -m jepsen_tpu.suites <suite>
+[test|analyze|serve] ...` — the one-command equivalent of the
+reference's per-suite `lein run` entry points."""
+
+from __future__ import annotations
+
+import sys
+
+from jepsen_tpu.suites import SUITES, main_for
+
+
+def main(argv=None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m jepsen_tpu.suites <suite> "
+              "[test|analyze|serve] [options]\n\nsuites: "
+              + ", ".join(sorted(SUITES)), file=sys.stderr)
+        sys.exit(0 if argv else 255)
+    name, rest = argv[0], argv[1:]
+    try:
+        entry = main_for(name)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        sys.exit(255)
+    entry(rest)
+
+
+if __name__ == "__main__":
+    main()

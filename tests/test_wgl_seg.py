@@ -261,6 +261,31 @@ class TestBatch:
         assert [r["valid?"] for r in res] == [True, False]
         assert all(r["engine"].startswith("wgl_seg_batch") for r in res)
 
+    def test_single_history_mesh_sharded(self):
+        # ONE history's segment axis sharded over the 8-device mesh
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()), ("segs",))
+        h = rand_history(31, n_ops=400, conc=3)
+        r = wgl_seg.check(models.CASRegister(), h, mesh=mesh,
+                          mesh_axis="segs",
+                          target_returns_per_segment=4)
+        assert r["valid?"] is True
+        assert r["segments"] >= 8
+        assert r["sharded"] is True
+        bad = History(list(h) + [invoke_op(9, "read", None),
+                                 ok_op(9, "read", 77)]).index()
+        r = wgl_seg.check(models.CASRegister(), bad, mesh=mesh,
+                          mesh_axis="segs",
+                          target_returns_per_segment=4)
+        assert r["valid?"] is False
+        assert r["sharded"] is True
+        assert r.get("op_index") is not None
+        # and without a mesh the flag reads False
+        r = wgl_seg.check(models.CASRegister(), h)
+        assert r["sharded"] is False
+
     def test_segmented_engine_matches_oracle(self, monkeypatch):
         # force the segmented (quiescent-cut) batch engine and check
         # verdict parity on a mix of valid/buggy keys
