@@ -18,24 +18,63 @@ checker over an unordered-queue model (rabbitmq.clj uses both).
 
 from __future__ import annotations
 
+import threading
+
 from jepsen_tpu import checker as ck
 from jepsen_tpu import generator as gen
 from jepsen_tpu import models
+
+
+class _CountingSource(gen.Generator):
+    """Pass-through that counts the enqueues it emits."""
+
+    def __init__(self, source):
+        self.source = source
+        self.enqueues = 0
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        o = gen.op(self.source, test, process)
+        if o is not None and gen._op_get(o, "f") == "enqueue":
+            with self.lock:
+                self.enqueues += 1
+        return o
+
+
+class _Drain(gen.Generator):
+    """One dequeue per counted enqueue."""
+
+    def __init__(self, counting: _CountingSource):
+        self.counting = counting
+        self.taken = 0
+        self.lock = threading.Lock()
+
+    def op(self, test, process):
+        with self.lock:
+            if self.taken >= self.counting.enqueues:
+                return None
+            self.taken += 1
+        return {"type": "invoke", "f": "dequeue", "value": None}
 
 
 def generator(time_limit=None, ops=5000):
     """Random enqueue/dequeue, then a drain phase covering every
     attempted enqueue (rabbitmq.clj:180-210).
 
-    The time/op bound must live INSIDE drain_queue: wrapping the whole
-    thing in an outer `gen.time_limit` would cut off the drain dequeues
-    and make total-queue report healthy elements as lost.  So the
-    source is always bounded here (by `ops`, and by `time_limit` when
-    given) and drain_queue runs to completion after it."""
+    Two subtleties:
+    - the time/op bound lives on the SOURCE only — an outer
+      gen.time_limit would cut off the drain dequeues and make
+      total-queue report healthy elements as lost;
+    - the drain is BARRIER-separated from the source: without the
+      synchronize, drain dequeues race ahead of still-in-flight
+      enqueues on other workers, burn their attempts on an empty
+      queue, and the late-landing element is reported lost (seen
+      ~1/400 runs under load)."""
     src = gen.limit(ops, gen.queue_gen())
     if time_limit:
         src = gen.time_limit(time_limit, src)
-    return gen.drain_queue(src)
+    counting = _CountingSource(src)
+    return gen.concat(counting, gen.synchronize(_Drain(counting)))
 
 
 def workload(opts=None) -> dict:

@@ -273,3 +273,37 @@ class TestLinearizableRegister:
         assert result["results"]["valid?"] is True
         assert result["results"]["linearizable"]["valid?"] is True
         assert len(result["results"]["linearizable"]["results"]) >= 2
+
+
+def test_queue_drain_covers_every_enqueue():
+    """The counted drain must emit exactly one dequeue per enqueue the
+    source produced, and only after the source phase ends — the
+    one-dequeue-per-enqueue invariant the total-queue accounting
+    depends on."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads import queue as queue_wl
+
+    g = queue_wl.generator(ops=60)
+    test = {"nodes": []}
+    with gen.with_threads([0]):          # single thread: no barrier wait
+        ops, enq, deq = [], 0, 0
+        while True:
+            o = gen.op(g, test, 0)
+            if o is None:
+                break
+            ops.append(o)
+            f = o["f"] if isinstance(o, dict) else o.f
+            if f == "enqueue":
+                enq += 1
+            elif f == "dequeue":
+                deq += 1
+        assert enq + deq == len(ops)
+        # drain adds exactly `enq` dequeues on top of the source's own
+        src_deq = deq - enq
+        assert src_deq >= 0
+        # every drain dequeue comes after the last enqueue
+        last_enq = max(i for i, o in enumerate(ops)
+                       if (o["f"] if isinstance(o, dict) else o.f)
+                       == "enqueue")
+        tail = ops[last_enq + 1:]
+        assert len(tail) >= enq  # the drain phase alone covers them
