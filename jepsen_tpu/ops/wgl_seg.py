@@ -544,7 +544,8 @@ _INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
 
 @functools.lru_cache(maxsize=32)
 def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
-                       decomposed: bool, J: int):
+                       decomposed: bool, J: int, rounds: int = 0,
+                       unroll: int = 1):
     """Bit-packed variant of the frontier kernel: the 2^R mask axis
     lives in the BITS of `Wd = max(1, 2^R/32)` uint32 words, so the
     frontier is `fr[Wd, Sn, J, K]` uint32 — 16-32x smaller than the
@@ -558,7 +559,18 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 
     State transitions use the diagonal + rank-1 decomposition when
     available (any Sn), else an unrolled s->t select-OR (Sn <= 16);
-    callers fall back to the dense bf16 kernel otherwise."""
+    callers fall back to the dense bf16 kernel otherwise.
+
+    `rounds > 0` replaces the dynamic closure `while_loop` with exactly
+    `rounds` statically-unrolled expansion rounds.  `rounds = R` is
+    EXACT: a closure sequence linearizes each open call at most once
+    (its slot bit is set and never cleared until retirement), at most R
+    calls are open, and round k unions in every config reachable by <= k
+    linearizations — so the fixpoint is reached by round R.  Removing
+    the data-dependent loop lets XLA fuse the whole event step and
+    pipeline the scan (`unroll` events per loop iteration), which on a
+    latency-bound chip beats early exit: the dynamic loop's per-round
+    popcount condition costs more than the 2-3 "wasted" rounds."""
     import jax
     import jax.numpy as jnp
 
@@ -682,18 +694,27 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
                     lt = lt | (lacking(fr, b) & sel32(rs == b))
                 return lt & sel32(rs >= 0)[None, None, None, :]
 
-            def round_(carry):
-                fr, _, prev = carry
+            def one_round(fr):
                 add = jnp.zeros_like(fr)
                 for c in range(C):
                     add = add | expand_candidate(fr, c)
-                fr2 = fr | add
-                cnt = popcount(fr2)
-                return fr2, (cnt > prev) & (popcount(lack_target(fr2)) > 0), cnt
+                return fr | add
 
-            fr, _, _ = jax.lax.while_loop(
-                lambda cy: cy[1], round_,
-                (fr, popcount(lack_target(fr)) > 0, jnp.int32(-1)))
+            if rounds > 0:
+                for _ in range(rounds):
+                    fr = one_round(fr)
+            else:
+                def round_(carry):
+                    fr, _, prev = carry
+                    fr2 = one_round(fr)
+                    cnt = popcount(fr2)
+                    return (fr2,
+                            (cnt > prev) & (popcount(lack_target(fr2)) > 0),
+                            cnt)
+
+                fr, _, _ = jax.lax.while_loop(
+                    lambda cy: cy[1], round_,
+                    (fr, popcount(lack_target(fr)) > 0, jnp.int32(-1)))
 
             # prune + retire the returning slot
             cleared = jnp.zeros_like(fr)
@@ -703,7 +724,8 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
             return fr, None
 
         fr, _ = jax.lax.scan(
-            event, fr0, (ret_slot, cand_slot, cand_aux1, cand_aux2, cand_t0))
+            event, fr0, (ret_slot, cand_slot, cand_aux1, cand_aux2, cand_t0),
+            unroll=unroll)
         # mask 0 = bit 0 of word 0
         return (fr[0] & 1).transpose(2, 1, 0)          # [K, J, Sn]
 
@@ -913,8 +935,23 @@ def _dispatch_kernel(K, L, C, M, Sn, R, J, ret_t, cslot_t, cuop_t,
     decomposed = diag_w is not None
     use_bits = (decomposed and Sn <= 32) or (not decomposed and Sn <= 8)
     if use_bits:
+        # Fixed-round unrolled closure + scan pipelining by default (see
+        # _build_kernel_bits: rounds=R is exact); JEPSEN_TPU_DYN_ROUNDS=1
+        # restores the dynamic while_loop, JEPSEN_TPU_SCAN_UNROLL tunes
+        # the events-per-loop-iteration pipelining.  Deep-concurrency
+        # batches (R beyond typical workload concurrency) keep the
+        # dynamic loop: the static body is O(R * C * R) full-tensor ops
+        # per round x R rounds x unroll, which at R near max_open_bits
+        # compiles huge HLO and wastes rounds the early exit would skip.
+        if (os.environ.get("JEPSEN_TPU_DYN_ROUNDS") == "1"
+                or (R > 6 and "JEPSEN_TPU_SCAN_UNROLL" not in os.environ)):
+            rounds, unroll = 0, 1
+        else:
+            rounds = int(R)
+            unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
         kern = _build_kernel_bits(K, int(L), int(C), max(1, M // 32),
-                                  int(Sn), int(R), decomposed, J=J)
+                                  int(Sn), int(R), decomposed, J=J,
+                                  rounds=rounds, unroll=unroll)
         aux1, aux2, t0c = _pack_cand_tables(
             cuop_t, legal, next_state, diag_w, const_w, const_t0)
         return kern, [ret_t.astype(np.int8), cslot_t.astype(np.int8),
@@ -1295,8 +1332,15 @@ def check_many(model, histories, *, max_states: int = 64,
         Sn = states.shape[0]
         R = max(fk.max_open for _, fk in batch)
         M = 1 << R
-        L = _next_pow2(max(fk.n_rets for _, fk in batch))
-        C = _next_pow2(R)
+        # Pad the event axis to a multiple of 64 (pow2 below that): the
+        # scan runs L serial steps for EVERY key, so pow2-padding 300-ret
+        # keys to 512 wasted 1.7x serial depth; 64-granularity keeps the
+        # compiled-shape set small without the waste.  C needs no pow2
+        # pad either — a return's candidate set is the open calls, <= R.
+        max_rets = max(fk.n_rets for _, fk in batch)
+        L = (_next_pow2(max_rets) if max_rets <= 64
+             else ((max_rets + 63) // 64) * 64)
+        C = int(R)
 
         # Opt-in segmented engine (JEPSEN_TPU_SEGMENT=1): cutting at
         # quiescent points turns returns-per-key serial depth into
