@@ -9,7 +9,9 @@ suite checks 300 ops/key, etcd.clj:167-179).
 Engine: jepsen_tpu.ops.wgl_seg.check_many — every key is one lane of a
 batched bitmap frontier kernel (dense (open-call-mask × model-state)
 configuration space, no sorting), all keys advance in lockstep on
-device.  Baseline: jepsen_tpu.ops.wgl_cpu, the knossos-equivalent
+device; the default register-delta form ships only per-return invoke
+deltas and maintains the open-call set in on-device registers, with a
+statically-unrolled closure (exact in <= R rounds).  Baseline: jepsen_tpu.ops.wgl_cpu, the knossos-equivalent
 just-in-time-linearization oracle, timed on a sample of the same keys
 (the reference delegates this work to knossos on a 32 GB JVM heap,
 jepsen/project.clj:30, and publishes no throughput numbers of its own —

@@ -26,12 +26,23 @@ def _kernels():
     import jax.numpy as jnp
 
     def member_counts(xs, ys):
-        """For each x in xs: multiplicity of x in ys.  Both int64[...]."""
-        order = jnp.argsort(ys)
-        ys_s = ys[order]
+        """For each x in xs: multiplicity of x in ys.  Only the sorted
+        VALUES of ys are needed — jnp.sort beats argsort + gather."""
+        ys_s = jnp.sort(ys)
         lo = jnp.searchsorted(ys_s, xs, side="left")
         hi = jnp.searchsorted(ys_s, xs, side="right")
         return hi - lo
+
+    def member(xs, ys):
+        """Membership x in ys — one binary search + a gather-compare,
+        half the cost of the two-sided count (the set checker only needs
+        masks, never multiplicities)."""
+        n = ys.shape[0]
+        if n == 0:
+            return jnp.zeros(xs.shape, bool)
+        ys_s = jnp.sort(ys)
+        lo = jnp.searchsorted(ys_s, xs, side="left")
+        return (ys_s[jnp.clip(lo, 0, n - 1)] == xs) & (lo < n)
 
     @jax.jit
     def set_kernel(attempts, adds, final_read):
@@ -39,15 +50,14 @@ def _kernels():
         program.  attempts/adds: values of invoked / ok'd :add ops;
         final_read: elements of the last ok :read.  Returns boolean masks
         over the inputs (host side maps them back to elements)."""
-        read_attempted = member_counts(final_read, attempts) > 0
+        read_attempted = member(final_read, attempts)
         # ok = final_read ∩ attempts ; unexpected = final_read \ attempts
         ok_mask = read_attempted
         unexpected_mask = ~read_attempted
         # lost = adds \ final_read
-        lost_mask = member_counts(adds, final_read) == 0
+        lost_mask = ~member(adds, final_read)
         # recovered = ok \ adds
-        in_adds = member_counts(final_read, adds) > 0
-        recovered_mask = ok_mask & ~in_adds
+        recovered_mask = ok_mask & ~member(final_read, adds)
         return ok_mask, unexpected_mask, lost_mask, recovered_mask
 
     @jax.jit
@@ -102,6 +112,20 @@ def _i64(xs) -> np.ndarray:
     return np.asarray(list(xs), np.int64).reshape(-1)
 
 
+_I32_MIN, _I32_MAX = -2 ** 31, 2 ** 31 - 1
+
+
+def _narrow(*arrs: np.ndarray):
+    """Cast a group of int64 arrays to int32 when every value fits —
+    halves host->device transfer and runs the TPU sorts on the native
+    32-bit lanes.  The group narrows together so cross-array compares
+    (searchsorted) keep one dtype."""
+    for a in arrs:
+        if len(a) and (a.min() < _I32_MIN or a.max() > _I32_MAX):
+            return arrs
+    return tuple(a.astype(np.int32) for a in arrs)
+
+
 def all_ints(xs) -> bool:
     return all(isinstance(x, int) and not isinstance(x, bool) for x in xs)
 
@@ -109,19 +133,19 @@ def all_ints(xs) -> bool:
 def set_masks(attempts, adds, final_read):
     """Device-evaluated masks for the set checker; see set_kernel."""
     k = _kernels()["set"]
-    out = k(_i64(attempts), _i64(adds), _i64(final_read))
+    out = k(*_narrow(_i64(attempts), _i64(adds), _i64(final_read)))
     return tuple(np.asarray(m) for m in out)
 
 
 def duplicate_counts(xs):
     k = _kernels()["dups"]
-    counts, mask = k(_i64(xs))
+    counts, mask = k(*_narrow(_i64(xs)))
     return np.asarray(counts), np.asarray(mask)
 
 
 def multiset_minus_mask(xs, ys):
     k = _kernels()["multiset_minus_mask"]
-    return np.asarray(k(_i64(xs), _i64(ys)))
+    return np.asarray(k(*_narrow(_i64(xs), _i64(ys))))
 
 
 def counter_bounds(is_inv_add, is_ok_add, values):

@@ -542,6 +542,52 @@ def _decompose(legal: np.ndarray, next_state: np.ndarray):
 _INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
 
 
+def _bit_ops(Wd: int, R: int):
+    """The frontier bit algebra shared by the bit-packed kernels: slot
+    bits 0-4 live within each uint32 word (constant-pattern masks and
+    shifts), slots >= 5 shift whole words along the word axis.  Returns
+    (lacking, set_slot, retire_slot, sel32) closures over frontier
+    tensors shaped [Wd, Sn, J, K]."""
+    import jax.numpy as jnp
+
+    FULL = np.uint32(0xFFFFFFFF)
+    Whalf = [(Wd >> (b + 1), 1 << b) for b in range(max(R - 5, 0))]
+    word_iota = np.arange(Wd, dtype=np.int32)
+
+    def word_lack(b):
+        """uint32 [Wd] mask: FULL where word index lacks bit b-5."""
+        return jnp.asarray(
+            np.where((word_iota >> (b - 5)) & 1 == 0, FULL, 0),
+            jnp.uint32)
+
+    def lacking(x, b):
+        """Configs in x whose mask lacks slot b."""
+        if b < 5:
+            return x & np.uint32(_INTRA[b])
+        return x & word_lack(b)[:, None, None, None]
+
+    def set_slot(x, b):
+        """Linearize slot b: configs lacking it move to mask|bit."""
+        if b < 5:
+            return (x & np.uint32(_INTRA[b])) << (1 << b)
+        return _reshape_shift(x & word_lack(b)[:, None, None, None],
+                              *Whalf[b - 5], set_bit=True)
+
+    def retire_slot(x, b):
+        """Prune configs lacking slot b, clear the bit on the rest."""
+        if b < 5:
+            return (x & np.uint32(~np.uint32(_INTRA[b]))) >> (1 << b)
+        keep = x & (~word_lack(b))[:, None, None, None]
+        return _reshape_shift(keep, *Whalf[b - 5], set_bit=False)
+
+    def sel32(cond):
+        """bool -> uint32 FULL/0 select mask."""
+        return jnp.where(cond, jnp.asarray(FULL),
+                         jnp.asarray(np.uint32(0)))
+
+    return lacking, set_slot, retire_slot, sel32
+
+
 @functools.lru_cache(maxsize=32)
 def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
                        decomposed: bool, J: int, rounds: int = 0,
@@ -575,47 +621,10 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
     import jax.numpy as jnp
 
     u32 = jnp.uint32
-    FULL = np.uint32(0xFFFFFFFF)
-    Whalf = [(Wd >> (b + 1), 1 << b) for b in range(max(R - 5, 0))]
-    word_iota = np.arange(Wd, dtype=np.int32)
-
-    def word_shift_set(x, wb):
-        """Word-axis m -> m | 1<<wb (x: [..., Wd, Sn, J, K])."""
-        return _reshape_shift(x, *Whalf[wb], set_bit=True)
-
-    def word_shift_clear(x, wb):
-        return _reshape_shift(x, *Whalf[wb], set_bit=False)
-
-    def word_lack(b):
-        """uint32 [Wd] mask: FULL where word index lacks bit b-5."""
-        return jnp.asarray(
-            np.where((word_iota >> (b - 5)) & 1 == 0, FULL, 0), u32)
-
-    def lacking(x, b):
-        """Configs in x whose mask lacks slot b."""
-        if b < 5:
-            return x & np.uint32(_INTRA[b])
-        return x & word_lack(b)[:, None, None, None]
-
-    def set_slot(x, b):
-        """Linearize slot b: configs lacking it move to mask|bit."""
-        if b < 5:
-            return (x & np.uint32(_INTRA[b])) << (1 << b)
-        return word_shift_set(x & word_lack(b)[:, None, None, None], b - 5)
-
-    def retire_slot(x, b):
-        """Prune configs lacking slot b, clear the bit on the rest."""
-        if b < 5:
-            return (x & np.uint32(~np.uint32(_INTRA[b]))) >> (1 << b)
-        keep = x & (~word_lack(b))[:, None, None, None]
-        return word_shift_clear(keep, b - 5)
+    lacking, set_slot, retire_slot, sel32 = _bit_ops(Wd, R)
 
     def popcount(x):
         return jax.lax.population_count(x).astype(jnp.int32).sum()
-
-    def sel32(cond):
-        """bool -> uint32 FULL/0 select mask."""
-        return jnp.where(cond, jnp.asarray(FULL), jnp.asarray(np.uint32(0)))
 
     def kern(ret_slot, cand_slot, cand_aux1, cand_aux2, cand_t0):
         # fr[w, s, j, k]; bit i of word w = mask index w*32+i.
@@ -730,6 +739,207 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
         return (fr[0] & 1).transpose(2, 1, 0)          # [K, J, Sn]
 
     return jax.jit(kern)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
+                       decomposed: bool, rounds: int, unroll: int):
+    """Register-delta variant of the bit-packed batch kernel (J=1).
+
+    The candidate-table kernel ships the FULL open-call set per return
+    ([L, K, C] x 4 tables, ~23 MB for the 1M-op bench) even though the
+    open set changes by ~one call per return; on a tunneled chip the
+    host->device transfer, not compute, bounds throughput (measured
+    ~0.45 s transfer vs ~0.12 s compute).  Here the device maintains the
+    open set itself in per-slot registers (aux words [R, K] carried
+    through the scan), and the host ships only the NEWLY-INVOKED calls
+    per return row — at most I per row, with bursts spilling into
+    virtual rows (ret_slot = -1: closure still runs there — a monotone
+    union of configs the return row reaches anyway — but nothing is
+    pruned or retired).  Transfer drops to [L', K] x (1 + 2I) bytes
+    (~5.5 MB for the same bench).
+
+    A second win falls out: candidates are now indexed BY slot, so the
+    closure's per-candidate 2R slot-select masks disappear — slot b's
+    expansion uses its static bit patterns directly.
+
+    Closure semantics and the rounds=R exactness argument are identical
+    to _build_kernel_bits (see its docstring); this builder only
+    supports fixed rounds (callers gate R <= 6 to the candidate-table
+    dynamic loop).  Transition tables are [U]-indexed on device (tiny
+    per-step gathers) in the same decomposed / nibble forms."""
+    import jax
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+    lacking, set_slot, retire_slot, sel32 = _bit_ops(Wd, R)
+    b_iota = np.arange(R, dtype=np.int32)[:, None]          # [R, 1]
+
+    def kern(ret_slot, inv_slot, inv_uop, aux1_tab, aux2_tab, t0_tab):
+        # ret_slot [L, K] i8; inv_slot/inv_uop [L, K, I] i8/i16;
+        # aux1_tab/aux2_tab [U] u32, t0_tab [U] i32.
+        fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, 0, 0, :].set(1)
+        reg0 = (jnp.zeros((R, K), u32), jnp.zeros((R, K), u32),
+                jnp.zeros((R, K), jnp.int32), jnp.zeros((R, K), bool))
+        s_iota = jnp.arange(Sn, dtype=jnp.int32)
+
+        def event(carry, ev):
+            fr, a1r, a2r, t0r, openr = carry
+            rs, isl, iu = ev
+            rs = rs.astype(jnp.int32)
+            isl = isl.astype(jnp.int32)
+            iu = iu.astype(jnp.int32)
+
+            # --- register the row's new invokes -----------------------
+            for i in range(I):
+                u = iu[:, i]
+                uc = jnp.clip(u, 0, None)
+                m = (u >= 0)[None, :] & (isl[:, i][None, :] == b_iota)
+                a1r = jnp.where(m, aux1_tab[uc][None, :], a1r)
+                a2r = jnp.where(m, aux2_tab[uc][None, :], a2r)
+                t0r = jnp.where(m, t0_tab[uc][None, :], t0r)
+                openr = openr | m
+
+            # --- closure: rounds x per-slot expansion -----------------
+            for _ in range(rounds):
+                add = jnp.zeros_like(fr)
+                for b in range(R):
+                    contrib = (lacking(fr, b)
+                               & sel32(openr[b])[None, None, None, :])
+                    if decomposed:
+                        dsel = sel32(((a1r[b][None, :]
+                                       >> s_iota[:, None]) & 1) == 1)
+                        moved = contrib & dsel[None, :, None, :]
+                        csel = sel32(((a2r[b][None, :]
+                                       >> s_iota[:, None]) & 1) == 1)
+                        red = jax.lax.reduce(
+                            contrib & csel[None, :, None, :],
+                            np.uint32(0), jax.lax.bitwise_or, (1,))
+                        at_t0 = sel32(s_iota[:, None] == t0r[b][None, :])
+                        moved = moved | (red[:, None, :, :]
+                                         & at_t0[None, :, None, :])
+                    else:
+                        lsel = sel32(((a1r[b][None, :]
+                                       >> s_iota[:, None]) & 1) == 1)
+                        nxt = (a2r[b][None, :]
+                               >> (4 * s_iota[:, None])) & 15   # [Sn, K]
+                        moved = jnp.zeros_like(fr)
+                        for s in range(Sn):
+                            src = contrib[:, s] & lsel[None, s, None, :]
+                            for t in range(Sn):
+                                m_t = src & sel32(nxt[s] == t)[None, None, :]
+                                moved = moved.at[:, t].set(moved[:, t] | m_t)
+                    add = add | set_slot(moved, b)
+                fr = fr | add
+
+            # --- prune + retire the returning slot --------------------
+            cleared = jnp.zeros_like(fr)
+            for b in range(R):
+                cleared = cleared | (retire_slot(fr, b) & sel32(rs == b))
+            fr = jnp.where((rs >= 0)[None, None, None, :], cleared, fr)
+            openr = openr & ~(rs[None, :] == b_iota)
+            return (fr, a1r, a2r, t0r, openr), None
+
+        (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0,
+                                   (ret_slot, inv_slot, inv_uop),
+                                   unroll=unroll)
+        return (fr[0] & 1).transpose(2, 1, 0)          # [K, 1, Sn]
+
+    return jax.jit(kern)
+
+
+def _pack_uop_tables(legal: np.ndarray, next_state: np.ndarray,
+                     diag_w, const_w, const_t0):
+    """[U]-indexed transition tables for the register kernel — the same
+    decomposed / nibble forms _pack_cand_tables gathers on host, left
+    un-gathered for device-side lookup."""
+    U, Sn = legal.shape
+    pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
+    if diag_w is not None:
+        aux1 = ((diag_w > 0).astype(np.uint64) * pow2).sum(1)
+        aux2 = ((const_w > 0).astype(np.uint64) * pow2).sum(1)
+        t0 = const_t0.astype(np.int32)
+    else:
+        aux1 = (legal.astype(np.uint64) * pow2).sum(1)
+        nib = (1 << (4 * np.arange(Sn, dtype=np.uint64))).astype(np.uint64)
+        aux2 = (next_state.astype(np.uint64) * nib).sum(1)
+        t0 = np.zeros(U, np.int32)
+    return (aux1.astype(np.uint32), aux2.astype(np.uint32), t0)
+
+
+def _pack_regs(batch, Kp: int, R: int, U: int, I: int):
+    """Delta-encode the whole batch for _build_kernel_regs: per return,
+    only the calls invoked since the previous return (derived from
+    consecutive candidate snapshots — between two returns a slot hosts
+    at most one new occupant, so a changed (slot -> uop) cell IS the new
+    invoke; an unchanged cell re-registers identical aux words, a
+    no-op).  Bursts beyond I spill into virtual rows (ret -1) BEFORE
+    their return's row.  Returns (ret_t [L', K], islot_t, iuop_t
+    [L', K, I], L')."""
+    # --- flatten all keys' snapshots ----------------------------------
+    rs_parts, cnt_parts, cs_parts, cu_parts, nr_parts = [], [], [], [], []
+    for _, fk in batch:
+        rs, counts, cs, cu = _fk_arrays(fk)
+        rs_parts.append(rs)
+        cnt_parts.append(counts)
+        cs_parts.append(cs)
+        cu_parts.append(cu)
+        nr_parts.append(len(rs))
+    rs_all = np.concatenate(rs_parts)
+    cnt_all = np.concatenate(cnt_parts)
+    cs_all = np.concatenate(cs_parts).astype(np.int64)
+    cu_all = np.concatenate(cu_parts)
+    nr_all = np.asarray(nr_parts, np.int64)
+    NR = len(rs_all)
+    ret_key = np.repeat(np.arange(len(batch)), nr_all)
+    key_start = np.concatenate([[0], np.cumsum(nr_all)[:-1]])
+    first_ret = key_start                       # global idx of row 0 per key
+
+    # dense snapshot matrix M[r, slot] = uop at return r, -1 empty
+    M = np.full((NR, R), -1, np.int64)
+    rowidx = np.repeat(np.arange(NR), cnt_all)
+    M[rowidx, cs_all] = cu_all
+    # previous snapshot with the returning slot freed
+    Oprev = np.full_like(M, -1)
+    Oprev[1:] = M[:-1]
+    idx = np.arange(1, NR)
+    Oprev[idx, rs_all[:-1].astype(np.int64)] = -1
+    Oprev[first_ret] = -1
+    D = (M != -1) & (M != Oprev)
+    c = D.sum(1).astype(np.int64)               # deltas per return
+
+    # --- row layout with virtual spill rows ---------------------------
+    e = np.maximum(0, (c + I - 1) // I - 1)     # virtual rows per return
+    ecum = np.cumsum(e)
+    ebase = np.concatenate([[0], ecum])[key_start]   # e-cumsum before key
+    r_local = np.arange(NR) - key_start[ret_key]
+    rho = r_local + (ecum - ebase[ret_key])     # local row of return r
+    rows_per_key = np.zeros(len(batch), np.int64)
+    np.maximum.at(rows_per_key, ret_key, rho + 1)
+    Lp = int(rows_per_key.max())
+    Lp = _next_pow2(Lp) if Lp <= 64 else ((Lp + 63) // 64) * 64
+
+    ret_slot = np.full((Kp, Lp), -1, np.int8)
+    ret_slot[ret_key, rho] = rs_all.astype(np.int8)
+
+    # --- scatter delta entries into (row, col) ------------------------
+    ent_ret, ent_slot = np.nonzero(D)           # ordered by (ret, slot)
+    ent_uop = M[ent_ret, ent_slot]
+    starts = np.cumsum(c) - c
+    j = np.arange(len(ent_ret)) - starts[ent_ret]
+    from_end = c[ent_ret] - 1 - j
+    row = rho[ent_ret] - from_end // I
+    col = from_end % I
+    uop_dtype = np.int8 if U <= 127 else np.int16
+    inv_slot = np.full((Kp, Lp, I), -1, np.int8)
+    inv_uop = np.full((Kp, Lp, I), -1, uop_dtype)
+    inv_slot[ret_key[ent_ret], row, col] = ent_slot.astype(np.int8)
+    inv_uop[ret_key[ent_ret], row, col] = ent_uop.astype(uop_dtype)
+
+    ret_t = np.ascontiguousarray(ret_slot.T)
+    islot_t = np.ascontiguousarray(inv_slot.transpose(1, 0, 2))
+    iuop_t = np.ascontiguousarray(inv_uop.transpose(1, 0, 2))
+    return ret_t, islot_t, iuop_t, Lp
 
 
 def _pack_cand_tables(cand_uop: np.ndarray, legal: np.ndarray,
@@ -943,8 +1153,11 @@ def _dispatch_kernel(K, L, C, M, Sn, R, J, ret_t, cslot_t, cuop_t,
         # dynamic loop: the static body is O(R * C * R) full-tensor ops
         # per round x R rounds x unroll, which at R near max_open_bits
         # compiles huge HLO and wastes rounds the early exit would skip.
+        # (JEPSEN_TPU_FORCE_STATIC=1 overrides the R guard explicitly;
+        # the unroll knob never does.)
         if (os.environ.get("JEPSEN_TPU_DYN_ROUNDS") == "1"
-                or (R > 6 and "JEPSEN_TPU_SCAN_UNROLL" not in os.environ)):
+                or (R > 6
+                    and os.environ.get("JEPSEN_TPU_FORCE_STATIC") != "1")):
             rounds, unroll = 0, 1
         else:
             rounds = int(R)
@@ -1372,6 +1585,46 @@ def check_many(model, histories, *, max_states: int = 64,
             mult = int(np.lcm(mult, mesh.shape[mesh_axis]))
         Kp = max(mult, ((Kk + mult - 1) // mult) * mult)
 
+        diag_w, const_w, const_t0 = _decompose(legal, next_state)
+        decomposed = diag_w is not None
+        U = legal.shape[0]
+
+        # Register-delta path (default): ship only per-return invoke
+        # deltas and let the device maintain the open set — see
+        # _build_kernel_regs.  Same R <= 6 fixed-rounds gate as the
+        # candidate-table path; JEPSEN_TPU_NO_REGS=1 opts out (and the
+        # dynamic-rounds / Pallas toggles imply the table path).
+        use_regs = (R <= 6 and U <= 32767
+                    and ((decomposed and Sn <= 32)
+                         or (not decomposed and Sn <= 8))
+                    and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
+                    and os.environ.get("JEPSEN_TPU_PALLAS") != "1"
+                    and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
+        if use_regs:
+            I = min(2, int(R))
+            ret_t, islot_t, iuop_t, Lp = _pack_regs(
+                batch, Kp, int(R), int(U), I)
+            a1t, a2t, t0t = _pack_uop_tables(
+                legal, next_state, diag_w, const_w, const_t0)
+            unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
+            kern = _build_kernel_regs(Kp, int(Lp), I, max(1, M // 32),
+                                      int(Sn), int(R), decomposed,
+                                      rounds=int(R), unroll=unroll)
+            args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
+            if mesh is not None and mesh_axis is not None:
+                args = _shard_args(mesh, mesh_axis, args, 3)
+            t1 = time.monotonic()
+            T = np.asarray(kern(*args))                  # [Kp, 1, Sn]
+            t_kernel = time.monotonic() - t1
+            engine_name = "wgl_seg_batch_regs"
+            ok_k = (T[:, 0, :] > 0.5).any(axis=1)
+            for kk, (i, fk) in enumerate(batch):
+                _emit_batch_result(results, i, fk, bool(ok_k[kk]),
+                                   backend_name, engine_name, t_kernel,
+                                   model, histories, localize)
+            batch = []
+
+    if batch:
         ret_slot = np.full((Kp, L), -1, np.int32)
         cand_slot = np.zeros((Kp, L, C), np.int32)
         cand_uop = np.full((Kp, L, C), -1, np.int32)
@@ -1395,7 +1648,6 @@ def check_many(model, histories, *, max_states: int = 64,
                     cand_slot[kk, r, j] = s2
                     cand_uop[kk, r, j] = u2
 
-        diag_w, const_w, const_t0 = _decompose(legal, next_state)
         ret_t = np.ascontiguousarray(ret_slot.T)             # [L, K]
         cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
         cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
