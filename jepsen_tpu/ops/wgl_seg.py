@@ -100,6 +100,9 @@ class SegPlan:
     diag_w: Optional[np.ndarray] = None    # f32 [U, Sn]
     const_w: Optional[np.ndarray] = None   # f32 [U, Sn]
     const_t0: Optional[np.ndarray] = None  # int32 [U]
+    # Per-segment flat snapshot arrays (the _fk_arrays form) for the
+    # register-delta kernel path; one _FastKey per segment.
+    seg_fk: Optional[list] = None
 
 
 def _encode_calls(calls, spec: DeviceSpec, seen: Optional[dict] = None,
@@ -221,7 +224,7 @@ def _enumerate_states(spec: DeviceSpec, init_state: np.ndarray,
 
 def plan(prep: PreparedHistory, spec: DeviceSpec, model, *,
          max_states: int = 64, max_open_bits: int = 10,
-         target_returns_per_segment: int = 512,
+         target_returns_per_segment: int = 256,
          pad_segments_pow2: bool = True) -> SegPlan:
     calls = prep.calls
     if any(c.is_crashed for c in calls):
@@ -269,27 +272,38 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model, *,
         C = max(C, max((len(cs) for _, _, cs in rets), default=1))
 
     if pad_segments_pow2:
-        L = _next_pow2(L)
+        L = _pad_len(L)
         C = _next_pow2(C)
 
     ret_slot = np.full((K, L), -1, np.int32)
     cand_slot = np.zeros((K, L, C), np.int32)
     cand_uop = np.full((K, L, C), -1, np.int32)
     seg_end_call = np.zeros(K, np.int32)
+    seg_fk = []
     for k, rets in enumerate(seg_tables):
+        rs_f, cnt_f, cs_f, cu_f = [], [], [], []
         for r, (cid, slot, cands) in enumerate(rets):
             ret_slot[k, r] = slot
+            rs_f.append(slot)
+            cnt_f.append(len(cands))
             for j, (c2, s2) in enumerate(cands):
                 cand_slot[k, r, j] = s2
                 cand_uop[k, r, j] = call_uop[c2]
+                cs_f.append(s2)
+                cu_f.append(call_uop[c2])
         seg_end_call[k] = rets[-1][0] if rets else -1
+        seg_fk.append(_FastKey(
+            None, prep.max_open, len(rets),
+            arrays=(np.asarray(rs_f, np.int32), np.asarray(cnt_f, np.int32),
+                    np.asarray(cs_f, np.int32), np.asarray(cu_f, np.int32))))
 
     diag_w, const_w, const_t0 = _decompose(legal, next_state)
 
     return SegPlan(ret_slot, cand_slot, cand_uop, legal, next_state,
                    states, seg_end_call, n_calls=len(calls),
                    max_open=prep.max_open,
-                   diag_w=diag_w, const_w=const_w, const_t0=const_t0)
+                   diag_w=diag_w, const_w=const_w, const_t0=const_t0,
+                   seg_fk=seg_fk)
 
 
 def _next_pow2(x: int) -> int:
@@ -297,6 +311,29 @@ def _next_pow2(x: int) -> int:
     while b < x:
         b *= 2
     return b
+
+
+def _pad_len(x: int) -> int:
+    """Event-axis padding: pow2 below 64, 64-multiples above.  The scan
+    runs this many serial steps for EVERY lane, so pow2 padding wasted
+    up to 2x; 64-granularity keeps the compiled-shape set small without
+    the waste."""
+    return _next_pow2(x) if x <= 64 else ((x + 63) // 64) * 64
+
+
+def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool) -> bool:
+    """One gate for the register-delta kernel, shared by check() and
+    check_many() so single-history and batch cannot silently diverge:
+    fixed rounds stay exact and compile small only for R <= 6, the uop
+    index must fit int16, and the transition form must fit the
+    decomposed (Sn <= 32) or nibble (Sn <= 8) tables.  The Pallas /
+    dynamic-rounds toggles imply the candidate-table path."""
+    return (R <= 6 and U <= 32767
+            and ((decomposed and Sn <= 32)
+                 or (not decomposed and Sn <= 8))
+            and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
+            and os.environ.get("JEPSEN_TPU_PALLAS") != "1"
+            and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
 
 
 class _FastKey:
@@ -743,8 +780,11 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 
 @functools.lru_cache(maxsize=32)
 def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
-                       decomposed: bool, rounds: int, unroll: int):
-    """Register-delta variant of the bit-packed batch kernel (J=1).
+                       decomposed: bool, rounds: int, unroll: int,
+                       J: int = 1):
+    """Register-delta variant of the bit-packed batch kernel (J=1 for
+    independent whole histories; J=Sn computes per-segment transfer
+    matrices for the single-history path, one lane per segment).
 
     The candidate-table kernel ships the FULL open-call set per return
     ([L, K, C] x 4 tables, ~23 MB for the 1M-op bench) even though the
@@ -778,7 +818,13 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
     def kern(ret_slot, inv_slot, inv_uop, aux1_tab, aux2_tab, t0_tab):
         # ret_slot [L, K] i8; inv_slot/inv_uop [L, K, I] i8/i16;
         # aux1_tab/aux2_tab [U] u32, t0_tab [U] i32.
-        fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, 0, 0, :].set(1)
+        if J == Sn:
+            # one lane per (segment, entry state): transfer matrices
+            fr0 = jnp.zeros((Wd, Sn, J, K), u32).at[0].set(
+                (jnp.eye(Sn, dtype=u32)[:, :, None]
+                 * jnp.ones((1, 1, K), u32)))
+        else:
+            fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, 0, 0, :].set(1)
         reg0 = (jnp.zeros((R, K), u32), jnp.zeros((R, K), u32),
                 jnp.zeros((R, K), jnp.int32), jnp.zeros((R, K), bool))
         s_iota = jnp.arange(Sn, dtype=jnp.int32)
@@ -843,7 +889,7 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
         (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0,
                                    (ret_slot, inv_slot, inv_uop),
                                    unroll=unroll)
-        return (fr[0] & 1).transpose(2, 1, 0)          # [K, 1, Sn]
+        return (fr[0] & 1).transpose(2, 1, 0)          # [K, J, Sn]
 
     return jax.jit(kern)
 
@@ -917,7 +963,7 @@ def _pack_regs(batch, Kp: int, R: int, U: int, I: int):
     rows_per_key = np.zeros(len(batch), np.int64)
     np.maximum.at(rows_per_key, ret_key, rho + 1)
     Lp = int(rows_per_key.max())
-    Lp = _next_pow2(Lp) if Lp <= 64 else ((Lp + 63) // 64) * 64
+    Lp = _pad_len(Lp)
 
     ret_slot = np.full((Kp, Lp), -1, np.int8)
     ret_slot[ret_key, rho] = rs_all.astype(np.int8)
@@ -1201,7 +1247,7 @@ def _shard_args(mesh, mesh_axis: str, args: list, n_sharded: int):
 # ---------------------------------------------------------------------------
 
 def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
-          target_returns_per_segment: int = 512,
+          target_returns_per_segment: int = 256,
           localize: bool = True, mesh=None,
           mesh_axis: Optional[str] = None) -> dict[str, Any]:
     """Segment-parallel linearizability check.  Returns a knossos-shaped
@@ -1237,37 +1283,61 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     M = 1 << pl.max_open
     t_plan = time.monotonic() - t0
 
-    ret_slot, cand_slot, cand_uop = pl.ret_slot, pl.cand_slot, pl.cand_uop
     sharded = False
+    K_run = K
     if mesh is not None and mesh_axis is not None:
         # pad the segment axis up to a mesh-size multiple — the plan
         # does NOT guarantee divisibility, and all-padding segments
         # (ret -1, no candidates) are identity transfer matrices
         m = int(mesh.shape[mesh_axis])
-        Kp = ((K + m - 1) // m) * m
-        if Kp != K:
-            ret_slot = np.concatenate(
-                [ret_slot, np.full((Kp - K, L), -1, np.int32)])
-            cand_slot = np.concatenate(
-                [cand_slot, np.zeros((Kp - K, L, C), np.int32)])
-            cand_uop = np.concatenate(
-                [cand_uop, np.full((Kp - K, L, C), -1, np.int32)])
-        K_run = Kp
+        K_run = ((K + m - 1) // m) * m
         sharded = True
+
+    # Register-delta kernel for segments (one lane per segment, J=Sn
+    # entry states) under the same gate as the batch path; the
+    # candidate-table kernel is the fallback.
+    R = int(pl.max_open)
+    decomposed = pl.diag_w is not None
+    U = pl.legal.shape[0]
+    if pl.seg_fk is not None and _regs_eligible(R, U, Sn, decomposed):
+        I = min(2, R) if R else 1
+        batch_fk = [(k, fk) for k, fk in enumerate(pl.seg_fk)]
+        ret_t, islot_t, iuop_t, Lp = _pack_regs(
+            batch_fk, K_run, R, int(U), I)
+        a1t, a2t, t0t = _pack_uop_tables(
+            pl.legal, pl.next_state, pl.diag_w, pl.const_w, pl.const_t0)
+        unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
+        kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
+                                  int(Sn), R, decomposed,
+                                  rounds=R, unroll=unroll, J=int(Sn))
+        args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
+        if sharded:
+            args = _shard_args(mesh, mesh_axis, args, 3)
+        t1 = time.monotonic()
+        T = np.asarray(kern(*args))[:K] > 0.5                # [K, Sn, Sn]
+        t_kernel = time.monotonic() - t1
     else:
-        K_run = K
-    ret_t = np.ascontiguousarray(ret_slot.T)                 # [L, K]
-    cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
-    cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
-    t1 = time.monotonic()
-    kern, args, n_sharded = _dispatch_kernel(
-        K_run, int(L), int(C), int(M), int(Sn), int(pl.max_open),
-        int(Sn), ret_t, cslot_t, cuop_t, pl.legal, pl.next_state,
-        pl.diag_w, pl.const_w, pl.const_t0)
-    if sharded:
-        args = _shard_args(mesh, mesh_axis, args, n_sharded)
-    T = np.asarray(kern(*args))[:K] > 0.5                    # [K, Sn, Sn]
-    t_kernel = time.monotonic() - t1
+        ret_slot, cand_slot, cand_uop = \
+            pl.ret_slot, pl.cand_slot, pl.cand_uop
+        if K_run != K:
+            ret_slot = np.concatenate(
+                [ret_slot, np.full((K_run - K, L), -1, np.int32)])
+            cand_slot = np.concatenate(
+                [cand_slot, np.zeros((K_run - K, L, C), np.int32)])
+            cand_uop = np.concatenate(
+                [cand_uop, np.full((K_run - K, L, C), -1, np.int32)])
+        ret_t = np.ascontiguousarray(ret_slot.T)             # [L, K]
+        cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
+        cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
+        t1 = time.monotonic()
+        kern, args, n_sharded = _dispatch_kernel(
+            K_run, int(L), int(C), int(M), int(Sn), R,
+            int(Sn), ret_t, cslot_t, cuop_t, pl.legal, pl.next_state,
+            pl.diag_w, pl.const_w, pl.const_t0)
+        if sharded:
+            args = _shard_args(mesh, mesh_axis, args, n_sharded)
+        T = np.asarray(kern(*args))[:K] > 0.5                # [K, Sn, Sn]
+        t_kernel = time.monotonic() - t1
 
     # Compose transfer matrices left-to-right on host (K tiny matvecs).
     v = np.zeros(Sn, bool)
@@ -1545,14 +1615,9 @@ def check_many(model, histories, *, max_states: int = 64,
         Sn = states.shape[0]
         R = max(fk.max_open for _, fk in batch)
         M = 1 << R
-        # Pad the event axis to a multiple of 64 (pow2 below that): the
-        # scan runs L serial steps for EVERY key, so pow2-padding 300-ret
-        # keys to 512 wasted 1.7x serial depth; 64-granularity keeps the
-        # compiled-shape set small without the waste.  C needs no pow2
-        # pad either — a return's candidate set is the open calls, <= R.
-        max_rets = max(fk.n_rets for _, fk in batch)
-        L = (_next_pow2(max_rets) if max_rets <= 64
-             else ((max_rets + 63) // 64) * 64)
+        # C needs no pow2 pad — a return's candidate set is the open
+        # calls, <= R.
+        L = _pad_len(max(fk.n_rets for _, fk in batch))
         C = int(R)
 
         # Opt-in segmented engine (JEPSEN_TPU_SEGMENT=1): cutting at
@@ -1591,16 +1656,8 @@ def check_many(model, histories, *, max_states: int = 64,
 
         # Register-delta path (default): ship only per-return invoke
         # deltas and let the device maintain the open set — see
-        # _build_kernel_regs.  Same R <= 6 fixed-rounds gate as the
-        # candidate-table path; JEPSEN_TPU_NO_REGS=1 opts out (and the
-        # dynamic-rounds / Pallas toggles imply the table path).
-        use_regs = (R <= 6 and U <= 32767
-                    and ((decomposed and Sn <= 32)
-                         or (not decomposed and Sn <= 8))
-                    and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
-                    and os.environ.get("JEPSEN_TPU_PALLAS") != "1"
-                    and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
-        if use_regs:
+        # _build_kernel_regs and the shared _regs_eligible gate.
+        if _regs_eligible(int(R), int(U), int(Sn), decomposed):
             I = min(2, int(R))
             ret_t, islot_t, iuop_t, Lp = _pack_regs(
                 batch, Kp, int(R), int(U), I)

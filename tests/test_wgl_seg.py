@@ -201,10 +201,72 @@ class TestRegsPath:
         for h, r in zip(hists, res):
             assert r["valid?"] == wgl_cpu.check(m, h)["valid?"]
 
-    def test_regs_mutex_nibble_path(self):
-        # Mutex acquire/release does NOT use the decomposed transition
-        # form end-to-end? it does — but force variety: queue model has
-        # a larger state space; mutex exercises tiny Sn with contention.
+    def test_regs_nibble_nondecomposable_model(self):
+        # A mod-3 incrementing counter: 'inc' maps each state to a
+        # DIFFERENT target (s -> s+1 mod 3), so _decompose() fails and
+        # the regs kernel must take its nibble (non-decomposed) branch.
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        def mod3_step(state, f, a, b, a_ok):
+            s = state[0]
+            is_inc = f == 0
+            ns = jnp.where(is_inc, (s + 1) % 3, s)
+            legal = is_inc | ((f == 1) & (a.astype(jnp.int32) == s))
+            return jnp.where(legal, ns, s)[None], legal
+
+        @dataclasses.dataclass(frozen=True)
+        class Mod3(models.Model):
+            value: int = 0
+
+            def step(self, op):
+                if op.f == "inc":
+                    return Mod3((self.value + 1) % 3)
+                if op.f == "read":
+                    if op.value == self.value:
+                        return self
+                    return models.inconsistent("bad read")
+                return models.inconsistent(f"unknown f {op.f!r}")
+
+            def device_spec(self):
+                return models.DeviceSpec(
+                    1, {"inc": 0, "read": 1},
+                    lambda m: np.array([m.value], np.int32), mod3_step)
+
+        from jepsen_tpu.ops.wgl_seg import _decompose, _encode_calls, \
+            _enumerate_states
+        from jepsen_tpu.ops.prep import prepare
+
+        def mk(read_vals):
+            ops = []
+            for i, rv in enumerate(read_vals):
+                ops.append(invoke_op(0, "inc", None))
+                ops.append(ok_op(0, "inc", None))
+                ops.append(invoke_op(1, "read", rv))
+                ops.append(ok_op(1, "read", rv))
+            return History(ops).index()
+
+        m = Mod3()
+        good = mk([1, 2, 0, 1])
+        bad = mk([1, 2, 0, 2])
+        # prove the model is non-decomposable (so the nibble branch runs)
+        spec = m.device_spec()
+        prep = prepare(good)
+        uops, _ = _encode_calls(prep.calls, spec)
+        _, legal, nxt = _enumerate_states(
+            spec, np.array([0], np.int32), uops, 64)
+        assert _decompose(legal, nxt) == (None, None, None)
+        res = wgl_seg.check_many(m, [good, bad])
+        assert all(r["engine"] == "wgl_seg_batch_regs" for r in res)
+        assert res[0]["valid?"] is True
+        assert res[1]["valid?"] is False
+        assert res[1]["valid?"] == wgl_cpu.check(m, bad)["valid?"]
+        # single-history J=Sn regs path through the same nibble branch
+        r1 = wgl_seg.check(m, good, target_returns_per_segment=2)
+        assert r1["valid?"] is True and r1["segments"] > 1, r1
+
+    def test_regs_mutex_small_state(self):
         m = models.Mutex()
         ops = []
         for i in range(6):
