@@ -51,7 +51,7 @@ from jepsen_tpu.ops import wgl_cpu, wgl_seg
 N_KEYS = 3400
 OPS_PER_KEY = 300
 CONCURRENCY = 5          # per key — the etcd workload shape
-CPU_SAMPLE_KEYS = 40
+CPU_SAMPLE_KEYS = 100   # large enough that the oracle rate is stable
 SINGLE_N_OPS = 100_000   # config 2 secondary measurement
 
 

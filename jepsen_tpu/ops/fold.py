@@ -130,17 +130,25 @@ def all_ints(xs) -> bool:
     return all(isinstance(x, int) and not isinstance(x, bool) for x in xs)
 
 
+def _get(out):
+    """One pipelined device-to-host fetch for a tuple of outputs — each
+    separate np.asarray pays a full round-trip on a tunneled chip."""
+    import jax
+
+    return jax.device_get(out)
+
+
 def set_masks(attempts, adds, final_read):
     """Device-evaluated masks for the set checker; see set_kernel."""
     k = _kernels()["set"]
-    out = k(*_narrow(_i64(attempts), _i64(adds), _i64(final_read)))
-    return tuple(np.asarray(m) for m in out)
+    return tuple(_get(k(*_narrow(_i64(attempts), _i64(adds),
+                                 _i64(final_read)))))
 
 
 def duplicate_counts(xs):
     k = _kernels()["dups"]
-    counts, mask = k(*_narrow(_i64(xs)))
-    return np.asarray(counts), np.asarray(mask)
+    counts, mask = _get(k(*_narrow(_i64(xs))))
+    return counts, mask
 
 
 def multiset_minus_mask(xs, ys):
@@ -150,6 +158,6 @@ def multiset_minus_mask(xs, ys):
 
 def counter_bounds(is_inv_add, is_ok_add, values):
     k = _kernels()["counter_bounds"]
-    lo, hi = k(np.asarray(is_inv_add, bool), np.asarray(is_ok_add, bool),
-               _i64(values))
-    return np.asarray(lo), np.asarray(hi)
+    lo, hi = _get(k(np.asarray(is_inv_add, bool),
+                    np.asarray(is_ok_add, bool), _i64(values)))
+    return lo, hi

@@ -239,24 +239,23 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model, *,
     states, legal, next_state = _enumerate_states(
         spec, init, uops, max_states)
 
-    # Quiescent cuts: event positions with zero open calls.
-    cuts = [0]
+    # Quiescent cuts: per-return flags (zero open calls after it) plus
+    # the event position just past each return, for segment slicing.
+    cut_flags = []
+    ret_event_end = []
     open_count = 0
     for i, (_, kind, _) in enumerate(prep.events):
         open_count += 1 if kind == 0 else -1
-        if open_count == 0:
-            cuts.append(i + 1)
-    if cuts[-1] != len(prep.events):
+        if kind == 1:
+            cut_flags.append(1 if open_count == 0 else 0)
+            ret_event_end.append(i + 1)
+    if open_count != 0:
         raise Unsupported("history ends with open calls")  # unreachable:
         # crash-free histories always return every call (prep marks
         # unreturned invokes as crashed, caught above)
 
-    # Greedy segment formation: next cut at least 2*target events on.
-    target_events = 2 * target_returns_per_segment
-    seg_bounds = [0]
-    for c in cuts[1:]:
-        if c - seg_bounds[-1] >= target_events or c == cuts[-1]:
-            seg_bounds.append(c)
+    seg_ret_ends = _segment_ends(cut_flags, target_returns_per_segment)
+    seg_bounds = [0] + [ret_event_end[r - 1] for r in seg_ret_ends]
     if len(seg_bounds) < 2:
         seg_bounds = [0, len(prep.events)]
 
@@ -275,29 +274,38 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model, *,
         L = _pad_len(L)
         C = _next_pow2(C)
 
+    diag_w, const_w, const_t0 = _decompose(legal, next_state)
+    # seg_fk is only consumed by the register-delta kernel — skip the
+    # extra per-candidate appends when that path cannot engage.
+    want_fk = _regs_eligible(prep.max_open, uops.shape[0],
+                             states.shape[0], diag_w is not None)
+
     ret_slot = np.full((K, L), -1, np.int32)
     cand_slot = np.zeros((K, L, C), np.int32)
     cand_uop = np.full((K, L, C), -1, np.int32)
     seg_end_call = np.zeros(K, np.int32)
-    seg_fk = []
+    seg_fk = [] if want_fk else None
     for k, rets in enumerate(seg_tables):
         rs_f, cnt_f, cs_f, cu_f = [], [], [], []
         for r, (cid, slot, cands) in enumerate(rets):
             ret_slot[k, r] = slot
-            rs_f.append(slot)
-            cnt_f.append(len(cands))
+            if want_fk:
+                rs_f.append(slot)
+                cnt_f.append(len(cands))
             for j, (c2, s2) in enumerate(cands):
                 cand_slot[k, r, j] = s2
                 cand_uop[k, r, j] = call_uop[c2]
-                cs_f.append(s2)
-                cu_f.append(call_uop[c2])
+                if want_fk:
+                    cs_f.append(s2)
+                    cu_f.append(call_uop[c2])
         seg_end_call[k] = rets[-1][0] if rets else -1
-        seg_fk.append(_FastKey(
-            None, prep.max_open, len(rets),
-            arrays=(np.asarray(rs_f, np.int32), np.asarray(cnt_f, np.int32),
-                    np.asarray(cs_f, np.int32), np.asarray(cu_f, np.int32))))
-
-    diag_w, const_w, const_t0 = _decompose(legal, next_state)
+        if want_fk:
+            seg_fk.append(_FastKey(
+                None, prep.max_open, len(rets),
+                arrays=(np.asarray(rs_f, np.int32),
+                        np.asarray(cnt_f, np.int32),
+                        np.asarray(cs_f, np.int32),
+                        np.asarray(cu_f, np.int32))))
 
     return SegPlan(ret_slot, cand_slot, cand_uop, legal, next_state,
                    states, seg_end_call, n_calls=len(calls),
@@ -311,6 +319,22 @@ def _next_pow2(x: int) -> int:
     while b < x:
         b *= 2
     return b
+
+
+def _segment_ends(cut_flags: np.ndarray, target: int) -> list:
+    """Greedy quiescent-cut segmentation over returns — the ONE
+    segmentation policy (shared by plan() and the fast scan path):
+    cut_flags[r] marks quiescence after return r; a segment closes at
+    the first quiescent return >= `target` returns in, and the last cut
+    always closes the tail."""
+    ends: list = []
+    start = 0
+    pos = np.nonzero(np.asarray(cut_flags))[0]
+    for c in pos:
+        if c + 1 - start >= target or c == pos[-1]:
+            ends.append(int(c) + 1)
+            start = int(c) + 1
+    return ends
 
 
 def _pad_len(x: int) -> int:
@@ -1246,6 +1270,135 @@ def _shard_args(mesh, mesh_axis: str, args: list, n_sharded: int):
 # Public API
 # ---------------------------------------------------------------------------
 
+def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
+                  legal, next_state, diag_w, const_w, const_t0,
+                  mesh, mesh_axis):
+    """Run the J=Sn register-delta kernel over per-segment lanes.
+    Returns (T bool [K, Sn, Sn], t_kernel, sharded) — shared by the
+    plan()-based and fast-scan single-history paths."""
+    sharded = False
+    K_run = K
+    if mesh is not None and mesh_axis is not None:
+        # pad the segment axis up to a mesh-size multiple: all-padding
+        # lanes (ret -1, no invokes) are identity transfer matrices
+        m = int(mesh.shape[mesh_axis])
+        K_run = ((K + m - 1) // m) * m
+        sharded = True
+    I = min(2, R) if R else 1
+    decomposed = diag_w is not None
+    # timer covers host packing too, matching the candidate-table path
+    # (whose _dispatch_kernel packing sits inside the timed window) so
+    # the two flavours report comparable time_kernel_s
+    t1 = time.monotonic()
+    ret_t, islot_t, iuop_t, Lp = _pack_regs(
+        [(k, fk) for k, fk in enumerate(seg_fk)], K_run, R, int(U), I)
+    a1t, a2t, t0t = _pack_uop_tables(
+        legal, next_state, diag_w, const_w, const_t0)
+    unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
+    kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
+                              int(Sn), R, decomposed,
+                              rounds=R, unroll=unroll, J=int(Sn))
+    args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
+    if sharded:
+        args = _shard_args(mesh, mesh_axis, args, 3)
+    T = np.asarray(kern(*args))[:K] > 0.5                    # [K, Sn, Sn]
+    return T, time.monotonic() - t1, sharded
+
+
+def _compose_transfer(T: np.ndarray, Sn: int) -> int:
+    """Compose transfer matrices left-to-right from entry state 0
+    (K tiny matvecs); returns the first dead segment or -1."""
+    v = np.zeros(Sn, bool)
+    v[0] = True
+    for k in range(T.shape[0]):
+        v = v @ T[k]
+        if not v.any():
+            return k
+    return -1
+
+
+def _check_fast(model, spec, history, *, max_states, max_open_bits,
+                target_returns_per_segment, localize, mesh, mesh_axis,
+                backend_name, t0):
+    """Single-history fast path: one fused host scan (the native C
+    scanner when available) straight into per-segment register-delta
+    lanes — no per-op Python objects.  Returns None when out of scope
+    (crashed calls, non-eligible models, custom encodings) so check()
+    takes the plan() route, which raises the descriptive Unsupported."""
+    seen: dict = {}
+    rows: list = []
+    ops = history.ops if isinstance(history, History) else \
+        History(history).ops
+    fk = _native_scan(ops, spec, seen, rows, max_open_bits)
+    if fk is False:
+        fk = _fast_scan(history, spec, seen, rows, max_open_bits)
+    if fk is None:
+        return None
+    if fk.n_calls == 0:
+        return {"valid?": True, "op_count": 0, "backend": backend_name,
+                "engine": "wgl_seg"}
+    uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
+    init = np.asarray(spec.encode(model), np.int32)
+    try:
+        states, legal, next_state = _enumerate_states(
+            spec, init, uops, max_states)
+    except Unsupported:
+        return None
+    Sn = states.shape[0]
+    R = int(fk.max_open)
+    diag_w, const_w, const_t0 = _decompose(legal, next_state)
+    if not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None):
+        return None
+
+    # segment at quiescent cuts, >= target returns per segment
+    rs, counts, cs, cu = _fk_arrays(fk)
+    nr = len(rs)
+    cuts = np.asarray(fk.cuts, np.int32)
+    if len(cuts) != nr or not nr or cuts[-1] != 1:
+        return None                  # defensive: malformed cut stream
+    seg_ends = _segment_ends(cuts, target_returns_per_segment)
+    cand_off = np.concatenate([[0], np.cumsum(counts)])
+    seg_fk = []
+    lo = 0
+    for hi in seg_ends:
+        seg_fk.append(_FastKey(
+            None, R, int(hi - lo),
+            arrays=(rs[lo:hi], counts[lo:hi],
+                    cs[cand_off[lo]:cand_off[hi]],
+                    cu[cand_off[lo]:cand_off[hi]])))
+        lo = hi
+    K = len(seg_fk)
+    t_plan = time.monotonic() - t0
+
+    T, t_kernel, sharded = _run_seg_regs(
+        seg_fk, K, R, legal.shape[0], Sn, 1 << R, legal, next_state,
+        diag_w, const_w, const_t0, mesh, mesh_axis)
+    dead_segment = _compose_transfer(T, Sn)
+
+    result: dict[str, Any] = {
+        "valid?": dead_segment < 0,
+        "op_count": fk.n_calls,
+        "backend": backend_name,
+        "engine": "wgl_seg",
+        "segments": K,
+        "states": Sn,
+        "sharded": sharded,
+        "time_plan_s": t_plan,
+        "time_kernel_s": t_kernel,
+    }
+    if dead_segment >= 0:
+        result["anomaly"] = "nonlinearizable"
+        result["dead_segment"] = dead_segment
+        if localize:
+            # the oracle terminates at the first non-linearizable op
+            from jepsen_tpu.ops import wgl_cpu
+            oracle = wgl_cpu.check(model, history)
+            for key in ("op", "op_index", "final_paths"):
+                if key in oracle:
+                    result[key] = oracle[key]
+    return result
+
+
 def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
           target_returns_per_segment: int = 256,
           localize: bool = True, mesh=None,
@@ -1268,8 +1421,18 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
         raise Unsupported(f"model {model!r} has no device spec")
 
     t0 = time.monotonic()
-    prep = history if isinstance(history, PreparedHistory) else prepare(history)
     backend_name = jax.default_backend()
+    if (not isinstance(history, PreparedHistory)
+            and getattr(spec, "encode_op", None) is None):
+        fast = _check_fast(
+            model, spec, history, max_states=max_states,
+            max_open_bits=max_open_bits,
+            target_returns_per_segment=target_returns_per_segment,
+            localize=localize, mesh=mesh, mesh_axis=mesh_axis,
+            backend_name=backend_name, t0=t0)
+        if fast is not None:
+            return fast
+    prep = history if isinstance(history, PreparedHistory) else prepare(history)
     if not prep.calls:
         return {"valid?": True, "op_count": 0, "backend": backend_name,
                 "engine": "wgl_seg"}
@@ -1283,16 +1446,6 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     M = 1 << pl.max_open
     t_plan = time.monotonic() - t0
 
-    sharded = False
-    K_run = K
-    if mesh is not None and mesh_axis is not None:
-        # pad the segment axis up to a mesh-size multiple — the plan
-        # does NOT guarantee divisibility, and all-padding segments
-        # (ret -1, no candidates) are identity transfer matrices
-        m = int(mesh.shape[mesh_axis])
-        K_run = ((K + m - 1) // m) * m
-        sharded = True
-
     # Register-delta kernel for segments (one lane per segment, J=Sn
     # entry states) under the same gate as the batch path; the
     # candidate-table kernel is the fallback.
@@ -1300,23 +1453,19 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     decomposed = pl.diag_w is not None
     U = pl.legal.shape[0]
     if pl.seg_fk is not None and _regs_eligible(R, U, Sn, decomposed):
-        I = min(2, R) if R else 1
-        batch_fk = [(k, fk) for k, fk in enumerate(pl.seg_fk)]
-        ret_t, islot_t, iuop_t, Lp = _pack_regs(
-            batch_fk, K_run, R, int(U), I)
-        a1t, a2t, t0t = _pack_uop_tables(
-            pl.legal, pl.next_state, pl.diag_w, pl.const_w, pl.const_t0)
-        unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
-        kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
-                                  int(Sn), R, decomposed,
-                                  rounds=R, unroll=unroll, J=int(Sn))
-        args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
-        if sharded:
-            args = _shard_args(mesh, mesh_axis, args, 3)
-        t1 = time.monotonic()
-        T = np.asarray(kern(*args))[:K] > 0.5                # [K, Sn, Sn]
-        t_kernel = time.monotonic() - t1
+        T, t_kernel, sharded = _run_seg_regs(
+            pl.seg_fk, K, R, U, Sn, M, pl.legal, pl.next_state,
+            pl.diag_w, pl.const_w, pl.const_t0, mesh, mesh_axis)
     else:
+        sharded = False
+        K_run = K
+        if mesh is not None and mesh_axis is not None:
+            # pad the segment axis up to a mesh-size multiple — the plan
+            # does NOT guarantee divisibility, and all-padding segments
+            # (ret -1, no candidates) are identity transfer matrices
+            m = int(mesh.shape[mesh_axis])
+            K_run = ((K + m - 1) // m) * m
+            sharded = True
         ret_slot, cand_slot, cand_uop = \
             pl.ret_slot, pl.cand_slot, pl.cand_uop
         if K_run != K:
@@ -1339,15 +1488,7 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
         T = np.asarray(kern(*args))[:K] > 0.5                # [K, Sn, Sn]
         t_kernel = time.monotonic() - t1
 
-    # Compose transfer matrices left-to-right on host (K tiny matvecs).
-    v = np.zeros(Sn, bool)
-    v[0] = True
-    dead_segment = -1
-    for k in range(K):
-        v = v @ T[k]
-        if not v.any():
-            dead_segment = k
-            break
+    dead_segment = _compose_transfer(T, Sn)
 
     result: dict[str, Any] = {
         "valid?": dead_segment < 0,
