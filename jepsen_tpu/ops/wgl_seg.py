@@ -345,14 +345,17 @@ def _pad_len(x: int) -> int:
     return _next_pow2(x) if x <= 64 else ((x + 63) // 64) * 64
 
 
-def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool) -> bool:
+def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool,
+                   r_cap: int = 6) -> bool:
     """One gate for the register-delta kernel, shared by check() and
     check_many() so single-history and batch cannot silently diverge:
-    fixed rounds stay exact and compile small only for R <= 6, the uop
-    index must fit int16, and the transition form must fit the
+    fixed rounds stay exact and compile small only for R <= r_cap, the
+    uop index must fit int16, and the transition form must fit the
     decomposed (Sn <= 32) or nibble (Sn <= 8) tables.  The Pallas /
-    dynamic-rounds toggles imply the candidate-table path."""
-    return (R <= 6 and U <= 32767
+    dynamic-rounds toggles imply the candidate-table path.  (The
+    crashed-call path passes r_cap=8: its extra permanent slots are
+    worth a bigger compile.)"""
+    return (R <= r_cap and U <= 32767
             and ((decomposed and Sn <= 32)
                  or (not decomposed and Sn <= 8))
             and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
@@ -360,22 +363,37 @@ def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool) -> bool:
             and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
 
 
+# Crashed-call tolerance of the fast single-history path: each crashed
+# call doubles the entry-config axis (J = Sn * 2^nc), so cap it low —
+# histories beyond the cap fall back to the serial/CPU engines.
+_MAX_CRASHED = 4
+
+
 class _FastKey:
     """One batchable key, produced by a single fused host pass:
     rets[r] = (slot, [(open_slot, open_uop), ...]) per return event —
     or, from the native scanner, the same data as flat int32 arrays
     (ret_slots, cand_counts, cand_slots, cand_uops).  `cuts[r]` marks
-    returns after which the key is QUIESCENT (zero open calls) — the
-    segmentation points the batch engine parallelizes across."""
+    returns after which the key is QUIESCENT (zero open NORMAL calls) —
+    the segmentation points the batch engine parallelizes across.
 
-    __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts")
+    Crashed-tolerant scans additionally set `nc` (crashed-call count)
+    and `rn` (first crashed slot = max normal open): crashed calls hold
+    permanent slots rn..rn+nc-1 and appear in every snapshot from their
+    invoke onward."""
 
-    def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None):
+    __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts",
+                 "nc", "rn")
+
+    def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None,
+                 nc=0, rn=None):
         self.rets = rets
         self.max_open = max_open
         self.n_calls = n_calls
         self.arrays = arrays
         self.cuts = cuts
+        self.nc = nc
+        self.rn = rn
 
     @property
     def n_rets(self):
@@ -406,15 +424,20 @@ def _native_scan(ops: list, spec, seen: dict, rows: list,
 
 
 def _fast_scan(history, spec, seen: dict, rows: list,
-               max_open_bits: int):
+               max_open_bits: int, max_crashed: int = 0):
     """Fused pairing + slot assignment + op interning for one key —
     ONE pass over the ops instead of prepare() + _assign_slots() +
     _encode_calls() building per-op objects (the host side dominated
     multi-key bench wall time).  Returns a _FastKey, or None when the
-    key is outside the batch engine's scope (crashed calls, too-deep
-    concurrency, un-internable ops, custom encode_op) — the caller
-    sends those through the slow path.  Shared seen/rows are only
-    touched on success."""
+    key is outside the batch engine's scope (crashed calls beyond
+    `max_crashed`, too-deep concurrency, un-internable ops, custom
+    encode_op) — the caller sends those through the slow path.  Shared
+    seen/rows are only touched on success.
+
+    With `max_crashed > 0`, up to that many crashed (:info / unpaired)
+    calls are tolerated: each holds a permanent slot above the normal
+    range (see _FastKey.nc/.rn) and joins every snapshot from its
+    invoke onward; quiescent cuts count NORMAL open calls only."""
     if getattr(spec, "encode_op", None) is not None:
         return None                  # custom encodings take the slow path
     ops = history.ops if isinstance(history, History) else \
@@ -441,7 +464,7 @@ def _fast_scan(history, spec, seen: dict, rows: list,
             ip = open_by_process.pop(p, None)
             if ip is not None:
                 fate[ip] = o
-    if open_by_process:
+    if open_by_process and max_crashed == 0:
         return None                  # unpaired invokes stay open: crashed
     if n_client == 0:
         return _FastKey([], 0, 0)
@@ -454,6 +477,7 @@ def _fast_scan(history, spec, seen: dict, rows: list,
     slot_of: dict = {}
     uop_of: dict = {}
     open_list: list = []
+    crashed_list: list = []          # [(temp slot -2-j, uop), ...]
     rets: list = []
     cuts: list = []
     max_open = 0
@@ -466,11 +490,14 @@ def _fast_scan(history, spec, seen: dict, rows: list,
         t = o.type
         if t == "invoke":
             comp = fate.get(pos)
-            if comp is None or comp.type == "info":
-                return None          # crashed call
-            if comp.type == "fail":
+            crashed = comp is None or comp.type == "info"
+            if crashed and (max_crashed == 0
+                            or len(crashed_list) >= max_crashed):
+                return None          # crashed call (or too many)
+            if not crashed and comp.type == "fail":
                 continue             # the pair never happened: dropped
-            v = o.value if o.value is not None else comp.value
+            v = o.value if (o.value is not None or comp is None) \
+                else comp.value
             fc = f_codes.get(o.f, -1)
             if fc < 0:
                 return None          # model has no f-code for this op
@@ -497,6 +524,11 @@ def _fast_scan(history, spec, seen: dict, rows: list,
             if u is None:
                 u = new_seen[key] = len(rows) + len(new_rows)
                 new_rows.append(key)
+            if crashed:
+                # permanent pseudo-slot, remapped to rn+j at the end
+                crashed_list.append((-2 - len(crashed_list), u))
+                n_calls += 1
+                continue
             s = free.pop() if free else next_slot
             if s == next_slot:
                 next_slot += 1
@@ -513,7 +545,7 @@ def _fast_scan(history, spec, seen: dict, rows: list,
             if s is None:
                 continue
             rets.append((s, [(slot_of[q], uop_of[q])
-                             for q in open_list]))
+                             for q in open_list] + list(crashed_list)))
             open_list.remove(p)
             del slot_of[p]
             del uop_of[p]
@@ -522,6 +554,14 @@ def _fast_scan(history, spec, seen: dict, rows: list,
 
     seen.update(new_seen)
     rows.extend(new_rows)
+    nc = len(crashed_list)
+    if nc:
+        # remap crashed pseudo-slots above the normal range
+        rn = max_open
+        rets = [(s, [(q if q >= 0 else rn + (-2 - q), u)
+                     for q, u in cands]) for s, cands in rets]
+        return _FastKey(rets, max_open, n_calls,
+                        cuts=np.asarray(cuts, np.int32), nc=nc, rn=rn)
     return _FastKey(rets, max_open, n_calls,
                     cuts=np.asarray(cuts, np.int32))
 
@@ -805,7 +845,7 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 @functools.lru_cache(maxsize=32)
 def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                        decomposed: bool, rounds: int, unroll: int,
-                       J: int = 1):
+                       J: int = 1, nc: int = 0, rn: int = 0):
     """Register-delta variant of the bit-packed batch kernel (J=1 for
     independent whole histories; J=Sn computes per-segment transfer
     matrices for the single-history path, one lane per segment).
@@ -831,7 +871,19 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
     to _build_kernel_bits (see its docstring); this builder only
     supports fixed rounds (callers gate R <= 6 to the candidate-table
     dynamic loop).  Transition tables are [U]-indexed on device (tiny
-    per-step gathers) in the same decomposed / nibble forms."""
+    per-step gathers) in the same decomposed / nibble forms.
+
+    With `nc > 0` (crashed-call support, J = Sn * 2^nc): crashed calls
+    hold permanent slots rn..rn+nc-1 — registered like invokes, never
+    retired, free to linearize at any return's closure or never.  Lane
+    entry/exit configurations become (crashed-linearized-mask x state)
+    pairs: fr0 seeds one entry config per J index (j = cm * Sn + s,
+    mask = cm << rn), and the output reads the 2^nc crashed-mask planes
+    at zero normal bits, giving [K, J, 2^nc * Sn] transfer matrices.
+    This removes the reference's worst scaling cliff — knossos treats a
+    crashed op as concurrent with the entire rest of the history
+    (doc/tutorial/06-refining.md:12-19); here it costs 2^nc extra
+    frontier width instead of exponential search."""
     import jax
     import jax.numpy as jnp
 
@@ -842,11 +894,17 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
     def kern(ret_slot, inv_slot, inv_uop, aux1_tab, aux2_tab, t0_tab):
         # ret_slot [L, K] i8; inv_slot/inv_uop [L, K, I] i8/i16;
         # aux1_tab/aux2_tab [U] u32, t0_tab [U] i32.
-        if J == Sn:
-            # one lane per (segment, entry state): transfer matrices
-            fr0 = jnp.zeros((Wd, Sn, J, K), u32).at[0].set(
-                (jnp.eye(Sn, dtype=u32)[:, :, None]
-                 * jnp.ones((1, 1, K), u32)))
+        if J > 1:
+            # one lane per (segment, entry config): j = cm * Sn + s with
+            # mask cm << rn (cm = 0 when nc = 0, reducing to the eye)
+            fr0_np = np.zeros((Wd, 32, Sn, J), np.uint32)
+            for cm in range(1 << nc):
+                m0 = cm << rn
+                for s in range(Sn):
+                    fr0_np[m0 // 32, m0 % 32, s, cm * Sn + s] = 1
+            fr0_np = (fr0_np << np.arange(32, dtype=np.uint32)
+                      [None, :, None, None]).sum(1, dtype=np.uint32)
+            fr0 = jnp.asarray(fr0_np)[..., None] * jnp.ones((K,), u32)
         else:
             fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, 0, 0, :].set(1)
         reg0 = (jnp.zeros((R, K), u32), jnp.zeros((R, K), u32),
@@ -913,7 +971,16 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
         (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0,
                                    (ret_slot, inv_slot, inv_uop),
                                    unroll=unroll)
-        return (fr[0] & 1).transpose(2, 1, 0)          # [K, J, Sn]
+        if nc == 0:
+            return (fr[0] & 1).transpose(2, 1, 0)      # [K, J, Sn]
+        # read the 2^nc crashed-mask planes at zero normal bits
+        planes = []
+        for cm in range(1 << nc):
+            m = cm << rn
+            planes.append((fr[m // 32] >> np.uint32(m % 32)) & 1)
+        out = jnp.stack(planes)                        # [2^nc, Sn, J, K]
+        return out.transpose(3, 2, 0, 1).reshape(
+            K, J, (1 << nc) * Sn)                      # j' = cm*Sn + s
 
     return jax.jit(kern)
 
@@ -1272,9 +1339,10 @@ def _shard_args(mesh, mesh_axis: str, args: list, n_sharded: int):
 
 def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
                   legal, next_state, diag_w, const_w, const_t0,
-                  mesh, mesh_axis):
-    """Run the J=Sn register-delta kernel over per-segment lanes.
-    Returns (T bool [K, Sn, Sn], t_kernel, sharded) — shared by the
+                  mesh, mesh_axis, nc: int = 0, rn: int = 0):
+    """Run the register-delta kernel over per-segment lanes with
+    J = Sn * 2^nc entry configurations (nc = crashed-call count).
+    Returns (T bool [K, J, J], t_kernel, sharded) — shared by the
     plan()-based and fast-scan single-history paths."""
     sharded = False
     K_run = K
@@ -1297,11 +1365,12 @@ def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
     unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
     kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
                               int(Sn), R, decomposed,
-                              rounds=R, unroll=unroll, J=int(Sn))
+                              rounds=R, unroll=unroll,
+                              J=int(Sn) << nc, nc=nc, rn=rn)
     args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
     if sharded:
         args = _shard_args(mesh, mesh_axis, args, 3)
-    T = np.asarray(kern(*args))[:K] > 0.5                    # [K, Sn, Sn]
+    T = np.asarray(kern(*args))[:K] > 0.5                    # [K, J, J]
     return T, time.monotonic() - t1, sharded
 
 
@@ -1333,10 +1402,17 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     if fk is False:
         fk = _fast_scan(history, spec, seen, rows, max_open_bits)
     if fk is None:
+        # crashed (:info / unpaired) calls: retry with the
+        # crash-tolerant scan (Python twin; permanent high slots)
+        fk = _fast_scan(history, spec, seen, rows, max_open_bits,
+                        max_crashed=_MAX_CRASHED)
+    if fk is None:
         return None
     if fk.n_calls == 0:
         return {"valid?": True, "op_count": 0, "backend": backend_name,
                 "engine": "wgl_seg"}
+    nc = int(fk.nc)
+    rn = int(fk.rn) if fk.rn is not None else int(fk.max_open)
     uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
     init = np.asarray(spec.encode(model), np.int32)
     try:
@@ -1345,10 +1421,13 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     except Unsupported:
         return None
     Sn = states.shape[0]
-    R = int(fk.max_open)
+    R = rn + nc if nc else int(fk.max_open)
     diag_w, const_w, const_t0 = _decompose(legal, next_state)
-    if not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None):
+    if not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None,
+                          r_cap=8 if nc else 6):
         return None
+    if (Sn << nc) > 128:
+        return None                  # entry-config axis too wide
 
     # segment at quiescent cuts, >= target returns per segment
     rs, counts, cs, cu = _fk_arrays(fk)
@@ -1372,8 +1451,8 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
 
     T, t_kernel, sharded = _run_seg_regs(
         seg_fk, K, R, legal.shape[0], Sn, 1 << R, legal, next_state,
-        diag_w, const_w, const_t0, mesh, mesh_axis)
-    dead_segment = _compose_transfer(T, Sn)
+        diag_w, const_w, const_t0, mesh, mesh_axis, nc=nc, rn=rn)
+    dead_segment = _compose_transfer(T, Sn << nc)
 
     result: dict[str, Any] = {
         "valid?": dead_segment < 0,
@@ -1386,6 +1465,8 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         "time_plan_s": t_plan,
         "time_kernel_s": t_kernel,
     }
+    if nc:
+        result["crashed"] = nc
     if dead_segment >= 0:
         result["anomaly"] = "nonlinearizable"
         result["dead_segment"] = dead_segment
