@@ -41,11 +41,22 @@ as `ops.wgl`:
     a return, so any linearization between returns can be deferred to
     the closure of the next return event.
 
-Scope guard: histories with crashed (`:info`) calls or models whose
-state space does not close within `max_states` raise `Unsupported`, and
-callers fall back to `ops.wgl` / `ops.wgl_cpu`.  (A crashed call stays
-open forever — `doc/tutorial/06-refining.md:12-19` — so no cut is ever
-quiescent and state alone no longer summarizes a prefix.)
+Crashed (`:info`) calls — the reference's worst cost driver ("a couple
+crashed processes can make the difference between seconds and days",
+`doc/tutorial/06-refining.md:12-19`, `doc/tutorial/07-parameters.md:150-152`)
+— are handled in three exact tiers (see _check_crashed_fast): inert
+crashed calls (identity + always-legal, e.g. reads) are dropped
+outright; up to `_MAX_CRASHED` remaining crashed calls ride the kernel
+as permanent mask slots with a `J = Sn * 2^nc` entry-configuration axis
+(cuts count open NORMAL calls only — "quiescent modulo crashed");
+beyond the bound, a valid verdict on the crash-stripped history proves
+validity at full speed (crashed calls carry no obligation).  Only the
+residual case — many effect-bearing crashes on a history the stripped
+pass cannot prove valid — falls back to the serial engines.
+
+Scope guard: models whose state space does not close within
+`max_states` (and the residual crash case above) raise `Unsupported`,
+and callers fall back to `ops.wgl` / `ops.wgl_cpu`.
 
 Verdict trust: both verdicts are exact (no frontier capacity exists to
 overflow — the bitmap covers the whole configuration space).  On
@@ -66,6 +77,8 @@ import numpy as np
 from jepsen_tpu.history import History
 from jepsen_tpu.models import DeviceSpec
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
+from jepsen_tpu.ops.frontier import (make_plane_ops as _bit_ops,
+                                     reshape_shift as _reshape_shift)
 
 
 class Unsupported(ValueError):
@@ -591,24 +604,6 @@ def _assign_slots(events):
     return rets, next_slot, open_calls
 
 
-def _reshape_shift(x, hi: int, lo: int, set_bit: bool):
-    """Move frontier content across one bit of the axis at position -4
-    by reshaping it to (hi, 2, lo): set_bit moves the bit-clear half to
-    the bit-set half (linearize), else the reverse (prune + retire).
-    Shared by the dense kernel (mask axis) and the bit-packed kernel
-    (word axis)."""
-    import jax.numpy as jnp
-
-    xs = x.reshape(x.shape[:-4] + (hi, 2, lo) + x.shape[-3:])
-    if set_bit:
-        half = xs[..., :, 0:1, :, :, :, :]
-        y = jnp.concatenate([jnp.zeros_like(half), half], axis=-5)
-    else:
-        half = xs[..., :, 1:2, :, :, :, :]
-        y = jnp.concatenate([half, jnp.zeros_like(half)], axis=-5)
-    return y.reshape(x.shape)
-
-
 def _decompose(legal: np.ndarray, next_state: np.ndarray):
     """Diagonal + rank-1 decomposition (see SegPlan): decomposable iff
     each op's state-changing transitions all target one state.  Returns
@@ -637,57 +632,6 @@ def _decompose(legal: np.ndarray, next_state: np.ndarray):
 # ---------------------------------------------------------------------------
 # Device kernel — bit-packed mask axis
 # ---------------------------------------------------------------------------
-
-# Intra-word "lacks bit b" patterns: bit i is set iff mask-index i has
-# bit b clear (i & (1<<b) == 0).
-_INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
-
-
-def _bit_ops(Wd: int, R: int):
-    """The frontier bit algebra shared by the bit-packed kernels: slot
-    bits 0-4 live within each uint32 word (constant-pattern masks and
-    shifts), slots >= 5 shift whole words along the word axis.  Returns
-    (lacking, set_slot, retire_slot, sel32) closures over frontier
-    tensors shaped [Wd, Sn, J, K]."""
-    import jax.numpy as jnp
-
-    FULL = np.uint32(0xFFFFFFFF)
-    Whalf = [(Wd >> (b + 1), 1 << b) for b in range(max(R - 5, 0))]
-    word_iota = np.arange(Wd, dtype=np.int32)
-
-    def word_lack(b):
-        """uint32 [Wd] mask: FULL where word index lacks bit b-5."""
-        return jnp.asarray(
-            np.where((word_iota >> (b - 5)) & 1 == 0, FULL, 0),
-            jnp.uint32)
-
-    def lacking(x, b):
-        """Configs in x whose mask lacks slot b."""
-        if b < 5:
-            return x & np.uint32(_INTRA[b])
-        return x & word_lack(b)[:, None, None, None]
-
-    def set_slot(x, b):
-        """Linearize slot b: configs lacking it move to mask|bit."""
-        if b < 5:
-            return (x & np.uint32(_INTRA[b])) << (1 << b)
-        return _reshape_shift(x & word_lack(b)[:, None, None, None],
-                              *Whalf[b - 5], set_bit=True)
-
-    def retire_slot(x, b):
-        """Prune configs lacking slot b, clear the bit on the rest."""
-        if b < 5:
-            return (x & np.uint32(~np.uint32(_INTRA[b]))) >> (1 << b)
-        keep = x & (~word_lack(b))[:, None, None, None]
-        return _reshape_shift(keep, *Whalf[b - 5], set_bit=False)
-
-    def sel32(cond):
-        """bool -> uint32 FULL/0 select mask."""
-        return jnp.where(cond, jnp.asarray(FULL),
-                         jnp.asarray(np.uint32(0)))
-
-    return lacking, set_slot, retire_slot, sel32
-
 
 @functools.lru_cache(maxsize=32)
 def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
@@ -1386,14 +1330,165 @@ def _compose_transfer(T: np.ndarray, Sn: int) -> int:
     return -1
 
 
+def _split_crashed(ops):
+    """One host pass over a key's ops: find crashed client calls
+    (:info completion, or invoke with no completion).  Returns
+    (drop bool[n], crashed) where drop marks crashed invokes and their
+    :info completions and crashed lists (inv_pos, info_pos | -1, op) in
+    invocation order — or None for malformed histories (double invoke),
+    which the slow path's prepare() rejects with the descriptive
+    error."""
+    open_by_process: dict = {}
+    info_of: dict = {}
+    for pos, o in enumerate(ops):
+        p = o.process
+        if not (type(p) is int and p >= 0):
+            continue
+        if o.type == "invoke":
+            if p in open_by_process:
+                return None
+            open_by_process[p] = pos
+        else:
+            ip = open_by_process.pop(p, None)
+            if ip is not None and o.type == "info":
+                info_of[ip] = pos
+    crashed_pos = sorted(set(open_by_process.values()) | set(info_of))
+    drop = np.zeros(len(ops), bool)
+    crashed = []
+    for ip in crashed_pos:
+        cp = info_of.get(ip, -1)
+        drop[ip] = True
+        if cp >= 0:
+            drop[cp] = True
+        crashed.append((ip, cp, ops[ip]))
+    return drop, crashed
+
+
+def _check_crashed_fast(model, spec, history, *, max_states,
+                        max_open_bits, target_returns_per_segment,
+                        localize, mesh, mesh_axis, backend_name, t0):
+    """Crash-bearing histories on the segment-parallel engine, in three
+    exact tiers (a crashed call may be linearized at any point after
+    its invoke, or never — `doc/tutorial/06-refining.md:12-19`):
+
+      1. *Inert-crash dropping.*  A crashed call whose op is identity
+         and always-legal on every reachable state (e.g. a read: its
+         result is unknown, so it constrains nothing) can be removed
+         outright — linearizing it changes no configuration, and no
+         witness is obliged to linearize it.  Exact in both directions.
+      2. *Bounded crash kernel.*  If <= _MAX_CRASHED non-inert crashed
+         calls remain, the register-delta kernel carries them as
+         permanent mask slots (J = Sn * 2^nc entry configurations; see
+         _build_kernel_regs).  Exact.
+      3. *Crash-stripped validity proof.*  Beyond the bound, check the
+         history with ALL crashed calls removed: crashed calls carry no
+         obligation, so a linearization that never linearizes one is a
+         linearization of the full history — stripped-valid => valid,
+         at full engine speed for ANY number of crashes.  A stripped-
+         invalid verdict proves nothing (a crashed write may need to
+         take effect), so it returns None and callers fall back to the
+         serial engines, which handle crashes exactly.
+    """
+    from jepsen_tpu.ops.wgl import _generic_encode_op
+
+    ops = history.ops if isinstance(history, History) else \
+        History(history).ops
+    split = _split_crashed(ops)
+    if split is None:
+        return None
+    drop, crashed = split
+    if not crashed:
+        return None              # scan failed for a non-crash reason
+
+    stripped = [o for pos, o in enumerate(ops) if not drop[pos]]
+    seen: dict = {}
+    rows: list = []
+    fk = _native_scan(stripped, spec, seen, rows, max_open_bits)
+    if fk is False:
+        fk = _fast_scan(stripped, spec, seen, rows, max_open_bits)
+    if fk is None:
+        return None              # stripped key still out of scope
+
+    # Intern the crashed ops alongside the stripped key's ops so the
+    # state space closes over BOTH, then classify inertness.
+    crash_uop = []
+    INT32 = 2 ** 31
+    for _, _, o in crashed:
+        fc, av, bv, okv = _generic_encode_op(o, spec.f_codes)
+        if fc < 0 or not (-INT32 <= av < INT32 and -INT32 <= bv < INT32):
+            crash_uop.append(-1)     # unencodable: never inert
+            continue
+        key = (fc, av, bv, okv)
+        u = seen.get(key)
+        if u is None:
+            u = seen[key] = len(rows)
+            rows.append(key)
+        crash_uop.append(u)
+    uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
+    init = np.asarray(spec.encode(model), np.int32)
+    try:
+        _, legal, next_state = _enumerate_states(
+            spec, init, uops, max_states)
+    except Unsupported:
+        return None
+    eye = np.arange(legal.shape[1])
+    inert = [u >= 0 and bool(legal[u].all())
+             and bool((next_state[u] == eye).all())
+             for u in crash_uop]
+
+    n_inert = sum(inert)
+    if len(crashed) - n_inert <= _MAX_CRASHED:
+        # Exact: drop only the inert crashed calls; the bounded kernel
+        # carries the rest.
+        if n_inert:
+            red_drop = np.zeros(len(ops), bool)
+            for (ip, cp, _), isin in zip(crashed, inert):
+                if isin:
+                    red_drop[ip] = True
+                    if cp >= 0:
+                        red_drop[cp] = True
+            reduced = [o for pos, o in enumerate(ops)
+                       if not red_drop[pos]]
+        else:
+            reduced = ops
+        res = _check_fast(
+            model, spec, History(reduced), max_states=max_states,
+            max_open_bits=max_open_bits,
+            target_returns_per_segment=target_returns_per_segment,
+            localize=localize, mesh=mesh, mesh_axis=mesh_axis,
+            backend_name=backend_name, t0=t0,
+            max_crashed=_MAX_CRASHED, escalate=False)
+        if res is not None:
+            if n_inert:
+                res["crashed_dropped"] = n_inert
+            return res
+        # tier 2 ineligible (e.g. Sn << nc too wide): fall through to
+        # the stripped validity proof rather than straight to serial.
+
+    # Beyond the bounded kernel's reach: a valid verdict on the fully-
+    # stripped history is a valid verdict on the original.
+    res = _check_fast(
+        model, spec, History(stripped), max_states=max_states,
+        max_open_bits=max_open_bits,
+        target_returns_per_segment=target_returns_per_segment,
+        localize=False, mesh=mesh, mesh_axis=mesh_axis,
+        backend_name=backend_name, t0=t0, escalate=False)
+    if res is not None and res.get("valid?") is True:
+        res["crashed_ignored"] = len(crashed)
+        return res
+    return None
+
+
 def _check_fast(model, spec, history, *, max_states, max_open_bits,
                 target_returns_per_segment, localize, mesh, mesh_axis,
-                backend_name, t0):
+                backend_name, t0, max_crashed: int = 0,
+                escalate: bool = True):
     """Single-history fast path: one fused host scan (the native C
     scanner when available) straight into per-segment register-delta
-    lanes — no per-op Python objects.  Returns None when out of scope
-    (crashed calls, non-eligible models, custom encodings) so check()
-    takes the plan() route, which raises the descriptive Unsupported."""
+    lanes — no per-op Python objects.  Crash-bearing histories escalate
+    to _check_crashed_fast (inert dropping / bounded kernel / stripped
+    validity proof).  Returns None when out of scope so check() takes
+    the plan() route, which raises the descriptive Unsupported."""
     seen: dict = {}
     rows: list = []
     ops = history.ops if isinstance(history, History) else \
@@ -1401,12 +1496,18 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     fk = _native_scan(ops, spec, seen, rows, max_open_bits)
     if fk is False:
         fk = _fast_scan(history, spec, seen, rows, max_open_bits)
-    if fk is None:
-        # crashed (:info / unpaired) calls: retry with the
+    if fk is None and max_crashed:
         # crash-tolerant scan (Python twin; permanent high slots)
         fk = _fast_scan(history, spec, seen, rows, max_open_bits,
-                        max_crashed=_MAX_CRASHED)
+                        max_crashed=max_crashed)
     if fk is None:
+        if escalate:
+            return _check_crashed_fast(
+                model, spec, history, max_states=max_states,
+                max_open_bits=max_open_bits,
+                target_returns_per_segment=target_returns_per_segment,
+                localize=localize, mesh=mesh, mesh_axis=mesh_axis,
+                backend_name=backend_name, t0=t0)
         return None
     if fk.n_calls == 0:
         return {"valid?": True, "op_count": 0, "backend": backend_name,
@@ -1485,10 +1586,12 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
           localize: bool = True, mesh=None,
           mesh_axis: Optional[str] = None) -> dict[str, Any]:
     """Segment-parallel linearizability check.  Returns a knossos-shaped
-    analysis map (same keys as ops.wgl.check).  Raises Unsupported when
-    the history/model falls outside this engine's scope (crashed calls,
-    large state spaces, deep concurrency) — callers fall back to
-    ops.wgl.check / ops.wgl_cpu.check.
+    analysis map (same keys as ops.wgl.check).  Crashed (:info) calls
+    are handled exactly (inert dropping / bounded crash kernel /
+    stripped validity proof — see _check_crashed_fast).  Raises
+    Unsupported when the history/model falls outside this engine's
+    scope (large state spaces, deep concurrency, residual many-crash
+    histories) — callers fall back to ops.wgl.check / ops.wgl_cpu.check.
 
     With `mesh`/`mesh_axis`, ONE history's segment axis is sharded over
     the devices (SURVEY.md §5 long-context: "sharding the DFS/BFS
@@ -1803,18 +1906,47 @@ def check_many(model, histories, *, max_states: int = 64,
     rows: list = []
     batch: list = []        # (key index, _FastKey)
     fall: list = []
+    stripped_note: dict = {}  # key idx -> crash count (stripped twin batched)
     native_ok = getattr(spec, "encode_op", None) is None
     for i, h in enumerate(histories):
         if isinstance(h, PreparedHistory):
             fall.append(i)  # pre-prepped callers take the slow path
             continue
+        ops = h.ops if isinstance(h, History) else History(h).ops
         fk = False
         if native_ok:
-            ops = h.ops if isinstance(h, History) else History(h).ops
             fk = _native_scan(ops, spec, seen, rows, max_open_bits)
         if fk is False:              # no extension: Python twin
             fk = _fast_scan(h, spec, seen, rows, max_open_bits)
         if fk is None:
+            # Crashed keys ride the batch as their crash-stripped twin:
+            # stripped-valid => valid (a crashed call carries no
+            # obligation, so a linearization that never linearizes one
+            # is a linearization of the full key).  Keys the stripped
+            # pass cannot prove valid are re-checked exactly afterwards
+            # (bounded crash kernel via check(), then serial fallback).
+            split = _split_crashed(ops)
+            if split is not None and split[1]:
+                drop, crashed = split
+                stripped = [o for pos, o in enumerate(ops)
+                            if not drop[pos]]
+                sfk = _native_scan(stripped, spec, seen, rows,
+                                   max_open_bits) if native_ok else False
+                if sfk is False:
+                    sfk = _fast_scan(stripped, spec, seen, rows,
+                                     max_open_bits)
+                if sfk is not None and sfk.n_calls:
+                    stripped_note[i] = len(crashed)
+                    batch.append((i, sfk))
+                    continue
+                if sfk is not None:
+                    # every client call crashed: trivially linearizable
+                    # (linearize none of them)
+                    results[i] = {"valid?": True, "op_count": 0,
+                                  "backend": backend_name,
+                                  "engine": "wgl_seg_batch",
+                                  "crashed_ignored": len(crashed)}
+                    continue
             fall.append(i)
         elif fk.n_calls == 0:
             results[i] = {"valid?": True, "op_count": 0,
@@ -1861,7 +1993,7 @@ def check_many(model, histories, *, max_states: int = 64,
                 _emit_batch_result(results, i, fk, bool(ok_b[bi]),
                                    backend_name, "wgl_seg_batch",
                                    t_kernel, model, histories,
-                                   localize)
+                                   localize and i not in stripped_note)
             batch = []
 
     if batch:
@@ -1900,7 +2032,8 @@ def check_many(model, histories, *, max_states: int = 64,
             for kk, (i, fk) in enumerate(batch):
                 _emit_batch_result(results, i, fk, bool(ok_k[kk]),
                                    backend_name, engine_name, t_kernel,
-                                   model, histories, localize)
+                                   model, histories,
+                                   localize and i not in stripped_note)
             batch = []
 
     if batch:
@@ -1979,7 +2112,31 @@ def check_many(model, histories, *, max_states: int = 64,
         for kk, (i, fk) in enumerate(batch):
             _emit_batch_result(results, i, fk, bool(ok_k[kk]),
                                backend_name, engine_name, t_kernel,
-                               model, histories, localize)
+                               model, histories,
+                               localize and i not in stripped_note)
+
+    if stripped_note:
+        # Crash-bearing keys: a valid verdict on the stripped twin IS
+        # the verdict; anything else gets the exact single-key chain
+        # (inert dropping + bounded crash kernel), then the serial
+        # fallback below.  Keys already routed to `fall` (e.g. the
+        # whole batch bailed on state enumeration) are left to it.
+        in_fall = set(fall)
+        for i, nc in stripped_note.items():
+            if i in in_fall:
+                continue
+            r = results[i]
+            if r is not None and r.get("valid?") is True:
+                r["crashed_ignored"] = nc
+                continue
+            try:
+                results[i] = check(model, histories[i],
+                                   max_states=max_states,
+                                   max_open_bits=max_open_bits,
+                                   localize=localize)
+            except Unsupported:
+                results[i] = None
+                fall.append(i)
 
     if fall:
         if fallback is None:
