@@ -19,7 +19,7 @@ from jepsen_tpu import control, db as db_mod
 from jepsen_tpu import generator as gen
 from jepsen_tpu.history import History, Op, op as to_op
 from jepsen_tpu.util import (fcatch, log_op, real_pmap, relative_time_nanos,
-                             with_relative_time)
+                             timeout as util_timeout, with_relative_time)
 
 log = logging.getLogger("jepsen")
 
@@ -77,11 +77,53 @@ class Worker:
         pass
 
 
+class InvokeTimeout(Exception):
+    """A client.invoke exceeded the test's :invoke-timeout bound."""
+
+
+def _bounded_invoke(client, test, op: Op, seconds: float):
+    """client.invoke with a wall-clock bound.  On timeout the invoking
+    thread is abandoned (exactly like util.timeout and the reference's
+    interrupt-based worker deadline, generator.clj:415-530) and
+    InvokeTimeout is raised — the caller converts it to an :info
+    completion and the worker recycles the process, so one hung client
+    can no longer overrun a generator time_limit indefinitely.  A late
+    result from the abandoned thread is discarded, which is sound: the
+    op is already journaled :info (indeterminate, may or may not have
+    taken effect)."""
+    box: list = [None]
+    err: list = [None]
+    done = threading.Event()
+
+    def run():
+        try:
+            box[0] = client.invoke(test, op)
+        except BaseException as e:  # noqa: BLE001 - re-raised in caller
+            err[0] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True,
+                         name=f"invoke-{op.process}")
+    t.start()
+    if not done.wait(seconds):
+        raise InvokeTimeout(f"invoke timed out after {seconds}s")
+    if err[0] is not None:
+        raise err[0]
+    return box[0]
+
+
 def invoke_op(op: Op, test, client, abort) -> Op:
     """Apply an op to a client, converting exceptions to :info completions
-    — 'indeterminate' (core.clj:199-232)."""
+    — 'indeterminate' (core.clj:199-232).  With test[:invoke-timeout]
+    (seconds) set, each invoke is wall-clock bounded via
+    _bounded_invoke."""
     try:
-        completion = client.invoke(test, op)
+        timeout_s = test.get("invoke_timeout")
+        if timeout_s:
+            completion = _bounded_invoke(client, test, op, timeout_s)
+        else:
+            completion = client.invoke(test, op)
         completion = to_op(completion).assoc(time=relative_time_nanos())
     except BaseException as e:
         if abort.is_set():
@@ -164,7 +206,15 @@ class ClientWorker(Worker):
                     # (core.clj:338-355).
                     self.process += test["concurrency"]
                     try:
-                        self.client.close(test)
+                        # close() on a hung client can block on the same
+                        # dead connection the invoke did — bound it too,
+                        # abandoning the closer thread on timeout.
+                        timeout_s = test.get("invoke_timeout")
+                        if timeout_s:
+                            util_timeout(timeout_s, None,
+                                         self.client.close, test)
+                        else:
+                            self.client.close(test)
                     except Exception:
                         pass
                     self.client = None

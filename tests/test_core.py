@@ -127,6 +127,45 @@ def test_worker_recovery():
     assert any(p >= result["concurrency"] for p in procs)
 
 
+def test_hung_client_bounded_by_invoke_timeout():
+    """A client that blocks forever cannot overrun the test deadline
+    when test[:invoke-timeout] is set: each hung invoke converts to an
+    :info completion at the bound, the process recycles, and the
+    generator's time_limit ends the run (the reference interrupts
+    worker threads instead, generator.clj:415-530)."""
+    import time
+
+    hang = threading.Event()
+
+    class Hanging(client_mod.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            hang.wait(30)            # hangs far beyond the deadline
+            return op.assoc(type="ok")
+
+        def close(self, test):
+            pass
+
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "hung client",
+        "client": Hanging(),
+        "invoke_timeout": 0.2,
+        "generator": gen.nemesis(
+            gen.void, gen.time_limit(1.0, gen.queue_gen())),
+    })
+    t0 = time.monotonic()
+    result = core.run(test)
+    elapsed = time.monotonic() - t0
+    hang.set()
+    assert elapsed < 10, f"run overran the deadline: {elapsed:.1f}s"
+    infos = [o for o in result["history"] if o.is_info]
+    assert infos, "hung invokes must journal :info completions"
+    assert all("timed out" in str(o.error) for o in infos)
+
+
 class TrackingClient(client_mod.Client):
     """core_test.clj tracking-client :19-37."""
 
