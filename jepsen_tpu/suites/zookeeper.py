@@ -81,13 +81,42 @@ class ZkCliConn:
     def _path(self, k) -> str:
         return f"/jepsen-r{k}"
 
-    def get(self, k) -> Optional[int]:
-        out = self._cli("get", self._path(k))
+    _stat_flag: Optional[bool] = None   # True: 3.5+ `get -s`; False: 3.4 `get`
+
+    @staticmethod
+    def _parse_stat(out):
+        value = version = None
         for line in (out or "").splitlines():
             line = line.strip()
-            if line.lstrip("-").isdigit():
-                return int(line)
-        return None
+            if value is None and line.lstrip("-").isdigit():
+                value = int(line)
+            elif line.startswith("dataVersion"):
+                digits = "".join(ch for ch in line if ch.isdigit())
+                if digits:
+                    version = int(digits)
+        return value, version
+
+    def _get_stat(self, k):
+        """(value, dataVersion) in ONE zkCli call — reading them
+        together is what makes cas() atomic (the version identifies the
+        exact state the value was read at).  3.5+ zkCli needs `get -s`
+        to print the Stat; 3.4 (the Debian package this suite installs)
+        prints it by default and would parse `-s` as the znode path —
+        probe once and remember which dialect the node speaks."""
+        if self._stat_flag is not True:
+            out = self._cli("get", self._path(k))
+            value, version = self._parse_stat(out)
+            if version is not None or self._stat_flag is False:
+                self._stat_flag = False
+                return value, version
+        out = self._cli("get", "-s", self._path(k))
+        value, version = self._parse_stat(out)
+        if version is not None:
+            self._stat_flag = True
+        return value, version
+
+    def get(self, k) -> Optional[int]:
+        return self._get_stat(k)[0]
 
     def put(self, k, v) -> None:
         # create first, set on exists: with set-then-create, two first
@@ -99,13 +128,24 @@ class ZkCliConn:
             self._cli("set", path, str(v))
 
     def cas(self, k, old, new) -> bool:
-        # ZooKeeper CAS = conditional set on the version read together
-        # with the value; the shell client can't do that atomically, so
-        # production users should prefer a kazoo-style factory.  The
-        # value check alone is the best a one-shot CLI offers.
-        if self.get(k) != old:
+        """Atomic CAS via ZooKeeper's znode-version conditional set
+        (the same mechanism as zookeeper.clj:68-105): read
+        (value, dataVersion) together, then `set <path> <new> <ver>` —
+        the server applies the write ONLY if the znode is still at that
+        version, rejecting with BadVersion otherwise.  The compare-and-
+        swap therefore linearizes at the server-side set; a plain
+        read-check-put would fabricate linearizability violations under
+        contention and blame ZooKeeper for them."""
+        value, version = self._get_stat(k)
+        if value != old or version is None:
             return False
-        self.put(k, new)
+        out = self._cli("set", self._path(k), str(new), str(version)) or ""
+        low = out.lower()
+        if "badversion" in low or "version no is not valid" in low:
+            return False             # definite: lost the race
+        if "exception" in low or "error" in low:
+            # anything else (connection loss mid-set) is indeterminate
+            raise TimeoutError(out.strip()[:200])
         return True
 
     def close(self):

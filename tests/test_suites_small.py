@@ -171,6 +171,88 @@ class TestRegisterSuites:
             assert result["results"]["valid?"] is True
 
 
+class TestZkVersionedCas:
+    """ZkCliConn.cas must be a znode-version conditional set — a
+    read-check-put would fabricate linearizability violations and blame
+    ZooKeeper (zookeeper.clj:68-105 uses the same versioned mechanism
+    via avout)."""
+
+    def _handler(self, store_, dialect="3.4"):
+        import shlex
+
+        def handler(node, cmd, stdin):
+            if "zkCli.sh" not in cmd:
+                return ""
+            args = shlex.split(cmd)
+            args = args[args.index("-server") + 2:]
+            if args[0] == "get":
+                rest = args[1:]
+                if dialect == "3.4":
+                    # 3.4 parses `-s` as the znode path and always
+                    # prints the Stat
+                    path = rest[0]
+                    if path not in store_:
+                        return "Node does not exist: " + path
+                    v, ver = store_[path]
+                    return f"{v}\ndataVersion = {ver}\n"
+                with_stat = rest[0] == "-s"
+                path = rest[-1]
+                if path not in store_:
+                    return "Node does not exist: " + path
+                v, ver = store_[path]
+                return (f"{v}\ndataVersion = {ver}\n" if with_stat
+                        else f"{v}\n")
+            if args[0] == "create":
+                path, data = args[1], args[2]
+                if path in store_:
+                    return "Node already exists: " + path
+                store_[path] = [data, 0]
+                return "Created " + path
+            if args[0] == "set":
+                path, data = args[1], args[2]
+                if path not in store_:
+                    return "Node does not exist: " + path
+                if len(args) > 3 and int(args[3]) != store_[path][1]:
+                    return "version No is not valid : " + path
+                store_[path] = [data, store_[path][1] + 1]
+                return ""
+            return ""
+        return handler
+
+    @pytest.mark.parametrize("dialect", ["3.4", "3.5"])
+    def test_cas_is_version_conditional(self, dialect):
+        store_ = {}
+        control.set_dummy_handler(self._handler(store_, dialect))
+        try:
+            with control.with_ssh({"dummy": True}):
+                self._drive(store_)
+        finally:
+            control.set_dummy_handler(None)
+
+    def _drive(self, store_):
+        conn = zookeeper.ZkCliConn("n1")
+        conn.put(1, 5)
+        assert conn.get(1) == 5
+        assert conn.cas(1, 5, 7) is True
+        assert conn.get(1) == 7
+        assert conn.cas(1, 5, 9) is False      # wrong expected value
+        assert conn.get(1) == 7
+
+        # A writer slipping in between the read and the set bumps
+        # the version: the conditional set must LOSE, not clobber.
+        real_cli = conn._cli
+
+        def racy(*args):
+            if args[0] == "set":
+                store_["/jepsen-r1"][1] += 1   # concurrent bump
+            return real_cli(*args)
+
+        conn._cli = racy
+        assert conn.cas(1, 7, 8) is False
+        assert store_["/jepsen-r1"][0] == "7"
+        conn.close()
+
+
 class TestQueueSuite:
     def test_rabbitmq_total_queue(self):
         mem = MemQueue()
