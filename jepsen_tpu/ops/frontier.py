@@ -1,0 +1,178 @@
+"""Shared frontier-kernel primitives for the WGL device engines.
+
+These encode the exactness-critical parts of the frontier search — the
+no-false-merge dedupe argument and the bitmask slot algebra — used by
+`ops.wgl` (adaptive single-history kernel), `ops.wgl_batch` (vmapped
+multi-key kernel) and `ops.wgl_seg` (segment-parallel bitmap kernel).
+One definition each: a subtle soundness bug in a hand-synced copy is
+exactly how a checker starts lying, so the copies were consolidated
+here (the differential test matrix in tests/test_wgl_*.py holds all
+three engines verdict-identical to the CPU oracle).
+
+Two families:
+
+  * row-frontier ops (`make_bit_ops`, `make_dedupe_compact`): a config
+    is one row (mask u32[Wd], state i32[S]); dedupe is a full-content
+    lexicographic sort — never a hash, so distinct configurations are
+    never merged;
+  * plane-frontier ops (`make_plane_ops`): the wgl_seg dense bitmap
+    layout, frontier bool[2^R x Sn] bit-packed into u32 words along a
+    [Wd, 32-lane, ...] axis — slot operations are word shuffles with
+    static bit patterns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+def make_bit_ops(Wd: int):
+    """(has_bit, set_bit, clear_bit) over mask rows u32[..., Wd].
+    `slot` broadcasts to masks.shape[:-1]."""
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+
+    def slot_word_bit(slot):
+        return slot // 32, (u32(1) << (slot % 32).astype(jnp.uint32))
+
+    def has_bit(masks, slot):
+        w, bit = slot_word_bit(slot)
+        word = jnp.take_along_axis(
+            masks, jnp.broadcast_to(w[..., None], masks.shape[:-1] + (1,)),
+            axis=-1)[..., 0]
+        return (word & bit) != 0
+
+    def set_bit(masks, slot):
+        w, bit = slot_word_bit(slot)
+        word_idx = jnp.arange(Wd)
+        shape = masks.shape[:-1] + (Wd,)
+        return jnp.where(
+            jnp.broadcast_to(word_idx, shape) == w[..., None],
+            masks | bit[..., None], masks)
+
+    def clear_bit(masks, slot):
+        w, bit = slot_word_bit(slot)
+        word_idx = jnp.arange(Wd)
+        shape = masks.shape[:-1] + (Wd,)
+        return jnp.where(
+            jnp.broadcast_to(word_idx, shape) == w[..., None],
+            masks & ~bit[..., None], masks)
+
+    return has_bit, set_bit, clear_bit
+
+
+def make_dedupe_compact(Wd: int, S: int):
+    """Exact dedupe + compaction of a pool of configs down to out_rows.
+    masks u32[P, Wd], states i32[P, S], valid bool[P].  Exactness
+    matters: dedupe compares full (mask, state) content — never a hash —
+    so distinct configurations are never merged.  Returns
+    (masks, states, valid, overflowed, distinct_count)."""
+    import jax
+    import jax.numpy as jnp
+
+    u32 = jnp.uint32
+
+    def dedupe_compact(masks, states, valid, out_rows: int):
+        P = masks.shape[0]
+        st_keys = jax.lax.bitcast_convert_type(states, u32) \
+            ^ u32(0x80000000)
+        sent = ~valid
+        keys = [jnp.where(sent, u32(1), u32(0))]
+        for wi in range(Wd):
+            keys.append(jnp.where(sent, _SENTINEL, masks[:, wi]))
+        for si in range(S):
+            keys.append(jnp.where(sent, _SENTINEL, st_keys[:, si]))
+        # lexsort: last key is primary -> reverse so keys[0] is primary.
+        perm = jnp.lexsort(tuple(reversed(keys)))
+        s_masks = masks[perm]
+        s_states = states[perm]
+        s_valid = valid[perm]
+        content = [k[perm] for k in keys[1:]]
+        eq_prev = jnp.ones(s_valid.shape, bool)
+        for col in content:
+            eq_prev &= col == jnp.roll(col, 1)
+        eq_prev = eq_prev.at[0].set(False)
+        keep = s_valid & ~eq_prev
+        pos = jnp.cumsum(keep) - 1
+        count = pos[-1] + 1
+        pos = jnp.where(keep, pos, P + 1)
+        out_masks = jnp.zeros((out_rows, Wd), u32).at[pos].set(
+            s_masks, mode="drop")
+        out_states = jnp.zeros((out_rows, S), jnp.int32).at[pos].set(
+            s_states, mode="drop")
+        out_valid = jnp.arange(out_rows) < jnp.minimum(count, out_rows)
+        return out_masks, out_states, out_valid, count > out_rows, count
+
+    return dedupe_compact
+
+
+def reshape_shift(x, hi: int, lo: int, set_bit: bool):
+    """Move frontier content across one bit of the axis at position -4
+    by reshaping it to (hi, 2, lo): set_bit moves the bit-clear half to
+    the bit-set half (linearize), else the reverse (prune + retire).
+    Shared by the dense kernel (mask axis) and the bit-packed kernel
+    (word axis)."""
+    import jax.numpy as jnp
+
+    xs = x.reshape(x.shape[:-4] + (hi, 2, lo) + x.shape[-3:])
+    if set_bit:
+        half = xs[..., :, 0:1, :, :, :, :]
+        y = jnp.concatenate([jnp.zeros_like(half), half], axis=-5)
+    else:
+        half = xs[..., :, 1:2, :, :, :, :]
+        y = jnp.concatenate([half, jnp.zeros_like(half)], axis=-5)
+    return y.reshape(x.shape)
+
+
+# Intra-word "lacks bit b" patterns: bit i is set iff mask-index i has
+# bit b clear (i & (1<<b) == 0).
+_INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
+
+
+def make_plane_ops(Wd: int, R: int):
+    """The frontier bit algebra shared by the wgl_seg bit-packed
+    kernels: slot bits 0-4 live within each uint32 word
+    (constant-pattern masks and shifts), slots >= 5 shift whole words
+    along the word axis.  Returns (lacking, set_slot, retire_slot,
+    sel32) closures over frontier tensors shaped [Wd, Sn, J, K]."""
+    import jax.numpy as jnp
+
+    FULL = np.uint32(0xFFFFFFFF)
+    Whalf = [(Wd >> (b + 1), 1 << b) for b in range(max(R - 5, 0))]
+    word_iota = np.arange(Wd, dtype=np.int32)
+
+    def word_lack(b):
+        """uint32 [Wd] mask: FULL where word index lacks bit b-5."""
+        return jnp.asarray(
+            np.where((word_iota >> (b - 5)) & 1 == 0, FULL, 0),
+            jnp.uint32)
+
+    def lacking(x, b):
+        """Configs in x whose mask lacks slot b."""
+        if b < 5:
+            return x & np.uint32(_INTRA[b])
+        return x & word_lack(b)[:, None, None, None]
+
+    def set_slot(x, b):
+        """Linearize slot b: configs lacking it move to mask|bit."""
+        if b < 5:
+            return (x & np.uint32(_INTRA[b])) << (1 << b)
+        return reshape_shift(x & word_lack(b)[:, None, None, None],
+                             *Whalf[b - 5], set_bit=True)
+
+    def retire_slot(x, b):
+        """Prune configs lacking slot b, clear the bit on the rest."""
+        if b < 5:
+            return (x & np.uint32(~np.uint32(_INTRA[b]))) >> (1 << b)
+        keep = x & (~word_lack(b))[:, None, None, None]
+        return reshape_shift(keep, *Whalf[b - 5], set_bit=False)
+
+    def sel32(cond):
+        """bool -> uint32 FULL/0 select mask."""
+        return jnp.where(cond, jnp.asarray(FULL),
+                         jnp.asarray(np.uint32(0)))
+
+    return lacking, set_slot, retire_slot, sel32

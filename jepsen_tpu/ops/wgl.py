@@ -61,10 +61,8 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from jepsen_tpu.models import DeviceSpec
+from jepsen_tpu.ops import frontier
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
-
-_SENTINEL = np.uint32(0xFFFFFFFF)
-
 
 # ---------------------------------------------------------------------------
 # Host-side planning: events -> dense per-return-event candidate tables
@@ -188,67 +186,8 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
     # runs first; overflow escalates within the event.
     TIERS = [t for t in (64, 512) if t < F] + [F]
 
-    def slot_word_bit(slot):
-        return slot // 32, (u32(1) << (slot % 32).astype(jnp.uint32))
-
-    def has_bit(masks, slot):
-        # masks [..., Wd], slot broadcastable to masks.shape[:-1]
-        w, bit = slot_word_bit(slot)
-        word = jnp.take_along_axis(
-            masks, jnp.broadcast_to(w[..., None], masks.shape[:-1] + (1,)),
-            axis=-1)[..., 0]
-        return (word & bit) != 0
-
-    def set_bit(masks, slot):
-        w, bit = slot_word_bit(slot)
-        word_idx = jnp.arange(Wd)
-        shape = masks.shape[:-1] + (Wd,)
-        return jnp.where(
-            jnp.broadcast_to(word_idx, shape) == w[..., None],
-            masks | bit[..., None], masks)
-
-    def clear_bit(masks, slot):
-        w, bit = slot_word_bit(slot)
-        word_idx = jnp.arange(Wd)
-        shape = masks.shape[:-1] + (Wd,)
-        return jnp.where(
-            jnp.broadcast_to(word_idx, shape) == w[..., None],
-            masks & ~bit[..., None], masks)
-
-    def dedupe_compact(masks, states, valid, out_rows: int):
-        """Exact dedupe + compaction of a pool of configs down to
-        out_rows.  masks u32[P, Wd], states i32[P, S], valid bool[P].
-        Exactness matters: dedupe compares full (mask, state) content —
-        never a hash — so distinct configurations are never merged."""
-        P = masks.shape[0]
-        st_keys = jax.lax.bitcast_convert_type(states, u32) \
-            ^ u32(0x80000000)
-        sent = ~valid
-        keys = [jnp.where(sent, u32(1), u32(0))]
-        for wi in range(Wd):
-            keys.append(jnp.where(sent, _SENTINEL, masks[:, wi]))
-        for si in range(S):
-            keys.append(jnp.where(sent, _SENTINEL, st_keys[:, si]))
-        # lexsort: last key is primary -> reverse so keys[0] is primary.
-        perm = jnp.lexsort(tuple(reversed(keys)))
-        s_masks = masks[perm]
-        s_states = states[perm]
-        s_valid = valid[perm]
-        content = [k[perm] for k in keys[1:]]
-        eq_prev = jnp.ones(s_valid.shape, bool)
-        for col in content:
-            eq_prev &= col == jnp.roll(col, 1)
-        eq_prev = eq_prev.at[0].set(False)
-        keep = s_valid & ~eq_prev
-        pos = jnp.cumsum(keep) - 1
-        count = pos[-1] + 1
-        pos = jnp.where(keep, pos, P + 1)
-        out_masks = jnp.zeros((out_rows, Wd), u32).at[pos].set(
-            s_masks, mode="drop")
-        out_states = jnp.zeros((out_rows, S), jnp.int32).at[pos].set(
-            s_states, mode="drop")
-        out_valid = jnp.arange(out_rows) < jnp.minimum(count, out_rows)
-        return out_masks, out_states, out_valid, count > out_rows, count
+    has_bit, set_bit, clear_bit = frontier.make_bit_ops(Wd)
+    dedupe_compact = frontier.make_dedupe_compact(Wd, S)
 
     def compact(masks, states, valid):
         """Re-pack valid configs to the front (cheap: no sort)."""
