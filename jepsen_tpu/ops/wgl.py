@@ -86,6 +86,9 @@ class WGLPlan:
     n_calls: int
     n_events: int            # real (unpadded) return events
     max_open: int
+    # crashed calls' permanent slots, grouped by identical op encoding
+    # (interchangeable tokens), each group in invoke order
+    crash_groups: tuple = ()
 
 
 def _generic_encode_op(op, f_codes) -> tuple[int, int, int, bool]:
@@ -126,23 +129,53 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model,
                 f"int32 range; use ops.wgl_cpu.check for this history")
         f[c.id], a[c.id], b[c.id], a_ok[c.id] = fc, av, bv, okv
 
-    # Slot assignment + per-return-event open sets.
+    # Slot assignment + per-return-event open sets.  Crashed calls get
+    # DEDICATED slots above the normal range (remapped below, like
+    # wgl_seg._fast_scan's rn+j pseudo-slots): slot index <-> crashed
+    # call identity must be STATIC across the whole history for the
+    # kernel's crash-bit pruning — a crashed call on a recycled slot
+    # would alias the normal calls that held the slot earlier.
     free: list[int] = []
     next_slot = 0
+    n_crashed = 0
     slot_of: dict[int, int] = {}
     open_calls: list[int] = []
     rets: list[tuple[int, int, list[int]]] = []
     for _, kind, cid in prep.events:
         if kind == 0:
-            s = free.pop() if free else next_slot
-            if s == next_slot:
-                next_slot += 1
-            slot_of[cid] = s
+            if calls[cid].is_crashed:
+                slot_of[cid] = -2 - n_crashed    # placeholder
+                n_crashed += 1
+            else:
+                s = free.pop() if free else next_slot
+                if s == next_slot:
+                    next_slot += 1
+                slot_of[cid] = s
             open_calls.append(cid)
         else:
             rets.append((cid, slot_of[cid], list(open_calls)))
             open_calls.remove(cid)
             free.append(slot_of[cid])
+    if n_crashed:
+        rn = next_slot
+        slot_of = {cid: (s if s >= 0 else rn + (-2 - s))
+                   for cid, s in slot_of.items()}
+        rets = [(cid, s if s >= 0 else rn + (-2 - s), cands)
+                for cid, s, cands in rets]
+
+    # Group crashed calls by identical op encoding: same transition
+    # function makes them interchangeable consumption tokens, and
+    # grouping them (in invoke order) lets the kernel canonicalize +
+    # dominance-prune the crashed-bit combinatorics that otherwise
+    # explode exactly like knossos ("a couple crashed processes ...
+    # seconds and days", doc/tutorial/06-refining.md:12-19).
+    groups: dict = {}
+    for c in calls:
+        if c.is_crashed:
+            groups.setdefault(
+                (int(f[c.id]), int(a[c.id]), int(b[c.id]),
+                 bool(a_ok[c.id])), []).append(slot_of[c.id])
+    crash_groups = tuple(tuple(g) for g in groups.values())
 
     R = len(rets)
     C = max((len(cands) for _, _, cands in rets), default=1)
@@ -166,7 +199,9 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model,
 
     return WGLPlan(ret_call, ret_slot, cand_call, cand_slot,
                    f, a, b, a_ok, np.asarray(spec.encode(model), np.int32),
-                   n_calls=n, n_events=R, max_open=max(next_slot, 1))
+                   n_calls=n, n_events=R,
+                   max_open=max(next_slot + n_crashed, 1),
+                   crash_groups=crash_groups)
 
 
 # ---------------------------------------------------------------------------
@@ -174,9 +209,45 @@ def plan(prep: PreparedHistory, spec: DeviceSpec, model,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=64)
-def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
+def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int,
+                  crash_sizes: tuple | None = None):
     """Compile the frontier search for static shapes.  step_fn must be a
-    hashable (module-level or cached) pure function."""
+    hashable (module-level or cached) pure function.
+
+    With `crash_groups` (crashed calls' permanent slots grouped by
+    identical op encoding, each group in invoke order), the closure
+    additionally prunes the crashed-consumption combinatorics — the
+    regime where knossos's config set multiplies per crashed op:
+
+      * fungibility canonicalization: same-encoding crashed calls are
+        interchangeable tokens, so each group's consumed subset remaps
+        to the earliest-invoked prefix (bits are only ever set on
+        already-invoked slots, so the prefix is always invoked — the
+        exchange swaps a consumed token for an earlier-invoked one,
+        which was available whenever the later one was);
+      * dominance: a config that consumed a PROPER SUPERSET of crashed
+        tokens while agreeing on model state and open-call bits is
+        redundant — every completion available to it is available to
+        the subset config (crashed tokens carry no obligation).
+
+    Both preserve exact verdicts.  They run inside every closure round
+    (the explosion is intra-event), which breaks the count-growth
+    termination test — so crash-mode rounds instead stop at an exact
+    content fixpoint: dedupe+compaction order output deterministically,
+    and a pruned set equal to the previous round's can never change
+    again (a dominated config's children are dominated by its
+    dominator's children, which expand from the same set).
+
+    `crash_sizes` is None for crash-free histories and a tuple of the
+    multi-slot group sizes otherwise — the ONLY crash data in the
+    compile cache key.  The actual slot masks/LUTs arrive as runtime
+    device arrays (extra kernel args), so every crash-bearing history
+    with the same shape signature shares one compiled kernel instead
+    of recompiling per history.  Dominance is skipped in escalation
+    tiers above _DOM_TIER_CAP: its (P, P) relation matrices are
+    quadratic in the pool (4.3 GB at P=65536), and skipping a prune is
+    always exact — worst case the big tier overflows and reports
+    unknown, as before."""
     import jax
     import jax.numpy as jnp
 
@@ -188,6 +259,48 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
 
     has_bit, set_bit, clear_bit = frontier.make_bit_ops(Wd)
     dedupe_compact = frontier.make_dedupe_compact(Wd, S)
+
+    crash_mode = crash_sizes is not None
+    # LUT row offsets per multi-slot group (static: derived from sizes)
+    _lut_off = []
+    off = 0
+    for size in (crash_sizes or ()):
+        _lut_off.append(off)
+        off += size + 1
+
+    def canonicalize(masks, gws, luts):
+        """Remap each crash group's consumed bits to its invoke-order
+        prefix: count the group's set bits, clear them, OR in the
+        prefix of that size (two table ops per group).  Bits are only
+        ever set on already-invoked slots, so the prefix is always
+        invoked.  gws u32[G, Wd]; luts u32[sum(sizes+1), Wd]."""
+        for gi, size in enumerate(crash_sizes):
+            gw = gws[gi]
+            lut = luts[_lut_off[gi]:_lut_off[gi] + size + 1]
+            cnt = jax.lax.population_count(masks & gw).sum(
+                axis=-1).astype(jnp.int32)
+            masks = (masks & ~gw) | lut[cnt]
+        return masks
+
+    def dominate(masks, states, valid, cw):
+        """Invalidate configs whose crashed-consumption set is a proper
+        superset of another config with equal state and open bits.
+        cw u32[Wd]: all crashed slots' word mask."""
+        crash = masks & cw
+        normal = masks & ~cw
+        P = masks.shape[0]
+        eq = valid[:, None] & valid[None, :]
+        for w in range(Wd):
+            eq &= normal[:, None, w] == normal[None, :, w]
+        for si in range(S):
+            eq &= states[:, None, si] == states[None, :, si]
+        subset = jnp.ones((P, P), bool)
+        proper = jnp.zeros((P, P), bool)
+        for w in range(Wd):
+            subset &= (crash[:, None, w] & ~crash[None, :, w]) == 0
+            proper |= crash[:, None, w] != crash[None, :, w]
+        dominated = (eq & subset & proper).any(axis=0)
+        return valid & ~dominated
 
     def compact(masks, states, valid):
         """Re-pack valid configs to the front (cheap: no sort)."""
@@ -210,8 +323,11 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
         return (st2.reshape(states.shape),
                 legal.reshape(states.shape[:-1]))
 
+    _DOM_TIER_CAP = 4096
+
     def closure_tier(Fb: int, masks, states, valid, tslot,
-                     cc, cs, cf, ca, cb, cok):
+                     cc, cs, cf, ca, cb, cok, cwords=None, gws=None,
+                     luts=None):
         """Run the closure in a pool of Fb*(C+1); configs live in the
         first Fb rows (caller guarantees count <= Fb).  Returns
         full-F arrays + overflow flag."""
@@ -240,20 +356,42 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
             pool_m = jnp.concatenate([bm, chm.reshape(Fb * C, Wd)])
             pool_s = jnp.concatenate([bs, chs.reshape(Fb * C, S)])
             pool_v = jnp.concatenate([bv, chv.reshape(Fb * C)])
+            if crash_mode and crash_sizes:
+                pool_m = jnp.where(pool_v[:, None],
+                                   canonicalize(pool_m, gws, luts),
+                                   pool_m)
             nm, ns, nv, o2, count = dedupe_compact(
                 pool_m, pool_s, pool_v, Fb)
-            # Parents are all retained in the pool, so "a new config
-            # appeared" is exactly "the DEDUPED count grew vs the
-            # previous round's deduped count" — the loop must stop on
-            # saturation even while some configs still lack the target
-            # (they are pruned afterwards).  Comparing against a raw
-            # sum(valid) would be wrong: the frontier entering an event
-            # may hold duplicates (configs that differed only in the
-            # just-retired slot bit), so round 1 always runs
-            # (prev_count starts at -1) and later rounds compare
-            # distinct-to-distinct.
+            if crash_mode:
+                # In-round pruning breaks the count-growth test below;
+                # stop at the exact content fixpoint instead (see the
+                # builder docstring for why a stable pruned set can
+                # never change again).  Re-pack after dominance so the
+                # comparison sees canonical content — stale rows left
+                # in dominance holes would read as change every round
+                # and burn the full rounds cap.
+                nv2 = dominate(nm, ns, nv, cwords) \
+                    if Fb <= _DOM_TIER_CAP else nv
+                pos = jnp.where(nv2, jnp.cumsum(nv2) - 1, Fb + 1)
+                nm = jnp.zeros_like(nm).at[pos].set(nm, mode="drop")
+                ns = jnp.zeros_like(ns).at[pos].set(ns, mode="drop")
+                nv = jnp.arange(Fb) < jnp.sum(nv2)
+                progressed = (jnp.any(nm != bm) | jnp.any(ns != bs)
+                              | jnp.any(nv != bv))
+            else:
+                # Parents are all retained in the pool, so "a new config
+                # appeared" is exactly "the DEDUPED count grew vs the
+                # previous round's deduped count" — the loop must stop
+                # on saturation even while some configs still lack the
+                # target (they are pruned afterwards).  Comparing
+                # against a raw sum(valid) would be wrong: the frontier
+                # entering an event may hold duplicates (configs that
+                # differed only in the just-retired slot bit), so round
+                # 1 always runs (prev_count starts at -1) and later
+                # rounds compare distinct-to-distinct.
+                progressed = count > prev_count
             return (nm, ns, nv, ovf | o2, rounds + 1,
-                    count > prev_count, count)
+                    progressed, count)
 
         bm, bs, bv, ovf, _, _, _ = jax.lax.while_loop(
             ex_cond, ex_body,
@@ -268,7 +406,10 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
         return pm, ps, pv, ovf
 
     def kernel(ret_call, ret_slot, cand_call, cand_slot, fv, av, bv, okv,
-               init_state, n_events):
+               init_state, n_events, *crash_args):
+        cwords = gws = luts = None
+        if crash_mode:
+            cwords, gws, luts = crash_args
         masks0 = jnp.zeros((F, Wd), u32)
         states0 = jnp.zeros((F, S), jnp.int32).at[0].set(init_state)
         valid0 = jnp.zeros(F, bool).at[0].set(True)
@@ -327,7 +468,8 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int):
                         functools.partial(
                             lambda Fb, _: closure_tier(
                                 Fb, masks, states, valid, tslot,
-                                cc, cs, cf, ca, cb, cok), Fb),
+                                cc, cs, cf, ca, cb, cok,
+                                cwords, gws, luts), Fb),
                         lambda _: out, operand=None)
                     accept = should & (~res[3] | is_last)
                     out = tuple(
@@ -390,19 +532,67 @@ def check(model, history, *,
               pad_events_to=_bucket(n_events) if pad else None,
               pad_cands_to=_bucket(prep.max_open, 4) if pad else None)
     C = pl.cand_call.shape[1]
-    W = C  # slots range over [0, max_open) and C >= max_open
+    # slots range over [0, max_open); crashed calls' dedicated slots can
+    # exceed the concurrent-candidate count C.  Bucketed so same-shaped
+    # histories share compiled kernels.
+    W = _bucket(max(C, pl.max_open), 4) if pad else max(C, pl.max_open)
     S = pl.init_state.shape[0]
     t_plan = time.monotonic() - t0
+
+    # Crash data splits into a static shape key (multi-group sizes) and
+    # runtime device arrays, so same-shaped crash histories share one
+    # compiled kernel (see _build_kernel docstring).
+    crash_sizes = None
+    crash_args: tuple = ()
+    if pl.crash_groups:
+        Wd = max((int(W) + 31) // 32, 1)
+        multi = sorted((g for g in pl.crash_groups if len(g) >= 2),
+                       key=len, reverse=True)
+        # Bucket each group size (pow2) and pad the group COUNT so the
+        # static key collapses to a few shapes; padded rows/size-0
+        # groups are inert (cnt never exceeds the real bit count).
+        G_pad = _bucket(max(len(multi), 1))
+        crash_sizes = tuple(_bucket(len(g)) for g in multi) \
+            + (0,) * (G_pad - len(multi))
+        cw = np.zeros(Wd, np.uint32)
+        for g in pl.crash_groups:
+            for slot in g:
+                cw[slot // 32] |= np.uint32(1) << (slot % 32)
+        gws = np.zeros((G_pad, Wd), np.uint32)
+        luts = np.zeros((max(sum(z + 1 for z in crash_sizes), 1), Wd),
+                        np.uint32)
+        off = 0
+        for gi, g in enumerate(multi):
+            for i, slot in enumerate(g):
+                gws[gi, slot // 32] |= np.uint32(1) << (slot % 32)
+                luts[off + i + 1] = luts[off + i]
+                luts[off + i + 1, slot // 32] |= \
+                    np.uint32(1) << (slot % 32)
+            for i in range(len(g), crash_sizes[gi]):
+                luts[off + i + 1] = luts[off + i]
+            off += crash_sizes[gi] + 1
+        crash_args = (cw, gws, luts)
+
+    # Pad the per-call op tables too: every input shape must bucket or
+    # the jit re-traces per distinct n_calls.
+    fv, av, bv, okv = pl.f, pl.a, pl.b, pl.a_ok
+    if pad:
+        Np = _bucket(pl.n_calls)
+        if Np != len(fv):
+            fv = np.concatenate([fv, np.zeros(Np - len(fv), np.int32)])
+            av = np.concatenate([av, np.zeros(Np - len(av), np.int32)])
+            bv = np.concatenate([bv, np.zeros(Np - len(bv), np.int32)])
+            okv = np.concatenate([okv, np.zeros(Np - len(okv), bool)])
 
     for F in frontier_sizes:
         if F < 1:
             continue
         kern = _build_kernel(spec.step, spec.pure, int(F), int(C), int(W),
-                             int(S))
+                             int(S), crash_sizes)
         t1 = time.monotonic()
         out = kern(pl.ret_call, pl.ret_slot, pl.cand_call, pl.cand_slot,
-                   pl.f, pl.a, pl.b, pl.a_ok, pl.init_state,
-                   np.int32(pl.n_events))
+                   fv, av, bv, okv, pl.init_state,
+                   np.int32(pl.n_events), *crash_args)
         ok = bool(out["ok"])
         overflow = bool(out["overflow"])
         t_kernel = time.monotonic() - t1

@@ -163,7 +163,7 @@ def check_many(model, histories: Sequence, *,
     C = _bucket(max(pl.cand_call.shape[1] for _, pl in lanes), 4)
     N = _bucket(max(pl.n_calls for _, pl in lanes))
     S = lanes[0][1].init_state.shape[0]
-    W = C
+    W = max(C, _bucket(max(pl.max_open for _, pl in lanes), 4))
 
     padded = [_pad_plan(pl, R, C, N) for _, pl in lanes]
     K = len(padded)

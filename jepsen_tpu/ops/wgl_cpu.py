@@ -41,7 +41,9 @@ def check(model, history, *,
     configs: set[tuple[frozenset, Any]] = {(frozenset(), model)}
     pending: set[int] = set()
 
+    events_done = 0
     for ev, kind, cid in prep.events:
+        events_done += 1
         if kind == 0:
             pending.add(cid)
             continue
@@ -54,7 +56,9 @@ def check(model, history, *,
         while frontier:
             if time_limit is not None and time.monotonic() - t0 > time_limit:
                 return {"valid?": "unknown", "cause": "timeout",
-                        "op_count": len(calls)}
+                        "op_count": len(calls),
+                        "events_done": events_done,
+                        "events_total": len(prep.events)}
             nxt: set[tuple[frozenset, Any]] = set()
             for mask, m in frontier:
                 if cid in mask:
@@ -72,7 +76,9 @@ def check(model, history, *,
                         nxt.add(c2)
             if len(seen) > max_configs:
                 return {"valid?": "unknown", "cause": "config-explosion",
-                        "op_count": len(calls), "configs": len(seen)}
+                        "op_count": len(calls), "configs": len(seen),
+                        "events_done": events_done,
+                        "events_total": len(prep.events)}
             frontier = nxt
 
         call = calls[cid]

@@ -1473,6 +1473,19 @@ def _check_crashed_fast(model, spec, history, *, max_states,
         target_returns_per_segment=target_returns_per_segment,
         localize=False, mesh=mesh, mesh_axis=mesh_axis,
         backend_name=backend_name, t0=t0, escalate=False)
+    if res is None:
+        # outside the register-delta gate (e.g. concurrency > 8): the
+        # stripped twin has no crashes, so the full check() chain (the
+        # candidate-table kernel) can still prove it — no recursion
+        # hazard, _check_crashed_fast bails on crash-free input.
+        try:
+            res = check(model, History(stripped), max_states=max_states,
+                        max_open_bits=max_open_bits,
+                        target_returns_per_segment=
+                        target_returns_per_segment,
+                        localize=False, mesh=mesh, mesh_axis=mesh_axis)
+        except Unsupported:
+            res = None
     if res is not None and res.get("valid?") is True:
         res["crashed_ignored"] = len(crashed)
         return res
