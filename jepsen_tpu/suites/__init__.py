@@ -27,6 +27,7 @@ SUITES = {
     "percona": "jepsen_tpu.suites.percona",
     "tidb": "jepsen_tpu.suites.tidb",
     "mongodb": "jepsen_tpu.suites.mongodb",
+    "mongodb-smartos": "jepsen_tpu.suites.mongodb_smartos",
     "postgres-rds": "jepsen_tpu.suites.postgres_rds",
     "raftis": "jepsen_tpu.suites.raftis",
     "logcabin": "jepsen_tpu.suites.logcabin",

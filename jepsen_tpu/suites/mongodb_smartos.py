@@ -1,0 +1,442 @@
+"""MongoDB-on-SmartOS test suite (reference: `mongodb-smartos/`, 788 LoC:
+`src/jepsen/mongodb_smartos/{core,document_cas,transfer}.clj`).
+
+Three pieces, mirroring the reference's registry:
+
+  * SmartOS replica-set automation (`core.clj:40-303`): pkgin install,
+    config file, per-node mongod, replica-set initiate from the primary
+    and an await-join loop;
+  * **document-cas** (`document_cas.clj`): a compare-and-set register on
+    ONE shared document, checked with knossos cas-register semantics;
+    write-concern matrix with a `no-read` variant ("mongo doesn't have
+    linearizable reads", document_cas.clj:103-110);
+  * **transfer** (`transfer.clj`): bank-account transfers via mongo's
+    documented two-phase-commit recipe, checked against a host-side
+    `Accounts` model (`transfer.clj:190-215` defines the model in-suite
+    the same way) with `read` / `partial-read` / `transfer` ops and the
+    `diff-account` variant.
+
+The rocks-engine suite shape (shared document-per-key register) stays in
+`suites/mongodb.py`; this module is the smartos-specific depth.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import generator as gen
+from jepsen_tpu import models
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import net
+from jepsen_tpu import os_smartos
+from jepsen_tpu.checker import timeline
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import workload_main
+
+DIR = "/opt/local/mongodb"
+DBPATH = "/var/mongodb"
+PIDFILE = f"{DBPATH}/mongod.pid"
+LOGFILE = f"{DBPATH}/mongod.log"
+PORT = 27017
+RS = "jepsen"
+
+
+class SmartOSMongoDB(db_mod.DB, db_mod.LogFiles, db_mod.Primary):
+    """Replica set on SmartOS: pkgin-installed mongod per node, set
+    initiated from the first node over all members, then an await loop
+    until a primary exists (core.clj install! :40, start! :55,
+    replica-set-initiate! :128, await-primary :228)."""
+
+    def setup(self, test, node):
+        with c.su():
+            c.execute("pkgin", "-y", "install", "mongodb", check=False)
+        c.execute("mkdir", "-p", DBPATH, check=False)
+        cu.start_daemon(
+            "mongod", "--replSet", RS, "--bind_ip_all",
+            "--port", str(PORT), "--dbpath", DBPATH,
+            chdir=DBPATH, logfile=LOGFILE, pidfile=PIDFILE)
+        c.execute(lit(
+            "for i in $(seq 1 60); do "
+            f"mongosh --host {node} --eval 'db.runCommand({{ping: 1}})' "
+            "> /dev/null 2>&1 && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def setup_primary(self, test, node):
+        members = [{"_id": i, "host": f"{n}:{PORT}"}
+                   for i, n in enumerate(test.get("nodes") or [])]
+        cfg = json.dumps({"_id": RS, "members": members})
+        c.execute("mongosh", "--host", node, "--eval",
+                  f"rs.initiate({cfg})", check=False)
+        # await-join (core.clj:234-249): wait for a primary
+        c.execute(lit(
+            "for i in $(seq 1 120); do "
+            f"mongosh --quiet --host {node} --eval "
+            "'db.hello().isWritablePrimary' 2>/dev/null "
+            "| grep -q true && exit 0; sleep 1; done; exit 1"),
+            check=False)
+
+    def teardown(self, test, node):
+        cu.stop_daemon(PIDFILE, "mongod")
+        c.execute("rm", "-rf", DBPATH, check=False)
+
+    def log_files(self, test, node):
+        return [LOGFILE]
+
+
+# ---------------------------------------------------------------------------
+# document-cas (document_cas.clj): CAS register on one shared document
+# ---------------------------------------------------------------------------
+
+class MongoDocConn:
+    """One shared document; findAndModify performs the compare-and-set
+    server-side (atomic: the query predicate and the update apply to
+    one document under the document-level lock)."""
+
+    DOC = "jepsen-doc-cas"
+
+    def __init__(self, node: str, write_concern: str = "majority"):
+        self.node = node
+        self.wc = write_concern
+        self._session = c.session(node)
+
+    def _eval(self, js: str) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("mongosh", "--quiet", "--host", self.node,
+                             "jepsen", "--eval", js, check=False)
+
+    def read(self) -> Optional[int]:
+        out = (self._eval(
+            "db.jepsen.find({_id: %r}).readPref('primary')"
+            ".toArray()[0]?.value ?? null" % self.DOC) or "").strip()
+        return int(out) if out.lstrip("-").isdigit() else None
+
+    def write(self, v: int) -> None:
+        self._eval(
+            "db.jepsen.updateOne({_id: %r}, {$set: {value: %d}}, "
+            "{upsert: true, writeConcern: {w: %r}})"
+            % (self.DOC, v, self.wc))
+
+    def cas(self, old: int, new: int) -> bool:
+        out = self._eval(
+            "db.jepsen.findAndModify({query: {_id: %r, value: %d}, "
+            "update: {$set: {value: %d}}, writeConcern: {w: %r}}) !== null"
+            % (self.DOC, old, new, self.wc))
+        return (out or "").strip() == "true"
+
+    def close(self):
+        self._session.close()
+
+
+class DocCasClient(client_mod.Client):
+    """document_cas.clj Client: reads are idempotent (failures :fail),
+    writes/cas indeterminate on timeout (with-errors op #{:read})."""
+
+    factory_key = "doc-factory"
+
+    def __init__(self, conn_factory=None, write_concern="majority"):
+        self.conn_factory = conn_factory
+        self.wc = write_concern
+        self.conn = None
+
+    def open(self, test, node):
+        out = type(self)(test.get(self.factory_key) or self.conn_factory
+                         or (lambda n: MongoDocConn(n, self.wc)), self.wc)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                return op.assoc(type="ok", value=self.conn.read())
+            if op.f == "write":
+                self.conn.write(op.value)
+                return op.assoc(type="ok")
+            ok = self.conn.cas(*op.value)
+            return op.assoc(type="ok" if ok else "fail")
+        except TimeoutError as e:
+            # reads are idempotent: a timed-out read definitely did not
+            # change anything (document_cas.clj:51-52)
+            if op.f == "read":
+                return op.assoc(type="fail", error=str(e))
+            return op.assoc(type="info", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="fail" if op.f == "read" else "info",
+                            error=str(e))
+
+
+def _r(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def _w(test, process):
+    return {"type": "invoke", "f": "write", "value": random.randint(0, 4)}
+
+
+def _cas(test, process):
+    return {"type": "invoke", "f": "cas",
+            "value": [random.randint(0, 4), random.randint(0, 4)]}
+
+
+def _std_test(name, opts, client, model, mix, final_gen=None) -> dict:
+    """core.clj test- + std-gen: the workload mix under a start/stop
+    partition nemesis, checked for linearizability + timeline."""
+    from jepsen_tpu import tests as tst
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    interval = opts.get("nemesis-interval", 30)
+    test = dict(tst.noop_test(), **{
+        "name": f"mongodb-smartos {name}",
+        "nodes": nodes,
+        "os": os_smartos.os,
+        "db": SmartOSMongoDB(),
+        "client": client,
+        "net": net.ipfilter,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "doc-factory": opts.get("doc-factory"),
+        "txn-factory": opts.get("txn-factory"),
+        "nemesis": nem.partition_random_halves(),
+        "generator": gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(interval, interval),
+                gen.stagger(1 / 10, gen.mix(mix)))),
+        "checker": ck.compose({
+            "linear": ck.linearizable({"model": model}),
+            "timeline": timeline.html_timeline(),
+            "perf": ck.perf(),
+        }),
+    })
+    return test
+
+
+def doc_cas_majority(opts) -> dict:
+    wc = (opts or {}).get("write-concern", "majority")
+    return _std_test("document cas majority", opts,
+                     DocCasClient(write_concern=wc),
+                     models.CASRegister(), [_r, _w, _cas, _cas])
+
+
+def doc_cas_no_read_majority(opts) -> dict:
+    """document_cas.clj:103-110: exclude reads — mongo has no
+    linearizable reads at this write concern."""
+    wc = (opts or {}).get("write-concern", "majority")
+    return _std_test("document cas no-read majority", opts,
+                     DocCasClient(write_concern=wc),
+                     models.CASRegister(), [_w, _cas, _cas])
+
+
+# ---------------------------------------------------------------------------
+# transfer (transfer.clj): two-phase-commit bank transfers
+# ---------------------------------------------------------------------------
+
+N_ACCTS = 3
+STARTING_BALANCE = 10
+
+
+class Accounts(models.Model):
+    """transfer.clj Accounts model :190-215: a map of account id ->
+    balance; reads must match exactly, partial reads must agree on the
+    accounts they did see, transfers apply unconditionally."""
+
+    def __init__(self, accts: dict):
+        self.accts = dict(accts)
+
+    def step(self, op):
+        v = op.value
+        if op.f == "read":
+            if v is None or v == self.accts:
+                return self
+            return models.inconsistent(
+                f"can't read {v!r} from {self.accts!r}")
+        if op.f == "partial-read":
+            if v is None or all(self.accts.get(a) == b
+                                for a, b in v.items()):
+                return self
+            return models.inconsistent(
+                f"{v!r} isn't consistent with {self.accts!r}")
+        if op.f == "transfer":
+            out = dict(self.accts)
+            out[v["from"]] -= v["amount"]
+            out[v["to"]] += v["amount"]
+            return Accounts(out)
+        return models.inconsistent(f"unknown op {op.f!r}")
+
+    def __eq__(self, other):
+        return isinstance(other, Accounts) and self.accts == other.accts
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.accts.items())))
+
+    def __repr__(self):
+        return f"Accounts({self.accts})"
+
+
+class MongoTxnConn:
+    """The two-phase-commit recipe (transfer.clj p0-p6, from mongo's
+    own tutorial): create txn doc -> apply to both accounts guarded by
+    pendingTxns -> mark applied -> clear pending -> done."""
+
+    def __init__(self, node: str, write_concern: str = "journaled"):
+        self.node = node
+        self.wc = write_concern
+        self._session = c.session(node)
+
+    def _eval(self, js: str) -> str:
+        with c.with_session(self.node, self._session):
+            return c.execute("mongosh", "--quiet", "--host", self.node,
+                             "jepsen", "--eval", js, check=False)
+
+    def setup_accounts(self, acct_ids, balance):
+        for a in acct_ids:
+            self._eval(
+                "db.accts.updateOne({_id: %d}, {$setOnInsert: "
+                "{balance: %d, pendingTxns: []}}, {upsert: true, "
+                "writeConcern: {w: %r}})" % (a, balance, self.wc))
+
+    def read(self) -> dict:
+        out = self._eval(
+            "JSON.stringify(Object.fromEntries(db.accts.find({})"
+            ".toArray().map(d => [d._id, d.balance])))")
+        return {int(k): v for k, v in json.loads(out or "{}").items()}
+
+    def partial_read(self) -> dict:
+        out = self._eval(
+            "JSON.stringify(Object.fromEntries("
+            "db.accts.find({pendingTxns: {$size: 0}})"
+            ".toArray().map(d => [d._id, d.balance])))")
+        return {int(k): v for k, v in json.loads(out or "{}").items()}
+
+    def transfer(self, frm: int, to: int, amount: int) -> None:
+        # p0 create; p3 apply both sides (guarded by pendingTxns so a
+        # retry cannot double-apply); p4 applied; p5 clear; p6 done.
+        self._eval(
+            "const t = db.txns.insertOne({state: 'pending', from: %d, "
+            "to: %d, amount: %d}, {writeConcern: {w: %r}}); "
+            "const id = t.insertedId; "
+            "db.accts.updateOne({_id: %d, pendingTxns: {$ne: id}}, "
+            " {$inc: {balance: -%d}, $push: {pendingTxns: id}}, "
+            " {writeConcern: {w: %r}}); "
+            "db.accts.updateOne({_id: %d, pendingTxns: {$ne: id}}, "
+            " {$inc: {balance: %d}, $push: {pendingTxns: id}}, "
+            " {writeConcern: {w: %r}}); "
+            "db.txns.updateOne({_id: id, state: 'pending'}, "
+            " {$set: {state: 'applied'}}, {writeConcern: {w: %r}}); "
+            "db.accts.updateOne({_id: %d, pendingTxns: id}, "
+            " {$pull: {pendingTxns: id}}, {writeConcern: {w: %r}}); "
+            "db.accts.updateOne({_id: %d, pendingTxns: id}, "
+            " {$pull: {pendingTxns: id}}, {writeConcern: {w: %r}}); "
+            "db.txns.updateOne({_id: id, state: 'applied'}, "
+            " {$set: {state: 'done'}}, {writeConcern: {w: %r}})"
+            % (frm, to, amount, self.wc, frm, amount, self.wc,
+               to, amount, self.wc, self.wc, frm, self.wc,
+               to, self.wc, self.wc))
+
+    def close(self):
+        self._session.close()
+
+
+class TransferClient(client_mod.Client):
+    factory_key = "txn-factory"
+
+    def __init__(self, conn_factory=None, write_concern="journaled"):
+        self.conn_factory = conn_factory
+        self.wc = write_concern
+        self.conn = None
+
+    def open(self, test, node):
+        out = type(self)(test.get(self.factory_key) or self.conn_factory
+                         or (lambda n: MongoTxnConn(n, self.wc)), self.wc)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def setup(self, test):
+        if self.conn is not None and hasattr(self.conn, "setup_accounts"):
+            self.conn.setup_accounts(range(N_ACCTS), STARTING_BALANCE)
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "read":
+                return op.assoc(type="ok", value=self.conn.read())
+            if op.f == "partial-read":
+                return op.assoc(type="ok", value=self.conn.partial_read())
+            v = op.value
+            self.conn.transfer(v["from"], v["to"], v["amount"])
+            return op.assoc(type="ok")
+        except TimeoutError as e:
+            if op.f in ("read", "partial-read"):
+                return op.assoc(type="fail", error=str(e))
+            return op.assoc(type="info", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="fail" if op.f != "transfer" else "info",
+                            error=str(e))
+
+
+def _t_read(test, process):
+    return {"type": "invoke", "f": "read", "value": None}
+
+
+def _t_partial(test, process):
+    return {"type": "invoke", "f": "partial-read", "value": None}
+
+
+def _t_transfer(test, process):
+    return {"type": "invoke", "f": "transfer",
+            "value": {"from": random.randrange(N_ACCTS),
+                      "to": random.randrange(N_ACCTS),
+                      "amount": random.randint(0, 4)}}
+
+
+_t_diff_transfer = gen.gfilter(
+    lambda op: op["value"]["from"] != op["value"]["to"], _t_transfer)
+
+
+def _transfer_test(name, opts, mix) -> dict:
+    model = Accounts({i: STARTING_BALANCE for i in range(N_ACCTS)})
+    wc = (opts or {}).get("write-concern", "journaled")
+    return _std_test(f"transfer {name}", opts,
+                     TransferClient(write_concern=wc), model, mix)
+
+
+def transfer_basic_read(opts) -> dict:
+    return _transfer_test("basic read", opts, [_t_read, _t_transfer])
+
+
+def transfer_partial_read(opts) -> dict:
+    return _transfer_test("partial read", opts,
+                          [_t_partial, _t_transfer])
+
+
+def transfer_diff_account(opts) -> dict:
+    return _transfer_test("diff account", opts,
+                          [_t_partial, _t_diff_transfer])
+
+
+TESTS = {
+    "document-cas-majority": doc_cas_majority,
+    "document-cas-no-read-majority": doc_cas_no_read_majority,
+    "transfer-basic-read": transfer_basic_read,
+    "transfer-partial-read": transfer_partial_read,
+    "transfer-diff-account": transfer_diff_account,
+}
+
+test_for, _opt_fn, main = workload_main(TESTS, "document-cas-majority")
+
+if __name__ == "__main__":
+    main()
