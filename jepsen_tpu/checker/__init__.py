@@ -123,9 +123,15 @@ def concurrency_limit(limit: int, checker: Checker) -> Checker:
 # ---------------------------------------------------------------------------
 
 class Linearizable(Checker):
-    """algorithm: 'auto' uses the device kernel when the model provides a
-    DeviceSpec and falls back to the CPU oracle (the reference's
-    'competition' slot, checker.clj:141-145); 'device'/'cpu' force one."""
+    """algorithm: 'auto' uses the device kernel when the model provides
+    a DeviceSpec and falls back to the CPU oracle; 'device'/'cpu' force
+    one; 'competition' races the device chain against the CPU oracle in
+    parallel and takes the first finisher — the reference's default
+    knossos mode (checker.clj:141-145 delegates to
+    knossos.competition/analysis, which races :linear and :wgl the same
+    way).  The losing CPU oracle is cancelled via an event, like
+    knossos cancelling the losing future; a losing device kernel runs
+    its (frontier-bounded) program to completion."""
 
     def __init__(self, model=None, algorithm: str = "auto", **kw):
         if model is None:
@@ -136,35 +142,116 @@ class Linearizable(Checker):
         self.algorithm = algorithm
         self.kw = kw
 
+    def _device_check(self, history):
+        from jepsen_tpu.ops import wgl, wgl_seg
+
+        seg_keys = ("max_states", "max_open_bits", "localize",
+                    "target_returns_per_segment")
+        ser_keys = ("frontier_sizes", "pad")
+        unknown = (set(self.kw) - set(seg_keys) - set(ser_keys)
+                   - set(self._CPU_KEYS))
+        if unknown:
+            raise TypeError(
+                f"unknown linearizable checker option(s): "
+                f"{sorted(unknown)}")
+        seg_kw = {k: v for k, v in self.kw.items() if k in seg_keys}
+        ser_kw = {k: v for k, v in self.kw.items() if k in ser_keys}
+        # Fastest engine first: the segment-parallel transfer-matrix
+        # kernel, then the serial frontier kernel for everything else.
+        try:
+            return wgl_seg.check(self.model, history, **seg_kw)
+        except wgl_seg.Unsupported:
+            return wgl.check(self.model, history, **ser_kw)
+
+    _CPU_KEYS = ("max_configs", "time_limit")
+
+    def _competition(self, history):
+        """Race device vs CPU; first result wins (competition mode).
+        The losing CPU oracle is cancelled via its `cancel` event (the
+        device kernel cannot be interrupted mid-XLA-program, but its
+        runtime is bounded by the frontier caps)."""
+        import queue as queue_mod
+        import threading
+
+        from jepsen_tpu.ops import wgl_cpu
+
+        out: queue_mod.Queue = queue_mod.Queue()
+        cancel = threading.Event()
+        cpu_kw = {k: v for k, v in self.kw.items() if k in self._CPU_KEYS}
+
+        def run(name, f):
+            try:
+                out.put((name, f()))
+            except Exception as e:  # noqa: BLE001 - loser may also fail
+                out.put((name, e))
+
+        racers = {
+            "device": lambda: self._device_check(history),
+            "cpu": lambda: wgl_cpu.check(self.model, history,
+                                         cancel=cancel, **cpu_kw),
+        }
+        for name, f in racers.items():
+            threading.Thread(target=run, args=(name, f),
+                             daemon=True, name=f"linear-{name}").start()
+        winner = None
+        for _ in racers:
+            name, res = out.get()
+            if not isinstance(res, Exception) and \
+                    res.get("valid?") != "cancelled":
+                winner = dict(res)
+                winner["competition-winner"] = name
+                cancel.set()
+                return winner
+        raise res  # both failed: surface the last error
+
     def check(self, test, history, opts=None):
-        from jepsen_tpu.ops import wgl, wgl_cpu, wgl_seg
+        from jepsen_tpu.ops import wgl_cpu
 
         algo = self.algorithm
         spec = self.model.device_spec()
         if algo == "auto":
             algo = "device" if spec is not None else "cpu"
-        if algo == "device":
-            # Fastest engine first: the segment-parallel transfer-matrix
-            # kernel (crash-free histories, enumerable state spaces),
-            # then the serial frontier kernel for everything else.
-            seg_keys = ("max_states", "max_open_bits", "localize",
-                        "target_returns_per_segment")
-            ser_keys = ("frontier_sizes", "pad")
-            unknown = set(self.kw) - set(seg_keys) - set(ser_keys)
-            if unknown:
-                raise TypeError(
-                    f"unknown linearizable checker option(s): "
-                    f"{sorted(unknown)}")
-            seg_kw = {k: v for k, v in self.kw.items() if k in seg_keys}
-            ser_kw = {k: v for k, v in self.kw.items() if k in ser_keys}
-            try:
-                a = wgl_seg.check(self.model, history, **seg_kw)
-            except wgl_seg.Unsupported:
-                a = wgl.check(self.model, history, **ser_kw)
+        if algo == "competition":
+            a = self._competition(history)
+        elif algo == "device":
+            a = self._device_check(history)
         elif algo == "cpu":
             a = wgl_cpu.check(self.model, history, **self.kw)
         else:
             raise ValueError(f"unknown algorithm {algo!r}")
+        if (a.get("valid?") is False and "final-paths" not in a
+                and a.get("op_index") is not None):
+            # Analysis-artifact parity (checker.clj:155-158): device
+            # verdicts localize a witness but carry no configs or
+            # final-paths; reconstruct both from the CPU oracle on the
+            # prefix through the witness (bounded: the verdict is
+            # already known invalid).
+            try:
+                # The prefix must include the witness's COMPLETION: cut
+                # at its invocation and prepare() treats it as crashed
+                # (linearizable by omission), yielding a bogus valid
+                # analysis (cf. wgl_seg's cutoff at completion.index).
+                hist = History(history)
+                wit = next((o for o in hist
+                            if o.index == a["op_index"]), None)
+                cutoff = a["op_index"]
+                if wit is not None:
+                    for o in hist:
+                        if (o.index is not None
+                                and o.index > a["op_index"]
+                                and o.process == wit.process
+                                and not o.is_invoke):
+                            cutoff = o.index
+                            break
+                prefix = History(
+                    [o for o in hist
+                     if o.index is not None and o.index <= cutoff])
+                oracle = wgl_cpu.check(self.model, prefix)
+                for key in ("configs", "final-paths"):
+                    if key in oracle and key not in a:
+                        a[key] = oracle[key]
+            except Exception as e:      # noqa: BLE001
+                a["final-paths-error"] = str(e)
         # Truncation parity (checker.clj:155-158): writing full configs
         # "can take *hours*".  The config-explosion verdict sets
         # 'configs' to a COUNT, not a list — only slice lists.

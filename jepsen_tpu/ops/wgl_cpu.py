@@ -30,8 +30,12 @@ from jepsen_tpu.ops.prep import PreparedHistory, prepare
 
 def check(model, history, *,
           max_configs: int = 1_000_000,
-          time_limit: Optional[float] = None) -> dict[str, Any]:
-    """Returns a knossos-shaped analysis map:
+          time_limit: Optional[float] = None,
+          cancel=None) -> dict[str, Any]:
+    """cancel: optional threading.Event — when set, the walk stops and
+    returns {'valid?': 'cancelled'} (competition-mode loser).
+
+    Returns a knossos-shaped analysis map:
     {'valid?': True|False|'unknown', 'op_count', 'configs', 'final_model'?,
      'op'? (witness), 'anomaly'?}."""
     t0 = time.monotonic()
@@ -54,6 +58,9 @@ def check(model, history, *,
         frontier = configs
         seen = set(configs)
         while frontier:
+            if cancel is not None and cancel.is_set():
+                # competition mode lost the race: stop burning CPU
+                return {"valid?": "cancelled", "op_count": len(calls)}
             if time_limit is not None and time.monotonic() - t0 > time_limit:
                 return {"valid?": "unknown", "cause": "timeout",
                         "op_count": len(calls),
@@ -88,7 +95,9 @@ def check(model, history, *,
                     "op_index": call.op.index,
                     "op_count": len(calls),
                     "anomaly": "nonlinearizable",
-                    "configs": _render_configs(configs, calls)}
+                    "configs": _render_configs(configs, calls),
+                    "final-paths": _final_paths(configs, calls, cid,
+                                                pending)}
         # cid's slot retires: drop it from masks (it is now linearized in
         # every surviving configuration, so the bit carries no information).
         pending.discard(cid)
@@ -96,6 +105,37 @@ def check(model, history, *,
 
     return {"valid?": True, "op_count": len(calls),
             "configs": _render_configs(configs, calls, limit=10)}
+
+
+def _final_paths(configs, calls, failing_cid: int, pending,
+                 limit: int = 10):
+    """Why each surviving configuration could not linearize the failing
+    call: for every config (truncated to `limit`, the reference's own
+    cap — knossos final-paths 'can take *hours*' to write,
+    checker.clj:155-158), the one-step expansion attempts from it and
+    the inconsistency each produced."""
+    from jepsen_tpu.models import is_inconsistent
+
+    paths = []
+    for mask, m in list(configs)[:limit]:
+        attempts = []
+        for j in sorted(pending):
+            if j in mask:
+                continue
+            m2 = m.step(calls[j].op)
+            attempts.append({
+                "op": calls[j].op.to_dict(),
+                "result": (m2.msg if is_inconsistent(m2) else repr(m2)),
+                "inconsistent": is_inconsistent(m2),
+            })
+        paths.append({
+            "model": m,
+            "pending-linearized": sorted(
+                calls[c].op.index for c in mask
+                if calls[c].op.index is not None),
+            "attempts": attempts,
+        })
+    return paths
 
 
 def _render_configs(configs, calls, limit: int = 10):

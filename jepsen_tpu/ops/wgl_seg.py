@@ -1588,7 +1588,7 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
             # the oracle terminates at the first non-linearizable op
             from jepsen_tpu.ops import wgl_cpu
             oracle = wgl_cpu.check(model, history)
-            for key in ("op", "op_index", "final_paths"):
+            for key in ("op", "op_index", "final-paths", "configs"):
                 if key in oracle:
                     result[key] = oracle[key]
     return result
@@ -1714,7 +1714,7 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
                 prefix = History(
                     [o for o in history if o.index <= cutoff])
                 oracle = wgl_cpu.check(model, prefix)
-                for key in ("op", "op_index", "final_paths"):
+                for key in ("op", "op_index", "final-paths", "configs"):
                     if key in oracle:
                         result[key] = oracle[key]
     return result
@@ -1876,7 +1876,7 @@ def _emit_batch_result(results, i, fk, ok: bool, backend_name: str,
         if localize and not isinstance(histories[i], PreparedHistory):
             from jepsen_tpu.ops import wgl_cpu
             oracle = wgl_cpu.check(model, histories[i])
-            for key in ("op", "op_index", "final_paths"):
+            for key in ("op", "op_index", "final-paths", "configs"):
                 if key in oracle:
                     results[i][key] = oracle[key]
 

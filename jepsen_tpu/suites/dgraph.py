@@ -321,22 +321,116 @@ class HttpConn:
                    "application/json")
 
     def cas(self, k, old, new) -> bool:  # pragma: no cover - cluster
-        cur = self.get(k)
-        if cur != old:
-            return False
-        self.set_kv(k, new)
-        return True
+        """Atomic CAS via dgraph's conditional upsert block: the query
+        matches the record only at the expected value, and the mutation
+        applies @if the match is non-empty — compare and swap both
+        execute inside ONE server-side transaction (the reference's
+        client gets the same guarantee from with-txn + conflict-as-fail,
+        dgraph/client.clj).  A read-check-then-put here would fabricate
+        linearizability violations and blame dgraph."""
+        out = self._post("/mutate?commitNow=true", json.dumps({
+            "query": '{ v as q(func: eq(key, %s)) '
+                     '@filter(eq(value, %s)) { uid } }'
+                     % (json.dumps(str(k)), json.dumps(old)),
+            "mutations": [{
+                "set": [{"uid": "uid(v)", "key": str(k), "value": new}],
+                "cond": "@if(gt(len(v), 0))",
+            }],
+        }), "application/json")
+        matched = ((out.get("data") or {}).get("queries") or {}).get("q")
+        return bool(matched)
 
     def upsert(self, k, candidate):  # pragma: no cover - cluster
-        """Read-or-create: returns the winning id for key k."""
-        cur = self.get(k)
-        if cur is None:
-            self.set_kv(k, candidate)
-            return candidate
-        return cur
+        """Read-or-create in one conditional upsert block (create only
+        @if no record exists), then read the winner."""
+        self._post("/mutate?commitNow=true", json.dumps({
+            "query": '{ v as q(func: eq(key, %s)) { uid } }'
+                     % json.dumps(str(k)),
+            "mutations": [{
+                "set": [{"key": str(k), "value": candidate}],
+                "cond": "@if(eq(len(v), 0))",
+            }],
+        }), "application/json")
+        return self.get(k)
 
     def read_keys(self, ks) -> list:
         return [self.get(k) for k in ks]
+
+    # -- UID addressing (linearizable_register.clj uid-workload,
+    # set.clj uid-workload: avoid the key index entirely) -------------
+    def alloc(self, value):  # pragma: no cover - cluster
+        """Insert a new record, returning its uid."""
+        out = self._post("/mutate?commitNow=true",
+                         json.dumps({"set": [{"value": value}]}),
+                         "application/json")
+        uids = (out.get("data") or {}).get("uids") or {}
+        return next(iter(uids.values()), None)
+
+    def get_uid(self, uid):  # pragma: no cover - cluster
+        out = self._post(
+            "/query",
+            '{ q(func: uid(%s)) { value } }' % uid, "application/dql")
+        vals = [row.get("value")
+                for row in (out.get("data") or {}).get("q") or []]
+        return vals[0] if vals else None
+
+    def set_uid(self, uid, value):  # pragma: no cover - cluster
+        self._post("/mutate?commitNow=true",
+                   json.dumps({"set": [{"uid": uid, "value": value}]}),
+                   "application/json")
+
+    def cas_uid(self, uid, old, new) -> bool:  # pragma: no cover - cluster
+        """Conditional upsert on one uid: atomic like cas()."""
+        out = self._post("/mutate?commitNow=true", json.dumps({
+            "query": '{ v as q(func: uid(%s)) '
+                     '@filter(eq(value, %s)) { uid } }'
+                     % (uid, json.dumps(old)),
+            "mutations": [{
+                "set": [{"uid": uid, "value": new}],
+                "cond": "@if(gt(len(v), 0))",
+            }],
+        }), "application/json")
+        matched = ((out.get("data") or {}).get("queries") or {}).get("q")
+        return bool(matched)
+
+    def alter_schema(self, schema: str):  # pragma: no cover - cluster
+        self._post("/alter", schema, "application/dql")
+
+    def add_uid_value(self, uid, value):  # pragma: no cover - cluster
+        """Append an element to the `members: [int]` LIST predicate on
+        uid (requires alter_schema — a scalar predicate would be
+        overwritten per add, and the set checker would then blame
+        dgraph for losing acknowledged elements)."""
+        self._post("/mutate?commitNow=true",
+                   json.dumps({"set": [{"uid": uid, "members": value}]}),
+                   "application/json")
+
+    def read_uid_values(self, uid) -> list:  # pragma: no cover - cluster
+        out = self._post(
+            "/query",
+            '{ q(func: uid(%s)) { members } }' % uid, "application/dql")
+        vals = []
+        for row in (out.get("data") or {}).get("q") or []:
+            v = row.get("members")
+            vals.extend(v if isinstance(v, list) else [v])
+        return [v for v in vals if v is not None]
+
+    # -- entity/attribute triples (types.clj) --------------------------
+    def write_triple(self, attr, value):  # pragma: no cover - cluster
+        """Write _:e <attr> value, returning the new entity id."""
+        out = self._post("/mutate?commitNow=true",
+                         json.dumps({"set": [{attr: value}]}),
+                         "application/json")
+        uids = (out.get("data") or {}).get("uids") or {}
+        return next(iter(uids.values()), None)
+
+    def read_triple(self, entity, attr):  # pragma: no cover - cluster
+        out = self._post(
+            "/query",
+            '{ q(func: uid(%s)) { %s } }' % (entity, attr),
+            "application/dql")
+        rows = (out.get("data") or {}).get("q") or []
+        return rows[0].get(attr) if rows else None
 
     def close(self):
         self._session.close()
@@ -477,6 +571,90 @@ class UpsertClient(DgraphClient):
             v = self.conn.get(f"ups-{k}")
             return op.assoc(type="ok",
                             value=[k, [] if v is None else [v]])
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class UidRegisterClient(DgraphClient):
+    """linearizable_register.clj UidClient :90-151: registers addressed
+    by raw UID instead of the key index.  The first writer of a key
+    races to install the key->uid mapping; a writer that loses the
+    race reports :fail (:lost-uid-race) because its record will never
+    be read again — exactly the reference's accounting."""
+
+    def _invoke(self, test, op):
+        uids = test.setdefault("uid-register-map", {})
+        lock = test.setdefault("uid-register-lock", threading.Lock())
+        k, v = op.value
+        uid = uids.get(k)
+        if op.f == "read":
+            val = self.conn.get_uid(uid) if uid is not None else None
+            return op.assoc(type="ok",
+                            value=independent.tuple_(k, val))
+        if op.f == "write":
+            if uid is not None:
+                self.conn.set_uid(uid, v)
+                return op.assoc(type="ok")
+            u = self.conn.alloc(v)
+            with lock:
+                won = uids.setdefault(k, u)
+            if won == u:
+                return op.assoc(type="ok")
+            return op.assoc(type="fail", error="lost-uid-race")
+        if op.f == "cas":
+            old, new = v
+            if uid is None:
+                return op.assoc(type="fail", error="not-found")
+            if self.conn.cas_uid(uid, old, new):
+                return op.assoc(type="ok")
+            return op.assoc(type="fail", error="value-mismatch")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class UidSetClient(DgraphClient):
+    """set.clj uid-workload :111-122: every element stored on ONE
+    record addressed by uid, no index involved."""
+
+    def setup(self, test):
+        if hasattr(self.conn, "alter_schema"):
+            self.conn.alter_schema("members: [int] .")
+
+    def _invoke(self, test, op):
+        box = test.setdefault("uid-set-box", [None])
+        lock = test.setdefault("uid-set-lock", threading.Lock())
+        if op.f == "add":
+            with lock:
+                if box[0] is None:
+                    box[0] = self.conn.alloc(None)
+                    uid = box[0]
+                else:
+                    uid = box[0]
+            self.conn.add_uid_value(uid, op.value)
+            return op.assoc(type="ok")
+        if op.f == "read":
+            uid = box[0]
+            vals = (self.conn.read_uid_values(uid)
+                    if uid is not None else [])
+            return op.assoc(type="ok", value=sorted(set(vals)))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class TypesClient(DgraphClient):
+    """types.clj Client: write (entity, attribute, value) triples and
+    read them back by entity — hunts type-coercion and integer-overflow
+    bugs at int64 boundaries."""
+
+    def _invoke(self, test, op):
+        ents = test.setdefault("types-entities", [])
+        lock = test.setdefault("types-entities-lock", threading.Lock())
+        e, a, v = op.value
+        if op.f == "write":
+            eid = self.conn.write_triple(a, v)
+            with lock:
+                ents.append((eid, a, v))
+            return op.assoc(type="ok", value=[eid, a, v])
+        if op.f == "read":
+            got = self.conn.read_triple(e, a)
+            return op.assoc(type="ok", value=[e, a, got])
         raise ValueError(f"unknown f {op.f!r}")
 
 
@@ -684,6 +862,119 @@ def _sequential(opts, test) -> dict:
                                    "perf": ck.perf()})}
 
 
+def _uid_register(opts, test) -> dict:
+    """linearizable_register.clj uid-workload :151-157: the register
+    test addressed by raw UIDs (per-key-limit 1024, extra stagger)."""
+    o = dict(opts or {})
+    o.setdefault("per-key-limit", 1024)
+    wl = linreg_wl.suite_workload(o)
+    test["concurrency"] = _rounded_concurrency(
+        o, wl["threads-per-key"])
+    return {"client": UidRegisterClient(),
+            "generator": gen.stagger(0.05, wl["generator"]),
+            "checker": ck.compose({
+                "linear": wl["checker"],
+                "timeline": independent.checker(
+                    timeline.html_timeline()),
+                "perf": ck.perf()})}
+
+
+def _uid_set(opts, test) -> dict:
+    """set.clj uid-workload :111-122: every element on one record."""
+    wl = sets_wl.workload(opts)
+    return {"client": UidSetClient(), "generator": wl["generator"],
+            "final-generator": wl["final-generator"],
+            "checker": ck.compose({"set": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
+# types.clj cases: int64-boundary values (Byte/Short/Integer/Long MAX,
+# exact-float/double limits, past-int64 bigints), ranges of 17 around
+# +/- each — hunting type coercion and overflow.
+_TYPE_POINTS = [0, 127, 32767, 2147483647, 9223372036854775807,
+                16777217, 9007199254740993, 3 * 9223372036854775807]
+
+
+def _type_cases():
+    cases = []
+    for a in ("foo", "int64"):
+        vals = []
+        for x in _TYPE_POINTS:
+            vals.extend(range(x - 8, x + 9))
+            vals.extend(range(-x - 8, -x + 9))
+        for v in vals:
+            cases.append((a, v))
+    return cases
+
+
+def _types(opts, test) -> dict:
+    """types.clj workload :162-189: write every boundary triple, wait,
+    then read each back 3x; the checker zips writes to reads and flags
+    any value that round-trips differently."""
+    cases = _type_cases()
+    if opts.get("type-cases"):
+        # test hook: small slice from the TAIL — that is where the
+        # int64-boundary values live
+        cases = cases[-int(opts["type-cases"]):]
+
+    writes = gen.gseq([
+        {"type": "invoke", "f": "write", "value": [None, a, v]}
+        for a, v in cases])
+
+    # Shared BY LIST IDENTITY with the clients: core.run shallow-copies
+    # the test map, so the dict written here is not the runtime dict —
+    # but this list is the same object in both.
+    ents: list = []
+    test["types-entities"] = ents
+    box: dict = {}
+
+    def reads():
+        # memoize: Derefer derefs on EVERY op; a fresh generator each
+        # time would never advance
+        if "g" not in box:
+            box["g"] = gen.gseq(
+                [{"type": "invoke", "f": "read", "value": [e, a, None]}
+                 for e, a, _ in ents for _i in range(3)])
+        return box["g"]
+
+    class TypesChecker(ck.Checker):
+        def check(self, tst_, history, opts_=None):
+            from jepsen_tpu.history import History
+            state, read_back, errs = {}, {}, []
+            for o in History(history):
+                if not o.is_ok or not isinstance(o.value, (list, tuple)):
+                    continue
+                e, a, v = o.value
+                if o.f == "write":
+                    state[(e, a)] = v
+                elif o.f == "read" and v is not None:
+                    read_back[(e, a)] = v
+                    # EVERY read must round-trip; a later correct read
+                    # must not mask an earlier corrupted one
+                    if (e, a) in state and v != state[(e, a)]:
+                        errs.append({"entity": e, "attribute": a,
+                                     "wrote": state[(e, a)], "read": v})
+            unread = sorted(k for k in state if k not in read_back)
+            mapping: dict = {}
+            for (e, a), w in sorted(state.items(),
+                                    key=lambda kv: repr(kv[0])):
+                mapping.setdefault(a, {})[w] = read_back.get((e, a))
+            return {"valid?": (False if errs
+                               else "unknown" if unread else True),
+                    "error-count": len(errs),
+                    "unread-count": len(unread),
+                    "errors": errs[:32],
+                    "unread": unread[:32],
+                    "mapping": {a: dict(list(m.items())[:64])
+                                for a, m in mapping.items()}}
+
+    return {"client": TypesClient(),
+            "generator": gen.stagger(0.01, writes),
+            "final-generator": gen.derefer(reads),
+            "checker": ck.compose({"types": TypesChecker(),
+                                   "perf": ck.perf()})}
+
+
 def _long_fork(opts, test) -> dict:
     wl = long_fork_wl.workload(opts)
     return {"client": LongForkClient(), "generator": wl["generator"],
@@ -696,9 +987,12 @@ workloads = {
     "delete": _delete,
     "long-fork": _long_fork,
     "linearizable-register": _register,
+    "uid-linearizable-register": _uid_register,
     "upsert": _upsert,
     "set": _set,
+    "uid-set": _uid_set,
     "sequential": _sequential,
+    "types": _types,
 }
 
 

@@ -40,12 +40,27 @@ def _page(title: str, body: str) -> bytes:
             "</style></head><body>" + body + "</body></html>").encode()
 
 
+# fast-tests memoization (web.clj:48-69): results.json files are
+# immutable once written, so each (name, ts) loads at most once per
+# process and the dashboard stays responsive with hundreds of runs.
+_results_cache: dict = {}
+
+
+def _cached_validity(name: str, ts: str):
+    key = (name, ts)
+    if key not in _results_cache:
+        res = store.load_results(name, ts)
+        if res is None:
+            return None              # analysis still running: retry later
+        _results_cache[key] = res.get("valid?")
+    return _results_cache[key]
+
+
 def _test_rows() -> list:
     rows = []
     for name, stamps in sorted(store.tests().items()):
         for ts in sorted(stamps, reverse=True):
-            res = store.load_results(name, ts)
-            rows.append((name, ts, (res or {}).get("valid?")))
+            rows.append((name, ts, _cached_validity(name, ts)))
     rows.sort(key=lambda r: r[1], reverse=True)
     return rows
 

@@ -19,3 +19,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from __graft_entry__ import _pin_virtual_cpu  # noqa: E402
 
 _pin_virtual_cpu(8)
+
+import jax  # noqa: E402
+
+# Persistent XLA compilation cache: kernel-shape compiles dominate the
+# suite's wall time on this host; cached compiles make re-runs cheap.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.dirname(
+                      os.path.abspath(__file__))), ".cache", "jax-tests"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -149,6 +149,24 @@ class TestWeb:
         with urllib.request.urlopen(url, timeout=10) as r:
             return r.status, r.read(), dict(r.headers)
 
+    def test_results_memoized(self, served, monkeypatch):
+        # web.clj:48-69 parity: results.json is immutable, so a second
+        # dashboard render must not re-read it.
+        calls = []
+        real = store.load_results
+
+        def counting(name, ts):
+            calls.append((name, ts))
+            return real(name, ts)
+
+        web._results_cache.clear()
+        monkeypatch.setattr(store, "load_results", counting)
+        web.home_html()
+        first = len(calls)
+        web.home_html()
+        assert first > 0
+        assert len(calls) == first, "second render re-read results"
+
     def test_home_lists_tests_with_colors(self, served):
         status, body, _ = self.get(served + "/")
         assert status == 200

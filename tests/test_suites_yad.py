@@ -323,6 +323,49 @@ class MemDgraph:
                     mem.kv[to] = mem.kv.get(to, 0) + amt
                     return True
 
+            # -- uid addressing + triples (uid/types workloads) ----
+            def alloc(self, value):
+                with mem.lock:
+                    uid = f"0x{len(mem.kv) + 1000:x}"
+                    mem.kv[("uid", uid)] = value
+                    return uid
+
+            def get_uid(self, uid):
+                with mem.lock:
+                    return mem.kv.get(("uid", uid))
+
+            def set_uid(self, uid, value):
+                with mem.lock:
+                    mem.kv[("uid", uid)] = value
+
+            def cas_uid(self, uid, old, new):
+                with mem.lock:
+                    if mem.kv.get(("uid", uid)) == old:
+                        mem.kv[("uid", uid)] = new
+                        return True
+                    return False
+
+            def add_uid_value(self, uid, value):
+                with mem.lock:
+                    cur = mem.kv.setdefault(("uidset", uid), [])
+                    cur.append(value)
+
+            def read_uid_values(self, uid):
+                with mem.lock:
+                    one = mem.kv.get(("uid", uid))
+                    vals = list(mem.kv.get(("uidset", uid), []))
+                    return ([one] if one is not None else []) + vals
+
+            def write_triple(self, attr, value):
+                with mem.lock:
+                    eid = f"0x{len(mem.kv) + 2000:x}"
+                    mem.kv[("triple", eid, attr)] = value
+                    return eid
+
+            def read_triple(self, entity, attr):
+                with mem.lock:
+                    return mem.kv.get(("triple", entity, attr))
+
             def close(self):
                 pass
 
@@ -351,8 +394,10 @@ class TestDgraph:
         ("delete", "delete"),
         ("long-fork", "long-fork"),
         ("linearizable-register", "linear"),
+        ("uid-linearizable-register", "linear"),
         ("upsert", "upsert"),
         ("set", "set"),
+        ("uid-set", "set"),
         ("sequential", "sequential"),
     ])
     def test_workloads_valid(self, workload, key):
@@ -360,6 +405,42 @@ class TestDgraph:
         res = result["results"]
         assert res[key]["valid?"] is True, res[key]
         assert res["valid?"] is True
+
+    def test_types_roundtrip_valid(self):
+        result, _ = run_dg("types", time_limit=3,
+                           extra={"type-cases": 24})
+        res = result["results"]
+        assert res["types"]["valid?"] in (True, "unknown"), res["types"]
+        assert res["types"]["error-count"] == 0
+
+    def test_types_detects_truncation(self):
+        # A backend that truncates to 32-bit must be flagged: exactly
+        # the overflow bug class types.clj hunts.
+        mem = MemDgraph()
+        base = mem.factory
+
+        def truncating(node):
+            conn = base(node)
+            real = conn.write_triple
+
+            def write_triple(attr, value):
+                return real(attr, ((value + 2**31) % 2**32) - 2**31)
+            conn.write_triple = write_triple
+            return conn
+
+        cmds = []
+        control.set_dummy_handler(dummy_handler(cmds))
+        try:
+            opts = {"nodes": ["n1", "n2", "n3"], "concurrency": 4,
+                    "time-limit": 3, "workload": "types",
+                    "ssh": {"dummy": True}, "dgraph-factory": truncating,
+                    "quiesce": 0.1, "type-cases": 40}
+            result = core.run(dg.dgraph_test(opts))
+        finally:
+            control.set_dummy_handler(None)
+        res = result["results"]
+        assert res["types"]["valid?"] is False
+        assert res["types"]["error-count"] > 0
 
     def test_tracing_spans_collected(self):
         result, _ = run_dg("set", extra={"trace": True})
@@ -376,7 +457,10 @@ class TestDgraph:
         assert any("alpha" in c for _, c in cmds)
 
     def test_nemesis_flags(self):
-        nm = dg.nemesis_for({"kill-alpha?": True, "partition?": True})
+        # tiny stagger: the default 5s interval makes 40 draws take
+        # minutes of real sleeping
+        nm = dg.nemesis_for({"kill-alpha?": True, "partition?": True,
+                             "nemesis-interval": 0.01})
         fs = set()
         for _ in range(40):
             o = gen.op(nm["generator"],

@@ -79,10 +79,9 @@ class TestSingleHistory:
                      ok_op(0, "write", 3)]).index()
         assert wgl_seg.check(models.CASRegister(), h)["valid?"] is True
 
-    @pytest.mark.parametrize("tr", [4, 16, 512])
-    def test_differential_vs_cpu_oracle(self, tr):
+    def _differential(self, tr, seeds):
         mism = []
-        for seed in range(25):
+        for seed in seeds:
             h = rand_history(seed, buggy=(seed % 3 == 0),
                              conc=4 if seed % 2 else 3)
             want = wgl_cpu.check(models.CASRegister(), h)["valid?"]
@@ -91,6 +90,16 @@ class TestSingleHistory:
             if want != got:
                 mism.append(seed)
         assert not mism
+
+    def test_differential_vs_cpu_oracle(self):
+        # CI-shaped smoke slice; the full 25-seed x 3-granularity
+        # battery is the slow twin below.
+        self._differential(16, range(8))
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("tr", [4, 16, 512])
+    def test_differential_vs_cpu_oracle_full(self, tr):
+        self._differential(tr, range(25))
 
     def test_many_segments_produced(self):
         h = rand_history(3, n_ops=400)
@@ -191,9 +200,9 @@ class TestCrashed:
     CPU oracle — knossos treats a crashed op as concurrent with the
     entire rest of the history, doc/tutorial/06-refining.md:12-19)."""
 
-    def test_differential_battery(self):
+    def _battery(self, seeds):
         model = lambda: models.CASRegister()  # noqa: E731
-        for seed in range(5):
+        for seed in seeds:
             h = crash_history(seed, n_calls=30, corrupt=seed % 2 == 1)
             o = wgl_cpu.check(model(), h)
             try:
@@ -201,6 +210,13 @@ class TestCrashed:
             except wgl_seg.Unsupported:
                 continue           # residual case: serial fallback
             assert r["valid?"] == o["valid?"], (seed, r, o)
+
+    def test_differential_battery(self):
+        self._battery(range(2))
+
+    @pytest.mark.slow
+    def test_differential_battery_full(self):
+        self._battery(range(8))
 
     def test_inert_crashed_reads_dropped(self):
         # >_MAX_CRASHED crashed reads: all inert => dropped outright,
@@ -307,6 +323,7 @@ class TestRegsPath:
         res = wgl_seg.check_many(models.CASRegister(), hists)
         assert all(r["engine"] == "wgl_seg_batch_regs" for r in res)
 
+    @pytest.mark.slow
     def test_regs_matches_table_kernel_and_oracle(self, monkeypatch):
         # high concurrency (R up to 6) forces invoke bursts that spill
         # into virtual rows; buggy keys must be flagged by both kernels
@@ -659,6 +676,38 @@ class TestCheckerIntegration:
         assert r["valid?"] == wgl_cpu.check(
             models.CASRegister(), h)["valid?"]
         assert r.get("engine") == "wgl_seg"
+
+    def test_competition_mode(self):
+        from jepsen_tpu import checker as ck
+
+        c = ck.linearizable({"model": models.cas_register(),
+                             "algorithm": "competition"})
+        good = rand_history(3)
+        r = c.check({}, good)
+        assert r["valid?"] is True
+        assert r["competition-winner"] in ("device", "cpu")
+        bad = rand_history(4, buggy=True, n_ops=120)
+        o = wgl_cpu.check(models.CASRegister(), bad)
+        r = c.check({}, bad)
+        assert r["valid?"] == o["valid?"]
+
+    def test_invalid_device_verdict_carries_analysis_artifacts(self):
+        # checker.clj:155-158 parity: configs + final-paths (truncated
+        # to 10) accompany invalid verdicts even on the device path.
+        from jepsen_tpu import checker as ck
+
+        h = History([invoke_op(0, "write", 1), ok_op(0, "write", 1),
+                     invoke_op(1, "read", None),
+                     ok_op(1, "read", 2)]).index()
+        c = ck.linearizable({"model": models.cas_register()})
+        r = c.check({}, h)
+        assert r["valid?"] is False
+        assert r.get("engine", "").startswith("wgl")
+        assert isinstance(r.get("configs"), list)
+        paths = r.get("final-paths")
+        assert paths and len(paths) <= 10
+        assert any(at["inconsistent"] for pth in paths
+                   for at in pth["attempts"])
 
     def test_linearizable_crashed_stays_on_device(self):
         # Crash-bearing histories stay on the segment engine (bounded

@@ -92,6 +92,14 @@ def test_cas_and_mutex():
 
 def test_differential_random_valid():
     rng = random.Random(1234)
+    for i in range(5):
+        h = simulate_register_history(rng, n_procs=4, n_ops=50)
+        both(CASRegister(0), h)
+
+
+@pytest.mark.slow
+def test_differential_random_valid_full():
+    rng = random.Random(4321)
     for i in range(15):
         h = simulate_register_history(rng, n_procs=4, n_ops=50)
         both(CASRegister(0), h)
