@@ -21,6 +21,7 @@ from jepsen_tpu.suites.cockroach import (SQLClient, ShellConn,
                                          ensure_table, with_txn_retry,
                                          _rounded_concurrency)
 from jepsen_tpu.workloads import linearizable_register as linreg_wl
+from jepsen_tpu.workloads import dirty_read as dirty_read_wl
 from jepsen_tpu.workloads import sets as sets_wl
 
 DIR = "/opt/crate"
@@ -179,7 +180,199 @@ def sets_test(opts) -> dict:
     return test
 
 
-tests = {"register": register_test, "sets": sets_test}
+class LostUpdatesClient(SQLClient):
+    """crate/lost_updates.clj: a map of keys -> sets of ints, updated
+    by read-modify-write with a `_version` guard — the optimistic-CC
+    pattern whose lost updates crate exhibited.  Ops carry independent
+    [k, v] tuples."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS lu_sets "
+           "(id INT PRIMARY KEY, elements STRING)")
+
+    def _invoke(self, test, op):
+        import json as json_mod
+
+        ensure_table(self.conn, test, self.DDL, "lu_sets")
+        k, v = op.value
+        if op.f == "read":
+            self.conn.sql("REFRESH TABLE lu_sets")
+            rows = with_txn_retry(lambda: self.conn.sql(
+                f"SELECT elements FROM lu_sets WHERE id = {k}"))
+            els = json_mod.loads(rows[0][0]) if rows else []
+            return op.assoc(type="ok",
+                            value=independent.tuple_(k, sorted(els)))
+        if op.f == "add":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                f"SELECT elements, _version FROM lu_sets WHERE id = {k}"))
+            if rows:
+                els = json_mod.loads(rows[0][0])
+                ver = rows[0][1]
+                els2 = json_mod.dumps(els + [v])
+                out = with_txn_retry(lambda: self.conn.sql(
+                    f"UPDATE lu_sets SET elements = '{els2}' "
+                    f"WHERE id = {k} AND _version = {ver} "
+                    "RETURNING id"))
+                # 0 rows: someone else moved _version — the add
+                # definitely did NOT happen
+                return op.assoc(type="ok" if out else "fail")
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO lu_sets (id, elements) "
+                f"VALUES ({k}, '{json_mod.dumps([v])}')"))
+            return op.assoc(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def lost_updates_test(opts) -> dict:
+    """Per-key adds under partitions, quiescence, then one final read
+    per key; every acknowledged add must be in the final set
+    (lost_updates.clj:107-148, checked by independent set checkers).
+
+    Adds are a flat mix over keys — NOT per-key gen.phases inside a
+    mix, whose Synchronize barriers would strand threads on different
+    keys' barriers and run zero ops.  The final per-key reads ride
+    nemesis_schedule's quiesced final phase."""
+    opts = dict(opts or {})
+    test = base(opts, "lost-updates")
+    n_keys = int(opts.get("keys", 4))
+    counter = [0]
+    import random as _r
+    import threading as _t
+    lock = _t.Lock()
+
+    def add(t, p):
+        with lock:
+            counter[0] += 1
+            return {"type": "invoke", "f": "add",
+                    "value": independent.tuple_(
+                        _r.randrange(n_keys), counter[0])}
+
+    final_reads = gen.gseq([
+        {"type": "invoke", "f": "read",
+         "value": independent.tuple_(k, None)} for k in range(n_keys)])
+    test["client"] = LostUpdatesClient()
+    test["checker"] = ck.compose({
+        "set": independent.checker(ck.set_checker()),
+        "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 50, add),
+                     final_gen=final_reads)
+    return test
+
+
+class VersionDivergenceClient(SQLClient):
+    """crate/version_divergence.clj: reads return [value, _version];
+    two reads at the same _version must agree on the value."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS vd_registers "
+           "(id INT PRIMARY KEY, val INT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "vd_registers")
+        k, v = op.value
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                f"SELECT val, _version FROM vd_registers WHERE id = {k}"))
+            val = ([int(rows[0][0]), int(rows[0][1])] if rows else None)
+            return op.assoc(type="ok", value=independent.tuple_(k, val))
+        if op.f == "write":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO vd_registers (id, val) VALUES ({k}, {v}) "
+                f"ON CONFLICT (id) DO UPDATE SET val = {v}"))
+            return op.assoc(type="ok")
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class MultiVersionChecker(ck.Checker):
+    """version_divergence.clj multiversion-checker: group ok reads by
+    _version; every version must map to ONE value."""
+
+    def check(self, test, history, opts=None):
+        from jepsen_tpu.history import History
+
+        by_version: dict = {}
+        for o in History(history):
+            if o.is_ok and o.f == "read" and o.value is not None:
+                val_ver = o.value
+                if isinstance(val_ver, (list, tuple)) and len(val_ver) == 2:
+                    val, ver = val_ver
+                    by_version.setdefault(ver, set()).add(val)
+        multis = {ver: sorted(vals) for ver, vals in by_version.items()
+                  if len(vals) > 1}
+        return {"valid?": not multis, "multis": multis}
+
+
+def version_divergence_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "version-divergence")
+    import random as _r
+
+    def r(t, p):
+        return {"type": "invoke", "f": "read",
+                "value": independent.tuple_(_r.randrange(
+                    int(opts.get("keys", 4))), None)}
+
+    counter = [0]
+    import threading as _t
+    lock = _t.Lock()
+
+    def w(t, p):
+        with lock:
+            counter[0] += 1
+            return {"type": "invoke", "f": "write",
+                    "value": independent.tuple_(
+                        _r.randrange(int(opts.get("keys", 4))),
+                        counter[0])}
+
+    test["client"] = VersionDivergenceClient()
+    test["checker"] = ck.compose({
+        "multi": independent.checker(MultiVersionChecker()),
+        "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 50, gen.mix([r, w])))
+    return test
+
+
+class DirtyReadClient(SQLClient):
+    """crate/dirty_read.clj client over the SQL conn."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS dirty_read (id INT PRIMARY KEY)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "dirty_read")
+        if op.f == "write":
+            with_txn_retry(lambda: self.conn.sql(
+                f"INSERT INTO dirty_read (id) VALUES ({op.value})"))
+            return op.assoc(type="ok")
+        if op.f == "read":
+            rows = with_txn_retry(lambda: self.conn.sql(
+                f"SELECT id FROM dirty_read WHERE id = {op.value}"))
+            return op.assoc(type="ok" if rows else "fail")
+        if op.f == "refresh":
+            self.conn.sql("REFRESH TABLE dirty_read")
+            return op.assoc(type="ok")
+        if op.f == "strong-read":
+            self.conn.sql("REFRESH TABLE dirty_read")
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT id FROM dirty_read"))
+            return op.assoc(type="ok",
+                            value=sorted(int(r0[0]) for r0 in rows))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+def dirty_read_test(opts) -> dict:
+    opts = dict(opts or {})
+    test = base(opts, "dirty-read")
+    wl = dirty_read_wl.workload(opts)
+    test["client"] = DirtyReadClient()
+    test["checker"] = ck.compose({"dirty-read": wl["checker"],
+                                  "perf": ck.perf()})
+    nemesis_schedule(opts, test, gen.stagger(1 / 50, wl["generator"]),
+                     final_gen=wl["final-generator"])
+    return test
+
+
+tests = {"register": register_test, "sets": sets_test,
+         "lost-updates": lost_updates_test,
+         "version-divergence": version_divergence_test,
+         "dirty-read": dirty_read_test}
 
 test_for, _opt_fn, main = workload_main(tests, "register")
 

@@ -11,6 +11,7 @@ import json
 from typing import Optional
 
 from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
 from jepsen_tpu import control as c
 from jepsen_tpu import control_util as cu
 from jepsen_tpu import db as db_mod
@@ -20,6 +21,7 @@ from jepsen_tpu import nemesis as nem
 from jepsen_tpu.control import lit
 from jepsen_tpu.suites._template import (KVRegisterClient,
                                          register_test, workload_main)
+from jepsen_tpu.workloads import dirty_read as dirty_read_wl
 from jepsen_tpu.workloads import sets as sets_wl
 
 DIR = "/opt/elasticsearch"
@@ -70,11 +72,18 @@ class EsHttpConn:
 
     # -- set workload ------------------------------------------------------
     def add(self, v) -> None:
-        self._curl("-X", "PUT",
-                   "-H", "Content-Type: application/json",
-                   "-d", json.dumps({"value": v}),
-                   f"http://{self.node}:{PORT}/{INDEX}/_doc/{v}"
-                   "?wait_for_active_shards=all")
+        out = self._curl("-X", "PUT",
+                         "-H", "Content-Type: application/json",
+                         "-d", json.dumps({"value": v}),
+                         f"http://{self.node}:{PORT}/{INDEX}/_doc/{v}"
+                         "?wait_for_active_shards=all")
+        # Success needs POSITIVE evidence: curl -sf via the control
+        # plane never raises, so a dropped PUT acked as ok would make
+        # the set/dirty-read checkers report data loss against a
+        # healthy cluster.
+        if '"result":"created"' not in (out or "") and \
+                '"result":"updated"' not in (out or ""):
+            raise TimeoutError(f"unacked index write: {out[:120]!r}")
 
     def read_all(self) -> list:
         self._curl("-X", "POST",
@@ -120,8 +129,87 @@ class EsHttpConn:
             f"?if_seq_no={seq}&if_primary_term={term}")
         return "\"result\":\"updated\"" in (out or "")
 
+    # -- dirty-read workload (elasticsearch/dirty_read.clj) -----------
+    def add_id(self, v) -> None:
+        self.add(v)
+
+    def has_id(self, v) -> bool:
+        out = self._curl(
+            f"http://{self.node}:{PORT}/{INDEX}/_doc/{v}")
+        return '"found":true' in (out or "")
+
+    def refresh(self) -> None:
+        self._curl("-X", "POST",
+                   f"http://{self.node}:{PORT}/{INDEX}/_refresh")
+
+    def all_ids(self) -> list:
+        return self.read_all()
+
     def close(self):
         self._session.close()
+
+
+class EsDirtyReadClient(client_mod.Client):
+    """elasticsearch/dirty_read.clj client: GETs of specific ids probe
+    uncommitted visibility; strong reads scan the refreshed index."""
+
+    def __init__(self, conn_factory=EsHttpConn):
+        self.conn_factory = conn_factory
+        self.conn = None
+
+    def open(self, test, node):
+        out = EsDirtyReadClient(test.get("es-factory")
+                                or self.conn_factory)
+        out.conn = out.conn_factory(node)
+        return out
+
+    def close(self, test):
+        if self.conn is not None and hasattr(self.conn, "close"):
+            self.conn.close()
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "write":
+                self.conn.add_id(op.value)
+                return op.assoc(type="ok")
+            if op.f == "read":
+                return op.assoc(
+                    type="ok" if self.conn.has_id(op.value) else "fail")
+            if op.f == "refresh":
+                self.conn.refresh()
+                return op.assoc(type="ok")
+            if op.f == "strong-read":
+                return op.assoc(type="ok", value=self.conn.all_ids())
+            raise ValueError(f"unknown f {op.f!r}")
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except (ConnectionError, OSError) as e:
+            return op.assoc(type="info", error=str(e))
+
+
+def dirty_read_test(opts) -> dict:
+    from jepsen_tpu import tests as tst
+    from jepsen_tpu.suites._template import nemesis_schedule
+
+    opts = dict(opts or {})
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    wl = dirty_read_wl.workload(opts)
+    test = dict(tst.noop_test(), **{
+        "name": "elasticsearch dirty-read",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": ElasticsearchDB(),
+        "net": net.iptables,
+        "nemesis": nem.partition_random_halves(),
+        "es-factory": opts.get("es-factory"),
+        "client": EsDirtyReadClient(),
+        "checker": ck.compose({"dirty-read": wl["checker"],
+                               "perf": ck.perf()}),
+    })
+    nemesis_schedule(opts, test, gen.stagger(1 / 50, wl["generator"]),
+                     final_gen=wl["final-generator"])
+    return test
 
 
 def set_test(opts) -> dict:
@@ -192,7 +280,8 @@ def reg_test(opts) -> dict:
                              or EsHttpConn), opts)
 
 
-tests = {"set": set_test, "register": reg_test}
+tests = {"set": set_test, "register": reg_test,
+         "dirty-read": dirty_read_test}
 
 test_for, _opt_fn, main = workload_main(tests, "set")
 

@@ -7,7 +7,7 @@ test map, mirroring how per-DB suites compose workloads
 """
 
 from jepsen_tpu.workloads import (adya, bank, causal,  # noqa: F401
-                                  counter, dirty_reads,
+                                  counter, dirty_read, dirty_reads,
                                   linearizable_register, long_fork,
                                   monotonic, multi_key_acid, queue,
                                   sequential, sets, single_key_acid,
@@ -21,6 +21,7 @@ WORKLOADS = {
     "causal": causal.workload,
     "monotonic": monotonic.workload,
     "sets": sets.workload,
+    "dirty-read": dirty_read.workload,
     "dirty-reads": dirty_reads.workload,
     "counter": counter.workload,
     "sequential": sequential.workload,

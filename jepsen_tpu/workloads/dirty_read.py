@@ -1,0 +1,95 @@
+"""Dirty-read workload for the crate / elasticsearch suites
+(`crate/src/jepsen/crate/dirty_read.clj`,
+`elasticsearch/src/jepsen/elasticsearch/dirty_read.clj`) — distinct
+from `workloads/dirty_reads.py`, galera's SELECT-during-write variant.
+
+Processes insert sequential ids (`write`), probe recently-written ids
+(`read`: ok iff visible), occasionally `refresh` the index, and finish
+with a `strong-read` of the whole table from every process.  The
+checker verifies (dirty_read.clj:143-193):
+
+  * nodes agree: every final strong read returns the same set;
+  * no dirty reads: no successful single read of an id that is missing
+    from the agreed final strong reads (reads - intersection, as the
+    reference computes it; with nodes-agree required this is exactly
+    a read of state that never committed);
+  * no lost writes: every acknowledged write appears in the final
+    strong reads.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import generator as gen
+from jepsen_tpu.history import History
+
+
+class DirtyReadChecker(ck.Checker):
+    def check(self, test, history, opts=None):
+        writes, reads, strong = set(), set(), []
+        for o in History(history):
+            if not o.is_ok:
+                continue
+            if o.f == "write":
+                writes.add(o.value)
+            elif o.f == "read" and o.value is not None:
+                reads.add(o.value)
+            elif o.f == "strong-read":
+                strong.append(frozenset(o.value or ()))
+        if not strong:
+            return {"valid?": "unknown", "error": "no strong reads"}
+        on_all = frozenset.intersection(*strong)
+        on_some = frozenset.union(*strong)
+        nodes_agree = len(set(strong)) == 1
+        dirty = sorted(reads - on_all)
+        lost = sorted(writes - on_all)
+        some_lost = sorted(writes - on_some)
+        return {"valid?": (nodes_agree and not dirty and not lost),
+                "nodes-agree?": nodes_agree,
+                "read-count": len(reads),
+                "on-all-count": len(on_all),
+                "on-some-count": len(on_some),
+                "not-on-all-count": len(on_some - on_all),
+                "dirty-count": len(dirty), "dirty": dirty[:32],
+                "lost-count": len(lost), "lost": lost[:32],
+                "some-lost-count": len(some_lost),
+                "some-lost": some_lost[:32]}
+
+
+def workload(opts=None) -> dict:
+    opts = dict(opts or {})
+    counter = [0]
+    lock = threading.Lock()
+    rng = random.Random(7)
+
+    def write(test, process):
+        with lock:
+            counter[0] += 1
+            v = counter[0]
+        return {"type": "invoke", "f": "write", "value": v}
+
+    def read(test, process):
+        with lock:
+            hi = counter[0]
+        if hi == 0:
+            return {"type": "invoke", "f": "refresh", "value": None}
+        return {"type": "invoke", "f": "read",
+                "value": rng.randint(max(1, hi - 10), hi)}
+
+    def refresh(test, process):
+        return {"type": "invoke", "f": "refresh", "value": None}
+
+    def strong_read(test, process):
+        return {"type": "invoke", "f": "strong-read", "value": None}
+
+    return {
+        "generator": gen.mix([write, write, read, refresh]),
+        # every process performs one final strong read (the reference
+        # reads from each node to check agreement)
+        "final-generator": gen.each(
+            lambda: gen.once(strong_read)),
+        "checker": DirtyReadChecker(),
+    }
