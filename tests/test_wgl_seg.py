@@ -270,6 +270,42 @@ class TestCrashed:
         assert r.get("crashed_ignored") == ncrash or \
             r.get("crashed_dropped", 0) + r.get("crashed", 0) == ncrash
 
+    def test_mutex_crashed_acquire(self):
+        # A crashed acquire may or may not hold the lock; both
+        # continuations must be explored (acquire is NOT inert).
+        good = History([invoke_op(0, "acquire", None),
+                        info_op(0, "acquire", None),
+                        invoke_op(1, "acquire", None),
+                        ok_op(1, "acquire", None)]).index()
+        o = wgl_cpu.check(models.Mutex(), good)
+        r = wgl_seg.check(models.Mutex(), good)
+        assert r["valid?"] == o["valid?"] is True
+
+        # two COMPLETED acquires with no release can never both
+        # linearize, crashed acquire or not
+        bad = History([invoke_op(0, "acquire", None),
+                       info_op(0, "acquire", None),
+                       invoke_op(1, "acquire", None),
+                       ok_op(1, "acquire", None),
+                       invoke_op(2, "acquire", None),
+                       ok_op(2, "acquire", None)]).index()
+        o = wgl_cpu.check(models.Mutex(), bad)
+        r = wgl_seg.check(models.Mutex(), bad)
+        assert r["valid?"] == o["valid?"] is False
+
+    def test_crashed_release_consumed(self):
+        # The second acquire is only linearizable if the CRASHED
+        # release took effect - consumption on the mutex model.
+        h = History([invoke_op(0, "acquire", None),
+                     ok_op(0, "acquire", None),
+                     invoke_op(0, "release", None),
+                     invoke_op(1, "acquire", None),
+                     ok_op(1, "acquire", None),
+                     info_op(0, "release", None)]).index()
+        o = wgl_cpu.check(models.Mutex(), h)
+        r = wgl_seg.check(models.Mutex(), h)
+        assert r["valid?"] == o["valid?"] is True
+
     def test_residual_many_effectful_crashes_unsupported(self):
         # Many effect-bearing crashed writes whose effects are observed:
         # stripped twin is invalid, bound exceeded => Unsupported (the
