@@ -166,6 +166,67 @@ def test_hung_client_bounded_by_invoke_timeout():
     assert all("timed out" in str(o.error) for o in infos)
 
 
+def test_nemesis_run_with_crashes_checked_on_device():
+    """The whole round-2 story end to end: a flaky client times out
+    under invoke_timeout, the runner journals :info completions and
+    recycles processes, and the linearizability checker handles the
+    crash-bearing history ON the segment engine (crash tiers) with the
+    correct verdict."""
+    import time
+
+    TIME_LIMIT = 2.5
+    # The hang must outlast the run: the abandoned invoke thread DOES
+    # apply its op when the sleep ends, and only the sleep >
+    # TIME_LIMIT relationship keeps that application after the history
+    # closes (an ineffective crash, the strip tier's case).  Shorter
+    # sleeps turn the crashes effectful mid-run, where hundreds of
+    # effect-bearing crashes exceed the bounded kernel and the serial
+    # engine takes over - a different (also correct) path.
+    HANG = TIME_LIMIT + 3
+
+    state = tst.Atom()
+    base = tst.atom_client(state)
+    hangs = {"n": 0}
+    lock = threading.Lock()
+
+    class Flaky(client_mod.Client):
+        def open(self, test, node):
+            out = Flaky()
+            out.inner = base.open(test, node)
+            return out
+
+        def invoke(self, test, op):
+            with lock:
+                hangs["n"] += 1
+                hang = hangs["n"] % 7 == 0
+            if hang:
+                time.sleep(HANG)
+            return self.inner.invoke(test, op)
+
+        def close(self, test):
+            pass
+
+    test = dict(tst.noop_test())
+    test.update({
+        "name": "crashy nemesis run",
+        "db": tst.atom_db(state),
+        "client": Flaky(),
+        "invoke_timeout": 0.15,
+        "concurrency": 4,
+        "generator": gen.nemesis(
+            gen.void, gen.time_limit(TIME_LIMIT, gen.cas)),
+        "checker": ck.linearizable({"model": models.CASRegister(0)}),
+    })
+    result = core.run(test)
+    infos = [o for o in result["history"] if o.is_info]
+    assert infos, "flaky invokes must journal :info completions"
+    res = result["results"]
+    assert res["valid?"] is True, res
+    assert res.get("engine") == "wgl_seg", res.get("engine")
+    assert (res.get("crashed") or res.get("crashed_dropped")
+            or res.get("crashed_ignored")), res
+
+
 class TrackingClient(client_mod.Client):
     """core_test.clj tracking-client :19-37."""
 
