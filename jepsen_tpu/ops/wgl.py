@@ -406,17 +406,20 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int,
         return pm, ps, pv, ovf
 
     def kernel(ret_call, ret_slot, cand_call, cand_slot, fv, av, bv, okv,
-               init_state, n_events, *crash_args):
+               r0, masks0, states0, valid0, n_events, stop_r,
+               *crash_args):
+        """Walk events r0..min(n_events, stop_r).  The frontier enters
+        and leaves as explicit args so check() can CHUNK the walk into
+        bounded device programs — a single program spanning tens of
+        thousands of events runs long enough to trip device-runtime
+        watchdogs on tunneled chips."""
         cwords = gws = luts = None
         if crash_mode:
             cwords, gws, luts = crash_args
-        masks0 = jnp.zeros((F, Wd), u32)
-        states0 = jnp.zeros((F, S), jnp.int32).at[0].set(init_state)
-        valid0 = jnp.zeros(F, bool).at[0].set(True)
 
         def ev_cond(carry):
             r, _, _, _, dead, _ = carry
-            return (r < n_events) & ~dead
+            return (r < n_events) & (r < stop_r) & ~dead
 
         def ev_body(carry):
             r, masks, states, valid, dead, overflow = carry
@@ -491,13 +494,39 @@ def _build_kernel(step_fn, pure_fn, F: int, C: int, W: int, S: int,
 
         r, masks, states, valid, dead, overflow = jax.lax.while_loop(
             ev_cond, ev_body,
-            (jnp.int32(0), masks0, states0, valid0, jnp.bool_(False),
+            (r0, masks0, states0, valid0, jnp.bool_(False),
              jnp.bool_(False)))
         return {"ok": ~dead, "failed_event": jnp.where(dead, r - 1, -1),
                 "overflow": overflow, "frontier": jnp.sum(valid),
+                "r": r, "final_masks": masks,
                 "final_states": states, "final_valid": valid}
 
     return jax.jit(kernel)
+
+
+@functools.lru_cache(maxsize=32)
+def _init_frontier_fn(F: int, Wd: int, S: int):
+    """Jitted initial-frontier builder: only the S-element init state
+    crosses the link; the F-row zero arrays materialize on device."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(init_state):
+        masks = jnp.zeros((F, Wd), jnp.uint32)
+        states = jnp.zeros((F, S), jnp.int32).at[0].set(init_state)
+        valid = jnp.zeros(F, bool).at[0].set(True)
+        return masks, states, valid
+
+    return jax.jit(init)
+
+
+def init_frontier(F: int, W: int, S: int, init_state):
+    """(masks0, states0, valid0) for a frontier of F rows over W mask
+    bits — the ONE definition of the frontier layout, shared by check()
+    and the driver graft entry."""
+    Wd = max((int(W) + 31) // 32, 1)
+    return _init_frontier_fn(int(F), Wd, int(S))(
+        np.asarray(init_state, np.int32))
 
 
 def _bucket(x: int, minimum: int = 1) -> int:
@@ -509,11 +538,17 @@ def _bucket(x: int, minimum: int = 1) -> int:
 
 def check(model, history, *,
           frontier_sizes: Sequence[int] = (1024, 8192, 65536),
-          pad: bool = True) -> dict[str, Any]:
+          pad: bool = True,
+          events_per_call: int = 2048) -> dict[str, Any]:
     """Check linearizability of `history` against `model` on the default
-    JAX backend.  Returns a knossos-shaped analysis map (same keys as
+    JAX backend, walking events in device programs of at most
+    `events_per_call` events (one unbounded program trips tunneled-chip
+    watchdogs).  Returns a knossos-shaped analysis map (same keys as
     ops.wgl_cpu.check) plus timing info."""
     import jax
+
+    if events_per_call < 1:
+        raise ValueError("events_per_call must be >= 1")
 
     spec = model.device_spec()
     if spec is None:
@@ -589,12 +624,28 @@ def check(model, history, *,
             continue
         kern = _build_kernel(spec.step, spec.pure, int(F), int(C), int(W),
                              int(S), crash_sizes)
+        masks0, states0, valid0 = init_frontier(F, W, S, pl.init_state)
         t1 = time.monotonic()
-        out = kern(pl.ret_call, pl.ret_slot, pl.cand_call, pl.cand_slot,
-                   fv, av, bv, okv, pl.init_state,
-                   np.int32(pl.n_events), *crash_args)
-        ok = bool(out["ok"])
-        overflow = bool(out["overflow"])
+        # Chunked walk: each device program covers at most
+        # events_per_call events, with the frontier carried across —
+        # one program spanning a whole long history runs long enough
+        # to trip device-runtime watchdogs on tunneled chips.
+        r = 0
+        overflow = False
+        while True:
+            out = kern(pl.ret_call, pl.ret_slot, pl.cand_call,
+                       pl.cand_slot, fv, av, bv, okv,
+                       np.int32(r), masks0, states0, valid0,
+                       np.int32(pl.n_events),
+                       np.int32(r + events_per_call), *crash_args)
+            ok = bool(out["ok"])
+            overflow = overflow or bool(out["overflow"])
+            r = int(out["r"])
+            if not ok or r >= pl.n_events:
+                break
+            masks0, states0, valid0 = (out["final_masks"],
+                                       out["final_states"],
+                                       out["final_valid"])
         t_kernel = time.monotonic() - t1
         if ok or not overflow:
             result: dict[str, Any] = {
