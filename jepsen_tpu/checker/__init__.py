@@ -193,16 +193,40 @@ class Linearizable(Checker):
         for name, f in racers.items():
             threading.Thread(target=run, args=(name, f),
                              daemon=True, name=f"linear-{name}").start()
-        winner = None
+        # Only a DEFINITIVE verdict (true/false) wins the race: an
+        # :unknown from a racer that hit config-explosion or its
+        # time_limit must not beat the still-running other racer, or
+        # competition would be strictly worse than auto on exactly the
+        # hard histories it targets.  Indefinite results and errors are
+        # held as fallbacks until both racers have reported.
+        indefinite = []
+        errors = []
         for _ in racers:
             name, res = out.get()
-            if not isinstance(res, Exception) and \
-                    res.get("valid?") != "cancelled":
+            if isinstance(res, Exception):
+                errors.append((name, res))
+                continue
+            if res.get("valid?") in ("cancelled", "unknown"):
+                indefinite.append((name, res))
+                continue
+            winner = dict(res)
+            winner["competition-winner"] = name
+            cancel.set()
+            return winner
+        for name, res in indefinite:
+            if res.get("valid?") == "unknown":
                 winner = dict(res)
                 winner["competition-winner"] = name
-                cancel.set()
                 return winner
-        raise res  # both failed: surface the last error
+        # Both racers failed: surface BOTH messages, chaining the first
+        # failure as __cause__ so neither is silently dropped.
+        (n1, e1), *rest = errors
+        if rest:
+            n2, e2 = rest[0]
+            raise RuntimeError(
+                f"both competition racers failed: {n1}: {e1!r}; "
+                f"{n2}: {e2!r}") from e1
+        raise e1
 
     def check(self, test, history, opts=None):
         from jepsen_tpu.ops import wgl_cpu

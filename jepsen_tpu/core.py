@@ -19,7 +19,7 @@ from jepsen_tpu import control, db as db_mod
 from jepsen_tpu import generator as gen
 from jepsen_tpu.history import History, Op, op as to_op
 from jepsen_tpu.util import (fcatch, log_op, real_pmap, relative_time_nanos,
-                             timeout as util_timeout, with_relative_time)
+                             with_relative_time)
 
 log = logging.getLogger("jepsen")
 
@@ -81,6 +81,19 @@ class InvokeTimeout(Exception):
     """A client.invoke exceeded the test's :invoke-timeout bound."""
 
 
+class InvokeNeverRan(Exception):
+    """The abandoned-invoker cap rejected an op BEFORE its invoke thread
+    was spawned: the op definitively did not take effect, so the sound
+    completion is :fail (history unchanged) — not :info, which would
+    flood the checker with phantom crashed calls that stay concurrent
+    forever and blow up the WGL config space."""
+
+
+_MAX_ABANDONED = 128
+_abandoned: list = []               # done-events of abandoned invokers
+_abandoned_lock = threading.Lock()
+
+
 def _bounded_invoke(client, test, op: Op, seconds: float):
     """client.invoke with a wall-clock bound.  On timeout the invoking
     thread is abandoned (exactly like util.timeout and the reference's
@@ -90,7 +103,28 @@ def _bounded_invoke(client, test, op: Op, seconds: float):
     can no longer overrun a generator time_limit indefinitely.  A late
     result from the abandoned thread is discarded, which is sound: the
     op is already journaled :info (indeterminate, may or may not have
-    taken effect)."""
+    taken effect).
+
+    Leak bound: each timeout abandons one daemon thread, which lives
+    until its client call returns.  Against a fully wedged cluster the
+    process-wide count of live abandoned threads is capped at
+    _MAX_ABANDONED.  At the cap a new invoke first waits its full
+    timeout budget for the oldest abandoned thread to retire (keeping
+    the one-op-per-timeout throttle rather than spinning), then — if
+    still saturated — raises InvokeNeverRan WITHOUT spawning a thread,
+    which the caller journals as :fail (definitely-no-effect)."""
+    with _abandoned_lock:
+        _abandoned[:] = [d for d in _abandoned if not d.is_set()]
+        oldest = _abandoned[0] if len(_abandoned) >= _MAX_ABANDONED \
+            else None
+    if oldest is not None:
+        oldest.wait(seconds)
+        with _abandoned_lock:
+            _abandoned[:] = [d for d in _abandoned if not d.is_set()]
+            if len(_abandoned) >= _MAX_ABANDONED:
+                raise InvokeNeverRan(
+                    f"{len(_abandoned)} abandoned invokers still live "
+                    f"(cluster wedged?); op not attempted")
     box: list = [None]
     err: list = [None]
     done = threading.Event()
@@ -107,10 +141,40 @@ def _bounded_invoke(client, test, op: Op, seconds: float):
                          name=f"invoke-{op.process}")
     t.start()
     if not done.wait(seconds):
+        with _abandoned_lock:
+            _abandoned.append(done)
         raise InvokeTimeout(f"invoke timed out after {seconds}s")
     if err[0] is not None:
         raise err[0]
     return box[0]
+
+
+def _bounded_close(client, test, seconds: float):
+    """Bounded client.close whose abandoned closer thread counts toward
+    the same _MAX_ABANDONED registry as timed-out invokers — otherwise
+    each recycled process would leak an uncapped closer thread and the
+    invoke cap's process-wide bound would be fiction.  At the cap the
+    close is skipped outright: the connection is already presumed dead
+    and the client object is being discarded either way."""
+    with _abandoned_lock:
+        _abandoned[:] = [d for d in _abandoned if not d.is_set()]
+        if len(_abandoned) >= _MAX_ABANDONED:
+            return
+    done = threading.Event()
+
+    def run():
+        try:
+            client.close(test)
+        except Exception:
+            pass
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True, name="close-bounded")
+    t.start()
+    if not done.wait(seconds):
+        with _abandoned_lock:
+            _abandoned.append(done)
 
 
 def invoke_op(op: Op, test, client, abort) -> Op:
@@ -125,6 +189,9 @@ def invoke_op(op: Op, test, client, abort) -> Op:
         else:
             completion = client.invoke(test, op)
         completion = to_op(completion).assoc(time=relative_time_nanos())
+    except InvokeNeverRan as e:
+        completion = op.assoc(type="fail", time=relative_time_nanos(),
+                              error=str(e))
     except BaseException as e:
         if abort.is_set():
             raise
@@ -211,8 +278,7 @@ class ClientWorker(Worker):
                         # abandoning the closer thread on timeout.
                         timeout_s = test.get("invoke_timeout")
                         if timeout_s:
-                            util_timeout(timeout_s, None,
-                                         self.client.close, test)
+                            _bounded_close(self.client, test, timeout_s)
                         else:
                             self.client.close(test)
                     except Exception:

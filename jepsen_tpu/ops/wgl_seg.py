@@ -420,6 +420,8 @@ def _native_scan(ops: list, spec, seen: dict, rows: list,
     the host; returns None for out-of-scope keys just like it."""
     from jepsen_tpu import native
 
+    if getattr(spec, "encode_op", None) is not None:
+        return None    # C scanner encodes via f_codes only; slow path
     mod = native.histscan()
     if mod is None:
         return False                 # extension unavailable

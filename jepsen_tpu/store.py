@@ -149,8 +149,14 @@ def load(name: str, timestamp: str) -> dict:
     return test
 
 
+def results_path(name: str, timestamp: str) -> Path:
+    """Canonical location of a run's results.json (shared with web.py's
+    cache key so layout changes stay in one place)."""
+    return BASE / _sanitize(name) / timestamp / "results.json"
+
+
 def load_results(name: str, timestamp: str) -> Optional[dict]:
-    p = BASE / _sanitize(name) / timestamp / "results.json"
+    p = results_path(name, timestamp)
     if not p.exists():
         return None
     with open(p) as f:

@@ -40,20 +40,35 @@ def _page(title: str, body: str) -> bytes:
             "</style></head><body>" + body + "</body></html>").encode()
 
 
-# fast-tests memoization (web.clj:48-69): results.json files are
-# immutable once written, so each (name, ts) loads at most once per
-# process and the dashboard stays responsive with hundreds of runs.
+# fast-tests memoization (web.clj:48-69): keyed on the results file's
+# mtime as well as (name, ts), so a re-analysis of a stored history
+# (which rewrites results.json in place) invalidates the cached verdict
+# instead of pinning the stale one for the life of the process.
 _results_cache: dict = {}
+_results_cache_lock = threading.Lock()
 
 
 def _cached_validity(name: str, ts: str):
-    key = (name, ts)
-    if key not in _results_cache:
-        res = store.load_results(name, ts)
-        if res is None:
-            return None              # analysis still running: retry later
-        _results_cache[key] = res.get("valid?")
-    return _results_cache[key]
+    try:
+        mtime = store.results_path(name, ts).stat().st_mtime_ns
+    except OSError:
+        return None                  # analysis still running: retry later
+    key = (name, ts, mtime)
+    with _results_cache_lock:
+        if key in _results_cache:
+            return _results_cache[key]
+    res = store.load_results(name, ts)
+    if res is None:
+        return None
+    valid = res.get("valid?")
+    with _results_cache_lock:
+        # Drop stale entries for this run so the cache stays bounded by
+        # the number of distinct runs, not rewrites.  The server is
+        # threaded; iteration and mutation stay under the lock.
+        for k in [k for k in _results_cache if k[:2] == (name, ts)]:
+            del _results_cache[k]
+        _results_cache[key] = valid
+    return valid
 
 
 def _test_rows() -> list:
