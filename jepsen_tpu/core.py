@@ -391,8 +391,8 @@ def run_workers(workers: list[Worker], test=None) -> None:
 def run_case(test) -> History:
     """Spawn nemesis + clients, run one case, return its history
     (core.clj:403-432)."""
-    history = History()
-    lock = threading.RLock()
+    history = History(journal=True)  # columns build as ops land, so
+    lock = threading.RLock()         # analysis starts from arrays
     test["history"] = history
     test["history_lock"] = lock
     with test["active_histories_lock"]:
@@ -416,7 +416,10 @@ def analyze(test) -> dict:
     """Index the history, run the checker, write results
     (core.clj:434-451)."""
     log.info("Analyzing...")
-    history = History(test["history"]).index()
+    history = test["history"]
+    if not isinstance(history, History):   # keep the run's journal
+        history = History(history)
+    history = history.index()
     test["history"] = history
     test["results"] = checker_mod.check_safe(
         test["checker"], test, history)

@@ -171,8 +171,18 @@ class History:
     """An indexed list of Ops with the analysis passes the reference gets
     from knossos.history: `index`, `complete`, `pairs`, `processes`."""
 
-    def __init__(self, ops: Iterable[Any] = ()):
+    def __init__(self, ops: Iterable[Any] = (), journal: bool = False):
         self.ops: list[Op] = [op(o) for o in ops]
+        self._packed: Optional["PackedHistory"] = None
+        # With journal=True (the run loop, core.py run_case), every
+        # append also lands in an incremental ColumnJournal, so the
+        # columnar representation exists the moment the run ends and
+        # analysis never walks the Op objects (SURVEY.md §7).
+        self._journal: Optional["ColumnJournal"] = None
+        if journal:
+            self._journal = ColumnJournal()
+            for o in self.ops:
+                self._journal.append(o)
 
     def __len__(self):
         return len(self.ops)
@@ -188,7 +198,30 @@ class History:
     def append(self, o: Any) -> "Op":
         o = op(o)
         self.ops.append(o)
+        self._packed = None          # columnar cache is positional
+        if self._journal is not None:
+            self._journal.append(o)
         return o
+
+    def packed_columns(self) -> Optional["PackedHistory"]:
+        """The columnar representation if one already exists (attached
+        or journal-built) — WITHOUT walking the ops.  None otherwise;
+        callers that need columns unconditionally use pack()."""
+        if self._packed is not None:
+            return self._packed
+        if self._journal is not None:
+            return self._journal.packed()
+        return None
+
+    def attach_packed(self, packed: "PackedHistory") -> "History":
+        """Attach a pre-built columnar representation (from a
+        ColumnJournal maintained during the run).  pack() then returns
+        it without walking the ops, and the native columnar scan path
+        engages in the checkers."""
+        assert len(packed) == len(self.ops), \
+            (len(packed), len(self.ops))
+        self._packed = packed
+        return self
 
     # -- passes --------------------------------------------------------------
     def index(self) -> "History":
@@ -261,6 +294,10 @@ class History:
     def pack(self, f_codes: Optional[dict] = None,
              value_encoder: Optional[Callable[[Op], tuple[int, int]]] = None,
              ) -> "PackedHistory":
+        if f_codes is None and value_encoder is None:
+            cols = self.packed_columns()
+            if cols is not None:
+                return cols
         return pack_history(self, f_codes, value_encoder)
 
 
@@ -280,6 +317,12 @@ class PackedHistory:
     value_ok: np.ndarray    # bool  [n, 2]
     time: np.ndarray        # int64 [n]
     f_codes: dict           # f tag -> code
+    # Value-shape discriminator for the native columnar scan: 0 = None,
+    # 1 = int32-range int, 2 = int32-range [a, b] pair, 3 = other
+    # (unencodable), 4 = int/pair outside int32.  None when the history
+    # was packed with a custom value_encoder (the scan then falls back
+    # to the Op-object walk, which sees the real values).
+    vkind: Optional[np.ndarray] = None  # uint8 [n]
 
     def __len__(self):
         return len(self.index)
@@ -298,22 +341,55 @@ class PackedHistory:
                   time=int(self.time[i]))
 
 
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def _fits_i64(x: int) -> bool:
+    return _I64_MIN <= x <= _I64_MAX
+
+
 def default_value_encoder(o: Op) -> tuple[list[int], list[bool]]:
     """Encode an op value into two int64 slots.  ints -> slot 0;
-    [a, b] pairs (cas) -> both slots; None/other -> marked not-ok."""
+    [a, b] pairs (cas) -> both slots; None/other -> marked not-ok.
+    Ints beyond int64 are marked not-ok instead of overflowing the
+    column store — the run loop journals every op through here
+    (ColumnJournal), so this must never raise."""
     v = o.value
     if isinstance(v, bool):  # bool is an int subclass; keep it encodable
         return [int(v), 0], [True, False]
     if isinstance(v, int):
+        if not _fits_i64(v):
+            return [0, 0], [False, False]
         return [v, 0], [True, False]
     if (isinstance(v, (list, tuple)) and len(v) == 2
             and all(isinstance(x, int) and not isinstance(x, bool) for x in v)):
+        if not (_fits_i64(v[0]) and _fits_i64(v[1])):
+            return [0, 0], [False, False]
         return [v[0], v[1]], [True, True]
     return [0, 0], [False, False]
 
 
+_I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
+
+
+def _value_kind(v) -> int:
+    """vkind discriminator (see PackedHistory.vkind)."""
+    if v is None:
+        return 0
+    if isinstance(v, bool):
+        return 1
+    if isinstance(v, int):
+        return 1 if _I32_MIN <= v <= _I32_MAX else 4
+    if (isinstance(v, (list, tuple)) and len(v) == 2
+            and all(isinstance(x, int) and not isinstance(x, bool)
+                    for x in v)):
+        return (2 if all(_I32_MIN <= x <= _I32_MAX for x in v) else 4)
+    return 3
+
+
 def pack_history(h: History, f_codes: Optional[dict] = None,
                  value_encoder=None) -> PackedHistory:
+    custom_encoder = value_encoder is not None
     value_encoder = value_encoder or default_value_encoder
     if f_codes is None:
         f_codes = {}
@@ -328,17 +404,85 @@ def pack_history(h: History, f_codes: Optional[dict] = None,
     value = np.zeros((n, 2), np.int64)
     value_ok = np.zeros((n, 2), bool)
     time = np.zeros(n, np.int64)
+    vkind = None if custom_encoder else np.zeros(n, np.uint8)
     for i, o in enumerate(h):
         index[i] = o.index if o.index is not None else i
         p = o.process
-        process[i] = NEMESIS if not isinstance(p, int) or isinstance(p, bool) else p
+        # `type(p) is int` (not isinstance): bools and int subclasses
+        # (IntEnum, numpy ints) are NOT client processes, exactly as
+        # the scan engines' PyLong_CheckExact treats them — the
+        # columnar and object paths must classify identically.
+        process[i] = p if type(p) is int and p >= 0 else NEMESIS
         typ[i] = TYPE_CODE[o.type]
         f[i] = f_codes.get(o.f, -1)
         (value[i, 0], value[i, 1]), (value_ok[i, 0], value_ok[i, 1]) = \
             value_encoder(o)
         time[i] = o.time if o.time is not None else 0
+        if vkind is not None:
+            vkind[i] = _value_kind(o.value)
     return PackedHistory(index, process, typ, f, value, value_ok, time,
-                         dict(f_codes))
+                         dict(f_codes), vkind=vkind)
+
+
+class ColumnJournal:
+    """Incremental columnar journal: the run loop appends each op as it
+    is journaled (the conj-op! point, core.clj:334-336), so by analysis
+    time the SURVEY.md §7 struct-of-arrays representation already
+    exists and checkers never pay a per-op Python traversal.  Attach
+    the result to a History with `attach_packed` (History.pack() then
+    returns it for free and the native columnar scan engages)."""
+
+    def __init__(self, cap: int = 1024):
+        self._n = 0
+        self._cap = cap
+        self.f_codes: dict = {}
+        self._alloc(cap)
+
+    def _alloc(self, cap):
+        self.index = np.zeros(cap, np.int32)
+        self.process = np.zeros(cap, np.int32)
+        self.type = np.zeros(cap, np.uint8)
+        self.f = np.zeros(cap, np.int32)
+        self.value = np.zeros((cap, 2), np.int64)
+        self.value_ok = np.zeros((cap, 2), bool)
+        self.time = np.zeros(cap, np.int64)
+        self.vkind = np.zeros(cap, np.uint8)
+
+    def _grow(self):
+        old = (self.index, self.process, self.type, self.f, self.value,
+               self.value_ok, self.time, self.vkind)
+        self._cap *= 2
+        self._alloc(self._cap)
+        for o, name in zip(old, ("index", "process", "type", "f",
+                                 "value", "value_ok", "time", "vkind")):
+            getattr(self, name)[:len(o)] = o
+
+    def append(self, o: Op) -> None:
+        i = self._n
+        if i == self._cap:
+            self._grow()
+        self.index[i] = o.index if o.index is not None else i
+        p = o.process
+        # match pack_history / the scanners: exact int only
+        self.process[i] = p if type(p) is int and p >= 0 else NEMESIS
+        self.type[i] = TYPE_CODE[o.type]
+        fc = self.f_codes.get(o.f)
+        if fc is None:
+            fc = self.f_codes[o.f] = len(self.f_codes)
+        self.f[i] = fc
+        (self.value[i, 0], self.value[i, 1]), \
+            (self.value_ok[i, 0], self.value_ok[i, 1]) = \
+            default_value_encoder(o)
+        self.time[i] = o.time if o.time is not None else 0
+        self.vkind[i] = _value_kind(o.value)
+        self._n = i + 1
+
+    def packed(self) -> PackedHistory:
+        n = self._n
+        return PackedHistory(self.index[:n], self.process[:n],
+                             self.type[:n], self.f[:n], self.value[:n],
+                             self.value_ok[:n], self.time[:n],
+                             dict(self.f_codes), vkind=self.vkind[:n])
 
 
 def history_latencies(h: History) -> list[tuple[Op, float]]:

@@ -109,7 +109,12 @@ static int encode_value(PyObject *v, long *a, long *b, int *ok) {
         *ok = 1;
         return 1;
     }
-    if (PyList_CheckExact(v) || PyTuple_CheckExact(v)) {
+    /* subclass-inclusive (PyList_Check, not CheckExact): namedtuples
+     * and list subclasses must encode as pairs exactly like the
+     * Python twin's isinstance() and history._value_kind, or the
+     * columnar and object paths would intern different uops for the
+     * same history */
+    if (PyList_Check(v) || PyTuple_Check(v)) {
         Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
         if (n != 2) return 1;                    /* unencodable: ok=0 */
         PyObject *x0 = PySequence_Fast_GET_ITEM(v, 0);
@@ -336,9 +341,309 @@ done:
     return result;
 }
 
+/* ---------------------------------------------------------------- */
+/* Columnar scan: same fused pass, but over the history's native
+ * struct-of-arrays representation (SURVEY.md §7) instead of Op
+ * objects — no attribute lookups, no PyObject allocation per op.
+ * ~20-30x the object walk; feeds the same _FastKey consumer.
+ *
+ * fast_scan_cols(proc i32[n], typ u8[n], fmap i32[n], va i32[n],
+ *                vb i32[n], vkind u8[n], seen, rows, max_open_bits)
+ *   fmap   per-op SPEC f-code (host maps history f-ids -> spec codes,
+ *          -1 = f unknown to the spec)
+ *   vkind  0 None / 1 int / 2 pair / 3 other / 4 out-of-int32
+ * Returns the same tuple as fast_scan, or None when out of scope
+ * (crashed calls, double invoke, vkind 4, missing f-code, deep
+ * concurrency) — callers fall through to the object paths.           */
+
+typedef struct { int64_t f, a, b, ok; long u; } uent;
+typedef struct { uent *e; long cap, n; } utab;
+
+static int utab_init(utab *t, long cap) {
+    long c = 64;
+    while (c < cap) c <<= 1;
+    t->e = PyMem_Malloc(c * sizeof(uent));
+    if (!t->e) return -1;
+    for (long i = 0; i < c; i++) t->e[i].u = -1;
+    t->cap = c;
+    t->n = 0;
+    return 0;
+}
+
+static uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
+    uint64_t h = 1469598103934665603ULL;
+    h = (h ^ (uint64_t)f) * 1099511628211ULL;
+    h = (h ^ (uint64_t)a) * 1099511628211ULL;
+    h = (h ^ (uint64_t)b) * 1099511628211ULL;
+    h = (h ^ (uint64_t)ok) * 1099511628211ULL;
+    return h;
+}
+
+/* find slot for key; returns index into t->e (occupied or empty) */
+static long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
+                      int64_t ok) {
+    uint64_t m = (uint64_t)t->cap - 1;
+    uint64_t i = utab_hash(f, a, b, ok) & m;
+    for (;;) {
+        uent *e = &t->e[i];
+        if (e->u < 0 || (e->f == f && e->a == a && e->b == b
+                         && e->ok == ok))
+            return (long)i;
+        i = (i + 1) & m;
+    }
+}
+
+static int utab_grow(utab *t) {
+    uent *old = t->e;
+    long ocap = t->cap;
+    t->e = PyMem_Malloc(2 * ocap * sizeof(uent));
+    if (!t->e) { t->e = old; return -1; }
+    t->cap = 2 * ocap;
+    for (long i = 0; i < t->cap; i++) t->e[i].u = -1;
+    for (long i = 0; i < ocap; i++)
+        if (old[i].u >= 0) {
+            long s = utab_slot(t, old[i].f, old[i].a, old[i].b,
+                               old[i].ok);
+            t->e[s] = old[i];
+        }
+    PyMem_Free(old);
+    return 0;
+}
+
+static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
+    Py_buffer bproc = {0}, btyp = {0}, bfmap = {0}, bva = {0},
+              bvb = {0}, bvk = {0};
+    PyObject *seen, *rows;
+    long max_open_bits;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*O!O!l",
+                          &bproc, &btyp, &bfmap, &bva, &bvb, &bvk,
+                          &PyDict_Type, &seen, &PyList_Type, &rows,
+                          &max_open_bits))
+        return NULL;
+    if (max_open_bits > MAX_OPEN_HARD) max_open_bits = MAX_OPEN_HARD;
+    Py_ssize_t n = (Py_ssize_t)(bproc.len / 4);
+    const int32_t *proc = bproc.buf;
+    const uint8_t *typ = btyp.buf;
+    const int32_t *fmap = bfmap.buf;
+    const int32_t *va = bva.buf;
+    const int32_t *vb = bvb.buf;
+    const uint8_t *vk = bvk.buf;
+
+    PyObject *result = NULL;
+    PyObject *new_rows = NULL;
+    vec ret_slots = {0}, cand_counts = {0}, cand_slots = {0},
+        cand_uops = {0}, cut_flags = {0};
+    vec d_counts = {0}, d_slots = {0}, d_uops = {0};
+    Py_ssize_t *fate = NULL;
+    utab ut = {0};
+    if ((Py_ssize_t)(btyp.len) != n || (Py_ssize_t)(bfmap.len / 4) != n
+        || (Py_ssize_t)(bva.len / 4) != n
+        || (Py_ssize_t)(bvb.len / 4) != n
+        || (Py_ssize_t)(bvk.len) != n) {
+        PyErr_SetString(PyExc_ValueError, "column length mismatch");
+        goto done;
+    }
+    fate = PyMem_Malloc((n ? n : 1) * sizeof(Py_ssize_t));
+    if (!fate) { PyErr_NoMemory(); goto done; }
+
+    /* pass 1: pair completions with invokes (open (proc,pos) array —
+     * live entries are bounded by the concurrent-open depth, which the
+     * scan caps at MAX_OPEN_HARD anyway) */
+    {
+        int32_t open_p[MAX_OPEN_HARD];
+        Py_ssize_t open_i[MAX_OPEN_HARD];
+        long n_open1 = 0;
+        for (Py_ssize_t i = 0; i < n; i++) fate[i] = -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            int32_t p = proc[i];
+            if (p < 0) continue;
+            uint8_t t = typ[i];
+            long j = -1;
+            for (long k = 0; k < n_open1; k++)
+                if (open_p[k] == p) { j = k; break; }
+            if (t == 0) {
+                if (j >= 0) goto fallback;        /* double invoke */
+                if (n_open1 >= MAX_OPEN_HARD) goto fallback;
+                open_p[n_open1] = p;
+                open_i[n_open1] = i;
+                n_open1++;
+            } else if (j >= 0) {
+                fate[open_i[j]] = i;
+                open_p[j] = open_p[n_open1 - 1];
+                open_i[j] = open_i[n_open1 - 1];
+                n_open1--;
+            }
+        }
+        if (n_open1 > 0) goto fallback;           /* crashed calls */
+    }
+
+    /* pass 2: slots + interning + returns */
+    new_rows = PyList_New(0);
+    if (!new_rows || utab_init(&ut, 256) < 0) goto fail_nomem;
+    {
+        long slot_of[MAX_OPEN_HARD], uop_of[MAX_OPEN_HARD];
+        int32_t open_procs[MAX_OPEN_HARD];
+        long free_slots[MAX_OPEN_HARD];
+        long n_free = 0, next_slot = 0, n_open = 0;
+        long max_open = 0, n_calls = 0;
+        Py_ssize_t d_emitted = 0;
+        Py_ssize_t base_rows = PyList_GET_SIZE(rows);
+        int seen_nonempty = PyDict_GET_SIZE(seen) > 0;
+
+        for (Py_ssize_t i = 0; i < n; i++) {
+            int32_t p = proc[i];
+            if (p < 0) continue;
+            uint8_t t = typ[i];
+            if (t == 0) {
+                Py_ssize_t ci = fate[i];
+                if (ci < 0 || typ[ci] == 3) goto fallback;
+                if (typ[ci] == 2) continue;       /* fail pair */
+                long a, b, okv;
+                uint8_t k = vk[i];
+                Py_ssize_t vi = i;
+                if (k == 0) { k = vk[ci]; vi = ci; }  /* None: completion */
+                if (k == 4) goto fallback;        /* out of int32 */
+                if (k == 0 || k == 3) { a = 0; b = 0; okv = 0; }
+                else {
+                    a = va[vi];
+                    b = (k == 2) ? vb[vi] : 0;
+                    okv = 1;
+                }
+                long fc = fmap[i];
+                if (fc < 0) goto fallback;        /* f not in spec */
+                long s2 = utab_slot(&ut, fc, a, b, okv);
+                long u;
+                if (ut.e[s2].u >= 0) {
+                    u = ut.e[s2].u;
+                } else {
+                    u = -1;
+                    if (seen_nonempty) {
+                        PyObject *key = Py_BuildValue("(llll)", fc, a,
+                                                      b, okv);
+                        if (!key) goto fail;
+                        PyObject *uo = PyDict_GetItem(seen, key);
+                        Py_DECREF(key);
+                        if (uo) u = PyLong_AsLong(uo);
+                    }
+                    if (u < 0) {
+                        u = base_rows + PyList_GET_SIZE(new_rows);
+                        PyObject *key = Py_BuildValue("(llll)", fc, a,
+                                                      b, okv);
+                        if (!key) goto fail;
+                        int r = PyList_Append(new_rows, key);
+                        Py_DECREF(key);
+                        if (r < 0) goto fail;
+                    }
+                    ut.e[s2].f = fc; ut.e[s2].a = a;
+                    ut.e[s2].b = b; ut.e[s2].ok = okv;
+                    ut.e[s2].u = u;
+                    if (++ut.n * 2 > ut.cap && utab_grow(&ut) < 0)
+                        goto fail_nomem;
+                }
+                long s = n_free ? free_slots[--n_free] : next_slot++;
+                if (n_open >= MAX_OPEN_HARD) goto fallback;
+                open_procs[n_open] = p;
+                slot_of[n_open] = s;
+                uop_of[n_open] = u;
+                n_open++;
+                if (n_open > max_open) {
+                    max_open = n_open;
+                    if (max_open > max_open_bits) goto fallback;
+                }
+                n_calls++;
+                /* delta stream: this call registers before the NEXT
+                 * return's closure (invoke order = stream order) */
+                if (vec_push(&d_slots, (int32_t)s) < 0 ||
+                    vec_push(&d_uops, (int32_t)u) < 0)
+                    goto fail_nomem;
+            } else if (t == 1) {
+                long idx = -1;
+                for (long j = 0; j < n_open; j++)
+                    if (open_procs[j] == p) { idx = j; break; }
+                if (idx < 0) continue;
+                if (vec_push(&d_counts,
+                             (int32_t)(d_slots.len - d_emitted)) < 0)
+                    goto fail_nomem;
+                d_emitted = d_slots.len;
+                if (vec_push(&ret_slots, (int32_t)slot_of[idx]) < 0 ||
+                    vec_push(&cand_counts, (int32_t)n_open) < 0)
+                    goto fail_nomem;
+                for (long j = 0; j < n_open; j++) {
+                    if (vec_push(&cand_slots, (int32_t)slot_of[j]) < 0 ||
+                        vec_push(&cand_uops, (int32_t)uop_of[j]) < 0)
+                        goto fail_nomem;
+                }
+                free_slots[n_free++] = slot_of[idx];
+                for (long j = idx; j < n_open - 1; j++) {
+                    open_procs[j] = open_procs[j + 1];
+                    slot_of[j] = slot_of[j + 1];
+                    uop_of[j] = uop_of[j + 1];
+                }
+                n_open--;
+                if (vec_push(&cut_flags, n_open == 0 ? 1 : 0) < 0)
+                    goto fail_nomem;
+            }
+        }
+
+        /* success: publish the staged interning */
+        {
+            Py_ssize_t m = PyList_GET_SIZE(new_rows);
+            for (Py_ssize_t i2 = 0; i2 < m; i2++) {
+                PyObject *key = PyList_GET_ITEM(new_rows, i2);
+                PyObject *uu = PyLong_FromSsize_t(base_rows + i2);
+                int r = uu ? PyDict_SetItem(seen, key, uu) : -1;
+                Py_XDECREF(uu);
+                if (r < 0) goto fail;
+                if (PyList_Append(rows, key) < 0) goto fail;
+            }
+        }
+        result = Py_BuildValue(
+            "(lly#y#y#y#y#y#y#y#)", n_calls, max_open,
+            (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),
+            (char *)cand_counts.data, cand_counts.len * sizeof(int32_t),
+            (char *)cand_slots.data, cand_slots.len * sizeof(int32_t),
+            (char *)cand_uops.data, cand_uops.len * sizeof(int32_t),
+            (char *)cut_flags.data, cut_flags.len * sizeof(int32_t),
+            (char *)d_counts.data, d_counts.len * sizeof(int32_t),
+            (char *)d_slots.data, d_slots.len * sizeof(int32_t),
+            (char *)d_uops.data, d_uops.len * sizeof(int32_t));
+    }
+    goto done;
+
+fallback:
+    result = Py_None;
+    Py_INCREF(Py_None);
+    goto done;
+
+fail_nomem:
+    PyErr_NoMemory();
+fail:
+done:
+    Py_XDECREF(new_rows);
+    PyMem_Free(fate);
+    PyMem_Free(ut.e);
+    PyMem_Free(ret_slots.data);
+    PyMem_Free(cand_counts.data);
+    PyMem_Free(cand_slots.data);
+    PyMem_Free(cand_uops.data);
+    PyMem_Free(cut_flags.data);
+    PyMem_Free(d_counts.data);
+    PyMem_Free(d_slots.data);
+    PyMem_Free(d_uops.data);
+    if (bproc.obj) PyBuffer_Release(&bproc);
+    if (btyp.obj) PyBuffer_Release(&btyp);
+    if (bfmap.obj) PyBuffer_Release(&bfmap);
+    if (bva.obj) PyBuffer_Release(&bva);
+    if (bvb.obj) PyBuffer_Release(&bvb);
+    if (bvk.obj) PyBuffer_Release(&bvk);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"fast_scan", fast_scan, METH_VARARGS,
      "Fused pairing/slotting/interning scan over one history."},
+    {"fast_scan_cols", fast_scan_cols, METH_VARARGS,
+     "Columnar twin of fast_scan over struct-of-arrays histories."},
     {NULL, NULL, 0, NULL},
 };
 

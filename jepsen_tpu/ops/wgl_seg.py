@@ -396,10 +396,10 @@ class _FastKey:
     invoke onward."""
 
     __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts",
-                 "nc", "rn")
+                 "nc", "rn", "deltas")
 
     def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None,
-                 nc=0, rn=None):
+                 nc=0, rn=None, deltas=None):
         self.rets = rets
         self.max_open = max_open
         self.n_calls = n_calls
@@ -407,6 +407,11 @@ class _FastKey:
         self.cuts = cuts
         self.nc = nc
         self.rn = rn
+        # From the columnar scanner: (d_counts[nr], d_slots[n_calls],
+        # d_uops[n_calls]) — the calls invoked since the previous
+        # return, attributed to each return in stream order.  Feeds
+        # _pack_regs_single without re-deriving deltas from snapshots.
+        self.deltas = deltas
 
     @property
     def n_rets(self):
@@ -426,16 +431,71 @@ def _native_scan(ops: list, spec, seen: dict, rows: list,
     if mod is None:
         return False                 # extension unavailable
     out = mod.fast_scan(ops, spec.f_codes, seen, rows, max_open_bits)
+    return _fastkey_from_native(out)
+
+
+def _fastkey_from_native(out):
     if out is None:
         return None
-    n_calls, max_open, rs, counts, cs, cu, cuts = out
+    n_calls, max_open, rs, counts, cs, cu, cuts, *delta = out
     # Py_BuildValue turns a NULL pointer (empty vec) into None
+    deltas = None
+    if delta:
+        dc, dslot, duop = delta
+        deltas = (np.frombuffer(dc or b"", np.int32),
+                  np.frombuffer(dslot or b"", np.int32),
+                  np.frombuffer(duop or b"", np.int32))
     return _FastKey(None, max_open, n_calls,
                     arrays=(np.frombuffer(rs or b"", np.int32),
                             np.frombuffer(counts or b"", np.int32),
                             np.frombuffer(cs or b"", np.int32),
                             np.frombuffer(cu or b"", np.int32)),
-                    cuts=np.frombuffer(cuts or b"", np.int32))
+                    cuts=np.frombuffer(cuts or b"", np.int32),
+                    deltas=deltas)
+
+
+def _native_scan_cols(packed, spec, seen: dict, rows: list,
+                      max_open_bits: int):
+    """Columnar twin of _native_scan: runs the fused C scan over the
+    history's native struct-of-arrays representation (built
+    incrementally by history.ColumnJournal at journal time, SURVEY.md
+    §7) — no per-op Python objects at all, ~25x the object walk.
+    Returns False when unavailable (no packed columns / no extension),
+    None when out of scope, else a _FastKey."""
+    from jepsen_tpu import native
+
+    if getattr(spec, "encode_op", None) is not None:
+        return None
+    if packed is None or getattr(packed, "vkind", None) is None:
+        return False
+    mod = native.histscan()
+    if mod is None or not hasattr(mod, "fast_scan_cols"):
+        return False
+    nf = len(packed.f_codes)
+    fcol = packed.f
+    if nf == 0:
+        fmap = np.full(len(fcol), -1, np.int32)
+    else:
+        f2spec = np.full(nf, -1, np.int32)
+        for tag, hid in packed.f_codes.items():
+            code = spec.f_codes.get(tag)
+            if code is not None:
+                f2spec[hid] = code
+        fmap = np.where((fcol >= 0) & (fcol < nf),
+                        f2spec[np.clip(fcol, 0, nf - 1)],
+                        np.int32(-1)).astype(np.int32, copy=False)
+    # vkind==4 gates every out-of-int32 value before it is read, so the
+    # wrapping cast below never reaches the kernel tables.
+    va = packed.value[:, 0].astype(np.int32)
+    vb = packed.value[:, 1].astype(np.int32)
+    out = mod.fast_scan_cols(
+        np.ascontiguousarray(packed.process, dtype=np.int32),
+        np.ascontiguousarray(packed.type, dtype=np.uint8),
+        np.ascontiguousarray(fmap),
+        np.ascontiguousarray(va), np.ascontiguousarray(vb),
+        np.ascontiguousarray(packed.vkind, dtype=np.uint8),
+        seen, rows, max_open_bits)
+    return _fastkey_from_native(out)
 
 
 def _fast_scan(history, spec, seen: dict, rows: list,
@@ -791,7 +851,8 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 @functools.lru_cache(maxsize=32)
 def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                        decomposed: bool, rounds: int, unroll: int,
-                       J: int = 1, nc: int = 0, rn: int = 0):
+                       J: int = 1, nc: int = 0, rn: int = 0,
+                       compose: bool = False):
     """Register-delta variant of the bit-packed batch kernel (J=1 for
     independent whole histories; J=Sn computes per-segment transfer
     matrices for the single-history path, one lane per segment).
@@ -918,17 +979,104 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                                    (ret_slot, inv_slot, inv_uop),
                                    unroll=unroll)
         if nc == 0:
-            return (fr[0] & 1).transpose(2, 1, 0)      # [K, J, Sn]
-        # read the 2^nc crashed-mask planes at zero normal bits
-        planes = []
-        for cm in range(1 << nc):
-            m = cm << rn
-            planes.append((fr[m // 32] >> np.uint32(m % 32)) & 1)
-        out = jnp.stack(planes)                        # [2^nc, Sn, J, K]
-        return out.transpose(3, 2, 0, 1).reshape(
-            K, J, (1 << nc) * Sn)                      # j' = cm*Sn + s
+            out = (fr[0] & 1).transpose(2, 1, 0)       # [K, J, Sn]
+        else:
+            # read the 2^nc crashed-mask planes at zero normal bits
+            planes = []
+            for cm in range(1 << nc):
+                m = cm << rn
+                planes.append((fr[m // 32] >> np.uint32(m % 32)) & 1)
+            outp = jnp.stack(planes)                   # [2^nc, Sn, J, K]
+            out = outp.transpose(3, 2, 0, 1).reshape(
+                K, J, (1 << nc) * Sn)                  # j' = cm*Sn + s
+        if not compose:
+            return out
+        # On-device composition (single-history path): prefix products
+        # of the per-segment transfer matrices via an associative scan
+        # — log2(K) levels of batched [J, J] matmuls on the MXU —
+        # instead of downloading [K, J, J] matrices over the tunnel and
+        # composing on host.  The verdict comes back as TWO int32 words
+        # (valid, first-dead-segment): a fetch of 8 bytes, which is the
+        # tunnel's fixed-latency floor.  Exactness: boolean matrix
+        # product is associative; `alive` is monotone (the empty state
+        # set is absorbing), so sum(alive) IS the first dead index.
+        Tm = out.astype(jnp.float32)                   # [K, J, J]
+        P = jax.lax.associative_scan(
+            lambda a, b: (jnp.einsum("kij,kjl->kil", a, b) > 0)
+            .astype(jnp.float32), Tm, axis=0)
+        alive = (P[:, 0, :] > 0).any(axis=1)           # entry config 0
+        valid = alive[-1]
+        dead = jnp.where(valid, jnp.int32(-1),
+                         jnp.sum(alive.astype(jnp.int32)))
+        return jnp.stack([valid.astype(jnp.int32), dead])
 
     return jax.jit(kern)
+
+
+def _unpack_transfer_bufs(buf8, buf32, B: int, L: int, K: int, I: int,
+                          U: int, wide_uop: bool):
+    """Device-side unpack of the two transfer buffers into the six
+    kernel tables (shared by the single-history and grouped builders —
+    the buffer layout and the little-endian int16 reassembly live
+    ONLY here).  buf8 holds B consecutive per-history blocks, each
+    ret[L,K] i8 ++ islot[L,K,I] i8 ++ iuop[L,K,I] i8|i16; with B > 1
+    the histories concatenate on the lane axis (ret [L, B*K], ...).
+    buf32 = a1[U] ++ a2[U] ++ t0[U]."""
+    import jax
+    import jax.numpy as jnp
+
+    n_ret = L * K
+    n_islot = L * K * I
+    n_iuop = L * K * I * (2 if wide_uop else 1)
+    per = n_ret + n_islot + n_iuop
+    blocks = buf8.reshape(B, per)
+
+    def lanes(x):                    # [B, L, ...] -> [L, B*K, ...]
+        x = jnp.moveaxis(x, 0, 1)
+        return x.reshape((L, B * K) + x.shape[3:])
+
+    ret = lanes(jax.lax.bitcast_convert_type(
+        blocks[:, :n_ret], jnp.int8).reshape(B, L, K))
+    islot = lanes(jax.lax.bitcast_convert_type(
+        blocks[:, n_ret:n_ret + n_islot], jnp.int8).reshape(B, L, K, I))
+    raw = blocks[:, n_ret + n_islot:per]
+    if wide_uop:                     # little-endian int16 from 2 bytes
+        pairs = raw.reshape(B, L, K, I, 2)
+        lo = pairs[..., 0].astype(jnp.int32)
+        hi = jax.lax.bitcast_convert_type(
+            pairs[..., 1], jnp.int8).astype(jnp.int32)
+        iuop = lanes(lo | (hi << 8))
+    else:
+        iuop = lanes(jax.lax.bitcast_convert_type(
+            raw, jnp.int8).reshape(B, L, K, I))
+    a1 = buf32[:U]
+    a2 = buf32[U:2 * U]
+    t0 = jax.lax.bitcast_convert_type(buf32[2 * U:3 * U], jnp.int32)
+    return ret, islot, iuop, a1, a2, t0
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel_regs_packed(K: int, L: int, I: int, Wd: int, Sn: int,
+                              R: int, decomposed: bool, rounds: int,
+                              unroll: int, J: int, nc: int, rn: int,
+                              U: int, wide_uop: bool):
+    """Packed-transfer wrapper around the composed register kernel: the
+    six host tables travel as TWO buffers (one uint8 for the [L, K(, I)]
+    event tables, one uint32 for the [U] transition tables) instead of
+    six separate device_puts — on the tunneled chip each transfer pays
+    a fixed latency that dominated the old 6-put plan.  Unpacking is
+    free on device (bitcasts + reshapes fused into the kernel)."""
+    import jax
+
+    kern = _build_kernel_regs(K, L, I, Wd, Sn, R, decomposed,
+                              rounds=rounds, unroll=unroll, J=J, nc=nc,
+                              rn=rn, compose=True)
+
+    def fn(buf8, buf32):
+        return kern(*_unpack_transfer_bufs(buf8, buf32, 1, L, K, I, U,
+                                           wide_uop))
+
+    return jax.jit(fn)
 
 
 def _pack_uop_tables(legal: np.ndarray, next_state: np.ndarray,
@@ -1022,6 +1170,79 @@ def _pack_regs(batch, Kp: int, R: int, U: int, I: int):
     ret_t = np.ascontiguousarray(ret_slot.T)
     islot_t = np.ascontiguousarray(inv_slot.transpose(1, 0, 2))
     iuop_t = np.ascontiguousarray(inv_uop.transpose(1, 0, 2))
+    return ret_t, islot_t, iuop_t, Lp
+
+
+class _RegsLayout:
+    """Row/column placement of one scanned key's delta stream across
+    its segments — everything _regs_fill needs to scatter the tables,
+    plus the minimal (Lp, K) shape.  Computing layouts for a whole
+    pipeline batch first lets every history fill DIRECTLY at the
+    common padded shape (no per-history np.pad / transpose copies)."""
+
+    __slots__ = ("ret_key", "rho", "rs", "ent_key", "row", "col",
+                 "dslot", "duop", "lp_min", "k")
+
+    def __init__(self, fk, seg_ends, I: int):
+        rs = _fk_arrays(fk)[0]
+        dc, dslot, duop = fk.deltas
+        NR = len(rs)
+        K = len(seg_ends)
+        nr_all = np.diff(np.concatenate([[0], seg_ends]))
+        ret_key = np.repeat(np.arange(K), nr_all)
+        key_start = np.concatenate([[0], np.cumsum(nr_all)[:-1]])
+        c = dc.astype(np.int64)
+        e = np.maximum(0, (c + I - 1) // I - 1)
+        ecum = np.cumsum(e)
+        ebase = np.concatenate([[0], ecum])[key_start]
+        r_local = np.arange(NR) - key_start[ret_key]
+        rho = r_local + (ecum - ebase[ret_key])
+        rows_per_key = np.zeros(K, np.int64)
+        np.maximum.at(rows_per_key, ret_key, rho + 1)
+        ent_ret = np.repeat(np.arange(NR), c)
+        starts = np.cumsum(c) - c
+        j = np.arange(len(dslot)) - starts[ent_ret]
+        from_end = c[ent_ret] - 1 - j
+        self.ret_key = ret_key
+        self.rho = rho
+        self.rs = rs
+        self.ent_key = ret_key[ent_ret]
+        self.row = rho[ent_ret] - from_end // I
+        self.col = from_end % I
+        self.dslot = dslot
+        self.duop = duop
+        self.lp_min = int(rows_per_key.max()) if K else 0
+        self.k = K
+
+
+def _regs_fill(lay: "_RegsLayout", Lp: int, K: int, U: int, I: int):
+    """Scatter one layout into [Lp, K(, I)] tables (already in the
+    kernel's transposed orientation — no copies).  Padding rows/lanes
+    beyond the layout's own shape are exact no-ops (ret -1, no
+    invokes)."""
+    ret_t = np.full((Lp, K), -1, np.int8)
+    ret_t[lay.rho, lay.ret_key] = lay.rs.astype(np.int8)
+    uop_dtype = np.int8 if U <= 127 else np.int16
+    islot_t = np.full((Lp, K, I), -1, np.int8)
+    iuop_t = np.full((Lp, K, I), -1, uop_dtype)
+    islot_t[lay.row, lay.ent_key, lay.col] = lay.dslot.astype(np.int8)
+    iuop_t[lay.row, lay.ent_key, lay.col] = lay.duop.astype(uop_dtype)
+    return ret_t, islot_t, iuop_t
+
+
+def _pack_regs_single(fk, seg_ends: np.ndarray, R: int, U: int, I: int):
+    """Delta-encode ONE scanned key split at `seg_ends` — the fast twin
+    of _pack_regs for the single-history path.  The columnar scanner
+    already emitted the invoke-delta stream (fk.deltas), so no dense
+    snapshot matrices are rebuilt here: segment boundaries sit at
+    quiescent cuts where nothing is open, which is exactly why the
+    per-return delta stream is valid for ANY such segmentation (the
+    first return of a segment registers precisely the calls invoked
+    since the cut).  Layout math (virtual spill rows before their
+    return) is identical to _pack_regs."""
+    lay = _RegsLayout(fk, seg_ends, I)
+    Lp = _pad_len(lay.lp_min)
+    ret_t, islot_t, iuop_t = _regs_fill(lay, Lp, lay.k, U, I)
     return ret_t, islot_t, iuop_t, Lp
 
 
@@ -1285,11 +1506,21 @@ def _shard_args(mesh, mesh_axis: str, args: list, n_sharded: int):
 
 def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
                   legal, next_state, diag_w, const_w, const_t0,
-                  mesh, mesh_axis, nc: int = 0, rn: int = 0):
+                  mesh, mesh_axis, nc: int = 0, rn: int = 0,
+                  compose: bool = True, tables=None):
     """Run the register-delta kernel over per-segment lanes with
     J = Sn * 2^nc entry configurations (nc = crashed-call count).
-    Returns (T bool [K, J, J], t_kernel, sharded) — shared by the
-    plan()-based and fast-scan single-history paths."""
+    Returns (T, t_kernel, sharded, dead_segment) — shared by the
+    plan()-based and fast-scan single-history paths.
+
+    Unsharded with compose=True (the default), the per-segment transfer
+    matrices are composed ON DEVICE and only (valid, first-dead) come
+    back — T is None and dead_segment is set (-1 = valid).  Sharded
+    runs keep the host composition (T comes back, dead_segment None):
+    every device computes its segment slice and only the [K, J, J]
+    matrices cross the ICI/host boundary.  (Multi-history pipelining
+    does not come through here — check_pipeline has its own grouped
+    dispatch.)"""
     sharded = False
     K_run = K
     if mesh is not None and mesh_axis is not None:
@@ -1304,11 +1535,21 @@ def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
     # (whose _dispatch_kernel packing sits inside the timed window) so
     # the two flavours report comparable time_kernel_s
     t1 = time.monotonic()
-    ret_t, islot_t, iuop_t, Lp = _pack_regs(
-        [(k, fk) for k, fk in enumerate(seg_fk)], K_run, R, int(U), I)
+    if tables is not None and not sharded and K_run == K:
+        ret_t, islot_t, iuop_t, Lp = tables
+    else:
+        ret_t, islot_t, iuop_t, Lp = _pack_regs(
+            [(k, fk) for k, fk in enumerate(seg_fk)], K_run, R, int(U), I)
     a1t, a2t, t0t = _pack_uop_tables(
         legal, next_state, diag_w, const_w, const_t0)
     unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
+    if not sharded and compose:
+        out = _dispatch_regs_packed(ret_t, islot_t, iuop_t, a1t, a2t,
+                                    t0t, M, Sn, R, decomposed, nc, rn,
+                                    unroll)
+        vd = np.asarray(out)
+        dead = int(vd[1])
+        return None, time.monotonic() - t1, False, dead
     kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
                               int(Sn), R, decomposed,
                               rounds=R, unroll=unroll,
@@ -1317,7 +1558,69 @@ def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
     if sharded:
         args = _shard_args(mesh, mesh_axis, args, 3)
     T = np.asarray(kern(*args))[:K] > 0.5                    # [K, J, J]
-    return T, time.monotonic() - t1, sharded
+    return T, time.monotonic() - t1, sharded, None
+
+
+def _dispatch_regs_packed(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
+                          M: int, Sn: int, R: int, decomposed: bool,
+                          nc: int, rn: int, unroll: int):
+    """Pack the six host tables into two transfer buffers and dispatch
+    the composed register kernel asynchronously; returns the un-fetched
+    int32[2] (valid, first-dead-segment) device value."""
+    Lp, K_run = ret_t.shape
+    I = islot_t.shape[2]
+    wide = iuop_t.dtype == np.int16
+    buf8 = np.concatenate([ret_t.view(np.uint8).ravel(),
+                           islot_t.view(np.uint8).ravel(),
+                           iuop_t.view(np.uint8).ravel()])
+    buf32 = np.concatenate([a1t, a2t, t0t.view(np.uint32)])
+    fn = _build_kernel_regs_packed(
+        int(K_run), int(Lp), I, max(1, M // 32), int(Sn), R, decomposed,
+        R, unroll, int(Sn) << nc, nc, rn, int(a1t.shape[0]), wide)
+    return fn(buf8, buf32)
+
+
+@functools.lru_cache(maxsize=8)
+def _build_stack(n: int):
+    import jax
+    import jax.numpy as jnp
+    return jax.jit(lambda *xs: jnp.stack(xs))
+
+
+@functools.lru_cache(maxsize=32)
+def _build_kernel_regs_group(B: int, K: int, L: int, I: int, Wd: int,
+                             Sn: int, R: int, decomposed: bool,
+                             rounds: int, unroll: int, U: int,
+                             wide_uop: bool):
+    """Grouped composed kernel: B histories' per-lane tables travel as
+    ONE uint8 buffer (B consecutive per-history blocks) and run as one
+    device program over B*K lanes — on the tunneled chip every transfer
+    pays a fixed latency, so grouping divides that cost by B.  The
+    per-segment transfer matrices are composed per history by a batched
+    associative scan; output is int32 [B, 2] (valid, first-dead)."""
+    import jax
+    import jax.numpy as jnp
+
+    J = Sn
+    kern = _build_kernel_regs(B * K, L, I, Wd, Sn, R, decomposed,
+                              rounds=rounds, unroll=unroll, J=J,
+                              nc=0, rn=0, compose=False)
+
+    def fn(buf8, buf32):
+        tabs = _unpack_transfer_bufs(buf8, buf32, B, L, K, I, U,
+                                     wide_uop)
+        out = kern(*tabs)                            # [B*K, J, J]
+        Tm = out.reshape(B, K, J, J).astype(jnp.float32)
+        P = jax.lax.associative_scan(
+            lambda a, b: (jnp.einsum("bkij,bkjl->bkil", a, b) > 0)
+            .astype(jnp.float32), Tm, axis=1)
+        alive = (P[:, :, 0, :] > 0).any(axis=-1)     # [B, K]
+        valid = alive[:, -1]
+        dead = jnp.where(valid, jnp.int32(-1),
+                         jnp.sum(alive.astype(jnp.int32), axis=1))
+        return jnp.stack([valid.astype(jnp.int32), dead], axis=1)
+
+    return jax.jit(fn)
 
 
 def _compose_transfer(T: np.ndarray, Sn: int) -> int:
@@ -1494,6 +1797,42 @@ def _check_crashed_fast(model, spec, history, *, max_states,
     return None
 
 
+def _segments_from_fk(fk, R: int, seg_ends):
+    """Slice one key's scanned return stream at the given segment ends
+    (quiescent cuts, from _segment_ends); returns per-segment
+    _FastKeys."""
+    rs, counts, cs, cu = _fk_arrays(fk)
+    cand_off = np.concatenate([[0], np.cumsum(counts)])
+    seg_fk = []
+    lo = 0
+    for hi in seg_ends:
+        seg_fk.append(_FastKey(
+            None, R, int(hi - lo),
+            arrays=(rs[lo:hi], counts[lo:hi],
+                    cs[cand_off[lo]:cand_off[hi]],
+                    cu[cand_off[lo]:cand_off[hi]])))
+        lo = hi
+    return seg_fk
+
+
+def _scan_history(h, ops, spec, seen: dict, rows: list,
+                  max_open_bits: int):
+    """The one scan-fallback policy shared by every engine entry point:
+    columnar C scan when the history carries packed columns, then the
+    object C scan, then the pure-Python twin.  Returns a _FastKey or
+    None (out of scope — crashed calls, deep concurrency, unencodable
+    values); all three scanners are differentially pinned to classify
+    identically."""
+    fk = _native_scan_cols(
+        h.packed_columns() if isinstance(h, History) else None,
+        spec, seen, rows, max_open_bits)
+    if fk is False or fk is None:
+        fk = _native_scan(ops, spec, seen, rows, max_open_bits)
+    if fk is False:
+        fk = _fast_scan(h, spec, seen, rows, max_open_bits)
+    return fk
+
+
 def _check_fast(model, spec, history, *, max_states, max_open_bits,
                 target_returns_per_segment, localize, mesh, mesh_axis,
                 backend_name, t0, max_crashed: int = 0,
@@ -1508,9 +1847,7 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     rows: list = []
     ops = history.ops if isinstance(history, History) else \
         History(history).ops
-    fk = _native_scan(ops, spec, seen, rows, max_open_bits)
-    if fk is False:
-        fk = _fast_scan(history, spec, seen, rows, max_open_bits)
+    fk = _scan_history(history, ops, spec, seen, rows, max_open_bits)
     if fk is None and max_crashed:
         # crash-tolerant scan (Python twin; permanent high slots)
         fk = _fast_scan(history, spec, seen, rows, max_open_bits,
@@ -1546,29 +1883,28 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         return None                  # entry-config axis too wide
 
     # segment at quiescent cuts, >= target returns per segment
-    rs, counts, cs, cu = _fk_arrays(fk)
-    nr = len(rs)
     cuts = np.asarray(fk.cuts, np.int32)
-    if len(cuts) != nr or not nr or cuts[-1] != 1:
+    if len(cuts) != fk.n_rets or not fk.n_rets or cuts[-1] != 1:
         return None                  # defensive: malformed cut stream
     seg_ends = _segment_ends(cuts, target_returns_per_segment)
-    cand_off = np.concatenate([[0], np.cumsum(counts)])
-    seg_fk = []
-    lo = 0
-    for hi in seg_ends:
-        seg_fk.append(_FastKey(
-            None, R, int(hi - lo),
-            arrays=(rs[lo:hi], counts[lo:hi],
-                    cs[cand_off[lo]:cand_off[hi]],
-                    cu[cand_off[lo]:cand_off[hi]])))
-        lo = hi
-    K = len(seg_fk)
+    K = len(seg_ends)
+    tables = seg_fk = None
+    if fk.deltas is not None and nc == 0 and mesh is None:
+        # columnar scan supplied the invoke-delta stream: pack tables
+        # directly, skipping the per-segment slicing round trip
+        tables = _pack_regs_single(fk, seg_ends, R,
+                                   int(legal.shape[0]),
+                                   min(2, R) if R else 1)
+    else:
+        seg_fk = _segments_from_fk(fk, R, seg_ends)
     t_plan = time.monotonic() - t0
 
-    T, t_kernel, sharded = _run_seg_regs(
+    T, t_kernel, sharded, dead_segment = _run_seg_regs(
         seg_fk, K, R, legal.shape[0], Sn, 1 << R, legal, next_state,
-        diag_w, const_w, const_t0, mesh, mesh_axis, nc=nc, rn=rn)
-    dead_segment = _compose_transfer(T, Sn << nc)
+        diag_w, const_w, const_t0, mesh, mesh_axis, nc=nc, rn=rn,
+        tables=tables)
+    if dead_segment is None:
+        dead_segment = _compose_transfer(T, Sn << nc)
 
     result: dict[str, Any] = {
         "valid?": dead_segment < 0,
@@ -1651,8 +1987,9 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     R = int(pl.max_open)
     decomposed = pl.diag_w is not None
     U = pl.legal.shape[0]
+    dead_segment = None
     if pl.seg_fk is not None and _regs_eligible(R, U, Sn, decomposed):
-        T, t_kernel, sharded = _run_seg_regs(
+        T, t_kernel, sharded, dead_segment = _run_seg_regs(
             pl.seg_fk, K, R, U, Sn, M, pl.legal, pl.next_state,
             pl.diag_w, pl.const_w, pl.const_t0, mesh, mesh_axis)
     else:
@@ -1687,7 +2024,8 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
         T = np.asarray(kern(*args))[:K] > 0.5                # [K, Sn, Sn]
         t_kernel = time.monotonic() - t1
 
-    dead_segment = _compose_transfer(T, Sn)
+    if dead_segment is None:
+        dead_segment = _compose_transfer(T, Sn)
 
     result: dict[str, Any] = {
         "valid?": dead_segment < 0,
@@ -1720,6 +2058,172 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
                     if key in oracle:
                         result[key] = oracle[key]
     return result
+
+
+def check_pipeline(model, histories, *, max_states: int = 64,
+                   max_open_bits: int = 10,
+                   target_returns_per_segment: int = 256,
+                   localize: bool = True) -> list:
+    """Steady-state checking of MANY long histories: every history is
+    scanned, segmented, packed, and dispatched asynchronously (the
+    host works on history i+1 while the device runs history i), then
+    ALL verdicts are stacked on device and fetched in ONE round trip —
+    amortizing the tunnel's fixed D2H latency over the batch, which
+    bounds any single-shot check from below (see bench.py's north-star
+    decomposition).  Verdict-identical to check() per history; lanes
+    and event rows are padded to one common shape so the whole batch
+    shares a single compiled kernel.
+
+    This is the steady-state formulation of the north-star metric: a
+    control plane re-checking stored histories back-to-back (the
+    reference's `analyze` re-check loop, cli.clj:366-397) is never
+    limited by the one-result fetch latency."""
+    import jax
+
+    spec = model.device_spec()
+    if spec is None:
+        raise Unsupported(f"model {model!r} has no device spec")
+    backend_name = jax.default_backend()
+    n = len(histories)
+    results: list = [None] * n
+    seen: dict = {}
+    rows: list = []
+    scans: dict = {}
+    strag: list = []
+    for i, h in enumerate(histories):
+        if isinstance(h, PreparedHistory):
+            strag.append(i)
+            continue
+        ops = h.ops if isinstance(h, History) else History(h).ops
+        fk = _scan_history(h, ops, spec, seen, rows, max_open_bits)
+        if fk is None:
+            strag.append(i)
+            continue
+        if fk.n_calls == 0:
+            results[i] = {"valid?": True, "op_count": 0,
+                          "backend": backend_name, "engine": "wgl_seg"}
+            continue
+        scans[i] = fk
+    states = legal = next_state = None
+    if scans:
+        uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
+        init = np.asarray(spec.encode(model), np.int32)
+        try:
+            states, legal, next_state = _enumerate_states(
+                spec, init, uops, max_states)
+        except Unsupported:
+            strag.extend(scans)
+            scans = {}
+    if scans:
+        Sn = states.shape[0]
+        R = max(fk.max_open for fk in scans.values())
+        diag_w, const_w, const_t0 = _decompose(legal, next_state)
+        if not _regs_eligible(R, legal.shape[0], Sn,
+                              diag_w is not None):
+            strag.extend(scans)
+            scans = {}
+    if scans:
+        a1t, a2t, t0t = _pack_uop_tables(
+            legal, next_state, diag_w, const_w, const_t0)
+        I = min(2, R) if R else 1
+        U = int(legal.shape[0])
+        unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
+        packs: dict = {}
+        for i, fk in scans.items():
+            cuts = np.asarray(fk.cuts, np.int32)
+            nr = fk.n_rets
+            if len(cuts) != nr or not nr or cuts[-1] != 1:
+                strag.append(i)
+                continue
+            seg_ends = _segment_ends(cuts, target_returns_per_segment)
+            if fk.deltas is not None:
+                packs[i] = (_RegsLayout(fk, seg_ends, I), fk)
+            else:                    # Python-scan keys: snapshot packer
+                seg_fk = _segments_from_fk(fk, R, seg_ends)
+                tabs = _pack_regs(
+                    [(k, f) for k, f in enumerate(seg_fk)],
+                    len(seg_ends), R, U, I)
+                packs[i] = ((tabs, len(seg_ends)), fk)
+        if packs:
+            # one common shape for the whole batch (one compile):
+            # padding rows/lanes are exact no-ops (ret -1, no invokes),
+            # and every layout fills DIRECTLY at the padded shape — no
+            # per-history pad/transpose copies
+            def _shape_of(p):
+                if isinstance(p, _RegsLayout):
+                    return p.lp_min, p.k
+                return p[0][3], p[0][0].shape[1]
+            Lp_c = _pad_len(max(_shape_of(p)[0] for p, _ in
+                                packs.values()))
+            K_c = ((max(_shape_of(p)[1] for p, _ in packs.values())
+                    + 63) // 64) * 64
+            wide = U > 127
+            bufs: dict = {}
+            for i, (p, fk) in packs.items():
+                if isinstance(p, _RegsLayout):
+                    ret_t, islot_t, iuop_t = _regs_fill(
+                        p, Lp_c, K_c, U, I)
+                else:
+                    (ret_t, islot_t, iuop_t, Lp), K0 = p
+                    ret_t = np.pad(ret_t, ((0, Lp_c - Lp),
+                                           (0, K_c - K0)),
+                                   constant_values=-1)
+                    islot_t = np.pad(islot_t, ((0, Lp_c - Lp),
+                                               (0, K_c - K0), (0, 0)),
+                                     constant_values=-1)
+                    iuop_t = np.pad(iuop_t, ((0, Lp_c - Lp),
+                                             (0, K_c - K0), (0, 0)),
+                                    constant_values=-1)
+                bufs[i] = np.concatenate(
+                    [ret_t.view(np.uint8).ravel(),
+                     islot_t.view(np.uint8).ravel(),
+                     iuop_t.view(np.uint8).ravel()])
+            # dispatch in groups of G: one transfer + one program per
+            # group (the tunnel charges a fixed latency per transfer,
+            # so G divides it); the last group is padded by repeating
+            # the first buffer (its extra verdict is discarded)
+            order = list(bufs)
+            G = min(int(os.environ.get("JEPSEN_TPU_PIPE_GROUP", "4")),
+                    len(order))
+            buf32 = np.concatenate([a1t, a2t, t0t.view(np.uint32)])
+            outs = []
+            for g0 in range(0, len(order), G):
+                grp = order[g0:g0 + G]
+                blocks = [bufs[i] for i in grp]
+                while len(blocks) < G:
+                    blocks.append(bufs[order[0]])
+                fn = _build_kernel_regs_group(
+                    G, K_c, Lp_c, I, max(1, (1 << R) // 32), int(Sn),
+                    R, diag_w is not None, R, unroll, U, wide)
+                outs.append(fn(np.concatenate(blocks), buf32))
+            stacked = _build_stack(len(outs))(*outs)
+            vd = np.asarray(stacked).reshape(-1, 2)  # ONE fetch
+            for j, i in enumerate(order):
+                valid = bool(vd[j, 0])
+                p, fk = packs[i]
+                res: dict = {"valid?": valid, "op_count": fk.n_calls,
+                             "backend": backend_name,
+                             "engine": "wgl_seg",
+                             "segments": _shape_of(p)[1],
+                             "states": int(Sn), "pipelined": True}
+                if not valid:
+                    res["anomaly"] = "nonlinearizable"
+                    res["dead_segment"] = int(vd[j, 1])
+                    if localize:
+                        from jepsen_tpu.ops import wgl_cpu
+                        oracle = wgl_cpu.check(model, histories[i])
+                        for key in ("op", "op_index", "final-paths",
+                                    "configs"):
+                            if key in oracle:
+                                res[key] = oracle[key]
+                results[i] = res
+    for i in strag:
+        results[i] = check(model, histories[i], max_states=max_states,
+                           max_open_bits=max_open_bits,
+                           target_returns_per_segment=
+                           target_returns_per_segment,
+                           localize=localize)
+    return results
 
 
 def _fk_arrays(fk: "_FastKey"):
@@ -1928,11 +2432,7 @@ def check_many(model, histories, *, max_states: int = 64,
             fall.append(i)  # pre-prepped callers take the slow path
             continue
         ops = h.ops if isinstance(h, History) else History(h).ops
-        fk = False
-        if native_ok:
-            fk = _native_scan(ops, spec, seen, rows, max_open_bits)
-        if fk is False:              # no extension: Python twin
-            fk = _fast_scan(h, spec, seen, rows, max_open_bits)
+        fk = _scan_history(h, ops, spec, seen, rows, max_open_bits)
         if fk is None:
             # Crashed keys ride the batch as their crash-stripped twin:
             # stripped-valid => valid (a crashed call carries no

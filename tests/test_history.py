@@ -102,3 +102,40 @@ def test_latencies_and_nemesis_intervals():
     ivals = nemesis_intervals(h)
     assert len(ivals) == 1
     assert ivals[0][0].time == 150 and ivals[0][1].time == 510
+
+
+def test_column_journal_matches_pack_history():
+    from jepsen_tpu.history import ColumnJournal, pack_history
+    import numpy as np
+    ops = [
+        invoke_op(0, "write", 3), ok_op(0, "write", 3),
+        invoke_op(1, "read", None), ok_op(1, "read", 3),
+        invoke_op(0, "cas", [3, 5]), ok_op(0, "cas", [3, 5]),
+        Op(process=NEMESIS, type="invoke", f="start"),
+        invoke_op(2, "write", 2 ** 40), ok_op(2, "write", 2 ** 40),
+        invoke_op(1, "read", "weird"), ok_op(1, "read", "weird"),
+    ]
+    h = History(ops).index()
+    j = ColumnJournal(cap=2)             # force growth
+    for o in h:
+        j.append(o)
+    a, b = j.packed(), pack_history(h)
+    for f in ("index", "process", "type", "f", "value", "value_ok",
+              "vkind"):
+        assert np.array_equal(getattr(a, f), getattr(b, f)), f
+    assert a.f_codes == b.f_codes
+    # vkind semantics: int=1, None-read=0, pair=2, big=4, other=3
+    assert list(b.vkind[:6]) == [1, 1, 0, 1, 2, 2]
+    assert b.vkind[7] == 4 and b.vkind[9] == 3
+
+
+def test_journaled_history_packs_without_walk():
+    h = History(journal=True)
+    h.append(invoke_op(0, "write", 1))
+    h.append(ok_op(0, "write", 1))
+    cols = h.packed_columns()
+    assert cols is not None and len(cols) == 2
+    assert h.pack() is not None
+    # plain histories have no free columns
+    h2 = History([invoke_op(0, "read", None)])
+    assert h2.packed_columns() is None
