@@ -342,6 +342,98 @@ def main() -> int:
         "value": round(nh / hard_wall, 1), "unit": "ops/sec",
         "vs_baseline": round(hard_ratio, 2)}), file=sys.stderr)
 
+    # --- Refutation: the reference's PRODUCT is finding violations
+    # (checker.clj:147-158).  Two invalid-history lines measure device
+    # time-to-witness. ------------------------------------------------
+    # (a) deep violation in the crash-free 100k history: corrupt a
+    # late ok-read; witness must match the oracle's exactly.
+    bad = make_history(SINGLE_N_OPS, CONCURRENCY, seed=31, vmax=9)
+    reads = [i for i, o in enumerate(bad.ops)
+             if o.type == "ok" and o.f == "read"]
+    tgt = reads[int(len(reads) * 0.95)]
+    bad.ops[tgt].value = 99               # impossible value (vmax=9)
+    bad.attach_packed(pack_history(bad))  # re-pack the mutated op
+    wgl_seg.check(model, bad)             # warm
+    bad_wall = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        rb = wgl_seg.check(model, bad)
+        bad_wall = min(bad_wall, time.monotonic() - t0)
+    t0 = time.monotonic()
+    ob = wgl_cpu.check(model, bad, time_limit=SINGLE_CPU_CAP)
+    cpu_bad_s = time.monotonic() - t0
+    if (rb["valid?"] is not False
+            or (ob["valid?"] is False
+                and rb.get("op_index") != ob.get("op_index"))):
+        print(json.dumps({"metric": "ERROR: deep-violation verdict/"
+                          f"witness mismatch dev={rb.get('op_index')} "
+                          f"cpu={ob.get('op_index')}", "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return 1
+    nb = sum(1 for o in bad if o.is_invoke)
+    print(json.dumps({
+        "metric": (f"refutation: {nb // 1000}k-op history with a "
+                   "violation at 95% depth; device wall-to-witness "
+                   "(segment-localized) vs CPU oracle"),
+        "value": round(nb / bad_wall, 1), "unit": "ops/sec",
+        "vs_baseline": round(cpu_bad_s / bad_wall, 2)}),
+        file=sys.stderr)
+    print(f"# refutation single: witness op {rb.get('op_index')} "
+          f"(== oracle) found in {bad_wall:.3f}s vs CPU "
+          f"{cpu_bad_s:.2f}s", file=sys.stderr)
+
+    # (b) violation in the crash-heavy regime: the sound crash-relaxed
+    # refutation tier must fire (any number of crashed calls); the CPU
+    # oracle is capped and rate-scored as in the hard-regime line.
+    badh = make_history(HARD_N_OPS, 16, seed=23, crash_rate=0.01,
+                        max_open=6)
+    reads = [i for i, o in enumerate(badh.ops)
+             if o.type == "ok" and o.f == "read"]
+    tgt = reads[int(len(reads) * 0.9)]
+    badh.ops[tgt].value = 99
+    badh.attach_packed(pack_history(badh))
+    wgl_seg.check(model, badh, max_open_bits=12)      # warm
+    badh_wall = float("inf")
+    for _ in range(3):
+        t0 = time.monotonic()
+        rbh = wgl_seg.check(model, badh, max_open_bits=12,
+                            localize=False)
+        badh_wall = min(badh_wall, time.monotonic() - t0)
+    if rbh["valid?"] is not False \
+            or rbh.get("refutation") != "crash-relaxed":
+        print(json.dumps({"metric": "ERROR: crash-regime violation "
+                          "not refuted by the relaxed tier: "
+                          + str({k: rbh.get(k) for k in
+                                 ("valid?", "refutation", "engine")}),
+                          "value": 0, "unit": "ops/sec",
+                          "vs_baseline": 0}))
+        return 1
+    t0 = time.monotonic()
+    obh = wgl_cpu.check(model, badh, time_limit=HARD_CPU_CAP)
+    cpu_badh_s = time.monotonic() - t0
+    nbh = sum(1 for o in badh if o.is_invoke)
+    ncbh = sum(1 for o in badh if o.type == "info")
+    if obh.get("cause"):
+        frac = obh.get("events_done", 0) / max(
+            1, obh.get("events_total", 1))
+        cpu_badh_rate = max(nbh * frac, 1) / cpu_badh_s
+        badh_note = (f"CPU {obh.get('cause')} at {cpu_badh_s:.0f}s "
+                     f"({frac:.0%} of events, no verdict)")
+    else:
+        cpu_badh_rate = nbh / cpu_badh_s
+        badh_note = f"CPU {cpu_badh_s:.2f}s"
+    badh_ratio = (nbh / badh_wall) / cpu_badh_rate
+    print(json.dumps({
+        "metric": (f"refutation, crash regime: {nbh // 1000}k ops, "
+                   f"{ncbh} crashed calls, violation at 90% depth; "
+                   "sound crash-relaxed device refutation vs capped "
+                   "CPU oracle"),
+        "value": round(nbh / badh_wall, 1), "unit": "ops/sec",
+        "vs_baseline": round(badh_ratio, 2)}), file=sys.stderr)
+    print(f"# refutation crash-regime: refuted in {badh_wall:.3f}s "
+          f"(witness bound idx {rbh.get('witness_bound_index')}); "
+          f"{badh_note}", file=sys.stderr)
+
     # --- Multi-key batch with crashed keys: a realistic nemesis run
     # (client timeouts scattered over independent keys) must stay on
     # the batched engine via the per-key crash-stripped twins. --------

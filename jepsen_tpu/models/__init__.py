@@ -56,6 +56,12 @@ class DeviceSpec:
                  module-level function (it keys the kernel cache).
     encode_op  : optional op -> (f, a, b, a_ok) override for models whose
                  values don't fit the generic int/pair encoding.
+    decode     : optional np.int32[state_size] -> Model, the inverse of
+                 `encode`.  Enables segment-local witness localization
+                 (the device reports WHICH segment died and from which
+                 entry states; the CPU oracle then replays only that
+                 segment seeded per entry state instead of the whole
+                 prefix).
     """
 
     state_size: int
@@ -64,6 +70,7 @@ class DeviceSpec:
     step: Callable
     pure: Optional[Callable] = None
     encode_op: Optional[Callable] = None
+    decode: Optional[Callable] = None
 
 
 class Model:
@@ -134,8 +141,12 @@ class CASRegister(Model):
             return np.array(
                 [none_code if m.value is None else m.value], np.int32)
 
+        def decode(state):
+            v = int(state[0])
+            return CASRegister(None if v == none_code else v)
+
         return DeviceSpec(1, dict(_REG_F), encode, _register_step,
-                          pure=_register_pure)
+                          pure=_register_pure, decode=decode)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,8 +172,12 @@ class Register(Model):
             return np.array(
                 [none_code if m.value is None else m.value], np.int32)
 
+        def decode(state):
+            v = int(state[0])
+            return Register(None if v == none_code else v)
+
         return DeviceSpec(1, dict(_REG_F), encode, _register_step,
-                          pure=_register_pure)
+                          pure=_register_pure, decode=decode)
 
 
 # ---------------------------------------------------------------------------
@@ -201,7 +216,8 @@ class Mutex(Model):
     def device_spec(self):
         return DeviceSpec(1, dict(_MUTEX_F),
                           lambda m: np.array([int(m.locked)], np.int32),
-                          _mutex_step)
+                          _mutex_step,
+                          decode=lambda s: Mutex(bool(int(s[0]))))
 
 
 # ---------------------------------------------------------------------------

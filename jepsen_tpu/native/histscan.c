@@ -161,7 +161,7 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
     PyObject *result = NULL;         /* set to None for fallback */
     PyObject *new_seen = NULL, *new_rows = NULL;
     vec ret_slots = {0}, cand_counts = {0}, cand_slots = {0},
-        cand_uops = {0}, cut_flags = {0};
+        cand_uops = {0}, cut_flags = {0}, ret_pos = {0};
     long *slot_of = NULL, *uop_of = NULL, *open_procs = NULL;
     if (!open_by_proc) goto fail;
 
@@ -279,7 +279,8 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
                 if (open_procs[j] == proc) { idx = j; break; }
             if (idx < 0) continue;
             if (vec_push(&ret_slots, (int32_t)slot_of[idx]) < 0 ||
-                vec_push(&cand_counts, (int32_t)n_open) < 0)
+                vec_push(&cand_counts, (int32_t)n_open) < 0 ||
+                vec_push(&ret_pos, (int32_t)i) < 0)
                 goto fail;
             for (long j = 0; j < n_open; j++) {
                 if (vec_push(&cand_slots, (int32_t)slot_of[j]) < 0 ||
@@ -309,12 +310,13 @@ static PyObject *fast_scan(PyObject *self, PyObject *args) {
         }
     }
     result = Py_BuildValue(
-        "(lly#y#y#y#y#)", n_calls, max_open,
+        "(lly#y#y#y#y#y#)", n_calls, max_open,
         (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),
         (char *)cand_counts.data, cand_counts.len * sizeof(int32_t),
         (char *)cand_slots.data, cand_slots.len * sizeof(int32_t),
         (char *)cand_uops.data, cand_uops.len * sizeof(int32_t),
-        (char *)cut_flags.data, cut_flags.len * sizeof(int32_t));
+        (char *)cut_flags.data, cut_flags.len * sizeof(int32_t),
+        (char *)ret_pos.data, ret_pos.len * sizeof(int32_t));
     goto done;
 
 fallback:
@@ -338,6 +340,7 @@ done:
     PyMem_Free(cand_slots.data);
     PyMem_Free(cand_uops.data);
     PyMem_Free(cut_flags.data);
+    PyMem_Free(ret_pos.data);
     return result;
 }
 
@@ -432,7 +435,7 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
     PyObject *result = NULL;
     PyObject *new_rows = NULL;
     vec ret_slots = {0}, cand_counts = {0}, cand_slots = {0},
-        cand_uops = {0}, cut_flags = {0};
+        cand_uops = {0}, cut_flags = {0}, ret_pos = {0};
     vec d_counts = {0}, d_slots = {0}, d_uops = {0};
     Py_ssize_t *fate = NULL;
     utab ut = {0};
@@ -566,7 +569,8 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
                     goto fail_nomem;
                 d_emitted = d_slots.len;
                 if (vec_push(&ret_slots, (int32_t)slot_of[idx]) < 0 ||
-                    vec_push(&cand_counts, (int32_t)n_open) < 0)
+                    vec_push(&cand_counts, (int32_t)n_open) < 0 ||
+                    vec_push(&ret_pos, (int32_t)i) < 0)
                     goto fail_nomem;
                 for (long j = 0; j < n_open; j++) {
                     if (vec_push(&cand_slots, (int32_t)slot_of[j]) < 0 ||
@@ -598,7 +602,7 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
             }
         }
         result = Py_BuildValue(
-            "(lly#y#y#y#y#y#y#y#)", n_calls, max_open,
+            "(lly#y#y#y#y#y#y#y#y#)", n_calls, max_open,
             (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),
             (char *)cand_counts.data, cand_counts.len * sizeof(int32_t),
             (char *)cand_slots.data, cand_slots.len * sizeof(int32_t),
@@ -606,7 +610,8 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
             (char *)cut_flags.data, cut_flags.len * sizeof(int32_t),
             (char *)d_counts.data, d_counts.len * sizeof(int32_t),
             (char *)d_slots.data, d_slots.len * sizeof(int32_t),
-            (char *)d_uops.data, d_uops.len * sizeof(int32_t));
+            (char *)d_uops.data, d_uops.len * sizeof(int32_t),
+            (char *)ret_pos.data, ret_pos.len * sizeof(int32_t));
     }
     goto done;
 
@@ -630,6 +635,7 @@ done:
     PyMem_Free(d_counts.data);
     PyMem_Free(d_slots.data);
     PyMem_Free(d_uops.data);
+    PyMem_Free(ret_pos.data);
     if (bproc.obj) PyBuffer_Release(&bproc);
     if (btyp.obj) PyBuffer_Release(&btyp);
     if (bfmap.obj) PyBuffer_Release(&bfmap);

@@ -74,7 +74,7 @@ from typing import Any, Optional
 
 import numpy as np
 
-from jepsen_tpu.history import History
+from jepsen_tpu.history import History, PackedHistory
 from jepsen_tpu.models import DeviceSpec
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
 from jepsen_tpu.ops.frontier import (make_plane_ops as _bit_ops,
@@ -396,10 +396,10 @@ class _FastKey:
     invoke onward."""
 
     __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts",
-                 "nc", "rn", "deltas")
+                 "nc", "rn", "deltas", "positions")
 
     def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None,
-                 nc=0, rn=None, deltas=None):
+                 nc=0, rn=None, deltas=None, positions=None):
         self.rets = rets
         self.max_open = max_open
         self.n_calls = n_calls
@@ -412,6 +412,11 @@ class _FastKey:
         # return, attributed to each return in stream order.  Feeds
         # _pack_regs_single without re-deriving deltas from snapshots.
         self.deltas = deltas
+        # int32[n_rets]: original op position of each return (from the
+        # native scanners) — lets invalid verdicts slice out JUST the
+        # dead segment's ops for witness localization.  None from the
+        # pure-Python twin; localization then uses the prefix oracle.
+        self.positions = positions
 
     @property
     def n_rets(self):
@@ -437,21 +442,25 @@ def _native_scan(ops: list, spec, seen: dict, rows: list,
 def _fastkey_from_native(out):
     if out is None:
         return None
-    n_calls, max_open, rs, counts, cs, cu, cuts, *delta = out
+    n_calls, max_open, rs, counts, cs, cu, cuts, *rest = out
     # Py_BuildValue turns a NULL pointer (empty vec) into None
     deltas = None
-    if delta:
-        dc, dslot, duop = delta
+    positions = None
+    if len(rest) == 1:               # object scan: + ret positions
+        positions = np.frombuffer(rest[0] or b"", np.int32)
+    elif len(rest) == 4:             # cols scan: + deltas + positions
+        dc, dslot, duop, pos = rest
         deltas = (np.frombuffer(dc or b"", np.int32),
                   np.frombuffer(dslot or b"", np.int32),
                   np.frombuffer(duop or b"", np.int32))
+        positions = np.frombuffer(pos or b"", np.int32)
     return _FastKey(None, max_open, n_calls,
                     arrays=(np.frombuffer(rs or b"", np.int32),
                             np.frombuffer(counts or b"", np.int32),
                             np.frombuffer(cs or b"", np.int32),
                             np.frombuffer(cu or b"", np.int32)),
                     cuts=np.frombuffer(cuts or b"", np.int32),
-                    deltas=deltas)
+                    deltas=deltas, positions=positions)
 
 
 def _native_scan_cols(packed, spec, seen: dict, rows: list,
@@ -852,7 +861,7 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                        decomposed: bool, rounds: int, unroll: int,
                        J: int = 1, nc: int = 0, rn: int = 0,
-                       compose: bool = False):
+                       compose: bool = False, crash_closure: bool = False):
     """Register-delta variant of the bit-packed batch kernel (J=1 for
     independent whole histories; J=Sn computes per-segment transfer
     matrices for the single-history path, one lane per segment).
@@ -898,9 +907,28 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
     lacking, set_slot, retire_slot, sel32 = _bit_ops(Wd, R)
     b_iota = np.arange(R, dtype=np.int32)[:, None]          # [R, 1]
 
-    def kern(ret_slot, inv_slot, inv_uop, aux1_tab, aux2_tab, t0_tab):
+    def kern(ret_slot, inv_slot, inv_uop, aux1_tab, aux2_tab, t0_tab,
+             *closure_args):
         # ret_slot [L, K] i8; inv_slot/inv_uop [L, K, I] i8/i16;
-        # aux1_tab/aux2_tab [U] u32, t0_tab [U] i32.
+        # aux1_tab/aux2_tab [U] u32, t0_tab [U] i32.  With
+        # crash_closure: closure_args = (crow i32 [L, K] row index,
+        # ctab u32 [nC, Sn]) — per-state next-masks, reflexively and
+        # transitively closed ON HOST, applied between expansion rounds
+        # (see _relaxed_refute for the exactness argument).
+        if crash_closure:
+            crow_all, ctab = closure_args
+
+            def close_states(fr, nm):
+                # nm [K, Sn] u32: bit t of nm[k, s] = s->t allowed
+                outs = []
+                for t in range(Sn):
+                    a = jnp.zeros_like(fr[:, 0])
+                    for s2 in range(Sn):
+                        sel = sel32(
+                            ((nm[:, s2] >> np.uint32(t)) & 1) == 1)
+                        a = a | (fr[:, s2] & sel[None, None, :])
+                    outs.append(a)
+                return jnp.stack(outs, axis=1)
         if J > 1:
             # one lane per (segment, entry config): j = cm * Sn + s with
             # mask cm << rn (cm = 0 when nc = 0, reducing to the eye)
@@ -920,7 +948,11 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
 
         def event(carry, ev):
             fr, a1r, a2r, t0r, openr = carry
-            rs, isl, iu = ev
+            if crash_closure:
+                rs, isl, iu, cr = ev
+                nm = ctab[cr.astype(jnp.int32)]           # [K, Sn]
+            else:
+                rs, isl, iu = ev
             rs = rs.astype(jnp.int32)
             isl = isl.astype(jnp.int32)
             iu = iu.astype(jnp.int32)
@@ -934,6 +966,9 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                 a2r = jnp.where(m, aux2_tab[uc][None, :], a2r)
                 t0r = jnp.where(m, t0_tab[uc][None, :], t0r)
                 openr = openr | m
+            if crash_closure:
+                # jumps BEFORE any linearization at this return
+                fr = close_states(fr, nm)
 
             # --- closure: rounds x per-slot expansion -----------------
             for _ in range(rounds):
@@ -966,6 +1001,9 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                                 moved = moved.at[:, t].set(moved[:, t] | m_t)
                     add = add | set_slot(moved, b)
                 fr = fr | add
+                if crash_closure:
+                    # jumps between consecutive linearizations
+                    fr = close_states(fr, nm)
 
             # --- prune + retire the returning slot --------------------
             cleared = jnp.zeros_like(fr)
@@ -975,8 +1013,10 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
             openr = openr & ~(rs[None, :] == b_iota)
             return (fr, a1r, a2r, t0r, openr), None
 
-        (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0,
-                                   (ret_slot, inv_slot, inv_uop),
+        xs = (ret_slot, inv_slot, inv_uop)
+        if crash_closure:
+            xs = xs + (closure_args[0],)
+        (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0, xs,
                                    unroll=unroll)
         if nc == 0:
             out = (fr[0] & 1).transpose(2, 1, 0)       # [K, J, Sn]
@@ -995,11 +1035,13 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
         # of the per-segment transfer matrices via an associative scan
         # — log2(K) levels of batched [J, J] matmuls on the MXU —
         # instead of downloading [K, J, J] matrices over the tunnel and
-        # composing on host.  The verdict comes back as TWO int32 words
-        # (valid, first-dead-segment): a fetch of 8 bytes, which is the
-        # tunnel's fixed-latency floor.  Exactness: boolean matrix
-        # product is associative; `alive` is monotone (the empty state
-        # set is absorbing), so sum(alive) IS the first dead index.
+        # composing on host.  The verdict comes back as SIX int32 words
+        # (valid, first-dead-segment, 128-bit entry-config mask of the
+        # dead segment): one fixed-latency fetch.  Exactness: boolean
+        # matrix product is associative; `alive` is monotone (the empty
+        # state set is absorbing), so sum(alive) IS the first dead
+        # index; the entry mask = reachable configs at the cut BEFORE
+        # the dead segment, which witness localization replays from.
         Tm = out.astype(jnp.float32)                   # [K, J, J]
         P = jax.lax.associative_scan(
             lambda a, b: (jnp.einsum("kij,kjl->kil", a, b) > 0)
@@ -1008,7 +1050,18 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
         valid = alive[-1]
         dead = jnp.where(valid, jnp.int32(-1),
                          jnp.sum(alive.astype(jnp.int32)))
-        return jnp.stack([valid.astype(jnp.int32), dead])
+        Jw = out.shape[1]
+        reach = P[jnp.clip(dead - 1, 0, K - 1), 0, :] > 0   # [J]
+        entry0 = jnp.zeros((Jw,), bool).at[0].set(True)
+        entry = jnp.where(valid, False,
+                          jnp.where(dead > 0, reach, entry0))
+        em = [jnp.uint32(0)] * 4
+        for j in range(min(Jw, 128)):
+            em[j // 32] = em[j // 32] | (
+                entry[j].astype(jnp.uint32) << np.uint32(j % 32))
+        return jnp.stack(
+            [valid.astype(jnp.int32), dead]
+            + [jax.lax.bitcast_convert_type(w, jnp.int32) for w in em])
 
     return jax.jit(kern)
 
@@ -1053,6 +1106,41 @@ def _unpack_transfer_bufs(buf8, buf32, B: int, L: int, K: int, I: int,
     a2 = buf32[U:2 * U]
     t0 = jax.lax.bitcast_convert_type(buf32[2 * U:3 * U], jnp.int32)
     return ret, islot, iuop, a1, a2, t0
+
+
+@functools.lru_cache(maxsize=16)
+def _build_kernel_regs_relaxed(K: int, L: int, I: int, Wd: int,
+                               Sn: int, R: int, decomposed: bool,
+                               rounds: int, unroll: int, U: int,
+                               wide_uop: bool, nC: int):
+    """Packed composed kernel under RELAXED crash semantics: crashed
+    ops are position-dependent epsilon-transitions whose reflexive-
+    transitive closures ride as a [nC, Sn] uint32 table (appended to
+    buf32); each event row carries an i16 index into it (appended to
+    buf8).  nC is bucket-padded by the caller so shapes recompile
+    rarely.  Output = the same int32[6] composed verdict."""
+    import jax
+    import jax.numpy as jnp
+
+    kern = _build_kernel_regs(K, L, I, Wd, Sn, R, decomposed,
+                              rounds=rounds, unroll=unroll, J=Sn,
+                              nc=0, rn=0, compose=True,
+                              crash_closure=True)
+    n_crow = L * K * 2               # i16
+
+    def fn(buf8, buf32):
+        base = len(buf8) - n_crow
+        tabs = _unpack_transfer_bufs(buf8[:base], buf32[:3 * U], 1, L,
+                                     K, I, U, wide_uop)
+        pairs = buf8[base:].reshape(L, K, 2)
+        lo = pairs[..., 0].astype(jnp.int32)
+        hi = jax.lax.bitcast_convert_type(
+            pairs[..., 1], jnp.int8).astype(jnp.int32)
+        crow = lo | (hi << 8)
+        ctab = buf32[3 * U:].reshape(nC, Sn)
+        return kern(*tabs, crow, ctab)
+
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=32)
@@ -1547,9 +1635,8 @@ def _run_seg_regs(seg_fk: list, K: int, R: int, U: int, Sn: int, M: int,
         out = _dispatch_regs_packed(ret_t, islot_t, iuop_t, a1t, a2t,
                                     t0t, M, Sn, R, decomposed, nc, rn,
                                     unroll)
-        vd = np.asarray(out)
-        dead = int(vd[1])
-        return None, time.monotonic() - t1, False, dead
+        vd = np.asarray(out)         # [6]: valid, dead, entry mask x4
+        return None, time.monotonic() - t1, False, vd
     kern = _build_kernel_regs(K_run, int(Lp), I, max(1, M // 32),
                               int(Sn), R, decomposed,
                               rounds=R, unroll=unroll,
@@ -1618,9 +1705,79 @@ def _build_kernel_regs_group(B: int, K: int, L: int, I: int, Wd: int,
         valid = alive[:, -1]
         dead = jnp.where(valid, jnp.int32(-1),
                          jnp.sum(alive.astype(jnp.int32), axis=1))
-        return jnp.stack([valid.astype(jnp.int32), dead], axis=1)
+        idx = jnp.clip(dead - 1, 0, K - 1)           # [B]
+        reach = P[jnp.arange(B), idx, 0, :] > 0      # [B, J]
+        entry0 = jnp.zeros((B, J), bool).at[:, 0].set(True)
+        entry = jnp.where(valid[:, None], False,
+                          jnp.where((dead > 0)[:, None], reach, entry0))
+        em = jnp.zeros((B, 4), jnp.uint32)
+        for j in range(min(J, 128)):
+            em = em.at[:, j // 32].set(
+                em[:, j // 32]
+                | (entry[:, j].astype(jnp.uint32) << np.uint32(j % 32)))
+        return jnp.concatenate(
+            [valid.astype(jnp.int32)[:, None], dead[:, None],
+             jax.lax.bitcast_convert_type(em, jnp.int32)], axis=1)
 
     return jax.jit(fn)
+
+
+def _localize_segment(model, spec, ops, fk, seg_ends, dead: int,
+                      mask_words, states) -> Optional[dict]:
+    """Exact witness localization confined to the DEAD segment: replay
+    only that segment's ops through the CPU oracle, once per reachable
+    entry state (the device's composed verdict carries the entry-config
+    mask).  The quiescent-cut composition argument makes this exact:
+    configs before the cut are summarized entirely by the reachable
+    state set, so the first op at which EVERY entry-state replay has
+    died is the global witness (the union config set empties there).
+    Returns the oracle result of the last-surviving replay (its op /
+    op_index / final-paths ARE the analysis artifacts), or None when
+    out of scope (no positions, no decode, crashed-path J-configs) —
+    callers fall back to the whole-prefix oracle."""
+    if fk.positions is None or getattr(spec, "decode", None) is None:
+        return None
+    from jepsen_tpu.ops import wgl_cpu
+
+    end_ret = int(seg_ends[dead]) - 1
+    start_pos = (int(fk.positions[int(seg_ends[dead - 1]) - 1]) + 1
+                 if dead > 0 else 0)
+    end_pos = int(fk.positions[end_ret])
+    # Quiescent cuts count OK-open calls only, so FAIL pairs may
+    # straddle either boundary; an unpaired half inside the slice
+    # would read to the oracle as a crashed (maybe-linearizable) call
+    # and could shift the witness.  A failed call is never linearized,
+    # so dropping the stray halves is exact.
+    seg_ops = []
+    open_p: set = set()
+    for o in ops[start_pos:end_pos + 1]:
+        p = o.process
+        if type(p) is int and p >= 0:
+            if o.type == "invoke":
+                open_p.add(p)
+            elif p not in open_p:
+                continue             # completion of a pre-slice invoke
+            else:
+                open_p.discard(p)
+        seg_ops.append(o)
+    if open_p:                       # invokes completing post-slice
+        seg_ops = [o for o in seg_ops
+                   if not (o.type == "invoke" and o.process in open_p)]
+    Sn = states.shape[0]
+    entry = [j for j in range(Sn)
+             if (int(mask_words[j // 32]) >> (j % 32)) & 1]
+    if not entry:
+        return None
+    best = None
+    for j in entry:
+        m = spec.decode(states[j])
+        o = wgl_cpu.check(m, History(seg_ops))
+        if o.get("valid?") is not False:
+            return None          # disagreement with the device verdict
+        if best is None or (o.get("op_index") or -1) > \
+                (best.get("op_index") or -1):
+            best = o
+    return best
 
 
 def _compose_transfer(T: np.ndarray, Sn: int) -> int:
@@ -1667,6 +1824,161 @@ def _split_crashed(ops):
             drop[cp] = True
         crashed.append((ip, cp, ops[ip]))
     return drop, crashed
+
+
+def _relaxed_refute(model, spec, history, ops, drop, crashed,
+                    crash_uop, inert, seen, rows, states, legal,
+                    next_state, *, max_open_bits,
+                    target_returns_per_segment, backend_name,
+                    localize, t0):
+    """Tier 4 — SOUND REFUTATION under relaxed crash semantics.
+
+    Over-approximate every crashed call as an unlimited-use epsilon
+    transition available from its invoke position onward: any true
+    linearization uses each crashed op at most once at some point
+    after its invoke, and each such use is one allowed jump — so the
+    relaxed config set contains the true one at every index, and
+    RELAXED-INVALID implies truly invalid.  (Relaxed-valid proves
+    nothing; callers fall through to the exact serial engines.)
+
+    This closes the reference's worst asymmetry: knossos's cost
+    explodes with crashed-op count precisely when refuting
+    (doc/tutorial/06-refining.md:12-19), while here availability is a
+    FUNCTION OF POSITION, not config state — availability only grows,
+    so the host precomputes one reflexive-transitive closure matrix
+    per crash-prefix and the kernel applies the row's closure between
+    expansion rounds.  Cost: +Sn^2 selects per round, zero extra
+    config width, any number of crashes.
+
+    Witness: the composed verdict localizes the dead segment; its last
+    return's original index is reported as `witness_bound_index` (the
+    true witness is at or before it — the relaxed config set dies no
+    earlier than the true one).  With localize=True a capped oracle
+    attempt upgrades the bound to the exact op when it finishes."""
+    Sn = states.shape[0]
+    if Sn > 32:
+        return None                  # closure masks are u32 rows
+    eff = [(ip, u) for (ip, cp, o), ine, u in
+           zip(crashed, inert, crash_uop) if not ine]
+    if any(u < 0 for _, u in eff):
+        return None                  # unencodable crashed op
+    if not eff:
+        return None                  # nothing non-inert: not our tier
+    if len(eff) > 32767:
+        return None                  # crow rides as int16
+
+    # Stripped history as columns (cheap when the run journaled them)
+    packed = history.packed_columns() if isinstance(history, History) \
+        else None
+    keep = np.nonzero(~drop)[0]
+    if packed is not None and packed.vkind is not None:
+        stripped_pk = PackedHistory(
+            packed.index[keep], packed.process[keep],
+            packed.type[keep], packed.f[keep], packed.value[keep],
+            packed.value_ok[keep], packed.time[keep],
+            dict(packed.f_codes), vkind=packed.vkind[keep])
+    else:
+        from jepsen_tpu.history import pack_history
+        stripped_pk = pack_history(
+            History([ops[i] for i in keep]))
+    U0 = len(rows)
+    fk = _native_scan_cols(stripped_pk, spec, seen, rows,
+                           max_open_bits)
+    if not fk or fk.n_calls == 0 or fk.deltas is None \
+            or len(rows) != U0:
+        return None
+    R = int(fk.max_open)
+    diag_w, const_w, const_t0 = _decompose(legal, next_state)
+    if not _regs_eligible(R, U0, Sn, diag_w is not None):
+        return None
+    cuts = np.asarray(fk.cuts, np.int32)
+    if len(cuts) != fk.n_rets or cuts[-1] != 1:
+        return None
+    seg_ends = _segment_ends(cuts, target_returns_per_segment)
+    I = min(2, R) if R else 1
+    lay = _RegsLayout(fk, seg_ends, I)
+    Lp = _pad_len(lay.lp_min)
+    K = lay.k
+    ret_t, islot_t, iuop_t = _regs_fill(lay, Lp, K, U0, I)
+
+    # Availability: #effective crashes invoked before each return's
+    # ORIGINAL position -> index into the prefix-closure table.
+    crash_pos = np.asarray([ip for ip, _ in eff], np.int64)
+    orig_ret_pos = keep[np.asarray(fk.positions, np.int64)]
+    crow_ret = np.searchsorted(crash_pos, orig_ret_pos,
+                               side="left").astype(np.int16)
+    crow_t = np.zeros((Lp, K), np.int16)
+    crow_t[lay.rho, lay.ret_key] = crow_ret
+
+    # Prefix reflexive-transitive closures (numpy boolean matmuls).
+    nC = len(eff) + 1
+    C = np.eye(Sn, dtype=bool)
+    ctab_rows = [C]
+    for _, u in eff:
+        rel = np.zeros((Sn, Sn), bool)
+        lg = legal[u].astype(bool)
+        rel[np.arange(Sn)[lg], next_state[u][lg]] = True
+        C = C | rel
+        while True:
+            C2 = C | (C @ C)
+            if (C2 == C).all():
+                break
+            C = C2
+        ctab_rows.append(C)
+    pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
+    nC_pad = _pad_len(nC)
+    ctab = np.zeros((nC_pad, Sn), np.uint32)
+    ctab[:] = (np.eye(Sn, dtype=np.uint64) * pow2).sum(1) \
+        .astype(np.uint32)           # padding rows: identity
+    for c, M in enumerate(ctab_rows):
+        ctab[c] = (M.astype(np.uint64) * pow2).sum(1).astype(np.uint32)
+
+    a1t, a2t, t0t = _pack_uop_tables(
+        legal, next_state, diag_w, const_w, const_t0)
+    # unroll=1: the closure adds Sn^2 selects per round and the scan
+    # body would otherwise blow up XLA compile time; the refutation
+    # path runs once per suspect history, not in the steady-state loop
+    unroll = 1
+    wide = iuop_t.dtype == np.int16
+    buf8 = np.concatenate([ret_t.view(np.uint8).ravel(),
+                           islot_t.view(np.uint8).ravel(),
+                           iuop_t.view(np.uint8).ravel(),
+                           crow_t.view(np.uint8).ravel()])
+    buf32 = np.concatenate([a1t, a2t, t0t.view(np.uint32),
+                            ctab.ravel()])
+    fn = _build_kernel_regs_relaxed(
+        K, int(Lp), I, max(1, (1 << R) // 32), int(Sn), R,
+        diag_w is not None, R, unroll, U0, wide, int(nC_pad))
+    vd = np.asarray(fn(buf8, buf32))
+    if int(vd[0]) == 1:
+        return None                  # relaxed-valid: proves nothing
+    dead = int(vd[1])
+    bound_pos = int(orig_ret_pos[int(seg_ends[dead]) - 1])
+    bound_op = ops[bound_pos]
+    result: dict[str, Any] = {
+        "valid?": False,
+        "op_count": fk.n_calls + len(crashed),
+        "backend": backend_name,
+        "engine": "wgl_seg",
+        "anomaly": "nonlinearizable",
+        "refutation": "crash-relaxed",
+        "crashed": len(crashed),
+        "dead_segment": dead,
+        "witness_bound_index": (bound_op.index
+                                if bound_op.index is not None
+                                else bound_pos),
+    }
+    if localize:
+        # best-effort exact witness: capped oracle (the bound already
+        # makes the verdict reportable if this gives up)
+        from jepsen_tpu.ops import wgl_cpu
+        oracle = wgl_cpu.check(model, history, time_limit=15,
+                               max_configs=500_000)
+        if oracle.get("valid?") is False:
+            for key in ("op", "op_index", "final-paths", "configs"):
+                if key in oracle:
+                    result[key] = oracle[key]
+    return result
 
 
 def _check_crashed_fast(model, spec, history, *, max_states,
@@ -1732,7 +2044,7 @@ def _check_crashed_fast(model, spec, history, *, max_states,
     uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
     init = np.asarray(spec.encode(model), np.int32)
     try:
-        _, legal, next_state = _enumerate_states(
+        states, legal, next_state = _enumerate_states(
             spec, init, uops, max_states)
     except Unsupported:
         return None
@@ -1794,7 +2106,17 @@ def _check_crashed_fast(model, spec, history, *, max_states,
     if res is not None and res.get("valid?") is True:
         res["crashed_ignored"] = len(crashed)
         return res
-    return None
+
+    # Tier 4: the stripped history could NOT be proven valid — attempt
+    # a sound refutation under relaxed crash semantics (any number of
+    # crashes; see _relaxed_refute).  Inconclusive -> None (serial
+    # engines take over, exactly as before).
+    return _relaxed_refute(
+        model, spec, history, ops, drop, crashed, crash_uop, inert,
+        seen, rows, states, legal, next_state,
+        max_open_bits=max_open_bits,
+        target_returns_per_segment=target_returns_per_segment,
+        backend_name=backend_name, localize=localize, t0=t0)
 
 
 def _segments_from_fk(fk, R: int, seg_ends):
@@ -1899,12 +2221,16 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         seg_fk = _segments_from_fk(fk, R, seg_ends)
     t_plan = time.monotonic() - t0
 
-    T, t_kernel, sharded, dead_segment = _run_seg_regs(
+    T, t_kernel, sharded, verdict = _run_seg_regs(
         seg_fk, K, R, legal.shape[0], Sn, 1 << R, legal, next_state,
         diag_w, const_w, const_t0, mesh, mesh_axis, nc=nc, rn=rn,
         tables=tables)
-    if dead_segment is None:
+    entry_mask = None
+    if verdict is None:
         dead_segment = _compose_transfer(T, Sn << nc)
+    else:
+        dead_segment = int(verdict[1])
+        entry_mask = verdict[2:6]
 
     result: dict[str, Any] = {
         "valid?": dead_segment < 0,
@@ -1923,9 +2249,18 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         result["anomaly"] = "nonlinearizable"
         result["dead_segment"] = dead_segment
         if localize:
-            # the oracle terminates at the first non-linearizable op
-            from jepsen_tpu.ops import wgl_cpu
-            oracle = wgl_cpu.check(model, history)
+            oracle = None
+            if entry_mask is not None and nc == 0:
+                # segment-local replay from the device's entry mask —
+                # O(segment) instead of O(prefix-through-witness)
+                oracle = _localize_segment(model, spec, ops, fk,
+                                           seg_ends, dead_segment,
+                                           entry_mask, states)
+            if oracle is None:
+                # fallback: whole-history oracle (terminates at the
+                # first non-linearizable op)
+                from jepsen_tpu.ops import wgl_cpu
+                oracle = wgl_cpu.check(model, history)
             for key in ("op", "op_index", "final-paths", "configs"):
                 if key in oracle:
                     result[key] = oracle[key]
@@ -1989,9 +2324,11 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     U = pl.legal.shape[0]
     dead_segment = None
     if pl.seg_fk is not None and _regs_eligible(R, U, Sn, decomposed):
-        T, t_kernel, sharded, dead_segment = _run_seg_regs(
+        T, t_kernel, sharded, verdict = _run_seg_regs(
             pl.seg_fk, K, R, U, Sn, M, pl.legal, pl.next_state,
             pl.diag_w, pl.const_w, pl.const_t0, mesh, mesh_axis)
+        if verdict is not None:
+            dead_segment = int(verdict[1])
     else:
         sharded = False
         K_run = K
@@ -2137,13 +2474,13 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                 continue
             seg_ends = _segment_ends(cuts, target_returns_per_segment)
             if fk.deltas is not None:
-                packs[i] = (_RegsLayout(fk, seg_ends, I), fk)
+                packs[i] = (_RegsLayout(fk, seg_ends, I), fk, seg_ends)
             else:                    # Python-scan keys: snapshot packer
                 seg_fk = _segments_from_fk(fk, R, seg_ends)
                 tabs = _pack_regs(
                     [(k, f) for k, f in enumerate(seg_fk)],
                     len(seg_ends), R, U, I)
-                packs[i] = ((tabs, len(seg_ends)), fk)
+                packs[i] = ((tabs, len(seg_ends)), fk, seg_ends)
         if packs:
             # one common shape for the whole batch (one compile):
             # padding rows/lanes are exact no-ops (ret -1, no invokes),
@@ -2153,13 +2490,13 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                 if isinstance(p, _RegsLayout):
                     return p.lp_min, p.k
                 return p[0][3], p[0][0].shape[1]
-            Lp_c = _pad_len(max(_shape_of(p)[0] for p, _ in
+            Lp_c = _pad_len(max(_shape_of(p)[0] for p, *_ in
                                 packs.values()))
-            K_c = ((max(_shape_of(p)[1] for p, _ in packs.values())
+            K_c = ((max(_shape_of(p)[1] for p, *_ in packs.values())
                     + 63) // 64) * 64
             wide = U > 127
             bufs: dict = {}
-            for i, (p, fk) in packs.items():
+            for i, (p, fk, _) in packs.items():
                 if isinstance(p, _RegsLayout):
                     ret_t, islot_t, iuop_t = _regs_fill(
                         p, Lp_c, K_c, U, I)
@@ -2197,10 +2534,10 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                     R, diag_w is not None, R, unroll, U, wide)
                 outs.append(fn(np.concatenate(blocks), buf32))
             stacked = _build_stack(len(outs))(*outs)
-            vd = np.asarray(stacked).reshape(-1, 2)  # ONE fetch
+            vd = np.asarray(stacked).reshape(-1, 6)  # ONE fetch
             for j, i in enumerate(order):
                 valid = bool(vd[j, 0])
-                p, fk = packs[i]
+                p, fk, seg_ends_i = packs[i]
                 res: dict = {"valid?": valid, "op_count": fk.n_calls,
                              "backend": backend_name,
                              "engine": "wgl_seg",
@@ -2210,8 +2547,15 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                     res["anomaly"] = "nonlinearizable"
                     res["dead_segment"] = int(vd[j, 1])
                     if localize:
-                        from jepsen_tpu.ops import wgl_cpu
-                        oracle = wgl_cpu.check(model, histories[i])
+                        hi = histories[i]
+                        h_ops = hi.ops if isinstance(hi, History) \
+                            else History(hi).ops
+                        oracle = _localize_segment(
+                            model, spec, h_ops, fk, seg_ends_i,
+                            int(vd[j, 1]), vd[j, 2:6], states)
+                        if oracle is None:
+                            from jepsen_tpu.ops import wgl_cpu
+                            oracle = wgl_cpu.check(model, histories[i])
                         for key in ("op", "op_index", "final-paths",
                                     "configs"):
                             if key in oracle:

@@ -944,3 +944,68 @@ class TestColumnarScanAndPipeline:
         h.append(ok_op(0, "write", 2 ** 70))
         cols = h.packed_columns()
         assert cols is not None and not cols.value_ok[0, 0]
+
+
+class TestRefutation:
+    """Round-3 refutation paths: segment-local witness localization
+    (entry-mask replay) and the sound crash-relaxed refutation tier."""
+
+    def test_deep_witness_matches_oracle(self):
+        from jepsen_tpu.history import pack_history
+        model = models.CASRegister(0)
+        for s in (3, 9, 15):
+            h = rand_history(s, n_ops=500, conc=4, buggy=True)
+            h.attach_packed(pack_history(h))
+            r = wgl_seg.check(model, h)
+            o = wgl_cpu.check(model, h)
+            assert r["valid?"] == o["valid?"]
+            if r["valid?"] is False:
+                assert r.get("op_index") == o.get("op_index")
+
+    def test_relaxed_refutation_sound_and_bounded(self):
+        from jepsen_tpu.history import History, pack_history
+        model = models.CASRegister(0)
+        fired = 0
+        for s in range(10):
+            h = crash_history(s, n_calls=70, conc=3, crash_rate=0.15,
+                              corrupt=(s % 2 == 0), effect_rate=0.6)
+            h = History(list(h)).index()
+            h.attach_packed(pack_history(h))
+            try:
+                r = wgl_seg.check(model, h)
+            except wgl_seg.Unsupported:
+                continue
+            o = wgl_cpu.check(model, h, max_configs=4_000_000)
+            if r.get("refutation") == "crash-relaxed":
+                fired += 1
+                assert r["valid?"] is False
+                if o["valid?"] != "unknown":
+                    # soundness: relaxed-invalid implies truly invalid
+                    assert o["valid?"] is False, s
+                    wb = r["witness_bound_index"]
+                    wi = o.get("op_index")
+                    assert wi is None or wi <= wb, (wi, wb)
+            elif o["valid?"] != "unknown":
+                assert r["valid?"] == o["valid?"], s
+        assert fired >= 2
+
+    @pytest.mark.slow
+    def test_relaxed_refutation_battery(self):
+        from jepsen_tpu.history import History, pack_history
+        model = models.CASRegister(0)
+        for s in range(10, 34):
+            h = crash_history(s, n_calls=90, conc=4, crash_rate=0.12,
+                              corrupt=(s % 2 == 0), effect_rate=0.5)
+            h = History(list(h)).index()
+            h.attach_packed(pack_history(h))
+            try:
+                r = wgl_seg.check(model, h)
+            except wgl_seg.Unsupported:
+                continue
+            o = wgl_cpu.check(model, h, max_configs=4_000_000)
+            if o["valid?"] == "unknown":
+                continue
+            if r.get("refutation") == "crash-relaxed":
+                assert o["valid?"] is False, s
+            else:
+                assert r["valid?"] == o["valid?"], s
