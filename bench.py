@@ -47,7 +47,7 @@ jax.config.update("jax_compilation_cache_dir",
 from jepsen_tpu import models
 from jepsen_tpu.history import (History, fail_op, invoke_op, ok_op,
                                 pack_history)
-from jepsen_tpu.ops import wgl_cpu, wgl_seg
+from jepsen_tpu.ops import wgl_cpu, wgl_cpu_native, wgl_seg
 
 N_KEYS = 3400
 OPS_PER_KEY = 300
@@ -142,6 +142,23 @@ def main() -> int:
     cpu_ops = sum(sum(1 for o in h if o.is_invoke)
                   for h in hists[:CPU_SAMPLE_KEYS])
     cpu_rate = cpu_ops / cpu_s
+    # Second baseline: the NATIVE oracle (ops/wgl_cpu_native — same
+    # algorithm, hot loop + columnar ingest in C).  Reported so no
+    # ratio hides an interpreter constant; see BASELINE.md.  One warm
+    # pass first: per-key state enumeration traces a tiny CPU-jax
+    # expander per distinct uop count, and the device side's compiles
+    # are likewise excluded from its timed runs.
+    for h in hists[:CPU_SAMPLE_KEYS]:
+        wgl_cpu_native.check(model, h)
+    t0 = time.monotonic()
+    for h in hists[:CPU_SAMPLE_KEYS]:
+        assert wgl_cpu_native.check(model, h)["valid?"] is True
+    nat_s = time.monotonic() - t0
+    nat_rate = cpu_ops / nat_s
+    print(f"# baselines: python oracle {cpu_rate:.0f} ops/s; NATIVE "
+          f"oracle {nat_rate:.0f} ops/s ({nat_rate / cpu_rate:.1f}x "
+          "python — the honest single-core CPU bound)",
+          file=sys.stderr)
 
     # --- Device batch engine: cold run compiles (cached persistently);
     # the steady-state measurement is the best of three warm runs (the
@@ -297,10 +314,20 @@ def main() -> int:
         return 1
     per_hist = pipe_wall / N_PIPE
     pipe_ratio = (n1 / per_hist) / cpu_single_rate
+    t0 = time.monotonic()
+    rn1 = wgl_cpu_native.check(model, single)
+    nat_single_s = time.monotonic() - t0
     print(f"# north-star pipelined: {N_PIPE} x {n1} ops in "
           f"{pipe_wall:.3f}s wall = {per_hist * 1e3:.1f} ms/history "
           f"({n1 / per_hist / 1e6:.2f}M ops/s; {cpu_note}; "
-          f"ratio {pipe_ratio:.1f}x)", file=sys.stderr)
+          f"ratio {pipe_ratio:.1f}x vs the python oracle).  "
+          f"HONESTY: the NATIVE oracle checks the same history in "
+          f"{nat_single_s * 1e3:.0f} ms on one CPU core "
+          f"(verdict {rn1['valid?']}) — on easy valid histories a "
+          "well-engineered serial oracle beats this tunneled chip; "
+          "the device case is the crash/refutation regimes below and "
+          "mesh scale-out, not easy-history constants (BASELINE.md).",
+          file=sys.stderr)
 
     # --- Config 6: the HARD regime — 16 worker processes, crashed
     # (:info) calls every ~1% of ops.  Crashed ops stay concurrent with
@@ -392,7 +419,8 @@ def main() -> int:
     tgt = reads[int(len(reads) * 0.9)]
     badh.ops[tgt].value = 99
     badh.attach_packed(pack_history(badh))
-    wgl_seg.check(model, badh, max_open_bits=12)      # warm
+    wgl_seg.check(model, badh, max_open_bits=12,      # warm
+                  localize=False)
     badh_wall = float("inf")
     for _ in range(3):
         t0 = time.monotonic()
@@ -432,7 +460,12 @@ def main() -> int:
         "vs_baseline": round(badh_ratio, 2)}), file=sys.stderr)
     print(f"# refutation crash-regime: refuted in {badh_wall:.3f}s "
           f"(witness bound idx {rbh.get('witness_bound_index')}); "
-          f"{badh_note}", file=sys.stderr)
+          f"{badh_note}.  The native oracle cannot hold this regime "
+          "either: crashed calls stay pending forever, overflowing "
+          "its 64-call mask, and its python fallback is the capped "
+          "oracle above — the crash regime is where the device "
+          "formulation is structurally, not constant-factor, ahead.",
+          file=sys.stderr)
 
     # --- Multi-key batch with crashed keys: a realistic nemesis run
     # (client timeouts scattered over independent keys) must stay on

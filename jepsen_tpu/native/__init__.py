@@ -32,9 +32,12 @@ def _build(name: str, source: str) -> Optional[str]:
     """cc -shared -fPIC — rebuilt whenever the source is newer."""
     out = _so_path(name)
     src = os.path.join(_DIR, source)
+    hdr = os.path.join(_DIR, "scancommon.h")
     try:
-        if (os.path.exists(out)
-                and os.path.getmtime(out) >= os.path.getmtime(src)):
+        newest = max([os.path.getmtime(src)]
+                     + ([os.path.getmtime(hdr)]
+                        if os.path.exists(hdr) else []))
+        if os.path.exists(out) and os.path.getmtime(out) >= newest:
             return out
         os.makedirs(_BUILD, exist_ok=True)
         include = sysconfig.get_paths()["include"]
@@ -71,3 +74,10 @@ def histscan():
     if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
         return None
     return _load("_histscan", "histscan.c")
+
+
+def wgloracle():
+    """The _wgloracle extension module, or None (Python fallback)."""
+    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+        return None
+    return _load("_wgloracle", "wgloracle.c")

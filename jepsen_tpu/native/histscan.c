@@ -28,24 +28,7 @@
 #include <stdint.h>
 #include <string.h>
 
-#define MAX_OPEN_HARD 64
-
-typedef struct {
-    int32_t *data;
-    Py_ssize_t len, cap;
-} vec;
-
-static int vec_push(vec *v, int32_t x) {
-    if (v->len == v->cap) {
-        Py_ssize_t ncap = v->cap ? v->cap * 2 : 256;
-        int32_t *nd = PyMem_Realloc(v->data, ncap * sizeof(int32_t));
-        if (!nd) return -1;
-        v->data = nd;
-        v->cap = ncap;
-    }
-    v->data[v->len++] = x;
-    return 0;
-}
+#include "scancommon.h"
 
 static PyObject *s_process, *s_type, *s_f, *s_value;
 static PyObject *t_invoke, *t_ok, *t_fail, *t_info;
@@ -359,60 +342,6 @@ done:
  * (crashed calls, double invoke, vkind 4, missing f-code, deep
  * concurrency) — callers fall through to the object paths.           */
 
-typedef struct { int64_t f, a, b, ok; long u; } uent;
-typedef struct { uent *e; long cap, n; } utab;
-
-static int utab_init(utab *t, long cap) {
-    long c = 64;
-    while (c < cap) c <<= 1;
-    t->e = PyMem_Malloc(c * sizeof(uent));
-    if (!t->e) return -1;
-    for (long i = 0; i < c; i++) t->e[i].u = -1;
-    t->cap = c;
-    t->n = 0;
-    return 0;
-}
-
-static uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
-    uint64_t h = 1469598103934665603ULL;
-    h = (h ^ (uint64_t)f) * 1099511628211ULL;
-    h = (h ^ (uint64_t)a) * 1099511628211ULL;
-    h = (h ^ (uint64_t)b) * 1099511628211ULL;
-    h = (h ^ (uint64_t)ok) * 1099511628211ULL;
-    return h;
-}
-
-/* find slot for key; returns index into t->e (occupied or empty) */
-static long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
-                      int64_t ok) {
-    uint64_t m = (uint64_t)t->cap - 1;
-    uint64_t i = utab_hash(f, a, b, ok) & m;
-    for (;;) {
-        uent *e = &t->e[i];
-        if (e->u < 0 || (e->f == f && e->a == a && e->b == b
-                         && e->ok == ok))
-            return (long)i;
-        i = (i + 1) & m;
-    }
-}
-
-static int utab_grow(utab *t) {
-    uent *old = t->e;
-    long ocap = t->cap;
-    t->e = PyMem_Malloc(2 * ocap * sizeof(uent));
-    if (!t->e) { t->e = old; return -1; }
-    t->cap = 2 * ocap;
-    for (long i = 0; i < t->cap; i++) t->e[i].u = -1;
-    for (long i = 0; i < ocap; i++)
-        if (old[i].u >= 0) {
-            long s = utab_slot(t, old[i].f, old[i].a, old[i].b,
-                               old[i].ok);
-            t->e[s] = old[i];
-        }
-    PyMem_Free(old);
-    return 0;
-}
-
 static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
     Py_buffer bproc = {0}, btyp = {0}, bfmap = {0}, bva = {0},
               bvb = {0}, bvk = {0};
@@ -514,35 +443,9 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
                 }
                 long fc = fmap[i];
                 if (fc < 0) goto fallback;        /* f not in spec */
-                long s2 = utab_slot(&ut, fc, a, b, okv);
-                long u;
-                if (ut.e[s2].u >= 0) {
-                    u = ut.e[s2].u;
-                } else {
-                    u = -1;
-                    if (seen_nonempty) {
-                        PyObject *key = Py_BuildValue("(llll)", fc, a,
-                                                      b, okv);
-                        if (!key) goto fail;
-                        PyObject *uo = PyDict_GetItem(seen, key);
-                        Py_DECREF(key);
-                        if (uo) u = PyLong_AsLong(uo);
-                    }
-                    if (u < 0) {
-                        u = base_rows + PyList_GET_SIZE(new_rows);
-                        PyObject *key = Py_BuildValue("(llll)", fc, a,
-                                                      b, okv);
-                        if (!key) goto fail;
-                        int r = PyList_Append(new_rows, key);
-                        Py_DECREF(key);
-                        if (r < 0) goto fail;
-                    }
-                    ut.e[s2].f = fc; ut.e[s2].a = a;
-                    ut.e[s2].b = b; ut.e[s2].ok = okv;
-                    ut.e[s2].u = u;
-                    if (++ut.n * 2 > ut.cap && utab_grow(&ut) < 0)
-                        goto fail_nomem;
-                }
+                long u = intern_uop(&ut, seen, seen_nonempty,
+                                    rows, new_rows, fc, a, b, okv);
+                if (u < 0) goto fail;
                 long s = n_free ? free_slots[--n_free] : next_slot++;
                 if (n_open >= MAX_OPEN_HARD) goto fallback;
                 open_procs[n_open] = p;
@@ -590,17 +493,8 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
         }
 
         /* success: publish the staged interning */
-        {
-            Py_ssize_t m = PyList_GET_SIZE(new_rows);
-            for (Py_ssize_t i2 = 0; i2 < m; i2++) {
-                PyObject *key = PyList_GET_ITEM(new_rows, i2);
-                PyObject *uu = PyLong_FromSsize_t(base_rows + i2);
-                int r = uu ? PyDict_SetItem(seen, key, uu) : -1;
-                Py_XDECREF(uu);
-                if (r < 0) goto fail;
-                if (PyList_Append(rows, key) < 0) goto fail;
-            }
-        }
+        if (publish_interning(seen, rows, new_rows, base_rows) < 0)
+            goto fail;
         result = Py_BuildValue(
             "(lly#y#y#y#y#y#y#y#y#)", n_calls, max_open,
             (char *)ret_slots.data, ret_slots.len * sizeof(int32_t),

@@ -1,0 +1,136 @@
+/* Shared helpers for the native history scanners/oracle:
+ * growable int32 vector, open-addressing uop-interning hash, and the
+ * hard bound on simultaneously-open calls.  Included by histscan.c
+ * and wgloracle.c so the interning semantics live in ONE place. */
+#ifndef JEPSEN_TPU_SCANCOMMON_H
+#define JEPSEN_TPU_SCANCOMMON_H
+
+#include <Python.h>
+#include <stdint.h>
+
+#define MAX_OPEN_HARD 64
+
+typedef struct {
+    int32_t *data;
+    Py_ssize_t len, cap;
+} vec;
+
+static int vec_push(vec *v, int32_t x) {
+    if (v->len == v->cap) {
+        Py_ssize_t ncap = v->cap ? v->cap * 2 : 256;
+        int32_t *nd = PyMem_Realloc(v->data, ncap * sizeof(int32_t));
+        if (!nd) return -1;
+        v->data = nd;
+        v->cap = ncap;
+    }
+    v->data[v->len++] = x;
+    return 0;
+}
+
+/* uop interning table: key (f, a, b, ok) -> dense uop id */
+typedef struct { int64_t f, a, b, ok; long u; } uent;
+typedef struct { uent *e; long cap, n; } utab;
+
+static int utab_init(utab *t, long cap) {
+    long c = 64;
+    while (c < cap) c <<= 1;
+    t->e = PyMem_Malloc(c * sizeof(uent));
+    if (!t->e) return -1;
+    for (long i = 0; i < c; i++) t->e[i].u = -1;
+    t->cap = c;
+    t->n = 0;
+    return 0;
+}
+
+static uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
+    uint64_t h = 1469598103934665603ULL;
+    h = (h ^ (uint64_t)f) * 1099511628211ULL;
+    h = (h ^ (uint64_t)a) * 1099511628211ULL;
+    h = (h ^ (uint64_t)b) * 1099511628211ULL;
+    h = (h ^ (uint64_t)ok) * 1099511628211ULL;
+    return h;
+}
+
+/* find slot for key; returns index into t->e (occupied or empty) */
+static long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
+                      int64_t ok) {
+    uint64_t m = (uint64_t)t->cap - 1;
+    uint64_t i = utab_hash(f, a, b, ok) & m;
+    for (;;) {
+        uent *e = &t->e[i];
+        if (e->u < 0 || (e->f == f && e->a == a && e->b == b
+                         && e->ok == ok))
+            return (long)i;
+        i = (i + 1) & m;
+    }
+}
+
+static int utab_grow(utab *t) {
+    uent *old = t->e;
+    long ocap = t->cap;
+    t->e = PyMem_Malloc(2 * ocap * sizeof(uent));
+    if (!t->e) { t->e = old; return -1; }
+    t->cap = 2 * ocap;
+    for (long i = 0; i < t->cap; i++) t->e[i].u = -1;
+    for (long i = 0; i < ocap; i++)
+        if (old[i].u >= 0) {
+            long s = utab_slot(t, old[i].f, old[i].a, old[i].b,
+                               old[i].ok);
+            t->e[s] = old[i];
+        }
+    PyMem_Free(old);
+    return 0;
+}
+
+/* Intern (f, a, b, ok) against the shared Python `seen`/staged
+ * `new_rows`, with the C hash as the fast path.  Returns the uop id,
+ * or -1 on error (Python exception set). */
+static long intern_uop(utab *ut, PyObject *seen, int seen_nonempty,
+                       PyObject *rows, PyObject *new_rows,
+                       long fc, long a, long b, long okv) {
+    long s2 = utab_slot(ut, fc, a, b, okv);
+    if (ut->e[s2].u >= 0) return ut->e[s2].u;
+    long u = -1;
+    if (seen_nonempty) {
+        PyObject *key = Py_BuildValue("(llll)", fc, a, b, okv);
+        if (!key) return -1;
+        PyObject *uo = PyDict_GetItem(seen, key);
+        Py_DECREF(key);
+        if (uo) u = PyLong_AsLong(uo);
+    }
+    if (u < 0) {
+        u = PyList_GET_SIZE(rows) + PyList_GET_SIZE(new_rows);
+        PyObject *key = Py_BuildValue("(llll)", fc, a, b, okv);
+        if (!key) return -1;
+        int r = PyList_Append(new_rows, key);
+        Py_DECREF(key);
+        if (r < 0) return -1;
+    }
+    ut->e[s2].f = fc;
+    ut->e[s2].a = a;
+    ut->e[s2].b = b;
+    ut->e[s2].ok = okv;
+    ut->e[s2].u = u;
+    if (++ut->n * 2 > ut->cap && utab_grow(ut) < 0) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    return u;
+}
+
+/* publish staged interning rows into the shared seen/rows */
+static int publish_interning(PyObject *seen, PyObject *rows,
+                             PyObject *new_rows, Py_ssize_t base_rows) {
+    Py_ssize_t m = PyList_GET_SIZE(new_rows);
+    for (Py_ssize_t i = 0; i < m; i++) {
+        PyObject *key = PyList_GET_ITEM(new_rows, i);
+        PyObject *uu = PyLong_FromSsize_t(base_rows + i);
+        int r = uu ? PyDict_SetItem(seen, key, uu) : -1;
+        Py_XDECREF(uu);
+        if (r < 0) return -1;
+        if (PyList_Append(rows, key) < 0) return -1;
+    }
+    return 0;
+}
+
+#endif /* JEPSEN_TPU_SCANCOMMON_H */

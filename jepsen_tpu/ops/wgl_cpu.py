@@ -31,9 +31,16 @@ from jepsen_tpu.ops.prep import PreparedHistory, prepare
 def check(model, history, *,
           max_configs: int = 1_000_000,
           time_limit: Optional[float] = None,
-          cancel=None) -> dict[str, Any]:
+          cancel=None, initial_models=None) -> dict[str, Any]:
     """cancel: optional threading.Event — when set, the walk stops and
     returns {'valid?': 'cancelled'} (competition-mode loser).
+
+    initial_models: optional list of models to seed the config set with
+    INSTEAD of `model` — the segment-local witness replay passes every
+    reachable entry state of the dead segment here, so the walk IS the
+    union of the per-entry-state searches and its witness (first return
+    at which the union empties) matches the whole-history oracle's by
+    quiescent-cut compositionality.
 
     Returns a knossos-shaped analysis map:
     {'valid?': True|False|'unknown', 'op_count', 'configs', 'final_model'?,
@@ -42,7 +49,10 @@ def check(model, history, *,
     prep = history if isinstance(history, PreparedHistory) else prepare(history)
     calls = prep.calls
 
-    configs: set[tuple[frozenset, Any]] = {(frozenset(), model)}
+    configs: set[tuple[frozenset, Any]] = {
+        (frozenset(), m)
+        for m in (initial_models if initial_models is not None
+                  else [model])}
     pending: set[int] = set()
 
     events_done = 0

@@ -1653,7 +1653,8 @@ def _dispatch_regs_packed(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
                           nc: int, rn: int, unroll: int):
     """Pack the six host tables into two transfer buffers and dispatch
     the composed register kernel asynchronously; returns the un-fetched
-    int32[2] (valid, first-dead-segment) device value."""
+    int32[6] (valid, first-dead-segment, 128-bit entry-config mask)
+    device value."""
     Lp, K_run = ret_t.shape
     I = islot_t.shape[2]
     wide = iuop_t.dtype == np.int16
@@ -1749,35 +1750,36 @@ def _localize_segment(model, spec, ops, fk, seg_ends, dead: int,
     # and could shift the witness.  A failed call is never linearized,
     # so dropping the stray halves is exact.
     seg_ops = []
-    open_p: set = set()
-    for o in ops[start_pos:end_pos + 1]:
+    open_at: dict = {}               # process -> seg_ops index of its
+    for o in ops[start_pos:end_pos + 1]:    # currently-open invoke
         p = o.process
         if type(p) is int and p >= 0:
             if o.type == "invoke":
-                open_p.add(p)
-            elif p not in open_p:
+                open_at[p] = len(seg_ops)
+            elif p not in open_at:
                 continue             # completion of a pre-slice invoke
             else:
-                open_p.discard(p)
+                del open_at[p]
         seg_ops.append(o)
-    if open_p:                       # invokes completing post-slice
-        seg_ops = [o for o in seg_ops
-                   if not (o.type == "invoke" and o.process in open_p)]
+    if open_at:                      # invokes completing post-slice:
+        drop = set(open_at.values())  # drop exactly those invokes
+        seg_ops = [o for i, o in enumerate(seg_ops) if i not in drop]
     Sn = states.shape[0]
     entry = [j for j in range(Sn)
              if (int(mask_words[j // 32]) >> (j % 32)) & 1]
     if not entry:
         return None
-    best = None
-    for j in entry:
-        m = spec.decode(states[j])
-        o = wgl_cpu.check(m, History(seg_ops))
-        if o.get("valid?") is not False:
-            return None          # disagreement with the device verdict
-        if best is None or (o.get("op_index") or -1) > \
-                (best.get("op_index") or -1):
-            best = o
-    return best
+    # ONE union walk seeded with every reachable entry state: its
+    # witness (the first return at which the union config set empties)
+    # is the whole-history witness by construction — separate
+    # per-entry-state replays would die at different RETURN events and
+    # picking among them by op_index (an INVOKE index) is wrong.
+    o = wgl_cpu.check(None, History(seg_ops),
+                      initial_models=[spec.decode(states[j])
+                                      for j in entry])
+    if o.get("valid?") is not False:
+        return None              # disagreement with the device verdict
+    return o
 
 
 def _compose_transfer(T: np.ndarray, Sn: int) -> int:

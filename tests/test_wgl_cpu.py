@@ -263,3 +263,53 @@ def test_random_mutated_histories_mostly_invalid():
         if r["valid?"] is False:
             invalid += 1
     assert invalid == total  # 99 can never legally be read
+
+
+class TestNativeOracle:
+    """ops/wgl_cpu_native — verdict- and witness-identical to the
+    Python oracle on its scope (differentially), C columnar ingest
+    included, graceful fallback outside it."""
+
+    def test_differential_including_columnar_ingest(self):
+        from jepsen_tpu.history import pack_history
+        from jepsen_tpu.ops import wgl_cpu_native
+        import sys as _sys
+        _sys.path.insert(0, "tests")
+        from test_wgl_seg import crash_history, rand_history
+
+        model = CASRegister(0)
+        for s in range(24):
+            if s % 3 == 2:
+                h = History(list(crash_history(
+                    s, n_calls=50, conc=3, crash_rate=0.1,
+                    corrupt=(s % 6 == 2)))).index()
+            else:
+                h = rand_history(s, n_ops=120, conc=4,
+                                 buggy=(s % 2 == 0))
+            if s % 2 == 0:
+                h.attach_packed(pack_history(h))
+            a = check(model, h)
+            b = wgl_cpu_native.check(model, h)
+            assert a["valid?"] == b["valid?"], s
+            if a["valid?"] is False:
+                assert a.get("op_index") == b.get("op_index"), s
+
+    def test_fallback_without_device_spec(self):
+        from jepsen_tpu.ops import wgl_cpu_native
+        h = History([invoke_op(0, "read", None),
+                     ok_op(0, "read", None)]).index()
+        from jepsen_tpu.models import NoOp
+        r = wgl_cpu_native.check(NoOp(), h)
+        assert r["valid?"] is True
+        assert r.get("engine") != "wgl_cpu_native"
+
+    def test_caps_report_unknown(self):
+        from jepsen_tpu.ops import wgl_cpu_native
+        import sys as _sys
+        _sys.path.insert(0, "tests")
+        from test_wgl_seg import rand_history
+        h = rand_history(3, n_ops=200, conc=4)
+        r = wgl_cpu_native.check(CASRegister(0), h,
+                                 max_configs=1)
+        assert r["valid?"] == "unknown"
+        assert r["cause"] == "config-explosion"

@@ -951,16 +951,20 @@ class TestRefutation:
     (entry-mask replay) and the sound crash-relaxed refutation tier."""
 
     def test_deep_witness_matches_oracle(self):
+        # seed 13 regression: a fail pair straddling the segment end
+        # must drop ONLY the unpaired invoke, not every invoke of that
+        # process, and the replay must be ONE union walk over the
+        # entry states (per-state replays die at different returns).
         from jepsen_tpu.history import pack_history
         model = models.CASRegister(0)
-        for s in (3, 9, 15):
+        for s in (3, 9, 13, 15, 18, 21):
             h = rand_history(s, n_ops=500, conc=4, buggy=True)
             h.attach_packed(pack_history(h))
             r = wgl_seg.check(model, h)
             o = wgl_cpu.check(model, h)
             assert r["valid?"] == o["valid?"]
             if r["valid?"] is False:
-                assert r.get("op_index") == o.get("op_index")
+                assert r.get("op_index") == o.get("op_index"), s
 
     def test_relaxed_refutation_sound_and_bounded(self):
         from jepsen_tpu.history import History, pack_history
