@@ -5,9 +5,13 @@ a full post-run drain."""
 
 from __future__ import annotations
 
+import random
+
 from jepsen_tpu import control as c
 from jepsen_tpu import control_util as cu
 from jepsen_tpu import db as db_mod
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import os_debian
 from jepsen_tpu.control import lit
 from jepsen_tpu.suites._template import (QueueClient, queue_test,
                                          simple_main)
@@ -17,16 +21,78 @@ PORT = 7711
 QUEUE = "jepsen"
 
 
+VERSION = "master"
+CONFIG = """port %PORT%
+daemonize no
+appendonly yes
+dir %DIR%
+"""
+
+
+def install(version: str = VERSION) -> None:
+    """Build disque from source on the node (disque.clj install!
+    :40-53: git clone antirez/disque, pin the version, make) — the
+    reference never assumes a prebuilt binary."""
+    os_debian.install(["git-core", "build-essential"])
+    with c.su():
+        if not cu.exists(DIR):
+            with c.cd("/opt"):
+                c.execute("git", "clone",
+                          "https://github.com/antirez/disque.git")
+        with c.cd(DIR):
+            c.execute("git", "pull", check=False)
+            c.execute("git", "reset", "--hard", version)
+            c.execute("make")
+
+
+def configure(node) -> None:
+    """Upload the config file (disque.clj configure! :55-62)."""
+    with c.su():
+        c.upload_str(CONFIG.replace("%PORT%", str(PORT))
+                     .replace("%DIR%", DIR),
+                     f"{DIR}/disque.conf")
+
+
+def stop(node) -> None:
+    with c.su():
+        cu.stop_daemon(f"{DIR}/disque.pid", f"{DIR}/src/disque-server")
+
+
+def start(node, test) -> None:
+    with c.su():                     # /opt/disque is root-owned (the
+        cu.start_daemon(             # build ran under su), disque.clj
+            f"{DIR}/src/disque-server",       # start!/stop! likewise
+            f"{DIR}/disque.conf",
+            chdir=DIR, logfile=f"{DIR}/disque.log",
+            pidfile=f"{DIR}/disque.pid")
+
+
+def killer():
+    """Kills a random node's server on :start, restarts it on :stop
+    (disque.clj killer :265-271)."""
+    return nem.node_start_stopper(
+        lambda nodes: random.choice(list(nodes)),
+        lambda test, node: (stop(node), ["killed", node])[1],
+        lambda test, node: (start(node, test), ["restarted", node])[1])
+
+
+NEMESES = {
+    "partitions": nem.partition_random_halves,
+    "killer": killer,
+}
+
+
 class DisqueDB(db_mod.DB, db_mod.LogFiles):
-    """disque.clj db: build/install the server, CLUSTER MEET the first
-    node."""
+    """disque.clj db: build from source, configure, CLUSTER MEET the
+    first node."""
+
+    def __init__(self, version: str = VERSION):
+        self.version = version
 
     def setup(self, test, node):
-        cu.start_daemon(f"{DIR}/disque-server",
-                        "--port", str(PORT),
-                        "--appendonly", "yes",
-                        chdir=DIR, logfile=f"{DIR}/disque.log",
-                        pidfile=f"{DIR}/disque.pid")
+        install(self.version)
+        configure(node)
+        start(node, test)
         c.execute(lit(
             "for i in $(seq 1 60); do "
             f"disque -h {node} -p {PORT} ping | grep -q PONG "
@@ -38,8 +104,9 @@ class DisqueDB(db_mod.DB, db_mod.LogFiles):
                       check=False)
 
     def teardown(self, test, node):
-        cu.stop_daemon(f"{DIR}/disque.pid", f"{DIR}/disque-server")
-        c.execute("rm", "-f", f"{DIR}/appendonly.aof", check=False)
+        stop(node)
+        with c.su():
+            c.execute("rm", "-f", f"{DIR}/appendonly.aof", check=False)
 
     def log_files(self, test, node):
         return [f"{DIR}/disque.log"]
@@ -85,8 +152,17 @@ class DisqueConn:
 
 
 def disque_test(opts) -> dict:
-    return queue_test("disque", DisqueDB(), QueueClient(
-        (opts or {}).get("queue-factory") or DisqueConn), opts)
+    opts = dict(opts or {})
+    nem_name = opts.get("nemesis") or "partitions"
+    try:
+        nemesis = NEMESES[nem_name]()
+    except KeyError:
+        raise ValueError(f"unknown disque nemesis {nem_name!r}; "
+                         f"one of {sorted(NEMESES)}")
+    db = DisqueDB(version=opts.get("version") or VERSION)
+    return queue_test("disque", db, QueueClient(
+        opts.get("queue-factory") or DisqueConn), opts,
+        nemesis=nemesis)
 
 
 main = simple_main(disque_test)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from jepsen_tpu import checker as ck
 from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
 from jepsen_tpu import db as db_mod
 from jepsen_tpu import generator as gen
 from jepsen_tpu import net
@@ -28,34 +29,93 @@ wsrep_on=ON
 wsrep_provider=/usr/lib/galera/libgalera_smm.so
 wsrep_cluster_address=gcomm://{peers}
 wsrep_cluster_name=jepsen
-binlog_format=ROW
+wsrep_sst_method=rsync
+{donor_line}binlog_format=ROW
 default_storage_engine=InnoDB
 innodb_autoinc_lock_mode=2
 """
+
+DIR = "/var/lib/mysql"
+STOCK_DIR = "/var/lib/mysql-stock"
 
 
 class GaleraDB(db_mod.DB, db_mod.LogFiles):
     """galera/db.clj: mariadb-server + galera provider; the first node
     bootstraps a new cluster."""
 
-    def setup(self, test, node):
-        os_debian.install(["mariadb-server", "galera-4"])
-        peers = ",".join(n for n in (test.get("nodes") or [])
-                         if n != node)
-        c.upload_str(GALERA_CNF.format(peers=peers),
+    # `mysql -u root` must work both under debconf-preseeded password
+    # auth AND under unix_socket auth (modern MariaDB ignores the
+    # preseed) — every admin command tries the password first, then
+    # socket auth (galera.clj eval! assumes password auth only).
+    MYSQL = ("mysql -u root --password=jepsen -e {q!r} "
+             "2>/dev/null || mysql -u root -e {q!r}")
+
+    def preseed_root_password(self, pkg: str = "mariadb-server"):
+        """galera.clj install! :43-46: non-interactive root password."""
+        with c.su():
+            for sel in (f"{pkg} mysql-server/root_password "
+                        "password jepsen",
+                        f"{pkg} mysql-server/root_password_again "
+                        "password jepsen"):
+                c.execute("debconf-set-selections",
+                          stdin=sel, check=False)
+
+    def backup_stock_datadir(self):
+        """Squirrel away pristine data files once; teardown restores
+        them so every run starts clean (galera.clj :55-57,
+        :126-129)."""
+        with c.su():
+            if not cu.exists(STOCK_DIR):
+                c.execute("service", "mysql", "stop", check=False)
+                c.execute("cp", "-rp", DIR, STOCK_DIR, check=False)
+
+    def upload_cnf(self, test, node):
+        """Render + upload the wsrep config: rsync SST, and on joiners
+        a donor preference for the bootstrap node (keeps snapshot load
+        off mid-cluster members).  Shared with the percona suite."""
+        nodes = test.get("nodes") or [node]
+        first = nodes[0]
+        peers = ",".join(n for n in nodes if n != node)
+        donor = ("" if node == first
+                 else f"wsrep_sst_donor={first}\n")
+        c.upload_str(GALERA_CNF.format(peers=peers, donor_line=donor),
                      "/etc/mysql/conf.d/galera.cnf")
+
+    def _sql(self, q: str):
+        c.execute(lit(self.MYSQL.format(q=q)), check=False)
+
+    def bootstrap_and_grant(self, test, node):
         first = (test.get("nodes") or [node])[0]
         if node == first:
             c.execute("galera_new_cluster", check=False)
         else:
             c.execute("service", "mysql", "restart", check=False)
+        probe = self.MYSQL.format(q="select 1")
         c.execute(lit(
             "for i in $(seq 1 60); do "
-            "mysql -u root -e 'select 1' > /dev/null 2>&1 "
+            f"({probe}) > /dev/null 2>&1 "
             "&& exit 0; sleep 1; done; exit 1"), check=False)
+        # jepsen database + grant (galera.clj setup-db! :95-101)
+        self._sql("create database if not exists jepsen;")
+        self._sql("GRANT ALL PRIVILEGES ON jepsen.* TO 'jepsen'@'%' "
+                  "IDENTIFIED BY 'jepsen';")
+
+    def setup(self, test, node):
+        # galera.clj install! :34-57: preseed the root password so apt
+        # installs non-interactively, rsync for the SST path.
+        self.preseed_root_password()
+        os_debian.install(["rsync", "mariadb-server", "galera-4"])
+        self.backup_stock_datadir()
+        self.upload_cnf(test, node)
+        self.bootstrap_and_grant(test, node)
 
     def teardown(self, test, node):
         c.execute("service", "mysql", "stop", check=False)
+        with c.su():
+            if cu.exists(STOCK_DIR):
+                # restore pristine data files (galera.clj :126-129)
+                c.execute("rm", "-rf", DIR, check=False)
+                c.execute("cp", "-rp", STOCK_DIR, DIR, check=False)
 
     def log_files(self, test, node):
         return ["/var/log/mysql/error.log"]

@@ -16,22 +16,24 @@ class PerconaDB(GaleraDB):
     """percona/db.clj: percona-xtradb-cluster instead of mariadb."""
 
     def setup(self, test, node):
-        os_debian.install(["percona-xtradb-cluster-server"])
-        peers = ",".join(n for n in (test.get("nodes") or [])
-                         if n != node)
-        from jepsen_tpu.suites.galera import GALERA_CNF
-        c.upload_str(GALERA_CNF.format(peers=peers),
-                     "/etc/mysql/conf.d/galera.cnf")
+        self.preseed_root_password("percona-xtradb-cluster-server")
+        os_debian.install(["rsync", "percona-xtradb-cluster-server"])
+        self.backup_stock_datadir()
+        self.upload_cnf(test, node)      # shared render: SST + donor
         first = (test.get("nodes") or [node])[0]
         if node == first:
             c.execute(lit("systemctl start mysql@bootstrap || "
                           "galera_new_cluster || true"), check=False)
+            probe = self.MYSQL.format(q="select 1")
+            c.execute(lit(
+                "for i in $(seq 1 60); do "
+                f"({probe}) > /dev/null 2>&1 "
+                "&& exit 0; sleep 1; done; exit 1"), check=False)
+            self._sql("create database if not exists jepsen;")
+            self._sql("GRANT ALL PRIVILEGES ON jepsen.* TO "
+                      "'jepsen'@'%' IDENTIFIED BY 'jepsen';")
         else:
-            c.execute("service", "mysql", "restart", check=False)
-        c.execute(lit(
-            "for i in $(seq 1 60); do "
-            "mysql -u root -e 'select 1' > /dev/null 2>&1 "
-            "&& exit 0; sleep 1; done; exit 1"), check=False)
+            self.bootstrap_and_grant(test, node)
 
 
 def percona_test(opts) -> dict:

@@ -13,7 +13,8 @@ from jepsen_tpu import db as db_mod
 from jepsen_tpu import os_debian
 from jepsen_tpu.control import lit
 from jepsen_tpu.suites._template import (KVRegisterClient,
-                                         register_test, simple_main)
+                                         register_test,
+                                         workload_main)
 
 DATA = "/var/lib/rethinkdb/jepsen"
 PORT = 28015
@@ -85,12 +86,98 @@ class ReqlShellConn:
         self._session.close()
 
 
-def rethink_test(opts) -> dict:
-    return register_test("rethinkdb", RethinkDB(), KVRegisterClient(
-        (opts or {}).get("kv-factory") or ReqlShellConn), opts)
+class TableAdmin:
+    """Cluster-level table knobs (document_cas.clj:30-48): write-acks
+    mode + shard layout on rethinkdb.table_config, heartbeat on
+    cluster_config — applied once per test before the workload."""
+
+    def __init__(self, conn: "ReqlShellConn"):
+        self.conn = conn
+
+    def set_write_acks(self, test, write_acks: str) -> None:
+        nodes = [n.replace("-", "_")
+                 for n in (test.get("nodes") or [])]
+        primary = nodes[0] if nodes else ""
+        self.conn._reql(
+            "r.db('rethinkdb').table('table_config').update("
+            f"{{write_acks: '{write_acks}', shards: "
+            f"[{{primary_replica: '{primary}', "
+            f"replicas: {nodes!r}}}]}})".replace("'", '"'))
+
+    def set_heartbeat(self, dt: int = 2) -> None:
+        self.conn._reql(
+            "r.db('rethinkdb').table('cluster_config')"
+            f".get('heartbeat').update("
+            f"{{heartbeat_timeout_secs: {dt}}})")
 
 
-main = simple_main(rethink_test)
+class _AdminOnceFactory:
+    """Wraps a conn factory so the FIRST connection of a test applies
+    the cluster-level table knobs exactly once (the reference guards
+    this with a promise, document_cas.clj:57-67): write-acks mode +
+    shard layout on table_config, heartbeat on cluster_config.  In
+    RethinkDB write acks are a TABLE property, so this single admin
+    step IS how the sweep's write_acks cell takes effect."""
+
+    def __init__(self, inner, test_box: dict, write_acks: str):
+        import threading
+        self.inner = inner
+        self.test_box = test_box
+        self.write_acks = write_acks
+        self._lock = threading.Lock()
+        self.applied = False
+
+    def __call__(self, node):
+        conn = self.inner(node)
+        with self._lock:
+            if not self.applied:
+                # in-process test conns (MemKV) have no ReQL channel;
+                # the knobs are a real-cluster concern
+                if hasattr(conn, "_reql"):
+                    admin = TableAdmin(conn)
+                    admin.set_write_acks(self.test_box,
+                                         self.write_acks)
+                    admin.set_heartbeat(2)
+                self.applied = True
+        return conn
+
+
+def document_cas_test(opts, write_acks: str = "majority",
+                      read_mode: str = "majority") -> dict:
+    """One cell of the reference's write-acks x read-mode sweep
+    (document_cas.clj cas-test :129-150 and rethinkdb_test.clj:15-24:
+    single-single, majority-single, single-majority,
+    majority-majority).  Weak modes are EXPECTED to lose
+    linearizability under partitions — the sweep exists to show the
+    checker catching it."""
+    opts = dict(opts or {})
+
+    def reql_factory(node):
+        return ReqlShellConn(node, write_acks=write_acks,
+                             read_mode=read_mode)
+
+    inner = opts.get("kv-factory") or reql_factory
+    test = register_test(
+        f"rethinkdb document write-{write_acks} read-{read_mode}",
+        RethinkDB(), None, opts)
+    admin_factory = _AdminOnceFactory(inner, test, write_acks)
+    test["client"] = KVRegisterClient(admin_factory)
+    return test
+
+
+TESTS = {
+    "document-cas-majority-majority":
+        lambda o: document_cas_test(o, "majority", "majority"),
+    "document-cas-single-single":
+        lambda o: document_cas_test(o, "single", "single"),
+    "document-cas-majority-single":
+        lambda o: document_cas_test(o, "majority", "single"),
+    "document-cas-single-majority":
+        lambda o: document_cas_test(o, "single", "majority"),
+}
+
+rethink_test, _opt_fn, main = workload_main(
+    TESTS, "document-cas-majority-majority")
 
 if __name__ == "__main__":
     main()
