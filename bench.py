@@ -467,6 +467,40 @@ def main() -> int:
           "formulation is structurally, not constant-factor, ahead.",
           file=sys.stderr)
 
+    # --- Envelope edges: overlap depth (max simultaneously-open
+    # calls).  The register-delta kernel is gated at R<=6 (8 with
+    # crashes); deeper overlap takes the candidate-table kernel on
+    # dense 2^R config planes, whose cost doubles per extra open call
+    # — quantified here so the perf story's domain is explicit.
+    # R>=12 is outside the device envelope (the dense plane would run
+    # past the accelerator's program watchdog): serial/oracle
+    # territory. -------------------------------------------------------
+    for mo in (6, 8, 10):
+        eh = make_history(20_000, 16, seed=41 + mo, vmax=9,
+                          max_open=mo)
+        ne = sum(1 for o in eh if o.is_invoke)
+        wgl_seg.check(model, eh, max_open_bits=14)            # warm
+        ew = float("inf")
+        for _ in range(2):
+            t0 = time.monotonic()
+            er = wgl_seg.check(model, eh, max_open_bits=14)
+            ew = min(ew, time.monotonic() - t0)
+        if er["valid?"] is not True:
+            print(json.dumps({"metric": "ERROR: envelope history "
+                              f"(max_open={mo}) judged "
+                              + str(er["valid?"]), "value": 0,
+                              "unit": "ops/sec", "vs_baseline": 0}))
+            return 1
+        t0 = time.monotonic()
+        en = wgl_cpu_native.check(model, eh)
+        en_s = time.monotonic() - t0
+        print(f"# envelope max_open={mo}: device {ne / ew:.0f} ops/s "
+              f"(wall {ew:.2f}s, {er.get('segments')} segments); "
+              f"native oracle {ne / en_s:.0f} ops/s — "
+              + ("register-delta kernel" if mo <= 6 else
+                 "candidate-table kernel, dense 2^R plane"),
+              file=sys.stderr)
+
     # --- Multi-key batch with crashed keys: a realistic nemesis run
     # (client timeouts scattered over independent keys) must stay on
     # the batched engine via the per-key crash-stripped twins. --------

@@ -372,7 +372,6 @@ def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool,
             and ((decomposed and Sn <= 32)
                  or (not decomposed and Sn <= 8))
             and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
-            and os.environ.get("JEPSEN_TPU_PALLAS") != "1"
             and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
 
 
@@ -2925,50 +2924,23 @@ def check_many(model, histories, *, max_states: int = 64,
         cslot_t = np.ascontiguousarray(cand_slot.transpose(1, 0, 2))
         cuop_t = np.ascontiguousarray(cand_uop.transpose(1, 0, 2))
 
-        # The Pallas megakernel fuses the whole L-event scan into one
-        # launch for the common batch shape (opt-in via
-        # JEPSEN_TPU_PALLAS=1: on today's shapes XLA's fusion of the
-        # same bitmap algebra is ~25% faster, so it stays the default;
-        # the Pallas path is kept verdict-identical by differential
-        # tests as the base for future tuning).  No mesh support;
-        # anything outside its scope takes the XLA kernel.
-        T = None
+        # (A Pallas megakernel variant of this scan was carried through
+        # round 2 behind JEPSEN_TPU_PALLAS=1; it never beat XLA's
+        # fusion of the same bitmap algebra on any measured shape
+        # (~25% slower at its best) and was removed in round 3 —
+        # hand-scheduling what the compiler already fuses well bought
+        # nothing but maintenance surface.)
         engine_name = "wgl_seg_batch"
-        if (mesh is None and diag_w is not None
-                and os.environ.get("JEPSEN_TPU_PALLAS") == "1"):
-            from jepsen_tpu.ops import wgl_pallas
-            if wgl_pallas.supported(max(1, M // 32), Sn, 1, True,
-                                    int(L), int(C), Kp):
-                aux1, aux2, t0c = _pack_cand_tables(
-                    cuop_t, legal, next_state, diag_w, const_w,
-                    const_t0)
-                packed = wgl_pallas.pack_tables(cslot_t, aux1, aux2,
-                                                t0c)
-                # timer starts AFTER host packing, mirroring the XLA
-                # path whose timer starts after _dispatch_kernel
-                t1 = time.monotonic()
-                try:
-                    T = wgl_pallas.run_packed(ret_t, packed, Kp,
-                                              int(L), int(C),
-                                              int(Sn), int(R))
-                    t_kernel = time.monotonic() - t1
-                    engine_name = "wgl_seg_batch_pallas"
-                except Exception:   # noqa: BLE001 - XLA fallback
-                    # (the XLA retry re-packs its own narrow tables in
-                    # _dispatch_kernel — acceptable on this rare path)
-                    T = None
+        kern, args, kc_shaped = _dispatch_kernel(
+            Kp, int(L), int(C), int(M), int(Sn), int(R), 1,
+            ret_t, cslot_t, cuop_t, legal, next_state,
+            diag_w, const_w, const_t0)
+        if mesh is not None and mesh_axis is not None:
+            args = _shard_args(mesh, mesh_axis, args, kc_shaped)
 
-        if T is None:
-            kern, args, kc_shaped = _dispatch_kernel(
-                Kp, int(L), int(C), int(M), int(Sn), int(R), 1,
-                ret_t, cslot_t, cuop_t, legal, next_state,
-                diag_w, const_w, const_t0)
-            if mesh is not None and mesh_axis is not None:
-                args = _shard_args(mesh, mesh_axis, args, kc_shaped)
-
-            t1 = time.monotonic()
-            T = np.asarray(kern(*args))                  # [Kp, 1, Sn]
-            t_kernel = time.monotonic() - t1
+        t1 = time.monotonic()
+        T = np.asarray(kern(*args))                      # [Kp, 1, Sn]
+        t_kernel = time.monotonic() - t1
         ok_k = (T[:, 0, :] > 0.5).any(axis=1)
         for kk, (i, fk) in enumerate(batch):
             _emit_batch_result(results, i, fk, bool(ok_k[kk]),

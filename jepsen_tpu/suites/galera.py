@@ -82,20 +82,31 @@ class GaleraDB(db_mod.DB, db_mod.LogFiles):
                      "/etc/mysql/conf.d/galera.cnf")
 
     def _sql(self, q: str):
-        c.execute(lit(self.MYSQL.format(q=q)), check=False)
+        # under su: unix_socket auth (the modern-MariaDB half of the
+        # MYSQL fallback) authenticates by OS uid — it only ever works
+        # as root
+        with c.su():
+            c.execute(lit(self.MYSQL.format(q=q)), check=False)
 
-    def bootstrap_and_grant(self, test, node):
+    def bootstrap_and_grant(self, test, node, bootstrap_cmd=None):
+        """Start/join the cluster, wait for liveness, create the
+        jepsen database + grant (galera.clj setup-db! :95-101).  The
+        first node runs `bootstrap_cmd` (default galera_new_cluster;
+        percona overrides), joiners restart into the cluster."""
         first = (test.get("nodes") or [node])[0]
         if node == first:
-            c.execute("galera_new_cluster", check=False)
+            if bootstrap_cmd is None:
+                c.execute("galera_new_cluster", check=False)
+            else:
+                c.execute(lit(bootstrap_cmd), check=False)
         else:
             c.execute("service", "mysql", "restart", check=False)
         probe = self.MYSQL.format(q="select 1")
-        c.execute(lit(
-            "for i in $(seq 1 60); do "
-            f"({probe}) > /dev/null 2>&1 "
-            "&& exit 0; sleep 1; done; exit 1"), check=False)
-        # jepsen database + grant (galera.clj setup-db! :95-101)
+        with c.su():
+            c.execute(lit(
+                "for i in $(seq 1 60); do "
+                f"({probe}) > /dev/null 2>&1 "
+                "&& exit 0; sleep 1; done; exit 1"), check=False)
         self._sql("create database if not exists jepsen;")
         self._sql("GRANT ALL PRIVILEGES ON jepsen.* TO 'jepsen'@'%' "
                   "IDENTIFIED BY 'jepsen';")

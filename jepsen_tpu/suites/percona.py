@@ -20,20 +20,10 @@ class PerconaDB(GaleraDB):
         os_debian.install(["rsync", "percona-xtradb-cluster-server"])
         self.backup_stock_datadir()
         self.upload_cnf(test, node)      # shared render: SST + donor
-        first = (test.get("nodes") or [node])[0]
-        if node == first:
-            c.execute(lit("systemctl start mysql@bootstrap || "
-                          "galera_new_cluster || true"), check=False)
-            probe = self.MYSQL.format(q="select 1")
-            c.execute(lit(
-                "for i in $(seq 1 60); do "
-                f"({probe}) > /dev/null 2>&1 "
-                "&& exit 0; sleep 1; done; exit 1"), check=False)
-            self._sql("create database if not exists jepsen;")
-            self._sql("GRANT ALL PRIVILEGES ON jepsen.* TO "
-                      "'jepsen'@'%' IDENTIFIED BY 'jepsen';")
-        else:
-            self.bootstrap_and_grant(test, node)
+        self.bootstrap_and_grant(
+            test, node,
+            bootstrap_cmd="systemctl start mysql@bootstrap || "
+                          "galera_new_cluster || true")
 
 
 def percona_test(opts) -> dict:

@@ -162,6 +162,10 @@ def document_cas_test(opts, write_acks: str = "majority",
         RethinkDB(), None, opts)
     admin_factory = _AdminOnceFactory(inner, test, write_acks)
     test["client"] = KVRegisterClient(admin_factory)
+    # KVRegisterClient.open prefers test["kv-factory"] over the
+    # client's own factory — the wrapped factory must sit in BOTH
+    # places or an injected conn factory would bypass the admin step
+    test["kv-factory"] = admin_factory
     return test
 
 

@@ -654,23 +654,6 @@ class TestBatch:
         res = wgl_seg.check_many(models.CASRegister(), hists)
         assert [r["valid?"] for r in res] == [True, False, True]
 
-    def test_pallas_and_xla_kernels_agree(self, monkeypatch):
-        # same batch through both device kernels -> identical verdicts
-        hists = [rand_history(700 + s, n_ops=48, conc=3,
-                              buggy=(s % 3 == 0)) for s in range(40)]
-        monkeypatch.setenv("JEPSEN_TPU_PALLAS", "1")
-        res_p = wgl_seg.check_many(models.CASRegister(), hists)
-        monkeypatch.delenv("JEPSEN_TPU_PALLAS")
-        res_x = wgl_seg.check_many(models.CASRegister(), hists)
-        assert [r["valid?"] for r in res_p] == \
-            [r["valid?"] for r in res_x]
-        assert any(r["engine"] == "wgl_seg_batch_pallas"
-                   for r in res_p), "pallas must engage on this shape"
-        assert all(r["engine"] == "wgl_seg_batch_regs" for r in res_x)
-        for h, r in zip(hists, res_p):
-            assert r["valid?"] == wgl_cpu.check(
-                models.CASRegister(), h)["valid?"]
-
     def test_native_disabled_env(self, monkeypatch):
         monkeypatch.setenv("JEPSEN_TPU_NO_NATIVE", "1")
         from jepsen_tpu import native
