@@ -164,6 +164,40 @@ class DummySession(Session):
             self.handler(self.node, cmd, None)
 
 
+class LocalSession(Session):
+    """Real-process-boundary transport: commands execute via /bin/sh on
+    THIS host, with real side effects — daemons really start under
+    start-stop-daemon, files really upload, logs really download.  The
+    integration tier for images without sshd/docker (the reference's
+    equivalent tier is its 5-node docker env, docker/docker-compose.yml;
+    only the SSH wire protocol itself goes unexercised here, since
+    SSHSession shells out to the same /bin/sh on arrival)."""
+
+    def __init__(self, node: str, opts: dict):
+        self.node = node
+        self.timeout = opts.get("timeout", 600)
+
+    def run(self, cmd, stdin=None):
+        p = subprocess.run(["/bin/sh", "-c", cmd], input=stdin,
+                           capture_output=True, text=True,
+                           timeout=self.timeout)
+        return p.returncode, p.stdout, p.stderr
+
+    def upload(self, local, remote):
+        p = subprocess.run(["cp", local, remote],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"cp {local}", p.returncode, p.stdout,
+                              p.stderr, self.node)
+
+    def download(self, remote, local):
+        p = subprocess.run(["cp", remote, local],
+                           capture_output=True, text=True)
+        if p.returncode != 0:
+            raise RemoteError(f"cp {remote}", p.returncode, p.stdout,
+                              p.stderr, self.node)
+
+
 class SSHSession(Session):
     """Persistent SSH via the system binary + ControlMaster socket."""
 
@@ -233,6 +267,8 @@ def session(node: str) -> Session:
     """Opens a session to the given node (control.clj:296-312)."""
     if _ssh_opts.get("dummy"):
         return DummySession(node, _dummy_handler)
+    if _ssh_opts.get("local"):
+        return LocalSession(node, dict(_ssh_opts))
     return SSHSession(node, dict(_ssh_opts))
 
 

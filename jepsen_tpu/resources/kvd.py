@@ -1,0 +1,104 @@
+#!/usr/bin/env python3
+"""kvd — a deliberately tiny single-file TCP key-value daemon.
+
+The integration-tier system-under-test for environments with no real
+database binaries: the kvd suite uploads THIS file to the node,
+launches it under start-stop-daemon, talks a line protocol over real
+TCP sockets, SIGSTOPs it mid-run, and snarfs its log — exercising the
+whole control plane with real side effects (the reference's equivalent
+tier runs a real etcd under docker, core_test.clj:54-108).
+
+Line protocol (one request per line, one reply line):
+    GET k            -> VAL v | NIL
+    SET k v          -> OK
+    CAS k old new    -> OK | FAIL | NIL
+Every mutation is logged to the --log file (the harness downloads it).
+"""
+
+import argparse
+import socket
+import socketserver
+import sys
+import threading
+import time
+
+
+class Store:
+    def __init__(self, log_path, unsafe_cas=False):
+        self.kv = {}
+        self.lock = threading.Lock()
+        self.unsafe_cas = unsafe_cas
+        self.log = open(log_path, "a", buffering=1)
+
+    def logline(self, msg):
+        self.log.write("%.6f %s\n" % (time.time(), msg))
+
+
+class Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store = self.server.store
+        for raw in self.rfile:
+            parts = raw.decode("utf-8", "replace").split()
+            if not parts:
+                continue
+            cmd, args = parts[0].upper(), parts[1:]
+            if cmd == "GET" and len(args) == 1:
+                v = store.kv.get(args[0])
+                out = "NIL" if v is None else f"VAL {v}"
+            elif cmd == "SET" and len(args) == 2:
+                with store.lock:
+                    store.kv[args[0]] = args[1]
+                store.logline(f"SET {args[0]}={args[1]}")
+                out = "OK"
+            elif cmd == "CAS" and len(args) == 3:
+                if store.unsafe_cas:
+                    # deliberately racy check-then-set (no lock, widened
+                    # window): the harness's negative test proves the
+                    # checker catches THIS real bug over real TCP
+                    cur = store.kv.get(args[0])
+                    time.sleep(0.002)
+                    ok = cur is not None and cur == args[1]
+                    if ok:
+                        store.kv[args[0]] = args[2]
+                    out = ("OK" if ok
+                           else "NIL" if cur is None else "FAIL")
+                else:
+                    with store.lock:
+                        cur = store.kv.get(args[0])
+                        ok = cur is not None and cur == args[1]
+                        if ok:
+                            store.kv[args[0]] = args[2]
+                    out = ("OK" if ok
+                           else "NIL" if cur is None else "FAIL")
+                if ok:
+                    store.logline(
+                        f"CAS {args[0]}:{args[1]}->{args[2]}")
+            elif cmd == "PING":
+                out = "PONG"
+            else:
+                out = "ERR"
+            self.wfile.write((out + "\n").encode())
+
+
+class Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=17711)
+    ap.add_argument("--log", default="/tmp/kvd.log")
+    ap.add_argument("--unsafe-cas", action="store_true")
+    a = ap.parse_args()
+    srv = Server(("0.0.0.0", a.port), Handler)
+    srv.store = Store(a.log, unsafe_cas=a.unsafe_cas)
+    srv.store.logline(f"kvd listening on {a.port}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

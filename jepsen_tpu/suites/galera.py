@@ -94,13 +94,14 @@ class GaleraDB(db_mod.DB, db_mod.LogFiles):
         first node runs `bootstrap_cmd` (default galera_new_cluster;
         percona overrides), joiners restart into the cluster."""
         first = (test.get("nodes") or [node])[0]
-        if node == first:
-            if bootstrap_cmd is None:
-                c.execute("galera_new_cluster", check=False)
+        with c.su():                 # service control needs root too
+            if node == first:
+                if bootstrap_cmd is None:
+                    c.execute("galera_new_cluster", check=False)
+                else:
+                    c.execute(lit(bootstrap_cmd), check=False)
             else:
-                c.execute(lit(bootstrap_cmd), check=False)
-        else:
-            c.execute("service", "mysql", "restart", check=False)
+                c.execute("service", "mysql", "restart", check=False)
         probe = self.MYSQL.format(q="select 1")
         with c.su():
             c.execute(lit(

@@ -1,0 +1,152 @@
+"""kvd integration suite — the REAL-TRANSPORT proof.
+
+Every other suite's in-process tests run over the dummy transport; this
+one exists to run the whole stack against real side effects on hosts
+with no sshd/docker (the reference's integration tier is a 5-node
+docker env + a real etcd, core_test.clj:54-108 — this image ships
+neither, so the local transport executes the same /bin/sh commands an
+SSH session would deliver):
+
+  - the DB automation really uploads resources/kvd.py and really
+    launches it under start-stop-daemon with a pidfile
+    (control_util.start_daemon, the path every real suite uses);
+  - clients talk REAL TCP to the daemon;
+  - the nemesis really SIGSTOPs/SIGCONTs the server process
+    (hammer_time — pausing the SUT mid-run is a real fault; network
+    partitions are deliberately NOT used here because iptables on this
+    host would sever the TPU tunnel);
+  - teardown really kills the daemon and the log snarfer really
+    downloads its log into store/<test>/<time>/n1/.
+
+Run: python -m jepsen_tpu.suites.kvd test --time-limit 10
+(the `local` ssh opt is set by default here).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Optional
+
+from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
+from jepsen_tpu import db as db_mod
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.control import lit
+from jepsen_tpu.suites._template import (KVRegisterClient,
+                                         register_test, simple_main)
+
+PORT = 17711
+DIR = "/tmp/jepsen-kvd"
+SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "resources", "kvd.py")
+
+
+class KvdDB(db_mod.DB, db_mod.LogFiles):
+    """Upload + daemonize resources/kvd.py (the etcd.clj:55-76 shape:
+    install artifact, start-daemon with pidfile, await liveness)."""
+
+    def __init__(self, unsafe_cas: bool = False):
+        self.unsafe_cas = unsafe_cas
+
+    def setup(self, test, node):
+        c.execute("mkdir", "-p", DIR)
+        c.upload(SRC, f"{DIR}/kvd.py")
+        import sys
+        extra = ["--unsafe-cas"] if self.unsafe_cas else []
+        cu.start_daemon(sys.executable, f"{DIR}/kvd.py",
+                        "--port", str(PORT),
+                        "--log", f"{DIR}/kvd.log", *extra,
+                        chdir=DIR, logfile=f"{DIR}/daemon.log",
+                        pidfile=f"{DIR}/kvd.pid")
+        c.execute(lit(
+            "for i in $(seq 1 30); do "
+            f"python3 -c 'import socket; socket.create_connection("
+            f"(\"127.0.0.1\", {PORT}), 1).close()' 2>/dev/null "
+            "&& exit 0; sleep 0.5; done; exit 1"))
+
+    def teardown(self, test, node):
+        import sys
+        # un-pause first: SIGTERM queues behind SIGSTOP otherwise
+        c.execute("pkill", "-CONT", "-f", "[k]vd.py", check=False)
+        cu.stop_daemon(f"{DIR}/kvd.pid", sys.executable)
+        c.execute("rm", "-f", f"{DIR}/kvd.pid", check=False)
+
+    def log_files(self, test, node):
+        return [f"{DIR}/kvd.log", f"{DIR}/daemon.log"]
+
+
+class KvdConn:
+    """Line-protocol client over a real TCP socket."""
+
+    def __init__(self, node: str):
+        self.sock = socket.create_connection(("127.0.0.1", PORT), 5)
+        self.rf = self.sock.makefile("r")
+
+    def _cmd(self, line: str) -> str:
+        self.sock.sendall((line + "\n").encode())
+        return (self.rf.readline() or "").strip()
+
+    def get(self, k) -> Optional[int]:
+        out = self._cmd(f"GET r{k}")
+        return int(out[4:]) if out.startswith("VAL ") else None
+
+    def put(self, k, v) -> None:
+        if not self._cmd(f"SET r{k} {v}").startswith("OK"):
+            raise RuntimeError("SET failed")
+
+    def cas(self, k, old, new) -> bool:
+        return self._cmd(f"CAS r{k} {old} {new}").startswith("OK")
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def pauser():
+    """SIGSTOP/SIGCONT the daemon — a real fault that freezes the SUT
+    mid-operation (nemesis.clj hammer-time :281); safe on a shared
+    host, unlike iptables.  pkill -f: the process NAME is python3, the
+    script path is only in the argv."""
+    import random
+
+    # "[k]vd.py": the regex still matches the daemon's argv, but the
+    # literal pattern in pkill's OWN /bin/sh -c cmdline does not match
+    # itself — without the bracket trick pkill SIGSTOPs its own shell
+    # wrapper and the nemesis hangs forever mid-communicate
+    def start(test, node):
+        c.execute("pkill", "-STOP", "-f", "[k]vd.py", check=False)
+        return ["paused", "kvd"]
+
+    def stop(test, node):
+        c.execute("pkill", "-CONT", "-f", "[k]vd.py", check=False)
+        return ["resumed", "kvd"]
+
+    return nem.node_start_stopper(
+        lambda nodes: random.choice(list(nodes)), start, stop)
+
+
+def kvd_test(opts) -> dict:
+    opts = dict(opts or {})
+    opts.setdefault("nodes", ["n1"])
+    # the CLI always supplies an ssh submap (username etc.) — force the
+    # local transport regardless, unless a test explicitly runs dummy
+    ssh = dict(opts.get("ssh") or {})
+    if not ssh.get("dummy"):
+        ssh["local"] = True
+    opts["ssh"] = ssh
+    test = register_test("kvd", KvdDB(
+                             unsafe_cas=bool(opts.get("unsafe-cas"))),
+                         KVRegisterClient(opts.get("kv-factory")
+                                          or KvdConn),
+                         opts, nemesis=pauser())
+    test["invoke_timeout"] = opts.get("invoke-timeout", 10)
+    return test
+
+
+main = simple_main(kvd_test)
+
+if __name__ == "__main__":
+    main()

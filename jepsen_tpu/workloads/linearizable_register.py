@@ -50,16 +50,28 @@ def suite_workload(opts=None) -> dict:
     threads-per-key."""
     opts = dict(opts or {})
     tpk = opts.get("threads-per-key", 2)
+    stagger_s = opts.get("stagger", 1 / 10)
+    vmax = opts.get("value-max", 4)
     if opts.get("checker-mode", "device") == "device":
         checker = independent.batch_checker(models.cas_register())
     else:
         checker = independent.checker(
             ck.linearizable({"model": models.cas_register()}))
+
+    def w_(test, process):
+        return {"type": "invoke", "f": "write",
+                "value": random.randint(0, vmax)}
+
+    def cas_(test, process):
+        return {"type": "invoke", "f": "cas",
+                "value": [random.randint(0, vmax),
+                          random.randint(0, vmax)]}
+
     generator = independent.concurrent_generator(
         tpk, itertools.count(),
         lambda k: gen.limit(opts.get("ops-per-key", 100),
-                            gen.stagger(1 / 10,
-                                        gen.mix([r, w, cas]))))
+                            gen.stagger(stagger_s,
+                                        gen.mix([r, w_, cas_]))))
     return {"generator": generator, "checker": checker,
             "threads-per-key": tpk}
 
