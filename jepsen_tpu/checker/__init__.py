@@ -142,11 +142,13 @@ class Linearizable(Checker):
         self.algorithm = algorithm
         self.kw = kw
 
+    _SEG_KEYS = ("max_states", "max_open_bits", "localize",
+                 "target_returns_per_segment")
+
     def _device_check(self, history):
         from jepsen_tpu.ops import wgl, wgl_seg
 
-        seg_keys = ("max_states", "max_open_bits", "localize",
-                    "target_returns_per_segment")
+        seg_keys = self._SEG_KEYS
         ser_keys = ("frontier_sizes", "pad")
         unknown = (set(self.kw) - set(seg_keys) - set(ser_keys)
                    - set(self._CPU_KEYS))
@@ -227,6 +229,27 @@ class Linearizable(Checker):
                 f"both competition racers failed: {n1}: {e1!r}; "
                 f"{n2}: {e2!r}") from e1
         raise e1
+
+    def check_many(self, test, histories) -> list:
+        """Batched re-check of MANY whole histories (the `analyze
+        --all` path): device-eligible models ride ONE pipelined pass
+        (wgl_seg.check_pipeline — grouped transfers, one verdict
+        fetch, per-history fallbacks for out-of-scope entries);
+        everything else loops the scalar check.  Verdict-identical to
+        per-history check() either way."""
+        spec = self.model.device_spec()
+        algo = self.algorithm
+        if algo == "auto":
+            algo = "device" if spec is not None else "cpu"
+        if algo == "device" and spec is not None \
+                and set(self.kw) <= set(self._SEG_KEYS):
+            from jepsen_tpu.ops import wgl_seg
+            try:
+                return wgl_seg.check_pipeline(self.model, histories,
+                                              **self.kw)
+            except wgl_seg.Unsupported:
+                pass
+        return [self.check(test, h) for h in histories]
 
     def check(self, test, history, opts=None):
         from jepsen_tpu.ops import wgl_cpu

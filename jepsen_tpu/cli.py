@@ -128,14 +128,81 @@ def analyze_cmd(test_fn: Callable[[dict], dict], opts) -> int:
     if stored is None:
         print("no stored test to analyze", file=sys.stderr)
         return 255
-    merged = dict(fresh)
-    merged.update({k: v for k, v in stored.items()
-                   if k in ("history", "name", "start-time", "nodes")})
-    merged["history"] = stored.get("history") or []
+    merged = _merge_stored(fresh, stored)
     completed = core.analyze(merged)   # writes save_2 for named tests
     core.log_results(completed)
     v = _validity(completed.get("results"))
     return 0 if v is True else (1 if v is False else 254)
+
+
+def _merge_stored(fresh: dict, stored: dict) -> dict:
+    """A fresh test map carrying a stored run's identity + history —
+    the shared reconstruction for both analyze paths
+    (cli.clj:374-378)."""
+    merged = dict(fresh)
+    merged.update({k: v for k, v in stored.items()
+                   if k in ("history", "name", "start-time", "nodes")})
+    merged["history"] = stored.get("history") or []
+    return merged
+
+
+def analyze_all_cmd(test_fn: Callable[[dict], dict], opts) -> int:
+    """Re-check EVERY stored run of this test name — the steady-state
+    re-analysis loop the pipelined engine exists for: when the fresh
+    checker supports batched checking (checker.Linearizable.check_many
+    -> wgl_seg.check_pipeline), all runs' linearizability rides ONE
+    grouped device pass; otherwise each run is re-analyzed in turn.
+    Every run's results.json is rewritten in place; exit code is the
+    worst verdict across runs (cli.clj:110-119 lattice)."""
+    topts = options_to_test_opts(opts)
+    fresh = test_fn(topts)
+    name = fresh.get("name")
+    stamps = sorted(store.tests(name).get(name, {}))
+    if not stamps:
+        print(f"no stored runs of {name!r} to analyze",
+              file=sys.stderr)
+        return 255
+    checker = fresh.get("checker")
+    runs = [_merge_stored(fresh, store.load(name, ts))
+            for ts in stamps]
+
+    batched = None
+    if hasattr(checker, "check_many"):
+        from jepsen_tpu.history import History
+        try:
+            hists = [History(t["history"]).index() for t in runs]
+            batched = checker.check_many(fresh, hists)
+        except Exception:            # noqa: BLE001 - per-run fallback
+            # the per-run path below wraps every check in check_safe
+            # (-> {'valid?': 'unknown', exit 254}) exactly like plain
+            # `analyze`; a batch failure must not cost the whole sweep
+            log.warning("batched re-check failed; falling back to "
+                        "per-run analysis", exc_info=True)
+            batched = None
+
+    worst = 0
+    if batched is not None:
+        for t, h, res in zip(runs, hists, batched):
+            t["history"] = h
+            t["results"] = res
+            store.save_2(t)
+            v = _validity(res)
+            log.info("%s %s -> %s", name, t.get("start-time"), v)
+            worst = max(worst, 0 if v is True
+                        else (1 if v is False else 254))
+        print(f"re-checked {len(runs)} runs of {name!r} "
+              f"(pipelined: "
+              f"{sum(1 for r in batched if r.get('pipelined'))})",
+              file=sys.stderr)
+        return worst
+
+    for t in runs:
+        completed = core.analyze(t)
+        v = _validity(completed.get("results"))
+        worst = max(worst, 0 if v is True
+                    else (1 if v is False else 254))
+    print(f"re-checked {len(runs)} runs of {name!r}", file=sys.stderr)
+    return worst
 
 
 def serve_cmd_run(opts) -> int:
@@ -154,14 +221,25 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
         if opt_fn:
             opt_fn(parser)
 
+    def add_analyze_opts(parser):
+        add_opts(parser)
+        parser.add_argument(
+            "--all", action="store_true",
+            help="re-check EVERY stored run of this test, with the "
+                 "linearizability work pipelined across runs on "
+                 "device (one grouped pass, one verdict fetch)")
+
     return {
         "test": {"opts": add_opts,
                  "run": lambda opts: run_test_cmd(test_fn, opts),
                  "help": "Run a test from CLI options."},
-        "analyze": {"opts": add_opts,
-                    "run": lambda opts: analyze_cmd(test_fn, opts),
-                    "help": "Re-check the latest stored history with a "
-                            "fresh checker."},
+        "analyze": {"opts": add_analyze_opts,
+                    "run": lambda opts: (
+                        analyze_all_cmd(test_fn, opts)
+                        if getattr(opts, "all", False)
+                        else analyze_cmd(test_fn, opts)),
+                    "help": "Re-check the latest stored history (or "
+                            "--all of them) with a fresh checker."},
         **serve_cmd(),
     }
 

@@ -233,3 +233,46 @@ class TestWeb:
         with pytest.raises(urllib.error.HTTPError) as ei:
             self.get(served + "/files/nope/nope/nope.txt")
         assert ei.value.code == 404
+
+
+class TestAnalyzeAll:
+    def test_analyze_all_pipelines_every_stored_run(self):
+        # three stored runs (one produced by a lying client), then ONE
+        # `analyze --all`: every run re-checked, linearizability
+        # pipelined across runs, worst verdict as exit code, every
+        # results.json rewritten in place.
+        good = cli.single_test_cmd(make_test_fn())
+        bad = cli.single_test_cmd(make_test_fn(lie=True))
+        assert cli.main(good, ["test", "--concurrency", "2"]) == 0
+        assert cli.main(bad, ["test", "--concurrency", "2"]) == 1
+        assert cli.main(good, ["test", "--concurrency", "2"]) == 0
+        stamps = sorted(store.tests()["cli-test"])
+        assert len(stamps) == 3
+        # wipe results so the rewrite is observable
+        for ts in stamps:
+            store.results_path("cli-test", ts).unlink()
+        assert cli.main(good, ["analyze", "--all"]) == 1
+        verdicts = [store.load_results("cli-test", ts)["valid?"]
+                    for ts in stamps]
+        assert verdicts.count(False) == 1
+        assert verdicts.count(True) == 2
+        # at least one run rode the pipelined engine
+        engines = [store.load_results("cli-test", ts).get("engine")
+                   for ts in stamps]
+        assert any(e == "wgl_seg" for e in engines)
+
+    def test_analyze_all_without_store_exits_255(self):
+        cmds = cli.single_test_cmd(make_test_fn())
+        assert cli.main(cmds, ["analyze", "--all"]) == 255
+
+    def test_checker_check_many_matches_scalar(self):
+        import sys as _sys
+        _sys.path.insert(0, "tests")
+        from test_wgl_seg import rand_history
+
+        c = ck.linearizable({"model": models.cas_register()})
+        hists = [rand_history(40 + s, n_ops=120, conc=3,
+                              buggy=(s % 2 == 0)) for s in range(6)]
+        batched = c.check_many({}, hists)
+        for h, r in zip(hists, batched):
+            assert r["valid?"] == c.check({}, h)["valid?"]
