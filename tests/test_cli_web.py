@@ -36,13 +36,19 @@ def make_test_fn(lie: bool = False):
                 return out
 
             client.invoke = bad_invoke
+        # a few guaranteed reads after the random mix: the lying client
+        # only yields an invalid history if a read occurs, and
+        # P(no read in 12 random ops) ~ 0.7% was a real full-suite flake
+        reads = gen.limit(3, {"type": "invoke", "f": "read",
+                              "value": None})
         return dict(tst.noop_test(), **{
             "name": "cli-test",
             "nodes": opts["nodes"],
             "concurrency": min(opts["concurrency"], 4),
             "db": tst.atom_db(state),
             "client": client,
-            "generator": gen.nemesis(gen.void, gen.limit(12, gen.cas)),
+            "generator": gen.nemesis(
+                gen.void, gen.concat(gen.limit(12, gen.cas), reads)),
             "checker": ck.linearizable({"model": models.CASRegister(0)}),
         })
 
