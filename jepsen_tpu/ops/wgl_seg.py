@@ -2156,6 +2156,71 @@ def _scan_history(h, ops, spec, seen: dict, rows: list,
     return fk
 
 
+def _check_deep(model, ops, fk, legal, next_state,
+                diag_w, const_w, const_t0, *, R, Sn, nc, localize,
+                backend_name, t0):
+    """Deep-overlap single history on the ops.wgl_deep Pallas
+    megakernel (R > the register-delta gate, up to wgl_deep.R_MAX;
+    crashed calls ride as permanent slots — no J-axis width limit).
+    Returns a knossos-shaped result, or None when out of scope
+    (callers fall through to the serial engines)."""
+    from jepsen_tpu.ops import wgl_deep
+
+    if diag_w is None or not wgl_deep.supported(
+            R, Sn, legal.shape[0], True, backend_name):
+        return None
+    I = min(2, R) if R else 1
+    ret_t, islot_t, iuop_t, Lp = _pack_regs(
+        [(0, fk)], 1, R, int(legal.shape[0]), I)
+    a1t, a2t, t0t = _pack_uop_tables(
+        legal, next_state, diag_w, const_w, const_t0)
+    t_plan = time.monotonic() - t0
+    res = wgl_deep.check_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
+                                R, Sn)
+    result: dict[str, Any] = {
+        "valid?": res["valid?"],
+        "op_count": fk.n_calls,
+        "backend": backend_name,
+        "engine": "wgl_deep",
+        "max_open": R,
+        "states": Sn,
+        "time_plan_s": t_plan,
+        "time_kernel_s": res["time_kernel_s"],
+    }
+    if nc:
+        result["crashed"] = nc
+    if res["valid?"]:
+        return result
+    result["anomaly"] = "nonlinearizable"
+    # Exact witness: the kernel reports the failing event row;
+    # wgl_deep.map_witness turns it into the failing call's invoke op
+    # (the same witness the oracle names, differentially pinned)
+    w = wgl_deep.map_witness(ret_t, fk, ops, res["failed_row"])
+    pos = None
+    if w is not None:
+        result["op"] = w[0].to_dict()
+        result["op_index"] = w[1]
+        pos = w[2]
+    if localize:
+        # artifacts (final-paths/configs) via a CAPPED oracle on the
+        # prefix through the witness: the deep regime is exactly where
+        # an uncapped oracle can spin, and the verdict + witness above
+        # are already exact without it
+        from jepsen_tpu.ops import wgl_cpu
+        prefix = ops if pos is None else ops[:pos + 1]
+        oracle = wgl_cpu.check(model, History(list(prefix)),
+                               time_limit=15, max_configs=500_000)
+        if oracle.get("valid?") is False:
+            for key in ("final-paths", "configs"):
+                if key in oracle:
+                    result[key] = oracle[key]
+            if "op_index" not in result:
+                for key in ("op", "op_index"):
+                    if key in oracle:
+                        result[key] = oracle[key]
+    return result
+
+
 def _check_fast(model, spec, history, *, max_states, max_open_bits,
                 target_returns_per_segment, localize, mesh, mesh_axis,
                 backend_name, t0, max_crashed: int = 0,
@@ -2199,11 +2264,25 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     Sn = states.shape[0]
     R = rn + nc if nc else int(fk.max_open)
     diag_w, const_w, const_t0 = _decompose(legal, next_state)
-    if not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None,
-                          r_cap=8 if nc else 6):
+    if (not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None,
+                           r_cap=8 if nc else 6)
+            or (Sn << nc) > 128):
+        # Deep-overlap regime (or a crash set too wide for the
+        # J = Sn * 2^nc entry axis): the serial Pallas megakernel
+        # walks the whole history with the 2^R plane in VMEM —
+        # crashed calls are just permanent slots there (ops.wgl_deep).
+        # Only the REGIME diverts here: the JEPSEN_TPU_NO_REGS /
+        # JEPSEN_TPU_DYN_ROUNDS escape hatches keep their documented
+        # meaning (the candidate-table path) — see _regs_eligible.
+        if (mesh is None
+                and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
+                and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1"
+                and (R > (8 if nc else 6) or (Sn << nc) > 128)):
+            return _check_deep(
+                model, ops, fk, legal, next_state,
+                diag_w, const_w, const_t0, R=R, Sn=Sn, nc=nc,
+                localize=localize, backend_name=backend_name, t0=t0)
         return None
-    if (Sn << nc) > 128:
-        return None                  # entry-config axis too wide
 
     # segment at quiescent cuts, >= target returns per segment
     cuts = np.asarray(fk.cuts, np.int32)

@@ -313,3 +313,34 @@ class TestNativeOracle:
                                  max_configs=1)
         assert r["valid?"] == "unknown"
         assert r["cause"] == "config-explosion"
+
+
+class TestNativeOracleEnvelope:
+    """The native oracle's own envelope bound, pinned (VERDICT r3 #8):
+    crashed calls hold their pending-set entry forever, so more than 64
+    simultaneously pending calls overflow its 64-slot config mask and
+    it must fall back CLEANLY to the Python oracle — same result dict,
+    no native engine tag, no crash."""
+
+    def test_over_64_pending_falls_back_to_python(self):
+        from jepsen_tpu.history import (History, info_op, invoke_op,
+                                        ok_op, pack_history)
+        from jepsen_tpu.ops import wgl_cpu, wgl_cpu_native
+
+        ops = [invoke_op(200, "write", 1), ok_op(200, "write", 1)]
+        # 66 crashed reads: all pending from invoke onward -> the
+        # native mask (64 slots) overflows mid-walk
+        for p in range(66):
+            ops.append(invoke_op(p, "read", None))
+        ops += [invoke_op(201, "read", None), ok_op(201, "read", 1)]
+        for p in range(66):
+            ops.append(info_op(p, "read", None))
+        h = History(ops).index()
+        h.attach_packed(pack_history(h))
+        model = __import__("jepsen_tpu").models.CASRegister()
+        # identical caps so the dicts are comparable field-for-field
+        rn = wgl_cpu_native.check(model, h, max_configs=5000)
+        rp = wgl_cpu.check(model, h, max_configs=5000)
+        assert rn.get("engine") != "wgl_cpu_native"
+        assert rn["valid?"] == rp["valid?"]
+        assert rn == rp

@@ -1,0 +1,182 @@
+"""Differential battery for the deep-overlap Pallas megakernel
+(ops/wgl_deep.py): identical verdicts AND identical witnesses to the
+CPU oracle on the deep-concurrency regime the reference names as THE
+cost cliff (`doc/tutorial/06-refining.md:7-10`,
+`doc/tutorial/07-parameters.md:148-152`).  Runs on the CPU interpreter
+(tests force JAX_PLATFORMS=cpu); the TPU lowering is the same kernel
+body, exercised by bench.py's envelope lines on hardware.
+
+Histories here are deliberately SMALL: the interpreter executes the
+event loop op-by-op in Python, so sizes are chosen to cover the
+structural cases (deep R, spill rows, multi-block grids, crashes as
+permanent slots, witness mapping) rather than throughput."""
+
+import os
+import random
+
+import pytest
+
+from jepsen_tpu import models
+from jepsen_tpu.history import (History, info_op, invoke_op, ok_op,
+                                fail_op, pack_history)
+from jepsen_tpu.ops import wgl_cpu, wgl_deep, wgl_seg
+
+
+def deep_history(n_calls, conc, seed, vmax=3, max_open=8,
+                 crash_rate=0.0):
+    """Bursty register workload bounded to `max_open` simultaneously
+    open calls (the bench.py make_history shape, trimmed for the
+    interpreter)."""
+    rng = random.Random(seed)
+    ops, value = [], None
+    open_ops = {}
+    i = 0
+    while i < n_calls:
+        p = rng.choice(range(conc))
+        if p in open_ops:
+            ops.append(open_ops.pop(p))
+            continue
+        if len(open_ops) >= max_open:
+            ops.append(open_ops.pop(rng.choice(list(open_ops))))
+            continue
+        i += 1
+        f = rng.choice(("read", "read", "write", "cas"))
+        if crash_rate and rng.random() < crash_rate:
+            v = (None if f == "read" else rng.randint(0, vmax)
+                 if f == "write" else
+                 [rng.randint(0, vmax), rng.randint(0, vmax)])
+            ops.append(invoke_op(p, f, v))
+            ops.append(info_op(p, f, v))
+            continue
+        if f == "read":
+            ops.append(invoke_op(p, "read", None))
+            open_ops[p] = ok_op(p, "read", value)
+        elif f == "write":
+            v = rng.randint(0, vmax)
+            ops.append(invoke_op(p, "write", v))
+            value = v
+            open_ops[p] = ok_op(p, "write", v)
+        else:
+            old, new = rng.randint(0, vmax), rng.randint(0, vmax)
+            ops.append(invoke_op(p, "cas", [old, new]))
+            if value == old:
+                value = new
+                open_ops[p] = ok_op(p, "cas", [old, new])
+            else:
+                open_ops[p] = fail_op(p, "cas", [old, new])
+    for comp in open_ops.values():
+        ops.append(comp)
+    h = History(ops).index()
+    h.attach_packed(pack_history(h))
+    return h
+
+
+def corrupt(h, frac=0.7, value=99):
+    reads = [i for i, o in enumerate(h.ops)
+             if o.type == "ok" and o.f == "read"]
+    h.ops[reads[int(len(reads) * frac)]].value = value
+    h.attach_packed(pack_history(h))
+    return h
+
+
+class TestDeepDifferential:
+    def test_valid_deep_overlap(self):
+        # R 7-9: past the register-delta gate, on the deep engine
+        for mo in (7, 8, 9):
+            h = deep_history(120, 14, seed=50 + mo, max_open=mo)
+            r = wgl_seg.check(models.CASRegister(), h,
+                              max_open_bits=14)
+            o = wgl_cpu.check(models.CASRegister(), h)
+            assert r["valid?"] == o["valid?"] is True
+            assert r["engine"] == "wgl_deep"
+            assert r["max_open"] >= 7
+
+    def test_invalid_witness_equality(self):
+        for mo, frac in ((7, 0.6), (9, 0.8)):
+            h = corrupt(deep_history(140, 14, seed=70 + mo,
+                                     max_open=mo), frac)
+            r = wgl_seg.check(models.CASRegister(), h,
+                              max_open_bits=14)
+            o = wgl_cpu.check(models.CASRegister(), h)
+            assert r["valid?"] is False and o["valid?"] is False
+            assert r["engine"] == "wgl_deep"
+            assert r["op_index"] == o["op_index"]
+            assert r["op"]["f"] == o["op"]["f"]
+
+    def test_subtle_invalid_legal_value(self):
+        # a stale read of a LEGAL value (not an impossible one): after
+        # a deep-overlap prefix quiesces, write 2 then read 1 strictly
+        # sequentially — no pending write can save the read, yet every
+        # value is in-domain, so refuting requires the search to reach
+        # that depth with the correct state set
+        h = deep_history(140, 14, seed=91, vmax=2, max_open=8)
+        tail = [invoke_op(0, "write", 2), ok_op(0, "write", 2),
+                invoke_op(1, "read", None), ok_op(1, "read", 1)]
+        h2 = History(h.ops + tail).index()
+        h2.attach_packed(pack_history(h2))
+        o = wgl_cpu.check(models.CASRegister(), h2)
+        r = wgl_seg.check(models.CASRegister(), h2, max_open_bits=14)
+        assert o["valid?"] is False
+        assert r["valid?"] is False
+        assert r["engine"] == "wgl_deep"
+        assert r["op_index"] == o["op_index"]
+
+    def test_crashes_as_permanent_slots(self):
+        # crashed calls beyond the J-axis gate (Sn * 2^nc > 128) land
+        # on the deep kernel, which has no entry-config axis at all
+        h = deep_history(100, 12, seed=31, vmax=3, max_open=4,
+                         crash_rate=0.06)
+        nc = sum(1 for o in h if o.type == "info")
+        if nc < 2:
+            pytest.skip("seed produced too few crashes")
+        r = wgl_seg.check(models.CASRegister(), h, max_open_bits=14)
+        o = wgl_cpu.check(models.CASRegister(), h)
+        assert r["valid?"] == o["valid?"]
+
+    def test_spill_rows_burst(self):
+        # an invoke burst far beyond I=2 per return row exercises the
+        # virtual spill rows of the register-delta layout
+        ops = []
+        for p in range(9):
+            ops.append(invoke_op(p, "write", p % 3))
+        for p in range(9):
+            ops.append(ok_op(p, "write", p % 3))
+        ops += [invoke_op(0, "read", None), ok_op(0, "read", 2),
+                invoke_op(1, "write", 1), ok_op(1, "write", 1),
+                invoke_op(2, "read", None), ok_op(2, "read", 1)]
+        h = History(ops).index()
+        h.attach_packed(pack_history(h))
+        r = wgl_seg.check(models.CASRegister(), h, max_open_bits=14)
+        o = wgl_cpu.check(models.CASRegister(), h)
+        assert r["valid?"] == o["valid?"] is True
+        assert r["engine"] == "wgl_deep"
+
+    def test_multi_block_grid(self):
+        # > EB returns: the grid streams several SMEM blocks; frontier
+        # and registers must persist across grid steps
+        h = deep_history(620, 14, seed=11, max_open=7)
+        r = wgl_seg.check(models.CASRegister(), h, max_open_bits=14)
+        o = wgl_cpu.check(models.CASRegister(), h)
+        assert r["valid?"] == o["valid?"] is True
+        assert r["engine"] == "wgl_deep"
+
+    def test_regs_kernel_still_owns_shallow(self):
+        h = deep_history(120, 5, seed=3, max_open=4)
+        r = wgl_seg.check(models.CASRegister(), h)
+        assert r["engine"] == "wgl_seg"
+
+    def test_opt_out_env(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_NO_DEEP", "1")
+        h = deep_history(80, 12, seed=5, max_open=8)
+        # falls through to the candidate-table plan() path
+        r = wgl_seg.check(models.CASRegister(), h, max_open_bits=10)
+        o = wgl_cpu.check(models.CASRegister(), h)
+        assert r["valid?"] == o["valid?"]
+        assert r["engine"] == "wgl_seg"
+
+    def test_supported_gate(self):
+        assert wgl_deep.supported(14, 16, 100, True, "tpu")
+        assert not wgl_deep.supported(15, 16, 100, True, "tpu")
+        assert not wgl_deep.supported(8, 33, 100, True, "tpu")
+        assert not wgl_deep.supported(8, 16, 100, False, "tpu")
+        assert not wgl_deep.supported(8, 16, 100, True, "gpu")
