@@ -347,10 +347,15 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
               bvb = {0}, bvk = {0};
     PyObject *seen, *rows;
     long max_open_bits;
-    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*O!O!l",
+    int want_snaps = 1;  /* 0: skip cand_slots/cand_uops emission —
+                          * delta-stream consumers (_RegsLayout /
+                          * _pack_regs_single) never read the
+                          * snapshots, and emitting them is ~1/3 of
+                          * the scan's work on long histories */
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*O!O!l|i",
                           &bproc, &btyp, &bfmap, &bva, &bvb, &bvk,
                           &PyDict_Type, &seen, &PyList_Type, &rows,
-                          &max_open_bits))
+                          &max_open_bits, &want_snaps))
         return NULL;
     if (max_open_bits > MAX_OPEN_HARD) max_open_bits = MAX_OPEN_HARD;
     Py_ssize_t n = (Py_ssize_t)(bproc.len / 4);
@@ -475,11 +480,14 @@ static PyObject *fast_scan_cols(PyObject *self, PyObject *args) {
                     vec_push(&cand_counts, (int32_t)n_open) < 0 ||
                     vec_push(&ret_pos, (int32_t)i) < 0)
                     goto fail_nomem;
-                for (long j = 0; j < n_open; j++) {
-                    if (vec_push(&cand_slots, (int32_t)slot_of[j]) < 0 ||
-                        vec_push(&cand_uops, (int32_t)uop_of[j]) < 0)
-                        goto fail_nomem;
-                }
+                if (want_snaps)
+                    for (long j = 0; j < n_open; j++) {
+                        if (vec_push(&cand_slots,
+                                     (int32_t)slot_of[j]) < 0 ||
+                            vec_push(&cand_uops,
+                                     (int32_t)uop_of[j]) < 0)
+                            goto fail_nomem;
+                    }
                 free_slots[n_free++] = slot_of[idx];
                 for (long j = idx; j < n_open - 1; j++) {
                     open_procs[j] = open_procs[j + 1];

@@ -466,29 +466,43 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
         raise ValueError(f"model {model!r} has no device spec")
     backend = jax.default_backend()
     pend = []
+    # shared interning across the batch: state enumeration, the
+    # decomposition, and the uop tables are (re)built only when a
+    # history grows the alphabet — not once per history
+    seen: dict = {}
+    rows: list = []
+    U_at = -1
+    tables = None            # (Sn, a1t, a2t, t0t)
+    init = np.asarray(spec.encode(model), np.int32)
     for h in histories:
-        seen: dict = {}
-        rows: list = []
         ops = h.ops
         fk = wgl_seg._scan_history(h, ops, spec, seen, rows,
-                                   max_open_bits)
+                                   max_open_bits, want_snaps=False)
         if not fk:
             raise ValueError("history out of deep-kernel scope (scan)")
         R = int(fk.max_open)
-        uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
-        init = np.asarray(spec.encode(model), np.int32)
-        states, legal, next_state = wgl_seg._enumerate_states(
-            spec, init, uops, max_states)
-        Sn = states.shape[0]
-        dw, cw, t0c = wgl_seg._decompose(legal, next_state)
-        if not supported(R, Sn, legal.shape[0], dw is not None, backend):
+        if len(rows) != U_at:
+            uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
+            states, legal, next_state = wgl_seg._enumerate_states(
+                spec, init, uops, max_states)
+            Sn = states.shape[0]
+            dw, cw, t0c = wgl_seg._decompose(legal, next_state)
+            if dw is None:
+                raise ValueError("model not decomposable")
+            tables = wgl_seg._pack_uop_tables(legal, next_state,
+                                              dw, cw, t0c)
+            U_at = len(rows)
+        if not supported(R, Sn, len(rows), True, backend):
             raise ValueError(
                 f"history out of deep-kernel scope (R={R}, Sn={Sn})")
         I = min(2, R) if R else 1
-        ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs(
-            [(0, fk)], 1, R, int(legal.shape[0]), I)
-        a1t, a2t, t0t = wgl_seg._pack_uop_tables(
-            legal, next_state, dw, cw, t0c)
+        if fk.deltas is not None:
+            ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs_single(
+                fk, [fk.n_rets], R, len(rows), I)
+        else:
+            ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs(
+                [(0, fk)], 1, R, len(rows), I)
+        a1t, a2t, t0t = tables
         dev, G = dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t,
                                  t0t, R, Sn)
         pend.append((dev, fk, ret_t, ops, R, Sn, G))
