@@ -108,17 +108,19 @@ def _bounded_invoke(client, test, op: Op, seconds: float):
     Leak bound: each timeout abandons one daemon thread, which lives
     until its client call returns.  Against a fully wedged cluster the
     process-wide count of live abandoned threads is capped at
-    _MAX_ABANDONED.  At the cap a new invoke first waits its full
-    timeout budget for the oldest abandoned thread to retire (keeping
-    the one-op-per-timeout throttle rather than spinning), then — if
-    still saturated — raises InvokeNeverRan WITHOUT spawning a thread,
-    which the caller journals as :fail (definitely-no-effect)."""
+    _MAX_ABANDONED.  At the cap a new invoke first waits a BOUNDED
+    slice (min(seconds, 1) — not the full invoke timeout, which would
+    stall the worker for up to 2x the configured budget before even
+    attempting the op; ADVICE r3) for the oldest abandoned thread to
+    retire, then — if still saturated — raises InvokeNeverRan WITHOUT
+    spawning a thread, which the caller journals as :fail
+    (definitely-no-effect)."""
     with _abandoned_lock:
         _abandoned[:] = [d for d in _abandoned if not d.is_set()]
         oldest = _abandoned[0] if len(_abandoned) >= _MAX_ABANDONED \
             else None
     if oldest is not None:
-        oldest.wait(seconds)
+        oldest.wait(min(seconds, 1.0))
         with _abandoned_lock:
             _abandoned[:] = [d for d in _abandoned if not d.is_set()]
             if len(_abandoned) >= _MAX_ABANDONED:

@@ -203,10 +203,24 @@ class History:
             self._journal.append(o)
         return o
 
+    def invalidate_packed(self) -> None:
+        """Drop the cached columnar representation.  MUST be called (or
+        attach_packed(pack_history(h)) re-run) after mutating an op IN
+        PLACE: append() invalidates automatically, but in-place edits
+        (bench corruption planters, test fixtures) would otherwise
+        feed stale columns to the native scanners while the Python
+        oracle sees the new values — a verdict-divergence footgun
+        (ADVICE r3)."""
+        self._packed = None
+
     def packed_columns(self) -> Optional["PackedHistory"]:
         """The columnar representation if one already exists (attached
         or journal-built) — WITHOUT walking the ops.  None otherwise;
-        callers that need columns unconditionally use pack()."""
+        callers that need columns unconditionally use pack().
+
+        CONTRACT: the cache is invalidated by append() but NOT by
+        in-place op mutation — mutators call invalidate_packed() or
+        re-attach fresh columns (see its docstring)."""
         if self._packed is not None:
             return self._packed
         if self._journal is not None:
@@ -344,6 +358,26 @@ class PackedHistory:
 _I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 
+_I32 = 2 ** 31
+
+
+def _i32_process(p) -> int:
+    """Process column value: exact non-negative int in int32 range,
+    else NEMESIS.  A plain int >= 2^31 (e.g. a uuid-derived worker id)
+    must never raise inside the run-loop journal append — it simply
+    isn't a batchable client process, the same bucket bools and
+    IntEnums land in (ADVICE r3)."""
+    return p if type(p) is int and 0 <= p < _I32 else NEMESIS
+
+
+def _i32_index(idx, fallback: int) -> int:
+    """Index column value: positional fallback when the op's own index
+    is missing OR outside int32 (the column is positional anyway for
+    journaled runs)."""
+    return idx if isinstance(idx, int) and not isinstance(idx, bool) \
+        and -_I32 <= idx < _I32 else fallback
+
+
 def _fits_i64(x: int) -> bool:
     return _I64_MIN <= x <= _I64_MAX
 
@@ -406,13 +440,14 @@ def pack_history(h: History, f_codes: Optional[dict] = None,
     time = np.zeros(n, np.int64)
     vkind = None if custom_encoder else np.zeros(n, np.uint8)
     for i, o in enumerate(h):
-        index[i] = o.index if o.index is not None else i
+        index[i] = _i32_index(o.index, i)
         p = o.process
         # `type(p) is int` (not isinstance): bools and int subclasses
         # (IntEnum, numpy ints) are NOT client processes, exactly as
         # the scan engines' PyLong_CheckExact treats them — the
-        # columnar and object paths must classify identically.
-        process[i] = p if type(p) is int and p >= 0 else NEMESIS
+        # columnar and object paths must classify identically; ints
+        # past int32 are not batchable processes either (range guard)
+        process[i] = _i32_process(p)
         typ[i] = TYPE_CODE[o.type]
         f[i] = f_codes.get(o.f, -1)
         (value[i, 0], value[i, 1]), (value_ok[i, 0], value_ok[i, 1]) = \
@@ -461,10 +496,11 @@ class ColumnJournal:
         i = self._n
         if i == self._cap:
             self._grow()
-        self.index[i] = o.index if o.index is not None else i
+        self.index[i] = _i32_index(o.index, i)
         p = o.process
-        # match pack_history / the scanners: exact int only
-        self.process[i] = p if type(p) is int and p >= 0 else NEMESIS
+        # match pack_history / the scanners: exact int only, int32
+        # range-guarded — journal append must never raise (ADVICE r3)
+        self.process[i] = _i32_process(p)
         self.type[i] = TYPE_CODE[o.type]
         fc = self.f_codes.get(o.f)
         if fc is None:

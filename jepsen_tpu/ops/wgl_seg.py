@@ -341,7 +341,10 @@ def _segment_ends(cut_flags: np.ndarray, target: int) -> list:
     the first quiescent return >= `target` returns in, and the last cut
     always closes the tail.  Iterates once per SEGMENT (searchsorted
     over the cut positions), not once per cut — low-concurrency
-    histories are quiescent at a large fraction of returns."""
+    histories are quiescent at a large fraction of returns.  target
+    clamps to >= 1 (0 used to mean cut-everywhere in the per-cut loop;
+    the searchsorted form would re-find the consumed cut forever)."""
+    target = max(int(target), 1)
     pos = np.nonzero(np.asarray(cut_flags))[0]
     if not len(pos):
         return []
@@ -369,17 +372,18 @@ def _pad_len(x: int) -> int:
 
 
 def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool,
-                   r_cap: int = 6) -> bool:
-    """One gate for the register-delta kernel, shared by check() and
-    check_many() so single-history and batch cannot silently diverge:
+                   r_cap: int = 6, sn_cap: int = 32) -> bool:
+    """One gate for the register-delta kernel, shared by check(),
+    check_many() and the relaxed tier so they cannot silently diverge:
     fixed rounds stay exact and compile small only for R <= r_cap, the
     uop index must fit int16, and the transition form must fit the
-    decomposed (Sn <= 32) or nibble (Sn <= 8) tables.  The Pallas /
+    decomposed (Sn <= sn_cap) or nibble (Sn <= 8) tables.  The Pallas /
     dynamic-rounds toggles imply the candidate-table path.  (The
     crashed-call path passes r_cap=8: its extra permanent slots are
-    worth a bigger compile.)"""
+    worth a bigger compile; the wide-state relaxed tier passes
+    sn_cap=64 — its aux masks ride as sn_words=2 uint32 words.)"""
     return (R <= r_cap and U <= 32767
-            and ((decomposed and Sn <= 32)
+            and ((decomposed and Sn <= sn_cap)
                  or (not decomposed and Sn <= 8))
             and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
             and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
@@ -870,7 +874,8 @@ def _build_kernel_bits(K: int, L: int, C: int, Wd: int, Sn: int, R: int,
 def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                        decomposed: bool, rounds: int, unroll: int,
                        J: int = 1, nc: int = 0, rn: int = 0,
-                       compose: bool = False, crash_closure: bool = False):
+                       compose: bool = False, crash_closure: bool = False,
+                       death_row: bool = False, sn_words: int = 1):
     """Register-delta variant of the bit-packed batch kernel (J=1 for
     independent whole histories; J=Sn computes per-segment transfer
     matrices for the single-history path, one lane per segment).
@@ -898,6 +903,22 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
     dynamic loop).  Transition tables are [U]-indexed on device (tiny
     per-step gathers) in the same decomposed / nibble forms.
 
+    With `sn_words = W > 1` (the crash-relaxed tier's wide-state lift,
+    VERDICT r3 #5): every per-state bitmask — the decomposed aux
+    tables/registers, the epsilon-closure rows, the death_row seed —
+    becomes W uint32 words, supporting Sn <= 32*W states; state row s
+    reads word s // 32, bit s % 32.  W = 1 keeps the legacy
+    single-word shapes bit-for-bit.
+
+    With `death_row` (J = 1, one extra runtime arg `seed_mask`
+    u32[W]):
+    the frontier is seeded with the SET of states in seed_mask at mask
+    0 (the composed verdict's reachable-entry mask) and the scan
+    additionally reports the first row index at which the frontier
+    empties (-1 = survives) — the per-return death localization the
+    crash-relaxed refutation tier uses to name an exact witness op
+    without any oracle.
+
     With `nc > 0` (crashed-call support, J = Sn * 2^nc): crashed calls
     hold permanent slots rn..rn+nc-1 — registered like invokes, never
     retired, free to linearize at any return's closure or never.  Lane
@@ -924,20 +945,27 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
         # ctab u32 [nC, Sn]) — per-state next-masks, reflexively and
         # transitively closed ON HOST, applied between expansion rounds
         # (see _relaxed_refute for the exactness argument).
+        seed_mask = None
+        if death_row:
+            *closure_args, seed_mask = closure_args
         if crash_closure:
             crow_all, ctab = closure_args
 
             def close_states(fr, nm):
-                # nm [K, Sn] u32: bit t of nm[k, s] = s->t allowed
-                outs = []
-                for t in range(Sn):
-                    a = jnp.zeros_like(fr[:, 0])
-                    for s2 in range(Sn):
-                        sel = sel32(
-                            ((nm[:, s2] >> np.uint32(t)) & 1) == 1)
-                        a = a | (fr[:, s2] & sel[None, None, :])
-                    outs.append(a)
-                return jnp.stack(outs, axis=1)
+                # nm [K, Sn, W] u32: bit t%32 of word t//32 of
+                # nm[k, s] = s->t allowed.  One gather + shift builds
+                # the full [K, s2, t] allow tensor and a single
+                # OR-reduction contracts the source-state axis — O(1)
+                # HLO ops instead of the Sn^2 unrolled select-ORs that
+                # made wide-state (Sn ~ 40) compiles take minutes.
+                t_i = np.arange(Sn)
+                words = nm[:, :, t_i // 32]          # [K, s2, t]
+                sel = sel32(((words >> jnp.asarray(
+                    t_i % 32, jnp.uint32)) & 1) == 1)
+                contrib = (fr[:, :, None, :, :]
+                           & sel.transpose(1, 2, 0)[None, :, :, None, :])
+                return jax.lax.reduce(contrib, np.uint32(0),
+                                      jax.lax.bitwise_or, (1,))
         if J > 1:
             # one lane per (segment, entry config): j = cm * Sn + s with
             # mask cm << rn (cm = 0 when nc = 0, reducing to the eye)
@@ -949,14 +977,27 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
             fr0_np = (fr0_np << np.arange(32, dtype=np.uint32)
                       [None, :, None, None]).sum(1, dtype=np.uint32)
             fr0 = jnp.asarray(fr0_np)[..., None] * jnp.ones((K,), u32)
+        elif death_row:
+            # seed the single lane with every state in seed_mask
+            # (u32[W]) at mask index 0 (bit 0 of word 0)
+            si = np.arange(Sn)
+            sm = jnp.asarray(seed_mask, u32)
+            sb = ((sm[si // 32] >> jnp.asarray(si % 32, u32))
+                  & 1).astype(u32)
+            fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, :, 0, :].set(
+                sb[:, None] * jnp.ones((K,), u32))
         else:
             fr0 = jnp.zeros((Wd, Sn, 1, K), u32).at[0, 0, 0, :].set(1)
-        reg0 = (jnp.zeros((R, K), u32), jnp.zeros((R, K), u32),
+        aw = (R, K) if sn_words == 1 else (R, K, sn_words)
+        reg0 = (jnp.zeros(aw, u32), jnp.zeros(aw, u32),
                 jnp.zeros((R, K), jnp.int32), jnp.zeros((R, K), bool))
         s_iota = jnp.arange(Sn, dtype=jnp.int32)
 
         def event(carry, ev):
-            fr, a1r, a2r, t0r, openr = carry
+            if death_row:
+                fr, a1r, a2r, t0r, openr, row, dead = carry
+            else:
+                fr, a1r, a2r, t0r, openr = carry
             if crash_closure:
                 rs, isl, iu, cr = ev
                 nm = ctab[cr.astype(jnp.int32)]           # [K, Sn]
@@ -971,8 +1012,9 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                 u = iu[:, i]
                 uc = jnp.clip(u, 0, None)
                 m = (u >= 0)[None, :] & (isl[:, i][None, :] == b_iota)
-                a1r = jnp.where(m, aux1_tab[uc][None, :], a1r)
-                a2r = jnp.where(m, aux2_tab[uc][None, :], a2r)
+                ma = m if sn_words == 1 else m[..., None]
+                a1r = jnp.where(ma, aux1_tab[uc][None], a1r)
+                a2r = jnp.where(ma, aux2_tab[uc][None], a2r)
                 t0r = jnp.where(m, t0_tab[uc][None, :], t0r)
                 openr = openr | m
             if crash_closure:
@@ -986,11 +1028,19 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                     contrib = (lacking(fr, b)
                                & sel32(openr[b])[None, None, None, :])
                     if decomposed:
-                        dsel = sel32(((a1r[b][None, :]
-                                       >> s_iota[:, None]) & 1) == 1)
+                        if sn_words == 1:
+                            a1b = a1r[b][None, :]        # [1, K]
+                            a2b = a2r[b][None, :]
+                            sh = s_iota[:, None]
+                        else:
+                            # state row s reads word s//32, bit s%32
+                            si = np.arange(Sn)
+                            a1b = a1r[b].T[si // 32]     # [Sn, K]
+                            a2b = a2r[b].T[si // 32]
+                            sh = jnp.asarray(si % 32)[:, None]
+                        dsel = sel32(((a1b >> sh) & 1) == 1)
                         moved = contrib & dsel[None, :, None, :]
-                        csel = sel32(((a2r[b][None, :]
-                                       >> s_iota[:, None]) & 1) == 1)
+                        csel = sel32(((a2b >> sh) & 1) == 1)
                         red = jax.lax.reduce(
                             contrib & csel[None, :, None, :],
                             np.uint32(0), jax.lax.bitwise_or, (1,))
@@ -1020,13 +1070,23 @@ def _build_kernel_regs(K: int, L: int, I: int, Wd: int, Sn: int, R: int,
                 cleared = cleared | (retire_slot(fr, b) & sel32(rs == b))
             fr = jnp.where((rs >= 0)[None, None, None, :], cleared, fr)
             openr = openr & ~(rs[None, :] == b_iota)
+            if death_row:
+                alive = jax.lax.population_count(fr).astype(
+                    jnp.int32).sum()
+                dead = jnp.where((dead < 0) & (alive == 0), row, dead)
+                return (fr, a1r, a2r, t0r, openr, row + 1, dead), None
             return (fr, a1r, a2r, t0r, openr), None
 
         xs = (ret_slot, inv_slot, inv_uop)
         if crash_closure:
             xs = xs + (closure_args[0],)
-        (fr, *_), _ = jax.lax.scan(event, (fr0,) + reg0, xs,
-                                   unroll=unroll)
+        carry0 = (fr0,) + reg0
+        if death_row:
+            carry0 = carry0 + (jnp.int32(0), jnp.int32(-1))
+        (fr, *rest), _ = jax.lax.scan(event, carry0, xs,
+                                      unroll=unroll)
+        if death_row:
+            return rest[-1]
         if nc == 0:
             out = (fr[0] & 1).transpose(2, 1, 0)       # [K, J, Sn]
         else:
@@ -1121,7 +1181,8 @@ def _unpack_transfer_bufs(buf8, buf32, B: int, L: int, K: int, I: int,
 def _build_kernel_regs_relaxed(K: int, L: int, I: int, Wd: int,
                                Sn: int, R: int, decomposed: bool,
                                rounds: int, unroll: int, U: int,
-                               wide_uop: bool, nC: int):
+                               wide_uop: bool, nC: int,
+                               sn_words: int = 1):
     """Packed composed kernel under RELAXED crash semantics: crashed
     ops are position-dependent epsilon-transitions whose reflexive-
     transitive closures ride as a [nC, Sn] uint32 table (appended to
@@ -1134,19 +1195,33 @@ def _build_kernel_regs_relaxed(K: int, L: int, I: int, Wd: int,
     kern = _build_kernel_regs(K, L, I, Wd, Sn, R, decomposed,
                               rounds=rounds, unroll=unroll, J=Sn,
                               nc=0, rn=0, compose=True,
-                              crash_closure=True)
+                              crash_closure=True, sn_words=sn_words)
     n_crow = L * K * 2               # i16
+    W = sn_words
 
     def fn(buf8, buf32):
         base = len(buf8) - n_crow
-        tabs = _unpack_transfer_bufs(buf8[:base], buf32[:3 * U], 1, L,
-                                     K, I, U, wide_uop)
+        if W == 1:
+            tabs = _unpack_transfer_bufs(buf8[:base], buf32[:3 * U],
+                                         1, L, K, I, U, wide_uop)
+        else:
+            # wide-state aux layout: a1[U,W] ++ a2[U,W] ++ t0[U]
+            na = U * W
+            t8 = _unpack_transfer_bufs(
+                buf8[:base],
+                jnp.zeros(3 * U, jnp.uint32), 1, L, K, I, U, wide_uop)
+            tabs = t8[:3] + (
+                buf32[:na].reshape(U, W),
+                buf32[na:2 * na].reshape(U, W),
+                jax.lax.bitcast_convert_type(
+                    buf32[2 * na:2 * na + U], jnp.int32))
         pairs = buf8[base:].reshape(L, K, 2)
         lo = pairs[..., 0].astype(jnp.int32)
         hi = jax.lax.bitcast_convert_type(
             pairs[..., 1], jnp.int8).astype(jnp.int32)
         crow = lo | (hi << 8)
-        ctab = buf32[3 * U:].reshape(nC, Sn)
+        aux_n = 3 * U if W == 1 else 2 * U * W + U
+        ctab = buf32[aux_n:].reshape(nC, Sn, W)
         return kern(*tabs, crow, ctab)
 
     return jax.jit(fn)
@@ -1177,11 +1252,26 @@ def _build_kernel_regs_packed(K: int, L: int, I: int, Wd: int, Sn: int,
 
 
 def _pack_uop_tables(legal: np.ndarray, next_state: np.ndarray,
-                     diag_w, const_w, const_t0):
+                     diag_w, const_w, const_t0, sn_words: int = 1):
     """[U]-indexed transition tables for the register kernel — the same
     decomposed / nibble forms _pack_cand_tables gathers on host, left
-    un-gathered for device-side lookup."""
+    un-gathered for device-side lookup.  With sn_words = W > 1 the
+    decomposed state bitmasks come back as [U, W] uint32 (state s ->
+    word s // 32, bit s % 32) for the wide-state relaxed tier."""
     U, Sn = legal.shape
+    if sn_words > 1:
+        assert diag_w is not None
+        a1 = np.zeros((U, sn_words), np.uint32)
+        a2 = np.zeros((U, sn_words), np.uint32)
+        for sw in range(sn_words):
+            lo, hi = sw * 32, min((sw + 1) * 32, Sn)
+            pw = (1 << np.arange(hi - lo, dtype=np.uint64)) \
+                .astype(np.uint64)
+            a1[:, sw] = ((diag_w[:, lo:hi] > 0).astype(np.uint64)
+                         * pw).sum(1).astype(np.uint32)
+            a2[:, sw] = ((const_w[:, lo:hi] > 0).astype(np.uint64)
+                         * pw).sum(1).astype(np.uint32)
+        return a1, a2, const_t0.astype(np.int32)
     pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
     if diag_w is not None:
         aux1 = ((diag_w > 0).astype(np.uint64) * pow2).sum(1)
@@ -1883,8 +1973,9 @@ def _relaxed_refute(model, spec, history, ops, drop, crashed,
     earlier than the true one).  With localize=True a capped oracle
     attempt upgrades the bound to the exact op when it finishes."""
     Sn = states.shape[0]
-    if Sn > 32:
-        return None                  # closure masks are u32 rows
+    if Sn > 64:
+        return None                  # closure masks cap at two words
+    W = 1 if Sn <= 32 else 2         # uint32 words per state bitmask
     eff = [(ip, u) for (ip, cp, o), ine, u in
            zip(crashed, inert, crash_uop) if not ine]
     if any(u < 0 for _, u in eff):
@@ -1916,7 +2007,11 @@ def _relaxed_refute(model, spec, history, ops, drop, crashed,
         return None
     R = int(fk.max_open)
     diag_w, const_w, const_t0 = _decompose(legal, next_state)
-    if not _regs_eligible(R, U0, Sn, diag_w is not None):
+    # one shared gate, width-aware: the wide (W=2) lift is decomposed-
+    # only, so the nibble form never widens
+    if not _regs_eligible(R, U0, Sn, diag_w is not None,
+                          sn_cap=32 * W) \
+            or (W > 1 and diag_w is None):
         return None
     cuts = np.asarray(fk.cuts, np.int32)
     if len(cuts) != fk.n_rets or cuts[-1] != 1:
@@ -1952,16 +2047,25 @@ def _relaxed_refute(model, spec, history, ops, drop, crashed,
                 break
             C = C2
         ctab_rows.append(C)
-    pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
     nC_pad = _pad_len(nC)
-    ctab = np.zeros((nC_pad, Sn), np.uint32)
-    ctab[:] = (np.eye(Sn, dtype=np.uint64) * pow2).sum(1) \
-        .astype(np.uint32)           # padding rows: identity
+    ctab = np.zeros((nC_pad, Sn, W), np.uint32)
+
+    def _rows_to_words(M):
+        out = np.zeros((Sn, W), np.uint32)
+        for sw in range(W):
+            lo, hi = sw * 32, min((sw + 1) * 32, Sn)
+            pw = (1 << np.arange(hi - lo, dtype=np.uint64)) \
+                .astype(np.uint64)
+            out[:, sw] = (M[:, lo:hi].astype(np.uint64)
+                          * pw).sum(1).astype(np.uint32)
+        return out
+
+    ctab[:] = _rows_to_words(np.eye(Sn, dtype=bool))  # padding: identity
     for c, M in enumerate(ctab_rows):
-        ctab[c] = (M.astype(np.uint64) * pow2).sum(1).astype(np.uint32)
+        ctab[c] = _rows_to_words(M)
 
     a1t, a2t, t0t = _pack_uop_tables(
-        legal, next_state, diag_w, const_w, const_t0)
+        legal, next_state, diag_w, const_w, const_t0, sn_words=W)
     # unroll=1: the closure adds Sn^2 selects per round and the scan
     # body would otherwise blow up XLA compile time; the refutation
     # path runs once per suspect history, not in the steady-state loop
@@ -1971,17 +2075,55 @@ def _relaxed_refute(model, spec, history, ops, drop, crashed,
                            islot_t.view(np.uint8).ravel(),
                            iuop_t.view(np.uint8).ravel(),
                            crow_t.view(np.uint8).ravel()])
-    buf32 = np.concatenate([a1t, a2t, t0t.view(np.uint32),
-                            ctab.ravel()])
+    buf32 = np.concatenate([a1t.ravel(), a2t.ravel(),
+                            t0t.view(np.uint32), ctab.ravel()])
     fn = _build_kernel_regs_relaxed(
         K, int(Lp), I, max(1, (1 << R) // 32), int(Sn), R,
-        diag_w is not None, R, unroll, U0, wide, int(nC_pad))
+        diag_w is not None, R, unroll, U0, wide, int(nC_pad),
+        sn_words=W)
     vd = np.asarray(fn(buf8, buf32))
     if int(vd[0]) == 1:
         return None                  # relaxed-valid: proves nothing
     dead = int(vd[1])
+    seg_lo = int(seg_ends[dead - 1]) if dead > 0 else 0
+    # Exact relaxed-death localization, NO oracle (VERDICT r3 #3):
+    # re-run the relaxed kernel over the dead segment ALONE (its table
+    # columns already exist), seeded with the composed verdict's
+    # reachable entry-state mask, tracking the first row at which the
+    # frontier empties.  Exactness of the point: the relaxed config
+    # set OVER-approximates the true one at every index, so the return
+    # it names is the first op at which even the relaxation is
+    # impossible — the true witness is at or before it, and on
+    # violations that are not themselves crash-explainable (e.g. a
+    # value no write OR crashed write ever carried) the two coincide
+    # (differentially asserted).
     bound_pos = int(orig_ret_pos[int(seg_ends[dead]) - 1])
-    bound_op = ops[bound_pos]
+    loc_kern = _build_kernel_regs(
+        1, int(Lp), I, max(1, (1 << R) // 32), int(Sn), R,
+        diag_w is not None, rounds=R, unroll=1, J=1,
+        compose=False, crash_closure=True, death_row=True,
+        sn_words=W)
+    seed = (vd[2:2 + max(W, 2)].astype(np.int64)
+            & 0xFFFFFFFF).astype(np.uint32)[:W] \
+        if W > 1 else np.asarray(
+            [np.int64(vd[2]) & 0xFFFFFFFF], np.uint32)
+    drow = int(np.asarray(loc_kern(
+        ret_t[:, dead:dead + 1], islot_t[:, dead:dead + 1],
+        iuop_t[:, dead:dead + 1], a1t, a2t, t0t,
+        crow_t[:, dead:dead + 1], ctab, seed)))
+    if drow >= 0:
+        local = int((ret_t[:drow + 1, dead] >= 0).sum()) - 1
+        g = seg_lo + local
+        if 0 <= g < len(orig_ret_pos):
+            bound_pos = int(orig_ret_pos[g])
+    p = ops[bound_pos].process
+    inv = bound_pos
+    while inv >= 0 and not (ops[inv].process == p
+                            and ops[inv].type == "invoke"):
+        inv -= 1
+    bound_op = ops[max(inv, 0)]
+    bound_idx = (bound_op.index if bound_op.index is not None
+                 else max(inv, 0))
     result: dict[str, Any] = {
         "valid?": False,
         "op_count": fk.n_calls + len(crashed),
@@ -1991,13 +2133,15 @@ def _relaxed_refute(model, spec, history, ops, drop, crashed,
         "refutation": "crash-relaxed",
         "crashed": len(crashed),
         "dead_segment": dead,
-        "witness_bound_index": (bound_op.index
-                                if bound_op.index is not None
-                                else bound_pos),
+        "op": bound_op.to_dict(),
+        "op_index": bound_idx,
+        "witness": "relaxed-exact" if drow >= 0 else "segment-bound",
+        "witness_bound_index": bound_idx,
     }
     if localize:
-        # best-effort exact witness: capped oracle (the bound already
-        # makes the verdict reportable if this gives up)
+        # the capped oracle now only upgrades ARTIFACTS (final-paths /
+        # configs) and, when it finishes, the true minimal witness —
+        # the exact relaxed-death op above is always reportable
         from jepsen_tpu.ops import wgl_cpu
         oracle = wgl_cpu.check(model, history, time_limit=15,
                                max_configs=500_000)
@@ -2690,18 +2834,19 @@ def check_pipeline(model, histories, *, max_states: int = 64,
             blocks.append(blocks[0])  # (extra verdicts discarded)
         dispatched.append(
             (fn(np.concatenate(blocks), buf32),
-             [i for i, _, _ in grp], spec_rounds, Sn, states))
+             [i for i, _, _ in grp], spec_rounds, R_cur, Sn, states))
 
     if dispatched:
         stacked = _build_stack(len(dispatched))(
             *[d for d, *_ in dispatched])
         vds = np.asarray(stacked)                 # ONE fetch
-        for g, (_, idxs, sr, Sn_g, states_g) in enumerate(dispatched):
+        for g, (_, idxs, sr, R_g_disp, Sn_g, states_g) \
+                in enumerate(dispatched):
             vd = vds[g].reshape(-1, 6)
             for j, i in enumerate(idxs):
                 valid = bool(vd[j, 0])
                 fk, seg_ends_i, k_segs = metas[i]
-                if not valid and sr < R_cur:
+                if not valid and sr < R_g_disp:
                     # speculative death is inconclusive: exact re-run
                     # (rare on valid workloads; carries the witness)
                     res = check(model, histories[i],

@@ -68,7 +68,9 @@ class KvdDB(db_mod.DB, db_mod.LogFiles):
     def teardown(self, test, node):
         import sys
         # un-pause first: SIGTERM queues behind SIGSTOP otherwise
-        c.execute("pkill", "-CONT", "-f", "[k]vd.py", check=False)
+        # (pid-targeted for the same shared-host reason as the pauser)
+        c.execute("sh", "-c",
+                  f"kill -CONT $(cat {DIR}/kvd.pid)", check=False)
         cu.stop_daemon(f"{DIR}/kvd.pid", sys.executable)
         c.execute("rm", "-f", f"{DIR}/kvd.pid", check=False)
 
@@ -108,20 +110,20 @@ class KvdConn:
 def pauser():
     """SIGSTOP/SIGCONT the daemon — a real fault that freezes the SUT
     mid-operation (nemesis.clj hammer-time :281); safe on a shared
-    host, unlike iptables.  pkill -f: the process NAME is python3, the
-    script path is only in the argv."""
+    host, unlike iptables.  Signals target the pid from the suite's
+    OWN pidfile — a pkill -f pattern would match every kvd.py on the
+    host, so two concurrent runs on a shared CI box would SIGSTOP each
+    other's daemons (ADVICE r3)."""
     import random
 
-    # "[k]vd.py": the regex still matches the daemon's argv, but the
-    # literal pattern in pkill's OWN /bin/sh -c cmdline does not match
-    # itself — without the bracket trick pkill SIGSTOPs its own shell
-    # wrapper and the nemesis hangs forever mid-communicate
     def start(test, node):
-        c.execute("pkill", "-STOP", "-f", "[k]vd.py", check=False)
+        c.execute("sh", "-c",
+                  f"kill -STOP $(cat {DIR}/kvd.pid)", check=False)
         return ["paused", "kvd"]
 
     def stop(test, node):
-        c.execute("pkill", "-CONT", "-f", "[k]vd.py", check=False)
+        c.execute("sh", "-c",
+                  f"kill -CONT $(cat {DIR}/kvd.pid)", check=False)
         return ["resumed", "kvd"]
 
     return nem.node_start_stopper(
@@ -133,9 +135,13 @@ def kvd_test(opts) -> dict:
     opts.setdefault("nodes", ["n1"])
     # the CLI always supplies an ssh submap (username etc.) — force the
     # local transport regardless, unless a test explicitly runs dummy
+    # or wire=True (the PATH-shim SSH transport test: the real
+    # SSHSession argv path, with `ssh`/`scp` shim executables
+    # delegating to /bin/sh — see tests/test_ssh_shim.py)
     ssh = dict(opts.get("ssh") or {})
-    if not ssh.get("dummy"):
+    if not ssh.get("dummy") and not ssh.get("wire"):
         ssh["local"] = True
+    ssh.pop("wire", None)
     opts["ssh"] = ssh
     test = register_test("kvd", KvdDB(
                              unsafe_cas=bool(opts.get("unsafe-cas"))),
