@@ -547,11 +547,251 @@ done:
     return result;
 }
 
+/* ---------------------------------------------------------------- */
+/* Stream scan: the columnar scan fused with quiescent-cut
+ * segmentation (wgl_seg._segment_ends' greedy policy) and I=1
+ * register-delta row-stream emission — ONE pass from packed columns
+ * to the exact wire layout wgl_seg._regs_fill_compact ships, so the
+ * pipeline's per-history host cost is the scan alone (the separate
+ * numpy segment/layout/fill stages measured ~11 ms per 100k-op
+ * history on the 1-core bench host, BENCH_r05 decomposition).
+ *
+ * Row model (wgl_seg._RegsLayout with I = 1): each return emits the
+ * calls invoked since the previous return, one row per invoke, in
+ * invoke order; the LAST of them rides the return's own row, earlier
+ * ones are spill rows (ret = -1); a return with no new invokes is a
+ * lone row (islot = -1).
+ *
+ * fast_scan_streams(proc, typ, fmap, va, vb, vk, seen, rows,
+ *                   max_open_bits, target)
+ * returns None when out of scope (same conditions as fast_scan_cols),
+ * else (n_calls, max_open, n_rets, lp_min,
+ *       ret_s i32[rtot], islot_s i32[rtot], iuop_s i32[rtot],
+ *       cum i32[K+1], seg_ends i32[K], positions i32[n_rets])       */
+
+static PyObject *fast_scan_streams(PyObject *self, PyObject *args) {
+    Py_buffer bproc = {0}, btyp = {0}, bfmap = {0}, bva = {0},
+              bvb = {0}, bvk = {0};
+    PyObject *seen, *rows;
+    long max_open_bits, target;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*y*y*O!O!ll",
+                          &bproc, &btyp, &bfmap, &bva, &bvb, &bvk,
+                          &PyDict_Type, &seen, &PyList_Type, &rows,
+                          &max_open_bits, &target))
+        return NULL;
+    if (max_open_bits > MAX_OPEN_HARD) max_open_bits = MAX_OPEN_HARD;
+    if (target < 1) target = 1;
+    Py_ssize_t n = (Py_ssize_t)(bproc.len / 4);
+    const int32_t *proc = bproc.buf;
+    const uint8_t *typ = btyp.buf;
+    const int32_t *fmap = bfmap.buf;
+    const int32_t *va = bva.buf;
+    const int32_t *vb = bvb.buf;
+    const uint8_t *vk = bvk.buf;
+
+    PyObject *result = NULL;
+    PyObject *new_rows = NULL;
+    vec ret_s = {0}, islot_s = {0}, iuop_s = {0}, cum = {0},
+        seg_ends = {0}, ret_pos = {0};
+    Py_ssize_t *fate = NULL;
+    utab ut = {0};
+    if ((Py_ssize_t)(btyp.len) != n || (Py_ssize_t)(bfmap.len / 4) != n
+        || (Py_ssize_t)(bva.len / 4) != n
+        || (Py_ssize_t)(bvb.len / 4) != n
+        || (Py_ssize_t)(bvk.len) != n) {
+        PyErr_SetString(PyExc_ValueError, "column length mismatch");
+        goto done;
+    }
+    fate = PyMem_Malloc((n ? n : 1) * sizeof(Py_ssize_t));
+    if (!fate) { PyErr_NoMemory(); goto done; }
+
+    /* pass 1: pair completions with invokes */
+    {
+        int32_t open_p[MAX_OPEN_HARD];
+        Py_ssize_t open_i[MAX_OPEN_HARD];
+        long n_open1 = 0;
+        for (Py_ssize_t i = 0; i < n; i++) fate[i] = -1;
+        for (Py_ssize_t i = 0; i < n; i++) {
+            int32_t p = proc[i];
+            if (p < 0) continue;
+            uint8_t t = typ[i];
+            long j = -1;
+            for (long k = 0; k < n_open1; k++)
+                if (open_p[k] == p) { j = k; break; }
+            if (t == 0) {
+                if (j >= 0) goto fallback;        /* double invoke */
+                if (n_open1 >= MAX_OPEN_HARD) goto fallback;
+                open_p[n_open1] = p;
+                open_i[n_open1] = i;
+                n_open1++;
+            } else if (j >= 0) {
+                fate[open_i[j]] = i;
+                open_p[j] = open_p[n_open1 - 1];
+                open_i[j] = open_i[n_open1 - 1];
+                n_open1--;
+            }
+        }
+        if (n_open1 > 0) goto fallback;           /* crashed calls */
+    }
+
+    /* pass 2: slots + interning + row-stream emission */
+    new_rows = PyList_New(0);
+    if (!new_rows || utab_init(&ut, 256) < 0) goto fail_nomem;
+    {
+        long slot_of[MAX_OPEN_HARD], uop_of[MAX_OPEN_HARD];
+        int32_t open_procs[MAX_OPEN_HARD];
+        long free_slots[MAX_OPEN_HARD];
+        long pend_slot[MAX_OPEN_HARD], pend_uop[MAX_OPEN_HARD];
+        long n_pend = 0;
+        long n_free = 0, next_slot = 0, n_open = 0;
+        long max_open = 0, n_calls = 0, n_rets = 0;
+        long nret_seg = 0, seg_row0 = 0, lp_min = 0;
+        Py_ssize_t base_rows = PyList_GET_SIZE(rows);
+        int seen_nonempty = PyDict_GET_SIZE(seen) > 0;
+        if (vec_push(&cum, 0) < 0) goto fail_nomem;
+
+        for (Py_ssize_t i = 0; i < n; i++) {
+            int32_t p = proc[i];
+            if (p < 0) continue;
+            uint8_t t = typ[i];
+            if (t == 0) {
+                Py_ssize_t ci = fate[i];
+                if (ci < 0 || typ[ci] == 3) goto fallback;
+                if (typ[ci] == 2) continue;       /* fail pair */
+                long a, b, okv;
+                uint8_t k = vk[i];
+                Py_ssize_t vi = i;
+                if (k == 0) { k = vk[ci]; vi = ci; }
+                if (k == 4) goto fallback;        /* out of int32 */
+                if (k == 0 || k == 3) { a = 0; b = 0; okv = 0; }
+                else {
+                    a = va[vi];
+                    b = (k == 2) ? vb[vi] : 0;
+                    okv = 1;
+                }
+                long fc = fmap[i];
+                if (fc < 0) goto fallback;        /* f not in spec */
+                long u = intern_uop(&ut, seen, seen_nonempty,
+                                    rows, new_rows, fc, a, b, okv);
+                if (u < 0) goto fail;
+                long s = n_free ? free_slots[--n_free] : next_slot++;
+                if (n_open >= MAX_OPEN_HARD) goto fallback;
+                open_procs[n_open] = p;
+                slot_of[n_open] = s;
+                uop_of[n_open] = u;
+                n_open++;
+                if (n_open > max_open) {
+                    max_open = n_open;
+                    if (max_open > max_open_bits) goto fallback;
+                }
+                n_calls++;
+                /* n_pend < n_open <= MAX_OPEN_HARD always holds: the
+                 * pending calls are all still open at the next return */
+                pend_slot[n_pend] = s;
+                pend_uop[n_pend] = u;
+                n_pend++;
+            } else if (t == 1) {
+                long idx = -1;
+                for (long j = 0; j < n_open; j++)
+                    if (open_procs[j] == p) { idx = j; break; }
+                if (idx < 0) continue;
+                /* spill rows: all but the last pending invoke */
+                for (long j = 0; j + 1 < n_pend; j++) {
+                    if (vec_push(&ret_s, -1) < 0 ||
+                        vec_push(&islot_s, (int32_t)pend_slot[j]) < 0 ||
+                        vec_push(&iuop_s, (int32_t)pend_uop[j]) < 0)
+                        goto fail_nomem;
+                }
+                /* the return row carries the last pending invoke */
+                if (vec_push(&ret_s, (int32_t)slot_of[idx]) < 0 ||
+                    vec_push(&islot_s, n_pend
+                             ? (int32_t)pend_slot[n_pend - 1]
+                             : (int32_t)-1) < 0 ||
+                    vec_push(&iuop_s, n_pend
+                             ? (int32_t)pend_uop[n_pend - 1]
+                             : (int32_t)0) < 0 ||
+                    vec_push(&ret_pos, (int32_t)i) < 0)
+                    goto fail_nomem;
+                n_pend = 0;
+                n_rets++;
+                nret_seg++;
+                free_slots[n_free++] = slot_of[idx];
+                for (long j = idx; j < n_open - 1; j++) {
+                    open_procs[j] = open_procs[j + 1];
+                    slot_of[j] = slot_of[j + 1];
+                    uop_of[j] = uop_of[j + 1];
+                }
+                n_open--;
+                if (n_open == 0 && nret_seg >= target) {
+                    /* close the segment at this quiescent return */
+                    long seg_rows = ret_s.len - seg_row0;
+                    if (seg_rows > lp_min) lp_min = seg_rows;
+                    if (vec_push(&cum, (int32_t)ret_s.len) < 0 ||
+                        vec_push(&seg_ends, (int32_t)n_rets) < 0)
+                        goto fail_nomem;
+                    seg_row0 = ret_s.len;
+                    nret_seg = 0;
+                }
+            }
+        }
+        if (nret_seg > 0) {
+            /* tail segment (< target returns); the history's last
+             * return is always quiescent for crash-free histories */
+            long seg_rows = ret_s.len - seg_row0;
+            if (seg_rows > lp_min) lp_min = seg_rows;
+            if (vec_push(&cum, (int32_t)ret_s.len) < 0 ||
+                vec_push(&seg_ends, (int32_t)n_rets) < 0)
+                goto fail_nomem;
+        }
+
+        if (publish_interning(seen, rows, new_rows, base_rows) < 0)
+            goto fail;
+        result = Py_BuildValue(
+            "(lllly#y#y#y#y#y#)", n_calls, max_open, n_rets, lp_min,
+            (char *)ret_s.data, ret_s.len * sizeof(int32_t),
+            (char *)islot_s.data, islot_s.len * sizeof(int32_t),
+            (char *)iuop_s.data, iuop_s.len * sizeof(int32_t),
+            (char *)cum.data, cum.len * sizeof(int32_t),
+            (char *)seg_ends.data, seg_ends.len * sizeof(int32_t),
+            (char *)ret_pos.data, ret_pos.len * sizeof(int32_t));
+    }
+    goto done;
+
+fallback:
+    result = Py_None;
+    Py_INCREF(Py_None);
+    goto done;
+
+fail_nomem:
+    PyErr_NoMemory();
+fail:
+done:
+    Py_XDECREF(new_rows);
+    PyMem_Free(fate);
+    PyMem_Free(ut.e);
+    PyMem_Free(ret_s.data);
+    PyMem_Free(islot_s.data);
+    PyMem_Free(iuop_s.data);
+    PyMem_Free(cum.data);
+    PyMem_Free(seg_ends.data);
+    PyMem_Free(ret_pos.data);
+    if (bproc.obj) PyBuffer_Release(&bproc);
+    if (btyp.obj) PyBuffer_Release(&btyp);
+    if (bfmap.obj) PyBuffer_Release(&bfmap);
+    if (bva.obj) PyBuffer_Release(&bva);
+    if (bvb.obj) PyBuffer_Release(&bvb);
+    if (bvk.obj) PyBuffer_Release(&bvk);
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"fast_scan", fast_scan, METH_VARARGS,
      "Fused pairing/slotting/interning scan over one history."},
     {"fast_scan_cols", fast_scan_cols, METH_VARARGS,
      "Columnar twin of fast_scan over struct-of-arrays histories."},
+    {"fast_scan_streams", fast_scan_streams, METH_VARARGS,
+     "Columnar scan fused with segmentation and I=1 row-stream "
+     "emission (the grouped pipeline's wire layout)."},
     {NULL, NULL, 0, NULL},
 };
 
