@@ -360,14 +360,27 @@ _I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 _I32 = 2 ** 31
 
+# Process-column sentinel for CLIENT ids outside int32 range (e.g.
+# uuid-derived worker ids): the object-path scanners and the oracle see
+# the real id and treat the op as a client call, so the columnar pack
+# must NOT silently fold it into NEMESIS — that would drop the op from
+# the columnar scan and break the pinned "columnar and object paths
+# classify identically" invariant (ADVICE r4).  The native columnar
+# ingests treat this sentinel as out-of-scope (whole history falls back
+# to the object walk, which sees the true ids).
+P_OUT_OF_RANGE = -2
+
 
 def _i32_process(p) -> int:
-    """Process column value: exact non-negative int in int32 range,
-    else NEMESIS.  A plain int >= 2^31 (e.g. a uuid-derived worker id)
-    must never raise inside the run-loop journal append — it simply
-    isn't a batchable client process, the same bucket bools and
-    IntEnums land in (ADVICE r3)."""
-    return p if type(p) is int and 0 <= p < _I32 else NEMESIS
+    """Process column value: exact non-negative int in int32 range as
+    itself; an exact int OUTSIDE int32 range -> P_OUT_OF_RANGE (the
+    columnar scans then defer to the object paths); anything else
+    (nemesis tags, bools, IntEnums, strings) -> NEMESIS.  Must never
+    raise inside the run-loop journal append (ADVICE r3)."""
+    if type(p) is int:
+        return p if 0 <= p < _I32 else \
+            (P_OUT_OF_RANGE if p >= _I32 else NEMESIS)
+    return NEMESIS
 
 
 def _i32_index(idx, fallback: int) -> int:

@@ -351,6 +351,10 @@ static PyObject *prep_cols(PyObject *self, PyObject *args) {
         for (Py_ssize_t i = 0; i < n; i++) fate[i] = -1;
         for (Py_ssize_t i = 0; i < n; i++) {
             int32_t p = proc[i];
+            if (p == -2) goto fallback;  /* out-of-int32 client id:
+                * the object paths see the real id (history.py
+                * P_OUT_OF_RANGE) — whole history out of columnar
+                * scope so classifications cannot diverge */
             if (p < 0) continue;
             uint8_t t = typ[i];
             long j = -1;

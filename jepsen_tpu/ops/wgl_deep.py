@@ -77,12 +77,20 @@ EB = 512            # event rows per grid step (SMEM block budget)
 def supported(R: int, Sn: int, U: int, decomposed: bool,
               backend: str) -> bool:
     """Gate shared with the wgl_seg dispatcher: the deep kernel takes
-    decomposable models with Sn <= 32 on TPU (or the CPU interpreter
-    for tests) at any R <= R_MAX.  It is *profitable* past the
-    register-delta gate (R > 6); eligibility below that is still
-    correct and used by the differential tests."""
+    decomposable models with Sn <= 32 on TPU at any R <= R_MAX.  It is
+    *profitable* past the register-delta gate (R > 6); eligibility
+    below that is still correct and used by the differential tests.
+
+    The 'cpu' backend runs the Pallas INTERPRETER — a per-event Python
+    loop, orders of magnitude slower than the compiled candidate-table
+    fallback on long histories — so it is opt-in via
+    JEPSEN_TPU_DEEP_INTERPRET=1 (set by the test suite, which runs
+    deliberately tiny histories on the virtual CPU mesh); production
+    CPU deployments keep the existing compiled fallback chain."""
     return (decomposed and 0 < R <= R_MAX and Sn <= 32 and U <= 32767
-            and backend in ("tpu", "cpu")
+            and (backend == "tpu"
+                 or (backend == "cpu" and os.environ.get(
+                     "JEPSEN_TPU_DEEP_INTERPRET") == "1"))
             and os.environ.get("JEPSEN_TPU_NO_DEEP") != "1")
 
 
@@ -361,6 +369,59 @@ def pack_events(ret_t: np.ndarray, islot_t: np.ndarray,
     return np.ascontiguousarray(evbuf[:, None, :]), G
 
 
+def pack_events_compact(ret_t: np.ndarray, islot_t: np.ndarray,
+                        iuop_t: np.ndarray) -> tuple[np.ndarray, int]:
+    """Compact wire twin of pack_events: the same event stream as a
+    uint8 buffer — ret+1 u8[L2] (0 = the -1 sentinel; slot+1 <= R_MAX
+    +1 = 15) ++ islot+1 u8[L2*I] ++ iuop u16-LE bytes[2*L2*I] — ~3.6x
+    fewer bytes than the int32 form at I=2, rebuilt into the kernel's
+    evbuf on device by _build_c's unpack prologue.  Padding iuops are
+    clamped to 0: the kernel reads a row's uop only where its islot
+    >= 0 (registration gate), so the clamp is unobservable."""
+    Lp = ret_t.shape[0]
+    I = islot_t.shape[2]
+    G = _pad_g((Lp + EB - 1) // EB)
+    L2 = G * EB
+    ret = np.zeros(L2, np.uint8)
+    ret[:Lp] = (ret_t[:, 0].astype(np.int32) + 1).astype(np.uint8)
+    islot = np.zeros((L2, I), np.uint8)
+    islot[:Lp] = (islot_t[:, 0, :].astype(np.int32) + 1).astype(
+        np.uint8)
+    iuop = np.zeros((L2, I), np.uint16)
+    iuop[:Lp] = np.maximum(
+        iuop_t[:, 0, :].astype(np.int32), 0).astype(np.uint16)
+    return np.concatenate([ret, islot.ravel(),
+                           iuop.ravel().view(np.uint8)]), G
+
+
+@functools.lru_cache(maxsize=32)
+def _build_c(G: int, I: int, Wd: int, SnP: int, R: int, UP: int,
+             interpret: bool):
+    """Compact-wire wrapper around _build: jit-unpacks the uint8 event
+    buffer of pack_events_compact back into the int32 evbuf on device
+    (a few fused casts/reshapes, free next to the event walk) and runs
+    the megakernel — the tunnel carries the compact form."""
+    import jax
+    import jax.numpy as jnp
+
+    kern = _build(G, I, Wd, SnP, R, UP, interpret)
+    L2 = G * EB
+
+    def fn(cbuf, auxbuf):
+        ret = cbuf[:L2].astype(jnp.int32) - 1
+        isl = cbuf[L2:L2 * (1 + I)].astype(jnp.int32) - 1
+        pairs = cbuf[L2 * (1 + I):].reshape(L2 * I, 2)
+        iu = (pairs[:, 0].astype(jnp.int32)
+              | (pairs[:, 1].astype(jnp.int32) << 8))
+        evbuf = jnp.concatenate(
+            [ret.reshape(G, EB),
+             isl.reshape(G, EB * I),
+             iu.reshape(G, EB * I)], axis=1)[:, None, :]
+        return kern(evbuf, auxbuf)
+
+    return jax.jit(fn)
+
+
 def pack_aux(a1t: np.ndarray, a2t: np.ndarray, t0t: np.ndarray,
              UP: int) -> np.ndarray:
     """[U] uop tables (wgl_seg._pack_uop_tables) -> u32[1, 3*UP+16]."""
@@ -398,12 +459,12 @@ def dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
         raise RuntimeError(f"no deep-kernel lowering for {backend}")
     I = islot_t.shape[2]
     UP = _pad_u(a1t.shape[0])
-    evbuf, G = pack_events(ret_t, islot_t, iuop_t)
+    cbuf, G = pack_events_compact(ret_t, islot_t, iuop_t)
     auxbuf = pack_aux(a1t, a2t, t0t, UP)
     Wd = max(1, (1 << R) // 32)
-    kern = _build(G, I, Wd, _snp(Sn), R, UP,
-                  interpret=(backend == "cpu"))
-    return kern(evbuf, auxbuf), G
+    kern = _build_c(G, I, Wd, _snp(Sn), R, UP,
+                    interpret=(backend == "cpu"))
+    return kern(cbuf, auxbuf), G
 
 
 def check_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
@@ -447,7 +508,7 @@ def map_witness(ret_t, fk, ops, failed_row):
 
 
 def check_pipeline(model, histories, *, max_open_bits: int = 14,
-                   max_states: int = 64) -> list:
+                   max_states: int = 64, stats=None) -> list:
     """Steady-state deep-overlap checking: scan + pack every history on
     host, dispatch ALL kernels asynchronously, stack the [1, 2]
     verdicts ON DEVICE and fetch them in ONE round trip — the tunnel's
@@ -455,8 +516,19 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
     (bench.py's north-star decomposition), and this amortizes it over
     the batch exactly like wgl_seg.check_pipeline does for the shallow
     regime.  Verdict-identical to wgl_seg.check per history
-    (differential battery).  Raises ValueError for histories outside
-    the deep kernel's scope."""
+    (differential battery).
+
+    Histories OUTSIDE the deep kernel's scope (R > R_MAX, crashed
+    scans, undecomposable growth) do not poison the batch: they ride
+    as stragglers through wgl_seg.check's own fallback chain after the
+    in-scope verdicts are fetched — the same pattern as
+    wgl_seg.check_pipeline's straggler path, so a mixed-depth batch
+    (e.g. one R = 15 history among R <= 14 ones) still returns one
+    correct verdict per history.
+
+    `stats`, when given a dict, receives the per-stage host-time
+    decomposition (scan / tables / pack / dispatch / fetch / assemble
+    seconds), mirroring wgl_seg.check_pipeline's."""
     import jax
 
     from jepsen_tpu.ops import wgl_seg
@@ -464,22 +536,29 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
     spec = model.device_spec()
     if spec is None:
         raise ValueError(f"model {model!r} has no device spec")
+    _mt, _acc = wgl_seg._stats_clock(stats)
     backend = jax.default_backend()
     pend = []
+    strag = []
+    results: list = [None] * len(histories)
     # shared interning across the batch: state enumeration, the
     # decomposition, and the uop tables are (re)built only when a
     # history grows the alphabet — not once per history
     seen: dict = {}
     rows: list = []
     U_at = -1
-    tables = None            # (Sn, a1t, a2t, t0t)
+    Sn = 0
+    tables = None            # (a1t, a2t, t0t)
     init = np.asarray(spec.encode(model), np.int32)
-    for h in histories:
+    for i, h in enumerate(histories):
         ops = h.ops
+        t0 = _mt()
         fk = wgl_seg._scan_history(h, ops, spec, seen, rows,
                                    max_open_bits, want_snaps=False)
+        t0 = _acc("scan", t0)
         if not fk:
-            raise ValueError("history out of deep-kernel scope (scan)")
+            strag.append(i)
+            continue
         R = int(fk.max_open)
         if len(rows) != U_at:
             uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
@@ -488,13 +567,17 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
             Sn = states.shape[0]
             dw, cw, t0c = wgl_seg._decompose(legal, next_state)
             if dw is None:
-                raise ValueError("model not decomposable")
+                # undecomposable models only grow less decomposable:
+                # everything from here on is a straggler
+                strag.extend(range(i, len(histories)))
+                break
             tables = wgl_seg._pack_uop_tables(legal, next_state,
                                               dw, cw, t0c)
             U_at = len(rows)
+        t0 = _acc("tables", t0)
         if not supported(R, Sn, len(rows), True, backend):
-            raise ValueError(
-                f"history out of deep-kernel scope (R={R}, Sn={Sn})")
+            strag.append(i)           # e.g. R > R_MAX: serial fallback
+            continue
         I = min(2, R) if R else 1
         if fk.deltas is not None:
             ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs_single(
@@ -503,21 +586,146 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
             ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs(
                 [(0, fk)], 1, R, len(rows), I)
         a1t, a2t, t0t = tables
+        t0 = _acc("pack", t0)
         dev, G = dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t,
                                  t0t, R, Sn)
-        pend.append((dev, fk, ret_t, ops, R, Sn, G))
+        _acc("dispatch", t0)
+        pend.append((dev, i, fk, ret_t, ops, R, Sn, G))
 
-    stacked = wgl_seg._build_stack(len(pend))(*[d for d, *_ in pend])
-    outs = np.asarray(stacked)                    # ONE fetch
+    if pend:
+        t0 = _mt()
+        stacked = wgl_seg._build_stack(len(pend))(
+            *[d for d, *_ in pend])
+        outs = np.asarray(stacked)                    # ONE fetch
+        t0 = _acc("fetch", t0)
+        for j, (dev, i, fk, ret_t, ops, R, Sn_i, G) in enumerate(pend):
+            alive = bool(outs[j, 0, 0])
+            res = {"valid?": alive, "op_count": fk.n_calls,
+                   "backend": backend, "engine": "wgl_deep",
+                   "max_open": R, "states": Sn_i, "pipelined": True}
+            if not alive:
+                res["anomaly"] = "nonlinearizable"
+                w = map_witness(ret_t, fk, ops, int(outs[j, 0, 1]))
+                if w is not None:
+                    res["op"] = w[0].to_dict()
+                    res["op_index"] = w[1]
+            results[i] = res
+        _acc("assemble", t0)
+    for i in strag:
+        try:
+            results[i] = wgl_seg.check(model, histories[i],
+                                       max_states=max_states,
+                                       max_open_bits=max_open_bits)
+        except wgl_seg.Unsupported:
+            # beyond every batched gate (e.g. R > R_MAX): the serial
+            # frontier engine has no overlap-depth limit
+            from jepsen_tpu.ops import wgl
+            results[i] = wgl.check(model, histories[i])
+    return results
+
+
+def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
+               max_open_bits: int = R_MAX,
+               max_states: int = 64) -> list:
+    """Deep-overlap scale-out over a jax.sharding.Mesh: one history
+    per device (SURVEY.md §2.5).  The megakernel is a single device
+    program per history, so the mesh strategy is the embarrassingly
+    parallel one — every history's packed event buffer is padded to
+    one common grid shape, stacked on a leading axis sharded over
+    `mesh_axis`, and shard_map runs the kernel once per device with NO
+    collectives (verdicts are independent; the [D, 2] output gathers
+    on fetch).  Grid-padding rows are ret = -1 / islot = -1 no-op rows
+    — exact, as in the pipelined path.  Verdict-identical to
+    check_pipeline per history; histories must all be in deep scope
+    (callers route stragglers through check_pipeline instead)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    shard_map = jax.shard_map
+
+    from jepsen_tpu.ops import wgl_seg
+
+    spec = model.device_spec()
+    if spec is None:
+        raise ValueError(f"model {model!r} has no device spec")
+    backend = jax.default_backend()
+    n_dev = int(np.prod(mesh.devices.shape))
+    if len(histories) != n_dev:
+        raise ValueError(f"one history per device: got "
+                         f"{len(histories)} histories, {n_dev} devices")
+    seen: dict = {}
+    rows: list = []
+    init = np.asarray(spec.encode(model), np.int32)
+    fks = []
+    for h in histories:
+        fk = wgl_seg._scan_history(h, h.ops, spec, seen, rows,
+                                   max_open_bits, want_snaps=False)
+        if not fk:
+            raise ValueError("history out of deep-kernel scope (scan)")
+        fks.append(fk)
+    uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
+    states, legal, next_state = wgl_seg._enumerate_states(
+        spec, init, uops, max_states)
+    Sn = states.shape[0]
+    dw, cw, t0c = wgl_seg._decompose(legal, next_state)
+    if dw is None:
+        raise ValueError("model not decomposable")
+    a1t, a2t, t0t = wgl_seg._pack_uop_tables(legal, next_state,
+                                             dw, cw, t0c)
+    R = max(int(fk.max_open) for fk in fks)
+    if not supported(R, Sn, len(rows), True, backend):
+        raise ValueError(
+            f"batch out of deep-kernel scope (R={R}, Sn={Sn})")
+    I = min(2, R) if R else 1
+    UP = _pad_u(a1t.shape[0])
+    auxbuf = pack_aux(a1t, a2t, t0t, UP)
+    evs, rets = [], []
+    for fk in fks:
+        if fk.deltas is not None:
+            ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs_single(
+                fk, [fk.n_rets], R, len(rows), I)
+        else:
+            ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs(
+                [(0, fk)], 1, R, len(rows), I)
+        evbuf, G = pack_events(ret_t, islot_t, iuop_t)
+        evs.append(evbuf)
+        rets.append(ret_t)
+    G_max = max(e.shape[0] for e in evs)
+    W = evs[0].shape[2]
+    ev_all = np.zeros((n_dev, G_max, 1, W), np.int32)
+    for d, e in enumerate(evs):
+        ev_all[d, :e.shape[0]] = e
+        # grid-padding blocks: ret = -1, islot = -1, iuop = 0 rows
+        ev_all[d, e.shape[0]:, :, :EB] = -1
+        ev_all[d, e.shape[0]:, :, EB:EB * (1 + I)] = -1
+    Wd = max(1, (1 << R) // 32)
+    kern = _build(G_max, I, Wd, _snp(Sn), R, UP,
+                  interpret=(backend == "cpu"))
+    pspec = PartitionSpec(mesh_axis)
+    fn = shard_map(
+        lambda ev, aux: kern(ev[0], aux)[None],
+        mesh=mesh,
+        in_specs=(pspec, PartitionSpec()),
+        out_specs=pspec,
+        # pallas_call's out_shape carries no varying-mesh-axes info;
+        # the per-device program is trivially independent (no
+        # collectives), so skip the vma check rather than thread it
+        # through the kernel builder
+        check_vma=False)  # type: ignore[call-arg]
+    ev_sharded = jax.device_put(
+        ev_all, NamedSharding(mesh, pspec))
+    outs = np.asarray(fn(ev_sharded, jnp.asarray(auxbuf)))  # [D, 1, 2]
     results = []
-    for i, (dev, fk, ret_t, ops, R, Sn, G) in enumerate(pend):
-        alive = bool(outs[i, 0, 0])
+    for d, fk in enumerate(fks):
+        alive = bool(outs[d, 0, 0])
         res = {"valid?": alive, "op_count": fk.n_calls,
                "backend": backend, "engine": "wgl_deep",
-               "max_open": R, "states": Sn, "pipelined": True}
+               "max_open": int(fk.max_open), "states": int(Sn),
+               "sharded": True}
         if not alive:
             res["anomaly"] = "nonlinearizable"
-            w = map_witness(ret_t, fk, ops, int(outs[i, 0, 1]))
+            w = map_witness(rets[d], fk, histories[d].ops,
+                            int(outs[d, 0, 1]))
             if w is not None:
                 res["op"] = w[0].to_dict()
                 res["op_index"] = w[1]

@@ -2816,6 +2816,21 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
     return result
 
 
+def _stats_clock(stats: Optional[dict]):
+    """(now_fn, acc_fn) pair for the pipelines' per-stage host-time
+    decomposition — ONE definition so wgl_seg's and wgl_deep's stage
+    protocols cannot drift.  acc(key, t0) adds now-t0 to stats[key]
+    (no-op when stats is None) and returns the new t0."""
+    mt = time.monotonic
+
+    def acc(key, t0):
+        if stats is not None:
+            stats[key] = stats.get(key, 0.0) + (mt() - t0)
+        return mt()
+
+    return mt, acc
+
+
 _fill_pool_lock = threading.Lock()
 _fill_pool_inst = None
 
@@ -2878,12 +2893,7 @@ def check_pipeline(model, histories, *, max_states: int = 64,
     spec = model.device_spec()
     if spec is None:
         raise Unsupported(f"model {model!r} has no device spec")
-    _mt = time.monotonic
-
-    def _acc(key, t0):
-        if stats is not None:
-            stats[key] = stats.get(key, 0.0) + (_mt() - t0)
-        return _mt()
+    _mt, _acc = _stats_clock(stats)
     backend_name = jax.default_backend()
     n = len(histories)
     results: list = [None] * n

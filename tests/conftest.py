@@ -16,6 +16,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The deep megakernel's CPU path is the Pallas interpreter — far too
+# slow for production CPU deployments (which keep the compiled fallback
+# chain) but exactly right for the suite's tiny differential histories.
+os.environ.setdefault("JEPSEN_TPU_DEEP_INTERPRET", "1")
+
 from __graft_entry__ import _pin_virtual_cpu  # noqa: E402
 
 _pin_virtual_cpu(8)

@@ -139,3 +139,35 @@ def test_journaled_history_packs_without_walk():
     # plain histories have no free columns
     h2 = History([invoke_op(0, "read", None)])
     assert h2.packed_columns() is None
+
+
+def test_out_of_int32_process_ids_never_silently_dropped():
+    # ADVICE r4 (medium): a client process id >= 2^31 (e.g. a
+    # uuid-derived worker id) used to pack as NEMESIS, so the columnar
+    # scan dropped its ops and judged a violating history trivially
+    # valid while the object paths saw the calls.  Now the pack marks
+    # it P_OUT_OF_RANGE and every columnar ingest defers to the object
+    # walk — all paths classify identically.
+    from jepsen_tpu.history import P_OUT_OF_RANGE
+    from jepsen_tpu import models
+    from jepsen_tpu.ops import wgl_cpu, wgl_seg
+
+    big = 2 ** 33 + 7
+    ops = [invoke_op(big, "write", 1), ok_op(big, "write", 1),
+           invoke_op(big, "read", None), ok_op(big, "read", 2)]  # stale
+    h = History(ops).index()
+    pk = pack_history(h)
+    assert pk.process[0] == P_OUT_OF_RANGE
+    h.attach_packed(pk)
+    model = models.CASRegister()
+    o = wgl_cpu.check(model, h)
+    r = wgl_seg.check(model, h)
+    assert o["valid?"] is False
+    assert r["valid?"] is False           # columnar path must NOT say True
+    # the columnar scanners classify it out of scope, not client-less
+    spec = model.device_spec()
+    assert wgl_seg._native_scan_cols(pk, spec, {}, [], 10) is None
+    assert wgl_seg._native_scan_streams(pk, spec, {}, [], 10, 256) is None
+    # pipelines route it through the straggler path with the same verdict
+    res = wgl_seg.check_pipeline(model, [h])
+    assert res[0]["valid?"] is False

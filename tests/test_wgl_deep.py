@@ -92,7 +92,9 @@ class TestDeepDifferential:
             assert r["max_open"] >= 7
 
     def test_invalid_witness_equality(self):
-        for mo, frac in ((7, 0.6), (9, 0.8)):
+        # mo=10 at 0.9 depth mirrors bench.py's deep-regime refutation
+        # line (VERDICT r4 #3) at interpreter scale
+        for mo, frac in ((7, 0.6), (9, 0.8), (10, 0.9)):
             h = corrupt(deep_history(140, 14, seed=70 + mo,
                                      max_open=mo), frac)
             r = wgl_seg.check(models.CASRegister(), h,
@@ -180,3 +182,49 @@ class TestDeepDifferential:
         assert not wgl_deep.supported(8, 33, 100, True, "tpu")
         assert not wgl_deep.supported(8, 16, 100, False, "tpu")
         assert not wgl_deep.supported(8, 16, 100, True, "gpu")
+
+    def test_cpu_interpreter_is_opt_in(self, monkeypatch):
+        # ADVICE r4: on a production CPU backend the Pallas interpreter
+        # (a per-event Python loop) must NOT swallow R > 6 histories;
+        # it is opt-in for the test suite via JEPSEN_TPU_DEEP_INTERPRET
+        monkeypatch.delenv("JEPSEN_TPU_DEEP_INTERPRET", raising=False)
+        assert not wgl_deep.supported(8, 16, 100, True, "cpu")
+        monkeypatch.setenv("JEPSEN_TPU_DEEP_INTERPRET", "1")
+        assert wgl_deep.supported(8, 16, 100, True, "cpu")
+
+
+class TestDeepPipeline:
+    def test_mixed_depth_batch_stragglers(self):
+        # VERDICT r4 #2: a batch mixing in-scope deep histories with an
+        # out-of-scope R = 15 one must NOT die with ValueError — the
+        # R = 15 history rides the serial fallback chain and still gets
+        # a correct verdict, while in-scope ones stay pipelined.
+        model = models.CASRegister()
+        h8 = deep_history(100, 14, seed=210, max_open=8)
+        # deterministic R = 15 burst: 15 simultaneously-open writes
+        ops15 = [invoke_op(p, "write", p % 3) for p in range(15)]
+        ops15 += [ok_op(p, "write", p % 3) for p in range(15)]
+        ops15 += [invoke_op(0, "read", None), ok_op(0, "read", 2)]
+        h15 = History(ops15).index()
+        h15.attach_packed(pack_history(h15))
+        hbad = corrupt(deep_history(100, 14, seed=212, max_open=8), 0.7)
+        res = wgl_deep.check_pipeline(model, [h8, h15, hbad])
+        o15 = wgl_cpu.check(model, h15)
+        obad = wgl_cpu.check(model, hbad)
+        assert res[0]["valid?"] is True
+        assert res[0]["engine"] == "wgl_deep" and res[0]["pipelined"]
+        assert res[1]["valid?"] == o15["valid?"]
+        assert res[1].get("engine") != "wgl_deep"  # straggler fallback
+        assert res[2]["valid?"] is False
+        assert res[2]["engine"] == "wgl_deep"
+        assert res[2]["op_index"] == obad["op_index"]
+
+    def test_pipeline_stats_decomposition(self):
+        model = models.CASRegister()
+        hs = [deep_history(80, 12, seed=220 + s, max_open=7)
+              for s in range(2)]
+        st = {}
+        res = wgl_deep.check_pipeline(model, hs, stats=st)
+        assert all(r["valid?"] is True for r in res)
+        assert {"scan", "fetch"} <= set(st)
+        assert all(v >= 0 for v in st.values())
