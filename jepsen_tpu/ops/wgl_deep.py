@@ -370,17 +370,20 @@ def pack_events(ret_t: np.ndarray, islot_t: np.ndarray,
 
 
 def pack_events_compact(ret_t: np.ndarray, islot_t: np.ndarray,
-                        iuop_t: np.ndarray) -> tuple[np.ndarray, int]:
+                        iuop_t: np.ndarray,
+                        g_min: int = 1) -> tuple[np.ndarray, int]:
     """Compact wire twin of pack_events: the same event stream as a
     uint8 buffer — ret+1 u8[L2] (0 = the -1 sentinel; slot+1 <= R_MAX
     +1 = 15) ++ islot+1 u8[L2*I] ++ iuop u16-LE bytes[2*L2*I] — ~3.6x
     fewer bytes than the int32 form at I=2, rebuilt into the kernel's
     evbuf on device by _build_c's unpack prologue.  Padding iuops are
     clamped to 0: the kernel reads a row's uop only where its islot
-    >= 0 (registration gate), so the clamp is unobservable."""
+    >= 0 (registration gate), so the clamp is unobservable.  `g_min`
+    lets check_mesh pack a whole batch at one common grid size (the
+    sentinel rows are exact no-ops)."""
     Lp = ret_t.shape[0]
     I = islot_t.shape[2]
-    G = _pad_g((Lp + EB - 1) // EB)
+    G = max(_pad_g((Lp + EB - 1) // EB), g_min)
     L2 = G * EB
     ret = np.zeros(L2, np.uint8)
     ret[:Lp] = (ret_t[:, 0].astype(np.int32) + 1).astype(np.uint8)
@@ -562,13 +565,19 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
         R = int(fk.max_open)
         if len(rows) != U_at:
             uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
-            states, legal, next_state = wgl_seg._enumerate_states(
-                spec, init, uops, max_states)
+            try:
+                states, legal, next_state = wgl_seg._enumerate_states(
+                    spec, init, uops, max_states)
+            except wgl_seg.Unsupported:
+                # the alphabet (and with it the state space) only
+                # grows: everything from here on is a straggler —
+                # already-dispatched in-scope verdicts stay valid
+                strag.extend(range(i, len(histories)))
+                break
             Sn = states.shape[0]
             dw, cw, t0c = wgl_seg._decompose(legal, next_state)
             if dw is None:
-                # undecomposable models only grow less decomposable:
-                # everything from here on is a straggler
+                # undecomposable models only grow less decomposable
                 strag.extend(range(i, len(histories)))
                 break
             tables = wgl_seg._pack_uop_tables(legal, next_state,
@@ -679,7 +688,7 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
     I = min(2, R) if R else 1
     UP = _pad_u(a1t.shape[0])
     auxbuf = pack_aux(a1t, a2t, t0t, UP)
-    evs, rets = [], []
+    tabs, rets = [], []
     for fk in fks:
         if fk.deltas is not None:
             ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs_single(
@@ -687,20 +696,19 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
         else:
             ret_t, islot_t, iuop_t, _ = wgl_seg._pack_regs(
                 [(0, fk)], 1, R, len(rows), I)
-        evbuf, G = pack_events(ret_t, islot_t, iuop_t)
-        evs.append(evbuf)
+        tabs.append((ret_t, islot_t, iuop_t))
         rets.append(ret_t)
-    G_max = max(e.shape[0] for e in evs)
-    W = evs[0].shape[2]
-    ev_all = np.zeros((n_dev, G_max, 1, W), np.int32)
-    for d, e in enumerate(evs):
-        ev_all[d, :e.shape[0]] = e
-        # grid-padding blocks: ret = -1, islot = -1, iuop = 0 rows
-        ev_all[d, e.shape[0]:, :, :EB] = -1
-        ev_all[d, e.shape[0]:, :, EB:EB * (1 + I)] = -1
+    # one common grid size, then the COMPACT wire form per history
+    # (sentinel rows are exact no-ops) — the mesh path ships the same
+    # ~3.6x-smaller buffers as the pipelined path
+    G_max = max(_pad_g((rt.shape[0] + EB - 1) // EB)
+                for rt, _, _ in tabs)
+    cbufs = [pack_events_compact(rt, it, ut, g_min=G_max)[0]
+             for rt, it, ut in tabs]
+    ev_all = np.stack(cbufs)                     # [D, nbytes] u8
     Wd = max(1, (1 << R) // 32)
-    kern = _build(G_max, I, Wd, _snp(Sn), R, UP,
-                  interpret=(backend == "cpu"))
+    kern = _build_c(G_max, I, Wd, _snp(Sn), R, UP,
+                    interpret=(backend == "cpu"))
     pspec = PartitionSpec(mesh_axis)
     fn = shard_map(
         lambda ev, aux: kern(ev[0], aux)[None],

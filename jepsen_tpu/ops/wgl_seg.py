@@ -1641,6 +1641,83 @@ def _build_kernel_regs_group_c(B: int, K: int, L: int, Wd: int,
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=32)
+def _build_kernel_regs_many_c(K: int, L: int, Wd: int, Sn: int, R: int,
+                              decomposed: bool, rounds: int,
+                              unroll: int, U: int, Rp: int):
+    """Compact-wire twin of check_many's J=1 register kernel (I = 1):
+    the whole key batch travels as ONE uint8 buffer of key-major row
+    streams (rows u8[Rp]: ret+1 | (islot+1)<<4; iuop u8|u16[Rp]; cum
+    i32[K+1]) and the padded [L, K] tables are rebuilt on device by
+    masked gathers — the multi-key bench's padded tables were ~3x the
+    stream bytes, and on the tunneled chip the wire bounds the batch
+    wall (BENCH_r05 wire model, docs/environments.md).  Output
+    [K, 1, Sn] like the padded form."""
+    import jax
+    import jax.numpy as jnp
+
+    kern = _build_kernel_regs(K, L, 1, Wd, Sn, R, decomposed,
+                              rounds=rounds, unroll=unroll, J=1,
+                              nc=0, rn=0, compose=False)
+    ub = 1 if U <= 255 else 2
+    l_iota = np.arange(L, dtype=np.int32)[:, None]      # [L, 1]
+
+    def fn(buf8, buf32):
+        cum = jax.lax.bitcast_convert_type(
+            buf8[Rp * (1 + ub):].reshape(K + 1, 4), jnp.int32)
+        start = cum[:K]
+        nrows = cum[1:] - start
+        idx = jnp.clip(start[None, :] + l_iota, 0, Rp - 1)  # [L, K]
+        live = l_iota < nrows[None, :]
+        rows8 = jnp.where(live, buf8[:Rp][idx],
+                          jnp.uint8(0)).astype(jnp.int32)
+        ret = (rows8 & 15) - 1
+        islot = ((rows8 >> 4) - 1)[:, :, None]
+        if ub == 1:
+            iu_s = buf8[Rp:2 * Rp].astype(jnp.int32)
+        else:
+            pairs = buf8[Rp:3 * Rp].reshape(Rp, 2)
+            iu_s = (pairs[:, 0].astype(jnp.int32)
+                    | (pairs[:, 1].astype(jnp.int32) << 8))
+        iuop = jnp.where(live, iu_s[idx], jnp.int32(0))[:, :, None]
+        a1 = buf32[:U]
+        a2 = buf32[U:2 * U]
+        t0 = jax.lax.bitcast_convert_type(buf32[2 * U:3 * U],
+                                          jnp.int32)
+        return kern(ret, islot, iuop, a1, a2, t0)
+
+    return jax.jit(fn)
+
+
+def _compact_many_block(ret_t, islot_t, iuop_t, Kp: int, U: int):
+    """Compress _pack_regs' I=1 padded tables into the key-major
+    compact stream block _build_kernel_regs_many_c consumes.  Each
+    lane's live rows are a contiguous prefix (returns + spills in
+    stream order, padding after), so the block is one ragged gather."""
+    Lp = ret_t.shape[0]
+    valid = (ret_t != -1) | (islot_t[:, :, 0] != -1)    # [Lp, Kp]
+    n_rows = np.where(valid, np.arange(Lp)[:, None] + 1, 0) \
+        .max(axis=0).astype(np.int64)                   # [Kp]
+    cum = np.zeros(Kp + 1, np.int32)
+    np.cumsum(n_rows, out=cum[1:])
+    total = int(cum[-1])
+    Rp = ((total + 8191) // 8192) * 8192
+    key_of = np.repeat(np.arange(Kp), n_rows)
+    row_of = np.arange(total) - np.repeat(cum[:-1].astype(np.int64),
+                                          n_rows)
+    rows_s = np.zeros(Rp, np.uint8)
+    rows_s[:total] = (
+        (ret_t[row_of, key_of].astype(np.int32) + 1)
+        | ((islot_t[row_of, key_of, 0].astype(np.int32) + 1)
+           << 4)).astype(np.uint8)
+    ud = np.uint8 if U <= 255 else np.uint16
+    iuop_s = np.zeros(Rp, ud)
+    iuop_s[:total] = np.maximum(
+        iuop_t[row_of, key_of, 0].astype(np.int32), 0).astype(ud)
+    return np.concatenate([rows_s, iuop_s.view(np.uint8),
+                           cum.view(np.uint8)]), Rp
+
+
 def _pack_regs_single(fk, seg_ends: np.ndarray, R: int, U: int, I: int):
     """Delta-encode ONE scanned key split at `seg_ends` — the fast twin
     of _pack_regs for the single-history path.  The columnar scanner
@@ -3423,18 +3500,36 @@ def check_many(model, histories, *, max_states: int = 64,
         # deltas and let the device maintain the open set — see
         # _build_kernel_regs and the shared _regs_eligible gate.
         if _regs_eligible(int(R), int(U), int(Sn), decomposed):
-            I = min(2, int(R))
-            ret_t, islot_t, iuop_t, Lp = _pack_regs(
-                batch, Kp, int(R), int(U), I)
+            unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
             a1t, a2t, t0t = _pack_uop_tables(
                 legal, next_state, diag_w, const_w, const_t0)
-            unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
-            kern = _build_kernel_regs(Kp, int(Lp), I, max(1, M // 32),
-                                      int(Sn), int(R), decomposed,
-                                      rounds=int(R), unroll=unroll)
-            args = [ret_t, islot_t, iuop_t, a1t, a2t, t0t]
-            if mesh is not None and mesh_axis is not None:
-                args = _shard_args(mesh, mesh_axis, args, 3)
+            if mesh is None:
+                # compact wire (I = 1): the whole batch as key-major
+                # row streams, tables rebuilt on device — ~3x fewer
+                # bytes than the padded tables, and the tunnel wire
+                # bounds this batch's wall
+                I = 1
+                ret_t, islot_t, iuop_t, Lp = _pack_regs(
+                    batch, Kp, int(R), int(U), I)
+                buf8, Rp = _compact_many_block(
+                    ret_t, islot_t, iuop_t, Kp, int(U))
+                buf32 = np.concatenate(
+                    [a1t, a2t, t0t.view(np.uint32)])
+                kern = _build_kernel_regs_many_c(
+                    Kp, int(Lp), max(1, M // 32), int(Sn), int(R),
+                    decomposed, int(R), unroll, int(U), Rp)
+                args = [buf8, buf32]
+            else:
+                I = min(2, int(R))
+                ret_t, islot_t, iuop_t, Lp = _pack_regs(
+                    batch, Kp, int(R), int(U), I)
+                kern = _build_kernel_regs(
+                    Kp, int(Lp), I, max(1, M // 32),
+                    int(Sn), int(R), decomposed,
+                    rounds=int(R), unroll=unroll)
+                args = _shard_args(
+                    mesh, mesh_axis,
+                    [ret_t, islot_t, iuop_t, a1t, a2t, t0t], 3)
             t1 = time.monotonic()
             T = np.asarray(kern(*args))                  # [Kp, 1, Sn]
             t_kernel = time.monotonic() - t1

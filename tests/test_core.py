@@ -166,6 +166,7 @@ def test_hung_client_bounded_by_invoke_timeout():
     assert all("timed out" in str(o.error) for o in infos)
 
 
+@pytest.mark.slow
 def test_nemesis_run_with_crashes_checked_on_device():
     """The whole round-2 story end to end: a flaky client times out
     under invoke_timeout, the runner journals :info completions and

@@ -38,6 +38,7 @@ def test_local_session_runs_real_commands(tmp_path):
             sess.close()
 
 
+@pytest.mark.slow
 def test_kvd_suite_end_to_end_real_daemon(tmp_path):
     from jepsen_tpu.suites import kvd
 
@@ -58,6 +59,7 @@ def test_kvd_suite_end_to_end_real_daemon(tmp_path):
     assert "SET r" in body or "CAS r" in body, body[:200]
 
 
+@pytest.mark.slow
 def test_kvd_unsafe_cas_race_is_caught_by_the_checker(tmp_path):
     """The capstone of the integration tier: run the DELIBERATELY racy
     daemon (check-then-set CAS without a lock, window widened to 2 ms)

@@ -167,6 +167,7 @@ def test_packet_corrupt_retry(shim, tmp_path, monkeypatch):
     assert not flag.exists()
 
 
+@pytest.mark.slow
 def test_kvd_suite_over_ssh_shim(shim):
     """The full kvd run — real daemon, real SIGSTOP nemesis, real log
     snarf — through SSHSession instead of LocalSession."""

@@ -593,6 +593,7 @@ class TestCrateWorkloads:
         assert res["dirty-read"]["valid?"] is True, res["dirty-read"]
         assert res["dirty-read"]["on-all-count"] > 0
 
+    @pytest.mark.slow
     def test_es_dirty_read_valid_and_lost_detected(self):
         from jepsen_tpu.suites import elasticsearch as es
 
@@ -642,6 +643,7 @@ class TestCrateWorkloads:
 
 
 class TestSecondBatch:
+    @pytest.mark.slow
     def test_kv_register_suites(self):
         from jepsen_tpu.suites import (crate, hazelcast, logcabin,
                                        mysql_cluster, raftis,

@@ -219,6 +219,25 @@ class TestDeepPipeline:
         assert res[2]["engine"] == "wgl_deep"
         assert res[2]["op_index"] == obad["op_index"]
 
+    def test_state_space_growth_does_not_poison_batch(self):
+        # code-review r5: a history whose values push the enumerated
+        # state space past max_states must become a straggler (serial
+        # fallback), not abort the batch with Unsupported
+        model = models.CASRegister()
+        h8 = deep_history(80, 12, seed=230, max_open=7)
+        wide_ops = []
+        for p in range(3):
+            for v in range(p * 30, p * 30 + 28):   # 84 distinct values
+                wide_ops += [invoke_op(p, "write", v),
+                             ok_op(p, "write", v)]
+        hwide = History(wide_ops).index()
+        hwide.attach_packed(pack_history(hwide))
+        res = wgl_deep.check_pipeline(model, [h8, hwide],
+                                      max_states=64)
+        assert res[0]["valid?"] is True
+        assert res[0]["engine"] == "wgl_deep"
+        assert res[1]["valid?"] is True            # straggler verdict
+
     def test_pipeline_stats_decomposition(self):
         model = models.CASRegister()
         hs = [deep_history(80, 12, seed=220 + s, max_open=7)
