@@ -16,7 +16,11 @@ from jepsen_tpu.ops import wgl_cpu, wgl_seg
 
 
 def rand_history(seed, n_ops=80, conc=3, buggy=False, vmax=3,
-                 crash_at=None):
+                 crash_at=None, max_open=0, attach=False):
+    """The ONE random register-history generator shared by the seg
+    batteries and the fuzz battery (test_fuzz_differential).  The
+    max_open / attach options are rng-neutral when off, so every
+    pinned seed's stream is unchanged by their addition."""
     rng = random.Random(seed)
     ops, value = [], None
     open_ops = {}
@@ -26,6 +30,9 @@ def rand_history(seed, n_ops=80, conc=3, buggy=False, vmax=3,
         p = rng.randrange(conc)
         if p in open_ops:
             ops.append(open_ops.pop(p))
+            continue
+        if max_open and len(open_ops) >= max_open:
+            ops.append(open_ops.pop(rng.choice(list(open_ops))))
             continue
         i += 1
         f = rng.choice(("read", "read", "write", "cas"))
@@ -53,7 +60,11 @@ def rand_history(seed, n_ops=80, conc=3, buggy=False, vmax=3,
                 open_ops[p] = fail_op(p, "cas", [old, new])
     for c in open_ops.values():
         ops.append(c)
-    return History(ops).index()
+    h = History(ops).index()
+    if attach:
+        from jepsen_tpu.history import pack_history
+        h.attach_packed(pack_history(h))
+    return h
 
 
 class TestSingleHistory:
