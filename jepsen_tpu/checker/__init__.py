@@ -144,6 +144,9 @@ class Linearizable(Checker):
 
     _SEG_KEYS = ("max_states", "max_open_bits", "localize",
                  "target_returns_per_segment")
+    # Resilience options consumed by ops.runner.ResilientRunner on the
+    # batched path (check_many); scalar check() ignores them.
+    _RUNNER_KEYS = ("deadline_s", "max_retries", "checkpoint_dir")
 
     def _device_check(self, history):
         from jepsen_tpu.ops import wgl, wgl_seg
@@ -151,7 +154,7 @@ class Linearizable(Checker):
         seg_keys = self._SEG_KEYS
         ser_keys = ("frontier_sizes", "pad")
         unknown = (set(self.kw) - set(seg_keys) - set(ser_keys)
-                   - set(self._CPU_KEYS))
+                   - set(self._CPU_KEYS) - set(self._RUNNER_KEYS))
         if unknown:
             raise TypeError(
                 f"unknown linearizable checker option(s): "
@@ -234,21 +237,29 @@ class Linearizable(Checker):
         """Batched re-check of MANY whole histories (the `analyze
         --all` path): device-eligible models ride ONE pipelined pass
         (wgl_seg.check_pipeline — grouped transfers, one verdict
-        fetch, per-history fallbacks for out-of-scope entries);
-        everything else loops the scalar check.  Verdict-identical to
-        per-history check() either way."""
+        fetch, per-history fallbacks for out-of-scope entries),
+        executed through ops.runner.ResilientRunner so a device OOM
+        bisects instead of aborting, a corrupt history is quarantined
+        with a structured verdict, `deadline_s` degrades the tail to
+        the capped CPU oracle, and `checkpoint_dir` makes the sweep
+        resumable.  Everything else loops the scalar check.
+        Verdict-identical to per-history check() on healthy
+        histories either way."""
         spec = self.model.device_spec()
         algo = self.algorithm
         if algo == "auto":
             algo = "device" if spec is not None else "cpu"
+        runner_kw = {k: v for k, v in self.kw.items()
+                     if k in self._RUNNER_KEYS}
+        seg_kw = {k: v for k, v in self.kw.items()
+                  if k in self._SEG_KEYS}
         if algo == "device" and spec is not None \
-                and set(self.kw) <= set(self._SEG_KEYS):
-            from jepsen_tpu.ops import wgl_seg
-            try:
-                return wgl_seg.check_pipeline(self.model, histories,
-                                              **self.kw)
-            except wgl_seg.Unsupported:
-                pass
+                and set(self.kw) <= (set(self._SEG_KEYS)
+                                     | set(self._RUNNER_KEYS)):
+            from jepsen_tpu.ops import runner as runner_mod
+            return runner_mod.ResilientRunner(
+                engine="seg_pipeline", engine_kwargs=seg_kw,
+            ).check(self.model, histories, **runner_kw)
         return [self.check(test, h) for h in histories]
 
     def check(self, test, history, opts=None):
@@ -263,7 +274,9 @@ class Linearizable(Checker):
         elif algo == "device":
             a = self._device_check(history)
         elif algo == "cpu":
-            a = wgl_cpu.check(self.model, history, **self.kw)
+            a = wgl_cpu.check(self.model, history,
+                              **{k: v for k, v in self.kw.items()
+                                 if k not in self._RUNNER_KEYS})
         else:
             raise ValueError(f"unknown algorithm {algo!r}")
         if (a.get("valid?") is False and "final-paths" not in a

@@ -416,15 +416,26 @@ def run_case(test) -> History:
 
 def analyze(test) -> dict:
     """Index the history, run the checker, write results
-    (core.clj:434-451)."""
+    (core.clj:434-451).
+
+    Named tests get a verdict-checkpoint directory under their store
+    dir passed through checker opts: runner-backed checkers
+    (independent.batch_checker, Linearizable.check_many) append
+    completed per-history verdicts there as they land, so re-running a
+    killed analysis resumes instead of re-checking everything (see
+    ops/runner.py and store.read_checkpoint)."""
     log.info("Analyzing...")
     history = test["history"]
     if not isinstance(history, History):   # keep the run's journal
         history = History(history)
     history = history.index()
     test["history"] = history
+    opts: dict = {}
+    if test.get("name") and test.get("start-time"):
+        from jepsen_tpu import store
+        opts["checkpoint_dir"] = str(store.path(test, "checkpoints"))
     test["results"] = checker_mod.check_safe(
-        test["checker"], test, history)
+        test["checker"], test, history, opts)
     log.info("Analysis complete")
     if test.get("name"):
         from jepsen_tpu import store

@@ -1,0 +1,152 @@
+"""Typed error taxonomy for the checker runtime.
+
+The batch entry points (`ops.wgl_seg.check_pipeline` / `check_many`,
+`ops.wgl_deep.check_pipeline` / `check_mesh`, `ops.wgl_batch.check_many`)
+historically raised ad-hoc `ValueError`s; a production checking service
+needs to tell "the device ran out of memory" from "this history is
+malformed" from "there is no device at all", because each demands a
+different recovery (bisect-and-retry, quarantine, CPU fallback — see
+`ops.runner.ResilientRunner`).
+
+Every class subclasses `ValueError` (via `CheckError`) so pre-taxonomy
+`except ValueError` fallback chains keep working unchanged.
+
+    CheckError            base; carries history_index / seed / backend
+    ├── DeviceOOM         device RESOURCE_EXHAUSTED / allocation failure
+    ├── DeadlineExceeded  the runner's wall-clock budget expired
+    ├── BackendUnavailable no usable device path (no DeviceSpec, no
+    │                     kernel lowering for this backend, whole-batch
+    │                     out of engine scope)
+    └── CorruptHistory    a single history the engines cannot check
+                          (malformed pairing, unencodable ops) — the
+                          runner quarantines it with a structured
+                          verdict instead of aborting the batch
+
+`classify()` maps arbitrary exceptions escaping a batch engine onto the
+taxonomy; `is_oom()` recognizes XLA out-of-memory failures across JAX
+versions by type name + message markers (the `XlaRuntimeError` type
+lives in a private jaxlib module whose path has moved repeatedly, so no
+import of it is attempted).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class CheckError(ValueError):
+    """Base of the checker-runtime error taxonomy.
+
+    history_index: index (within the batch that raised) of the history
+        that reproduces the failure, when known.
+    seed: the generator seed that reproduces the history, when the
+        caller tracked one (`ResilientRunner.check(seeds=...)`).
+    backend: the jax backend the failing path ran on.
+    batch_size: size of the batch that was being dispatched.
+    """
+
+    def __init__(self, message: str, *,
+                 history_index: Optional[int] = None,
+                 seed: Optional[Any] = None,
+                 backend: Optional[str] = None,
+                 batch_size: Optional[int] = None):
+        super().__init__(message)
+        self.history_index = history_index
+        self.seed = seed
+        self.backend = backend
+        self.batch_size = batch_size
+
+    def to_dict(self) -> dict:
+        """Structured form for quarantine verdicts / checkpoints."""
+        out: dict = {"error": type(self).__name__,
+                     "message": str(self)}
+        for k in ("history_index", "seed", "backend", "batch_size"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+class DeviceOOM(CheckError):
+    """Device memory exhaustion (XLA RESOURCE_EXHAUSTED / allocation
+    failure).  Recoverable by bisecting the batch."""
+
+
+class DeadlineExceeded(CheckError):
+    """The runner's wall-clock deadline budget expired before the
+    device path finished."""
+
+
+class BackendUnavailable(CheckError):
+    """No usable device path: the model has no DeviceSpec, the backend
+    has no kernel lowering, or the whole batch is outside every device
+    engine's scope.  Recoverable by the CPU oracle."""
+
+
+class CorruptHistory(CheckError):
+    """A single history the engines cannot check at all (malformed
+    invoke/return pairing, unencodable values).  The runner quarantines
+    it; it is never retried."""
+
+
+# Message markers of an XLA device-memory failure.  Matched
+# case-insensitively against the stringified exception.
+_OOM_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "failed to allocate",
+    "allocation failure",
+    "oom",
+)
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True when `exc` looks like a device out-of-memory failure.
+
+    Matches by type name (`XlaRuntimeError` lives in a private jaxlib
+    module whose import path has moved across releases, so it is never
+    imported) plus message markers; a plain `MemoryError` and an
+    explicit `DeviceOOM` also qualify."""
+    if isinstance(exc, (DeviceOOM, MemoryError)):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+def classify(exc: BaseException, *,
+             history_index: Optional[int] = None,
+             seed: Optional[Any] = None,
+             backend: Optional[str] = None,
+             batch_size: Optional[int] = None) -> CheckError:
+    """Map an exception escaping a batch engine onto the taxonomy.
+
+    Already-typed errors pass through (with the reproducing context
+    filled in if they lacked it); `wgl_seg.Unsupported` — whole-engine
+    out of scope — becomes BackendUnavailable; OOM-shaped failures
+    become DeviceOOM; other ValueError/Key/Index/AssertionErrors (the
+    shapes prepare()/scan raise on malformed histories) become
+    CorruptHistory; anything else is a bare CheckError."""
+    if isinstance(exc, CheckError) and type(exc).__name__ != "Unsupported":
+        if exc.history_index is None:
+            exc.history_index = history_index
+        if exc.seed is None:
+            exc.seed = seed
+        if exc.backend is None:
+            exc.backend = backend
+        if exc.batch_size is None:
+            exc.batch_size = batch_size
+        return exc
+    ctx = dict(history_index=history_index, seed=seed, backend=backend,
+               batch_size=batch_size)
+    if type(exc).__name__ == "Unsupported":
+        err: CheckError = BackendUnavailable(str(exc), **ctx)
+    elif is_oom(exc):
+        err = DeviceOOM(str(exc), **ctx)
+    elif isinstance(exc, (ValueError, KeyError, IndexError, TypeError,
+                          AssertionError)):
+        err = CorruptHistory(f"{type(exc).__name__}: {exc}", **ctx)
+    else:
+        err = CheckError(f"{type(exc).__name__}: {exc}", **ctx)
+    err.__cause__ = exc
+    return err

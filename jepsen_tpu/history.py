@@ -210,7 +210,15 @@ class History:
         (bench corruption planters, test fixtures) would otherwise
         feed stale columns to the native scanners while the Python
         oracle sees the new values — a verdict-divergence footgun
-        (ADVICE r3)."""
+        (ADVICE r3).
+
+        Also bumps the attached PackedHistory's `version` counter, so
+        any alias still holding that instance (e.g. a scanner that
+        cached its contiguous casts in `_scan_cols`) recomputes
+        instead of reading stale derived arrays — see the
+        PackedHistory docstring."""
+        if self._packed is not None:
+            self._packed.version += 1
         self._packed = None
 
     def packed_columns(self) -> Optional["PackedHistory"]:
@@ -321,6 +329,15 @@ class PackedHistory:
     "history transport to device").  Two int64 value slots cover every
     built-in workload (cas carries [old, new]); richer payloads stay
     host-side.  value_ok marks slots that held encodable (integer) values.
+
+    Derived-cast caching: the native scanners cache their contiguous
+    int32/uint8 casts of these columns on the instance (the
+    `_scan_cols` attribute, built by `ops.wgl_seg._cols_args`), keyed
+    by `(version, len)`.  Code that mutates the column arrays IN PLACE
+    must bump `version` (History.invalidate_packed() does this for the
+    attached instance) or the cached casts go stale while the Python
+    oracle sees the new values — a verdict-divergence footgun.  A
+    length change invalidates on its own.
     """
 
     index: np.ndarray       # int32 [n]
@@ -337,6 +354,9 @@ class PackedHistory:
     # was packed with a custom value_encoder (the scan then falls back
     # to the Op-object walk, which sees the real values).
     vkind: Optional[np.ndarray] = None  # uint8 [n]
+    # Mutation counter guarding derived-cast caches (see class
+    # docstring): bump after any in-place column edit.
+    version: int = 0
 
     def __len__(self):
         return len(self.index)

@@ -261,29 +261,45 @@ class BatchedLinearizableChecker(ck.Checker):
     first (ops/wgl_seg.check_many — dense configuration space, no
     sorting, exact; crash-free keys with small state spaces), whose
     per-key fallback escalates out-of-scope keys to the sorted frontier
-    kernel (ops/wgl) and then the CPU oracle.  A model with no device
-    spec at all is checked entirely by the CPU oracle, key by key."""
+    kernel (ops/wgl) and then the CPU oracle.  The batch dispatch runs
+    through ops.runner.ResilientRunner, so a device OOM on a wide key
+    axis bisects instead of aborting, one poisoned key is quarantined
+    with a structured verdict, and — when the analysis phase provides
+    opts['checkpoint_dir'] — completed per-key verdicts checkpoint to
+    the store and a killed analysis resumes.  A model with no device
+    spec at all degrades to the CPU oracle, key by key (the runner's
+    BackendUnavailable path)."""
 
-    def __init__(self, model, frontier_size: int = 256, mesh=None):
+    def __init__(self, model, frontier_size: int = 256, mesh=None,
+                 deadline_s: Optional[float] = None,
+                 max_retries: int = 2):
         self.model = model
         self.frontier_size = frontier_size  # advisory; kept for API compat
         self.mesh = mesh
+        self.deadline_s = deadline_s
+        self.max_retries = max_retries
 
     def check(self, test, history, opts=None):
-        from jepsen_tpu.ops import wgl_cpu, wgl_seg
+        import os as _os
+
+        from jepsen_tpu.ops import runner as runner_mod
 
         ks = sorted(history_keys(history), key=repr)
         if not ks:
             return {"valid?": True, "results": {}, "failures": []}
         subs = [subhistory(k, history) for k in ks]
-        try:
-            per_key = wgl_seg.check_many(
-                self.model, subs, mesh=self.mesh,
-                mesh_axis=self.mesh.axis_names[0] if self.mesh else None)
-        except wgl_seg.Unsupported:
-            # Only raised when the model has no device spec (wgl_batch
-            # would need one too) — exact CPU oracle per key.
-            per_key = [wgl_cpu.check(self.model, s) for s in subs]
+        ckdir = (opts or {}).get("checkpoint_dir")
+        per_key = runner_mod.ResilientRunner(
+            engine="seg_many",
+            engine_kwargs=dict(
+                mesh=self.mesh,
+                mesh_axis=(self.mesh.axis_names[0]
+                           if self.mesh else None)),
+            deadline_s=self.deadline_s,
+            max_retries=self.max_retries,
+            checkpoint_dir=(_os.path.join(str(ckdir), DIR)
+                            if ckdir else None),
+        ).check(self.model, subs)
         results = dict(zip(ks, per_key))
         failures = [k for k, r in results.items() if r["valid?"] is not True]
         # Failing-window SVGs under independent/<k>/, matching the

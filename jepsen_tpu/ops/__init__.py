@@ -5,4 +5,7 @@
   wgl.py      batched frontier WGL search on TPU — the centerpiece
   fold.py     masked segmented reductions for O(n) checkers
   cycle.py    dependency-graph reachability / SCC via bool matmul
+  runner.py   resilient execution layer around the batch entry points
+              (OOM bisection, deadline-bounded CPU fallback,
+              retry/quarantine, resumable verdict checkpoints)
 """

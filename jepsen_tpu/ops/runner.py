@@ -1,0 +1,363 @@
+"""Resilient checker runtime: fault-tolerant execution around the
+batch entry points (`wgl_seg.check_pipeline` / `check_many`,
+`wgl_deep.check_pipeline` / `check_mesh`, `wgl_batch.check_many`).
+
+Long device-bound verification runs over large multi-history batches
+fail the way inference stacks fail, not the way unit tests fail: one
+`RESOURCE_EXHAUSTED` on a big batch, one hung compile, or one corrupted
+history in ten thousand must not abort the run and discard every
+completed verdict.  `ResilientRunner.check` gives every batch entry
+point the same robustness contract:
+
+  * **OOM-adaptive batch splitting** — a device OOM
+    (`errors.is_oom`) bisects the batch and retries the halves with
+    exponential backoff + deterministic jitter, down to per-history
+    granularity; a single history that still OOMs after `max_retries`
+    is quarantined with a structured verdict instead of raising.
+  * **Poison isolation** — a non-OOM engine failure on a multi-history
+    batch also bisects (no backoff: the failure is deterministic), so
+    one corrupt history costs one quarantine verdict, not the batch.
+  * **Deadline budget with graceful degradation** — when the device
+    path exceeds `deadline_s`, every remaining history degrades to the
+    capped CPU oracle (`wgl_cpu.check(time_limit=...)`), each verdict
+    tagged with the backend that produced it and
+    `fallback: "deadline"`.
+  * **Resumable verdict checkpoints** — with `checkpoint_dir`, each
+    completed per-history verdict is appended (fsynced) to
+    `<dir>/verdicts.jsonl` via `jepsen_tpu.store` as it lands; a killed
+    run resumes by re-checking only histories without a
+    digest-matching checkpoint record.
+
+Error classification lives in `jepsen_tpu.errors` (CheckError ->
+DeviceOOM / DeadlineExceeded / BackendUnavailable / CorruptHistory);
+`BackendUnavailable` (no DeviceSpec, no kernel lowering) short-circuits
+the whole remaining batch to the CPU oracle rather than bisecting —
+halving a batch cannot conjure a device.
+
+`clock` / `sleep` are injectable so the fault-injection tests drive
+deadlines and observe backoff without wall-clock waits.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+import zlib
+from typing import Any, Callable, Optional, Sequence
+
+from jepsen_tpu import errors as errors_mod
+from jepsen_tpu import store
+from jepsen_tpu.errors import (BackendUnavailable, CheckError,
+                               CorruptHistory, DeviceOOM)
+
+log = logging.getLogger("jepsen")
+
+_UNSET = object()
+
+
+def _resolve_engine(engine) -> Callable:
+    """Engine name -> batch callable `(model, histories, **kw) -> list`.
+    A callable passes through (the fault-injection tests hand in
+    wrapped/synthetic engines)."""
+    if callable(engine):
+        return engine
+    from jepsen_tpu.ops import wgl_batch, wgl_deep, wgl_seg
+    table = {
+        "auto": wgl_seg.check_pipeline,
+        "seg_pipeline": wgl_seg.check_pipeline,
+        "seg_many": wgl_seg.check_many,
+        "deep_pipeline": wgl_deep.check_pipeline,
+        "deep_mesh": wgl_deep.check_mesh,
+        "batch_many": wgl_batch.check_many,
+    }
+    try:
+        return table[engine]
+    except KeyError:
+        raise ValueError(f"unknown runner engine {engine!r}; one of "
+                         f"{sorted(table)} or a callable") from None
+
+
+def history_digest(h) -> str:
+    """Cheap positional fingerprint of a history, used to key verdict
+    checkpoints: resume only trusts a stored verdict whose digest
+    matches the history at the same batch index, so reordered or
+    edited batches re-check rather than mis-attribute."""
+    ops = getattr(h, "ops", None)
+    if ops is None:
+        ops = getattr(h, "calls", None)
+    if ops is None:
+        try:
+            ops = list(h)
+        except TypeError:
+            ops = [repr(h)]
+    c = zlib.crc32(str(len(ops)).encode())
+    for o in ops:
+        key = (getattr(o, "index", None), getattr(o, "process", None),
+               getattr(o, "type", None), getattr(o, "f", None),
+               getattr(o, "value", None))
+        c = zlib.crc32(repr(key).encode(), c)
+    return f"{c:08x}"
+
+
+class ResilientRunner:
+    """Fault-tolerant wrapper around one batch checking engine.
+
+    engine: an engine name ("auto"/"seg_pipeline"/"seg_many"/
+        "deep_pipeline"/"deep_mesh"/"batch_many") or a callable
+        `(model, histories, **engine_kwargs) -> list of verdict dicts`.
+    engine_kwargs: passed through to the engine on every dispatch.
+    max_retries: OOM retries per single history before quarantine.
+    deadline_s: wall-clock budget; past it, remaining histories degrade
+        to the capped CPU oracle.
+    checkpoint_dir: directory for `verdicts.jsonl` (see module doc).
+    max_group: largest batch dispatched at once — bounds both the OOM
+        blast radius and the checkpoint granularity (verdicts land
+        after each group).
+    backoff_base_s / backoff_cap_s / jitter_seed: retry backoff shape;
+        jitter is DETERMINISTIC in (jitter_seed, history index,
+        attempt) so failures replay identically.
+    cpu_slice_floor_s: minimum per-history time_limit handed to the
+        CPU oracle on deadline fallback, so a blown budget still makes
+        bounded forward progress instead of checking nothing.
+    clock / sleep: injectable for tests.
+    """
+
+    def __init__(self, *, engine="auto",
+                 engine_kwargs: Optional[dict] = None,
+                 max_retries: int = 2,
+                 deadline_s: Optional[float] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 max_group: int = 32,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 jitter_seed: int = 0,
+                 cpu_slice_floor_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.engine = engine
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.max_retries = max_retries
+        self.deadline_s = deadline_s
+        self.checkpoint_dir = checkpoint_dir
+        self.max_group = max(1, int(max_group))
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.jitter_seed = jitter_seed
+        self.cpu_slice_floor_s = cpu_slice_floor_s
+        self.clock = clock
+        self.sleep = sleep
+
+    # -- backoff ------------------------------------------------------------
+
+    def _jitter(self, key: int, attempt: int) -> float:
+        """Deterministic jitter fraction in [0, 1): crc32 of the
+        (seed, history key, attempt) triple — stable across processes
+        (unlike hash()) so a failure replays with identical timing."""
+        h = zlib.crc32(f"{self.jitter_seed}:{key}:{attempt}".encode())
+        return (h % 1024) / 1024.0
+
+    def backoff_s(self, key: int, attempt: int) -> float:
+        """Exponential backoff with deterministic jitter, capped."""
+        base = min(self.backoff_base_s * (2 ** max(attempt - 1, 0)),
+                   self.backoff_cap_s)
+        return base * (0.5 + self._jitter(key, attempt))
+
+    # -- verdict shaping ----------------------------------------------------
+
+    @staticmethod
+    def _backend() -> str:
+        try:
+            import jax
+            return jax.default_backend()
+        except Exception:           # noqa: BLE001 - tagging must not raise
+            return "unknown"
+
+    @staticmethod
+    def _quarantine(err: CheckError, i: int, seed=None) -> dict:
+        """The structured verdict a poisoned history gets instead of
+        aborting the batch.  'unknown' merges through the checker
+        validity lattice without masking real invalids."""
+        v: dict = {"valid?": "unknown", "quarantined": True,
+                   "history_index": i}
+        if seed is not None:
+            v["seed"] = seed
+        v.update(err.to_dict())
+        return v
+
+    # -- the runner ---------------------------------------------------------
+
+    def check(self, model, histories: Sequence, *,
+              deadline_s=_UNSET, max_retries=_UNSET,
+              checkpoint_dir=_UNSET,
+              seeds: Optional[Sequence[Any]] = None) -> list:
+        """Check `histories` through the configured engine with OOM
+        bisection, retry/quarantine, deadline-bounded CPU fallback, and
+        checkpoint/resume.  Always returns one verdict dict per
+        history, in order; never raises for a per-history failure."""
+        if deadline_s is _UNSET:
+            deadline_s = self.deadline_s
+        if max_retries is _UNSET:
+            max_retries = self.max_retries
+        if checkpoint_dir is _UNSET:
+            checkpoint_dir = self.checkpoint_dir
+        engine_fn = _resolve_engine(self.engine)
+        n = len(histories)
+        results: list = [None] * n
+        backend = self._backend()
+
+        def seed_of(i):
+            return seeds[i] if seeds is not None and i < len(seeds) \
+                else None
+
+        # -- resume --------------------------------------------------------
+        ckpt_file = None
+        digests: Optional[list] = None
+        if checkpoint_dir:
+            ckpt_file = store.checkpoint_path(checkpoint_dir)
+            digests = [history_digest(h) for h in histories]
+            for rec in store.read_checkpoint(ckpt_file):
+                i = rec.get("i")
+                if (isinstance(i, int) and 0 <= i < n
+                        and results[i] is None
+                        and rec.get("digest") == digests[i]
+                        and isinstance(rec.get("verdict"), dict)):
+                    v = dict(rec["verdict"])
+                    v["resumed"] = True
+                    results[i] = v
+
+        def record(i: int) -> None:
+            if ckpt_file is not None:
+                store.append_checkpoint(
+                    ckpt_file, {"i": i, "digest": digests[i],
+                                "verdict": results[i]})
+
+        pending = [i for i in range(n) if results[i] is None]
+        start = self.clock()
+
+        def remaining() -> Optional[float]:
+            return None if deadline_s is None \
+                else deadline_s - (self.clock() - start)
+
+        # LIFO work stack of (indices, attempt); seeded with groups of
+        # <= max_group in order, so verdicts (and checkpoints) land
+        # roughly front-to-back.
+        stack: list = []
+        for k in range(0, len(pending), self.max_group):
+            stack.append((pending[k:k + self.max_group], 0))
+        stack.reverse()
+
+        cpu_rest: list = []          # indices degrading to the oracle
+        fallback_cause: Optional[str] = None
+
+        while stack:
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                for idxs, _ in stack:
+                    cpu_rest.extend(idxs)
+                stack = []
+                fallback_cause = "deadline"
+                log.warning("runner deadline (%ss) exceeded with %d "
+                            "histories left; degrading to CPU oracle",
+                            deadline_s, len(cpu_rest))
+                break
+            idxs, attempt = stack.pop()
+            if attempt:
+                self.sleep(self.backoff_s(idxs[0], attempt))
+            try:
+                rs = engine_fn(model, [histories[i] for i in idxs],
+                               **self.engine_kwargs)
+            except Exception as e:   # noqa: BLE001 - classified below
+                err = errors_mod.classify(
+                    e, backend=backend, batch_size=len(idxs),
+                    history_index=idxs[0] if len(idxs) == 1 else None,
+                    seed=seed_of(idxs[0]) if len(idxs) == 1 else None)
+                if isinstance(err, BackendUnavailable):
+                    # No device path at all: bisection cannot help;
+                    # everything still queued degrades to the oracle.
+                    cpu_rest.extend(idxs)
+                    for rest_idxs, _ in stack:
+                        cpu_rest.extend(rest_idxs)
+                    stack = []
+                    fallback_cause = "backend-unavailable"
+                    log.info("device path unavailable (%s); checking "
+                             "%d histories on the CPU oracle",
+                             err, len(cpu_rest))
+                    break
+                if len(idxs) > 1:
+                    # Bisect to isolate; only OOM escalates the attempt
+                    # counter (and with it the backoff) — a
+                    # deterministic poison gains nothing from waiting.
+                    mid = len(idxs) // 2
+                    nxt = attempt + 1 if isinstance(err, DeviceOOM) \
+                        else attempt
+                    log.warning("batch of %d failed (%s: %s); "
+                                "bisecting", len(idxs),
+                                type(err).__name__, err)
+                    stack.append((idxs[mid:], nxt))
+                    stack.append((idxs[:mid], nxt))
+                    continue
+                i = idxs[0]
+                if isinstance(err, DeviceOOM) and attempt < max_retries:
+                    stack.append((idxs, attempt + 1))
+                    continue
+                log.warning("quarantining history %d after %d "
+                            "attempt(s): %s: %s", i, attempt + 1,
+                            type(err).__name__, err)
+                results[i] = self._quarantine(err, i, seed_of(i))
+                record(i)
+                continue
+            for i, r in zip(idxs, rs):
+                if r is None:
+                    results[i] = self._quarantine(
+                        CorruptHistory("engine returned no verdict",
+                                       history_index=i,
+                                       backend=backend),
+                        i, seed_of(i))
+                else:
+                    r = dict(r)
+                    r.setdefault("backend", backend)
+                    if attempt:
+                        r["runner_attempts"] = attempt + 1
+                    results[i] = r
+                record(i)
+
+        # -- CPU degradation ----------------------------------------------
+        if cpu_rest:
+            from jepsen_tpu.ops import wgl_cpu
+            rem = remaining()
+            slice_s = None
+            if deadline_s is not None:
+                # split what's left of the budget evenly, floored so a
+                # blown budget still makes bounded progress per history
+                slice_s = max(self.cpu_slice_floor_s,
+                              max(rem or 0.0, 0.0) / len(cpu_rest))
+            for i in cpu_rest:
+                try:
+                    r = dict(wgl_cpu.check(model, histories[i],
+                                           time_limit=slice_s))
+                    r["backend"] = "cpu"
+                    r.setdefault("engine", "wgl_cpu")
+                    if fallback_cause:
+                        r["fallback"] = fallback_cause
+                    results[i] = r
+                except Exception as e:  # noqa: BLE001 - quarantine
+                    err = errors_mod.classify(
+                        e, history_index=i, seed=seed_of(i),
+                        backend="cpu", batch_size=1)
+                    results[i] = self._quarantine(err, i, seed_of(i))
+                record(i)
+        return results
+
+
+def check(model, histories: Sequence, *, engine="auto",
+          engine_kwargs: Optional[dict] = None,
+          deadline_s: Optional[float] = None, max_retries: int = 2,
+          checkpoint_dir: Optional[str] = None,
+          seeds: Optional[Sequence[Any]] = None, **runner_kw) -> list:
+    """One-shot convenience: `runner.check(model, histories, ...)`
+    without holding a ResilientRunner."""
+    return ResilientRunner(
+        engine=engine, engine_kwargs=engine_kwargs, **runner_kw,
+    ).check(model, histories, deadline_s=deadline_s,
+            max_retries=max_retries, checkpoint_dir=checkpoint_dir,
+            seeds=seeds)

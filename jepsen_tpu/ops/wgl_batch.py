@@ -25,6 +25,7 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
+from jepsen_tpu.errors import BackendUnavailable
 from jepsen_tpu.ops import frontier
 from jepsen_tpu.ops.prep import prepare
 from jepsen_tpu.ops.wgl import WGLPlan, _bucket, plan
@@ -146,7 +147,7 @@ def check_many(model, histories: Sequence, *,
 
     spec = model.device_spec()
     if spec is None:
-        raise ValueError(f"model {model!r} has no device spec")
+        raise BackendUnavailable(f"model {model!r} has no device spec")
 
     preps = [h if hasattr(h, "calls") else prepare(h) for h in histories]
     results: list[Optional[dict]] = [None] * len(preps)

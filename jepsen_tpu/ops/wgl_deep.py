@@ -64,6 +64,8 @@ from typing import Any
 
 import numpy as np
 
+from jepsen_tpu.errors import BackendUnavailable, CheckError
+
 # Intra-word "lacks bit b" patterns: bit i set iff mask-index i has
 # bit b clear (shared constant with ops.frontier._INTRA).
 _INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
@@ -306,6 +308,13 @@ def _build(G: int, I: int, Wd: int, SnP: int, R: int, UP: int,
         out_ref[0, 0] = 1 - flags[0]
         out_ref[0, 1] = flags[1]
 
+    # Version-drift shim (same class, renamed across Pallas releases:
+    # TPUCompilerParams on older jax, CompilerParams on newer) — the
+    # kernel must degrade across the drift, not AttributeError
+    # (ADVICE r5's check_vma lesson, applied to the whole build path).
+    _params_cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+
     def kern(evbuf, auxbuf):
         return pl.pallas_call(
             kernel,
@@ -329,7 +338,7 @@ def _build(G: int, I: int, Wd: int, SnP: int, R: int, UP: int,
                 pltpu.SMEM((R,), np.int32),         # openr
                 pltpu.SMEM((2,), np.int32),         # flags
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_params_cls(
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
         )(evbuf, auxbuf)
@@ -459,7 +468,8 @@ def dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
 
     backend = jax.default_backend()
     if backend not in ("tpu", "cpu"):
-        raise RuntimeError(f"no deep-kernel lowering for {backend}")
+        raise BackendUnavailable(
+            f"no deep-kernel lowering for {backend}", backend=backend)
     I = islot_t.shape[2]
     UP = _pad_u(a1t.shape[0])
     cbuf, G = pack_events_compact(ret_t, islot_t, iuop_t)
@@ -538,7 +548,7 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
 
     spec = model.device_spec()
     if spec is None:
-        raise ValueError(f"model {model!r} has no device spec")
+        raise BackendUnavailable(f"model {model!r} has no device spec")
     _mt, _acc = wgl_seg._stats_clock(stats)
     backend = jax.default_backend()
     pend = []
@@ -650,27 +660,32 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
-    shard_map = jax.shard_map
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:        # pre-export-move JAX releases
+        from jax.experimental.shard_map import shard_map
 
     from jepsen_tpu.ops import wgl_seg
 
     spec = model.device_spec()
     if spec is None:
-        raise ValueError(f"model {model!r} has no device spec")
+        raise BackendUnavailable(f"model {model!r} has no device spec")
     backend = jax.default_backend()
     n_dev = int(np.prod(mesh.devices.shape))
     if len(histories) != n_dev:
-        raise ValueError(f"one history per device: got "
-                         f"{len(histories)} histories, {n_dev} devices")
+        raise CheckError(f"one history per device: got "
+                         f"{len(histories)} histories, {n_dev} devices",
+                         batch_size=len(histories), backend=backend)
     seen: dict = {}
     rows: list = []
     init = np.asarray(spec.encode(model), np.int32)
     fks = []
-    for h in histories:
+    for d, h in enumerate(histories):
         fk = wgl_seg._scan_history(h, h.ops, spec, seen, rows,
                                    max_open_bits, want_snaps=False)
         if not fk:
-            raise ValueError("history out of deep-kernel scope (scan)")
+            raise CheckError("history out of deep-kernel scope (scan)",
+                             history_index=d, backend=backend)
         fks.append(fk)
     uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
     states, legal, next_state = wgl_seg._enumerate_states(
@@ -678,13 +693,14 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
     Sn = states.shape[0]
     dw, cw, t0c = wgl_seg._decompose(legal, next_state)
     if dw is None:
-        raise ValueError("model not decomposable")
+        raise CheckError("model not decomposable", backend=backend)
     a1t, a2t, t0t = wgl_seg._pack_uop_tables(legal, next_state,
                                              dw, cw, t0c)
     R = max(int(fk.max_open) for fk in fks)
     if not supported(R, Sn, len(rows), True, backend):
-        raise ValueError(
-            f"batch out of deep-kernel scope (R={R}, Sn={Sn})")
+        raise CheckError(
+            f"batch out of deep-kernel scope (R={R}, Sn={Sn})",
+            backend=backend)
     I = min(2, R) if R else 1
     UP = _pad_u(a1t.shape[0])
     auxbuf = pack_aux(a1t, a2t, t0t, UP)
@@ -710,16 +726,29 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
     kern = _build_c(G_max, I, Wd, _snp(Sn), R, UP,
                     interpret=(backend == "cpu"))
     pspec = PartitionSpec(mesh_axis)
-    fn = shard_map(
-        lambda ev, aux: kern(ev[0], aux)[None],
-        mesh=mesh,
-        in_specs=(pspec, PartitionSpec()),
-        out_specs=pspec,
-        # pallas_call's out_shape carries no varying-mesh-axes info;
-        # the per-device program is trivially independent (no
-        # collectives), so skip the vma check rather than thread it
-        # through the kernel builder
-        check_vma=False)  # type: ignore[call-arg]
+    _body = lambda ev, aux: kern(ev[0], aux)[None]  # noqa: E731
+    _specs = dict(mesh=mesh, in_specs=(pspec, PartitionSpec()),
+                  out_specs=pspec)
+    # pallas_call's out_shape carries no varying-mesh-axes info; the
+    # per-device program is trivially independent (no collectives), so
+    # the vma/rep check must be skipped rather than threaded through
+    # the kernel builder.  The kwarg spelling is version-sensitive
+    # (check_vma on newer JAX, check_rep on 0.4.x, where the default
+    # check also has no pallas_call rule at all), so degrade through
+    # the spellings on unknown-kwarg TypeError instead of raising
+    # (ADVICE r5).
+    fn = None
+    for kwarg in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            fn = shard_map(_body, **_specs,
+                           **kwarg)  # type: ignore[call-arg]
+            break
+        except TypeError:
+            continue
+    if fn is None:
+        raise BackendUnavailable(
+            "jax.shard_map rejected every known kwarg spelling",
+            backend=backend)
     ev_sharded = jax.device_put(
         ev_all, NamedSharding(mesh, pspec))
     outs = np.asarray(fn(ev_sharded, jnp.asarray(auxbuf)))  # [D, 1, 2]

@@ -75,6 +75,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from jepsen_tpu.errors import CheckError
 from jepsen_tpu.history import History, PackedHistory
 from jepsen_tpu.models import DeviceSpec
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
@@ -82,9 +83,12 @@ from jepsen_tpu.ops.frontier import (make_plane_ops as _bit_ops,
                                      reshape_shift as _reshape_shift)
 
 
-class Unsupported(ValueError):
+class Unsupported(CheckError):
     """This history/model cannot use the segment-parallel engine; use
-    ops.wgl (device serial) or ops.wgl_cpu instead."""
+    ops.wgl (device serial) or ops.wgl_cpu instead.  Part of the
+    jepsen_tpu.errors taxonomy (still a ValueError via CheckError);
+    errors.classify maps it to BackendUnavailable when a whole batch
+    falls out of device scope."""
 
 
 # ---------------------------------------------------------------------------
@@ -502,9 +506,17 @@ def _cols_args(packed, spec):
                         np.int32(-1)).astype(np.int32, copy=False)
     # The spec-INDEPENDENT contiguous casts (the int32 value columns
     # are ~2 ms per 100k-op history) are a pure representation
-    # transform of the immutable packed journal — cache them on it,
-    # like packed_columns() itself; only fmap depends on the spec.
-    fixed = getattr(packed, "_scan_cols", None)
+    # transform of the packed journal — cache them on it, like
+    # packed_columns() itself; only fmap depends on the spec.  The
+    # cache is GUARDED by (packed.version, len(packed)): in-place
+    # column mutators bump `version` via History.invalidate_packed()
+    # (or PackedHistory directly), and a length change (journal grew
+    # between scans) also invalidates — a stale cache here would feed
+    # the native scanners columns the Python oracle no longer sees.
+    tag = (getattr(packed, "version", 0), len(packed))
+    cached = getattr(packed, "_scan_cols", None)
+    fixed = cached[1] if cached is not None and cached[0] == tag \
+        else None
     if fixed is None:
         fixed = (np.ascontiguousarray(packed.process, dtype=np.int32),
                  np.ascontiguousarray(packed.type, dtype=np.uint8),
@@ -513,7 +525,7 @@ def _cols_args(packed, spec):
                  np.ascontiguousarray(packed.value[:, 1].astype(
                      np.int32)),
                  np.ascontiguousarray(packed.vkind, dtype=np.uint8))
-        packed._scan_cols = fixed
+        packed._scan_cols = (tag, fixed)
     return (fixed[0], fixed[1], np.ascontiguousarray(fmap),
             fixed[2], fixed[3], fixed[4])
 
@@ -581,6 +593,13 @@ def _native_scan_streams(packed, spec, seen: dict, rows: list,
     unavailable, None when out of scope, else a _StreamKey."""
     from jepsen_tpu import native
 
+    # Scope check FIRST, mirroring _native_scan_cols: a custom
+    # encode_op is out of SCOPE for the C scanners (None — callers
+    # must not retry other native forms), not merely unavailable
+    # (False).  Checking module availability first conflated the two
+    # sentinels whenever the extension was missing (ADVICE r5).
+    if getattr(spec, "encode_op", None) is not None:
+        return None
     mod = native.histscan()
     if mod is None or not hasattr(mod, "fast_scan_streams"):
         return False                 # cheap check BEFORE the casts

@@ -221,6 +221,59 @@ def _relink(link: Path, target: Path) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Resumable verdict checkpoints (ops/runner.py)
+# ---------------------------------------------------------------------------
+#
+# Layout: <checkpoint_dir>/verdicts.jsonl, one record per COMPLETED
+# per-history verdict, appended (and flushed + fsynced) as each lands:
+#
+#     {"i": <batch index>, "digest": <history fingerprint>,
+#      "verdict": {...}}
+#
+# A killed run leaves at worst one truncated trailing line, which
+# read_checkpoint skips — every fully-written verdict survives and the
+# re-run checks only the remainder.  For named tests the runner's
+# checkpoint_dir defaults to store/<name>/<timestamp>/checkpoints/
+# (core.analyze wires it through checker opts).
+
+def checkpoint_path(checkpoint_dir) -> Path:
+    """Canonical verdict-checkpoint file inside a checkpoint dir — one
+    definition shared by the runner and anything inspecting store/."""
+    return Path(checkpoint_dir) / "verdicts.jsonl"
+
+
+def append_checkpoint(path, record: dict) -> None:
+    """Append one JSON record and force it to disk: a verdict is only
+    a checkpoint if it survives a kill -9 mid-batch."""
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    line = json.dumps(_jsonable_tree(record), default=repr)
+    with open(p, "a") as f:
+        f.write(line + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_checkpoint(path) -> list[dict]:
+    """All parseable records; a truncated final line (killed mid-write)
+    is skipped rather than poisoning the resume."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    out = []
+    with open(p) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Logging (store.clj:394-422)
 # ---------------------------------------------------------------------------
 
