@@ -22,9 +22,11 @@ options collected into an `ssh` submap (cli.clj:200-216).
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import sys
 import traceback
+from pathlib import Path
 from typing import Callable, Optional
 
 from jepsen_tpu import core, store
@@ -205,6 +207,64 @@ def analyze_all_cmd(test_fn: Callable[[dict], dict], opts) -> int:
     return worst
 
 
+def recover_store_dir(store_dir):
+    """Rebuild a dead run's history files from its WAL.
+
+    `store_dir` is a store/<name>/<ts>/ directory (or a history.wal
+    path).  history.recover closes open invocations as :info and the
+    result overwrites history.jsonl / history.txt — the files `analyze`
+    and `store.load` read — plus a recovery.json breadcrumb with the
+    recovery stats.  Returns (stats, History, run_dir)."""
+    from jepsen_tpu import history as history_mod
+    d = Path(store_dir)
+    wal = d if d.is_file() else d / "history.wal"
+    if not wal.exists():
+        raise FileNotFoundError(f"no history.wal under {store_dir}")
+    h = history_mod.recover(wal)
+    run_dir = wal.parent
+    with open(run_dir / "history.txt", "w") as f:
+        for op in h:
+            f.write(str(op) + "\n")
+    with open(run_dir / "history.jsonl", "w") as f:
+        f.write(h.to_jsonl())
+    stats = dict(h.recovery, wal=str(wal), history_len=len(h))
+    with open(run_dir / "recovery.json", "w") as f:
+        json.dump(stats, f, indent=2)
+    return stats, h, run_dir
+
+
+def recover_cmd(opts, test_fn: Optional[Callable] = None) -> int:
+    """`recover <store-dir>`: re-animate a SIGKILLed run from its
+    history WAL (cf. ISSUE 2's crash-safe run phase).  Standalone
+    (python -m jepsen_tpu.cli recover) it rebuilds the history files;
+    from a suite binary (single_test_cmd) it also re-runs analysis with
+    the suite's fresh checker, riding the same resumable verdict
+    checkpoints as a live run."""
+    try:
+        stats, h, run_dir = recover_store_dir(opts.store_dir)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 255
+    print(f"recovered {stats['ops']} ops from {stats['wal']} "
+          f"({stats['closed']} open invocation(s) closed as :info"
+          f"{'; torn tail: ' + stats['stop_reason'] if stats['torn'] else ''})",
+          file=sys.stderr)
+    if test_fn is None:
+        return 0
+    topts = options_to_test_opts(opts)
+    fresh = test_fn(topts)
+    stored = {}
+    test_json = run_dir / "test.json"
+    if test_json.exists():
+        with open(test_json) as f:
+            stored = json.load(f)
+    merged = _merge_stored(fresh, {**stored, "history": h})
+    completed = core.analyze(merged)
+    core.log_results(completed)
+    v = _validity(completed.get("results"))
+    return 0 if v is True else (1 if v is False else 254)
+
+
 def serve_cmd_run(opts) -> int:
     from jepsen_tpu import web
     web.serve(host=opts.host, port=opts.port, block=True)
@@ -229,6 +289,12 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                  "linearizability work pipelined across runs on "
                  "device (one grouped pass, one verdict fetch)")
 
+    def add_recover_opts(parser):
+        add_opts(parser)
+        parser.add_argument("store_dir", metavar="STORE_DIR",
+                            help="store/<name>/<ts> dir (or history.wal "
+                                 "path) of the dead run")
+
     return {
         "test": {"opts": add_opts,
                  "run": lambda opts: run_test_cmd(test_fn, opts),
@@ -240,6 +306,10 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                         else analyze_cmd(test_fn, opts)),
                     "help": "Re-check the latest stored history (or "
                             "--all of them) with a fresh checker."},
+        "recover": {"opts": add_recover_opts,
+                    "run": lambda opts: recover_cmd(opts, test_fn),
+                    "help": "Rebuild a SIGKILLed run's history from its "
+                            "WAL and re-analyze it."},
         **serve_cmd(),
     }
 
@@ -282,3 +352,27 @@ def main(commands: dict, argv: Optional[list] = None) -> int:
     except Exception:
         traceback.print_exc()
         return 255
+
+
+def standard_commands() -> dict:
+    """Suite-less command map for `python -m jepsen_tpu.cli`: operator
+    tooling that needs no test constructor — `recover` rebuilds a dead
+    run's history from its WAL (re-analysis then happens through the
+    suite binary's own `analyze`/`recover`), `serve` is the dashboard."""
+
+    def add_recover_opts(parser):
+        parser.add_argument("store_dir", metavar="STORE_DIR",
+                            help="store/<name>/<ts> dir (or history.wal "
+                                 "path) of the dead run")
+
+    return {
+        "recover": {"opts": add_recover_opts,
+                    "run": lambda opts: recover_cmd(opts),
+                    "help": "Rebuild a SIGKILLed run's history files "
+                            "from its history.wal."},
+        **serve_cmd(),
+    }
+
+
+if __name__ == "__main__":
+    run(standard_commands())

@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from jepsen_tpu import reconnect
+from jepsen_tpu.reconnect import BreakerOpen, CircuitBreaker
 from jepsen_tpu.util import real_pmap
 
 log = logging.getLogger("jepsen.control")
@@ -46,6 +48,72 @@ class RemoteError(Exception):
             f"command {cmd!r} on {host} exited {exit}: {err or out}")
         self.cmd, self.exit, self.out, self.err, self.host = \
             cmd, exit, out, err, host
+
+
+# Transport-failure markers in ssh/scp stderr: ssh exits 255 both for
+# transport loss AND for a remote command that itself exited 255, so
+# the exit code alone cannot classify — these strings disambiguate.
+_TRANSPORT_MARKERS = (
+    "connection refused", "connection reset", "connection closed",
+    "connection timed out", "timed out", "broken pipe", "no route to host",
+    "network is unreachable", "packet corrupt", "kex_exchange",
+    "could not resolve hostname", "control socket", "mux_client",
+    "lost connection", "administratively prohibited",
+)
+
+
+def transient(exc: BaseException) -> bool:
+    """Classify a control-plane failure as transient (the transport —
+    retry/reconnect may cure it) vs fatal (the remote command really
+    ran and failed — retrying would re-run side effects).
+
+    Transient: ConnectionError (incl. BreakerOpen — already counted),
+    subprocess timeouts, OSError from a dead ControlMaster socket, and
+    RemoteError shapes that smell of transport loss (exit -1 from an
+    exhausted retry ladder, or exit 255 with an ssh transport marker).
+    Everything else — ordinary nonzero exits above all — is fatal."""
+    if isinstance(exc, (ConnectionError, subprocess.TimeoutExpired)):
+        return True
+    if isinstance(exc, RemoteError):
+        if exc.exit == -1:
+            return True
+        blob = f"{exc.err or ''} {exc.out or ''}".lower()
+        return exc.exit == 255 and any(m in blob
+                                       for m in _TRANSPORT_MARKERS)
+    if isinstance(exc, OSError):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Per-node circuit breakers (reconnect.CircuitBreaker).  Module-level —
+# like _ssh_opts — and reset at the start of each with_ssh scope, so
+# one run's tripped node never poisons the next run.
+# ---------------------------------------------------------------------------
+
+_breakers: dict = {}
+_breakers_lock = threading.Lock()
+
+BREAKER_THRESHOLD = 5
+BREAKER_COOLDOWN_S = 10.0
+
+
+def breaker_for(node) -> CircuitBreaker:
+    with _breakers_lock:
+        b = _breakers.get(node)
+        if b is None:
+            b = _breakers[node] = CircuitBreaker(
+                node,
+                threshold=_ssh_opts.get("breaker-threshold",
+                                        BREAKER_THRESHOLD),
+                cooldown_s=_ssh_opts.get("breaker-cooldown-s",
+                                         BREAKER_COOLDOWN_S))
+        return b
+
+
+def reset_breakers() -> None:
+    with _breakers_lock:
+        _breakers.clear()
 
 
 class _Dyn(threading.local):
@@ -124,6 +192,12 @@ class Session:
 
     def download(self, remote: str, local: str) -> None:
         raise NotImplementedError
+
+    def alive(self) -> bool:
+        """Cheap liveness probe for cached-session reuse (`on`).  In-
+        process transports are always alive; SSHSession checks its
+        ControlMaster socket.  Must never block on a dead peer."""
+        return True
 
     def close(self) -> None:
         pass
@@ -249,9 +323,81 @@ class SSHSession(Session):
             raise RemoteError(f"scp {remote}", p.returncode, p.stdout,
                               p.stderr, self.node)
 
+    def alive(self):
+        """`ssh -O check` against the ControlMaster socket: a local
+        multiplexer query, no remote round trip.  No socket yet (no
+        command has run) counts as alive — the first real command will
+        establish it."""
+        if not _os.path.exists(self.ctl_path):
+            return True
+        p = subprocess.run(
+            self._base("ssh") + ["-O", "check", self._target()],
+            capture_output=True, text=True, timeout=10)
+        return p.returncode == 0
+
     def close(self):
         subprocess.run(self._base("ssh") + ["-O", "exit", self._target()],
                        capture_output=True, text=True)
+
+
+class ReconnectingSession(Session):
+    """A session wrapped in the reconnect holder (the reference wraps
+    persistent JSch sessions in reconnectors; reconnect.clj wrapper).
+
+    Commands run via `with_conn`, so a transport failure closes and
+    reopens the underlying session for the next user; on top of that,
+    transient failures (see `transient`) are retried here with
+    exponential backoff + deterministic jitter, gated by the node's
+    circuit breaker: every attempt consults `breaker.check()` first,
+    failures feed `breaker.failure()`, and once the breaker opens the
+    next attempt fails fast with BreakerOpen instead of burning the
+    whole backoff ladder against a dead node."""
+
+    def __init__(self, node: str, factory: Callable[[], Session],
+                 retries: int = 3, breaker: Optional[CircuitBreaker] = None):
+        self.node = node
+        self.retries = max(1, retries)
+        self.breaker = breaker if breaker is not None else breaker_for(node)
+        self.wrapper = reconnect.wrapper(factory, lambda s: s.close(),
+                                         name=node)
+        self.wrapper.open()
+
+    def _call(self, f: Callable[[Session], Any]) -> Any:
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            self.breaker.check()
+            try:
+                with self.wrapper.with_conn() as sess:
+                    out = f(sess)
+            except Exception as e:      # noqa: BLE001 - classified below
+                if not transient(e):
+                    raise
+                self.breaker.failure()
+                last = e
+                log.warning("transient transport error on %s "
+                            "(attempt %d): %s", self.node, attempt, e)
+                time.sleep(reconnect.backoff_s(attempt, name=self.node))
+                continue
+            self.breaker.success()
+            return out
+        raise last if last is not None else \
+            ConnectionError(f"no attempt ran against {self.node}")
+
+    def run(self, cmd, stdin=None):
+        return self._call(lambda s: s.run(cmd, stdin))
+
+    def upload(self, local, remote):
+        return self._call(lambda s: s.upload(local, remote))
+
+    def download(self, remote, local):
+        return self._call(lambda s: s.download(remote, local))
+
+    def alive(self):
+        conn = self.wrapper.conn
+        return conn is None or conn.alive()
+
+    def close(self):
+        self.wrapper.close()
 
 
 _dummy_handler: Optional[Callable] = None
@@ -264,12 +410,17 @@ def set_dummy_handler(handler: Optional[Callable]) -> None:
 
 
 def session(node: str) -> Session:
-    """Opens a session to the given node (control.clj:296-312)."""
+    """Opens a session to the given node (control.clj:296-312).  Real
+    transports (ssh/local) come wrapped in the reconnector so a
+    transport failure mid-run transparently reopens the connection for
+    the next user; the dummy transport stays raw — tests inspect its
+    recorded `.commands` and fake failures at the handler layer."""
     if _ssh_opts.get("dummy"):
         return DummySession(node, _dummy_handler)
-    if _ssh_opts.get("local"):
-        return LocalSession(node, dict(_ssh_opts))
-    return SSHSession(node, dict(_ssh_opts))
+    opts = dict(_ssh_opts)
+    if opts.get("local"):
+        return ReconnectingSession(node, lambda: LocalSession(node, opts))
+    return ReconnectingSession(node, lambda: SSHSession(node, opts))
 
 
 def disconnect(s: Session) -> None:
@@ -288,6 +439,7 @@ class with_ssh:
         with _ssh_lock:
             self.saved = dict(_ssh_opts)
             _ssh_opts = self.ssh
+        reset_breakers()     # one run's dead node must not poison the next
         return self
 
     def __exit__(self, *exc):
@@ -341,22 +493,42 @@ def trace_on():
 
 def ssh_star(cmd: str, stdin: Optional[str] = None) -> tuple[int, str, str]:
     """Run a raw command on the current session with retry on transient
-    transport failures (control.clj ssh* :141-161)."""
+    transport failures (control.clj ssh* :141-161), gated by the node's
+    circuit breaker: consecutive transport failures trip it, and once
+    open every subsequent command on that node fails fast with
+    BreakerOpen (a ConnectionError — the worker loop journals :info)
+    instead of hanging for the full retry-backoff ladder.
+
+    ReconnectingSession does its own breaker bookkeeping per underlying
+    attempt, so for wrapped sessions this layer only honors the fail-
+    fast (BreakerOpen passes through) without double-counting."""
     sess = _dyn.session
     if sess is None:
         raise RuntimeError("no session bound; use with_session/on")
+    breaker = None
+    if _dyn.host is not None and not isinstance(sess, ReconnectingSession):
+        breaker = breaker_for(_dyn.host)
     last: Any = None
     for attempt in range(max(_dyn.retries, 1)):
+        if breaker is not None:
+            breaker.check()             # raises BreakerOpen when open
         try:
             rc, out, err = sess.run(cmd, stdin)
             if rc == 255 and "corrupt" in (err or "").lower():
                 raise ConnectionError(err)  # "Packet corrupt" retry
-            return rc, out, err
+        except BreakerOpen:
+            raise
         except (ConnectionError, subprocess.TimeoutExpired) as e:
+            if breaker is not None:
+                breaker.failure()
             last = e
             log.warning("ssh error on %s (attempt %d): %s",
                         _dyn.host, attempt, e)
-            time.sleep(min(2 ** attempt * 0.1, 2.0))
+            time.sleep(reconnect.backoff_s(attempt, name=_dyn.host))
+            continue
+        if breaker is not None:
+            breaker.success()
+        return rc, out, err
     raise RemoteError(cmd, -1, "", str(last), _dyn.host)
 
 
@@ -409,11 +581,29 @@ def download(remote: str, local: str) -> None:
 
 def on(node: str, f: Callable, test: Optional[dict] = None):
     """Run f() with the session for `node` bound (control.clj on :346).
-    Uses the test's session table when given, else opens a fresh one."""
+    Uses the test's session table when given — after a cheap liveness
+    probe: a cached session that died since it was opened (`ssh -O
+    check` failure on the ControlMaster) is evicted, closed, and
+    replaced in the table rather than handed to the worker.  Else opens
+    a fresh one."""
     sess = None
     opened = False
     if test is not None:
-        sess = (test.get("sessions") or {}).get(node)
+        sessions = test.get("sessions") or {}
+        sess = sessions.get(node)
+        if sess is not None:
+            try:
+                ok = sess.alive()
+            except Exception:           # a probe that errors is a dead peer
+                ok = False
+            if not ok:
+                log.warning("cached session for %s is dead; evicting",
+                            node)
+                try:
+                    sess.close()
+                except Exception:
+                    pass
+                sess = sessions[node] = session(node)
     if sess is None:
         sess = session(node)
         opened = True

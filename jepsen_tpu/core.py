@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time as time_mod
 from typing import Any, Optional
 
 from jepsen_tpu import checker as checker_mod
@@ -81,6 +82,14 @@ class InvokeTimeout(Exception):
     """A client.invoke exceeded the test's :invoke-timeout bound."""
 
 
+class InvokeStalled(Exception):
+    """The worker watchdog cancelled an in-flight invoke: the worker
+    had not journaled progress within the stall budget (or the run
+    deadline expired mid-drain).  Indeterminate, like InvokeTimeout —
+    the op may or may not have taken effect — so the completion is
+    :info and the process id retires."""
+
+
 class InvokeNeverRan(Exception):
     """The abandoned-invoker cap rejected an op BEFORE its invoke thread
     was spawned: the op definitively did not take effect, so the sound
@@ -94,16 +103,25 @@ _abandoned: list = []               # done-events of abandoned invokers
 _abandoned_lock = threading.Lock()
 
 
-def _bounded_invoke(client, test, op: Op, seconds: float):
-    """client.invoke with a wall-clock bound.  On timeout the invoking
-    thread is abandoned (exactly like util.timeout and the reference's
-    interrupt-based worker deadline, generator.clj:415-530) and
-    InvokeTimeout is raised — the caller converts it to an :info
-    completion and the worker recycles the process, so one hung client
-    can no longer overrun a generator time_limit indefinitely.  A late
-    result from the abandoned thread is discarded, which is sound: the
-    op is already journaled :info (indeterminate, may or may not have
-    taken effect).
+def _bounded_invoke(client, test, op: Op, seconds: Optional[float],
+                    cancel: Optional[threading.Event] = None):
+    """client.invoke with a wall-clock bound and/or a watchdog cancel
+    hook.  On timeout the invoking thread is abandoned (exactly like
+    util.timeout and the reference's interrupt-based worker deadline,
+    generator.clj:415-530) and InvokeTimeout is raised — the caller
+    converts it to an :info completion and the worker recycles the
+    process, so one hung client can no longer overrun a generator
+    time_limit indefinitely.  A late result from the abandoned thread
+    is discarded, which is sound: the op is already journaled :info
+    (indeterminate, may or may not have taken effect).
+
+    With `cancel` (the worker watchdog's per-op stall event, or the
+    run-deadline drain), `seconds` may be None: the wait then has no
+    fixed bound but wakes the moment the watchdog fires, abandoning the
+    thread and raising InvokeStalled.  Either way the abandoned
+    thread's cancel token (util.cancel_scope) is set, so cooperative
+    clients that poll util.cancelled() retire promptly instead of
+    running forever.
 
     Leak bound: each timeout abandons one daemon thread, which lives
     until its client call returns.  Against a fully wedged cluster the
@@ -120,7 +138,7 @@ def _bounded_invoke(client, test, op: Op, seconds: float):
         oldest = _abandoned[0] if len(_abandoned) >= _MAX_ABANDONED \
             else None
     if oldest is not None:
-        oldest.wait(min(seconds, 1.0))
+        oldest.wait(min(seconds, 1.0) if seconds else 1.0)
         with _abandoned_lock:
             _abandoned[:] = [d for d in _abandoned if not d.is_set()]
             if len(_abandoned) >= _MAX_ABANDONED:
@@ -130,22 +148,46 @@ def _bounded_invoke(client, test, op: Op, seconds: float):
     box: list = [None]
     err: list = [None]
     done = threading.Event()
+    thread_cancel = threading.Event()
 
     def run():
-        try:
-            box[0] = client.invoke(test, op)
-        except BaseException as e:  # noqa: BLE001 - re-raised in caller
-            err[0] = e
-        finally:
-            done.set()
+        from jepsen_tpu.util import cancel_scope
+        with cancel_scope(thread_cancel):
+            try:
+                box[0] = client.invoke(test, op)
+            except BaseException as e:  # noqa: BLE001 - re-raised in caller
+                err[0] = e
+            finally:
+                done.set()
 
     t = threading.Thread(target=run, daemon=True,
                          name=f"invoke-{op.process}")
     t.start()
-    if not done.wait(seconds):
+
+    def abandon(exc):
+        thread_cancel.set()           # cooperative clients retire early
         with _abandoned_lock:
             _abandoned.append(done)
-        raise InvokeTimeout(f"invoke timed out after {seconds}s")
+        raise exc
+
+    if cancel is None:
+        finished = done.wait(seconds)
+    else:
+        # Wake on completion, watchdog cancel, or deadline — whichever
+        # first.  Python has no multi-event wait, so slice the wait.
+        deadline = (time_mod.monotonic() + seconds) if seconds else None
+        while True:
+            if done.wait(0.05):
+                finished = True
+                break
+            if cancel.is_set():
+                abandon(InvokeStalled(
+                    "invoke cancelled by worker watchdog"))
+            if deadline is not None and time_mod.monotonic() > deadline:
+                finished = False
+                break
+    if not finished:
+        abandon(InvokeTimeout(f"invoke timed out after {seconds}s"))
     if err[0] is not None:
         raise err[0]
     return box[0]
@@ -179,15 +221,19 @@ def _bounded_close(client, test, seconds: float):
             _abandoned.append(done)
 
 
-def invoke_op(op: Op, test, client, abort) -> Op:
+def invoke_op(op: Op, test, client, abort,
+              cancel: Optional[threading.Event] = None) -> Op:
     """Apply an op to a client, converting exceptions to :info completions
     — 'indeterminate' (core.clj:199-232).  With test[:invoke-timeout]
     (seconds) set, each invoke is wall-clock bounded via
-    _bounded_invoke."""
+    _bounded_invoke; with `cancel` (the watchdog's per-op stall event)
+    the invoke additionally wakes and journals :info the moment the
+    watchdog retires the worker's in-flight op."""
     try:
         timeout_s = test.get("invoke_timeout")
-        if timeout_s:
-            completion = _bounded_invoke(client, test, op, timeout_s)
+        if timeout_s or cancel is not None:
+            completion = _bounded_invoke(client, test, op, timeout_s,
+                                         cancel)
         else:
             completion = client.invoke(test, op)
         completion = to_op(completion).assoc(time=relative_time_nanos())
@@ -222,18 +268,37 @@ class ClientWorker(Worker):
         self.node = node
         self.client: Optional[client_mod.Client] = None
         self.name = f"worker {process_id}"
+        # Watchdog bookkeeping: the monitor thread reads (inflight,
+        # last_journal) under progress_lock and fires stall_cancel to
+        # retire a wedged in-flight op (see Watchdog).
+        self.progress_lock = threading.Lock()
+        self.inflight: Optional[Op] = None
+        self.last_journal = time_mod.monotonic()
+        self.stall_cancel: Optional[threading.Event] = None
 
     def setup_worker(self):
         self.client = client_mod.open_client(
             self.test["client"], self.test, self.node)
 
+    def _mark_inflight(self, op: Optional[Op]):
+        with self.progress_lock:
+            self.inflight = op
+            self.last_journal = time_mod.monotonic()
+            self.stall_cancel = threading.Event() if op is not None \
+                else None
+            return self.stall_cancel
+
     def run_worker(self):
         test = self.test
         g = test["generator"]
+        drain = test.get("drain_event")
+        watched = drain is not None
         with gen.with_threads(test["threads"]):
             while True:
                 if self.abort.is_set():
                     raise WorkerAbort()
+                if drain is not None and drain.is_set():
+                    return          # run deadline: stop drawing ops
                 op = gen.op_and_validate(g, test, self.process)
                 if op is None:
                     return
@@ -254,17 +319,22 @@ class ClientWorker(Worker):
                         self.client = None
                         continue
                 conj_op(test, op)
+                cancel = self._mark_inflight(op) if watched else None
                 tr = test.get("tracer")
-                if tr is not None and tr.enabled:
-                    # dgraph trace.clj:52-63 wraps client ops in spans
-                    with tr.span("client/invoke", f=str(op.f),
-                                 process=op.process):
+                try:
+                    if tr is not None and tr.enabled:
+                        # dgraph trace.clj:52-63 wraps client ops in spans
+                        with tr.span("client/invoke", f=str(op.f),
+                                     process=op.process):
+                            completion = invoke_op(op, test, self.client,
+                                                   self.abort, cancel)
+                            tr.attribute("type", str(completion.type))
+                    else:
                         completion = invoke_op(op, test, self.client,
-                                               self.abort)
-                        tr.attribute("type", str(completion.type))
-                else:
-                    completion = invoke_op(op, test, self.client,
-                                           self.abort)
+                                               self.abort, cancel)
+                finally:
+                    if watched:
+                        self._mark_inflight(None)
                 conj_op(test, completion)
                 log_op(completion)
                 if completion.is_info:
@@ -320,10 +390,13 @@ class NemesisWorker(Worker):
         from jepsen_tpu import nemesis as nemesis_mod
         test = self.test
         g = test["generator"]
+        drain = test.get("drain_event")
         with gen.with_threads(test["threads"]):
             while True:
                 if self.abort.is_set():
                     raise WorkerAbort()
+                if drain is not None and drain.is_set():
+                    return          # run deadline: drain into teardown
                 op = gen.op_and_validate(g, test, gen.NEMESIS)
                 if op is None:
                     return
@@ -347,6 +420,92 @@ class NemesisWorker(Worker):
         if self.nemesis is not None:
             from jepsen_tpu import nemesis as nemesis_mod
             nemesis_mod.teardown(self.nemesis, self.test)
+
+
+class Watchdog:
+    """Worker watchdog + whole-run deadline (the tentpole's part 3).
+
+    A monitor thread polls the client workers' journaling progress:
+
+      * **Stall detection** — a worker whose in-flight op has not
+        journaled a completion within `stall_budget_s` gets its
+        per-op stall event fired; the worker's `_bounded_invoke` wait
+        wakes, abandons the wedged invoker thread (cancel token set so
+        cooperative clients retire), and journals the op `:info` — the
+        standard indeterminate path then retires the wedged logical
+        process id (+concurrency) and opens a fresh client, which is
+        exactly Jepsen's process-crash semantics: a fresh logical
+        process takes the slot, the old one stays crashed forever.
+      * **Run deadline** — past `deadline_s` (measured from watchdog
+        start) the drain event is set: workers stop drawing ops and
+        fall through to teardown gracefully.  In-flight ops are given
+        `drain_grace_s` to finish, then stall-cancelled so a wedged
+        node cannot hold the run past its deadline.
+
+    The watchdog itself journals nothing — the woken worker does — so
+    there is no completion-race between monitor and worker."""
+
+    def __init__(self, test, workers: list["ClientWorker"],
+                 stall_budget_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 drain_grace_s: Optional[float] = None,
+                 poll_s: float = 0.05):
+        self.test = test
+        self.workers = workers
+        self.stall_budget_s = stall_budget_s
+        self.deadline_s = deadline_s
+        self.drain_grace_s = drain_grace_s if drain_grace_s is not None \
+            else (stall_budget_s if stall_budget_s else 1.0)
+        self.poll_s = poll_s
+        self.stop_event = threading.Event()
+        self.stalls = 0
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name="watchdog")
+
+    def start(self):
+        self.t0 = time_mod.monotonic()
+        self.drained_at: Optional[float] = None
+        self.thread.start()
+        return self
+
+    def stop(self):
+        self.stop_event.set()
+        self.thread.join(timeout=5)
+
+    def _cancel(self, w: "ClientWorker", why: str):
+        with w.progress_lock:
+            op, cancel = w.inflight, w.stall_cancel
+        if op is None or cancel is None or cancel.is_set():
+            return
+        log.warning("watchdog: retiring process %s (%s; op %s)",
+                    op.process, why, op.f)
+        self.stalls += 1
+        cancel.set()
+
+    def _run(self):
+        drain = self.test.get("drain_event")
+        while not self.stop_event.wait(self.poll_s):
+            now = time_mod.monotonic()
+            if (self.deadline_s is not None and drain is not None
+                    and not drain.is_set()
+                    and now - self.t0 > self.deadline_s):
+                log.warning("watchdog: run deadline %.1fs reached; "
+                            "draining workers into teardown",
+                            self.deadline_s)
+                drain.set()
+                self.drained_at = now
+            for w in self.workers:
+                with w.progress_lock:
+                    inflight = w.inflight
+                    last = w.last_journal
+                if inflight is None:
+                    continue
+                if (self.stall_budget_s is not None
+                        and now - last > self.stall_budget_s):
+                    self._cancel(w, f"stalled > {self.stall_budget_s}s")
+                elif (self.drained_at is not None
+                        and now - self.drained_at > self.drain_grace_s):
+                    self._cancel(w, "run deadline drain")
 
 
 def run_workers(workers: list[Worker], test=None) -> None:
@@ -392,13 +551,29 @@ def run_workers(workers: list[Worker], test=None) -> None:
 
 def run_case(test) -> History:
     """Spawn nemesis + clients, run one case, return its history
-    (core.clj:403-432)."""
-    history = History(journal=True)  # columns build as ops land, so
-    lock = threading.RLock()         # analysis starts from arrays
-    test["history"] = history
+    (core.clj:403-432).
+
+    Crash-safety wiring: named tests journal every op write-through to
+    the fsynced history WAL (store/<name>/<ts>/history.wal), so a
+    SIGKILLed run can be rebuilt with history.recover; a watchdog
+    monitors worker progress when stall_budget_s / deadline_s are set;
+    and whatever faults the nemesis left outstanding (its worker may
+    have died mid-fault) are reversed from the fault ledger on EVERY
+    exit path — normal, deadline drain, watchdog, or exception."""
+    wal = None
+    if test.get("name") and test.get("start-time"):
+        from jepsen_tpu import store
+        from jepsen_tpu.history import HistoryWAL
+        wal = HistoryWAL(store.make_path(test, "history.wal"))
+    history = History(journal=True, wal=wal)  # columns build as ops
+    lock = threading.RLock()                  # land, so analysis
+    test["history"] = history                 # starts from arrays
     test["history_lock"] = lock
     with test["active_histories_lock"]:
         test["active_histories"].add((history, lock))
+    watchdog = None
+    if test.get("stall_budget_s") or test.get("deadline_s"):
+        test["drain_event"] = threading.Event()
     try:
         nodes = test.get("nodes") or []
         n = test["concurrency"]
@@ -407,11 +582,43 @@ def run_case(test) -> History:
         clients = [ClientWorker(test, i, node)
                    for i, node in enumerate(client_nodes)]
         workers = [NemesisWorker(test)] + clients
+        if test.get("drain_event") is not None:
+            watchdog = Watchdog(
+                test, clients,
+                stall_budget_s=test.get("stall_budget_s"),
+                deadline_s=test.get("deadline_s"),
+                drain_grace_s=test.get("drain_grace_s")).start()
         run_workers(workers, test)
     finally:
+        if watchdog is not None:
+            watchdog.stop()
         with test["active_histories_lock"]:
             test["active_histories"].discard((history, lock))
+        _heal_outstanding_faults(test)
+        if wal is not None:
+            wal.close()
     return history
+
+
+def _heal_outstanding_faults(test) -> None:
+    """Reverse every fault still registered in the test's ledger
+    (nemesis.FaultLedger) — the guaranteed-heal backstop for teardown
+    paths where the nemesis worker itself died mid-fault.  Never
+    raises: teardown must proceed, and a heal failure cannot be
+    allowed to mask the run's primary error."""
+    ledger = test.get("fault_ledger")
+    if ledger is None or not ledger.outstanding():
+        return
+    log.warning("healing %d outstanding nemesis fault(s) from the "
+                "ledger: %s", len(ledger.outstanding()),
+                [k for k, _ in ledger.outstanding()])
+    try:
+        results = ledger.heal_all(test)
+        for key, res in results.items():
+            if isinstance(res, Exception):
+                log.error("ledger heal of %r failed: %s", key, res)
+    except Exception:
+        log.error("fault-ledger heal failed", exc_info=True)
 
 
 def analyze(test) -> dict:
@@ -466,12 +673,18 @@ def run(test: dict) -> dict:
     test["active_histories"] = set()
     test["active_histories_lock"] = threading.Lock()
     test["abort_event"] = threading.Event()
+    from jepsen_tpu import nemesis as nemesis_mod
+    test.setdefault("fault_ledger", nemesis_mod.FaultLedger())
     test["threads"] = gen.sort_processes(
         [gen.NEMESIS] + list(range(test["concurrency"])))
 
     if test.get("name"):
         from jepsen_tpu import store
         store.start_logging(test)
+        # Write the test map BEFORE the run: a SIGKILLed run then
+        # leaves test.json + history.wal behind, which is everything
+        # `cli recover` needs to rebuild and re-analyze it.
+        fcatch(store.write_test)(test)
     from jepsen_tpu import trace as trace_mod
     test["tracer"] = trace_mod.tracer(test)
     log.info("Running test: %s", test.get("name"))

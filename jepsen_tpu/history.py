@@ -23,9 +23,16 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
+import os
+import threading
+import zlib
+from pathlib import Path
 from typing import Any, Callable, Iterable, Iterator, Optional
 
 import numpy as np
+
+log = logging.getLogger("jepsen")
 
 # Sentinel process id for the nemesis (the reference uses the keyword
 # :nemesis; we reserve a negative int so process columns stay integral).
@@ -171,7 +178,8 @@ class History:
     """An indexed list of Ops with the analysis passes the reference gets
     from knossos.history: `index`, `complete`, `pairs`, `processes`."""
 
-    def __init__(self, ops: Iterable[Any] = (), journal: bool = False):
+    def __init__(self, ops: Iterable[Any] = (), journal: bool = False,
+                 wal: Optional["HistoryWAL"] = None):
         self.ops: list[Op] = [op(o) for o in ops]
         self._packed: Optional["PackedHistory"] = None
         # With journal=True (the run loop, core.py run_case), every
@@ -179,10 +187,17 @@ class History:
         # columnar representation exists the moment the run ends and
         # analysis never walks the Op objects (SURVEY.md §7).
         self._journal: Optional["ColumnJournal"] = None
+        # With a wal, every append is also written through to the
+        # fsynced on-disk write-ahead log, so a SIGKILLed run leaves a
+        # recoverable op record (see HistoryWAL / recover).
+        self.wal = wal
         if journal:
             self._journal = ColumnJournal()
             for o in self.ops:
                 self._journal.append(o)
+        if wal is not None:
+            for o in self.ops:
+                wal.append(o)
 
     def __len__(self):
         return len(self.ops)
@@ -201,6 +216,8 @@ class History:
         self._packed = None          # columnar cache is positional
         if self._journal is not None:
             self._journal.append(o)
+        if self.wal is not None:
+            self.wal.append(o)
         return o
 
     def invalidate_packed(self) -> None:
@@ -552,6 +569,147 @@ class ColumnJournal:
                              self.type[:n], self.f[:n], self.value[:n],
                              self.value_ok[:n], self.time[:n],
                              dict(self.f_codes), vkind=self.vkind[:n])
+
+
+# ---------------------------------------------------------------------------
+# Crash-safe history WAL (ISSUE 2 tentpole; same framing discipline as
+# the resilient runner's verdicts.jsonl checkpoints, store.py:223-273):
+# one JSON record per journaled op, appended + flushed + fsynced as it
+# lands, each record guarded by a crc32 digest of its canonical op
+# payload.  A SIGKILLed run leaves at worst one torn trailing line;
+# `recover` rebuilds a well-formed history from the intact prefix,
+# closing open invocations as :info (indeterminate — exactly what the
+# reference's checkers assume about ops whose process crashed).
+#
+# Record framing (history.wal):
+#     {"i": <seq>, "crc": "<crc32 of canonical op json>", "op": {...}}
+#
+# The canonical payload is json.dumps(op_dict, sort_keys=True,
+# separators=(",", ":"), default=repr) — deterministic across the
+# write/read round trip, so a reader can re-derive and verify the crc
+# from the parsed record alone.
+# ---------------------------------------------------------------------------
+
+def _wal_payload(op_dict: dict) -> str:
+    return json.dumps(op_dict, sort_keys=True, separators=(",", ":"),
+                      default=repr)
+
+
+class HistoryWAL:
+    """Append-only, fsynced, digest-guarded op log.
+
+    Thread-safe: the run loop appends from every worker (via
+    History.append under the history lock) AND from the nemesis
+    journal; the internal lock keeps records whole regardless.  Append
+    failures (disk full, fs gone) are logged once and disable the WAL
+    rather than crashing the run — a run without crash-safety beats no
+    run."""
+
+    def __init__(self, path, fsync: bool = True):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.fsync = fsync
+        self.lock = threading.Lock()
+        self._n = 0
+        self._dead = False
+        self._f = open(self.path, "a")
+
+    def append(self, o: "Op") -> None:
+        with self.lock:
+            if self._dead:
+                return
+            try:
+                payload = _wal_payload(o.to_dict())
+                crc = zlib.crc32(payload.encode())
+                # embed the canonical payload verbatim (it is itself
+                # JSON) — the reader re-derives the crc from it alone
+                self._f.write(f'{{"i":{self._n},"crc":"{crc:08x}",'
+                              f'"op":{payload}}}\n')
+                self._f.flush()
+                if self.fsync:
+                    os.fsync(self._f.fileno())
+                self._n += 1
+            except Exception:
+                self._dead = True
+                log.warning("history WAL write failed; continuing "
+                            "without crash-safety", exc_info=True)
+
+    def close(self) -> None:
+        with self.lock:
+            self._dead = True
+            try:
+                self._f.close()
+            except Exception:
+                pass
+
+
+def recover(path) -> History:
+    """Rebuild a well-formed History from a (possibly truncated) WAL.
+
+    Reads records in order, stopping at the first line that fails to
+    parse, fails its crc check, or breaks the sequence — everything
+    past a tear is unattributable, so recovery trusts exactly the
+    intact prefix.  Invocations without a completion in that prefix are
+    closed with synthesized `:info` completions (indeterminate: the op
+    may or may not have taken effect), so `core.analyze` and the
+    checkpointed checkers can verify the result directly.
+
+    The returned History carries a `recovery` attribute:
+        {"ops": <recovered op count>, "closed": <synthesized :info>,
+         "torn": <True when the file ended mid-record or failed a
+                  guard>, "stop_reason": <str or None>}
+    """
+    p = Path(path)
+    ops: list[Op] = []
+    stop_reason = None
+    raw = p.read_bytes().decode("utf-8", errors="replace")
+    for lineno, line in enumerate(raw.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            stop_reason = f"line {lineno}: torn/unparseable record"
+            break
+        if not isinstance(rec, dict) or "op" not in rec:
+            stop_reason = f"line {lineno}: not a WAL record"
+            break
+        if rec.get("i") != len(ops):
+            stop_reason = (f"line {lineno}: sequence break "
+                           f"(expected {len(ops)}, got {rec.get('i')})")
+            break
+        payload = _wal_payload(rec["op"])
+        if f"{zlib.crc32(payload.encode()):08x}" != rec.get("crc"):
+            stop_reason = f"line {lineno}: crc mismatch"
+            break
+        ops.append(Op.from_dict(rec["op"]))
+
+    # Close open invocations as :info (knossos treats such processes as
+    # crashed; the invocation stays concurrent to everything after it).
+    open_by_process: dict[Any, Op] = {}
+    for o in ops:
+        if o.is_invoke:
+            open_by_process[o.process] = o
+        else:
+            open_by_process.pop(o.process, None)
+    last_time = max((o.time for o in ops if o.time is not None), default=0)
+    closed = 0
+    for inv in sorted(open_by_process.values(),
+                      key=lambda o: o.index if o.index is not None else 0):
+        ops.append(inv.assoc(type=INFO, time=last_time,
+                             error="wal-recover: open at crash"))
+        closed += 1
+
+    h = History(ops).index()
+    h.recovery = {"ops": len(ops) - closed, "closed": closed,
+                  "torn": stop_reason is not None,
+                  "stop_reason": stop_reason}
+    if stop_reason or closed:
+        log.warning("WAL recovery %s: %d ops, %d open invocations "
+                    "closed as :info%s", p, len(ops) - closed, closed,
+                    f" ({stop_reason})" if stop_reason else "")
+    return h
 
 
 def history_latencies(h: History) -> list[tuple[Op, float]]:

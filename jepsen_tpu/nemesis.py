@@ -14,6 +14,75 @@ from jepsen_tpu import util
 from jepsen_tpu.history import Op
 
 
+class FaultLedger:
+    """Registry of outstanding injected faults (partitions, slow/flaky
+    links, process kills) with their undo actions.
+
+    Nemeses register a fault BEFORE injecting it and resolve it when
+    they reverse it themselves; teardown — every teardown, including
+    the ones reached via the watchdog, the run deadline, or an
+    exception after the nemesis worker died mid-fault — calls
+    `heal_all`, which reverses whatever is still outstanding in
+    reverse registration order.  Undo actions must therefore be
+    idempotent (healing an already-healed network is a no-op).
+
+    Thread-safe: the nemesis worker registers while client workers
+    (via net helpers) may too, and heal_all can race a late resolve."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._seq = 0
+        self._faults: dict = {}   # key -> (seq, undo fn, description)
+
+    def register(self, key, undo: Callable[[], object],
+                 description=None) -> None:
+        """Record an outstanding fault.  Re-registering a key replaces
+        its undo (e.g. a second partition before the first healed —
+        one heal reverses both for iptables -F semantics)."""
+        with self.lock:
+            self._faults[key] = (self._seq, undo, description)
+            self._seq += 1
+
+    def resolve(self, key) -> bool:
+        """The fault was reversed by its owner; drop it."""
+        with self.lock:
+            return self._faults.pop(key, None) is not None
+
+    def outstanding(self) -> list:
+        """[(key, description)] of unreversed faults, registration
+        order."""
+        with self.lock:
+            items = sorted(self._faults.items(), key=lambda kv: kv[1][0])
+        return [(k, d) for k, (_, _, d) in items]
+
+    def heal_all(self, test=None) -> dict:
+        """Reverse every outstanding fault, newest first (faults can
+        stack: un-kill before un-partition).  Each undo runs even if
+        earlier ones raise; failures are returned, not thrown.  The
+        ledger is emptied regardless — a failed heal is logged by the
+        caller, and retrying it forever would wedge teardown."""
+        with self.lock:
+            items = sorted(self._faults.items(), key=lambda kv: kv[1][0],
+                           reverse=True)
+            self._faults.clear()
+        results = {}
+        for key, (_, undo, _desc) in items:
+            try:
+                results[key] = undo()
+            except Exception as e:   # noqa: BLE001 - reported, not raised
+                results[key] = e
+        return results
+
+
+def ledger(test) -> FaultLedger:
+    """The test's fault ledger (created by core.run; tests driving
+    nemeses directly get one on demand)."""
+    led = test.get("fault_ledger")
+    if led is None:
+        led = test["fault_ledger"] = FaultLedger()
+    return led
+
+
 class Nemesis:
     """nemesis.clj:9-14."""
 
@@ -48,7 +117,14 @@ def teardown(nemesis: Optional[Nemesis], test) -> None:
 
 class Timeout(Nemesis):
     """Bound unreliable nemesis ops; timed-out ops get value 'timeout'
-    (nemesis.clj:56-70)."""
+    (nemesis.clj:56-70).
+
+    Thread hygiene: util.timeout runs the inner invoke on a daemon
+    thread and, on timeout, abandons it with its cancel token set —
+    inner nemeses that poll `util.cancelled()` in their wait loops
+    retire promptly, so a long run with a flaky nemesis does not
+    accumulate live threads (one timed-out op used to leak one thread
+    for as long as its invoke blocked)."""
 
     def __init__(self, timeout_ms: float, nemesis: Nemesis):
         self.timeout_ms = timeout_ms
@@ -136,7 +212,14 @@ def majorities_ring(nodes) -> dict:
 # ---------------------------------------------------------------------------
 
 class Partitioner(Nemesis):
-    """:start cuts links per (grudge nodes); :stop heals."""
+    """:start cuts links per (grudge nodes); :stop heals.
+
+    Outstanding partitions are registered in the test's fault ledger
+    BEFORE the links are cut, so a nemesis that dies mid-partition (or
+    a run torn down while one is active) still gets its network healed
+    by the ledger backstop in core.run_case."""
+
+    LEDGER_KEY = "nemesis.partition"
 
     def __init__(self, grudge: Optional[Callable] = None):
         self.grudge = grudge
@@ -145,19 +228,27 @@ class Partitioner(Nemesis):
         test["net"].heal(test)
         return self
 
+    def _heal(self, test):
+        test["net"].heal(test)
+        ledger(test).resolve(self.LEDGER_KEY)
+
     def invoke(self, test, op):
         if op.f == "start":
             grudge = op.value or self.grudge(test["nodes"])
+            ledger(test).register(self.LEDGER_KEY,
+                                  lambda: test["net"].heal(test),
+                                  {k: sorted(v)
+                                   for k, v in grudge.items()})
             net_mod.drop_all(test, grudge)
             return op.assoc(value=["isolated", {k: sorted(v) for k, v in
                                                 grudge.items()}])
         if op.f == "stop":
-            test["net"].heal(test)
+            self._heal(test)
             return op.assoc(value="network-healed")
         raise ValueError(f"partitioner can't handle {op.f!r}")
 
     def teardown(self, test):
-        test["net"].heal(test)
+        self._heal(test)
 
 
 def partitioner(grudge=None):
@@ -267,7 +358,11 @@ def clock_scrambler(dt):
 
 
 class NodeStartStopper(Nemesis):
-    """Generic start!/stop! on targeted nodes (nemesis.clj:236-279)."""
+    """Generic start!/stop! on targeted nodes (nemesis.clj:236-279).
+
+    Started disruptions (kills, pauses) register in the fault ledger
+    keyed by this nemesis instance; stop — or the teardown backstop —
+    runs the stop fn on whatever nodes are still disrupted."""
 
     def __init__(self, targeter, start, stop):
         self.targeter = targeter
@@ -275,6 +370,20 @@ class NodeStartStopper(Nemesis):
         self.stop = stop
         self.nodes = None
         self.lock = threading.Lock()
+
+    @property
+    def _ledger_key(self):
+        return ("nemesis.node-start-stopper", id(self))
+
+    def _stop_all(self, test):
+        """Undo: stop the disruption on every still-started node.  Used
+        by :stop and, via the ledger, by the teardown backstop."""
+        with self.lock:
+            ns, self.nodes = self.nodes, None
+        if not ns:
+            return "not-started"
+        return {node: c.on(node, lambda n=node: self.stop(test, n), test)
+                for node in ns}
 
     def invoke(self, test, op):
         with self.lock:
@@ -292,6 +401,8 @@ class NodeStartStopper(Nemesis):
                     return op.assoc(
                         type="info",
                         value=f"nemesis already disrupting {self.nodes}")
+                ledger(test).register(self._ledger_key,
+                                      lambda: self._stop_all(test), ns)
                 self.nodes = ns
                 value = {node: c.on(node,
                                     lambda n=node: self.start(test, n),
@@ -301,13 +412,16 @@ class NodeStartStopper(Nemesis):
             if op.f == "stop":
                 if self.nodes is None:
                     return op.assoc(type="info", value="not-started")
-                value = {node: c.on(node,
-                                    lambda n=node: self.stop(test, n),
-                                    test)
-                         for node in self.nodes}
-                self.nodes = None
-                return op.assoc(type="info", value=value)
+        if op.f == "stop":
+            value = self._stop_all(test)
+            ledger(test).resolve(self._ledger_key)
+            return op.assoc(type="info", value=value)
         raise ValueError(f"node-start-stopper can't handle {op.f!r}")
+
+    def teardown(self, test):
+        if self.nodes is not None:
+            self._stop_all(test)
+            ledger(test).resolve(self._ledger_key)
 
 
 def node_start_stopper(targeter, start, stop):

@@ -1,5 +1,13 @@
 """Network manipulation: partitions, latency, loss
-(reference: `jepsen/src/jepsen/net.clj` + `net/proto.clj`)."""
+(reference: `jepsen/src/jepsen/net.clj` + `net/proto.clj`).
+
+Every link-level fault injected here (drops, netem delay, netem loss)
+is registered in the test's fault ledger (nemesis.FaultLedger) before
+the commands run, and resolved by the operation that reverses it
+(`heal` / `fast`) — so core.run_case's teardown backstop can reverse
+whatever a dead nemesis left behind.  `heal` and `fast` are idempotent:
+flushing empty iptables chains and deleting an absent qdisc are
+no-ops."""
 
 from __future__ import annotations
 
@@ -7,6 +15,20 @@ from jepsen_tpu import control as c
 from jepsen_tpu.util import real_pmap
 
 TC = "/sbin/tc"
+
+# Fault-ledger keys for link-level faults.
+K_PARTITION = "net.partition"
+K_SLOW = "net.slow"
+K_FLAKY = "net.flaky"
+
+
+def _ledger(test):
+    led = test.get("fault_ledger")
+    if led is None:
+        # lazy import: nemesis imports this module at load time
+        from jepsen_tpu import nemesis as nemesis_mod
+        led = test["fault_ledger"] = nemesis_mod.FaultLedger()
+    return led
 
 
 class Net:
@@ -64,6 +86,8 @@ class IPTables(Net, PartitionAll):
     """iptables/tc backend (net.clj:57-109)."""
 
     def drop(self, test, src, dest):
+        _ledger(test).register(K_PARTITION,
+                               lambda: self.heal(test), (src, dest))
         c.on(dest, lambda: self._drop_from(src), test)
 
     def _drop_from(self, src):
@@ -72,13 +96,19 @@ class IPTables(Net, PartitionAll):
                       "-j", "DROP", "-w")
 
     def heal(self, test):
+        """Flush every drop rule.  Idempotent: `iptables -F`/-X on
+        already-empty chains exit 0, so healing a healed (or never
+        partitioned) network runs the same commands and succeeds."""
         def f(tst, node):
             with c.su():
                 c.execute("iptables", "-F", "-w")
                 c.execute("iptables", "-X", "-w")
         c.on_nodes(test, f)
+        _ledger(test).resolve(K_PARTITION)
 
     def slow(self, test, mean=50, variance=10, distribution="normal"):
+        _ledger(test).register(K_SLOW, lambda: self.fast(test),
+                               f"delay {mean}ms")
         def f(tst, node):
             with c.su():
                 c.execute(TC, "qdisc", "add", "dev", "eth0", "root",
@@ -87,6 +117,8 @@ class IPTables(Net, PartitionAll):
         c.on_nodes(test, f)
 
     def flaky(self, test):
+        _ledger(test).register(K_FLAKY, lambda: self.fast(test),
+                               "loss 20% 75%")
         def f(tst, node):
             with c.su():
                 c.execute(TC, "qdisc", "add", "dev", "eth0", "root",
@@ -94,6 +126,9 @@ class IPTables(Net, PartitionAll):
         c.on_nodes(test, f)
 
     def fast(self, test):
+        """Remove delay/loss.  Idempotent: a missing root qdisc is
+        swallowed, so `fast` after `fast` (or with nothing shaped) is a
+        no-op."""
         def f(tst, node):
             with c.su():
                 try:
@@ -102,16 +137,24 @@ class IPTables(Net, PartitionAll):
                     if "No such file or directory" not in str(e):
                         raise
         c.on_nodes(test, f)
+        led = _ledger(test)
+        led.resolve(K_SLOW)
+        led.resolve(K_FLAKY)
 
     def drop_all(self, test, grudge):
+        _ledger(test).register(K_PARTITION, lambda: self.heal(test),
+                               {k: sorted(v) for k, v in grudge.items()})
         def snub(tst, node):
             srcs = grudge.get(node) or ()
             if not srcs:
                 return
             with c.su():
+                # sorted: deterministic rule text, so fault injection
+                # replays (and the dummy-transport tests) see identical
+                # command sequences run to run
                 c.execute("iptables", "-A", "INPUT", "-s",
-                          ",".join(_ip(s) for s in srcs), "-j", "DROP",
-                          "-w")
+                          ",".join(_ip(s) for s in sorted(srcs)),
+                          "-j", "DROP", "-w")
         c.on_nodes(test, snub, list(grudge.keys()))
 
 
@@ -122,6 +165,8 @@ class IPFilter(Net):
     """ipfilter backend (net.clj:111-143)."""
 
     def drop(self, test, src, dest):
+        _ledger(test).register(K_PARTITION,
+                               lambda: self.heal(test), (src, dest))
         def f():
             with c.su():
                 c.execute(c.lit(f"echo block in from {src} to any | "
@@ -133,6 +178,7 @@ class IPFilter(Net):
             with c.su():
                 c.execute("ipf", "-Fa")
         c.on_nodes(test, f)
+        _ledger(test).resolve(K_PARTITION)
 
 
 ipfilter = IPFilter()

@@ -6,6 +6,21 @@ use shares the connection under the read lock; when an operation
 throws, `with_conn` closes and reopens the connection (write lock) so
 the *next* user gets a fresh one, then rethrows — the caller still sees
 the failure, exactly like `with-conn` (reconnect.clj:92-129).
+
+On top of the holder this module carries the rest of the self-healing
+control plane's connection policy (wired through `control.py`):
+
+  * `backoff_s` — exponential backoff with DETERMINISTIC jitter
+    (seeded by (name, attempt), same discipline as the resilient
+    checker runtime's retry shape) so transport-retry schedules replay
+    identically across runs.
+  * `CircuitBreaker` — a per-node closed/open/half-open breaker:
+    after `threshold` consecutive transport failures the node is
+    declared down and further commands fail fast with `BreakerOpen`
+    (a ConnectionError, so the worker loop journals an `:info`
+    completion) instead of hanging every worker for a full
+    retry-backoff ladder; after `cooldown_s` one probe is let through
+    (half-open) and a success re-closes the breaker.
 """
 
 from __future__ import annotations
@@ -13,9 +28,101 @@ from __future__ import annotations
 import contextlib
 import logging
 import threading
+import time
+import zlib
 from typing import Any, Callable, Optional
 
 log = logging.getLogger("jepsen.reconnect")
+
+
+def backoff_s(attempt: int, base_s: float = 0.1, cap_s: float = 2.0,
+              name: Any = None, seed: int = 0) -> float:
+    """Exponential backoff with deterministic jitter in [0.5, 1.0) of
+    the exponential slot — keyed by (seed, name, attempt), never by
+    wall clock, so a failing run's retry schedule is reproducible."""
+    slot = min(base_s * (2 ** attempt), cap_s)
+    h = zlib.crc32(repr((seed, name, attempt)).encode())
+    return slot * (0.5 + (h % 1000) / 2000.0)
+
+
+class BreakerOpen(ConnectionError):
+    """Fail-fast refusal: the node's circuit breaker is open.  Derives
+    from ConnectionError so existing transport-failure handling (worker
+    :info conversion, transient classification) applies unchanged."""
+
+    def __init__(self, node, failures: int, retry_in_s: float):
+        super().__init__(
+            f"circuit breaker open for {node}: {failures} consecutive "
+            f"transport failures; retrying in {retry_in_s:.1f}s")
+        self.node = node
+        self.failures = failures
+        self.retry_in_s = retry_in_s
+
+
+class CircuitBreaker:
+    """Per-node transport circuit breaker (closed -> open -> half-open).
+
+    closed: commands flow; consecutive transport failures are counted
+        (any success resets the count).
+    open: after `threshold` consecutive failures; `check()` raises
+        BreakerOpen immediately until `cooldown_s` has elapsed.
+    half-open: first `check()` past the cooldown lets ONE probe
+        through; its success() re-closes the breaker, its failure()
+        re-opens it for another cooldown.
+    """
+
+    def __init__(self, node=None, threshold: int = 5,
+                 cooldown_s: float = 10.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.node = node
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.lock = threading.Lock()
+        self.failures = 0
+        self.opened_at: Optional[float] = None
+        self.probing = False
+
+    @property
+    def state(self) -> str:
+        with self.lock:
+            if self.opened_at is None:
+                return "closed"
+            return "half-open" if self.probing else "open"
+
+    def check(self) -> None:
+        """Gate a command attempt: no-op when closed; raises BreakerOpen
+        while open; past the cooldown admits a single half-open probe
+        (concurrent callers keep failing fast until it resolves)."""
+        with self.lock:
+            if self.opened_at is None:
+                return
+            elapsed = self.clock() - self.opened_at
+            if elapsed >= self.cooldown_s and not self.probing:
+                self.probing = True
+                return
+            raise BreakerOpen(self.node, self.failures,
+                              max(self.cooldown_s - elapsed, 0.0))
+
+    def success(self) -> None:
+        with self.lock:
+            if self.opened_at is not None:
+                log.info("breaker for %s closed again", self.node)
+            self.failures = 0
+            self.opened_at = None
+            self.probing = False
+
+    def failure(self) -> None:
+        with self.lock:
+            self.failures += 1
+            if self.probing or (self.opened_at is None
+                                and self.failures >= self.threshold):
+                if self.opened_at is None:
+                    log.warning(
+                        "breaker for %s OPEN after %d consecutive "
+                        "transport failures", self.node, self.failures)
+                self.opened_at = self.clock()
+                self.probing = False
 
 
 class _RWLock:

@@ -38,6 +38,7 @@ NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "barrier", "active_histories", "active_histories_lock", "history_lock",
     "sessions", "remote", "store", "abort_event", "tracer",
+    "fault_ledger", "drain_event",
 }
 
 
@@ -240,6 +241,13 @@ def checkpoint_path(checkpoint_dir) -> Path:
     """Canonical verdict-checkpoint file inside a checkpoint dir — one
     definition shared by the runner and anything inspecting store/."""
     return Path(checkpoint_dir) / "verdicts.jsonl"
+
+
+def wal_path(test) -> Path:
+    """Canonical location of a run's history WAL (history.HistoryWAL):
+    store/<name>/<ts>/history.wal — one definition shared by the run
+    loop, `history.recover`, and the CLI `recover` subcommand."""
+    return path(test, "history.wal")
 
 
 def append_checkpoint(path, record: dict) -> None:

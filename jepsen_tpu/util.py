@@ -125,22 +125,67 @@ class with_retry:
         raise err
 
 
+# ---------------------------------------------------------------------------
+# Cooperative cancellation (the watchdog / bounded-invoke story): Python
+# threads cannot be killed, so every wrapper that abandons a thread on
+# timeout instead installs a per-thread cancel token the abandoned body
+# can poll.  Long-running clients and nemeses check `util.cancelled()`
+# in their wait loops and return early, so abandoned threads retire
+# promptly instead of accumulating for the rest of the run.
+# ---------------------------------------------------------------------------
+
+_cancel_local = threading.local()
+
+
+@contextlib.contextmanager
+def cancel_scope(token: threading.Event):
+    """Bind `token` as the current thread's cancel token for the body.
+    Installed by the thread-spawning timeout wrappers (util.timeout,
+    core._bounded_invoke) around the abandoned-able call."""
+    prev = getattr(_cancel_local, "token", None)
+    _cancel_local.token = token
+    try:
+        yield token
+    finally:
+        _cancel_local.token = prev
+
+
+def cancel_token() -> Optional[threading.Event]:
+    """The current thread's cancel token, or None outside any bounded
+    call.  Cooperative bodies wait on this instead of bare sleep."""
+    return getattr(_cancel_local, "token", None)
+
+
+def cancelled() -> bool:
+    """True when the caller has been abandoned by its timeout wrapper
+    and should return as soon as it conveniently can."""
+    t = cancel_token()
+    return t is not None and t.is_set()
+
+
 def timeout(seconds: float, default, f: Callable, *args):
     """Run f in a thread with a wall-clock bound; yields default on
     timeout (util.clj:311 — the thread is abandoned, not killed, which
-    is also true of the reference's variant)."""
+    is also true of the reference's variant).  The abandoned thread is
+    a daemon and gets a cancel token set at abandonment, so an f that
+    polls `util.cancelled()` retires promptly instead of running
+    forever (the nemesis.Timeout thread-leak fix)."""
     result = [default]
     done = threading.Event()
+    cancel = threading.Event()
 
     def run():
-        try:
-            result[0] = f(*args)
-        finally:
-            done.set()
+        with cancel_scope(cancel):
+            try:
+                result[0] = f(*args)
+            finally:
+                done.set()
 
     t = threading.Thread(target=run, daemon=True)
     t.start()
-    done.wait(seconds)
+    if not done.wait(seconds):
+        cancel.set()
+        return default
     return result[0]
 
 

@@ -1,0 +1,535 @@
+"""Crash-safe run phase (ISSUE 2): history WAL + recovery, worker
+watchdog, whole-run deadline, circuit-broken nodes, fault-ledger
+guaranteed heal, and the abandoned-thread hygiene of the timeout
+wrappers.  Everything runs in-process over the dummy transport except
+the kill9 battery, which SIGKILLs a real child interpreter mid-run and
+recovers from the WAL it left behind."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, generator as gen
+from jepsen_tpu import history as history_mod
+from jepsen_tpu import models
+from jepsen_tpu import nemesis as nemesis_mod
+from jepsen_tpu import store
+from jepsen_tpu import tests as tst
+from jepsen_tpu import util
+from jepsen_tpu.history import History, HistoryWAL, invoke_op, ok_op
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+# ---------------------------------------------------------------------------
+# WAL write-through + recovery
+# ---------------------------------------------------------------------------
+
+class TestHistoryWAL:
+    def test_run_writes_wal(self):
+        state = tst.Atom()
+        test = dict(tst.noop_test())
+        test.update({
+            "name": "wal run",
+            "db": tst.atom_db(state),
+            "client": tst.atom_client(state),
+            "generator": gen.nemesis(gen.void, gen.limit(8, gen.cas)),
+            "checker": ck.linearizable({"model": models.CASRegister(0)}),
+        })
+        result = core.run(test)
+        wal = store.wal_path(result)
+        assert wal.exists()
+        recovered = history_mod.recover(wal)
+        assert recovered.recovery == {
+            "ops": len(result["history"]), "closed": 0, "torn": False,
+            "stop_reason": None}
+        assert [ (o.process, o.type, o.f, o.value)
+                 for o in recovered ] == \
+               [ (o.process, o.type, o.f, o.value)
+                 for o in result["history"] ]
+
+    def test_recover_closes_open_invocations(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        wal.append(invoke_op(0, "write", 3, time=10))
+        wal.append(ok_op(0, "write", 3, time=20))
+        wal.append(invoke_op(1, "read", None, time=30))  # never completes
+        wal.close()
+        h = history_mod.recover(tmp_path / "history.wal")
+        assert h.recovery["ops"] == 3
+        assert h.recovery["closed"] == 1
+        assert h.recovery["torn"] is False
+        closure = h[-1]
+        assert closure.is_info and closure.process == 1
+        assert "wal-recover" in str(closure.error)
+        # well-formed: every invocation pairs
+        assert all(c is not None for _, c in h.pairs())
+
+    def test_recover_tolerates_torn_tail(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        for i in range(3):
+            wal.append(invoke_op(0, "write", i, time=i))
+            wal.append(ok_op(0, "write", i, time=i))
+        wal.close()
+        with open(tmp_path / "history.wal", "a") as f:
+            f.write('{"i": 6, "crc": "00000000", "op": {"proc')  # torn
+        h = history_mod.recover(tmp_path / "history.wal")
+        assert len(h) == 6 and h.recovery["torn"]
+
+    def test_recover_stops_at_crc_mismatch(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        for i in range(4):
+            wal.append(invoke_op(0, "w", i, time=i))
+        wal.close()
+        lines = (tmp_path / "history.wal").read_text().splitlines()
+        lines[2] = lines[2].replace('"value":2', '"value":7')  # bitrot
+        (tmp_path / "history.wal").write_text("\n".join(lines) + "\n")
+        h = history_mod.recover(tmp_path / "history.wal")
+        # trusts exactly the intact prefix: ops 0-1, each closed :info
+        assert h.recovery["ops"] == 2
+        assert "crc mismatch" in h.recovery["stop_reason"]
+
+    def test_recover_stops_at_sequence_break(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        for i in range(4):
+            wal.append(invoke_op(0, "w", i, time=i))
+        wal.close()
+        lines = (tmp_path / "history.wal").read_text().splitlines()
+        del lines[1]                                     # lost record
+        (tmp_path / "history.wal").write_text("\n".join(lines) + "\n")
+        h = history_mod.recover(tmp_path / "history.wal")
+        assert h.recovery["ops"] == 1
+        assert "sequence break" in h.recovery["stop_reason"]
+
+    def test_wal_failure_does_not_crash_run(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        wal._f.close()                                   # yank the disk
+        h = History(journal=True, wal=wal)
+        h.append(invoke_op(0, "w", 1))                   # must not raise
+        h.append(ok_op(0, "w", 1))
+        assert len(h) == 2
+
+
+# ---------------------------------------------------------------------------
+# Worker watchdog + run deadline
+# ---------------------------------------------------------------------------
+
+class CooperativeHang(client_mod.Client):
+    """Hangs until its invoker is abandoned (polls util.cancelled), so
+    watchdog-cancelled invoke threads retire instead of leaking."""
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        while not util.cancelled():
+            time.sleep(0.005)
+        return op.assoc(type="ok")
+
+    def close(self, test):
+        pass
+
+
+class TestWatchdog:
+    def test_stalled_worker_retired_and_replaced(self):
+        test = dict(tst.noop_test())
+        test.update({
+            "name": "stalled worker",
+            "client": CooperativeHang(),
+            "concurrency": 2,
+            "stall_budget_s": 0.2,
+            "generator": gen.nemesis(
+                gen.void, gen.limit(4, gen.queue_gen())),
+        })
+        t0 = time.monotonic()
+        result = core.run(test)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15, f"watchdog failed to unwedge: {elapsed:.1f}s"
+        infos = [o for o in result["history"] if o.is_info]
+        assert len(infos) == 4
+        assert all("watchdog" in str(o.error) for o in infos)
+        # process-crash semantics: fresh logical processes took over
+        procs = {o.process for o in result["history"]}
+        assert any(p >= test["concurrency"] for p in procs)
+
+    def test_run_deadline_drains_workers(self):
+        state = tst.Atom()
+        base = tst.atom_client(state)
+
+        class Slow(client_mod.Client):
+            def open(self, test, node):
+                out = Slow()
+                out.inner = base.open(test, node)
+                return out
+
+            def invoke(self, test, op):
+                time.sleep(0.02)
+                return self.inner.invoke(test, op)
+
+            def close(self, test):
+                pass
+
+        test = dict(tst.noop_test())
+        test.update({
+            "name": "deadline drain",
+            "db": tst.atom_db(state),
+            "client": Slow(),
+            "concurrency": 2,
+            "deadline_s": 0.6,
+            # no limit: only the run deadline ends this generator
+            "generator": gen.nemesis(gen.void, gen.cas),
+            "checker": ck.linearizable({"model": models.CASRegister(0)}),
+        })
+        t0 = time.monotonic()
+        result = core.run(test)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10, f"deadline did not drain: {elapsed:.1f}s"
+        assert len(result["history"]) > 0
+        assert result["results"]["valid?"] is True
+
+    def test_deadline_cancels_wedged_inflight_op(self):
+        test = dict(tst.noop_test())
+        test.update({
+            "name": "deadline vs wedge",
+            "client": CooperativeHang(),
+            "concurrency": 1,
+            "deadline_s": 0.3,
+            "drain_grace_s": 0.2,
+            "generator": gen.nemesis(gen.void, gen.queue_gen()),
+        })
+        t0 = time.monotonic()
+        result = core.run(test)
+        assert time.monotonic() - t0 < 10
+        infos = [o for o in result["history"] if o.is_info]
+        assert infos, "wedged op must be journaled :info on deadline"
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker: a dead node's ops journal :info instead of hanging
+# ---------------------------------------------------------------------------
+
+class TestTrippedNode:
+    def test_dead_node_ops_fail_fast(self):
+        from jepsen_tpu import control
+
+        class SSHBacked(client_mod.Client):
+            def open(self, test, node):
+                out = SSHBacked()
+                out.node = node
+                return out
+
+            def invoke(self, test, op):
+                control.on(self.node,
+                           lambda: control.execute("app-get"), test)
+                return op.assoc(type="ok")
+
+            def close(self, test):
+                pass
+
+        def handler(node, cmd, stdin):
+            if node == "n1" and "app-get" in cmd:
+                raise ConnectionError("connection reset by peer")
+            return ""
+
+        control.set_dummy_handler(handler)
+        try:
+            test = dict(tst.noop_test())
+            test.update({
+                "name": "tripped node",
+                "client": SSHBacked(),
+                "concurrency": 5,
+                "deadline_s": 20.0,
+                "generator": gen.nemesis(
+                    gen.void, gen.limit(25, gen.queue_gen())),
+                "ssh": {"dummy": True, "breaker-threshold": 3,
+                        "breaker-cooldown-s": 60.0},
+            })
+            t0 = time.monotonic()
+            result = core.run(test)
+            elapsed = time.monotonic() - t0
+        finally:
+            control.set_dummy_handler(None)
+        assert elapsed < 18, f"tripped node hung the run: {elapsed:.1f}s"
+        completions = [o for o in result["history"]
+                       if not o.is_invoke and isinstance(o.process, int)]
+        # worker slot 0 sits on n1 (renumbered ids stay ≡ 0 mod 5)
+        n1 = [o for o in completions if o.process % 5 == 0]
+        others = [o for o in completions if o.process % 5 != 0]
+        assert n1, "the dead node's worker never drew an op"
+        assert all(o.type in ("info", "fail") for o in n1)
+        assert any("circuit breaker open" in str(o.error) for o in n1), \
+            "breaker never tripped for the dead node"
+        # healthy nodes were untouched
+        assert others and all(o.is_ok for o in others)
+
+
+# ---------------------------------------------------------------------------
+# Fault ledger: teardown heals what a dead nemesis left behind
+# ---------------------------------------------------------------------------
+
+class TestFaultLedger:
+    def test_heal_all_reverses_in_reverse_order(self):
+        led = nemesis_mod.FaultLedger()
+        order = []
+        led.register("a", lambda: order.append("a"))
+        led.register("b", lambda: order.append("b"))
+        res = led.heal_all()
+        assert order == ["b", "a"]
+        assert res == {"a": None, "b": None}
+        assert led.outstanding() == []
+
+    def test_heal_all_survives_failing_undo(self):
+        led = nemesis_mod.FaultLedger()
+        ran = []
+        led.register("bad", lambda: 1 / 0)
+        led.register("good", lambda: ran.append(1))
+        res = led.heal_all()
+        assert ran == [1]
+        assert isinstance(res["bad"], ZeroDivisionError)
+
+    def test_resolve_drops_fault(self):
+        led = nemesis_mod.FaultLedger()
+        led.register("k", lambda: None, "desc")
+        assert led.outstanding() == [("k", "desc")]
+        assert led.resolve("k") is True
+        assert led.resolve("k") is False
+        assert led.heal_all() == {}
+
+    def test_run_heals_faults_from_dead_nemesis(self):
+        """A nemesis that injects a fault and then dies without ever
+        healing: teardown's ledger backstop reverses it anyway."""
+        healed = []
+
+        class DiesMidFault(nemesis_mod.Nemesis):
+            def invoke(self, test, op):
+                nemesis_mod.ledger(test).register(
+                    "partition", lambda: healed.append(True),
+                    "n1 vs all")
+                raise RuntimeError("nemesis crashed mid-fault")
+
+        test = dict(tst.noop_test())
+        test.update({
+            "name": "dead nemesis",
+            "nemesis": DiesMidFault(),
+            "generator": gen.nemesis(
+                gen.once({"type": "invoke", "f": "start"}),
+                gen.limit(2, gen.queue_gen())),
+        })
+        result = core.run(test)
+        assert healed == [True]
+        assert result["fault_ledger"].outstanding() == []
+
+    def test_partitioner_registers_and_resolves(self):
+        heals = []
+
+        class FakeNet:
+            def drop(self, t, src, dst):
+                pass
+
+            def heal(self, t):
+                heals.append(True)
+
+        test = {"nodes": ["a", "b"], "net": FakeNet(),
+                "fault_ledger": nemesis_mod.FaultLedger()}
+        p = nemesis_mod.partition_halves()
+        p.invoke(test, history_mod.Op(f="start", type="invoke"))
+        assert [k for k, _ in test["fault_ledger"].outstanding()] == \
+            ["nemesis.partition"]
+        p.invoke(test, history_mod.Op(f="stop", type="invoke"))
+        assert test["fault_ledger"].outstanding() == []
+        assert heals  # really healed
+
+
+# ---------------------------------------------------------------------------
+# Abandoned-thread hygiene (satellite: nemesis.Timeout / _bounded_invoke)
+# ---------------------------------------------------------------------------
+
+def _settled_thread_count(baseline, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if len(threading.enumerate()) <= baseline:
+            return len(threading.enumerate())
+        time.sleep(0.02)
+    return len(threading.enumerate())
+
+
+class TestThreadHygiene:
+    def test_util_timeout_cancels_abandoned_thread(self):
+        before = len(threading.enumerate())
+
+        def waiter():
+            while not util.cancelled():
+                time.sleep(0.005)
+            return "retired"
+
+        for _ in range(10):
+            assert util.timeout(0.02, "default", waiter) == "default"
+        assert _settled_thread_count(before) <= before, \
+            "abandoned timeout threads must retire once cancelled"
+
+    def test_nemesis_timeout_threads_do_not_accumulate(self):
+        class Cooperative(nemesis_mod.Nemesis):
+            def invoke(self, test, op):
+                while not util.cancelled():
+                    time.sleep(0.005)
+                return op
+
+        before = len(threading.enumerate())
+        bounded = nemesis_mod.timeout(20, Cooperative())
+        op = history_mod.Op(f="start", type="invoke")
+        for _ in range(10):
+            out = bounded.invoke({}, op)
+            assert out.value == "timeout"
+        assert _settled_thread_count(before) <= before, \
+            "timed-out nemesis invokes must not leak live threads"
+
+    def test_bounded_invoke_sets_cancel_token(self):
+        class Cooperative(client_mod.Client):
+            def open(self, test, node):
+                return self
+
+            def invoke(self, test, op):
+                while not util.cancelled():
+                    time.sleep(0.005)
+                return op.assoc(type="ok")
+
+        before = len(threading.enumerate())
+        op = history_mod.invoke_op(0, "w", 1)
+        for _ in range(5):
+            with pytest.raises(core.InvokeTimeout):
+                core._bounded_invoke(Cooperative(), {}, op, 0.02)
+        assert _settled_thread_count(before) <= before
+
+
+# ---------------------------------------------------------------------------
+# kill9: SIGKILL a child mid-history, recover, re-verify
+# ---------------------------------------------------------------------------
+
+_KILL9_CHILD = r"""
+import sys, time
+sys.path.insert(0, {repo!r})
+from jepsen_tpu import client as client_mod
+from jepsen_tpu import core, generator as gen
+from jepsen_tpu import tests as tst
+
+state = tst.Atom()
+base = tst.atom_client(state)
+
+class Slow(client_mod.Client):
+    def open(self, test, node):
+        out = Slow(); out.inner = base.open(test, node); return out
+    def invoke(self, test, op):
+        time.sleep(0.01)
+        return self.inner.invoke(test, op)
+    def close(self, test):
+        pass
+
+test = dict(tst.noop_test())
+test.update({{
+    "name": "kill9",
+    "db": tst.atom_db(state),
+    "client": Slow(),
+    "concurrency": 3,
+    "generator": gen.nemesis(gen.void, gen.limit(100000, gen.cas)),
+}})
+core.run(test)
+"""
+
+
+@pytest.mark.kill9
+class TestKill9:
+    def test_sigkill_mid_history_recovers_same_verdict(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        child = subprocess.Popen(
+            [sys.executable, "-c", _KILL9_CHILD.format(repo=repo)],
+            cwd=tmp_path, env=env,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            # wait for the run to journal a healthy slab of ops
+            wal = None
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                wals = list((tmp_path / "store").glob(
+                    "kill9/*/history.wal"))
+                if wals:
+                    wal = wals[0]
+                    if wal.read_bytes().count(b"\n") >= 40:
+                        break
+                if child.poll() is not None:
+                    pytest.fail("child exited before it could be killed")
+                time.sleep(0.05)
+            assert wal is not None, "child never produced a WAL"
+            child.send_signal(signal.SIGKILL)
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=30)
+
+        # test.json was written before the run started
+        assert (wal.parent / "test.json").exists()
+
+        h = history_mod.recover(wal)
+        assert len(h) >= 40
+        # well-formed: every invocation has a completion
+        assert all(c is not None for _, c in h.pairs())
+        # at most one open invocation per worker slot got closed :info
+        assert 0 <= h.recovery["closed"] <= 3
+
+        checker = ck.linearizable({"model": models.CASRegister(0)})
+        recovered_verdict = ck.check_safe(checker, {}, h, {})
+        # The killed run's completed prefix IS linearizable against the
+        # atom register — the synthesized :info closures keep the
+        # crashed ops indeterminate (they may have applied just before
+        # the kill), exactly like a clean run whose processes crashed.
+        assert recovered_verdict["valid?"] is True, recovered_verdict
+
+        # And the operator path agrees: recover_store_dir rewrites
+        # history.jsonl; re-loading it yields the same verdict.
+        from jepsen_tpu import cli
+        stats, h2, run_dir = cli.recover_store_dir(wal.parent)
+        assert stats["ops"] == h.recovery["ops"]
+        loaded = History.from_jsonl(
+            (run_dir / "history.jsonl").read_text()).index()
+        assert len(loaded) == len(h)
+        reloaded_verdict = ck.check_safe(checker, {}, loaded, {})
+        assert reloaded_verdict["valid?"] == recovered_verdict["valid?"]
+
+        # Dropping the crashed invocations instead of closing them
+        # :info would be UNSOUND: the op may have taken effect before
+        # the kill, and later reads legitimately observe it.  (No
+        # assertion on that verdict — it depends on where the kill
+        # landed — but the recovered one above must stay valid.)
+
+    def test_cli_recover_rebuilds_history_files(self, tmp_path):
+        wal = HistoryWAL(tmp_path / "history.wal")
+        wal.append(invoke_op(0, "write", 1, time=1))
+        wal.append(ok_op(0, "write", 1, time=2))
+        wal.append(invoke_op(1, "read", None, time=3))
+        wal.close()
+        p = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "recover",
+             str(tmp_path)],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert p.returncode == 0, p.stderr
+        assert "recovered 3 ops" in p.stderr
+        assert (tmp_path / "history.jsonl").exists()
+        assert (tmp_path / "history.txt").exists()
+        stats = json.loads((tmp_path / "recovery.json").read_text())
+        assert stats["closed"] == 1 and stats["ops"] == 3
+        h = History.from_jsonl((tmp_path / "history.jsonl").read_text())
+        assert len(h) == 4 and h[-1].is_info

@@ -1,0 +1,132 @@
+"""net.IPTables against the dummy transport (ISSUE 2 satellite):
+exact iptables/tc command sequences for drop_all / slow / flaky, heal
+idempotence when nothing is dropped, and the fault-ledger registration
+every link fault carries."""
+
+import shlex
+
+import pytest
+
+from jepsen_tpu import control
+from jepsen_tpu import net as net_mod
+from jepsen_tpu import nemesis as nemesis_mod
+
+
+def sudo(cmd: str) -> str:
+    """The wire form of a `with c.su():` command (control.wrap_sudo)."""
+    return f"sudo -S -u root bash -c {shlex.quote(cmd)}"
+
+
+@pytest.fixture
+def cluster():
+    """Dummy-transport cluster: {node: DummySession} + a test map whose
+    cached sessions record every command."""
+    nodes = ["n1", "n2", "n3"]
+    with control.with_ssh({"dummy": True}):
+        sessions = {n: control.DummySession(n) for n in nodes}
+        test = {"nodes": nodes, "sessions": sessions,
+                "net": net_mod.iptables,
+                "fault_ledger": nemesis_mod.FaultLedger()}
+        yield test, sessions
+
+
+def commands(sessions, node):
+    return [cmd for cmd, _ in sessions[node].commands]
+
+
+class TestDropAll:
+    def test_exact_grudge_commands(self, cluster):
+        test, sessions = cluster
+        grudge = {"n1": {"n2", "n3"}, "n2": {"n1"}, "n3": set()}
+        net_mod.iptables.drop_all(test, grudge)
+        # each snubbed node drops all its grudges in ONE -A, comma-
+        # joined (the PartitionAll fast path); in dummy mode _ip is the
+        # node name itself
+        assert commands(sessions, "n1") == [
+            sudo("iptables -A INPUT -s n2,n3 -j DROP -w")]
+        assert commands(sessions, "n2") == [
+            sudo("iptables -A INPUT -s n1 -j DROP -w")]
+        # an empty grudge set runs nothing on that node
+        assert commands(sessions, "n3") == []
+
+    def test_module_drop_all_uses_fast_path(self, cluster):
+        test, sessions = cluster
+        net_mod.drop_all(test, {"n2": {"n3"}})
+        assert commands(sessions, "n2") == [
+            sudo("iptables -A INPUT -s n3 -j DROP -w")]
+        assert commands(sessions, "n1") == []
+
+    def test_single_drop_command(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.drop(test, "n1", "n2")   # n2 drops n1's traffic
+        assert commands(sessions, "n2") == [
+            sudo("iptables -A INPUT -s n1 -j DROP -w")]
+        assert commands(sessions, "n1") == []
+
+    def test_drop_all_registers_fault(self, cluster):
+        test, _ = cluster
+        net_mod.iptables.drop_all(test, {"n1": {"n2"}})
+        assert [k for k, _ in test["fault_ledger"].outstanding()] == \
+            [net_mod.K_PARTITION]
+
+
+class TestSlowFlaky:
+    def test_slow_command_sequence(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.slow(test)
+        expected = sudo("/sbin/tc qdisc add dev eth0 root netem delay "
+                        "50ms 10ms distribution normal")
+        for n in test["nodes"]:
+            assert commands(sessions, n) == [expected]
+        assert [k for k, _ in test["fault_ledger"].outstanding()] == \
+            [net_mod.K_SLOW]
+
+    def test_slow_custom_parameters(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.slow(test, mean=120, variance=30,
+                              distribution="pareto")
+        assert commands(sessions, "n1") == [
+            sudo("/sbin/tc qdisc add dev eth0 root netem delay 120ms "
+                 "30ms distribution pareto")]
+
+    def test_flaky_command_sequence(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.flaky(test)
+        expected = sudo("/sbin/tc qdisc add dev eth0 root netem loss "
+                        "20% 75%")
+        for n in test["nodes"]:
+            assert commands(sessions, n) == [expected]
+        assert [k for k, _ in test["fault_ledger"].outstanding()] == \
+            [net_mod.K_FLAKY]
+
+    def test_fast_resolves_slow_and_flaky(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.slow(test)
+        net_mod.iptables.flaky(test)
+        net_mod.iptables.fast(test)
+        assert test["fault_ledger"].outstanding() == []
+        assert commands(sessions, "n1")[-1] == \
+            sudo("/sbin/tc qdisc del dev eth0 root")
+
+
+class TestHeal:
+    HEAL = [sudo("iptables -F -w"), sudo("iptables -X -w")]
+
+    def test_heal_flushes_all_nodes(self, cluster):
+        test, sessions = cluster
+        net_mod.iptables.drop_all(test, {"n1": {"n2"}})
+        net_mod.iptables.heal(test)
+        assert commands(sessions, "n1")[-2:] == self.HEAL
+        assert commands(sessions, "n2") == self.HEAL
+        assert test["fault_ledger"].outstanding() == []
+
+    def test_heal_idempotent_when_nothing_dropped(self, cluster):
+        """Healing a never-partitioned (or already healed) network runs
+        the same flush commands and succeeds — `iptables -F`/`-X` on
+        empty chains exit 0."""
+        test, sessions = cluster
+        net_mod.iptables.heal(test)
+        net_mod.iptables.heal(test)
+        for n in test["nodes"]:
+            assert commands(sessions, n) == self.HEAL + self.HEAL
+        assert test["fault_ledger"].outstanding() == []

@@ -1,0 +1,316 @@
+"""Self-healing control plane (ISSUE 2): transient/fatal transport
+classification, the per-node circuit breaker, the reconnector-wrapped
+session, deterministic retry backoff, and cached-session liveness
+eviction in `control.on`."""
+
+import subprocess
+import threading
+
+import pytest
+
+from jepsen_tpu import control, reconnect
+from jepsen_tpu.reconnect import BreakerOpen, CircuitBreaker, backoff_s
+
+
+# ---------------------------------------------------------------------------
+# Classification
+# ---------------------------------------------------------------------------
+
+class TestTransient:
+    def test_connection_error(self):
+        assert control.transient(ConnectionError("reset"))
+
+    def test_breaker_open_is_transient(self):
+        assert control.transient(BreakerOpen("n1", 5, 1.0))
+
+    def test_subprocess_timeout(self):
+        assert control.transient(
+            subprocess.TimeoutExpired(cmd="ssh", timeout=5))
+
+    def test_ssh_255_with_transport_marker(self):
+        e = control.RemoteError("ls", 255, "", "Connection reset by peer",
+                                "n1")
+        assert control.transient(e)
+
+    def test_ssh_255_without_marker_is_fatal(self):
+        # a remote command that itself exited 255
+        e = control.RemoteError("weird-bin", 255, "", "bad flag", "n1")
+        assert not control.transient(e)
+
+    def test_exhausted_retry_ladder_exit_minus_1(self):
+        assert control.transient(
+            control.RemoteError("ls", -1, "", "timeout", "n1"))
+
+    def test_ordinary_nonzero_exit_is_fatal(self):
+        assert not control.transient(
+            control.RemoteError("false", 1, "", "", "n1"))
+
+    def test_oserror_is_transient(self):
+        assert control.transient(OSError("control socket gone"))
+
+
+# ---------------------------------------------------------------------------
+# Backoff
+# ---------------------------------------------------------------------------
+
+class TestBackoff:
+    def test_deterministic(self):
+        assert backoff_s(2, name="n1") == backoff_s(2, name="n1")
+
+    def test_varies_by_attempt_and_name(self):
+        assert backoff_s(0, name="n1") != backoff_s(1, name="n1")
+        assert backoff_s(3, name="n1") != backoff_s(3, name="n2")
+
+    def test_bounded(self):
+        for attempt in range(12):
+            b = backoff_s(attempt, base_s=0.1, cap_s=2.0, name="x")
+            assert 0.0 < b <= 2.0
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker state machine (fake clock — no wall-clock waits)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def mk(self, threshold=3, cooldown=10.0):
+        clock = FakeClock()
+        return CircuitBreaker("n1", threshold=threshold,
+                              cooldown_s=cooldown, clock=clock), clock
+
+    def test_closed_until_threshold(self):
+        b, _ = self.mk(threshold=3)
+        for _ in range(2):
+            b.check()
+            b.failure()
+        assert b.state == "closed"
+        b.failure()
+        assert b.state == "open"
+
+    def test_open_fails_fast(self):
+        b, _ = self.mk(threshold=1)
+        b.failure()
+        with pytest.raises(BreakerOpen) as ei:
+            b.check()
+        assert "n1" in str(ei.value)
+
+    def test_success_resets_consecutive_count(self):
+        b, _ = self.mk(threshold=3)
+        b.failure()
+        b.failure()
+        b.success()
+        b.failure()
+        b.failure()
+        assert b.state == "closed"   # never 3 consecutive
+
+    def test_half_open_probe_recloses_on_success(self):
+        b, clock = self.mk(threshold=1, cooldown=10.0)
+        b.failure()
+        clock.t = 11.0
+        b.check()                    # the single probe is admitted
+        assert b.state == "half-open"
+        # concurrent callers keep failing fast while the probe runs
+        with pytest.raises(BreakerOpen):
+            b.check()
+        b.success()
+        assert b.state == "closed"
+        b.check()                    # closed again: flows freely
+
+    def test_half_open_probe_reopens_on_failure(self):
+        b, clock = self.mk(threshold=1, cooldown=10.0)
+        b.failure()
+        clock.t = 11.0
+        b.check()
+        b.failure()
+        assert b.state == "open"
+        with pytest.raises(BreakerOpen):
+            b.check()                # cooldown restarted
+        clock.t = 22.0
+        b.check()                    # next probe admitted
+
+
+# ---------------------------------------------------------------------------
+# Reconnecting session
+# ---------------------------------------------------------------------------
+
+class FlakySession(control.Session):
+    """Fails its first `fail_n` run() calls with ConnectionError."""
+
+    instances = 0
+
+    def __init__(self, node="n1", fail_n=0, counter=None):
+        self.node = node
+        self.fail_n = counter if counter is not None else [fail_n]
+        self.closed = False
+        FlakySession.instances += 1
+
+    def run(self, cmd, stdin=None):
+        if self.fail_n[0] > 0:
+            self.fail_n[0] -= 1
+            raise ConnectionError("connection reset")
+        return 0, f"ran {cmd}", ""
+
+    def close(self):
+        self.closed = True
+
+
+class TestReconnectingSession:
+    def mk(self, fail_n, retries=5, threshold=10, cooldown=60.0):
+        counter = [fail_n]
+        opened = []
+
+        def factory():
+            s = FlakySession(counter=counter)
+            opened.append(s)
+            return s
+
+        sess = control.ReconnectingSession(
+            "n1", factory, retries=retries,
+            breaker=CircuitBreaker("n1", threshold=threshold,
+                                   cooldown_s=cooldown))
+        return sess, opened
+
+    def test_transparent_success(self):
+        sess, opened = self.mk(fail_n=0)
+        assert sess.run("hostname") == (0, "ran hostname", "")
+        assert len(opened) == 1
+
+    def test_reopens_after_transient_failure(self, monkeypatch):
+        monkeypatch.setattr(reconnect, "backoff_s",
+                            lambda *a, **k: 0.0)
+        sess, opened = self.mk(fail_n=2)
+        rc, out, _ = sess.run("hostname")
+        assert rc == 0
+        # each failed attempt reopened the underlying session
+        assert len(opened) == 3
+        assert opened[0].closed and opened[1].closed
+
+    def test_raises_after_retries_exhausted(self, monkeypatch):
+        monkeypatch.setattr(reconnect, "backoff_s",
+                            lambda *a, **k: 0.0)
+        sess, _ = self.mk(fail_n=99, retries=3)
+        with pytest.raises(ConnectionError):
+            sess.run("hostname")
+
+    def test_breaker_trips_and_fails_fast(self, monkeypatch):
+        monkeypatch.setattr(reconnect, "backoff_s",
+                            lambda *a, **k: 0.0)
+        sess, opened = self.mk(fail_n=99, retries=10, threshold=4)
+        with pytest.raises(BreakerOpen):
+            sess.run("hostname")
+        assert len(opened) == 5      # 1 initial open + 4 failure reopens
+
+    def test_fatal_error_not_retried(self):
+        class Fatal(control.Session):
+            def __init__(self):
+                self.calls = 0
+
+            def run(self, cmd, stdin=None):
+                self.calls += 1
+                raise control.RemoteError(cmd, 1, "", "boom", "n1")
+
+            def close(self):
+                pass
+
+        inner = Fatal()
+        sess = control.ReconnectingSession(
+            "n1", lambda: inner, retries=5,
+            breaker=CircuitBreaker("n1", threshold=99))
+        with pytest.raises(control.RemoteError):
+            sess.run("false")
+        assert inner.calls == 1
+
+
+# ---------------------------------------------------------------------------
+# ssh_star breaker gating (dummy transport)
+# ---------------------------------------------------------------------------
+
+class TestSshStarBreaker:
+    def test_node_trips_and_fails_fast(self, monkeypatch):
+        monkeypatch.setattr(reconnect, "backoff_s",
+                            lambda *a, **k: 0.0)
+        calls = [0]
+
+        def handler(node, cmd, stdin):
+            calls[0] += 1
+            raise ConnectionError("connection reset")
+
+        control.set_dummy_handler(handler)
+        try:
+            with control.with_ssh({"dummy": True,
+                                   "breaker-threshold": 3,
+                                   "breaker-cooldown-s": 60.0}):
+                sess = control.session("n9")
+                with control.with_session("n9", sess):
+                    with pytest.raises(BreakerOpen):
+                        control.execute("ls")
+                    before = calls[0]
+                    # breaker is open: no further handler calls at all
+                    with pytest.raises(BreakerOpen):
+                        control.execute("ls")
+                    assert calls[0] == before == 3
+        finally:
+            control.set_dummy_handler(None)
+
+    def test_breakers_reset_per_run(self):
+        with control.with_ssh({"dummy": True}):
+            control.breaker_for("nX").failure()
+            assert control.breaker_for("nX").failures == 1
+        with control.with_ssh({"dummy": True}):
+            assert control.breaker_for("nX").failures == 0
+
+
+# ---------------------------------------------------------------------------
+# Cached-session liveness (control.on eviction)
+# ---------------------------------------------------------------------------
+
+class TestSessionLiveness:
+    def test_dead_cached_session_evicted(self):
+        class DeadSession(control.Session):
+            node = "n1"
+
+            def alive(self):
+                return False
+
+            def run(self, cmd, stdin=None):
+                raise AssertionError("dead session must not be used")
+
+        dead = DeadSession()
+        test = {"sessions": {"n1": dead}}
+        with control.with_ssh({"dummy": True}):
+            out = control.on("n1", lambda: control.execute("hostname"),
+                             test)
+        assert out == ""
+        assert test["sessions"]["n1"] is not dead
+        assert isinstance(test["sessions"]["n1"], control.DummySession)
+
+    def test_live_cached_session_reused(self):
+        with control.with_ssh({"dummy": True}):
+            cached = control.session("n1")
+            test = {"sessions": {"n1": cached}}
+            control.on("n1", lambda: control.execute("hostname"), test)
+        assert test["sessions"]["n1"] is cached
+        assert cached.commands == [("hostname", None)]
+
+    def test_probing_error_counts_as_dead(self):
+        class ExplodingProbe(control.Session):
+            node = "n1"
+
+            def alive(self):
+                raise OSError("socket gone")
+
+        test = {"sessions": {"n1": ExplodingProbe()}}
+        with control.with_ssh({"dummy": True}):
+            control.on("n1", lambda: control.execute("hostname"), test)
+        assert isinstance(test["sessions"]["n1"], control.DummySession)
+
+    def test_base_sessions_default_alive(self):
+        assert control.DummySession("n1").alive()
+        assert control.LocalSession("n1", {}).alive()

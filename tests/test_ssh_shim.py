@@ -111,7 +111,10 @@ def test_ssh_session_argv_and_roundtrip(shim, tmp_path):
     with control.with_ssh({"username": "jeff", "port": 2222,
                            "private-key-path": "/tmp/k.pem"}):
         sess = control.session("n1")
-        assert isinstance(sess, control.SSHSession)
+        # real transports come wrapped in the reconnector (ISSUE 2);
+        # the underlying connection is still a plain SSHSession
+        assert isinstance(sess, control.ReconnectingSession)
+        assert isinstance(sess.wrapper.conn, control.SSHSession)
         try:
             with control.with_session("n1", sess):
                 out = control.execute("echo", "over the wire")
