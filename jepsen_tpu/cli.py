@@ -45,6 +45,20 @@ def parse_concurrency(s: str, n_nodes: int) -> int:
     return int(s)
 
 
+def nemesis_opt_spec(parser: argparse.ArgumentParser, registry,
+                     default: Optional[str] = None) -> None:
+    """The repeatable --nemesis registry flag a suite runner wires
+    (cockroach runner.clj:42-56): names resolve through the suite's
+    nemesis registry of named maps; repeating the flag composes them
+    (nemesis.compose_named)."""
+    names = ", ".join(sorted(registry))
+    parser.add_argument(
+        "--nemesis", action="append", dest="nemesis",
+        choices=sorted(registry), metavar="NAME",
+        help=f"nemesis to use (repeat to compose): {names}"
+             + (f" (default: {default})" if default else ""))
+
+
 def test_opt_spec(parser: argparse.ArgumentParser) -> None:
     """The standard test options (cli.clj:54-92)."""
     parser.add_argument("-n", "--node", action="append", dest="nodes",

@@ -111,6 +111,28 @@ def install_archive(url: str, dest: str, force: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# Mounts (consumed by the faultfs FUSE layer; generic on purpose)
+# ---------------------------------------------------------------------------
+
+def mounted(path: str) -> bool:
+    """Is anything mounted at exactly `path` on the node?"""
+    out = c.execute(lit(f"awk -v m={c.escape(path)} "
+                        "'$2 == m {print \"yes\"; exit}' /proc/mounts"),
+                    check=False)
+    return out.strip() == "yes"
+
+
+def umount(path: str, lazy_fallback: bool = True) -> None:
+    """Unmount `path`, escalating to a lazy detach (`umount -l`) when
+    the plain umount fails — a wedged or SIGKILLed FUSE daemon keeps a
+    plain umount blocked/EBUSY forever, and the lazy detach is the
+    documented escape hatch.  Idempotent: nothing mounted is a no-op."""
+    p = c.escape(path)
+    tail = f"|| umount -l {p} 2>/dev/null " if lazy_fallback else ""
+    c.execute(lit(f"umount {p} 2>/dev/null {tail}|| true"), check=False)
+
+
+# ---------------------------------------------------------------------------
 # Processes and daemons (util.clj:191-253)
 # ---------------------------------------------------------------------------
 

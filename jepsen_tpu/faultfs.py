@@ -1,32 +1,60 @@
 """Disk-fault injection control plane (reference:
 `charybdefs/src/jepsen/charybdefs.clj`).
 
-The reference mounts a C++ FUSE passthrough filesystem (CharybdeFS)
-over the DB's data dir and flips fault behavior over Thrift RPC
-(charybdefs.clj:41-84).  Here the native component is
-`resources/fault_inject.cpp`: an LD_PRELOAD interposer compiled to
-`libfaultinject.so` — on the node, by `install()`, exactly like the
-reference builds charybdefs on the node — that injects probabilistic
-errno faults and latency at the libc boundary of the faulted process,
-controlled over a line-oriented TCP protocol.
+Two native mechanisms, one control protocol:
 
-Fault recipes mirror charybdefs.clj:
+* **FUSE passthrough** (`resources/faultfs_fuse.cpp`, preferred) — a
+  filesystem mounted OVER the DB data dir, the reference's CharybdeFS
+  mechanism (charybdefs.clj:41-84 mounts the fs and flips faults over
+  Thrift; here the control plane is line-oriented TCP).  The kernel
+  routes *every* file op of *any* process through it — statically
+  linked Go binaries making raw syscalls included — which is the
+  coverage crash-consistency work (ALICE OSDI '14, CrashMonkey
+  OSDI '18) shows is required to reach real durability bugs.  It also
+  does what an interposer can't: **torn writes** (persist the first k
+  bytes, then EIO) and **dropped fsyncs** (ACK without durability,
+  replayed on heal).  Needs `/dev/fuse` + mount privilege (root).
+
+* **LD_PRELOAD interposer** (`resources/fault_inject.cpp`, fallback) —
+  injects at the libc boundary of the faulted process.  **SCOPE: it
+  never fires for statically-linked binaries or raw syscalls** —
+  exactly the etcd/consul/cockroach/dgraph/tidb half of the suite
+  matrix — nor for mmap I/O.  `mount()` falls back to it only where
+  FUSE is unavailable, with an explicit logged warning; treat those
+  runs as partial-coverage.  (`tests/test_faultfs.py` pins this gap:
+  a static victim demonstrably ignores the interposer and demonstrably
+  faults under FUSE.)
+
+Both ends speak the same TCP protocol, so the fault recipes mirror
+charybdefs.clj against either backend:
 
     break_all(node)          every read/write/fsync fails EIO (:72)
     break_one_percent(node)  1% of ops fail EIO (:77)
     clear(node)              stop injecting (:82)
+
+plus the FUSE-only durability recipes `set_torn` / `set_lost_fsync`.
+Named nemesis maps (`disk-eio`, `disk-slow`, `disk-torn`) live in the
+`nemeses` registry for suite `--nemesis` flags; see docs/disk-faults.md
+for the mechanism/scope matrix.
 """
 
 from __future__ import annotations
 
+import ctypes
 import errno as errno_mod
 import logging
+import os
 import socket
+import tempfile
+import threading
+import time
 from pathlib import Path
 from typing import Optional
 
 from jepsen_tpu import control as c
+from jepsen_tpu import control_util as cu
 from jepsen_tpu import nemesis as nem
+from jepsen_tpu import reconnect
 from jepsen_tpu.control import lit
 
 log = logging.getLogger("jepsen.faultfs")
@@ -34,32 +62,209 @@ log = logging.getLogger("jepsen.faultfs")
 RESOURCES = Path(__file__).parent / "resources"
 LIB_DIR = "/opt/jepsen"
 LIB = f"{LIB_DIR}/libfaultinject.so"
+FUSE_BIN = f"{LIB_DIR}/faultfs_fuse"
 DEFAULT_PORT = 7678
+
+MECH_FUSE = "fuse"
+MECH_PRELOAD = "preload"
+
+SCOPE_WARNING = (
+    "faultfs: FUSE unavailable on %s; falling back to the LD_PRELOAD "
+    "interposer, which does NOT fault statically-linked or raw-syscall "
+    "SUTs (Go binaries: etcd/consul/cockroach/dgraph/tidb...) nor mmap "
+    "I/O — disk-fault coverage is PARTIAL on this node")
+
+
+# ---------------------------------------------------------------------------
+# Install / availability
+# ---------------------------------------------------------------------------
+
+def _built_and_current(target: str, remote_src: str,
+                       local_src: Path) -> bool:
+    """Is the node's cached build of `target` compiled from the CURRENT
+    source?  Checked by md5 of the uploaded source, so a framework
+    upgrade redeploys instead of running a stale native component."""
+    import hashlib
+    local_md5 = hashlib.md5(local_src.read_bytes()).hexdigest()
+    out = c.execute(lit(
+        f"test -e {c.escape(target)} && md5sum {c.escape(remote_src)} "
+        "2>/dev/null | cut -d ' ' -f 1"), check=False)
+    return out.strip() == local_md5
 
 
 def install(test=None, node=None) -> None:
     """Upload the interposer source and build it on the node
     (charybdefs.clj setup! builds C++ on the node, :8-66)."""
-    out = c.execute(lit(f"test -e {c.escape(LIB)} && echo built"),
-                    check=False)
-    if out.strip() == "built":
+    local = RESOURCES / "fault_inject.cpp"
+    src = f"{LIB_DIR}/fault_inject.cpp"
+    if _built_and_current(LIB, src, local):
         return
     c.execute("mkdir", "-p", LIB_DIR)
-    src = f"{LIB_DIR}/fault_inject.cpp"
-    c.upload(str(RESOURCES / "fault_inject.cpp"), src)
+    c.upload(str(local), src)
     c.execute("g++", "-O2", "-shared", "-fPIC", "-o", LIB, src,
               "-ldl", "-pthread")
 
 
+def install_fuse(test=None, node=None) -> None:
+    """Upload + build the FUSE daemon on the node.  Builds with nothing
+    but g++ and libc — it speaks the raw kernel protocol over
+    /dev/fuse, so no libfuse dev headers are needed on the node."""
+    local = RESOURCES / "faultfs_fuse.cpp"
+    src = f"{LIB_DIR}/faultfs_fuse.cpp"
+    if _built_and_current(FUSE_BIN, src, local):
+        return
+    c.execute("mkdir", "-p", LIB_DIR)
+    c.upload(str(local), src)
+    c.execute("g++", "-O2", "-o", FUSE_BIN, src, "-pthread")
+
+
+def fuse_available(test=None, node=None) -> bool:
+    """Can the CURRENT control-plane node host a faultfs mount?  Cheap
+    screen (/dev/fuse + compiler) first, then the definitive check: the
+    built daemon's `--probe` mode actually mounts and detaches an empty
+    fs, so privilege problems (no CAP_SYS_ADMIN in a container) are
+    caught here, not at DB setup."""
+    out = c.execute(lit("test -e /dev/fuse && command -v g++ "
+                        ">/dev/null 2>&1 && echo fuse-ok"), check=False)
+    if out.strip() != "fuse-ok":
+        return False
+    try:
+        install_fuse(test, node)
+    except c.RemoteError:
+        return False
+    out = c.execute(lit(f"{c.escape(FUSE_BIN)} --probe 2>/dev/null "
+                        "|| true"), check=False)
+    return "ok" in out.split()
+
+
+_host_fuse_lock = threading.Lock()
+_host_fuse: Optional[bool] = None
+
+
+def host_supports_fuse() -> bool:
+    """Can THIS process create FUSE mounts?  Backs the `fuse` pytest
+    marker's auto-skip.  Probed once by actually mounting a transient
+    fs over a temp dir via mount(2) and detaching it — which is exactly
+    the daemon's own mechanism, so the probe can't pass where the real
+    thing would fail.  False when /dev/fuse is missing or mount
+    privilege is absent (no root/CAP_SYS_ADMIN and no setuid
+    fusermount3 route, which this daemon does not use)."""
+    global _host_fuse
+    with _host_fuse_lock:
+        if _host_fuse is None:
+            _host_fuse = _probe_local_mount()
+        return _host_fuse
+
+
+def _probe_local_mount() -> bool:
+    if not os.path.exists("/dev/fuse"):
+        return False
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+    except OSError:
+        return False
+    mnt = tempfile.mkdtemp(prefix="faultfs-probe-")
+    fd = -1
+    try:
+        fd = os.open("/dev/fuse", os.O_RDWR)
+        opts = (f"fd={fd},rootmode=40000,user_id={os.getuid()},"
+                f"group_id={os.getgid()}")
+        if libc.mount(b"faultfs", mnt.encode(), b"fuse.faultfs", 0,
+                      opts.encode()) != 0:
+            return False
+        libc.umount2(mnt.encode(), 2)     # MNT_DETACH
+        return True
+    except OSError:
+        return False
+    finally:
+        if fd >= 0:
+            os.close(fd)
+        try:
+            os.rmdir(mnt)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Mount lifecycle (mechanism selection)
+# ---------------------------------------------------------------------------
+
+def backing_dir(data_dir: str) -> str:
+    """The real directory a faultfs mount passes through to."""
+    return data_dir.rstrip("/") + ".backing"
+
+
+def fuse_pidfile(data_dir: str) -> str:
+    slug = data_dir.strip("/").replace("/", "-")
+    return f"{LIB_DIR}/faultfs-{slug}.pid"
+
+
+def mount(test, node, data_dir: str, port: int = DEFAULT_PORT,
+          prefer: str = MECH_FUSE) -> dict:
+    """Put `data_dir` under disk-fault control on the current node,
+    choosing the strongest available mechanism.
+
+    FUSE route (preferred): build the daemon, adopt any pre-existing
+    data into the backing dir, mount faultfs over `data_dir`, and wait
+    for the mount to appear.  Every process touching `data_dir` is then
+    in scope.  Returns {"mechanism": "fuse", "env": {}}.
+
+    Fallback: the LD_PRELOAD interposer, with a logged scope warning.
+    Returns {"mechanism": "preload", "env": {...}} — the env MUST be
+    passed to start_daemon for the SUT, and only that (dynamically
+    linked) process is in scope.
+
+    The chosen mechanism is recorded in test["disk-mechanism"][node] so
+    nemeses and checks can see which coverage class each node got."""
+    mech = MECH_FUSE if prefer == MECH_FUSE and fuse_available(test, node) \
+        else MECH_PRELOAD
+    if mech == MECH_FUSE:
+        backing = backing_dir(data_dir)
+        c.execute("mkdir", "-p", backing, data_dir)
+        # Adopt pre-existing data-dir contents into the backing dir so
+        # a re-mount over a lived-in directory is transparent.
+        c.execute(lit(
+            f"find {c.escape(data_dir)} -mindepth 1 -maxdepth 1 "
+            f"-exec mv -t {c.escape(backing)} {{}} + 2>/dev/null "
+            "|| true"), check=False)
+        cu.start_daemon(FUSE_BIN, backing, data_dir, "--port", str(port),
+                        logfile=f"{LIB_DIR}/faultfs.log",
+                        pidfile=fuse_pidfile(data_dir))
+        c.execute(lit(
+            "for i in $(seq 1 40); do "
+            f"grep -qs \"faultfs {data_dir} fuse.faultfs\" /proc/mounts "
+            "&& exit 0; sleep 0.25; done; exit 1"))
+        env: dict = {}
+    else:
+        log.warning(SCOPE_WARNING, node)
+        install(test, node)
+        env = preload_env(data_dir, port)
+    if test is not None:
+        test.setdefault("disk-mechanism", {})[node] = mech
+    return {"mechanism": mech, "env": env}
+
+
+def unmount(data_dir: str, lazy_ok: bool = True) -> None:
+    """Tear a faultfs mount down on the current node.  Idempotent and
+    wedge-proof: SIGTERM the daemon (its handler lazy-unmounts), then
+    plain umount, then the `umount -l` escape hatch — a FUSE daemon
+    that is hung or SIGKILLed can block a plain umount forever, and a
+    lazy detach is the documented way out."""
+    cu.stop_daemon(fuse_pidfile(data_dir), FUSE_BIN)
+    cu.umount(data_dir, lazy_fallback=lazy_ok)
+
+
 def preload_env(data_dir: str, port: int = DEFAULT_PORT) -> dict:
     """Env for start_daemon so the DB process runs under the
-    interposer, faulting ops on its data dir."""
+    interposer, faulting ops on its data dir.  Reaches ONLY that
+    process, and only if it is dynamically linked — see SCOPE in
+    resources/fault_inject.cpp."""
     return {"LD_PRELOAD": LIB, "FAULTFS_PATH": data_dir,
             "FAULTFS_PORT": str(port)}
 
 
 # ---------------------------------------------------------------------------
-# Control client
+# Control client (both mechanisms speak this protocol)
 # ---------------------------------------------------------------------------
 
 def command(host: str, cmd: str, port: int = DEFAULT_PORT,
@@ -73,28 +278,53 @@ def command(host: str, cmd: str, port: int = DEFAULT_PORT,
 def set_fault(host: str, errno: int = errno_mod.EIO,
               prob_per_100k: int = 100000, delay_us: int = 0,
               ops: str = "read,write,fsync",
-              port: int = DEFAULT_PORT) -> str:
+              port: int = DEFAULT_PORT, timeout: float = 10.0) -> str:
+    """errno != 0: fail `prob` of `ops` with it.  errno == 0 with a
+    delay: latency-only faults (the op succeeds after the delay)."""
     return command(host, f"set {errno} {prob_per_100k} {delay_us} {ops}",
-                   port)
+                   port, timeout)
 
 
-def break_all(host: str, port: int = DEFAULT_PORT) -> str:
+def set_torn(host: str, prob_per_100k: int, first_bytes: int = 512,
+             port: int = DEFAULT_PORT, timeout: float = 10.0) -> str:
+    """FUSE backend only: `prob` of writes persist their first
+    `first_bytes` bytes then fail EIO (the interposer replies
+    'err unknown command')."""
+    return command(host, f"torn {prob_per_100k} {first_bytes}", port,
+                   timeout)
+
+
+def set_lost_fsync(host: str, prob_per_100k: int,
+                   port: int = DEFAULT_PORT,
+                   timeout: float = 10.0) -> str:
+    """FUSE backend only: `prob` of fsyncs are ACKed without touching
+    the disk; still-open fds get their sync replayed on `clear`."""
+    return command(host, f"lostsync {prob_per_100k}", port, timeout)
+
+
+def break_all(host: str, port: int = DEFAULT_PORT,
+              timeout: float = 10.0) -> str:
     """All reads/writes/fsyncs fail EIO (charybdefs.clj break-all :72)."""
-    return set_fault(host, prob_per_100k=100000, port=port)
+    return set_fault(host, prob_per_100k=100000, port=port,
+                     timeout=timeout)
 
 
-def break_one_percent(host: str, port: int = DEFAULT_PORT) -> str:
+def break_one_percent(host: str, port: int = DEFAULT_PORT,
+                      timeout: float = 10.0) -> str:
     """1% of ops fail EIO (charybdefs.clj break-one-percent :77)."""
-    return set_fault(host, prob_per_100k=1000, port=port)
+    return set_fault(host, prob_per_100k=1000, port=port, timeout=timeout)
 
 
-def clear(host: str, port: int = DEFAULT_PORT) -> str:
-    """Stop injecting (charybdefs.clj clear :82)."""
-    return command(host, "clear", port)
+def clear(host: str, port: int = DEFAULT_PORT,
+          timeout: float = 10.0) -> str:
+    """Stop injecting (charybdefs.clj clear :82); the FUSE backend also
+    replays pending lost fsyncs."""
+    return command(host, "clear", port, timeout)
 
 
-def get_config(host: str, port: int = DEFAULT_PORT) -> str:
-    return command(host, "get", port)
+def get_config(host: str, port: int = DEFAULT_PORT,
+               timeout: float = 10.0) -> str:
+    return command(host, "get", port, timeout)
 
 
 # ---------------------------------------------------------------------------
@@ -102,48 +332,191 @@ def get_config(host: str, port: int = DEFAULT_PORT) -> str:
 # ---------------------------------------------------------------------------
 
 class DiskFaultNemesis(nem.Nemesis):
-    """Ops:
-        {f: "break",       value: None|{prob, delay_us, ops, nodes}}
-        {f: "heal-disk",   value: None|[nodes...]}
-    """
+    """Recipe-carrying disk-fault nemesis on the standard cadence:
 
-    def __init__(self, port: int = DEFAULT_PORT):
+        {f: "start", value: None|{prob, delay_us, ops, errno, torn,
+                                  torn_bytes, lost_fsync, nodes}}
+        {f: "stop",  value: None|[nodes...]}
+
+    (legacy "break"/"heal-disk" accepted as aliases).  Ledger
+    discipline: the fault registers its clear-all undo in the test's
+    FaultLedger BEFORE any injection command goes out, so the
+    core.run_case backstop heals it on every exit path — including a
+    nemesis worker SIGKILLed between per-node injections.
+
+    Control-plane calls are bounded (short socket timeout, `retries`
+    attempts with deterministic backoff) and gated per node by a
+    reconnect.CircuitBreaker, so a dead node costs teardown a couple of
+    fast failures, not a hang."""
+
+    def __init__(self, recipe: Optional[dict] = None,
+                 port: int = DEFAULT_PORT, retries: int = 3,
+                 timeout: float = 2.0, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 10.0):
+        self.recipe = dict(recipe or {})
         self.port = port
+        self.retries = max(1, retries)
+        self.timeout = timeout
+        self._breaker_opts = (breaker_threshold, breaker_cooldown_s)
+        self._breakers: dict = {}
+        self._lock = threading.Lock()
+
+    @property
+    def _ledger_key(self):
+        return ("nemesis.disk", id(self))
+
+    # -- plumbing
+
+    def _breaker(self, node) -> reconnect.CircuitBreaker:
+        with self._lock:
+            b = self._breakers.get(node)
+            if b is None:
+                thr, cool = self._breaker_opts
+                b = self._breakers[node] = reconnect.CircuitBreaker(
+                    node, threshold=thr, cooldown_s=cool)
+            return b
+
+    def _addr(self, test, node) -> str:
+        """Control-plane address for a node; suites whose nodes are
+        logical names over a local transport map them here
+        (test["faultfs-addr"] = lambda node: "127.0.0.1")."""
+        f = (test or {}).get("faultfs-addr")
+        return f(node) if callable(f) else node
+
+    def _retry(self, node, fn):
+        """Breaker-gated bounded retry; returns the reply or an
+        'error: ...' string — never raises and never hangs, because
+        teardown runs through this too."""
+        b = self._breaker(node)
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries):
+            try:
+                b.check()
+            except reconnect.BreakerOpen as e:
+                return f"error: {e}"
+            try:
+                out = fn()
+            except OSError as e:
+                b.failure()
+                last = e
+                time.sleep(reconnect.backoff_s(attempt, name=node))
+                continue
+            b.success()
+            return out
+        return f"error: {last}"
+
+    # -- lifecycle
 
     def setup(self, test):
-        c.on_nodes(test, lambda t, n: install(t, n))
+        # DB-managed faultfs mounts record their mechanism per node; if
+        # nothing is recorded this nemesis is being used standalone, so
+        # provision the interposer fallback the legacy way.
+        if not test.get("disk-mechanism"):
+            c.on_nodes(test, lambda t, n: install(t, n))
         return self
 
     def invoke(self, test, op):
+        f = {"break": "start", "heal-disk": "stop"}.get(op.f, op.f)
         v = op.value if isinstance(op.value, dict) else {}
-        nodes = (v.get("nodes") or
-                 (op.value if isinstance(op.value, list) else None) or
-                 test.get("nodes") or [])
-        results = {}
-        for node in nodes:
-            try:
-                if op.f == "break":
-                    results[node] = set_fault(
-                        node,
-                        prob_per_100k=v.get("prob", 100000),
-                        delay_us=v.get("delay_us", 0),
-                        ops=v.get("ops", "read,write,fsync"),
-                        port=self.port)
-                elif op.f == "heal-disk":
-                    results[node] = clear(node, port=self.port)
-                else:
-                    raise ValueError(f"unknown disk op {op.f!r}")
-            except OSError as e:
-                results[node] = f"error: {e}"
-        return op.assoc(**{"disk-results": results})
+        nodes = list(v.get("nodes") or
+                     (op.value if isinstance(op.value, list) else None) or
+                     test.get("nodes") or [])
+        if f == "start":
+            recipe = {**self.recipe,
+                      **{k: val for k, val in v.items() if k != "nodes"}}
+            nem.ledger(test).register(
+                self._ledger_key,
+                lambda ns=tuple(nodes): self._clear_all(test, ns),
+                {"recipe": recipe, "nodes": nodes})
+            results = {node: self._apply(test, node, recipe)
+                       for node in nodes}
+            return op.assoc(**{"disk-results": results})
+        if f == "stop":
+            results = self._clear_all(test, nodes)
+            nem.ledger(test).resolve(self._ledger_key)
+            return op.assoc(**{"disk-results": results})
+        raise ValueError(f"unknown disk op {op.f!r}")
+
+    def _apply(self, test, node, recipe) -> dict:
+        host = self._addr(test, node)
+        out = {"set": self._retry(node, lambda: set_fault(
+            host,
+            errno=recipe.get("errno", errno_mod.EIO),
+            prob_per_100k=recipe.get("prob", 100000),
+            delay_us=recipe.get("delay_us", 0),
+            ops=recipe.get("ops", "read,write,fsync"),
+            port=self.port, timeout=self.timeout))}
+        if recipe.get("torn"):
+            out["torn"] = self._retry(node, lambda: set_torn(
+                host, recipe["torn"], recipe.get("torn_bytes", 512),
+                port=self.port, timeout=self.timeout))
+        if recipe.get("lost_fsync"):
+            out["lostsync"] = self._retry(node, lambda: set_lost_fsync(
+                host, recipe["lost_fsync"], port=self.port,
+                timeout=self.timeout))
+        return out
+
+    def _clear_all(self, test, nodes) -> dict:
+        return {node: self._retry(
+                    node,
+                    lambda h=self._addr(test, node): clear(
+                        h, port=self.port, timeout=self.timeout))
+                for node in nodes}
 
     def teardown(self, test):
-        for node in test.get("nodes") or []:
-            try:
-                clear(node, port=self.port)
-            except OSError:
-                pass
+        """Heal whatever this nemesis may have left active, without
+        ever hanging on a dead node (`_retry` + breaker), then resolve
+        the ledger entry so the run_case backstop doesn't double-heal.
+        Failures are returned by _retry as strings, not raised —
+        teardown must complete."""
+        self._clear_all(test, test.get("nodes") or [])
+        nem.ledger(test).resolve(self._ledger_key)
 
 
-def disk_fault_nemesis(port: int = DEFAULT_PORT) -> DiskFaultNemesis:
-    return DiskFaultNemesis(port)
+def disk_fault_nemesis(port: int = DEFAULT_PORT,
+                       recipe: Optional[dict] = None) -> DiskFaultNemesis:
+    return DiskFaultNemesis(recipe, port=port)
+
+
+# ---------------------------------------------------------------------------
+# Named recipes (the registry currency of suite --nemesis flags, like
+# cockroachdb/src/jepsen/cockroach/runner.clj:42-56's nemesis menu)
+# ---------------------------------------------------------------------------
+
+def disk_eio(prob_per_100k: int = 1000) -> dict:
+    """1% of reads/writes/fsyncs on the data dir fail EIO while the
+    fault window is open (charybdefs break-one-percent)."""
+    return nem.named_nemesis(
+        "disk-eio",
+        DiskFaultNemesis({"errno": errno_mod.EIO, "prob": prob_per_100k,
+                          "ops": "read,write,fsync"}))
+
+
+def disk_slow(delay_ms: float = 100) -> dict:
+    """Latency-only: every data-dir op takes an extra delay_ms; nothing
+    fails.  Surfaces timeout/indeterminacy handling."""
+    return nem.named_nemesis(
+        "disk-slow",
+        DiskFaultNemesis({"errno": 0, "prob": 100000,
+                          "delay_us": int(delay_ms * 1000),
+                          "ops": "read,write,fsync"}))
+
+
+def disk_torn(prob_per_100k: int = 20000) -> dict:
+    """Durability faults (FUSE backend only — the interposer ignores
+    these commands): torn writes (first 512 bytes persist, then EIO)
+    and dropped fsyncs (ACKed, replayed on heal)."""
+    return nem.named_nemesis(
+        "disk-torn",
+        DiskFaultNemesis({"errno": 0, "prob": 0,
+                          "torn": prob_per_100k, "torn_bytes": 512,
+                          "lost_fsync": prob_per_100k}))
+
+
+nemeses = {
+    "disk-eio": disk_eio,
+    "disk-slow": disk_slow,
+    "disk-torn": disk_torn,
+}
+
+DISK_NEMESES = frozenset(nemeses)

@@ -318,6 +318,66 @@ def compose(nemeses: dict):
     return Compose(nemeses)
 
 
+# ---------------------------------------------------------------------------
+# Named nemesis maps (cockroach nemesis.clj:32-107) — the registry
+# currency every suite's --nemesis flag deals in: {name client during
+# final clocks}.  Lived in suites/cockroach.py until the disk-fault
+# nemeses needed them from outside a suite module.
+# ---------------------------------------------------------------------------
+
+def named_nemesis(name: str, client: "Nemesis", *, clocks: bool = False,
+                  delay: float = 5, duration: float = 5) -> dict:
+    """A named nemesis map on the standard single-gen cadence: sleep
+    delay / start / sleep duration / stop, forever; final stop
+    (nemesis.clj:32-38)."""
+    from jepsen_tpu import generator as gen
+    return {"name": name, "client": client, "clocks": clocks,
+            "during": gen.start_stop(delay, duration),
+            "final": gen.once({"type": "info", "f": "stop"})}
+
+
+def tag_f(name: str, source):
+    """Wrap a generator so emitted ops carry f=(name, inner-f) — the
+    namespacing compose_named uses for routing (nemesis.clj:80-103)."""
+    from jepsen_tpu import generator as gen
+
+    def retag(op):
+        if op is None:
+            return None
+        if isinstance(op, dict):
+            out = dict(op)
+            out["f"] = (name, out.get("f"))
+            return out
+        return op.assoc(f=(name, op.f))
+    return gen.gmap(retag, source)
+
+
+def compose_named(nemeses) -> dict:
+    """nemesis.clj compose :62-107: merge named nemesis maps into one
+    {name clocks client during final}, ops tagged (name, f) and routed
+    back to their owners."""
+    from jepsen_tpu import generator as gen
+    nemeses = [n for n in nemeses if n]
+    names = [n["name"] for n in nemeses]
+    assert len(set(names)) == len(names), f"duplicate nemeses: {names}"
+    routes = {}
+    for nm in nemeses:
+        def route(f, _name=nm["name"]):
+            if isinstance(f, tuple) and len(f) == 2 and f[0] == _name:
+                return f[1]
+            return None
+        routes[route] = nm["client"]
+    return {
+        "name": "+".join(names),
+        "clocks": any(n.get("clocks") for n in nemeses),
+        "client": compose(routes),
+        "during": gen.mix([tag_f(n["name"], n["during"])
+                           for n in nemeses]),
+        "final": gen.concat(*[tag_f(n["name"], n["final"])
+                              for n in nemeses]),
+    }
+
+
 class fdict(dict):
     """A hashable f-routing map for compose() keys: outer f -> inner f
     (plain dicts can't be dict keys; identity hashing is fine since

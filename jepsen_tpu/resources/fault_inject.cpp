@@ -1,13 +1,28 @@
-// fault_inject: disk-fault injection for processes under test.
+// fault_inject: disk-fault injection for DYNAMICALLY-LINKED processes
+// under test.
 //
-// TPU-framework equivalent of the reference's CharybdeFS component
-// (charybdefs/src/jepsen/charybdefs.clj: a C++ FUSE passthrough
-// filesystem whose fault behavior is driven over Thrift RPC).  This
-// implementation reaches the same capability — per-syscall-class
-// probabilistic errno injection and latency on a chosen directory
-// subtree, controlled remotely at runtime — as an LD_PRELOAD
-// interposer with a TCP control plane, which needs no FUSE kernel
-// support and injects at the libc boundary of the faulted process.
+// SCOPE — read this before trusting a green run: this is an LD_PRELOAD
+// interposer.  It fires only when the faulted process resolves libc's
+// open/read/write/fsync through the dynamic linker.  It does NOT fire
+// for
+//   * statically-linked binaries (musl-static, Go's default linkage —
+//     etcd, consul, cockroach, dgraph, tidb...): there is no dynamic
+//     linker in the process, so LD_PRELOAD is inert;
+//   * raw syscalls that bypass libc (syscall(2), io_uring, direct
+//     SYSCALL instructions from a runtime's own wrappers);
+//   * mmap'd I/O (faults are injected per libc call, not per page).
+// For those SUTs use resources/faultfs_fuse.cpp: a FUSE passthrough
+// filesystem mounted OVER the data dir, where the kernel routes every
+// file op of every process through the fault layer — the mechanism of
+// the reference's CharybdeFS (charybdefs/src/jepsen/charybdefs.clj)
+// and the crash-consistency literature (ALICE OSDI '14, CrashMonkey
+// OSDI '18).  faultfs.py prefers the FUSE backend and falls back to
+// this interposer — with a logged warning — only where FUSE is
+// unavailable; both speak the same TCP control protocol.
+//
+// What this interposer IS for: glibc-linked SUTs on hosts where FUSE
+// mounts are impossible (no /dev/fuse, no CAP_SYS_ADMIN) — it needs no
+// kernel support at all and injects at the libc boundary.
 //
 // Usage:
 //   FAULTFS_PATH=/var/lib/db FAULTFS_PORT=7678 \
@@ -133,6 +148,14 @@ bool should_fault(unsigned op) {
 bool tracked(int fd) {
   return fd >= 0 && fd < kMaxFd &&
          g_tracked[fd].load(std::memory_order_relaxed);
+}
+
+// After should_fault() hit (and slept): errno 0 means latency-only —
+// the op proceeds; nonzero means fail it with that errno.
+bool fail_with_errno() {
+  int e = g_errno.load(std::memory_order_relaxed);
+  if (e) errno = e;
+  return e != 0;
 }
 
 // Component-boundary prefix match: /var/lib/db matches /var/lib/db and
@@ -288,10 +311,9 @@ mode_t va_mode(int flags, va_list ap) {
 int do_open(open_fn &slot, const char *name, const char *path, int flags,
             mode_t mode) {
   RESOLVE(slot, open_fn, name);
-  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
-    errno = g_errno.load();
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN) &&
+      fail_with_errno())
     return -1;
-  }
   int fd = slot(path, flags, mode);
   if (fd >= 0) track(fd, AT_FDCWD, path);
   return fd;
@@ -300,10 +322,9 @@ int do_open(open_fn &slot, const char *name, const char *path, int flags,
 int do_openat(openat_fn &slot, const char *name, int dirfd,
               const char *path, int flags, mode_t mode) {
   RESOLVE(slot, openat_fn, name);
-  if (path_in_prefix(dirfd, path) && should_fault(OP_OPEN)) {
-    errno = g_errno.load();
+  if (path_in_prefix(dirfd, path) && should_fault(OP_OPEN) &&
+      fail_with_errno())
     return -1;
-  }
   int fd = slot(dirfd, path, flags, mode);
   if (fd >= 0) track(fd, dirfd, path);
   return fd;
@@ -347,10 +368,9 @@ int openat64(int dirfd, const char *path, int flags, ...) {
 
 int creat(const char *path, mode_t mode) {
   RESOLVE(real_creat, creat_fn, "creat");
-  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
-    errno = g_errno.load();
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN) &&
+      fail_with_errno())
     return -1;
-  }
   int fd = real_creat(path, mode);
   if (fd >= 0) track(fd, AT_FDCWD, path);
   return fd;
@@ -358,10 +378,9 @@ int creat(const char *path, mode_t mode) {
 
 int creat64(const char *path, mode_t mode) {
   RESOLVE(real_creat64, creat_fn, "creat64");
-  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN)) {
-    errno = g_errno.load();
+  if (path_in_prefix(AT_FDCWD, path) && should_fault(OP_OPEN) &&
+      fail_with_errno())
     return -1;
-  }
   int fd = real_creat64(path, mode);
   if (fd >= 0) track(fd, AT_FDCWD, path);
   return fd;
@@ -369,73 +388,65 @@ int creat64(const char *path, mode_t mode) {
 
 ssize_t read(int fd, void *buf, size_t n) {
   RESOLVE(real_read, read_fn, "read");
-  if (tracked(fd) && should_fault(OP_READ)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_READ) &&
+      fail_with_errno())
     return -1;
-  }
   return real_read(fd, buf, n);
 }
 
 ssize_t pread(int fd, void *buf, size_t n, off_t off) {
   RESOLVE(real_pread, pread_fn, "pread");
-  if (tracked(fd) && should_fault(OP_READ)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_READ) &&
+      fail_with_errno())
     return -1;
-  }
   return real_pread(fd, buf, n, off);
 }
 
 ssize_t pread64(int fd, void *buf, size_t n, off64_t off) {
   RESOLVE(real_pread64, pread64_fn, "pread64");
-  if (tracked(fd) && should_fault(OP_READ)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_READ) &&
+      fail_with_errno())
     return -1;
-  }
   return real_pread64(fd, buf, n, off);
 }
 
 ssize_t write(int fd, const void *buf, size_t n) {
   RESOLVE(real_write, write_fn, "write");
-  if (tracked(fd) && should_fault(OP_WRITE)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_WRITE) &&
+      fail_with_errno())
     return -1;
-  }
   return real_write(fd, buf, n);
 }
 
 ssize_t pwrite(int fd, const void *buf, size_t n, off_t off) {
   RESOLVE(real_pwrite, pwrite_fn, "pwrite");
-  if (tracked(fd) && should_fault(OP_WRITE)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_WRITE) &&
+      fail_with_errno())
     return -1;
-  }
   return real_pwrite(fd, buf, n, off);
 }
 
 ssize_t pwrite64(int fd, const void *buf, size_t n, off64_t off) {
   RESOLVE(real_pwrite64, pwrite64_fn, "pwrite64");
-  if (tracked(fd) && should_fault(OP_WRITE)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_WRITE) &&
+      fail_with_errno())
     return -1;
-  }
   return real_pwrite64(fd, buf, n, off);
 }
 
 int fsync(int fd) {
   RESOLVE(real_fsync, fsync_fn, "fsync");
-  if (tracked(fd) && should_fault(OP_FSYNC)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_FSYNC) &&
+      fail_with_errno())
     return -1;
-  }
   return real_fsync(fd);
 }
 
 int fdatasync(int fd) {
   RESOLVE(real_fdatasync, fsync_fn, "fdatasync");
-  if (tracked(fd) && should_fault(OP_FSYNC)) {
-    errno = g_errno.load();
+  if (tracked(fd) && should_fault(OP_FSYNC) &&
+      fail_with_errno())
     return -1;
-  }
   return real_fdatasync(fd);
 }
 

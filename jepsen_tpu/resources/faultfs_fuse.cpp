@@ -1,0 +1,1026 @@
+// faultfs_fuse: universal disk-fault injection via a FUSE passthrough
+// filesystem (reference: CharybdeFS, charybdefs/src/jepsen/charybdefs.clj
+// — a C++ FUSE passthrough whose fault behavior is flipped over RPC).
+//
+// Unlike the LD_PRELOAD interposer (fault_inject.cpp), which fires only
+// at the libc boundary of dynamically-linked processes, this daemon
+// sits UNDER the kernel VFS: the kernel routes every file operation of
+// every process — statically-linked Go binaries making raw syscalls
+// included — through this process.  That is the property
+// crash-consistency work (ALICE OSDI '14, CrashMonkey OSDI '18)
+// shows is needed to reach real durability bugs.
+//
+// Implementation note: this speaks the RAW FUSE kernel protocol over
+// /dev/fuse and mounts with mount(2) directly — no libfuse dependency
+// at all, so it builds with nothing but g++ and libc on any node
+// (the deploy images ship libfuse2 runtime but no dev headers, and no
+// fusermount3).  It therefore needs root (CAP_SYS_ADMIN) to mount,
+// which the test harness has on its DB nodes.
+//
+// Usage:
+//   faultfs_fuse BACKING_DIR MOUNTPOINT [--port N]   serve (foreground)
+//   faultfs_fuse --probe                             can this host mount
+//                                                    FUSE? exit 0/1
+//
+// Control protocol (line-oriented TCP, one command per line — a strict
+// superset of fault_inject.cpp's, so faultfs.py recipes work unchanged
+// against either backend):
+//   set <errno> <prob_per_100k> <delay_us> <ops-csv>
+//       probabilistic errno faults + latency on read/write/fsync/open.
+//       errno 0 = latency only (the op still succeeds after the delay).
+//   torn <prob_per_100k> <first_k_bytes>
+//       a faulted write persists only its first k bytes, then fails EIO
+//       — the partial-write crash image fsck/recovery code must survive.
+//   lostsync <prob_per_100k>
+//       a faulted fsync/fdatasync is ACKed without touching the disk;
+//       the fd is remembered and the sync is REPLAYED on `clear` (heal
+//       = power came back before the cache died).  An fd closed while
+//       a sync is pending loses that durability window for good.
+//   clear
+//       stop injecting and replay pending fsyncs.
+//   get
+//       report config: errno= prob= delay_us= ops= torn= torn_bytes=
+//       lostsync= pending=
+//
+// Ops are served with FOPEN_DIRECT_IO so every read/write of the SUT
+// reaches this layer (no page-cache bypass); mmap-heavy SUTs are out
+// of scope for this mechanism (see docs/disk-faults.md).
+
+#include <arpa/inet.h>
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/mount.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/statvfs.h>
+#include <sys/types.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---------------------------------------------------------------- FUSE ABI
+// The stable uapi subset of <linux/fuse.h> this daemon needs, declared
+// locally so the build needs no kernel/libfuse headers.
+
+namespace {
+
+enum {
+  FUSE_LOOKUP = 1, FUSE_FORGET = 2, FUSE_GETATTR = 3, FUSE_SETATTR = 4,
+  FUSE_READLINK = 5, FUSE_SYMLINK = 6, FUSE_MKNOD = 8, FUSE_MKDIR = 9,
+  FUSE_UNLINK = 10, FUSE_RMDIR = 11, FUSE_RENAME = 12, FUSE_LINK = 13,
+  FUSE_OPEN = 14, FUSE_READ = 15, FUSE_WRITE = 16, FUSE_STATFS = 17,
+  FUSE_RELEASE = 18, FUSE_FSYNC = 20, FUSE_SETXATTR = 21,
+  FUSE_GETXATTR = 22, FUSE_LISTXATTR = 23, FUSE_REMOVEXATTR = 24,
+  FUSE_FLUSH = 25, FUSE_INIT = 26, FUSE_OPENDIR = 27, FUSE_READDIR = 28,
+  FUSE_RELEASEDIR = 29, FUSE_FSYNCDIR = 30, FUSE_ACCESS = 34,
+  FUSE_CREATE = 35, FUSE_INTERRUPT = 36, FUSE_DESTROY = 38,
+  FUSE_BATCH_FORGET = 42, FUSE_FALLOCATE = 43, FUSE_RENAME2 = 45,
+  FUSE_LSEEK = 46,
+};
+
+struct fuse_in_header {
+  uint32_t len, opcode;
+  uint64_t unique, nodeid;
+  uint32_t uid, gid, pid, padding;
+};
+
+struct fuse_out_header {
+  uint32_t len;
+  int32_t error;
+  uint64_t unique;
+};
+
+struct fuse_attr {
+  uint64_t ino, size, blocks, atime, mtime, ctime;
+  uint32_t atimensec, mtimensec, ctimensec;
+  uint32_t mode, nlink, uid, gid, rdev, blksize, flags;
+};
+
+struct fuse_entry_out {
+  uint64_t nodeid, generation, entry_valid, attr_valid;
+  uint32_t entry_valid_nsec, attr_valid_nsec;
+  struct fuse_attr attr;
+};
+
+struct fuse_attr_out {
+  uint64_t attr_valid;
+  uint32_t attr_valid_nsec, dummy;
+  struct fuse_attr attr;
+};
+
+struct fuse_getattr_in { uint32_t getattr_flags, dummy; uint64_t fh; };
+struct fuse_open_in { uint32_t flags, open_flags; };
+struct fuse_create_in { uint32_t flags, mode, umask, open_flags; };
+struct fuse_open_out { uint64_t fh; uint32_t open_flags, padding; };
+struct fuse_release_in {
+  uint64_t fh;
+  uint32_t flags, release_flags;
+  uint64_t lock_owner;
+};
+struct fuse_flush_in { uint64_t fh; uint32_t unused, padding; uint64_t lock_owner; };
+struct fuse_read_in {
+  uint64_t fh, offset;
+  uint32_t size, read_flags;
+  uint64_t lock_owner;
+  uint32_t flags, padding;
+};
+struct fuse_write_in {
+  uint64_t fh, offset;
+  uint32_t size, write_flags;
+  uint64_t lock_owner;
+  uint32_t flags, padding;
+};
+struct fuse_write_out { uint32_t size, padding; };
+struct fuse_fsync_in { uint64_t fh; uint32_t fsync_flags, padding; };
+struct fuse_mknod_in { uint32_t mode, rdev, umask, padding; };
+struct fuse_mkdir_in { uint32_t mode, umask; };
+struct fuse_rename_in { uint64_t newdir; };
+struct fuse_rename2_in { uint64_t newdir; uint32_t flags, padding; };
+struct fuse_link_in { uint64_t oldnodeid; };
+struct fuse_setattr_in {
+  uint32_t valid, padding;
+  uint64_t fh, size, lock_owner, atime, mtime, ctime;
+  uint32_t atimensec, mtimensec, ctimensec;
+  uint32_t mode, unused4, uid, gid, unused5;
+};
+struct fuse_init_in { uint32_t major, minor, max_readahead, flags; };
+struct fuse_init_out {
+  uint32_t major, minor, max_readahead, flags;
+  uint16_t max_background, congestion_threshold;
+  uint32_t max_write, time_gran;
+  uint16_t max_pages, map_alignment;
+  uint32_t flags2, max_stack_depth;
+  uint32_t unused[6];
+};
+struct fuse_access_in { uint32_t mask, padding; };
+struct fuse_forget_in { uint64_t nlookup; };
+struct fuse_batch_forget_in { uint32_t count, dummy; };
+struct fuse_forget_one { uint64_t nodeid, nlookup; };
+struct fuse_interrupt_in { uint64_t unique; };
+struct fuse_kstatfs {
+  uint64_t blocks, bfree, bavail, files, ffree;
+  uint32_t bsize, namelen, frsize, padding;
+  uint32_t spare[6];
+};
+struct fuse_getxattr_in { uint32_t size, padding; };
+struct fuse_lseek_in { uint64_t fh, offset; uint32_t whence, padding; };
+struct fuse_lseek_out { uint64_t offset; };
+struct fuse_fallocate_in {
+  uint64_t fh, offset, length;
+  uint32_t mode, padding;
+};
+struct fuse_dirent { uint64_t ino, off; uint32_t namelen, type; };
+
+constexpr uint32_t FOPEN_DIRECT_IO = 1u << 0;
+constexpr uint32_t FUSE_FSYNC_FDATASYNC = 1u << 0;
+constexpr uint32_t FUSE_GETATTR_FH = 1u << 0;
+constexpr uint32_t FATTR_MODE = 1u << 0, FATTR_UID = 1u << 1,
+    FATTR_GID = 1u << 2, FATTR_SIZE = 1u << 3, FATTR_ATIME = 1u << 4,
+    FATTR_MTIME = 1u << 5, FATTR_FH = 1u << 6, FATTR_ATIME_NOW = 1u << 7,
+    FATTR_MTIME_NOW = 1u << 8, FATTR_CTIME = 1u << 10;
+
+// ---------------------------------------------------------------- fault state
+
+enum OpClass : unsigned {
+  OP_READ = 1u << 0,
+  OP_WRITE = 1u << 1,
+  OP_FSYNC = 1u << 2,
+  OP_OPEN = 1u << 3,
+};
+
+std::atomic<int> g_errno{0};
+std::atomic<unsigned> g_prob{0};          // per 100,000 calls
+std::atomic<unsigned> g_delay_us{0};
+std::atomic<unsigned> g_ops{0};
+std::atomic<unsigned> g_torn_prob{0};     // per 100,000 writes
+std::atomic<unsigned> g_torn_bytes{512};
+std::atomic<unsigned> g_lost_prob{0};     // per 100,000 fsyncs
+std::atomic<unsigned long> g_seed{88172645463325252ull};
+
+std::mutex g_pending_mu;
+std::set<int> g_pending;                  // fds with a dropped fsync
+
+unsigned long xorshift() {
+  unsigned long x = g_seed.load(std::memory_order_relaxed);
+  x ^= x >> 12;
+  x ^= x << 25;
+  x ^= x >> 27;
+  g_seed.store(x, std::memory_order_relaxed);
+  return x * 2685821657736338717ull;
+}
+
+bool dice(unsigned prob) {
+  return prob != 0 && (xorshift() % 100000) < prob;
+}
+
+// Returns the errno to inject (0 = proceed), applying latency on a hit.
+int fault_for(unsigned op) {
+  if (!(g_ops.load(std::memory_order_relaxed) & op)) return 0;
+  if (!dice(g_prob.load(std::memory_order_relaxed))) return 0;
+  unsigned delay = g_delay_us.load(std::memory_order_relaxed);
+  if (delay) {
+    struct timespec ts;
+    ts.tv_sec = delay / 1000000;
+    ts.tv_nsec = (delay % 1000000) * 1000L;
+    nanosleep(&ts, nullptr);
+  }
+  return g_errno.load(std::memory_order_relaxed);
+}
+
+void replay_pending_fsyncs() {
+  std::lock_guard<std::mutex> lk(g_pending_mu);
+  for (int fd : g_pending) fsync(fd);
+  g_pending.clear();
+}
+
+size_t pending_count() {
+  std::lock_guard<std::mutex> lk(g_pending_mu);
+  return g_pending.size();
+}
+
+// ---------------------------------------------------------------- control TCP
+
+unsigned parse_ops(const char *csv) {
+  unsigned ops = 0;
+  if (strstr(csv, "read")) ops |= OP_READ;
+  if (strstr(csv, "write")) ops |= OP_WRITE;
+  if (strstr(csv, "fsync")) ops |= OP_FSYNC;
+  if (strstr(csv, "open")) ops |= OP_OPEN;
+  return ops;
+}
+
+void handle_line(char *line, int conn) {
+  int e;
+  unsigned prob, delay, bytes;
+  char opscsv[128];
+  if (sscanf(line, "set %d %u %u %127s", &e, &prob, &delay, opscsv) == 4) {
+    g_errno.store(e);
+    g_prob.store(prob > 100000 ? 100000 : prob);
+    g_delay_us.store(delay);
+    g_ops.store(parse_ops(opscsv));
+    dprintf(conn, "ok\n");
+  } else if (sscanf(line, "torn %u %u", &prob, &bytes) == 2) {
+    g_torn_prob.store(prob > 100000 ? 100000 : prob);
+    g_torn_bytes.store(bytes);
+    dprintf(conn, "ok\n");
+  } else if (sscanf(line, "lostsync %u", &prob) == 1) {
+    g_lost_prob.store(prob > 100000 ? 100000 : prob);
+    dprintf(conn, "ok\n");
+  } else if (strncmp(line, "clear", 5) == 0) {
+    g_prob.store(0);
+    g_ops.store(0);
+    g_errno.store(0);
+    g_delay_us.store(0);
+    g_torn_prob.store(0);
+    g_lost_prob.store(0);
+    replay_pending_fsyncs();
+    dprintf(conn, "ok\n");
+  } else if (strncmp(line, "get", 3) == 0) {
+    dprintf(conn,
+            "errno=%d prob=%u delay_us=%u ops=%u torn=%u torn_bytes=%u "
+            "lostsync=%u pending=%zu\n",
+            g_errno.load(), g_prob.load(), g_delay_us.load(),
+            g_ops.load(), g_torn_prob.load(), g_torn_bytes.load(),
+            g_lost_prob.load(), pending_count());
+  } else {
+    dprintf(conn, "err unknown command\n");
+  }
+}
+
+int g_port = 7678;
+
+void *control_loop(void *) {
+  if (g_port <= 0) return nullptr;
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) return nullptr;
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)g_port);
+  if (bind(srv, (struct sockaddr *)&addr, sizeof addr) != 0 ||
+      listen(srv, 4) != 0) {
+    close(srv);
+    return nullptr;
+  }
+  for (;;) {
+    int conn = accept(srv, nullptr, nullptr);
+    if (conn < 0) continue;
+    char line[512];
+    size_t off = 0;
+    for (;;) {
+      ssize_t r = recv(conn, line + off, sizeof(line) - 1 - off, 0);
+      if (r <= 0) break;
+      off += (size_t)r;
+      line[off] = 0;
+      char *nl, *start = line;
+      while ((nl = strchr(start, '\n')) != nullptr) {
+        *nl = 0;
+        handle_line(start, conn);
+        start = nl + 1;
+      }
+      off = strlen(start);
+      memmove(line, start, off + 1);
+    }
+    close(conn);
+  }
+  return nullptr;
+}
+
+// ---------------------------------------------------------------- inode table
+
+std::string g_backing;
+char g_mnt[4096];
+
+struct NodeTable {
+  std::mutex mu;
+  std::unordered_map<uint64_t, std::string> path;  // nodeid -> rel path
+  std::unordered_map<std::string, uint64_t> id;    // rel path -> nodeid
+  std::unordered_map<uint64_t, uint64_t> nlookup;
+  uint64_t next = 2;
+
+  std::string abs(uint64_t nodeid) {
+    if (nodeid == 1) return g_backing;
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = path.find(nodeid);
+    return it == path.end() ? std::string() : g_backing + "/" + it->second;
+  }
+
+  std::string rel(uint64_t nodeid) {
+    if (nodeid == 1) return "";
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = path.find(nodeid);
+    return it == path.end() ? std::string() : it->second;
+  }
+
+  uint64_t lookup(const std::string &rel_path) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = id.find(rel_path);
+    uint64_t n;
+    if (it != id.end()) {
+      n = it->second;
+    } else {
+      n = next++;
+      id[rel_path] = n;
+      path[n] = rel_path;
+    }
+    nlookup[n]++;
+    return n;
+  }
+
+  void forget(uint64_t nodeid, uint64_t n) {
+    if (nodeid == 1) return;
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = nlookup.find(nodeid);
+    if (it == nlookup.end()) return;
+    if (it->second <= n) {
+      auto pit = path.find(nodeid);
+      if (pit != path.end()) {
+        id.erase(pit->second);
+        path.erase(pit);
+      }
+      nlookup.erase(it);
+    } else {
+      it->second -= n;
+    }
+  }
+
+  void rename(const std::string &from, const std::string &to) {
+    // Re-point the moved node and any children at their new paths.
+    std::lock_guard<std::mutex> lk(mu);
+    std::vector<std::pair<uint64_t, std::string>> moves;
+    for (auto &kv : path) {
+      const std::string &p = kv.second;
+      if (p == from) {
+        moves.emplace_back(kv.first, to);
+      } else if (p.size() > from.size() &&
+                 p.compare(0, from.size(), from) == 0 &&
+                 p[from.size()] == '/') {
+        moves.emplace_back(kv.first, to + p.substr(from.size()));
+      }
+    }
+    for (auto &mv : moves) {
+      id.erase(path[mv.first]);
+      path[mv.first] = mv.second;
+      id[mv.second] = mv.first;
+    }
+  }
+};
+
+NodeTable g_nodes;
+
+std::string child_rel(uint64_t parent, const char *name) {
+  std::string p = g_nodes.rel(parent);
+  if (parent != 1 && p.empty()) return std::string();  // stale parent
+  return p.empty() ? std::string(name) : p + "/" + name;
+}
+
+// ---------------------------------------------------------------- replies
+
+int g_dev = -1;
+
+void reply(uint64_t unique, int error, const void *body, size_t body_len) {
+  fuse_out_header out;
+  out.len = (uint32_t)(sizeof out + (error == 0 ? body_len : 0));
+  out.error = error == 0 ? 0 : -error;   // negated errno on the wire
+  out.unique = unique;
+  struct iovec iov[2] = {{&out, sizeof out},
+                         {const_cast<void *>(body), body_len}};
+  int cnt = (error == 0 && body_len) ? 2 : 1;
+  ssize_t r = writev(g_dev, iov, cnt);
+  (void)r;  // ENOENT here means the request was interrupted; ignore
+}
+
+void reply_err(uint64_t unique, int error) { reply(unique, error, nullptr, 0); }
+void reply_ok(uint64_t unique) { reply(unique, 0, nullptr, 0); }
+
+void fill_attr(const struct stat &st, fuse_attr *a) {
+  memset(a, 0, sizeof *a);
+  a->ino = st.st_ino;
+  a->size = (uint64_t)st.st_size;
+  a->blocks = (uint64_t)st.st_blocks;
+  a->atime = (uint64_t)st.st_atim.tv_sec;
+  a->mtime = (uint64_t)st.st_mtim.tv_sec;
+  a->ctime = (uint64_t)st.st_ctim.tv_sec;
+  a->atimensec = (uint32_t)st.st_atim.tv_nsec;
+  a->mtimensec = (uint32_t)st.st_mtim.tv_nsec;
+  a->ctimensec = (uint32_t)st.st_ctim.tv_nsec;
+  a->mode = st.st_mode;
+  a->nlink = (uint32_t)st.st_nlink;
+  a->uid = st.st_uid;
+  a->gid = st.st_gid;
+  a->rdev = (uint32_t)st.st_rdev;
+  a->blksize = (uint32_t)st.st_blksize;
+}
+
+// Attr/entry validity 0: faults change visible file state out of band,
+// so the kernel must re-ask every time rather than trust its cache.
+void reply_entry(uint64_t unique, uint64_t nodeid, const struct stat &st) {
+  fuse_entry_out e;
+  memset(&e, 0, sizeof e);
+  e.nodeid = nodeid;
+  fill_attr(st, &e.attr);
+  reply(unique, 0, &e, sizeof e);
+}
+
+void reply_attr(uint64_t unique, const struct stat &st) {
+  fuse_attr_out a;
+  memset(&a, 0, sizeof a);
+  fill_attr(st, &a.attr);
+  reply(unique, 0, &a, sizeof a);
+}
+
+// ---------------------------------------------------------------- dir handles
+
+struct DirSnap {
+  struct Ent { std::string name; uint64_t ino; uint32_t type; };
+  std::vector<Ent> ents;
+};
+
+// ---------------------------------------------------------------- dispatch
+
+void do_lookup(const fuse_in_header *in, const char *name) {
+  std::string rel = child_rel(in->nodeid, name);
+  if (in->nodeid != 1 && rel.empty()) return reply_err(in->unique, ENOENT);
+  std::string abs = g_backing + "/" + rel;
+  struct stat st;
+  if (lstat(abs.c_str(), &st) != 0) return reply_err(in->unique, errno);
+  reply_entry(in->unique, g_nodes.lookup(rel), st);
+}
+
+void do_getattr(const fuse_in_header *in, const fuse_getattr_in *gi) {
+  struct stat st;
+  int rc;
+  if (gi && (gi->getattr_flags & FUSE_GETATTR_FH)) {
+    rc = fstat((int)gi->fh, &st);
+  } else {
+    std::string abs = g_nodes.abs(in->nodeid);
+    if (abs.empty()) return reply_err(in->unique, ENOENT);
+    rc = lstat(abs.c_str(), &st);
+  }
+  if (rc != 0) return reply_err(in->unique, errno);
+  reply_attr(in->unique, st);
+}
+
+void do_setattr(const fuse_in_header *in, const fuse_setattr_in *si) {
+  std::string abs = g_nodes.abs(in->nodeid);
+  bool have_fh = si->valid & FATTR_FH;
+  int fd = have_fh ? (int)si->fh : -1;
+  if (!have_fh && abs.empty()) return reply_err(in->unique, ENOENT);
+  if (si->valid & FATTR_SIZE) {
+    int rc = have_fh ? ftruncate(fd, (off_t)si->size)
+                     : truncate(abs.c_str(), (off_t)si->size);
+    if (rc != 0) return reply_err(in->unique, errno);
+  }
+  if (si->valid & FATTR_MODE) {
+    int rc = have_fh ? fchmod(fd, si->mode) : chmod(abs.c_str(), si->mode);
+    if (rc != 0) return reply_err(in->unique, errno);
+  }
+  if (si->valid & (FATTR_UID | FATTR_GID)) {
+    uid_t u = (si->valid & FATTR_UID) ? si->uid : (uid_t)-1;
+    gid_t g = (si->valid & FATTR_GID) ? si->gid : (gid_t)-1;
+    int rc = have_fh ? fchown(fd, u, g) : lchown(abs.c_str(), u, g);
+    if (rc != 0) return reply_err(in->unique, errno);
+  }
+  if (si->valid & (FATTR_ATIME | FATTR_MTIME | FATTR_ATIME_NOW |
+                   FATTR_MTIME_NOW)) {
+    struct timespec ts[2];
+    ts[0].tv_nsec = UTIME_OMIT;
+    ts[1].tv_nsec = UTIME_OMIT;
+    if (si->valid & FATTR_ATIME_NOW) ts[0].tv_nsec = UTIME_NOW;
+    else if (si->valid & FATTR_ATIME) {
+      ts[0].tv_sec = (time_t)si->atime;
+      ts[0].tv_nsec = si->atimensec;
+    }
+    if (si->valid & FATTR_MTIME_NOW) ts[1].tv_nsec = UTIME_NOW;
+    else if (si->valid & FATTR_MTIME) {
+      ts[1].tv_sec = (time_t)si->mtime;
+      ts[1].tv_nsec = si->mtimensec;
+    }
+    int rc = have_fh ? futimens(fd, ts)
+                     : utimensat(AT_FDCWD, abs.c_str(), ts,
+                                 AT_SYMLINK_NOFOLLOW);
+    if (rc != 0) return reply_err(in->unique, errno);
+  }
+  struct stat st;
+  int rc = have_fh ? fstat(fd, &st) : lstat(abs.c_str(), &st);
+  if (rc != 0) return reply_err(in->unique, errno);
+  reply_attr(in->unique, st);
+}
+
+void do_open(const fuse_in_header *in, const fuse_open_in *oi) {
+  int e = fault_for(OP_OPEN);
+  if (e) return reply_err(in->unique, e);
+  std::string abs = g_nodes.abs(in->nodeid);
+  if (abs.empty()) return reply_err(in->unique, ENOENT);
+  int fd = open(abs.c_str(), (int)(oi->flags & ~O_NOFOLLOW));
+  if (fd < 0) return reply_err(in->unique, errno);
+  fuse_open_out oo;
+  memset(&oo, 0, sizeof oo);
+  oo.fh = (uint64_t)fd;
+  oo.open_flags = FOPEN_DIRECT_IO;
+  reply(in->unique, 0, &oo, sizeof oo);
+}
+
+void do_create(const fuse_in_header *in, const fuse_create_in *ci,
+               const char *name) {
+  int e = fault_for(OP_OPEN);
+  if (e) return reply_err(in->unique, e);
+  std::string rel = child_rel(in->nodeid, name);
+  if (in->nodeid != 1 && rel.empty()) return reply_err(in->unique, ENOENT);
+  std::string abs = g_backing + "/" + rel;
+  int fd = open(abs.c_str(), (int)ci->flags | O_CREAT, ci->mode);
+  if (fd < 0) return reply_err(in->unique, errno);
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    int err = errno;
+    close(fd);
+    return reply_err(in->unique, err);
+  }
+  struct {
+    fuse_entry_out e;
+    fuse_open_out o;
+  } out;
+  memset(&out, 0, sizeof out);
+  out.e.nodeid = g_nodes.lookup(rel);
+  fill_attr(st, &out.e.attr);
+  out.o.fh = (uint64_t)fd;
+  out.o.open_flags = FOPEN_DIRECT_IO;
+  reply(in->unique, 0, &out, sizeof out);
+}
+
+void do_read(const fuse_in_header *in, const fuse_read_in *ri) {
+  int e = fault_for(OP_READ);
+  if (e) return reply_err(in->unique, e);
+  std::vector<char> buf(ri->size);
+  ssize_t n = pread((int)ri->fh, buf.data(), ri->size, (off_t)ri->offset);
+  if (n < 0) return reply_err(in->unique, errno);
+  reply(in->unique, 0, buf.data(), (size_t)n);
+}
+
+void do_write(const fuse_in_header *in, const fuse_write_in *wi,
+              const char *data) {
+  if (dice(g_torn_prob.load(std::memory_order_relaxed))) {
+    // Torn write: persist the first k bytes, then fail — the caller
+    // sees EIO but a partial image reached the backing file.
+    unsigned k = g_torn_bytes.load(std::memory_order_relaxed);
+    if (k > wi->size) k = wi->size;
+    if (k) {
+      ssize_t r = pwrite((int)wi->fh, data, k, (off_t)wi->offset);
+      (void)r;
+    }
+    return reply_err(in->unique, EIO);
+  }
+  int e = fault_for(OP_WRITE);
+  if (e) return reply_err(in->unique, e);
+  ssize_t n = pwrite((int)wi->fh, data, wi->size, (off_t)wi->offset);
+  if (n < 0) return reply_err(in->unique, errno);
+  fuse_write_out wo;
+  memset(&wo, 0, sizeof wo);
+  wo.size = (uint32_t)n;
+  reply(in->unique, 0, &wo, sizeof wo);
+}
+
+void do_fsync(const fuse_in_header *in, const fuse_fsync_in *fi) {
+  if (dice(g_lost_prob.load(std::memory_order_relaxed))) {
+    // Lost fsync: ACK without durability; remember the fd so `clear`
+    // can replay the sync (heal = the cache survived after all).
+    std::lock_guard<std::mutex> lk(g_pending_mu);
+    g_pending.insert((int)fi->fh);
+    return reply_ok(in->unique);
+  }
+  int e = fault_for(OP_FSYNC);
+  if (e) return reply_err(in->unique, e);
+  int rc = (fi->fsync_flags & FUSE_FSYNC_FDATASYNC)
+               ? fdatasync((int)fi->fh)
+               : fsync((int)fi->fh);
+  if (rc != 0) return reply_err(in->unique, errno);
+  reply_ok(in->unique);
+}
+
+void do_release(const fuse_in_header *in, const fuse_release_in *ri) {
+  {
+    std::lock_guard<std::mutex> lk(g_pending_mu);
+    g_pending.erase((int)ri->fh);  // a pending sync dies with the fd
+  }
+  close((int)ri->fh);
+  reply_ok(in->unique);
+}
+
+void do_opendir(const fuse_in_header *in) {
+  std::string abs = g_nodes.abs(in->nodeid);
+  if (abs.empty()) return reply_err(in->unique, ENOENT);
+  DIR *d = opendir(abs.c_str());
+  if (!d) return reply_err(in->unique, errno);
+  DirSnap *snap = new DirSnap();
+  struct dirent *de;
+  while ((de = readdir(d)) != nullptr)
+    snap->ents.push_back({de->d_name, (uint64_t)de->d_ino,
+                          (uint32_t)de->d_type});
+  closedir(d);
+  fuse_open_out oo;
+  memset(&oo, 0, sizeof oo);
+  oo.fh = (uint64_t)(uintptr_t)snap;
+  reply(in->unique, 0, &oo, sizeof oo);
+}
+
+void do_readdir(const fuse_in_header *in, const fuse_read_in *ri) {
+  DirSnap *snap = (DirSnap *)(uintptr_t)ri->fh;
+  if (!snap) return reply_err(in->unique, EBADF);
+  std::vector<char> buf;
+  buf.reserve(ri->size);
+  size_t i = (size_t)ri->offset;
+  while (i < snap->ents.size()) {
+    const auto &ent = snap->ents[i];
+    size_t entlen = sizeof(fuse_dirent) + ent.name.size();
+    size_t padded = (entlen + 7) & ~size_t(7);
+    if (buf.size() + padded > ri->size) break;
+    fuse_dirent de;
+    de.ino = ent.ino;
+    de.off = (uint64_t)(i + 1);   // next offset cookie
+    de.namelen = (uint32_t)ent.name.size();
+    de.type = ent.type;
+    size_t base = buf.size();
+    buf.resize(base + padded, 0);
+    memcpy(&buf[base], &de, sizeof de);
+    memcpy(&buf[base + sizeof de], ent.name.data(), ent.name.size());
+    i++;
+  }
+  reply(in->unique, 0, buf.data(), buf.size());
+}
+
+void do_releasedir(const fuse_in_header *in, const fuse_release_in *ri) {
+  delete (DirSnap *)(uintptr_t)ri->fh;
+  reply_ok(in->unique);
+}
+
+void do_mkdir(const fuse_in_header *in, const fuse_mkdir_in *mi,
+              const char *name) {
+  std::string rel = child_rel(in->nodeid, name);
+  if (in->nodeid != 1 && rel.empty()) return reply_err(in->unique, ENOENT);
+  std::string abs = g_backing + "/" + rel;
+  if (mkdir(abs.c_str(), mi->mode) != 0)
+    return reply_err(in->unique, errno);
+  struct stat st;
+  if (lstat(abs.c_str(), &st) != 0) return reply_err(in->unique, errno);
+  reply_entry(in->unique, g_nodes.lookup(rel), st);
+}
+
+void do_mknod(const fuse_in_header *in, const fuse_mknod_in *mi,
+              const char *name) {
+  std::string rel = child_rel(in->nodeid, name);
+  if (in->nodeid != 1 && rel.empty()) return reply_err(in->unique, ENOENT);
+  std::string abs = g_backing + "/" + rel;
+  if (mknod(abs.c_str(), mi->mode, mi->rdev) != 0)
+    return reply_err(in->unique, errno);
+  struct stat st;
+  if (lstat(abs.c_str(), &st) != 0) return reply_err(in->unique, errno);
+  reply_entry(in->unique, g_nodes.lookup(rel), st);
+}
+
+void do_unlink(const fuse_in_header *in, const char *name, bool isdir) {
+  std::string rel = child_rel(in->nodeid, name);
+  if (in->nodeid != 1 && rel.empty()) return reply_err(in->unique, ENOENT);
+  std::string abs = g_backing + "/" + rel;
+  int rc = isdir ? rmdir(abs.c_str()) : unlink(abs.c_str());
+  if (rc != 0) return reply_err(in->unique, errno);
+  reply_ok(in->unique);
+}
+
+void do_rename(const fuse_in_header *in, uint64_t newdir,
+               const char *oldname, const char *newname) {
+  std::string from = child_rel(in->nodeid, oldname);
+  std::string to = child_rel(newdir, newname);
+  if ((in->nodeid != 1 && from.empty()) || (newdir != 1 && to.empty()))
+    return reply_err(in->unique, ENOENT);
+  if (rename((g_backing + "/" + from).c_str(),
+             (g_backing + "/" + to).c_str()) != 0)
+    return reply_err(in->unique, errno);
+  g_nodes.rename(from, to);
+  reply_ok(in->unique);
+}
+
+void do_statfs(const fuse_in_header *in) {
+  struct statvfs sv;
+  if (statvfs(g_backing.c_str(), &sv) != 0)
+    return reply_err(in->unique, errno);
+  fuse_kstatfs st;
+  memset(&st, 0, sizeof st);
+  st.blocks = sv.f_blocks;
+  st.bfree = sv.f_bfree;
+  st.bavail = sv.f_bavail;
+  st.files = sv.f_files;
+  st.ffree = sv.f_ffree;
+  st.bsize = (uint32_t)sv.f_bsize;
+  st.namelen = (uint32_t)sv.f_namemax;
+  st.frsize = (uint32_t)sv.f_frsize;
+  reply(in->unique, 0, &st, sizeof st);
+}
+
+void do_init(const fuse_in_header *in, const fuse_init_in *ii) {
+  fuse_init_out out;
+  memset(&out, 0, sizeof out);
+  out.major = 7;
+  out.minor = ii->minor < 31 ? ii->minor : 31;
+  out.max_readahead = 0;          // direct_io: no readahead cache
+  out.flags = 0;                  // no optional kernel features
+  out.max_background = 12;
+  out.congestion_threshold = 9;
+  out.max_write = 128 * 1024;
+  out.time_gran = 1;
+  // Pre-7.23 kernels expect a 24-byte init_out; everything current
+  // (>= 4.x) takes the full 64.
+  size_t len = ii->minor < 23 ? 24 : sizeof out;
+  reply(in->unique, 0, &out, len);
+}
+
+// ---------------------------------------------------------------- main loop
+
+void on_term(int) {
+  umount2(g_mnt, MNT_DETACH);
+  _exit(0);
+}
+
+int serve() {
+  std::vector<char> buf(1 << 20);
+  for (;;) {
+    ssize_t n = read(g_dev, buf.data(), buf.size());
+    if (n < 0) {
+      if (errno == EINTR || errno == EAGAIN) continue;
+      if (errno == ENODEV) return 0;      // unmounted externally
+      perror("faultfs: /dev/fuse read");
+      return 1;
+    }
+    if ((size_t)n < sizeof(fuse_in_header)) continue;
+    const fuse_in_header *in = (const fuse_in_header *)buf.data();
+    const char *arg = buf.data() + sizeof(fuse_in_header);
+    switch (in->opcode) {
+      case FUSE_INIT:
+        do_init(in, (const fuse_init_in *)arg);
+        break;
+      case FUSE_LOOKUP:
+        do_lookup(in, arg);
+        break;
+      case FUSE_FORGET:
+        g_nodes.forget(in->nodeid, ((const fuse_forget_in *)arg)->nlookup);
+        break;                              // no reply
+      case FUSE_BATCH_FORGET: {
+        const fuse_batch_forget_in *bi = (const fuse_batch_forget_in *)arg;
+        const fuse_forget_one *one =
+            (const fuse_forget_one *)(arg + sizeof *bi);
+        for (uint32_t i = 0; i < bi->count; i++)
+          g_nodes.forget(one[i].nodeid, one[i].nlookup);
+        break;                              // no reply
+      }
+      case FUSE_GETATTR:
+        do_getattr(in, (const fuse_getattr_in *)arg);
+        break;
+      case FUSE_SETATTR:
+        do_setattr(in, (const fuse_setattr_in *)arg);
+        break;
+      case FUSE_OPEN:
+        do_open(in, (const fuse_open_in *)arg);
+        break;
+      case FUSE_CREATE:
+        do_create(in, (const fuse_create_in *)arg,
+                  arg + sizeof(fuse_create_in));
+        break;
+      case FUSE_READ:
+        do_read(in, (const fuse_read_in *)arg);
+        break;
+      case FUSE_WRITE:
+        do_write(in, (const fuse_write_in *)arg,
+                 arg + sizeof(fuse_write_in));
+        break;
+      case FUSE_FSYNC:
+      case FUSE_FSYNCDIR:
+        do_fsync(in, (const fuse_fsync_in *)arg);
+        break;
+      case FUSE_FLUSH:
+        reply_ok(in->unique);
+        break;
+      case FUSE_RELEASE:
+        do_release(in, (const fuse_release_in *)arg);
+        break;
+      case FUSE_OPENDIR:
+        do_opendir(in);
+        break;
+      case FUSE_READDIR:
+        do_readdir(in, (const fuse_read_in *)arg);
+        break;
+      case FUSE_RELEASEDIR:
+        do_releasedir(in, (const fuse_release_in *)arg);
+        break;
+      case FUSE_MKDIR:
+        do_mkdir(in, (const fuse_mkdir_in *)arg,
+                 arg + sizeof(fuse_mkdir_in));
+        break;
+      case FUSE_MKNOD:
+        do_mknod(in, (const fuse_mknod_in *)arg,
+                 arg + sizeof(fuse_mknod_in));
+        break;
+      case FUSE_UNLINK:
+        do_unlink(in, arg, false);
+        break;
+      case FUSE_RMDIR:
+        do_unlink(in, arg, true);
+        break;
+      case FUSE_RENAME: {
+        const fuse_rename_in *ri = (const fuse_rename_in *)arg;
+        const char *oldname = arg + sizeof *ri;
+        do_rename(in, ri->newdir, oldname, oldname + strlen(oldname) + 1);
+        break;
+      }
+      case FUSE_RENAME2: {
+        const fuse_rename2_in *ri = (const fuse_rename2_in *)arg;
+        if (ri->flags != 0) {               // RENAME_EXCHANGE etc.
+          reply_err(in->unique, EINVAL);
+          break;
+        }
+        const char *oldname = arg + sizeof *ri;
+        do_rename(in, ri->newdir, oldname, oldname + strlen(oldname) + 1);
+        break;
+      }
+      case FUSE_STATFS:
+        do_statfs(in);
+        break;
+      case FUSE_ACCESS: {
+        std::string abs = g_nodes.abs(in->nodeid);
+        if (abs.empty()) reply_err(in->unique, ENOENT);
+        else if (access(abs.c_str(),
+                        (int)((const fuse_access_in *)arg)->mask) != 0)
+          reply_err(in->unique, errno);
+        else reply_ok(in->unique);
+        break;
+      }
+      case FUSE_FALLOCATE: {
+        const fuse_fallocate_in *fi = (const fuse_fallocate_in *)arg;
+        int e = fault_for(OP_WRITE);
+        if (e) { reply_err(in->unique, e); break; }
+        if (fallocate((int)fi->fh, (int)fi->mode, (off_t)fi->offset,
+                      (off_t)fi->length) != 0)
+          reply_err(in->unique, errno);
+        else reply_ok(in->unique);
+        break;
+      }
+      case FUSE_LSEEK: {
+        const fuse_lseek_in *li = (const fuse_lseek_in *)arg;
+        off_t off = lseek((int)li->fh, (off_t)li->offset, (int)li->whence);
+        if (off < 0) reply_err(in->unique, errno);
+        else {
+          fuse_lseek_out lo = {(uint64_t)off};
+          reply(in->unique, 0, &lo, sizeof lo);
+        }
+        break;
+      }
+      case FUSE_INTERRUPT:
+        break;                              // no reply, ever
+      case FUSE_DESTROY:
+        reply_ok(in->unique);
+        return 0;
+      default:
+        reply_err(in->unique, ENOSYS);
+        break;
+    }
+  }
+}
+
+int mount_fuse(const char *mnt) {
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (stat(g_backing.c_str(), &st) != 0) {
+    close(fd);
+    return -1;
+  }
+  char opts[256];
+  snprintf(opts, sizeof opts,
+           "fd=%d,rootmode=%o,user_id=%u,group_id=%u,allow_other,"
+           "default_permissions",
+           fd, st.st_mode & S_IFMT, getuid(), getgid());
+  if (mount("faultfs", mnt, "fuse.faultfs", MS_NOSUID | MS_NODEV,
+            opts) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int probe() {
+  // Can this host create FUSE mounts at all?  Mount an empty fs over a
+  // temp dir and immediately detach it — no requests are ever served.
+  char tmpl[] = "/tmp/faultfs-probe-XXXXXX";
+  char *dir = mkdtemp(tmpl);
+  if (!dir) return 1;
+  g_backing = "/tmp";
+  int fd = mount_fuse(dir);
+  int ok = fd >= 0;
+  if (ok) {
+    umount2(dir, MNT_DETACH);
+    close(fd);
+  }
+  rmdir(dir);
+  printf(ok ? "ok\n" : "unsupported\n");
+  return ok ? 0 : 1;
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc >= 2 && strcmp(argv[1], "--probe") == 0) return probe();
+  if (argc < 3) {
+    fprintf(stderr,
+            "usage: %s BACKING_DIR MOUNTPOINT [--port N] | --probe\n",
+            argv[0]);
+    return 2;
+  }
+  char backing_real[4096];
+  if (!realpath(argv[1], backing_real)) {
+    perror("faultfs: backing dir");
+    return 1;
+  }
+  g_backing = backing_real;
+  if (!realpath(argv[2], g_mnt)) {
+    perror("faultfs: mountpoint");
+    return 1;
+  }
+  for (int i = 3; i + 1 < argc; i++)
+    if (strcmp(argv[i], "--port") == 0) g_port = atoi(argv[i + 1]);
+
+  int fd = mount_fuse(g_mnt);
+  if (fd < 0) {
+    perror("faultfs: mount");
+    return 1;
+  }
+  g_dev = fd;
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_handler = on_term;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
+
+  pthread_t tid;
+  pthread_create(&tid, nullptr, control_loop, nullptr);
+  pthread_detach(tid);
+
+  int rc = serve();
+  umount2(g_mnt, MNT_DETACH);
+  return rc;
+}

@@ -10,12 +10,19 @@ tier runs a real etcd under docker, core_test.clj:54-108).
 
 Line protocol (one request per line, one reply line):
     GET k            -> VAL v | NIL
-    SET k v          -> OK
-    CAS k old new    -> OK | FAIL | NIL
+    SET k v          -> OK | ERR disk <errno>
+    CAS k old new    -> OK | FAIL | NIL | ERR disk <errno>
 Every mutation is logged to the --log file (the harness downloads it).
+
+With --data-dir the daemon is DURABLE: every mutation is appended to
+<data-dir>/kvd.data with write+fsync BEFORE it is applied in memory,
+and the file is replayed at startup.  That data dir is the surface the
+faultfs disk-fault layer mounts over: an injected EIO surfaces to the
+client as `ERR disk`, with the mutation provably not applied.
 """
 
 import argparse
+import os
 import socket
 import socketserver
 import sys
@@ -24,11 +31,48 @@ import time
 
 
 class Store:
-    def __init__(self, log_path, unsafe_cas=False):
+    def __init__(self, log_path, unsafe_cas=False, data_dir=None):
         self.kv = {}
         self.lock = threading.Lock()
         self.unsafe_cas = unsafe_cas
         self.log = open(log_path, "a", buffering=1)
+        self.data_path = None
+        self.data = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self.data_path = os.path.join(data_dir, "kvd.data")
+            try:
+                with open(self.data_path, "rb") as f:
+                    for ln in f:
+                        parts = ln.decode("utf-8", "replace").split()
+                        if len(parts) == 2:
+                            self.kv[parts[0]] = parts[1]
+            except OSError:
+                pass
+
+    def persist(self, k, v):
+        """Durably append k v (unbuffered write + fsync) BEFORE the
+        in-memory apply; OSError propagates so the handler replies
+        `ERR disk` with the mutation NOT applied.  The handle is
+        dropped after a failure so no half-buffered line survives to
+        leak into a later append.  (A torn append that does reach the
+        disk may be replayed at next startup — within a run there is no
+        restart, so histories stay honest.)"""
+        if self.data_path is None:
+            return
+        try:
+            if self.data is None:
+                self.data = open(self.data_path, "ab", buffering=0)
+            self.data.write(("%s %s\n" % (k, v)).encode())
+            os.fsync(self.data.fileno())
+        except OSError:
+            try:
+                if self.data is not None:
+                    self.data.close()
+            except OSError:
+                pass
+            self.data = None
+            raise
 
     def logline(self, msg):
         self.log.write("%.6f %s\n" % (time.time(), msg))
@@ -47,9 +91,15 @@ class Handler(socketserver.StreamRequestHandler):
                 out = "NIL" if v is None else f"VAL {v}"
             elif cmd == "SET" and len(args) == 2:
                 with store.lock:
-                    store.kv[args[0]] = args[1]
-                store.logline(f"SET {args[0]}={args[1]}")
-                out = "OK"
+                    try:
+                        store.persist(args[0], args[1])
+                    except OSError as e:
+                        out = "ERR disk %s" % (e.errno or "")
+                    else:
+                        store.kv[args[0]] = args[1]
+                        out = "OK"
+                if out == "OK":
+                    store.logline(f"SET {args[0]}={args[1]}")
             elif cmd == "CAS" and len(args) == 3:
                 if store.unsafe_cas:
                     # deliberately racy check-then-set (no lock, widened
@@ -59,16 +109,26 @@ class Handler(socketserver.StreamRequestHandler):
                     time.sleep(0.002)
                     ok = cur is not None and cur == args[1]
                     if ok:
-                        store.kv[args[0]] = args[2]
-                    out = ("OK" if ok
+                        try:
+                            store.persist(args[0], args[2])
+                        except OSError:
+                            ok = None       # disk refused; not applied
+                        else:
+                            store.kv[args[0]] = args[2]
+                    out = ("ERR disk" if ok is None else "OK" if ok
                            else "NIL" if cur is None else "FAIL")
                 else:
                     with store.lock:
                         cur = store.kv.get(args[0])
                         ok = cur is not None and cur == args[1]
                         if ok:
-                            store.kv[args[0]] = args[2]
-                    out = ("OK" if ok
+                            try:
+                                store.persist(args[0], args[2])
+                            except OSError:
+                                ok = None   # disk refused; not applied
+                            else:
+                                store.kv[args[0]] = args[2]
+                    out = ("ERR disk" if ok is None else "OK" if ok
                            else "NIL" if cur is None else "FAIL")
                 if ok:
                     store.logline(
@@ -90,9 +150,13 @@ def main():
     ap.add_argument("--port", type=int, default=17711)
     ap.add_argument("--log", default="/tmp/kvd.log")
     ap.add_argument("--unsafe-cas", action="store_true")
+    ap.add_argument("--data-dir", default=None,
+                    help="persist mutations here (write+fsync each), "
+                         "replayed at startup; the faultfs mount point")
     a = ap.parse_args()
     srv = Server(("0.0.0.0", a.port), Handler)
-    srv.store = Store(a.log, unsafe_cas=a.unsafe_cas)
+    srv.store = Store(a.log, unsafe_cas=a.unsafe_cas,
+                      data_dir=a.data_dir)
     srv.store.logline(f"kvd listening on {a.port}")
     try:
         srv.serve_forever()

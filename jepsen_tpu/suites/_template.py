@@ -118,17 +118,61 @@ class QueueClient(client_mod.Client):
             return op.assoc(type="info", error=str(e))
 
 
+def resolve_named_nemeses(registry: dict, opts: dict,
+                          default: Optional[list] = None
+                          ) -> Optional[dict]:
+    """--nemesis names -> ONE named nemesis map ({name client during
+    final clocks}), composed via nem.compose_named when several names
+    are given.  Names come from opts["nemesis"], the CLI's argv-options
+    submap, or `default`; None when none of those yield names (the
+    suite's own default nemesis applies).  Every registry entry is a
+    single-gen map, so each is re-cadenced to --nemesis-interval before
+    composition (after composition the fs carry routing tags and the
+    cadence is baked in)."""
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    names = opts.get("nemesis") or av.get("nemesis") or default
+    if not names:
+        return None
+    try:
+        maps = [registry[n]() for n in names]
+    except KeyError as e:
+        raise ValueError(
+            f"unknown nemesis {e.args[0]!r}; one of {sorted(registry)}")
+    interval = opts.get("nemesis-interval", 5)
+    for m in maps:
+        m["during"] = gen.start_stop(interval, interval)
+    return maps[0] if len(maps) == 1 else nem.compose_named(maps)
+
+
 def register_test(name: str, db, client: client_mod.Client,
                   opts: dict, nemesis: Optional[nem.Nemesis] = None,
-                  factory_key: str = "kv-factory") -> dict:
+                  factory_key: str = "kv-factory",
+                  nemesis_map: Optional[dict] = None) -> dict:
     """The zookeeper.clj test shape: independent-keys register checked
     for per-key linearizability, partition-random-halves nemesis on
-    the standard cadence."""
+    the standard cadence.  A `nemesis_map` (a named map, e.g. from
+    resolve_named_nemeses) overrides `nemesis` and wires the map's own
+    during/final generators as phases."""
     from jepsen_tpu import tests as tst
 
     opts = dict(opts or {})
     nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
     wl = linreg_wl.suite_workload(opts)
+    if nemesis_map is not None:
+        nemesis = nemesis_map["client"]
+        generator = gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.nemesis(nemesis_map["during"], wl["generator"])),
+            gen.nemesis(nemesis_map["final"], gen.void))
+    else:
+        generator = gen.time_limit(
+            opts.get("time-limit", 60),
+            gen.nemesis(
+                gen.start_stop(opts.get("nemesis-interval", 5),
+                               opts.get("nemesis-interval", 5)),
+                wl["generator"]))
     test = dict(tst.noop_test(), **{
         "name": name,
         "nodes": nodes,
@@ -141,12 +185,7 @@ def register_test(name: str, db, client: client_mod.Client,
         "nemesis": (nemesis if nemesis is not None
                     else nem.partition_random_halves()),
         factory_key: opts.get(factory_key),
-        "generator": gen.time_limit(
-            opts.get("time-limit", 60),
-            gen.nemesis(
-                gen.start_stop(opts.get("nemesis-interval", 5),
-                               opts.get("nemesis-interval", 5)),
-                wl["generator"])),
+        "generator": generator,
         "checker": ck.compose({
             "linear": wl["checker"],
             "timeline": independent.checker(timeline.html_timeline()),

@@ -337,43 +337,12 @@ def nemesis_double_gen() -> dict:
                 lambda t, p: {"type": "info", "f": "stop2"}])}
 
 
-def _tag_f(name: str, source):
-    """Wrap a generator so emitted ops carry f=(name, inner-f) — the
-    namespacing compose() uses for routing (nemesis.clj:80-103)."""
-    def retag(op):
-        if op is None:
-            return None
-        if isinstance(op, dict):
-            out = dict(op)
-            out["f"] = (name, out.get("f"))
-            return out
-        return op.assoc(f=(name, op.f))
-    return gen.gmap(retag, source)
-
-
-def compose_named(nemeses) -> dict:
-    """nemesis.clj compose :62-107: merge named nemesis maps into one
-    {name clocks client during final}, ops tagged (name, f) and routed
-    back to their owners."""
-    nemeses = [n for n in nemeses if n]
-    names = [n["name"] for n in nemeses]
-    assert len(set(names)) == len(names), f"duplicate nemeses: {names}"
-    routes = {}
-    for nm in nemeses:
-        def route(f, _name=nm["name"]):
-            if isinstance(f, tuple) and len(f) == 2 and f[0] == _name:
-                return f[1]
-            return None
-        routes[route] = nm["client"]
-    return {
-        "name": "+".join(names),
-        "clocks": any(n.get("clocks") for n in nemeses),
-        "client": nem.compose(routes),
-        "during": gen.mix([_tag_f(n["name"], n["during"])
-                           for n in nemeses]),
-        "final": gen.concat(*[_tag_f(n["name"], n["final"])
-                              for n in nemeses]),
-    }
+# The named-map tagging/composition machinery moved to nemesis.py
+# (named_nemesis / tag_f / compose_named) so non-suite nemeses — the
+# disk-fault recipes in faultfs.py — can publish registry entries too;
+# re-exported here because this suite is their reference home.
+_tag_f = nem.tag_f
+compose_named = nem.compose_named
 
 
 def none() -> dict:

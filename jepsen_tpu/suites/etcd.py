@@ -36,6 +36,7 @@ from jepsen_tpu import client as client_mod
 from jepsen_tpu import control as c
 from jepsen_tpu import control_util as cu
 from jepsen_tpu import db as db_mod
+from jepsen_tpu import faultfs
 from jepsen_tpu import generator as gen
 from jepsen_tpu import independent, models, nemesis, net
 from jepsen_tpu.checker import timeline
@@ -63,10 +64,26 @@ def initial_cluster(test) -> str:
 
 
 class EtcdDB(db_mod.DB, db_mod.LogFiles):
-    """etcd.clj db :55-91."""
+    """etcd.clj db :55-91.
+
+    With disk_faults on, the data dir is put under faultfs before the
+    daemon starts: preferably a FUSE mount (which reaches etcd even
+    though it is a statically-linked Go binary — the LD_PRELOAD
+    interposer never would), else the interposer env fallback with its
+    logged partial-coverage warning."""
+
+    def __init__(self, disk_faults: bool = False,
+                 faultfs_port: int = faultfs.DEFAULT_PORT):
+        self.disk_faults = disk_faults
+        self.faultfs_port = faultfs_port
 
     def setup(self, test, node):
         cu.install_archive(URL, DIR)
+        env = None
+        if self.disk_faults:
+            mech = faultfs.mount(test, node, DATA_DIR,
+                                 port=self.faultfs_port)
+            env = mech["env"] or None
         cu.start_daemon(
             f"{DIR}/etcd",
             "--name", node,
@@ -77,7 +94,7 @@ class EtcdDB(db_mod.DB, db_mod.LogFiles):
             "--initial-cluster", initial_cluster(test),
             "--initial-cluster-state", "new",
             "--data-dir", DATA_DIR,
-            chdir=DIR, logfile=LOGFILE, pidfile=PIDFILE)
+            chdir=DIR, logfile=LOGFILE, pidfile=PIDFILE, env=env)
         # wait for the member to come up before letting clients loose
         c.execute(lit(
             "for i in $(seq 1 60); do "
@@ -86,6 +103,10 @@ class EtcdDB(db_mod.DB, db_mod.LogFiles):
 
     def teardown(self, test, node):
         cu.stop_daemon(PIDFILE, f"{DIR}/etcd")
+        if self.disk_faults:
+            faultfs.unmount(DATA_DIR)
+            c.execute("rm", "-rf", faultfs.backing_dir(DATA_DIR),
+                      check=False)
         c.execute("rm", "-rf", DATA_DIR, check=False)
 
     def log_files(self, test, node):
@@ -184,6 +205,20 @@ class EtcdClient(client_mod.Client):
 
 
 # ---------------------------------------------------------------------------
+# Nemesis registry — parts (the etcd.clj default) plus the disk-fault
+# recipes, compose-able via --nemesis repetition (runner.clj:42-56)
+# ---------------------------------------------------------------------------
+
+def _parts() -> dict:
+    """Random-halves partition as a named map (etcd.clj's nemesis)."""
+    return nemesis.named_nemesis("parts",
+                                 nemesis.partition_random_halves())
+
+
+nemeses = {"parts": _parts, **faultfs.nemeses}
+
+
+# ---------------------------------------------------------------------------
 # Workload (etcd.clj:145-180)
 # ---------------------------------------------------------------------------
 
@@ -205,6 +240,11 @@ def etcd_test(opts) -> dict:
     """Build the test map from CLI options (etcd.clj etcd-test
     :149-180)."""
     opts = dict(opts or {})
+    from jepsen_tpu.suites._template import resolve_named_nemeses
+    nm = resolve_named_nemeses(nemeses, opts, default=["parts"])
+    av = opts.get("argv-options") or {}
+    disk = any(n in faultfs.DISK_NEMESES
+               for n in (opts.get("nemesis") or av.get("nemesis") or []))
     nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
     per_key = opts.get("ops-per-key", 300)
     checker_mode = opts.get("checker-mode", "device")
@@ -226,21 +266,24 @@ def etcd_test(opts) -> dict:
         "nodes": nodes,
         "concurrency": conc,
         "ssh": opts.get("ssh", {}),
-        "db": EtcdDB(),
+        "db": EtcdDB(disk_faults=disk),
         "client": EtcdClient(),
         "net": net.iptables,
-        "nemesis": nemesis.partition_random_halves(),
-        "generator": gen.time_limit(
-            opts.get("time-limit", 60),
-            gen.nemesis(
-                gen.start_stop(opts.get("nemesis-interval", 5),
-                               opts.get("nemesis-interval", 5)),
-                independent.concurrent_generator(
-                    tpk,
-                    itertools.count(),
-                    lambda k: gen.limit(per_key,
-                                        gen.stagger(1 / 30,
-                                                    gen.mix([r, w, cas])))))),
+        "nemesis": nm["client"],
+        "disk-faults": disk,
+        "generator": gen.phases(
+            gen.time_limit(
+                opts.get("time-limit", 60),
+                gen.nemesis(
+                    nm["during"],
+                    independent.concurrent_generator(
+                        tpk,
+                        itertools.count(),
+                        lambda k: gen.limit(
+                            per_key,
+                            gen.stagger(1 / 30,
+                                        gen.mix([r, w, cas])))))),
+            gen.nemesis(nm["final"], gen.void)),
         "checker": ck.compose({
             "perf": ck.perf(),
             "indep": ck.compose({
@@ -251,9 +294,13 @@ def etcd_test(opts) -> dict:
     })
 
 
+def _opt_fn(parser):
+    cli.nemesis_opt_spec(parser, nemeses, default="parts")
+
+
 def main(argv=None):
-    """etcd.clj -main :182-188."""
-    cli.run(cli.single_test_cmd(etcd_test), argv)
+    """etcd.clj -main :182-188 (+ the --nemesis registry flag)."""
+    cli.run(cli.single_test_cmd(etcd_test, _opt_fn), argv)
 
 
 if __name__ == "__main__":

@@ -28,37 +28,58 @@ import os
 import socket
 from typing import Optional
 
+from jepsen_tpu import cli
 from jepsen_tpu import control as c
 from jepsen_tpu import control_util as cu
 from jepsen_tpu import db as db_mod
+from jepsen_tpu import faultfs
 from jepsen_tpu import nemesis as nem
 from jepsen_tpu.control import lit
 from jepsen_tpu.suites._template import (KVRegisterClient,
-                                         register_test, simple_main)
+                                         register_test,
+                                         resolve_named_nemeses,
+                                         simple_main)
 
 PORT = 17711
 DIR = "/tmp/jepsen-kvd"
+DATA_DIR = f"{DIR}/data"            # the faultfs mountpoint
+FAULTFS_PORT = 17718
 SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "resources", "kvd.py")
 
 
 class KvdDB(db_mod.DB, db_mod.LogFiles):
     """Upload + daemonize resources/kvd.py (the etcd.clj:55-76 shape:
-    install artifact, start-daemon with pidfile, await liveness)."""
+    install artifact, start-daemon with pidfile, await liveness).
 
-    def __init__(self, unsafe_cas: bool = False):
+    With disk_faults on, DATA_DIR goes under faultfs BEFORE the daemon
+    starts (FUSE mount preferred, LD_PRELOAD env fallback with its
+    logged scope warning) and the daemon runs durable (--data-dir),
+    fsyncing every mutation through the fault layer."""
+
+    def __init__(self, unsafe_cas: bool = False,
+                 disk_faults: bool = False,
+                 faultfs_port: int = FAULTFS_PORT):
         self.unsafe_cas = unsafe_cas
+        self.disk_faults = disk_faults
+        self.faultfs_port = faultfs_port
 
     def setup(self, test, node):
         c.execute("mkdir", "-p", DIR)
         c.upload(SRC, f"{DIR}/kvd.py")
         import sys
         extra = ["--unsafe-cas"] if self.unsafe_cas else []
+        env = None
+        if self.disk_faults:
+            mech = faultfs.mount(test, node, DATA_DIR,
+                                 port=self.faultfs_port)
+            env = mech["env"] or None
+            extra += ["--data-dir", DATA_DIR]
         cu.start_daemon(sys.executable, f"{DIR}/kvd.py",
                         "--port", str(PORT),
                         "--log", f"{DIR}/kvd.log", *extra,
                         chdir=DIR, logfile=f"{DIR}/daemon.log",
-                        pidfile=f"{DIR}/kvd.pid")
+                        pidfile=f"{DIR}/kvd.pid", env=env)
         c.execute(lit(
             "for i in $(seq 1 30); do "
             f"python3 -c 'import socket; socket.create_connection("
@@ -73,6 +94,12 @@ class KvdDB(db_mod.DB, db_mod.LogFiles):
                   f"kill -CONT $(cat {DIR}/kvd.pid)", check=False)
         cu.stop_daemon(f"{DIR}/kvd.pid", sys.executable)
         c.execute("rm", "-f", f"{DIR}/kvd.pid", check=False)
+        if self.disk_faults:
+            # after the SUT is dead: unmount (lazy escape hatch inside)
+            # and wipe both sides of the mount
+            faultfs.unmount(DATA_DIR)
+            c.execute("rm", "-rf", faultfs.backing_dir(DATA_DIR),
+                      DATA_DIR, check=False)
 
     def log_files(self, test, node):
         return [f"{DIR}/kvd.log", f"{DIR}/daemon.log"]
@@ -94,8 +121,12 @@ class KvdConn:
         return int(out[4:]) if out.startswith("VAL ") else None
 
     def put(self, k, v) -> None:
-        if not self._cmd(f"SET r{k} {v}").startswith("OK"):
-            raise RuntimeError("SET failed")
+        out = self._cmd(f"SET r{k} {v}")
+        if not out.startswith("OK"):
+            # e.g. "ERR disk 5" under an injected EIO; raising makes
+            # the worker journal :info (indeterminate) and recycle the
+            # process — the crashed-op path the crash-tier checkers eat
+            raise RuntimeError(f"SET failed: {out or 'no reply'}")
 
     def cas(self, k, old, new) -> bool:
         return self._cmd(f"CAS r{k} {old} {new}").startswith("OK")
@@ -130,6 +161,26 @@ def pauser():
         lambda nodes: random.choice(list(nodes)), start, stop)
 
 
+def _pause() -> dict:
+    """The default pauser as a named map, so it composes with the disk
+    recipes (--nemesis disk-eio --nemesis pause)."""
+    return nem.named_nemesis("pause", pauser())
+
+
+nemeses = {
+    "pause": _pause,
+    **{name: (lambda ctor=ctor: _localized(ctor()))
+       for name, ctor in faultfs.nemeses.items()},
+}
+
+
+def _localized(nm: dict) -> dict:
+    """kvd's disk nemeses talk to the faultfs daemon on this suite's
+    own control port (a shared CI box may run several faultfs mounts)."""
+    nm["client"].port = FAULTFS_PORT
+    return nm
+
+
 def kvd_test(opts) -> dict:
     opts = dict(opts or {})
     opts.setdefault("nodes", ["n1"])
@@ -143,16 +194,32 @@ def kvd_test(opts) -> dict:
         ssh["local"] = True
     ssh.pop("wire", None)
     opts["ssh"] = ssh
-    test = register_test("kvd", KvdDB(
-                             unsafe_cas=bool(opts.get("unsafe-cas"))),
+    av = opts.get("argv-options") or {}
+    names = list(opts.get("nemesis") or av.get("nemesis") or [])
+    nm = resolve_named_nemeses(nemeses, dict(opts, nemesis=names)) \
+        if names else None
+    disk = any(n in faultfs.DISK_NEMESES for n in names)
+    test = register_test("kvd",
+                         KvdDB(unsafe_cas=bool(opts.get("unsafe-cas")),
+                               disk_faults=disk),
                          KVRegisterClient(opts.get("kv-factory")
                                           or KvdConn),
-                         opts, nemesis=pauser())
+                         opts,
+                         nemesis=None if nm is not None else pauser(),
+                         nemesis_map=nm)
     test["invoke_timeout"] = opts.get("invoke-timeout", 10)
+    if disk:
+        # nodes are logical names over the local transport; the faultfs
+        # control plane lives on this host
+        test["faultfs-addr"] = lambda node: "127.0.0.1"
     return test
 
 
-main = simple_main(kvd_test)
+def _opt_fn(parser):
+    cli.nemesis_opt_spec(parser, nemeses, default="pause")
+
+
+main = simple_main(kvd_test, _opt_fn)
 
 if __name__ == "__main__":
     main()

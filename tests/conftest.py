@@ -14,7 +14,28 @@ after sitecustomize imported jax — still works.
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip the `fuse` marker where FUSE mounts are impossible
+    (like the kill9 marker, the battery is tier-1-safe where it CAN
+    run; elsewhere tier-1 must stay green rather than error).  The
+    probe actually mounts and detaches a transient fs — the exact
+    mechanism the battery uses — so it cannot pass spuriously."""
+    fuse_items = [it for it in items if "fuse" in it.keywords]
+    if not fuse_items:
+        return
+    from jepsen_tpu import faultfs
+    if faultfs.host_supports_fuse():
+        return
+    skip = pytest.mark.skip(
+        reason="host cannot create FUSE mounts (/dev/fuse + mount(2) "
+               "privilege, or fusermount3, unavailable)")
+    for item in fuse_items:
+        item.add_marker(skip)
 
 # The deep megakernel's CPU path is the Pallas interpreter — far too
 # slow for production CPU deployments (which keep the compiled fallback

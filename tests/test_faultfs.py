@@ -1,9 +1,22 @@
-"""End-to-end native disk-fault injection: compile libfaultinject.so,
-run a victim process under LD_PRELOAD, flip faults over the TCP control
-plane, observe EIO at the victim's libc boundary, heal, observe
-recovery.  Mirrors the capability of the reference's CharybdeFS
-(charybdefs.clj break-all / break-one-percent / clear)."""
+"""End-to-end native disk-fault injection, both mechanisms:
 
+* LD_PRELOAD interposer: compile libfaultinject.so, run a victim under
+  it, flip faults over TCP, observe EIO at the victim's libc boundary
+  (charybdefs.clj break-all / break-one-percent / clear recipes).
+* FUSE passthrough (faultfs_fuse): mount over a data dir and fault ANY
+  process — including a STATICALLY-LINKED victim the interposer
+  provably cannot reach (the scope gap is pinned by TestStaticScope,
+  not by prose) — plus the durability faults only a filesystem can do:
+  torn writes and dropped-then-replayed fsyncs.
+* DiskFaultNemesis: ledger register-before-inject, breaker-bounded
+  teardown against dead nodes, and the kvd suite end-to-end on a
+  faultfs-mounted data dir (`--nemesis disk-eio` → :info ops → the
+  crash-tier device check).
+
+FUSE-mount tests carry the `fuse` marker and auto-skip on hosts that
+cannot create FUSE mounts (tests/conftest.py)."""
+
+import os
 import re
 import socket
 import subprocess
@@ -15,7 +28,8 @@ import time
 import pytest
 
 from jepsen_tpu import control as c
-from jepsen_tpu import faultfs
+from jepsen_tpu import core, faultfs, store
+from jepsen_tpu import nemesis as nem
 
 VICTIM = textwrap.dedent("""
     import os, sys
@@ -229,3 +243,441 @@ class TestNemesis:
         ups = [cmd for _, cmd in cmds if "fault_inject.cpp" in cmd
                and cmd.startswith("<upload")]
         assert ups
+
+    def test_setup_skips_install_when_mount_recorded(self):
+        cmds = []
+
+        def handler(node, cmd, stdin):
+            cmds.append((node, cmd))
+            return ""
+
+        c.set_dummy_handler(handler)
+        try:
+            with c.with_ssh({"dummy": True}):
+                faultfs.disk_fault_nemesis().setup(
+                    {"nodes": ["n1"], "ssh": {"dummy": True},
+                     "disk-mechanism": {"n1": "fuse"}})
+        finally:
+            c.set_dummy_handler(None)
+        assert not cmds     # the DB's mount already provisioned n1
+
+
+# ---------------------------------------------------------------------------
+# The FUSE backend + the statically-linked victim (the scope pin)
+# ---------------------------------------------------------------------------
+
+STATIC_VICTIM = textwrap.dedent(r"""
+    #include <errno.h>
+    #include <fcntl.h>
+    #include <stdio.h>
+    #include <string.h>
+    #include <unistd.h>
+
+    int main(int argc, char **argv) {
+        const char *path = argv[1];
+        char line[64], buf[128];
+        printf("ready\n");
+        fflush(stdout);
+        while (fgets(line, sizeof line, stdin)) {
+            if (!strncmp(line, "quit", 4)) break;
+            if (!strncmp(line, "read", 4)) {
+                int fd = open(path, O_RDONLY);
+                if (fd < 0) { printf("err:%d\n", errno); }
+                else {
+                    ssize_t n = read(fd, buf, 64);
+                    if (n < 0) printf("err:%d\n", errno);
+                    else { buf[n] = 0; printf("ok:%s\n", buf); }
+                    close(fd);
+                }
+            } else if (!strncmp(line, "write", 5)) {
+                int fd = open(path, O_WRONLY | O_APPEND);
+                if (fd < 0) { printf("err:%d\n", errno); }
+                else {
+                    ssize_t n = write(fd, "0123456789abcdef", 16);
+                    if (n < 0) printf("err:%d\n", errno);
+                    else if (fsync(fd) != 0) printf("err:%d\n", errno);
+                    else printf("wrote:%zd\n", n);
+                    close(fd);
+                }
+            }
+            fflush(stdout);
+        }
+        return 0;
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def static_victim_bin(tmp_path_factory):
+    """A STATICALLY linked raw-syscall victim — the linkage class of
+    the Go-binary half of the suite matrix (etcd, consul, cockroach,
+    dgraph, tidb): no dynamic linker in the process, so LD_PRELOAD is
+    inert by construction."""
+    d = tmp_path_factory.mktemp("staticvictim")
+    src = d / "victim.c"
+    src.write_text(STATIC_VICTIM)
+    out = d / "victim"
+    r = subprocess.run(
+        ["gcc", "-static", "-O2", "-o", str(out), str(src)],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"no static libc on this host: {r.stderr[:200]}")
+    # sanity: really static
+    lddout = subprocess.run(["ldd", str(out)], capture_output=True,
+                            text=True)
+    assert ("not a dynamic executable" in lddout.stdout + lddout.stderr
+            or lddout.returncode != 0), lddout.stdout
+    return out
+
+
+@pytest.fixture(scope="module")
+def fuse_bin(tmp_path_factory):
+    d = tmp_path_factory.mktemp("faultfsbin")
+    out = d / "faultfs_fuse"
+    r = subprocess.run(
+        ["g++", "-O2", "-o", str(out),
+         str(faultfs.RESOURCES / "faultfs_fuse.cpp"), "-pthread"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return out
+
+
+def wait_control(port, deadline_s=10.0):
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        try:
+            return faultfs.get_config("127.0.0.1", port)
+        except OSError:
+            time.sleep(0.05)
+    pytest.fail("faultfs control port never came up")
+
+
+@pytest.fixture()
+def fusefs(fuse_bin, tmp_path):
+    """A live faultfs mount: (mountpoint, backing dir, control port)."""
+    backing = tmp_path / "backing"
+    mnt = tmp_path / "mnt"
+    backing.mkdir()
+    mnt.mkdir()
+    (backing / "f.txt").write_text("hello-disk")
+    port = free_port()
+    p = subprocess.Popen([str(fuse_bin), str(backing), str(mnt),
+                          "--port", str(port)])
+    try:
+        wait_control(port)
+        yield mnt, backing, port
+    finally:
+        p.terminate()
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        subprocess.run(["umount", "-l", str(mnt)], capture_output=True)
+
+
+class StaticVictim:
+    """Driver for the compiled static victim over stdin/stdout."""
+
+    def __init__(self, binary, path, env=None):
+        self.p = subprocess.Popen([str(binary), str(path)],
+                                  stdin=subprocess.PIPE,
+                                  stdout=subprocess.PIPE, text=True,
+                                  env=env)
+        assert self.p.stdout.readline().strip() == "ready"
+
+    def cmd(self, word):
+        self.p.stdin.write(word + "\n")
+        self.p.stdin.flush()
+        return self.p.stdout.readline().strip()
+
+    def close(self):
+        try:
+            self.p.stdin.write("quit\n")
+            self.p.stdin.close()
+            self.p.wait(timeout=10)
+        except (OSError, subprocess.TimeoutExpired):
+            self.p.kill()
+            self.p.wait(timeout=10)
+
+
+class TestStaticScope:
+    """The honest-scope pin: the SAME statically-linked victim is
+    provably missed by the LD_PRELOAD interposer and provably faulted
+    by the FUSE layer."""
+
+    def test_preload_interposer_misses_static_victim(
+            self, lib, static_victim_bin, tmp_path):
+        data = tmp_path / "data"
+        data.mkdir()
+        (data / "f.txt").write_text("hello-disk")
+        port = free_port()
+        env = {"LD_PRELOAD": str(lib), "FAULTFS_PATH": str(data),
+               "FAULTFS_PORT": str(port), "PATH": "/usr/bin:/bin"}
+        v = StaticVictim(static_victim_bin, data / "f.txt", env=env)
+        try:
+            # The interposer's constructor never ran: its control port
+            # never comes up, so there is nothing to even aim a fault
+            # at — LD_PRELOAD is inert for this linkage class.
+            t0 = time.time()
+            while time.time() - t0 < 1.0:
+                with pytest.raises(OSError):
+                    faultfs.get_config("127.0.0.1", port, timeout=0.2)
+                time.sleep(0.1)
+            # and the victim's data-dir reads proceed unfaulted
+            assert v.cmd("read") == "ok:hello-disk"
+            assert v.cmd("write").startswith("wrote:")
+        finally:
+            v.close()
+
+    @pytest.mark.fuse
+    def test_fuse_faults_static_victim(self, fusefs, static_victim_bin):
+        mnt, backing, port = fusefs
+        v = StaticVictim(static_victim_bin, mnt / "f.txt")
+        try:
+            assert v.cmd("read") == "ok:hello-disk"
+            assert faultfs.break_all("127.0.0.1", port) == "ok"
+            assert v.cmd("read") == "err:5"          # EIO, via the kernel
+            assert v.cmd("write") == "err:5"
+            assert faultfs.clear("127.0.0.1", port) == "ok"
+            assert v.cmd("read") == "ok:hello-disk"
+        finally:
+            v.close()
+
+    @pytest.mark.fuse
+    def test_fuse_latency_only_fault_on_static_victim(
+            self, fusefs, static_victim_bin):
+        mnt, backing, port = fusefs
+        v = StaticVictim(static_victim_bin, mnt / "f.txt")
+        try:
+            faultfs.set_fault("127.0.0.1", errno=0, prob_per_100k=100000,
+                              delay_us=200000, ops="read", port=port)
+            t0 = time.time()
+            assert v.cmd("read") == "ok:hello-disk"  # slow, not broken
+            assert time.time() - t0 >= 0.2
+            faultfs.clear("127.0.0.1", port)
+        finally:
+            v.close()
+
+
+@pytest.mark.fuse
+class TestFuseDurabilityFaults:
+    def test_torn_write_persists_first_k_bytes(self, fusefs):
+        mnt, backing, port = fusefs
+        assert faultfs.set_torn("127.0.0.1", 100000, first_bytes=7,
+                                port=port) == "ok"
+        fd = os.open(str(mnt / "torn.bin"),
+                     os.O_CREAT | os.O_WRONLY, 0o644)
+        try:
+            with pytest.raises(OSError) as ei:
+                os.write(fd, b"0123456789abcdef")
+            assert ei.value.errno == 5               # EIO to the writer
+        finally:
+            os.close(fd)
+        faultfs.clear("127.0.0.1", port)
+        # ... but the first k bytes really hit the backing store: the
+        # partial image recovery code must survive
+        assert (backing / "torn.bin").read_bytes() == b"0123456"
+
+    def test_lost_fsync_acked_then_replayed_on_clear(self, fusefs):
+        mnt, backing, port = fusefs
+        assert faultfs.set_lost_fsync("127.0.0.1", 100000,
+                                      port=port) == "ok"
+        fd = os.open(str(mnt / "f.txt"), os.O_WRONLY)
+        try:
+            os.write(fd, b"X")
+            os.fsync(fd)                             # ACKed, not durable
+            cfg = faultfs.get_config("127.0.0.1", port)
+            assert "pending=1" in cfg, cfg
+            # heal: clear replays the dropped sync on the still-open fd
+            assert faultfs.clear("127.0.0.1", port) == "ok"
+            cfg = faultfs.get_config("127.0.0.1", port)
+            assert "pending=0" in cfg, cfg
+        finally:
+            os.close(fd)
+
+    def test_get_reports_extended_config(self, fusefs):
+        mnt, backing, port = fusefs
+        faultfs.set_torn("127.0.0.1", 12345, first_bytes=99, port=port)
+        faultfs.set_lost_fsync("127.0.0.1", 777, port=port)
+        cfg = faultfs.get_config("127.0.0.1", port)
+        assert re.search(r"torn=12345 torn_bytes=99 lostsync=777", cfg)
+        faultfs.clear("127.0.0.1", port)
+
+
+# ---------------------------------------------------------------------------
+# DiskFaultNemesis: ledger discipline + breaker-bounded teardown
+# ---------------------------------------------------------------------------
+
+def nemesis_test_map(port):
+    return {"nodes": ["127.0.0.1"],
+            "fault_ledger": nem.FaultLedger(),
+            "faultfs-port": port}
+
+
+@pytest.mark.fuse
+class TestDiskFaultNemesisLedger:
+    def test_register_before_inject_and_backstop_heal(self, fusefs):
+        """A nemesis worker SIGKILLed mid-fault leaves the ledger entry
+        behind; core.run_case's backstop heal must clear the fault."""
+        mnt, backing, port = fusefs
+        n = faultfs.DiskFaultNemesis({"prob": 100000}, port=port)
+        test = nemesis_test_map(port)
+        from jepsen_tpu.history import Op
+        op = Op(process="nemesis", type="info", f="start")
+        out = n.invoke(test, op)
+        assert "ok" in str(out["disk-results"])
+        assert "prob=100000" in faultfs.get_config("127.0.0.1", port)
+        # the fault is in the ledger (registered BEFORE injection)
+        assert test["fault_ledger"].outstanding()
+        # nemesis worker dies here — no stop op.  The run_case backstop:
+        core._heal_outstanding_faults(test)
+        assert not test["fault_ledger"].outstanding()
+        assert "prob=0" in faultfs.get_config("127.0.0.1", port)
+
+    def test_stop_resolves_ledger(self, fusefs):
+        mnt, backing, port = fusefs
+        n = faultfs.DiskFaultNemesis({"prob": 100000}, port=port)
+        test = nemesis_test_map(port)
+        from jepsen_tpu.history import Op
+        n.invoke(test, Op(process="nemesis", type="info", f="start"))
+        n.invoke(test, Op(process="nemesis", type="info", f="stop"))
+        assert not test["fault_ledger"].outstanding()
+        assert "prob=0" in faultfs.get_config("127.0.0.1", port)
+
+    def test_legacy_break_heal_aliases(self, fusefs):
+        mnt, backing, port = fusefs
+        n = faultfs.DiskFaultNemesis(port=port)
+        test = nemesis_test_map(port)
+        from jepsen_tpu.history import Op
+        n.invoke(test, Op(process="nemesis", type="info", f="break"))
+        assert "prob=100000" in faultfs.get_config("127.0.0.1", port)
+        n.invoke(test, Op(process="nemesis", type="info", f="heal-disk"))
+        assert "prob=0" in faultfs.get_config("127.0.0.1", port)
+
+    def test_durability_recipe_sets_torn_and_lostsync(self, fusefs):
+        mnt, backing, port = fusefs
+        recipe = faultfs.disk_torn()["client"].recipe
+        n = faultfs.DiskFaultNemesis(recipe, port=port)
+        test = nemesis_test_map(port)
+        from jepsen_tpu.history import Op
+        n.invoke(test, Op(process="nemesis", type="info", f="start"))
+        cfg = faultfs.get_config("127.0.0.1", port)
+        assert "torn=20000" in cfg and "lostsync=20000" in cfg, cfg
+        n.teardown(test)
+        cfg = faultfs.get_config("127.0.0.1", port)
+        assert "torn=0" in cfg and "lostsync=0" in cfg, cfg
+
+
+class TestDeadNodeTeardown:
+    def test_teardown_against_dead_node_is_bounded(self):
+        """A node whose control plane is gone must cost teardown a few
+        fast refusals (retry ladder + breaker), not a hang."""
+        port = free_port()             # nothing listens here
+        n = faultfs.DiskFaultNemesis(port=port, retries=3, timeout=0.5)
+        test = {"nodes": ["127.0.0.1"], "fault_ledger": nem.FaultLedger()}
+        t0 = time.time()
+        n.teardown(test)               # must not raise
+        assert time.time() - t0 < 8.0
+        # breaker is open after consecutive failures: a second teardown
+        # fails fast without burning the ladder again
+        t0 = time.time()
+        n.teardown(test)
+        assert time.time() - t0 < 0.5
+
+    def test_clear_errors_are_strings_not_raises(self):
+        port = free_port()
+        n = faultfs.DiskFaultNemesis(port=port, retries=1, timeout=0.3)
+        out = n._clear_all({"nodes": ["127.0.0.1"]}, ["127.0.0.1"])
+        assert "error:" in out["127.0.0.1"]
+
+
+# ---------------------------------------------------------------------------
+# Mount helpers over the real local transport
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
+# End to end: kvd on a faultfs data dir — the L2 fault injection ->
+# L4 history -> L6 device-analysis loop (acceptance tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fuse
+class TestKvdDiskFaultsEndToEnd:
+    @pytest.fixture(autouse=True)
+    def store_tmpdir(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(store, "BASE", tmp_path / "store")
+        yield
+        subprocess.run(["pkill", "-CONT", "-f", "[k]vd.py"],
+                       capture_output=True)
+        subprocess.run(["pkill", "-9", "-f", "[k]vd.py"],
+                       capture_output=True)
+
+    def test_disk_eio_nemesis_to_crash_tier_verdict(self):
+        from jepsen_tpu.suites import kvd
+
+        t = kvd.kvd_test({"time-limit": 4, "ops-per-key": 30,
+                          "concurrency": 4, "nemesis-interval": 1,
+                          "nemesis": ["disk-eio"]})
+        # make every in-window disk op fail so the short run is
+        # guaranteed to produce client-visible faults
+        t["nemesis"].recipe["prob"] = 100000
+        # pre-seed the ledger: core.run copies the test map, so only a
+        # caller-provided ledger instance is observable after the run
+        t["fault_ledger"] = nem.FaultLedger()
+        res = core.run(t)
+
+        h = list(res["history"])
+        # the nemesis really drove the fault layer ...
+        starts = [op for op in h if op.f == "start"
+                  and "disk-results" in op]
+        assert starts, [op.f for op in h][:40]
+        assert any("ok" in str(op["disk-results"]) for op in starts)
+        # ... the SUT's clients saw indeterminate disk failures ...
+        infos = [op for op in h
+                 if op.type == "info" and op.f in ("read", "write", "cas")
+                 and op.error]
+        assert infos, "no :info ops — disk faults never reached clients"
+        assert any("disk" in str(op.error) for op in infos)
+        # ... and the crash-tier device check still returned a verdict
+        # (EIO'd ops are :info — either linearization must be allowed)
+        assert res["results"]["linear"]["valid?"] is True, \
+            res["results"]["linear"]
+        # every ledgered fault was healed on the way out
+        assert not t["fault_ledger"].outstanding()
+        # and the mount is gone (teardown unmounted + wiped)
+        assert f"faultfs {kvd.DATA_DIR} " not in open("/proc/mounts").read()
+
+
+@pytest.mark.fuse
+class TestMountLifecycle:
+    def test_mount_prefers_fuse_and_unmount_cleans_up(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setattr(faultfs, "LIB_DIR", str(tmp_path / "opt"))
+        monkeypatch.setattr(faultfs, "FUSE_BIN",
+                            str(tmp_path / "opt" / "faultfs_fuse"))
+        data = tmp_path / "data"
+        data.mkdir()
+        (data / "pre-existing.txt").write_text("keep me")
+        port = free_port()
+        test = {"nodes": ["n1"]}
+        with c.with_ssh({"local": True}):
+            sess = c.session("n1")
+            try:
+                with c.with_session("n1", sess):
+                    mech = faultfs.mount(test, "n1", str(data),
+                                         port=port)
+                    assert mech["mechanism"] == "fuse"
+                    assert test["disk-mechanism"]["n1"] == "fuse"
+                    # pre-existing data adopted through the mount
+                    assert ((data / "pre-existing.txt").read_text()
+                            == "keep me")
+                    wait_control(port)
+                    # the mount really routes: fault it, see EIO
+                    faultfs.break_all("127.0.0.1", port)
+                    with pytest.raises(OSError):
+                        (data / "pre-existing.txt").read_text()
+                    faultfs.clear("127.0.0.1", port)
+                    faultfs.unmount(str(data))
+                    mounts = open("/proc/mounts").read()
+                    assert f"faultfs {data} " not in mounts
+            finally:
+                sess.close()

@@ -883,3 +883,81 @@ class TestRound3SuiteTail:
         assert cnf, uploads
         assert "wsrep_sst_method=rsync" in cnf[0]
         assert "wsrep_sst_donor=n1" in cnf[0]
+
+
+class TestDiskNemesisPlumbing:
+    """--nemesis disk-* resolves through the suite registries (kvd plus
+    the etcd reference suite) and composes with the existing partition
+    and pause nemeses.  Pure plumbing: test-map construction and the
+    argv -> registry path, no FUSE mount involved."""
+
+    def test_etcd_disk_eio_resolves(self):
+        from jepsen_tpu import faultfs
+        from jepsen_tpu.suites import etcd
+
+        t = etcd.etcd_test({"nemesis": ["disk-eio"]})
+        assert isinstance(t["nemesis"], faultfs.DiskFaultNemesis)
+        assert t["disk-faults"] is True
+        assert t["db"].disk_faults is True
+
+    def test_etcd_default_is_partitioner_no_disk(self):
+        from jepsen_tpu import nemesis as nem
+        from jepsen_tpu.suites import etcd
+
+        t = etcd.etcd_test({})
+        assert isinstance(t["nemesis"], nem.Partitioner)
+        assert t["disk-faults"] is False
+        assert t["db"].disk_faults is False
+
+    def test_etcd_disk_composes_with_partition(self):
+        from jepsen_tpu import nemesis as nem
+        from jepsen_tpu.suites import etcd
+
+        t = etcd.etcd_test({"nemesis": ["parts", "disk-eio"]})
+        assert isinstance(t["nemesis"], nem.Compose)
+        assert t["disk-faults"] is True
+
+    def test_etcd_cli_argv_to_registry(self):
+        import argparse
+
+        from jepsen_tpu import cli
+        from jepsen_tpu import nemesis as nem
+        from jepsen_tpu.suites import etcd
+
+        parser = argparse.ArgumentParser()
+        cli.test_opt_spec(parser)
+        etcd._opt_fn(parser)
+        opts = parser.parse_args(
+            ["--nemesis", "disk-eio", "--nemesis", "parts", "--dummy"])
+        t = etcd.etcd_test(cli.options_to_test_opts(opts))
+        assert isinstance(t["nemesis"], nem.Compose)
+        assert t["disk-faults"] is True
+        # unknown names are rejected at the argparse layer (choices)
+        with pytest.raises(SystemExit):
+            parser.parse_args(["--nemesis", "nope"])
+
+    def test_kvd_disk_eio_resolves_on_suite_port(self):
+        from jepsen_tpu import faultfs
+        from jepsen_tpu.suites import kvd
+
+        t = kvd.kvd_test({"nemesis": ["disk-eio"]})
+        assert isinstance(t["nemesis"], faultfs.DiskFaultNemesis)
+        assert t["nemesis"].port == kvd.FAULTFS_PORT
+        assert t["faultfs-addr"]("n1") == "127.0.0.1"
+        assert t["db"].disk_faults is True
+
+    def test_kvd_composes_with_pause_and_keeps_default(self):
+        from jepsen_tpu import nemesis as nem
+        from jepsen_tpu.suites import kvd
+
+        t = kvd.kvd_test({"nemesis": ["pause", "disk-torn"]})
+        assert isinstance(t["nemesis"], nem.Compose)
+        t2 = kvd.kvd_test({})
+        assert isinstance(t2["nemesis"], nem.NodeStartStopper)
+        assert t2["db"].disk_faults is False
+
+    def test_unknown_disk_nemesis_raises(self):
+        from jepsen_tpu.suites import kvd
+
+        with pytest.raises(ValueError):
+            kvd.kvd_test({"nemesis": ["nope"]})
