@@ -437,8 +437,15 @@ def main() -> int:
     pipe_ratio = (n1 / per_hist) / cpu_single_rate
     nat_ratio = nat_single_s / per_hist
     best = min(pipe_stats, key=lambda ws: ws[0])[1]  # the min-WALL run
+    # measured wire throughput: bytes shipped to the device over the
+    # dispatch+fetch window (the tunnel-bound stages) — attribution for
+    # the tail JSON block, not just a stderr blur (VERDICT r5 Next #4)
+    wire_mb = best.get("wire_bytes", 0) / 1e6
+    xfer_s = best.get("dispatch", 0.0) + best.get("fetch", 0.0)
+    wire_mb_s = wire_mb / xfer_s if xfer_s > 0 else 0.0
     stages = " ".join(f"{k}={v * 1e3:.0f}ms"
-                      for k, v in sorted(best.items()))
+                      for k, v in sorted(best.items())
+                      if k != "wire_bytes")
     print(f"# north-star pipelined: {N_PIPE} x {n1} ops in "
           f"{pipe_wall:.3f}s wall (median {pipe_med:.3f}s) = "
           f"{per_hist * 1e3:.1f} ms/history "
@@ -455,6 +462,9 @@ def main() -> int:
     print(f"# north-star stage decomposition (best run, host seconds "
           f"summed over {N_PIPE} histories): {stages}",
           file=sys.stderr)
+    print(f"# north-star wire: {wire_mb:.2f} MB shipped over "
+          f"dispatch+fetch {xfer_s * 1e3:.0f} ms = {wire_mb_s:.1f} "
+          "MB/s measured", file=sys.stderr)
     if nat_ratio < 1.0:
         print("# WARNING: pipelined north star below the native "
               f"oracle this run ({nat_ratio:.2f}x) — host/tunnel "
@@ -819,6 +829,39 @@ def main() -> int:
     print(f"# envelope mixed-depth: R<=14 batch + one R=15 straggler "
           f"-> all valid; straggler engine="
           f"{mres[-1].get('engine', 'wgl-serial')}", file=sys.stderr)
+    # PRICE the R >= 15 serial-chain concession (VERDICT r5 Next #3):
+    # the straggler rides the serial fallback chain one history at a
+    # time — measure what that concession actually costs per straggler
+    # against the capped native oracle on the SAME history, so the
+    # "mixed batches still work" claim carries its bill.
+    strag_wall, strag_med, sres = timed(
+        lambda: wgl_deep.check_pipeline(model, [h15]), n=3)
+    if sres[0]["valid?"] is not True:
+        print(json.dumps({"metric": "ERROR: R=15 straggler judged "
+                          + str(sres[0]["valid?"]), "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return 1
+    wgl_cpu_native.check(model, h15)                    # warm
+    nat15_s, _, rn15 = timed(
+        lambda: wgl_cpu_native.check(model, h15, time_limit=HARD_CPU_CAP),
+        n=3)
+    n15 = sum(1 for o in h15 if o.is_invoke)
+    print(json.dumps({
+        "metric": (f"mixed-depth straggler price: one {n15}-op R=15 "
+                   "history (beyond R_MAX) on the serial-chain "
+                   "fallback, wall per straggler vs the capped native "
+                   "oracle on the SAME history"),
+        "value": round(strag_wall, 4), "unit": "s/straggler",
+        "vs_baseline": round(nat15_s / strag_wall, 2)}),
+        file=sys.stderr)
+    print(f"# straggler price: serial chain {strag_wall * 1e3:.0f}ms "
+          f"(median {strag_med * 1e3:.0f}ms, engine "
+          f"{sres[0].get('engine', 'wgl-serial')}) vs native oracle "
+          f"{nat15_s * 1e3:.0f}ms (verdict {rn15['valid?']}) on the "
+          f"same history -> oracle/serial = {nat15_s / strag_wall:.2f}x"
+          " (values < 1 mean each straggler costs MORE than just "
+          "running the native oracle on it — the honest bill for the "
+          "R>=15 concession)", file=sys.stderr)
     print(json.dumps({
         "metric": ("deep-overlap envelope: 20k-op histories at "
                    "max_open 8/10/12/14, pipelined wgl_deep vs warmed "
@@ -883,6 +926,16 @@ def main() -> int:
         "unit": "ops/sec",
         "vs_baseline": round(pipe_ratio, 2),
         "vs_native": round(nat_ratio, 2),
+        # per-stage attribution of the best run (host seconds summed
+        # over the pipeline) + measured wire throughput, so the parsed
+        # BENCH artifact carries the decomposition, not just the
+        # headline (VERDICT r5 Next #4)
+        "stages": {k: round(v, 4) for k, v in sorted(best.items())
+                   if k != "wire_bytes"},
+        "wire_mb": round(wire_mb, 2),
+        "wire_mb_s": round(wire_mb_s, 1),
+        "straggler_r15_s": round(strag_wall, 4),
+        "straggler_vs_native": round(nat15_s / strag_wall, 2),
     }))
     print(f"# multi-key: {n_ops} ops / {N_KEYS} keys in {kernel_s:.3f}s "
           f"kernel (median {kernel_med:.3f}s; {warm_s:.2f}s wall incl. "

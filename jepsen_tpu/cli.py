@@ -279,6 +279,37 @@ def recover_cmd(opts, test_fn: Optional[Callable] = None) -> int:
     return 0 if v is True else (1 if v is False else 254)
 
 
+def metrics_cmd(opts) -> int:
+    """`metrics <store-dir>`: summarize a run's telemetry log — op
+    volume + top latencies, engine mix + stage seconds, fault windows,
+    breaker transitions, runner resilience counters (ISSUE 4).
+    `store_dir` is a store/<name>/<ts>/ directory (or a
+    telemetry.jsonl path)."""
+    from jepsen_tpu import telemetry
+    d = Path(opts.store_dir)
+    f = d if d.is_file() else d / "telemetry.jsonl"
+    if not f.exists():
+        print(f"no telemetry.jsonl under {opts.store_dir}",
+              file=sys.stderr)
+        return 255
+    events = telemetry.read_events(f)
+    print(f"# {f}")
+    print(telemetry.summarize(events))
+    return 0
+
+
+def metrics_cmd_spec() -> dict:
+    def add_opts(parser):
+        parser.add_argument("store_dir", metavar="STORE_DIR",
+                            help="store/<name>/<ts> dir (or "
+                                 "telemetry.jsonl path)")
+
+    return {"metrics": {"opts": add_opts, "run": metrics_cmd,
+                        "help": "Summarize a run's telemetry log (op "
+                                "latencies, engine mix, fault "
+                                "windows)."}}
+
+
 def serve_cmd_run(opts) -> int:
     from jepsen_tpu import web
     web.serve(host=opts.host, port=opts.port, block=True)
@@ -324,6 +355,7 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                     "run": lambda opts: recover_cmd(opts, test_fn),
                     "help": "Rebuild a SIGKILLed run's history from its "
                             "WAL and re-analyze it."},
+        **metrics_cmd_spec(),
         **serve_cmd(),
     }
 
@@ -384,6 +416,7 @@ def standard_commands() -> dict:
                     "run": lambda opts: recover_cmd(opts),
                     "help": "Rebuild a SIGKILLed run's history files "
                             "from its history.wal."},
+        **metrics_cmd_spec(),
         **serve_cmd(),
     }
 

@@ -268,6 +268,8 @@ class ClientWorker(Worker):
         self.node = node
         self.client: Optional[client_mod.Client] = None
         self.name = f"worker {process_id}"
+        from jepsen_tpu import telemetry as telemetry_mod
+        self.tele = telemetry_mod.of(test)
         # Watchdog bookkeeping: the monitor thread reads (inflight,
         # last_journal) under progress_lock and fires stall_cancel to
         # retire a wedged in-flight op (see Watchdog).
@@ -337,6 +339,11 @@ class ClientWorker(Worker):
                         self._mark_inflight(None)
                 conj_op(test, completion)
                 log_op(completion)
+                # per-op latency histogram keyed (f, node, outcome) +
+                # one event — the telemetry.jsonl attribution stream
+                self.tele.record_op(op.f, self.node, completion.type,
+                                    op.time, completion.time,
+                                    process=op.process)
                 if completion.is_info:
                     # This process is hung: it cannot initiate another op
                     # without violating the single-threaded process
@@ -373,6 +380,8 @@ class NemesisWorker(Worker):
         super().__init__()
         self.test = test
         self.nemesis = None
+        from jepsen_tpu import telemetry as telemetry_mod
+        self.tele = telemetry_mod.of(test)
 
     def setup_worker(self):
         from jepsen_tpu import nemesis as nemesis_mod
@@ -403,8 +412,15 @@ class NemesisWorker(Worker):
                 op = to_op(op).assoc(process=gen.NEMESIS,
                                      time=relative_time_nanos())
                 self._journal(op)
+                tr = test.get("tracer")
                 try:
-                    completion = self.nemesis.invoke(test, op)
+                    if tr is not None and tr.enabled:
+                        # same span discipline as the client workers
+                        # (the trace.py docstring's "workers + nemesis")
+                        with tr.span("nemesis/invoke", f=str(op.f)):
+                            completion = self.nemesis.invoke(test, op)
+                    else:
+                        completion = self.nemesis.invoke(test, op)
                     completion = to_op(completion).assoc(
                         time=relative_time_nanos())
                 except Exception as e:
@@ -415,6 +431,8 @@ class NemesisWorker(Worker):
                         type="info", time=relative_time_nanos(),
                         error=f"indeterminate: {e}")
                 self._journal(completion)
+                self.tele.event("nemesis", f=str(completion.f),
+                                outcome=str(completion.type))
 
     def teardown_worker(self):
         if self.nemesis is not None:
@@ -480,6 +498,10 @@ class Watchdog:
         log.warning("watchdog: retiring process %s (%s; op %s)",
                     op.process, why, op.f)
         self.stalls += 1
+        from jepsen_tpu import telemetry as telemetry_mod
+        telemetry_mod.of(self.test).event(
+            "watchdog-stall", durable=True, process=op.process,
+            f=str(op.f), why=why)
         cancel.set()
 
     def _run(self):
@@ -560,11 +582,13 @@ def run_case(test) -> History:
     and whatever faults the nemesis left outstanding (its worker may
     have died mid-fault) are reversed from the fault ledger on EVERY
     exit path — normal, deadline drain, watchdog, or exception."""
+    from jepsen_tpu import telemetry as telemetry_mod
     wal = None
     if test.get("name") and test.get("start-time"):
         from jepsen_tpu import store
         from jepsen_tpu.history import HistoryWAL
-        wal = HistoryWAL(store.make_path(test, "history.wal"))
+        wal = HistoryWAL(store.make_path(test, "history.wal"),
+                         telemetry=telemetry_mod.of(test))
     history = History(journal=True, wal=wal)  # columns build as ops
     lock = threading.RLock()                  # land, so analysis
     test["history"] = history                 # starts from arrays
@@ -685,8 +709,24 @@ def run(test: dict) -> dict:
         # leaves test.json + history.wal behind, which is everything
         # `cli recover` needs to rebuild and re-analyze it.
         fcatch(store.write_test)(test)
+    # Telemetry: always-on for named tests (test["telemetry"] = False
+    # opts out).  The active scope lets code with no test in reach
+    # (breakers, engine dispatch, the resilient runner) emit into this
+    # run's event log for the duration of run + analysis.
+    from jepsen_tpu import telemetry as telemetry_mod
+    tele = telemetry_mod.for_test(test)
+    test["telemetry"] = tele
+    telemetry_mod.set_active(tele)
+    test["fault_ledger"].telemetry = tele
+    tele.event("run-start", durable=True, name=test.get("name"),
+               start_time=test.get("start-time"),
+               nodes=list(nodes), concurrency=test["concurrency"])
     from jepsen_tpu import trace as trace_mod
-    test["tracer"] = trace_mod.tracer(test)
+    tr = test["tracer"] = trace_mod.tracer(test)
+    if tr.enabled and tele.enabled:
+        # bridge spans into the telemetry event log, so ONE file tells
+        # the whole story (trace.jsonl remains the standalone export)
+        tr.set_sink(lambda m: tele.event("span", span=m))
     log.info("Running test: %s", test.get("name"))
     try:
         with control.with_ssh(test.get("ssh")):
@@ -701,6 +741,10 @@ def run(test: dict) -> dict:
         log_results(test)
         return test
     finally:
+        fcatch(tele.metrics_event)()
+        fcatch(tele.event)("run-end", durable=True)
+        fcatch(tele.close)()
+        telemetry_mod.clear_active(tele)
         if test.get("name"):
             from jepsen_tpu import store
             store.stop_logging()
@@ -746,17 +790,22 @@ def _with_os_db_run(test) -> None:
 
 def _run_case_and_analyze(test) -> None:
     with with_relative_time():
-        history = run_case(test)
-        test["history"] = history
-        for k in ("barrier",):
-            test.pop(k, None)
-        log.info("Run complete, writing")
-        if test.get("name"):
-            from jepsen_tpu import store
-            store.save_1(test)
-        analyze(test)
-        tr = test.get("tracer")
-        if tr is not None:
-            if test.get("name"):  # file export needs a store dir
-                tr.write(test)
-            tr.flush_http()       # HTTP export only needs an endpoint
+        try:
+            history = run_case(test)
+            test["history"] = history
+            for k in ("barrier",):
+                test.pop(k, None)
+            log.info("Run complete, writing")
+            if test.get("name"):
+                from jepsen_tpu import store
+                store.save_1(test)
+            analyze(test)
+        finally:
+            # span export rides the TEARDOWN path: a run that dies in
+            # analysis still leaves trace.jsonl behind (and the export
+            # itself must never mask the primary error)
+            tr = test.get("tracer")
+            if tr is not None:
+                if test.get("name"):  # file export needs a store dir
+                    fcatch(tr.write)(test)
+                fcatch(tr.flush_http)()  # only needs an endpoint

@@ -26,6 +26,7 @@ import json
 import logging
 import os
 import threading
+import time
 import zlib
 from pathlib import Path
 from typing import Any, Callable, Iterable, Iterator, Optional
@@ -605,10 +606,16 @@ class HistoryWAL:
     rather than crashing the run — a run without crash-safety beats no
     run."""
 
-    def __init__(self, path, fsync: bool = True):
+    def __init__(self, path, fsync: bool = True, telemetry=None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.fsync = fsync
+        # jepsen_tpu.telemetry.Telemetry (or None): fsync latency is
+        # recorded per append into jepsen_wal_fsync_seconds — the WAL
+        # is the run loop's one mandatory disk wait, so its latency
+        # distribution is the first thing to check when op latencies
+        # drift (docs/observability.md)
+        self.telemetry = telemetry
         self.lock = threading.Lock()
         self._n = 0
         self._dead = False
@@ -627,7 +634,11 @@ class HistoryWAL:
                               f'"op":{payload}}}\n')
                 self._f.flush()
                 if self.fsync:
+                    t0 = time.monotonic()
                     os.fsync(self._f.fileno())
+                    if self.telemetry is not None:
+                        self.telemetry.observe_wal_fsync(
+                            time.monotonic() - t0)
                 self._n += 1
             except Exception:
                 self._dead = True

@@ -33,6 +33,21 @@ class FaultLedger:
         self.lock = threading.Lock()
         self._seq = 0
         self._faults: dict = {}   # key -> (seq, undo fn, description)
+        # jepsen_tpu.telemetry.Telemetry (wired by core.run / ledger()):
+        # every register/resolve edge becomes a fault-start/fault-stop
+        # event pair in telemetry.jsonl, so checker timelines and the
+        # /telemetry dashboard can overlay fault windows on the op
+        # stream without parsing nemesis op values.
+        self.telemetry = None
+
+    def _window(self, phase: str, key, desc=None,
+                healed: bool = False) -> None:
+        try:
+            from jepsen_tpu import telemetry as telemetry_mod
+            telemetry_mod.fault_window(phase, key, desc, healed=healed,
+                                       tele=self.telemetry)
+        except Exception:   # noqa: BLE001 - telemetry never fails a run
+            pass
 
     def register(self, key, undo: Callable[[], object],
                  description=None) -> None:
@@ -42,11 +57,15 @@ class FaultLedger:
         with self.lock:
             self._faults[key] = (self._seq, undo, description)
             self._seq += 1
+        self._window("start", key, description)
 
     def resolve(self, key) -> bool:
         """The fault was reversed by its owner; drop it."""
         with self.lock:
-            return self._faults.pop(key, None) is not None
+            dropped = self._faults.pop(key, None) is not None
+        if dropped:
+            self._window("stop", key)
+        return dropped
 
     def outstanding(self) -> list:
         """[(key, description)] of unreversed faults, registration
@@ -71,15 +90,23 @@ class FaultLedger:
                 results[key] = undo()
             except Exception as e:   # noqa: BLE001 - reported, not raised
                 results[key] = e
+            self._window("stop", key, healed=True)
         return results
 
 
 def ledger(test) -> FaultLedger:
     """The test's fault ledger (created by core.run; tests driving
-    nemeses directly get one on demand)."""
+    nemeses directly get one on demand).  Wires the test's telemetry
+    into the ledger so fault-window events flow even for nemeses
+    driven outside core.run."""
     led = test.get("fault_ledger")
     if led is None:
         led = test["fault_ledger"] = FaultLedger()
+    if led.telemetry is None:
+        from jepsen_tpu import telemetry as telemetry_mod
+        t = telemetry_mod.of(test)
+        if t.enabled:
+            led.telemetry = t
     return led
 
 

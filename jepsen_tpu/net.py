@@ -23,12 +23,12 @@ K_FLAKY = "net.flaky"
 
 
 def _ledger(test):
-    led = test.get("fault_ledger")
-    if led is None:
-        # lazy import: nemesis imports this module at load time
-        from jepsen_tpu import nemesis as nemesis_mod
-        led = test["fault_ledger"] = nemesis_mod.FaultLedger()
-    return led
+    # lazy import: nemesis imports this module at load time.  Routing
+    # through nemesis.ledger wires the test's telemetry in, so every
+    # link-level fault registered here (drop/slow/flaky) also emits its
+    # fault-window start/stop event pair into telemetry.jsonl.
+    from jepsen_tpu import nemesis as nemesis_mod
+    return nemesis_mod.ledger(test)
 
 
 class Net:

@@ -233,6 +233,12 @@ class ResilientRunner:
 
         pending = [i for i in range(n) if results[i] is None]
         start = self.clock()
+        # Resilience accounting (telemetry, ISSUE 4): how much work the
+        # runner did beyond one clean dispatch — counted in the global
+        # registry and journaled into the active run's event log, and
+        # echoed onto the verdicts the runner itself produced.
+        counts = {"oom_bisections": 0, "poison_bisections": 0,
+                  "retries": 0, "quarantines": 0, "cpu_fallbacks": 0}
 
         def remaining() -> Optional[float]:
             return None if deadline_s is None \
@@ -287,6 +293,8 @@ class ResilientRunner:
                     # Bisect to isolate; only OOM escalates the attempt
                     # counter (and with it the backoff) — a
                     # deterministic poison gains nothing from waiting.
+                    counts["oom_bisections" if isinstance(err, DeviceOOM)
+                           else "poison_bisections"] += 1
                     mid = len(idxs) // 2
                     nxt = attempt + 1 if isinstance(err, DeviceOOM) \
                         else attempt
@@ -298,11 +306,13 @@ class ResilientRunner:
                     continue
                 i = idxs[0]
                 if isinstance(err, DeviceOOM) and attempt < max_retries:
+                    counts["retries"] += 1
                     stack.append((idxs, attempt + 1))
                     continue
                 log.warning("quarantining history %d after %d "
                             "attempt(s): %s: %s", i, attempt + 1,
                             type(err).__name__, err)
+                counts["quarantines"] += 1
                 results[i] = self._quarantine(err, i, seed_of(i))
                 record(i)
                 continue
@@ -344,9 +354,50 @@ class ResilientRunner:
                     err = errors_mod.classify(
                         e, history_index=i, seed=seed_of(i),
                         backend="cpu", batch_size=1)
+                    counts["quarantines"] += 1
                     results[i] = self._quarantine(err, i, seed_of(i))
                 record(i)
+            counts["cpu_fallbacks"] = len(cpu_rest)
+
+        # -- telemetry ------------------------------------------------------
+        self._account(results, counts, fallback_cause, n)
         return results
+
+    def _account(self, results, counts: dict, fallback_cause, n) -> None:
+        """Record resilience counters + attach dispatch records to the
+        verdicts the runner itself produced (quarantines, CPU
+        degradations); engine-produced verdicts already carry theirs.
+        Never raises — accounting must not undo a survived batch."""
+        try:
+            from jepsen_tpu import telemetry as telemetry_mod
+            for k, v in counts.items():
+                if v:
+                    telemetry_mod.REGISTRY.counter(
+                        f"jepsen_runner_{k}_total").inc(v)
+            if any(counts.values()):
+                telemetry_mod.emit("runner", **counts)
+            by_kind: dict = {}
+            for r in results:
+                if isinstance(r, dict) and "dispatch" not in r:
+                    kind = ("quarantine" if r.get("quarantined")
+                            else r.get("engine", "wgl_cpu"))
+                    by_kind.setdefault(kind, []).append(r)
+            engine_name = self.engine if isinstance(self.engine, str) \
+                else getattr(self.engine, "__name__", "custom")
+            for kind, rs in by_kind.items():
+                telemetry_mod.attach_dispatch(
+                    rs,
+                    telemetry_mod.dispatch_record(
+                        kind,
+                        why=(fallback_cause
+                             or ("quarantined after retries/bisection"
+                                 if kind == "quarantine"
+                                 else "resilient-runner degradation")),
+                        fallback_chain=[engine_name, "wgl_cpu"],
+                        batch=n, **counts))
+        except Exception:   # noqa: BLE001
+            log.debug("runner telemetry accounting failed",
+                      exc_info=True)
 
 
 def check(model, histories: Sequence, *, engine="auto",

@@ -135,19 +135,31 @@ def _pad_plan(pl: WGLPlan, R: int, C: int, N: int) -> WGLPlan:
 def check_many(model, histories: Sequence, *,
                frontier_size: int = 256,
                mesh=None,
-               escalate: bool = True) -> list[dict[str, Any]]:
+               escalate: bool = True,
+               stats: Optional[dict] = None) -> list[dict[str, Any]]:
     """Check linearizability of many independent histories in one
     batched device call.  Returns one knossos-shaped result map per
     history (same keys as ops.wgl.check).
 
     mesh: optional jax.sharding.Mesh; the key axis is sharded over its
     first axis (pure data parallelism — each device checks its shard of
-    keys)."""
+    keys).
+
+    `stats` (always collected; pass a dict to read it back) receives
+    the per-stage host-time decomposition — plan / pack / dispatch /
+    fetch / assemble seconds — mirroring wgl_seg.check_pipeline's
+    protocol, and every verdict carries a dispatch record
+    (jepsen_tpu.telemetry)."""
     import jax
+
+    from jepsen_tpu.ops.wgl_seg import _stats_clock
 
     spec = model.device_spec()
     if spec is None:
         raise BackendUnavailable(f"model {model!r} has no device spec")
+    stats = {} if stats is None else stats
+    _mt, _acc = _stats_clock(stats)
+    t0 = _mt()
 
     preps = [h if hasattr(h, "calls") else prepare(h) for h in histories]
     results: list[Optional[dict]] = [None] * len(preps)
@@ -157,6 +169,7 @@ def check_many(model, histories: Sequence, *,
             results[i] = {"valid?": True, "op_count": 0}
             continue
         lanes.append((i, plan(prep, spec, model)))
+    t0 = _acc("plan", t0)
     if not lanes:
         return [r for r in results]
 
@@ -184,6 +197,9 @@ def check_many(model, histories: Sequence, *,
             stack("a_ok"), stack("init_state"),
             np.asarray([p.n_events for p in padded], np.int32)]
 
+    stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                           + sum(a.nbytes for a in args))
+    t0 = _acc("pack", t0)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
         axis = mesh.axis_names[0]
@@ -192,8 +208,12 @@ def check_many(model, histories: Sequence, *,
 
     kern = _build_batch_kernel(spec.step, int(frontier_size), int(C),
                                int(W), int(S))
-    out = jax.device_get(kern(*args))
+    dev = kern(*args)
+    t0 = _acc("dispatch", t0)
+    out = jax.device_get(dev)
+    t0 = _acc("fetch", t0)
 
+    escalated: list = []
     for lane_idx, (i, pl) in enumerate(lanes):
         ok = bool(out["ok"][lane_idx])
         overflow = bool(out["overflow"][lane_idx])
@@ -214,7 +234,32 @@ def check_many(model, histories: Sequence, *,
         elif escalate:
             from jepsen_tpu.ops import wgl
             results[i] = wgl.check(model, preps[i])
+            results[i].setdefault("engine", "wgl")
+            escalated.append(i)
         else:
             results[i] = {"valid?": "unknown", "cause": "frontier-overflow",
                           "op_count": pl.n_calls}
+    _acc("assemble", t0)
+    # dispatch records (telemetry): batched lanes vs escalated lanes
+    from jepsen_tpu import telemetry as telemetry_mod
+    mesh_desc = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                 if mesh is not None else None)
+    batched_rs = [r for i, r in enumerate(results)
+                  if isinstance(r, dict) and i not in set(escalated)]
+    telemetry_mod.attach_dispatch(
+        batched_rs,
+        telemetry_mod.dispatch_record(
+            "wgl_batch", why="vmap-over-keys frontier kernel "
+                             f"(frontier_size={int(frontier_size)})",
+            fallback_chain=["wgl"], batch=len(histories),
+            mesh=mesh_desc),
+        stages=stats)
+    for i in escalated:
+        telemetry_mod.attach_dispatch(
+            [results[i]],
+            telemetry_mod.dispatch_record(
+                results[i].get("engine", "wgl"),
+                why="frontier overflow on an invalid-looking lane; "
+                    "escalated to the adaptive serial kernel",
+                fallback_chain=["wgl_cpu"], batch=1))
     return [r for r in results]

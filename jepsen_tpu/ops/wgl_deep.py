@@ -455,7 +455,7 @@ def _pad_u(u: int) -> int:
 
 
 def dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
-                    R: int, Sn: int):
+                    R: int, Sn: int, stats=None):
     """Asynchronously dispatch the deep kernel on pre-packed
     register-delta tables; returns the UN-FETCHED i32[1, 2] device
     verdict (alive, first-dead-row | -1).  On the tunneled chip a
@@ -474,6 +474,9 @@ def dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
     UP = _pad_u(a1t.shape[0])
     cbuf, G = pack_events_compact(ret_t, islot_t, iuop_t)
     auxbuf = pack_aux(a1t, a2t, t0t, UP)
+    if stats is not None:           # measured wire traffic (telemetry)
+        stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                               + cbuf.nbytes + auxbuf.nbytes)
     Wd = max(1, (1 << R) // 32)
     kern = _build_c(G, I, Wd, _snp(Sn), R, UP,
                     interpret=(backend == "cpu"))
@@ -549,6 +552,7 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
     spec = model.device_spec()
     if spec is None:
         raise BackendUnavailable(f"model {model!r} has no device spec")
+    stats = {} if stats is None else stats   # always collected now
     _mt, _acc = wgl_seg._stats_clock(stats)
     backend = jax.default_backend()
     pend = []
@@ -607,7 +611,7 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
         a1t, a2t, t0t = tables
         t0 = _acc("pack", t0)
         dev, G = dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t,
-                                 t0t, R, Sn)
+                                 t0t, R, Sn, stats=stats)
         _acc("dispatch", t0)
         pend.append((dev, i, fk, ret_t, ops, R, Sn, G))
 
@@ -630,6 +634,19 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
                     res["op_index"] = w[1]
             results[i] = res
         _acc("assemble", t0)
+    # in-scope verdicts carry the deep pipeline's dispatch record +
+    # stage decomposition BEFORE the stragglers run, so the serial
+    # chain's verdicts keep their own engines' records
+    from jepsen_tpu import telemetry as telemetry_mod
+    telemetry_mod.attach_dispatch(
+        results,
+        telemetry_mod.dispatch_record(
+            "wgl_deep",
+            why="pipelined deep megakernel (async dispatch, one fetch)",
+            fallback_chain=["wgl_seg.check", "wgl"],
+            R=(max(p[5] for p in pend) if pend else None),
+            batch=len(histories), stragglers=len(strag) or None),
+        stages=stats)
     for i in strag:
         try:
             results[i] = wgl_seg.check(model, histories[i],
@@ -640,6 +657,13 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
             # frontier engine has no overlap-depth limit
             from jepsen_tpu.ops import wgl
             results[i] = wgl.check(model, histories[i])
+            telemetry_mod.attach_dispatch(
+                [results[i]],
+                telemetry_mod.dispatch_record(
+                    results[i].get("engine", "wgl"),
+                    why="deep straggler beyond every batched gate "
+                        "(serial frontier engine)",
+                    fallback_chain=["wgl_cpu"], batch=1))
     return results
 
 
@@ -767,4 +791,12 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
                 res["op"] = w[0].to_dict()
                 res["op_index"] = w[1]
         results.append(res)
+    from jepsen_tpu import telemetry as telemetry_mod
+    telemetry_mod.attach_dispatch(
+        results,
+        telemetry_mod.dispatch_record(
+            "wgl_deep", why="mesh-sharded deep megakernel "
+                            "(one history per device, no collectives)",
+            R=R, batch=len(histories),
+            mesh=dict(zip(mesh.axis_names, mesh.devices.shape))))
     return results

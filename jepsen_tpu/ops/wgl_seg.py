@@ -2786,6 +2786,40 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
           target_returns_per_segment: int = 256,
           localize: bool = True, mesh=None,
           mesh_axis: Optional[str] = None) -> dict[str, Any]:
+    """_check_impl plus the inspectable dispatch record every verdict
+    carries (jepsen_tpu.telemetry): which engine produced it, why, the
+    fallback chain below it, and the env knobs in effect — so
+    `results.json` explains its own dispatch instead of requiring the
+    reader to re-derive eight modules' worth of gating."""
+    from jepsen_tpu import telemetry as telemetry_mod
+    r = _check_impl(model, history, max_states=max_states,
+                    max_open_bits=max_open_bits,
+                    target_returns_per_segment=target_returns_per_segment,
+                    localize=localize, mesh=mesh, mesh_axis=mesh_axis)
+    if isinstance(r, dict) and "dispatch" not in r:
+        telemetry_mod.attach_dispatch(
+            [r],
+            telemetry_mod.dispatch_record(
+                r.get("engine", "wgl_seg"),
+                why=(r.get("refutation") or r.get("crash_tier")
+                     or "scalar segment chain"),
+                fallback_chain=["wgl_seg._check_crashed_fast",
+                                "wgl_deep", "wgl", "wgl_cpu"],
+                R=r.get("max_open"),
+                crashes=r.get("crashed_ignored"),
+                batch=1,
+                mesh=(getattr(mesh, "shape", None)
+                      if mesh is not None else None)),
+            stages={"plan": r.get("time_plan_s"),
+                    "kernel": r.get("time_kernel_s")})
+    return r
+
+
+def _check_impl(model, history, *, max_states: int = 64,
+                max_open_bits: int = 10,
+                target_returns_per_segment: int = 256,
+                localize: bool = True, mesh=None,
+                mesh_axis: Optional[str] = None) -> dict[str, Any]:
     """Segment-parallel linearizability check.  Returns a knossos-shaped
     analysis map (same keys as ops.wgl.check).  Crashed (:info) calls
     are handled exactly (inert dropping / bounded crash kernel /
@@ -2989,6 +3023,10 @@ def check_pipeline(model, histories, *, max_states: int = 64,
     spec = model.device_spec()
     if spec is None:
         raise Unsupported(f"model {model!r} has no device spec")
+    # stage timings are ALWAYS collected now (the dict costs a handful
+    # of monotonic() reads per group): every pipelined verdict carries
+    # its stage decomposition + dispatch record (telemetry, ISSUE 4)
+    stats = {} if stats is None else stats
     _mt, _acc = _stats_clock(stats)
     backend_name = jax.default_backend()
     n = len(histories)
@@ -3156,8 +3194,14 @@ def check_pipeline(model, histories, *, max_states: int = 64,
         while len(blocks) < G:        # short tail group: padding lane
             blocks.append(blocks[0])  # (extra verdicts discarded)
         t0 = _acc("fill", t0)
+        payload = np.concatenate(blocks)
+        # measured wire traffic: the compact event blocks + the uop
+        # tables shipped with every group (bench.py reports MB/s over
+        # the dispatch+fetch window from this)
+        stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                               + payload.nbytes + buf32.nbytes)
         dispatched.append(
-            (fn(np.concatenate(blocks), buf32),
+            (fn(payload, buf32),
              [i for i, *_ in grp], spec_rounds, R_cur, Sn, states))
         _acc("dispatch", t0)
 
@@ -3209,6 +3253,20 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                                 res[key] = oracle[key]
                 results[i] = res
         _acc("assemble", t0)
+    # pipelined verdicts carry the pipeline's dispatch record + stage
+    # decomposition; stragglers (checked below through check()'s own
+    # chain) carry the record check() attaches for the engine that
+    # actually produced them
+    from jepsen_tpu import telemetry as telemetry_mod
+    telemetry_mod.attach_dispatch(
+        results,
+        telemetry_mod.dispatch_record(
+            "wgl_seg",
+            why="pipelined segment engine (grouped dispatch, one fetch)",
+            fallback_chain=["wgl_seg.check", "wgl_deep", "wgl",
+                            "wgl_cpu"],
+            R=R_cur or None, batch=n, stragglers=len(strag) or None),
+        stages=stats)
     for i in strag:
         results[i] = check(model, histories[i], max_states=max_states,
                            max_open_bits=max_open_bits,
@@ -3410,6 +3468,9 @@ def check_many(model, histories, *, max_states: int = 64,
     t0 = time.monotonic()
     backend_name = jax.default_backend()
     results: list = [None] * len(histories)
+    stats: dict = {}            # per-stage host seconds (telemetry)
+    _mt_s, _acc_s = _stats_clock(stats)
+    ts = _mt_s()
 
     # Partition keys: batchable vs fallback — one fused host pass per
     # key (no per-op objects).
@@ -3461,6 +3522,7 @@ def check_many(model, histories, *, max_states: int = 64,
                           "engine": "wgl_seg_batch"}
         else:
             batch.append((i, fk))
+    ts = _acc_s("scan", ts)
 
     if batch:
         uops = np.asarray(rows, np.int32).reshape(len(rows), 4)
@@ -3471,10 +3533,13 @@ def check_many(model, histories, *, max_states: int = 64,
         except Unsupported:
             fall.extend(i for i, _ in batch)
             batch = []
+        ts = _acc_s("tables", ts)
 
+    R_batch = None
     if batch:
         Sn = states.shape[0]
         R = max(fk.max_open for _, fk in batch)
+        R_batch = int(R)
         M = 1 << R
         # C needs no pow2 pad — a return's candidate set is the open
         # calls, <= R.
@@ -3549,9 +3614,15 @@ def check_many(model, histories, *, max_states: int = 64,
                 args = _shard_args(
                     mesh, mesh_axis,
                     [ret_t, islot_t, iuop_t, a1t, a2t, t0t], 3)
+            ts = _acc_s("fill", ts)
+            stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                                   + sum(a.nbytes for a in args
+                                         if hasattr(a, "nbytes")))
             t1 = time.monotonic()
             T = np.asarray(kern(*args))                  # [Kp, 1, Sn]
             t_kernel = time.monotonic() - t1
+            stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
+            ts = _mt_s()
             engine_name = "wgl_seg_batch_regs"
             ok_k = (T[:, 0, :] > 0.5).any(axis=1)
             for kk, (i, fk) in enumerate(batch):
@@ -3603,9 +3674,11 @@ def check_many(model, histories, *, max_states: int = 64,
         if mesh is not None and mesh_axis is not None:
             args = _shard_args(mesh, mesh_axis, args, kc_shaped)
 
+        ts = _acc_s("fill", ts)
         t1 = time.monotonic()
         T = np.asarray(kern(*args))                      # [Kp, 1, Sn]
         t_kernel = time.monotonic() - t1
+        stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
         ok_k = (T[:, 0, :] > 0.5).any(axis=1)
         for kk, (i, fk) in enumerate(batch):
             _emit_batch_result(results, i, fk, bool(ok_k[kk]),
@@ -3658,4 +3731,25 @@ def check_many(model, histories, *, max_states: int = 64,
     for r in results:
         if r is not None and "time_total_s" not in r:
             r["time_total_s"] = t_total
+    # Dispatch records, grouped by the engine that actually produced
+    # each verdict (batched kernel lanes, exact single-key crash
+    # chains, serial fallbacks): one shared record per engine, so the
+    # attribution costs dict references, not per-verdict env scans.
+    from jepsen_tpu import telemetry as telemetry_mod
+    by_engine: dict = {}
+    for r in results:
+        if isinstance(r, dict) and "dispatch" not in r:
+            by_engine.setdefault(r.get("engine", "wgl_seg_batch"),
+                                 []).append(r)
+    n_crash = sum(stripped_note.values()) if stripped_note else None
+    for eng, rs in by_engine.items():
+        telemetry_mod.attach_dispatch(
+            rs,
+            telemetry_mod.dispatch_record(
+                eng, why="independent-keys batch (one lane per key)",
+                fallback_chain=["wgl_seg.check", "wgl", "wgl_cpu"],
+                R=R_batch, crashes=n_crash, batch=len(histories),
+                mesh=(getattr(mesh, "shape", None)
+                      if mesh is not None else None)),
+            stages=stats)
     return results

@@ -83,6 +83,16 @@ class CircuitBreaker:
         self.opened_at: Optional[float] = None
         self.probing = False
 
+    def _transition(self, to: str, failures: int) -> None:
+        """Journal a state transition into telemetry (outside the
+        breaker lock — the event log takes its own lock and does file
+        IO).  Must never fail a command attempt."""
+        try:
+            from jepsen_tpu import telemetry as telemetry_mod
+            telemetry_mod.breaker_transition(self.node, to, failures)
+        except Exception:   # noqa: BLE001 - telemetry never breaks IO
+            pass
+
     @property
     def state(self) -> str:
         with self.lock:
@@ -100,19 +110,26 @@ class CircuitBreaker:
             elapsed = self.clock() - self.opened_at
             if elapsed >= self.cooldown_s and not self.probing:
                 self.probing = True
-                return
-            raise BreakerOpen(self.node, self.failures,
-                              max(self.cooldown_s - elapsed, 0.0))
+                n = self.failures
+            else:
+                raise BreakerOpen(self.node, self.failures,
+                                  max(self.cooldown_s - elapsed, 0.0))
+        self._transition("half-open", n)
 
     def success(self) -> None:
         with self.lock:
-            if self.opened_at is not None:
+            reclosed = self.opened_at is not None
+            if reclosed:
                 log.info("breaker for %s closed again", self.node)
+                n = self.failures
             self.failures = 0
             self.opened_at = None
             self.probing = False
+        if reclosed:
+            self._transition("closed", n)
 
     def failure(self) -> None:
+        opened = False
         with self.lock:
             self.failures += 1
             if self.probing or (self.opened_at is None
@@ -121,8 +138,14 @@ class CircuitBreaker:
                     log.warning(
                         "breaker for %s OPEN after %d consecutive "
                         "transport failures", self.node, self.failures)
+                # first open AND a failed half-open probe re-opening
+                # are both journaled as -> open transitions
+                opened = True
                 self.opened_at = self.clock()
                 self.probing = False
+                n = self.failures
+        if opened:
+            self._transition("open", n)
 
 
 class _RWLock:

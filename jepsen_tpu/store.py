@@ -38,7 +38,7 @@ NONSERIALIZABLE_KEYS = {
     "db", "os", "net", "client", "checker", "nemesis", "generator", "model",
     "barrier", "active_histories", "active_histories_lock", "history_lock",
     "sessions", "remote", "store", "abort_event", "tracer",
-    "fault_ledger", "drain_event",
+    "fault_ledger", "drain_event", "telemetry",
 }
 
 

@@ -17,9 +17,15 @@ Usage (suite-side, mirroring dgraph client.clj):
         tracer.annotate("sending txn")
         ...
 
-Core wiring: `core.run` calls `trace.tracer(test)` once and stores it at
-test["tracer"]; workers wrap every client invoke in a span when tracing
-is enabled.
+Core wiring (verified, core.py): `core.run` calls `trace.tracer(test)`
+once and stores it at test["tracer"]; client workers wrap every invoke
+in a `client/invoke` span and the nemesis worker wraps each fault op
+in a `nemesis/invoke` span when tracing is enabled.
+`core._run_case_and_analyze` calls `Tracer.write` on the run teardown
+path (even when analysis raises), and when telemetry is active the
+tracer's sink bridges every finished span into the run's
+`telemetry.jsonl` event log as `{"type": "span", ...}` records — one
+file tells the whole story (see jepsen_tpu/telemetry.py).
 """
 
 from __future__ import annotations
@@ -145,12 +151,21 @@ class Tracer:
         if stack:
             stack[-1].attributes[key] = value
 
+    def set_sink(self, sink) -> None:
+        """Attach (or replace) the per-span sink callable — core.run
+        uses this to bridge spans into the telemetry event log."""
+        with self._lock:
+            self._sink = sink
+
     def _emit(self, span: Span) -> None:
         m = span.to_map()
         with self._lock:
             self._spans.append(m)
             if self._sink is not None:
-                self._sink(m)
+                try:
+                    self._sink(m)
+                except Exception:   # noqa: BLE001 - sinks must not
+                    pass            # fail the traced operation
 
     # -- export ------------------------------------------------------------
 

@@ -2,9 +2,13 @@
 
 A dependency-free HTTP dashboard over the store/ directory: a test
 table colored by validity (web.clj:25-34,122), a file browser rooted at
-the store (web.clj app :328), and zip export of a whole test run
-(web.clj:336 zip handler).  Built on http.server so it runs anywhere
-the framework does.
+the store (web.clj app :328), zip export of a whole test run
+(web.clj:336 zip handler), plus the telemetry surfaces (ISSUE 4):
+`/telemetry` lists runs with a telemetry.jsonl, `/telemetry/<name>/<ts>`
+renders op-rate and p95-latency sparklines with nemesis fault windows
+shaded and the `cli metrics` summary inline, and `/metrics` is the
+process-global Prometheus text exposition for scraping.  Built on
+http.server so it runs anywhere the framework does.
 """
 
 from __future__ import annotations
@@ -93,7 +97,9 @@ def home_html() -> bytes:
             f"<td><a href='{base}/history.txt'>history</a></td>"
             f"<td><a href='/zip/{quote(name)}/{quote(ts)}'>zip</a></td>"
             "</tr>")
-    body = ("<h1>Jepsen</h1><table><tr><th>Test</th><th>Time</th>"
+    body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
+            "<a href='/metrics'>metrics</a></p>"
+            "<table><tr><th>Test</th><th>Time</th>"
             "<th>Valid?</th><th>Results</th><th>History</th><th>Zip</th>"
             "</tr>" + "".join(rows) + "</table>")
     return _page("Jepsen", body)
@@ -126,6 +132,83 @@ def dir_html(rel: str, p: Path) -> bytes:
                  f"<h1>{html.escape(rel or 'store')}</h1><p>"
                  "<a href='/'>&larr; tests</a></p><ul>"
                  + "".join(ents) + "</ul>")
+
+
+# ---------------------------------------------------------------------------
+# Telemetry pages (ISSUE 4): /telemetry index, per-run sparklines with
+# nemesis windows shaded, /metrics Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _sparkline_svg(values: list, windows: list, color: str,
+                   width: int = 640, height: int = 80,
+                   label: str = "") -> str:
+    """Inline SVG polyline over bucketed values; fault windows shaded
+    as translucent rectangles spanning the full height."""
+    if not values:
+        return "<p>(no data)</p>"
+    vmax = max(values) or 1.0
+    n = len(values)
+    pts = " ".join(
+        f"{i / max(n - 1, 1) * width:.1f},"
+        f"{height - (v / vmax) * (height - 4):.1f}"
+        for i, v in enumerate(values))
+    shades = "".join(
+        f"<rect x='{a * width:.1f}' y='0' "
+        f"width='{max((b - a) * width, 1.0):.1f}' height='{height}' "
+        "fill='#E8A4A4' fill-opacity='0.35'/>"
+        for a, b in windows)
+    return (f"<div><b>{html.escape(label)}</b> "
+            f"(max {vmax:.3g})<br>"
+            f"<svg width='{width}' height='{height}' "
+            "style='border:1px solid #ccc;background:#fff'>"
+            + shades +
+            f"<polyline points='{pts}' fill='none' stroke='{color}' "
+            "stroke-width='1.5'/></svg></div>")
+
+
+def telemetry_index_html() -> bytes:
+    rows = []
+    for name, ts, valid in _test_rows():
+        if not (store.BASE / store._sanitize(name) / ts
+                / "telemetry.jsonl").exists():
+            continue
+        rows.append(
+            f"<tr style='background:{_color(valid)}'>"
+            f"<td>{html.escape(name)}</td>"
+            f"<td><a href='/telemetry/{quote(name)}/{quote(ts)}'>"
+            f"{html.escape(ts)}</a></td>"
+            f"<td><a href='/files/{quote(name)}/{quote(ts)}/"
+            "telemetry.jsonl'>raw</a></td></tr>")
+    body = ("<h1>Telemetry</h1><p><a href='/'>&larr; tests</a> &middot; "
+            "<a href='/metrics'>prometheus snapshot</a></p>"
+            "<table><tr><th>Test</th><th>Run</th><th>Log</th></tr>"
+            + "".join(rows) + "</table>")
+    if not rows:
+        body += "<p>(no runs with a telemetry.jsonl yet)</p>"
+    return _page("Telemetry", body)
+
+
+def telemetry_run_html(name: str, ts: str) -> bytes:
+    from jepsen_tpu import telemetry
+    p = _safe_path(f"{name}/{ts}") / "telemetry.jsonl"
+    if not p.exists():
+        raise FileNotFoundError(p)
+    events = telemetry.read_events(p)
+    series = telemetry.op_series(events)
+    body = [f"<h1>{html.escape(name)} / {html.escape(ts)}</h1>",
+            "<p><a href='/telemetry'>&larr; telemetry</a></p>"]
+    if series["rate"]:
+        span = series["t1"] - series["t0"]
+        body.append(f"<p>{span:.1f}s of ops; shaded bands are nemesis "
+                    "fault windows</p>")
+        body.append(_sparkline_svg(series["rate"], series["windows"],
+                                   "#3B6EA5", label="op rate (ops/s)"))
+        body.append(_sparkline_svg(series["p95_ms"], series["windows"],
+                                   "#A5703B",
+                                   label="op latency p95 (ms)"))
+    body.append("<h2>Summary</h2><pre>"
+                + html.escape(telemetry.summarize(events)) + "</pre>")
+    return _page(f"telemetry {name}/{ts}", "".join(body))
 
 
 def zip_bytes(name: str, ts: str) -> bytes:
@@ -164,6 +247,19 @@ class Handler(BaseHTTPRequestHandler):
             path = self.path.split("?", 1)[0]
             if path == "/" or path == "":
                 return self._send(200, home_html())
+            if path == "/metrics":
+                from jepsen_tpu import telemetry
+                return self._send(200, telemetry.snapshot().encode(),
+                                  "text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+            if path == "/telemetry" or path == "/telemetry/":
+                return self._send(200, telemetry_index_html())
+            if path.startswith("/telemetry/"):
+                parts = [unquote(x) for x in
+                         path[len("/telemetry/"):].strip("/").split("/")]
+                if len(parts) == 2:
+                    return self._send(200, telemetry_run_html(*parts))
+                return self._send(404, b"not found", "text/plain")
             if path.startswith("/files/"):
                 rel = unquote(path[len("/files/"):])
                 p = _safe_path(rel)

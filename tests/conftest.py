@@ -19,6 +19,54 @@ import pytest
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# ---------------------------------------------------------------------------
+# Tier-1 timing artifact (ISSUE 4 CI satellite): every suite run writes
+# store/ci/last-tier1.json — total wall + the 20 slowest tests — so
+# test-suite latency regressions become diffable across PRs instead of
+# a wall-clock blur in the CI log.
+# ---------------------------------------------------------------------------
+
+_ci_durations: list = []
+_ci_t0: list = []
+
+
+def pytest_sessionstart(session):
+    import time as _time
+    _ci_t0.append(_time.monotonic())
+
+
+def pytest_runtest_logreport(report):
+    # setup+call+teardown all count toward a test's bill (fixtures like
+    # the kvd daemon are real wall time)
+    _ci_durations.append((report.nodeid, report.when, report.duration))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    import json as _json
+    import time as _time
+    try:
+        per_test: dict = {}
+        for nodeid, _when, dur in _ci_durations:
+            per_test[nodeid] = per_test.get(nodeid, 0.0) + dur
+        slowest = sorted(per_test.items(), key=lambda kv: -kv[1])[:20]
+        total = (_time.monotonic() - _ci_t0[0]) if _ci_t0 else None
+        out = {
+            "total_wall_s": round(total, 3) if total is not None else None,
+            "tests": len(per_test),
+            "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
+            "slowest": [{"test": n, "s": round(s, 3)}
+                        for n, s in slowest],
+        }
+        ci_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "store", "ci")
+        os.makedirs(ci_dir, exist_ok=True)
+        with open(os.path.join(ci_dir, "last-tier1.json"), "w") as f:
+            _json.dump(out, f, indent=2)
+    except Exception:
+        pass            # the artifact must never fail the suite
+
+
 def pytest_collection_modifyitems(config, items):
     """Auto-skip the `fuse` marker where FUSE mounts are impossible
     (like the kill9 marker, the battery is tier-1-safe where it CAN

@@ -57,6 +57,39 @@ def test_kvd_suite_end_to_end_real_daemon(tmp_path):
     assert logs, list(store.BASE.rglob("*"))
     body = logs[0].read_text()
     assert "SET r" in body or "CAS r" in body, body[:200]
+    # telemetry acceptance (ISSUE 4): the named run left a crash-safe
+    # telemetry.jsonl carrying op-latency metrics, at least one fault-
+    # window event pair (the pauser registers in the fault ledger),
+    # and per-verdict dispatch records with stage timings
+    from jepsen_tpu import telemetry
+    tele_p = store.test_dir(res) / "telemetry.jsonl"
+    assert tele_p.exists()
+    evs = telemetry.read_events(tele_p)
+    ops = [e for e in evs if e["type"] == "op"]
+    assert ops and any(e["latency_ns"] is not None for e in ops)
+    windows = telemetry.pair_fault_windows(evs)
+    assert any(t0 is not None and t1 is not None
+               for _, t0, t1 in windows), windows
+    assert any(e["type"] == "dispatch" and e.get("stages")
+               for e in evs)
+    # cli metrics summarizes it
+    from jepsen_tpu import cli
+    assert cli.main(cli.standard_commands(),
+                    ["metrics", str(tele_p.parent)]) == 0
+    # and the /telemetry web page renders it
+    from jepsen_tpu import web
+    from urllib.parse import quote
+    import urllib.request
+    srv = web.serve(host="127.0.0.1", port=0, block=False)
+    try:
+        url = (f"http://127.0.0.1:{srv.server_address[1]}/telemetry/"
+               f"kvd/{quote(tele_p.parent.name)}")
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            page = resp.read().decode()
+        assert resp.status == 200 and "<svg" in page
+    finally:
+        srv.shutdown()
+        srv.server_close()
 
 
 @pytest.mark.slow

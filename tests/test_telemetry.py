@@ -1,0 +1,701 @@
+"""Telemetry layer tests (ISSUE 4): registry semantics, crash-safety
+of the event log (incl. the kill9 SIGKILL battery), dispatch-record
+presence on verdicts from every engine entry point, the CLI `metrics`
+summary, the web `/telemetry` + `/metrics` surfaces, and the bounded-
+overhead claims (disabled-path no-op-cheap, enabled-path per-op cost)."""
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import cli, core, generator as gen, models, store
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import telemetry, web
+from jepsen_tpu import tests as tst
+from jepsen_tpu.history import History, invoke_op, ok_op
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def mk_history(seed, n=50, conc=3, vmax=3) -> History:
+    """A small sequentially-consistent register history (valid by
+    construction) for engine dispatch tests."""
+    rng = random.Random(seed)
+    ops, val, open_ = [], None, {}
+    i = 0
+    while i < n:
+        p = rng.randrange(conc)
+        if p in open_:
+            ops.append(open_.pop(p))
+            continue
+        i += 1
+        if rng.random() < 0.5:
+            ops.append(invoke_op(p, "read", None))
+            open_[p] = ok_op(p, "read", val)
+        else:
+            v = rng.randint(0, vmax)
+            ops.append(invoke_op(p, "write", v))
+            val = v
+            open_[p] = ok_op(p, "write", v)
+    ops += list(open_.values())
+    return History(ops).index()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+class TestRegistry:
+    def test_concurrent_counter_increments(self):
+        reg = telemetry.MetricsRegistry()
+        c = reg.counter("x_total")
+
+        def worker():
+            for _ in range(1000):
+                c.inc()
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value == 8000
+
+    def test_concurrent_get_or_create_is_one_metric(self):
+        reg = telemetry.MetricsRegistry()
+        out = []
+
+        def worker():
+            out.append(reg.counter("y_total", node="n1"))
+
+        ts = [threading.Thread(target=worker) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(m is out[0] for m in out)
+
+    def test_labeled_counters_are_independent(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("ops_total", f="read").inc(3)
+        reg.counter("ops_total", f="write").inc()
+        assert reg.counter("ops_total", f="read").value == 3
+        assert reg.counter("ops_total", f="write").value == 1
+
+    def test_histogram_buckets(self):
+        h = telemetry.Histogram(buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.counts == [1, 2, 1, 1]      # last = +Inf overflow
+        assert h.count == 5
+        assert abs(h.sum - 5.605) < 1e-9
+        # cumulative quantile resolves to a bucket's upper edge
+        assert h.quantile(0.5) == 0.1
+        assert h.quantile(1.0) == 1.0        # +Inf reports last finite
+
+    def test_concurrent_histogram_observations(self):
+        reg = telemetry.MetricsRegistry()
+        h = reg.histogram("lat_seconds")
+
+        def worker():
+            for _ in range(500):
+                h.observe(0.01)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.count == 2000
+
+    def test_gauge(self):
+        reg = telemetry.MetricsRegistry()
+        g = reg.gauge("inflight")
+        g.set(5)
+        g.inc()
+        g.dec(2)
+        assert g.value == 4
+
+    def test_prometheus_snapshot(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("a_total", node="n1").inc(2)
+        reg.gauge("b").set(1.5)
+        reg.histogram("c_seconds", buckets=(0.1, 1.0)).observe(0.05)
+        text = reg.snapshot()
+        assert "# TYPE a_total counter" in text
+        assert 'a_total{node="n1"} 2' in text
+        assert "b 1.5" in text
+        assert 'c_seconds_bucket{le="0.1"} 1' in text
+        assert 'c_seconds_bucket{le="+Inf"} 1' in text
+        assert "c_seconds_count 1" in text
+
+    def test_kind_conflict_raises(self):
+        reg = telemetry.MetricsRegistry()
+        reg.counter("z")
+        with pytest.raises(TypeError):
+            reg.gauge("z")
+
+
+# ---------------------------------------------------------------------------
+# Event log crash-safety
+# ---------------------------------------------------------------------------
+
+class TestEventLog:
+    def test_roundtrip(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        log.append({"type": "op", "f": "read"})
+        log.append({"type": "fault-start", "key": "k"}, durable=True)
+        log.close()
+        evs = telemetry.read_events(p)
+        assert [e["type"] for e in evs] == ["op", "fault-start"]
+        assert [e["i"] for e in evs] == [0, 1]
+        assert all(isinstance(e["t"], float) for e in evs)
+
+    def test_torn_tail_recovers_prefix(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        for i in range(5):
+            log.append({"type": "op", "n": i})
+        log.close()
+        raw = p.read_bytes()
+        p.write_bytes(raw[:-7])       # tear mid-record
+        evs = telemetry.read_events(p)
+        assert [e["n"] for e in evs] == [0, 1, 2, 3]
+
+    def test_crc_mismatch_stops_at_corruption(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        for i in range(4):
+            log.append({"type": "op", "n": i})
+        log.close()
+        lines = p.read_text().splitlines()
+        lines[1] = lines[1].replace('"n":1', '"n":9')   # corrupt rec 1
+        p.write_text("\n".join(lines) + "\n")
+        evs = telemetry.read_events(p)
+        assert [e["n"] for e in evs] == [0]
+
+    def test_sequence_break_stops(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        for i in range(3):
+            log.append({"type": "op", "n": i})
+        log.close()
+        lines = p.read_text().splitlines()
+        del lines[1]                                    # drop rec 1
+        p.write_text("\n".join(lines) + "\n")
+        evs = telemetry.read_events(p)
+        assert [e["n"] for e in evs] == [0]
+
+    def test_append_after_close_is_noop(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        log.append({"type": "op"})
+        log.close()
+        log.append({"type": "op"})    # must not raise
+        assert len(telemetry.read_events(p)) == 1
+
+    def test_unjsonable_payload_survives_via_repr(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        log = telemetry.EventLog(p)
+        log.append({"type": "fault-start", "key": ("a", object())})
+        log.close()
+        evs = telemetry.read_events(p)
+        assert evs[0]["type"] == "fault-start"
+
+
+_KILL9_CHILD = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from jepsen_tpu import telemetry
+log = telemetry.EventLog({path!r})
+i = 0
+while True:
+    log.append({{"type": "op", "n": i}})
+    i += 1
+"""
+
+
+@pytest.mark.kill9
+class TestKill9:
+    def test_sigkill_mid_write_leaves_recoverable_prefix(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        p = tmp_path / "telemetry.jsonl"
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             _KILL9_CHILD.format(repo=repo, path=str(p))],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if p.exists() and p.read_bytes().count(b"\n") >= 50:
+                    break
+                if child.poll() is not None:
+                    pytest.fail("child exited before the kill")
+                time.sleep(0.02)
+            child.send_signal(signal.SIGKILL)
+        finally:
+            if child.poll() is None:
+                child.kill()
+            child.wait(timeout=30)
+        evs = telemetry.read_events(p)
+        assert len(evs) >= 50
+        # the recovered prefix is gapless and in order
+        assert [e["n"] for e in evs] == list(range(len(evs)))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch records on every engine entry point
+# ---------------------------------------------------------------------------
+
+class TestDispatchRecords:
+    def setup_method(self):
+        self.model = models.CASRegister()
+        self.hists = [mk_history(100 + s) for s in range(3)]
+
+    @staticmethod
+    def _assert_record(r, engines=None):
+        assert "dispatch" in r, r
+        rec = r["dispatch"]
+        assert "engine" in rec and "env" in rec
+        if engines is not None:
+            assert rec["engine"] in engines, rec
+
+    def test_seg_check_scalar(self):
+        from jepsen_tpu.ops import wgl_seg
+        r = wgl_seg.check(self.model, self.hists[0])
+        self._assert_record(r)
+        assert r["dispatch"]["fallback_chain"]
+
+    def test_seg_check_pipeline(self):
+        from jepsen_tpu.ops import wgl_seg
+        rs = wgl_seg.check_pipeline(self.model, self.hists)
+        for r in rs:
+            self._assert_record(r)
+            assert "stages" in r
+
+    def test_seg_check_many(self):
+        from jepsen_tpu.ops import wgl_seg
+        rs = wgl_seg.check_many(self.model, self.hists)
+        for r in rs:
+            self._assert_record(r)
+
+    def test_deep_check_pipeline(self):
+        from jepsen_tpu.ops import wgl_deep
+        rs = wgl_deep.check_pipeline(self.model, self.hists)
+        for r in rs:
+            self._assert_record(r)
+
+    def test_deep_check_mesh(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from jepsen_tpu.ops import wgl_deep
+        mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("hists",))
+        rs = wgl_deep.check_mesh(self.model, self.hists[:2], mesh)
+        for r in rs:
+            self._assert_record(r, engines={"wgl_deep"})
+            assert "hists" in r["dispatch"]["mesh"]
+
+    def test_batch_check_many(self):
+        from jepsen_tpu.ops import wgl_batch
+        rs = wgl_batch.check_many(self.model, self.hists)
+        for r in rs:
+            self._assert_record(r, engines={"wgl_batch", "wgl"})
+
+    def test_runner_engine_verdicts_carry_records(self):
+        from jepsen_tpu.ops import runner
+        rs = runner.ResilientRunner(engine="seg_many").check(
+            self.model, self.hists)
+        for r in rs:
+            self._assert_record(r)
+
+    def test_runner_quarantine_counts_and_records(self):
+        from jepsen_tpu.ops import runner
+        before = telemetry.REGISTRY.counter(
+            "jepsen_runner_quarantines_total").value
+
+        def boom(model, hists, **kw):
+            raise ValueError("corrupt history: bad bytes")
+
+        rs = runner.ResilientRunner(engine=boom, max_retries=0).check(
+            self.model, self.hists[:2])
+        assert all(r["valid?"] == "unknown" and r["quarantined"]
+                   for r in rs)
+        for r in rs:
+            self._assert_record(r, engines={"quarantine"})
+            assert r["dispatch"]["quarantines"] == 2
+        after = telemetry.REGISTRY.counter(
+            "jepsen_runner_quarantines_total").value
+        assert after - before == 2
+
+    def test_env_overrides_in_record(self, monkeypatch):
+        from jepsen_tpu.ops import wgl_seg
+        monkeypatch.setenv("JEPSEN_TPU_TEST_KNOB", "42")
+        r = wgl_seg.check(self.model, mk_history(7))
+        assert r["dispatch"]["env"]["JEPSEN_TPU_TEST_KNOB"] == "42"
+
+    def test_dispatch_events_reach_active_log(self, tmp_path):
+        from jepsen_tpu.ops import wgl_seg
+        tele = telemetry.Telemetry(
+            enabled=True, log=telemetry.EventLog(tmp_path / "t.jsonl"),
+            registry=telemetry.MetricsRegistry())
+        telemetry.set_active(tele)
+        try:
+            wgl_seg.check_pipeline(self.model, self.hists)
+        finally:
+            telemetry.clear_active(tele)
+            tele.close()
+        evs = telemetry.read_events(tmp_path / "t.jsonl")
+        ds = [e for e in evs if e["type"] == "dispatch"]
+        assert ds and ds[0]["record"]["engine"] == "wgl_seg"
+        assert isinstance(ds[0].get("stages"), dict)
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a named run produces a full telemetry.jsonl
+# ---------------------------------------------------------------------------
+
+class LedgerNemesis(nem.Nemesis):
+    """Registers/resolves a synthetic fault through the test's ledger —
+    the same path every real fault primitive (partitions, net faults,
+    process kills, disk faults) takes."""
+
+    def invoke(self, test, op):
+        led = nem.ledger(test)
+        if op.f == "start":
+            led.register("synthetic-fault", lambda: None, "windowed")
+        else:
+            led.resolve("synthetic-fault")
+        return op
+
+
+def run_named_test(name="telem-test", telemetry_opt=None, trace=None,
+                   n_ops=25):
+    state = tst.Atom()
+    test = dict(tst.noop_test(), **{
+        "name": name,
+        "db": tst.atom_db(state),
+        "client": tst.atom_client(state),
+        "concurrency": 2,
+        "nemesis": LedgerNemesis(),
+        "generator": gen.nemesis(
+            gen.concat(gen.once({"type": "info", "f": "start"}),
+                       gen.once({"type": "info", "f": "stop"})),
+            gen.limit(n_ops, gen.cas)),
+        "checker": ck.linearizable({"model": models.CASRegister(0)}),
+    })
+    if telemetry_opt is not None:
+        test["telemetry"] = telemetry_opt
+    if trace is not None:
+        test["trace"] = trace
+    return core.run(test)
+
+
+class TestRunTelemetry:
+    def test_named_run_produces_full_log(self):
+        done = run_named_test()
+        p = store.test_dir(done) / "telemetry.jsonl"
+        assert p.exists()
+        evs = telemetry.read_events(p)
+        types = [e["type"] for e in evs]
+        # op-latency metrics: per-op events + the aggregate snapshot
+        ops = [e for e in evs if e["type"] == "op"]
+        assert len(ops) == 25
+        assert all(e["latency_ns"] is not None and e["outcome"]
+                   in ("ok", "fail", "info") for e in ops)
+        snaps = [e for e in evs if e["type"] == "metrics"]
+        assert snaps and "jepsen_op_latency_seconds" in \
+            snaps[-1]["snapshot"]
+        # at least one fault-window event pair
+        windows = telemetry.pair_fault_windows(evs)
+        assert windows and windows[0][1] is not None \
+            and windows[0][2] is not None
+        # per-verdict dispatch records with stage timings, in the log
+        # AND on the stored verdict
+        ds = [e for e in evs if e["type"] == "dispatch"]
+        assert ds and ds[0]["record"]["engine"]
+        assert "run-start" in types and "run-end" in types
+        results = json.load(open(store.test_dir(done) / "results.json"))
+        assert results["dispatch"]["engine"] == results["engine"]
+        assert "stages" in results
+
+    def test_fault_ledger_heal_backstop_emits_stop(self):
+        """A nemesis that dies mid-fault: the teardown ledger backstop
+        heals it, and the stop event is tagged healed=True."""
+
+        class DyingNem(nem.Nemesis):
+            def invoke(self, test, op):
+                nem.ledger(test).register("orphan", lambda: None, "w")
+                raise RuntimeError("nemesis died mid-fault")
+
+        state = tst.Atom()
+        done = core.run(dict(tst.noop_test(), **{
+            "name": "telem-heal",
+            "db": tst.atom_db(state),
+            "client": tst.atom_client(state),
+            "concurrency": 2,
+            "nemesis": DyingNem(),
+            "generator": gen.nemesis(
+                gen.once({"type": "info", "f": "start"}),
+                gen.limit(5, gen.cas)),
+            "checker": ck.linearizable({"model": models.CASRegister(0)}),
+        }))
+        evs = telemetry.read_events(
+            store.test_dir(done) / "telemetry.jsonl")
+        stops = [e for e in evs if e["type"] == "fault-stop"]
+        assert stops and stops[-1]["healed"] is True
+
+    def test_trace_spans_bridge_into_event_log(self):
+        done = run_named_test(name="telem-trace", trace=True, n_ops=8)
+        evs = telemetry.read_events(
+            store.test_dir(done) / "telemetry.jsonl")
+        spans = [e for e in evs if e["type"] == "span"]
+        assert spans, "no spans bridged"
+        names = {e["span"]["name"] for e in spans}
+        assert "client/invoke" in names
+        assert "nemesis/invoke" in names
+        # and the standalone trace.jsonl export still happens
+        assert (store.test_dir(done) / "trace.jsonl").exists()
+
+    def test_telemetry_false_disables(self):
+        done = run_named_test(name="telem-off", telemetry_opt=False)
+        assert not (store.test_dir(done) / "telemetry.jsonl").exists()
+        assert done["results"]["valid?"] is True
+
+    def test_unnamed_run_writes_nothing(self, tmp_path):
+        state = tst.Atom()
+        test = dict(tst.noop_test(), **{
+            "name": None,           # unnamed: no store dir, no log
+            "db": tst.atom_db(state),
+            "client": tst.atom_client(state),
+            "concurrency": 2,
+            "generator": gen.nemesis(gen.void, gen.limit(5, gen.cas)),
+            "checker": ck.linearizable({"model": models.CASRegister(0)}),
+        })
+        done = core.run(test)
+        assert done["results"]["valid?"] is True
+        assert telemetry.of(done).enabled is False
+
+
+# ---------------------------------------------------------------------------
+# Breaker transitions
+# ---------------------------------------------------------------------------
+
+class TestBreakerTelemetry:
+    def test_transitions_are_journaled(self, tmp_path):
+        from jepsen_tpu.reconnect import BreakerOpen, CircuitBreaker
+        tele = telemetry.Telemetry(
+            enabled=True, log=telemetry.EventLog(tmp_path / "t.jsonl"),
+            registry=telemetry.MetricsRegistry())
+        telemetry.set_active(tele)
+        try:
+            clock = [0.0]
+            b = CircuitBreaker(node="n9", threshold=2, cooldown_s=5,
+                               clock=lambda: clock[0])
+            b.failure()
+            b.failure()                       # -> open
+            with pytest.raises(BreakerOpen):
+                b.check()
+            clock[0] = 6.0
+            b.check()                         # -> half-open probe
+            b.success()                       # -> closed
+        finally:
+            telemetry.clear_active(tele)
+            tele.close()
+        evs = telemetry.read_events(tmp_path / "t.jsonl")
+        trans = [(e["node"], e["to"]) for e in evs
+                 if e["type"] == "breaker"]
+        assert trans == [("n9", "open"), ("n9", "half-open"),
+                        ("n9", "closed")]
+
+
+# ---------------------------------------------------------------------------
+# CLI metrics summary
+# ---------------------------------------------------------------------------
+
+class TestCliMetrics:
+    def _fixture_log(self, tmp_path):
+        d = tmp_path / "run"
+        d.mkdir()
+        log = telemetry.EventLog(d / "telemetry.jsonl")
+        t0 = time.time()
+        for i in range(40):
+            log.append({"type": "op", "f": "read", "node": "n1",
+                        "outcome": "ok", "process": i % 3,
+                        "time": i * 1000, "latency_ns": 2_000_000 + i})
+        log.append({"type": "fault-start", "key": "'p'", "desc": "w"},
+                   durable=True)
+        log.append({"type": "fault-stop", "key": "'p'",
+                    "healed": False}, durable=True)
+        log.append({"type": "dispatch",
+                    "record": {"engine": "wgl_seg", "env": {}},
+                    "stages": {"scan": 0.1, "fill": 0.2},
+                    "verdicts": 3})
+        log.append({"type": "runner", "oom_bisections": 1, "retries": 2,
+                    "quarantines": 0, "cpu_fallbacks": 0})
+        log.close()
+        return d
+
+    def test_summarize_sections(self, tmp_path):
+        d = self._fixture_log(tmp_path)
+        out = telemetry.summarize(
+            telemetry.read_events(d / "telemetry.jsonl"))
+        assert "ops: 40 completed" in out
+        assert "read@n1 ok" in out and "p95=" in out
+        assert "engine mix: wgl_seg=3" in out
+        assert "fault windows: 1" in out
+        assert "oom_bisections=1" in out
+        assert "stage seconds:" in out
+
+    def test_cli_metrics_exit_0(self, tmp_path, capsys):
+        d = self._fixture_log(tmp_path)
+        assert cli.main(cli.standard_commands(),
+                        ["metrics", str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "ops: 40 completed" in out
+
+    def test_cli_metrics_missing_exits_255(self, tmp_path):
+        assert cli.main(cli.standard_commands(),
+                        ["metrics", str(tmp_path)]) == 255
+
+    def test_cli_metrics_on_real_run(self, capsys):
+        done = run_named_test(name="telem-cli")
+        d = store.test_dir(done)
+        assert cli.main(cli.standard_commands(),
+                        ["metrics", str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "fault windows" in out and "engine mix" in out
+
+    def test_suite_commands_include_metrics(self):
+        cmds = cli.single_test_cmd(lambda opts: {})
+        assert "metrics" in cmds
+
+
+# ---------------------------------------------------------------------------
+# Web surfaces
+# ---------------------------------------------------------------------------
+
+class TestWebTelemetry:
+    @pytest.fixture()
+    def served(self):
+        done = run_named_test(name="telem-web")
+        srv = web.serve(host="127.0.0.1", port=0, block=False)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        yield base, done
+        srv.shutdown()
+        srv.server_close()
+
+    def get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read()
+
+    def test_telemetry_index_lists_run(self, served):
+        base, _ = served
+        status, body = self.get(base + "/telemetry")
+        assert status == 200 and b"telem-web" in body
+
+    def test_run_page_renders_sparklines_and_windows(self, served):
+        base, done = served
+        ts = store.test_dir(done).name
+        from urllib.parse import quote
+        status, body = self.get(
+            f"{base}/telemetry/telem-web/{quote(ts)}")
+        assert status == 200
+        text = body.decode()
+        assert "<svg" in text and "polyline" in text
+        assert "op rate" in text and "p95" in text
+        assert "<rect" in text          # shaded nemesis window
+        assert "engine mix" in text     # inline summary
+
+    def test_metrics_endpoint_is_prometheus(self, served):
+        base, _ = served
+        status, body = self.get(base + "/metrics")
+        assert status == 200
+        assert b"# TYPE jepsen_op_latency_seconds histogram" in body
+
+    def test_missing_run_404(self, served):
+        base, _ = served
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self.get(base + "/telemetry/nope/nope")
+        assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------------------
+# Overhead bounds (stated precisely in docs/observability.md)
+# ---------------------------------------------------------------------------
+
+class TestOverhead:
+    def test_disabled_path_is_noop_cheap(self):
+        # 200k disabled record_op calls well under a second: the off
+        # switch is one attribute check, so always-on instrumentation
+        # in the worker loop is safe to leave unconditional.
+        tele = telemetry.Telemetry(enabled=False)
+        t0 = time.monotonic()
+        for i in range(200_000):
+            tele.record_op("read", "n1", "ok", 0, 1000, process=1)
+        assert time.monotonic() - t0 < 2.0
+
+    def test_enabled_per_op_cost_is_bounded(self, tmp_path):
+        # The enabled path buys one histogram observe + one buffered
+        # (non-fsync) line write per op.  Budget: < 2 ms/op average —
+        # two orders of magnitude under a real SUT round trip, which
+        # is how the <5% end-to-end bound holds (the kvd e2e op path
+        # includes a TCP round trip + the fsynced history WAL).
+        tele = telemetry.Telemetry(
+            enabled=True, log=telemetry.EventLog(tmp_path / "t.jsonl"),
+            registry=telemetry.MetricsRegistry())
+        n = 2000
+        t0 = time.monotonic()
+        for i in range(n):
+            tele.record_op("read", "n1", "ok", i * 1000,
+                           i * 1000 + 5000, process=i % 3)
+        wall = time.monotonic() - t0
+        tele.close()
+        assert wall / n < 0.002, f"{wall / n * 1e3:.3f} ms/op"
+        assert len(telemetry.read_events(tmp_path / "t.jsonl")) == n
+
+    def test_end_to_end_overhead_loose(self):
+        # Loose end-to-end guard (the precise numbers live in the
+        # docs): the same 60-op run with telemetry on vs off must not
+        # blow up.  Generous factor — CI wall clocks are noisy; the
+        # per-op bound above is the precise assertion.
+        class TrivialChecker(ck.Checker):
+            def check(self, test, history, opts=None):
+                return {"valid?": True}
+
+        def run_once(name, telemetry_opt):
+            state = tst.Atom()
+            test = dict(tst.noop_test(), **{
+                "name": name,
+                "db": tst.atom_db(state),
+                "client": tst.atom_client(state),
+                "concurrency": 2,
+                "generator": gen.nemesis(gen.void,
+                                         gen.limit(60, gen.cas)),
+                "checker": TrivialChecker(),
+            })
+            if telemetry_opt is not None:
+                test["telemetry"] = telemetry_opt
+            t0 = time.monotonic()
+            core.run(test)
+            return time.monotonic() - t0
+
+        off = run_once("ovh-off", False)
+        on = run_once("ovh-on", None)
+        assert on < off * 2.0 + 2.0, (on, off)
