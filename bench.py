@@ -667,73 +667,83 @@ def main() -> int:
           f"{badw_med:.3f}s) with exact witness op "
           f"{rbw.get('op_index')} == planted read", file=sys.stderr)
 
-    # (d) the DEEP regime (VERDICT r4 #3): a subtle legal-value stale
-    # read planted at 90% depth of an R = 10 history — the regime where
-    # the envelope claims the megakernel wins on VALID histories must
-    # also win time-to-witness on invalid ones.  The wgl_deep kernel
+    # (d) the DEEP regime (VERDICT r4 #3, extended per r5 Next #7): a
+    # subtle legal-value stale read planted at 90% depth of R = 10 /
+    # 12 / 14 histories — the full invalid-half of the envelope, at
+    # the SAME depths as the valid half below.  The wgl_deep kernel
     # reports the exact failing event; witness equality vs the capped
-    # oracle is asserted.
-    badd = make_history(20_000, 16, seed=53, vmax=9, max_open=10)
-    planted_d = plant_stale_read(badd, 0.9, 9)
-    if planted_d is None:
-        print(json.dumps({"metric": "ERROR: no plantable stale read "
-                          "in the deep regime", "value": 0,
-                          "unit": "ops/sec", "vs_baseline": 0}))
-        return 1
-    dp = planted_d[0]
-    p_d = badd.ops[dp].process
-    inv_d = dp
-    while inv_d >= 0 and not (badd.ops[inv_d].process == p_d
-                              and badd.ops[inv_d].type == "invoke"):
-        inv_d -= 1
-    expected_d = badd.ops[inv_d].index
-    # localize=False: the kernel names the exact witness itself; the
-    # optional localize tier replays a capped oracle on the prefix for
-    # final-paths artifacts, which would time the oracle, not the
-    # device (the same measurement choice as the crash-regime lines)
-    wgl_seg.check(model, badd, max_open_bits=12,          # warm
-                  localize=False)
-    badd_wall, badd_med, rbd = timed(
-        lambda: wgl_seg.check(model, badd, max_open_bits=12,
-                              localize=False))
-    if rbd["valid?"] is not False or rbd.get("engine") != "wgl_deep" \
-            or rbd.get("op_index") != expected_d:
-        print(json.dumps({"metric": "ERROR: deep-regime violation not "
-                          "refuted by wgl_deep with the exact witness: "
-                          + str({k: rbd.get(k) for k in
-                                 ("valid?", "engine", "op_index")})
-                          + f" expected witness {expected_d}",
-                          "value": 0, "unit": "ops/sec",
-                          "vs_baseline": 0}))
-        return 1
-    t0 = time.monotonic()
-    obd = wgl_cpu.check(model, badd, time_limit=HARD_CPU_CAP)
-    cpu_badd_s = time.monotonic() - t0
-    nbd = sum(1 for o in badd if o.is_invoke)
-    if obd.get("cause"):
-        frac = obd.get("events_done", 0) / max(
-            1, obd.get("events_total", 1))
-        badd_note = (f"CPU {obd.get('cause')} at {cpu_badd_s:.0f}s "
-                     f"({frac:.0%} of events, no verdict)")
-    else:
-        badd_note = f"CPU {cpu_badd_s:.2f}s"
-        if obd.get("op_index") != expected_d:
-            print(json.dumps({"metric": "ERROR: deep-regime oracle "
-                              "witness mismatch", "value": 0,
-                              "unit": "ops/sec", "vs_baseline": 0}))
+    # oracle is asserted whenever the oracle finishes.
+    for mo_d, seed_d in ((10, 53), (12, 57), (14, 59)):
+        badd = make_history(20_000, 16, seed=seed_d, vmax=9,
+                            max_open=mo_d)
+        planted_d = plant_stale_read(badd, 0.9, 9)
+        if planted_d is None:
+            print(json.dumps({"metric": "ERROR: no plantable stale "
+                              f"read in the deep regime R={mo_d}",
+                              "value": 0, "unit": "ops/sec",
+                              "vs_baseline": 0}))
             return 1
-    print(json.dumps({
-        "metric": (f"refutation, deep regime: {nbd // 1000}k ops at "
-                   "max_open=10, stale LEGAL-value read at 90% depth; "
-                   "wgl_deep megakernel time-to-witness vs capped CPU "
-                   "oracle"),
-        "value": round(nbd / badd_wall, 1), "unit": "ops/sec",
-        "vs_baseline": round(cpu_badd_s / badd_wall, 2)}),
-        file=sys.stderr)
-    print(f"# refutation deep regime: exact witness op "
-          f"{rbd.get('op_index')} == planted read in {badd_wall:.3f}s "
-          f"(median {badd_med:.3f}s; wgl_deep); {badd_note}",
-          file=sys.stderr)
+        dp = planted_d[0]
+        p_d = badd.ops[dp].process
+        inv_d = dp
+        while inv_d >= 0 and not (badd.ops[inv_d].process == p_d
+                                  and badd.ops[inv_d].type == "invoke"):
+            inv_d -= 1
+        expected_d = badd.ops[inv_d].index
+        # localize=False: the kernel names the exact witness itself;
+        # the optional localize tier replays a capped oracle on the
+        # prefix for final-paths artifacts, which would time the
+        # oracle, not the device (the same measurement choice as the
+        # crash-regime lines).  max_open_bits=15 admits the R=14 row
+        # (the depth cap is the kernel's R_MAX, not this plan gate).
+        wgl_seg.check(model, badd, max_open_bits=15,          # warm
+                      localize=False)
+        badd_wall, badd_med, rbd = timed(
+            lambda badd=badd: wgl_seg.check(model, badd,
+                                            max_open_bits=15,
+                                            localize=False))
+        if rbd["valid?"] is not False \
+                or rbd.get("engine") != "wgl_deep" \
+                or rbd.get("op_index") != expected_d:
+            print(json.dumps({"metric": "ERROR: deep-regime "
+                              f"(R={mo_d}) violation not refuted by "
+                              "wgl_deep with the exact witness: "
+                              + str({k: rbd.get(k) for k in
+                                     ("valid?", "engine", "op_index")})
+                              + f" expected witness {expected_d}",
+                              "value": 0, "unit": "ops/sec",
+                              "vs_baseline": 0}))
+            return 1
+        t0 = time.monotonic()
+        obd = wgl_cpu.check(model, badd, time_limit=HARD_CPU_CAP)
+        cpu_badd_s = time.monotonic() - t0
+        nbd = sum(1 for o in badd if o.is_invoke)
+        if obd.get("cause"):
+            frac = obd.get("events_done", 0) / max(
+                1, obd.get("events_total", 1))
+            badd_note = (f"CPU {obd.get('cause')} at {cpu_badd_s:.0f}s "
+                         f"({frac:.0%} of events, no verdict)")
+        else:
+            badd_note = f"CPU {cpu_badd_s:.2f}s"
+            if obd.get("op_index") != expected_d:
+                print(json.dumps({"metric": "ERROR: deep-regime "
+                                  f"(R={mo_d}) oracle witness "
+                                  "mismatch", "value": 0,
+                                  "unit": "ops/sec",
+                                  "vs_baseline": 0}))
+                return 1
+        print(json.dumps({
+            "metric": (f"refutation, deep regime: {nbd // 1000}k ops "
+                       f"at max_open={mo_d}, stale LEGAL-value read "
+                       "at 90% depth; wgl_deep megakernel "
+                       "time-to-witness vs capped CPU oracle"),
+            "value": round(nbd / badd_wall, 1), "unit": "ops/sec",
+            "vs_baseline": round(cpu_badd_s / badd_wall, 2)}),
+            file=sys.stderr)
+        print(f"# refutation deep regime R={mo_d}: exact witness op "
+              f"{rbd.get('op_index')} == planted read in "
+              f"{badd_wall:.3f}s (median {badd_med:.3f}s; wgl_deep); "
+              f"{badd_note}", file=sys.stderr)
 
     # --- Envelope: overlap depth (max simultaneously-open calls),
     # the axis the reference's tutorial names as THE cost cliff
@@ -901,6 +911,99 @@ def main() -> int:
           f"({nck / mk_wall / 1e6:.1f}M ops/s; every key batched, "
           "crash-bearing keys ride as stripped twins)", file=sys.stderr)
 
+    # --- Elle: typed-plane transactional isolation closure (the
+    # serializability counterpart of the envelope, ISSUE 5): batched
+    # log-squaring closure over stacked ww/wr/rw/po/rt planes, anomaly
+    # class decided by masked plane combinations (ops/elle_graph.py).
+    # Correctness pinned by a planted G-single in half of each batch
+    # and a clean DAG in the other half; throughput = histories/s at
+    # 1k- and 10k-txn scales vs the naive host oracle (numpy f32
+    # closures; at 10k the host wall is extrapolated from 2 measured
+    # squarings of the identical-squaring schedule — disclosed). ----
+    import math as math_mod
+
+    from jepsen_tpu.ops import elle_graph
+
+    def elle_stack(n, seed, plant):
+        rng = np.random.RandomState(seed)
+        st = np.zeros((5, n, n), bool)
+        perm = rng.permutation(n)
+        pos = np.empty(n, int)
+        pos[perm] = np.arange(n)
+        fwd = pos[:, None] < pos[None, :]          # DAG: clean by
+        for p in range(2):                         # construction;
+            st[p] = fwd & (rng.rand(n, n) < 4.0 / n)   # ww + wr only
+        # rw stays empty except the plant below — a random forward rw
+        # could pair with the planted backward one into a REAL ≥2-rw
+        # cycle and turn the expected G-single into a G2
+        for a, b in zip(perm, perm[1:]):
+            st[3, a, b] = True                     # po chain
+        st[4] = fwd & (rng.rand(n, n) < 1.0 / n)   # rt sample
+        if plant:
+            # ONE backward rw edge: its forward return path rides the
+            # po chain, and since every other edge is forward the only
+            # cycles are single-rw — exactly G-single, no G0/G1c/G2
+            a, b = int(perm[n // 3]), int(perm[2 * n // 3])
+            st[2, b, a] = True
+        return st
+
+    elle_stats = {}
+    for n_e, B_e in ((1_000, 8), (10_000, 1)):
+        stacks = [elle_stack(n_e, 1000 + n_e + i, plant=(i % 2 == 0))
+                  for i in range(B_e)]
+        elle_graph.classify_batch(stacks)              # warm compile
+        e_bad: list = []
+
+        def _elle_run(stacks=stacks, bad=e_bad):
+            rows = elle_graph.classify_batch(stacks)
+            for i, r in enumerate(rows):
+                want = {"G-single"} if i % 2 == 0 else set()
+                if set(r["anomalies"]) != want:
+                    bad.append((i, sorted(r["anomalies"])))
+            return rows
+
+        ew_min, ew_med, _ = timed(_elle_run, n=3)
+        if e_bad:
+            print(json.dumps({"metric": "ERROR: elle closure "
+                              f"misclassified at n={n_e}: "
+                              + str(e_bad[:4]), "value": 0,
+                              "unit": "histories/s",
+                              "vs_baseline": 0}))
+            return 1
+        if n_e <= 1_000:
+            t0 = time.monotonic()
+            for s in stacks:
+                elle_graph.classify_host(s)
+            host_s = time.monotonic() - t0
+            host_note = "measured"
+        else:
+            steps = max(1, math_mod.ceil(math_mod.log2(n_e - 1)))
+            a = (stacks[0][0] | stacks[0][1] | stacks[0][3]
+                 | stacks[0][4]).astype(np.float32)
+            t0 = time.monotonic()
+            for _ in range(2):
+                a = (a @ a > 0).astype(np.float32)
+            per_sq = (time.monotonic() - t0) / 2
+            # the full oracle runs ~6 closure chains of `steps`
+            # squarings each (c_ww, c_wwr, 4 matmuls/step in the
+            # ≥1-rw pair closure)
+            host_s = per_sq * steps * 6 * len(stacks)
+            host_note = f"extrapolated from 2/{steps} squarings"
+        per_hist_e = ew_min / len(stacks)
+        elle_stats[n_e] = (per_hist_e, host_s / ew_min)
+        print(json.dumps({
+            "metric": (f"elle typed-plane closure: {B_e}x {n_e}-txn "
+                       "histories/batch, batched device "
+                       "classification (G0/G1c/G-single/G2 masks) "
+                       f"vs naive host oracle ({host_note})"),
+            "value": round(len(stacks) / ew_min, 2),
+            "unit": "histories/s",
+            "vs_baseline": round(host_s / ew_min, 2)}),
+            file=sys.stderr)
+        print(f"# elle n={n_e}: device {ew_min:.3f}s/batch (median "
+              f"{ew_med:.3f}s, {per_hist_e * 1e3:.0f}ms/history); "
+              f"host {host_s:.2f}s ({host_note})", file=sys.stderr)
+
     print(json.dumps({
         "metric": (f"linearizability check throughput, {N_KEYS} "
                    f"independent {OPS_PER_KEY}-op register histories "
@@ -936,6 +1039,13 @@ def main() -> int:
         "wire_mb_s": round(wire_mb_s, 1),
         "straggler_r15_s": round(strag_wall, 4),
         "straggler_vs_native": round(nat15_s / strag_wall, 2),
+        # the new transactional-isolation engine's trajectory
+        # (BENCH_r06+): device seconds per history for the batched
+        # typed-plane closure, and its speedup vs the host oracle
+        "elle_1k_hist_s": round(elle_stats[1_000][0], 4),
+        "elle_1k_vs_host": round(elle_stats[1_000][1], 2),
+        "elle_10k_hist_s": round(elle_stats[10_000][0], 4),
+        "elle_10k_vs_host": round(elle_stats[10_000][1], 2),
     }))
     print(f"# multi-key: {n_ops} ops / {N_KEYS} keys in {kernel_s:.3f}s "
           f"kernel (median {kernel_med:.3f}s; {warm_s:.2f}s wall incl. "

@@ -1,0 +1,28 @@
+"""Elle-style transactional isolation analysis (Kingsbury & Alvaro,
+*Elle: Inferring Isolation Anomalies from Experimental Observations*,
+VLDB 2020; anomaly taxonomy from Adya's thesis, MIT 1999).
+
+The subsystem spans four layers:
+
+  * workloads  — `jepsen_tpu.workloads.list_append` /
+    `jepsen_tpu.workloads.rw_register` generate *recoverable* txn
+    histories: every write is unique per key, so observations name
+    their writers exactly.
+  * inference  — `jepsen_tpu.elle.infer` derives per-key version
+    orders from observed states and emits typed dependency-edge
+    planes (ww, wr, rw, plus process and realtime order planes);
+    G1a (aborted read) and G1b (intermediate read) fall out of the
+    same pass.
+  * kernels    — `jepsen_tpu.ops.elle_graph` runs the typed-cycle
+    search as batched boolean-matmul closures on device; the anomaly
+    class (G0, G1c, G-single, G2-item) is decided by which plane
+    combination closes a cycle.
+  * verdicts   — `jepsen_tpu.checker.elle` maps found anomalies to
+    the weakest violated isolation level and plugs into the standard
+    Checker machinery (compose, independent batching, the resilient
+    runner, dispatch telemetry, report/web rendering).
+
+See docs/elle.md for the full design.
+"""
+
+from jepsen_tpu.elle import infer  # noqa: F401

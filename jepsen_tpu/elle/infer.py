@@ -1,0 +1,421 @@
+"""Dependency inference: observed txn history -> typed edge planes.
+
+From the observed values of a *recoverable* transactional workload
+(every write unique per key — `workloads/list_append.py`,
+`workloads/rw_register.py`), derive per-key version orders and emit
+one boolean adjacency plane per dependency type over committed
+transactions:
+
+    ww  write-write:  Tv installed a version, Tw installed a later one
+    wr  write-read:   Tw installed the version Tr observed
+    rw  anti-dep:     Tr observed a version preceding Tw's write
+    po  process:      same worker process, consecutive txns
+    rt  realtime:     Tw completed before Tr invoked
+
+Soundness discipline (the property the whole subsystem leans on —
+every reported cycle must exist in the real DSG):
+
+  * list-append: the version order of key k is recovered from observed
+    list states, which must form a prefix chain (longest read wins;
+    a non-prefix read is itself an anomaly, `incompatible-order`).
+  * rw-register: version order uses *evidence only* — the initial nil
+    precedes everything, and a txn that read u before writing v
+    proves u ≺ v (write-follows-read).  An emitted ww/rw edge over a
+    non-adjacent version pair stands for a real edge followed by a
+    ww-path, so cycle existence and rw-edge counts (what the Adya
+    classification keys on) are preserved.
+  * reads already condemned as G1a (aborted/garbage read) or G1b
+    (intermediate read) contribute NO dependency edges: their version
+    positions are unreliable, and the direct anomaly already carries
+    the report.
+
+G1a and G1b are detected inline during this pass; cycles are the
+device kernels' job (`jepsen_tpu.ops.elle_graph`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from jepsen_tpu import txn as mop
+from jepsen_tpu.history import History
+
+# Fixed plane order — ops/elle_graph.py indexes by position.
+PLANES = ("ww", "wr", "rw", "po", "rt")
+DEP_PLANES = ("ww", "wr", "rw")
+
+LIST_APPEND = "list-append"
+RW_REGISTER = "rw-register"
+
+
+@dataclasses.dataclass
+class Inference:
+    """Everything the cycle kernels and the report need."""
+
+    txns: list                    # (invoke, ok) Op pairs, completion order
+    planes: dict                  # plane name -> bool [n, n]
+    edge_types: dict              # (a, b) -> set of dep-plane names
+    direct: dict                  # anomaly name -> [witness dicts]
+    workload: str
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def n(self) -> int:
+        return len(self.txns)
+
+    def stacked(self) -> np.ndarray:
+        """Planes as one [len(PLANES), n, n] bool array."""
+        return np.stack([self.planes[p] for p in PLANES])
+
+
+class _Edges:
+    def __init__(self, n: int):
+        self.planes = {p: np.zeros((n, n), bool) for p in PLANES}
+        self.types: dict = {}
+
+    def add(self, plane: str, a: int, b: int) -> None:
+        if a == b or a is None or b is None:
+            return
+        self.planes[plane][a, b] = True
+        if plane in DEP_PLANES:
+            self.types.setdefault((a, b), set()).add(plane)
+
+
+def txn_mops(okop) -> list:
+    return [m for m in (okop.value or []) if mop.is_op(m)]
+
+
+def detect_workload(history) -> str:
+    """Sniff ALL ops (a failed append still marks the workload)."""
+    for o in History(history):
+        if isinstance(o.value, (list, tuple)):
+            for m in o.value:
+                if mop.is_op(m) and mop.is_append(m):
+                    return LIST_APPEND
+    return RW_REGISTER
+
+
+def collect_txns(history):
+    """(ok_pairs, failed_writes, indeterminate_writes): ok txns as
+    (invoke, ok) pairs in completion order; the (k, v) write/append
+    sets of failed txns (definitely didn't commit -> reading one is
+    G1a) and of info txns (may have committed -> reading one is NOT an
+    anomaly, but the writer isn't a graph node)."""
+    hist = History(history)
+    inv: dict = {}
+    ok_pairs, failed, indet = [], set(), set()
+
+    def writes_of(v):
+        return {(mop.key(m), mop.value(m)) for m in (v or [])
+                if mop.is_op(m) and (mop.is_write(m) or mop.is_append(m))
+                and not isinstance(mop.value(m), (list, dict, set))}
+
+    for o in hist:
+        if not isinstance(o.value, (list, tuple)) or isinstance(
+                o.value, str):
+            continue
+        if o.value and not all(mop.is_op(m) for m in o.value):
+            continue
+        if o.is_invoke:
+            inv[o.process] = o
+        elif o.process in inv:
+            first = inv.pop(o.process)
+            if o.is_ok:
+                ok_pairs.append((first, o))
+            elif o.is_fail:
+                failed |= writes_of(first.value)
+            else:                    # info: indeterminate
+                indet |= writes_of(first.value)
+    # invocations never completed are indeterminate too
+    for o in inv.values():
+        indet |= writes_of(o.value)
+    return ok_pairs, failed, indet
+
+
+def _order_planes(txns: list, edges: _Edges) -> None:
+    """po: consecutive txns of one process; rt: ok strictly before
+    invoke (vectorized — the O(n^2) pair set is exactly the plane)."""
+    n = len(txns)
+    by_proc: dict = {}
+    for i, (inv, _) in enumerate(txns):
+        by_proc.setdefault(inv.process, []).append(i)
+    for seq in by_proc.values():
+        for a, b in zip(seq, seq[1:]):
+            edges.add("po", a, b)
+    if n:
+        inv_idx = np.array([inv.index if inv.index is not None else -1
+                            for inv, _ in txns], np.int64)
+        ok_idx = np.array([ok.index if ok.index is not None else -1
+                           for _, ok in txns], np.int64)
+        known = (inv_idx >= 0) & (ok_idx >= 0)
+        rt = (ok_idx[:, None] < inv_idx[None, :]) \
+            & known[:, None] & known[None, :]
+        np.fill_diagonal(rt, False)
+        edges.planes["rt"] = rt
+
+
+# ---------------------------------------------------------------------------
+# list-append
+# ---------------------------------------------------------------------------
+
+def _infer_list_append(txns, failed, indet, edges: _Edges):
+    direct: dict = {}
+    meta: dict = {"keys": 0}
+
+    def flag(name, i, m, **kw):
+        direct.setdefault(name, []).append(
+            dict({"op": txns[i][1].to_dict(), "mop": list(m)}, **kw))
+
+    # per-key append bookkeeping over committed txns
+    writer_of: dict = {}          # (k, v) -> txn index
+    appends_by_txn: dict = {}     # (k, txn) -> [v, ...] in mop order
+    for i, (_, okop) in enumerate(txns):
+        for m in txn_mops(okop):
+            if mop.is_append(m):
+                k, v = mop.key(m), mop.value(m)
+                if (k, v) in writer_of and writer_of[(k, v)] != i:
+                    flag("duplicate-elements", i, m,
+                         other=txns[writer_of[(k, v)]][1].to_dict())
+                    continue
+                writer_of[(k, v)] = i
+                appends_by_txn.setdefault((k, i), []).append(v)
+
+    # observed states per key; version order = longest prefix chain
+    reads: list = []              # (txn index, key, state tuple, mop)
+    for i, (_, okop) in enumerate(txns):
+        for m in txn_mops(okop):
+            if mop.is_read(m):
+                s = mop.value(m)
+                if s is None:
+                    s = []
+                if not isinstance(s, (list, tuple)):
+                    continue
+                reads.append((i, mop.key(m), tuple(s), m))
+
+    orders: dict = {}             # key -> tuple of values, longest observed
+    for i, k, s, m in reads:
+        if len(s) > len(orders.get(k, ())):
+            orders[k] = s
+    meta["keys"] = len({k for k, _ in writer_of} | set(orders))
+
+    # classify each read; only clean prefix reads contribute edges
+    for i, k, s, m in reads:
+        order = orders.get(k, ())
+        bad = False
+        for v in s:
+            if (k, v) in failed:
+                flag("G1a", i, m, kind="aborted")
+                bad = True
+                break
+            if (writer_of.get((k, v)) is None and (k, v) not in indet):
+                flag("G1a", i, m, kind="garbage")
+                bad = True
+                break
+        if bad:
+            continue
+        seen = set(s)
+        for (k2, t), vs in appends_by_txn.items():
+            if k2 != k or t == i or len(vs) < 2:
+                continue
+            if any(v in seen for v in vs[:-1]) and vs[-1] not in seen:
+                flag("G1b", i, m, writer=txns[t][1].to_dict())
+                bad = True
+                break
+        if bad:
+            continue
+        if tuple(order[:len(s)]) != tuple(s):
+            flag("incompatible-order", i, m, longest=list(order))
+            continue
+        # wr: the last element whose writer is a committed node other
+        # than the reader itself (read-your-own-write is not an
+        # external observation; the one before it is)
+        for v in reversed(s):
+            w = writer_of.get((k, v))
+            if w is not None and w != i:
+                edges.add("wr", w, i)
+                break
+        # rw: lists grow monotonically, so ANY committed append not in
+        # the observed state was installed after it — the next observed
+        # version plus every unobserved committed append (sound: the
+        # emitted edge stands for rw + a ww-path)
+        seen2 = set(s)
+        for (k2, t), vs in appends_by_txn.items():
+            if k2 == k and t != i and not seen2.issuperset(vs):
+                edges.add("rw", i, t)
+
+    # ww: consecutive committed writers along each key's version
+    # order, then order-tail -> unobserved appends (same monotonicity
+    # argument: absent from the longest observed state => later)
+    by_key_appends: dict = {}
+    for (k, t), vs in appends_by_txn.items():
+        by_key_appends.setdefault(k, []).append((t, vs))
+    for k, order in orders.items():
+        prev = None
+        for v in order:
+            w = writer_of.get((k, v))
+            if w is None:
+                continue
+            if prev is not None and prev != w:
+                edges.add("ww", prev, w)
+            prev = w
+        if prev is not None:
+            observed = set(order)
+            for t, vs in by_key_appends.get(k, ()):
+                if t != prev and not observed.issuperset(vs):
+                    edges.add("ww", prev, t)
+
+    # bounded: results.json must not scale with history size
+    meta["version-orders"] = {
+        repr(k): (list(v[:32]) + ["..."] if len(v) > 32 else list(v))
+        for k, v in sorted(orders.items(),
+                           key=lambda kv: repr(kv[0]))[:8]}
+    return direct, meta
+
+
+# ---------------------------------------------------------------------------
+# rw-register
+# ---------------------------------------------------------------------------
+
+def _infer_rw_register(txns, failed, indet, edges: _Edges):
+    direct: dict = {}
+    meta: dict = {}
+
+    def flag(name, i, m, **kw):
+        direct.setdefault(name, []).append(
+            dict({"op": txns[i][1].to_dict(), "mop": list(m)}, **kw))
+
+    writer_of: dict = {}          # (k, v) -> txn of the FINAL write of v
+    intermediate: dict = {}       # (k, v) -> txn whose non-final write v was
+    finals_by_txn: list = []      # per txn: {k: final value written}
+    for i, (_, okop) in enumerate(txns):
+        last: dict = {}
+        for m in txn_mops(okop):
+            if mop.is_write(m):
+                k = mop.key(m)
+                if k in last:
+                    intermediate[(k, last[k])] = i
+                last[k] = mop.value(m)
+        for k, v in list(last.items()):
+            if (k, v) in writer_of and writer_of[(k, v)] != i:
+                flag("duplicate-elements", i, ["w", k, v],
+                     other=txns[writer_of[(k, v)]][1].to_dict())
+                del last[k]
+                continue
+            writer_of[(k, v)] = i
+        finals_by_txn.append(last)
+
+    # clean reads + version-order evidence (write-follows-read).  A
+    # read AFTER the txn's own write to the key observes itself; only
+    # pre-write reads are external observations.
+    clean_reads: list = []        # (txn, key, value read)
+    evidence: dict = {}           # key -> {u: set of direct successors v}
+    for i, (_, okop) in enumerate(txns):
+        wrote: set = set()
+        pre_read: dict = {}
+        for m in txn_mops(okop):
+            k = mop.key(m)
+            if mop.is_write(m):
+                wrote.add(k)
+                continue
+            if not mop.is_read(m) or k in wrote:
+                continue
+            v = mop.value(m)
+            if isinstance(v, (list, dict, set)):
+                continue             # not a register observation
+            if v is not None:
+                if (k, v) in failed:
+                    flag("G1a", i, m, kind="aborted")
+                    continue
+                if (k, v) in intermediate:
+                    t = intermediate[(k, v)]
+                    if t != i:
+                        flag("G1b", i, m, writer=txns[t][1].to_dict())
+                        continue
+                if writer_of.get((k, v)) is None:
+                    if (k, v) not in indet:
+                        flag("G1a", i, m, kind="garbage")
+                    continue          # indeterminate writer: no edges
+            clean_reads.append((i, k, v))
+            pre_read.setdefault(k, v)
+        for k, v in finals_by_txn[i].items():
+            if k in pre_read:
+                evidence.setdefault(k, {}).setdefault(
+                    pre_read[k], set()).add(v)
+
+    # per-key evidence DAG sanity: a cycle means the observations are
+    # not explainable by ANY version order.  Iterative coloring — the
+    # write-follows-read chain of a counter-shaped key is as long as
+    # the history.
+    for k, succ in evidence.items():
+        color: dict = {}
+        bad = False
+        for root in list(succ):
+            if color.get(root, 0):
+                continue
+            stack = [(root, iter(succ.get(root, ())))]
+            color[root] = 1
+            while stack and not bad:
+                u, it = stack[-1]
+                v = next(it, None)
+                if v is None:
+                    color[u] = 2
+                    stack.pop()
+                elif color.get(v, 0) == 1:
+                    bad = True
+                elif color.get(v, 0) == 0:
+                    color[v] = 1
+                    stack.append((v, iter(succ.get(v, ()))))
+            if bad:
+                break
+        if bad:
+            flag("cyclic-version-order", 0, ["r", k, None], key=repr(k))
+            evidence[k] = {}
+
+    # ww + wr + rw from evidence
+    for k, succ in evidence.items():
+        for u, vs in succ.items():
+            wu = writer_of.get((k, u)) if u is not None else None
+            for v in vs:
+                wv = writer_of.get((k, v))
+                if wu is not None and wv is not None:
+                    edges.add("ww", wu, wv)
+    for i, k, v in clean_reads:
+        if v is not None:
+            w = writer_of.get((k, v))
+            if w is not None:
+                edges.add("wr", w, i)
+        for nxt in evidence.get(k, {}).get(v, ()):
+            wv = writer_of.get((k, nxt))
+            if wv is not None:
+                edges.add("rw", i, wv)
+
+    meta["evidence-keys"] = len(evidence)
+    return direct, meta
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def infer(history, workload: str = "auto") -> Inference:
+    """Infer dependency planes + direct anomalies from a history.
+    `workload`: "list-append", "rw-register", or "auto" (sniff for
+    append micro-ops)."""
+    if workload == "auto":
+        workload = detect_workload(history)
+    txns, failed, indet = collect_txns(history)
+    edges = _Edges(len(txns))
+    if workload == LIST_APPEND:
+        direct, meta = _infer_list_append(txns, failed, indet, edges)
+    elif workload == RW_REGISTER:
+        direct, meta = _infer_rw_register(txns, failed, indet, edges)
+    else:
+        raise ValueError(f"unknown elle workload {workload!r}")
+    _order_planes(txns, edges)
+    meta["txn-count"] = len(txns)
+    meta["edge-counts"] = {p: int(edges.planes[p].sum()) for p in PLANES}
+    return Inference(txns=txns, planes=edges.planes,
+                     edge_types=edges.types, direct=direct,
+                     workload=workload, meta=meta)

@@ -323,5 +323,17 @@ class BatchedLinearizableChecker(ck.Checker):
                 "failures": failures}
 
 
-def batch_checker(model, frontier_size: int = 256, mesh=None):
-    return BatchedLinearizableChecker(model, frontier_size, mesh)
+def batch_checker(model_or_checker, frontier_size: int = 256, mesh=None):
+    """The TPU-native independent checker.  Handed a *model*, every
+    per-key subhistory rides one lane of the batched WGL program
+    (BatchedLinearizableChecker).  Handed a *Checker* that knows how
+    to `check_many` (e.g. `checker.elle.Elle`), the same key-splitting
+    shell batches through that checker's own device engine instead —
+    txn isolation planes get the same one-program treatment as
+    linearizability lanes."""
+    if isinstance(model_or_checker, ck.Checker) \
+            and callable(getattr(model_or_checker, "check_many", None)):
+        from jepsen_tpu.checker.elle import BatchedElleChecker
+        return BatchedElleChecker(model_or_checker)
+    return BatchedLinearizableChecker(model_or_checker, frontier_size,
+                                      mesh)

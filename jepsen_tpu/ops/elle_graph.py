@@ -1,0 +1,281 @@
+"""Typed-cycle classification on device — Elle's DSG phase as batched
+boolean matmuls.
+
+`ops/cycle.py` proves *a* cycle exists; isolation classification needs
+to know which **edge-type combination** closes one (Adya):
+
+    G0        cycle of ww edges only
+    G1c       cycle of ww ∪ wr containing ≥ 1 wr
+    G-single  cycle containing exactly one rw (anti-dependency)
+    G2-item   cycle containing ≥ 2 rw
+
+Each history arrives as a stack of boolean adjacency planes
+(`elle.infer.PLANES`: ww, wr, rw, po, rt) and the whole batch runs as
+ONE device program (same batching discipline as `wgl_batch`): planes
+pad to 128-aligned tiles so the log-squaring matmuls land on the MXU
+at full utilisation, and `vmap` carries the history axis.
+
+The classification trick — *masked closures*: each class is decided by
+whether some defining edge (a, b) has a return path b ⇒ a through a
+restricted plane union:
+
+    G0        (a,b) ∈ ww,  b ⇒ a via ww ∪ O          (O = po/rt planes)
+    G1c       (a,b) ∈ wr,  b ⇒ a via ww ∪ wr ∪ O
+    G-single  (a,b) ∈ rw,  b ⇒ a via ww ∪ wr ∪ O     (zero further rw)
+    G2-item   (a,b) ∈ rw,  b ⇒ a via the full plane **using ≥ 1 rw**,
+              and (a,b) closes NO zero-rw return (priority: an edge
+              already explained as G-single cannot define a G2 —
+              closures count walks, and a single-rw cycle walked twice
+              would otherwise masquerade as a ≥2-rw cycle)
+
+The ≥1-rw reachability is a two-plane closure: carry (P0, P1) =
+(paths with zero rw, paths with ≥ one rw) and square the pair —
+P1 ← P1 ∨ P0·P1 ∨ P1·P0 ∨ P1·P1.  The device returns only per-class
+flags and ONE defining edge per class (argmax over the mask), so the
+D2H transfer is O(B), not O(B·n²); the host then walks one explicit
+cycle witness per anomaly over the sparse planes it already holds.
+
+`classify_host` is the independent naive oracle (numpy closures +
+BFS) used by the differential battery and as the no-device fallback
+(`engine=elle-host`).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from jepsen_tpu.elle.infer import PLANES
+
+_TILE = 128
+
+ANOMALY_CLASSES = ("G0", "G1c", "G-single", "G2-item")
+
+
+def _pad_to_tile(n: int) -> int:
+    return max(_TILE, _TILE * math.ceil(n / _TILE))
+
+
+@functools.cache
+def _kernels(n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    steps = max(1, math.ceil(math.log2(max(n_pad - 1, 2))))
+
+    def _sq(a, b):
+        # 0/1 exact in bf16 x bf16 -> f32 accumulation on the MXU
+        return (jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32) > 0.5)
+
+    def _closure(adj):
+        def body(_, r):
+            return r | _sq(r, r)
+        return jax.lax.fori_loop(0, steps, body, adj)
+
+    def _pair_closure(a, r):
+        """(reach with 0 rw, reach with ≥1 rw) over plane a ∪ r where
+        only r-edges count as rw.  P0 seeds with identity so length-0
+        prefixes/suffixes compose."""
+        eye = jnp.eye(n_pad, dtype=bool)
+
+        def body(_, c):
+            p0, p1 = c
+            n0 = p0 | _sq(p0, p0)
+            n1 = p1 | _sq(p0, p1) | _sq(p1, p0) | _sq(p1, p1)
+            return n0, n1
+
+        return jax.lax.fori_loop(0, steps, body, (a | eye, r))
+
+    def _pick(mask):
+        """(found?, a, b) for one edge of a boolean [n, n] mask."""
+        flat = jnp.argmax(mask)
+        return mask.reshape(-1)[flat], flat // n_pad, flat % n_pad
+
+    def one(planes):
+        ww, wr, rw, po, rt = (planes[i] for i in range(len(PLANES)))
+        order = po | rt
+        c_ww = _closure(ww | order)
+        c_wwr = _closure(ww | wr | order)
+        _, p1 = _pair_closure(ww | wr | order, rw)
+        # Priority masking (the "which combination first closes a
+        # cycle" rule): the pair closure counts WALKS, so a G-single
+        # cycle traversed twice would read as a ≥2-rw cycle — an rw
+        # edge that already closes with zero further rw (G-single)
+        # therefore cannot define a G2-item.
+        masks = {
+            "G0": ww & c_ww.T,
+            "G1c": wr & c_wwr.T,
+            "G-single": rw & c_wwr.T,
+            "G2-item": rw & p1.T & ~c_wwr.T,
+        }
+        flags, edges = [], []
+        for cls in ANOMALY_CLASSES:
+            found, a, b = _pick(masks[cls])
+            flags.append(found)
+            edges.append(jnp.stack([a, b]))
+        return jnp.stack(flags), jnp.stack(edges).astype(jnp.int32)
+
+    return jax.jit(jax.vmap(one))
+
+
+def _pad_stack(stacks: Sequence[np.ndarray], n_pad: int) -> np.ndarray:
+    out = np.zeros((len(stacks), len(PLANES), n_pad, n_pad), bool)
+    for i, s in enumerate(stacks):
+        n = s.shape[-1]
+        out[i, :, :n, :n] = s
+    return out
+
+
+def classify_batch(stacks: Sequence[np.ndarray],
+                   include_order: bool = True) -> list:
+    """Classify MANY histories in one device program.
+
+    stacks: one [len(PLANES), n, n] bool array per history (n may
+    differ; the batch pads to the largest 128-aligned tile).
+    include_order: include the po/rt planes in every combination
+    (strict/strong-session variants); when False they are zeroed.
+
+    Returns one dict per history:
+      {"anomalies": {cls: (a, b) defining edge}, "n": n, "n_pad": int}
+    """
+    if not stacks:
+        return []
+    import jax
+
+    ns = [s.shape[-1] for s in stacks]
+    n_pad = _pad_to_tile(max(ns))
+    batch = _pad_stack(stacks, n_pad)
+    if not include_order:
+        batch[:, 3:, :, :] = False
+    flags, edges = jax.device_get(_kernels(n_pad)(batch))
+    out = []
+    for i, n in enumerate(ns):
+        found = {cls: (int(edges[i, c, 0]), int(edges[i, c, 1]))
+                 for c, cls in enumerate(ANOMALY_CLASSES)
+                 if bool(flags[i, c])}
+        out.append({"anomalies": found, "n": n, "n_pad": n_pad})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host oracle — independent formulation (numpy closure + BFS), the
+# differential-test baseline and the no-device fallback engine.
+# ---------------------------------------------------------------------------
+
+def _mm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    # f32, not uint8: path counts overflow a byte past n=255 and can
+    # wrap to exactly 0, silently erasing reachability
+    return a.astype(np.float32) @ b.astype(np.float32) > 0
+
+
+def _host_closure(adj: np.ndarray) -> np.ndarray:
+    n = adj.shape[0]
+    r = adj.copy()
+    for _ in range(max(1, math.ceil(math.log2(max(n - 1, 2))))):
+        r = r | _mm(r, r)
+    return r
+
+
+def classify_host(stack: np.ndarray, include_order: bool = True) -> dict:
+    """Naive host classification of ONE history's plane stack —
+    same output row shape as classify_batch."""
+    ww, wr, rw, po, rt = (stack[i] for i in range(len(PLANES)))
+    n = ww.shape[-1]
+    if n == 0:
+        return {"anomalies": {}, "n": 0, "n_pad": 0}
+    order = (po | rt) if include_order else np.zeros_like(ww)
+    c_ww = _host_closure(ww | order)
+    c_wwr = _host_closure(ww | wr | order)
+    # ≥1-rw reachability via the same pair recurrence
+    p0 = (ww | wr | order) | np.eye(n, dtype=bool)
+    p1 = rw.copy()
+    for _ in range(max(1, math.ceil(math.log2(max(n - 1, 2))))):
+        n0 = p0 | _mm(p0, p0)
+        n1 = p1 | _mm(p0, p1) | _mm(p1, p0) | _mm(p1, p1)
+        p0, p1 = n0, n1
+    masks = {"G0": ww & c_ww.T, "G1c": wr & c_wwr.T,
+             "G-single": rw & c_wwr.T,
+             "G2-item": rw & p1.T & ~c_wwr.T}
+    found = {}
+    for cls, m in masks.items():
+        if m.any():
+            a, b = np.unravel_index(int(np.argmax(m)), m.shape)
+            found[cls] = (int(a), int(b))
+    return {"anomalies": found, "n": n, "n_pad": n}
+
+
+# ---------------------------------------------------------------------------
+# Witness recovery — host walk, O(cycle) after the device proved it
+# ---------------------------------------------------------------------------
+
+def _bfs_path(adj: np.ndarray, src: int, dst: int) -> Optional[list]:
+    """Shortest path src -> dst (length ≥ 1) over a boolean adjacency
+    matrix, or None."""
+    parent = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in map(int, np.nonzero(adj[u])[0]):
+                if v == dst:
+                    path = [v, u]
+                    while parent[path[-1]] is not None:
+                        path.append(parent[path[-1]])
+                    path.reverse()
+                    return path
+                if v not in parent:
+                    parent[v] = u
+                    nxt.append(v)
+        frontier = nxt
+    return None
+
+
+def _bfs_path_with_rw(base: np.ndarray, rw: np.ndarray,
+                      src: int, dst: int) -> Optional[list]:
+    """Path src -> dst over base ∪ rw that uses ≥ 1 rw edge: BFS over
+    the (node, seen-rw) product graph."""
+    full = base | rw
+    start = (src, False)
+    parent: dict = {start: None}
+    frontier = [start]
+    while frontier:
+        nxt = []
+        for u, seen in frontier:
+            for v in map(int, np.nonzero(full[u])[0]):
+                s2 = seen or bool(rw[u, v])
+                if v == dst and s2:
+                    path = [(v, s2), (u, seen)]
+                    while parent[path[-1]] is not None:
+                        path.append(parent[path[-1]])
+                    path.reverse()
+                    return [p for p, _ in path]
+                if (v, s2) not in parent:
+                    parent[(v, s2)] = (u, seen)
+                    nxt.append((v, s2))
+        frontier = nxt
+    return None
+
+
+def find_witness(stack: np.ndarray, cls: str, edge,
+                 include_order: bool = True) -> Optional[list]:
+    """One explicit cycle [a, b, ..., a] for a device-found anomaly:
+    the defining edge (a, b) plus the restricted return path b ⇒ a.
+    G-single's return path must avoid rw; G2-item's must include one."""
+    ww, wr, rw, po, rt = (stack[i] for i in range(len(PLANES)))
+    order = (po | rt) if include_order else np.zeros_like(ww)
+    a, b = int(edge[0]), int(edge[1])
+    if cls == "G0":
+        back = _bfs_path(ww | order, b, a)
+    elif cls in ("G1c", "G-single"):
+        back = _bfs_path(ww | wr | order, b, a)
+    elif cls == "G2-item":
+        back = _bfs_path_with_rw(ww | wr | order, rw, b, a)
+    else:
+        raise ValueError(f"unknown anomaly class {cls!r}")
+    if back is None:
+        return None
+    return [a] + back

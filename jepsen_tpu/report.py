@@ -34,3 +34,63 @@ def write(test, filename: str, text: str, echo: bool = False) -> str:
     with to(test, filename, echo=echo) as out:
         out.write(text)
     return str(store.path(test, filename))
+
+
+# ---------------------------------------------------------------------------
+# Transactional anomaly section (checker/elle.py verdicts)
+# ---------------------------------------------------------------------------
+
+def _fmt_op(d: dict) -> str:
+    return (f"{d.get('process')}\t{d.get('type')}\t{d.get('f')}\t"
+            f"{d.get('value')}")
+
+
+def elle_section(result: dict) -> str:
+    """Human-readable anomaly section for one elle verdict: the
+    isolation damage first, then one explicit witness per anomaly."""
+    lines = ["Transactional isolation (elle)",
+             "=" * 30, ""]
+    lines.append(f"txns analyzed:   {result.get('txn-count', 0)}"
+                 f"  (workload {result.get('workload', '?')},"
+                 f" engine {result.get('engine', '?')})")
+    kinds = result.get("anomaly-types") or []
+    if not kinds:
+        lines += ["", "No anomalies detected.",
+                  "Consistent with: serializable."]
+        return "\n".join(lines) + "\n"
+    lines.append(f"anomalies found: {', '.join(kinds)}")
+    weakest = result.get("weakest-violated")
+    if weakest:
+        lines.append(f"weakest violated isolation level: {weakest}")
+        lines.append("ruled out: " + ", ".join(result.get("not", [])))
+    anomalies = result.get("anomalies") or {}
+    for kind in kinds:
+        lines += ["", f"-- {kind} " + "-" * max(1, 40 - len(kind))]
+        for w in anomalies.get(kind, [])[:4]:
+            if "cycle" in w:
+                edges = w.get("edges", [])
+                for i, opd in enumerate(w["cycle"]):
+                    lines.append("  " + _fmt_op(opd))
+                    if i < len(edges):
+                        lines.append(f"    --{edges[i]}-->")
+            elif "op" in w:
+                lines.append("  " + _fmt_op(w["op"])
+                             + f"   mop {w.get('mop')}")
+                if w.get("kind"):
+                    lines.append(f"    ({w['kind']})")
+            else:
+                lines.append(f"  {w}")
+        extra = len(anomalies.get(kind, [])) - 4
+        if extra > 0:
+            lines.append(f"  ... {extra} more {kind} witness(es)")
+    return "\n".join(lines) + "\n"
+
+
+def write_elle(test, result: dict, opts=None) -> str:
+    """Render the anomaly section under the test's store dir (and the
+    per-key subdirectory when the independent checker provides one)."""
+    subdir = list((opts or {}).get("subdirectory") or [])
+    path = store.make_path(test, *subdir, "elle.txt")
+    with open(path, "w") as f:
+        f.write(elle_section(result))
+    return str(path)

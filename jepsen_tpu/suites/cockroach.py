@@ -45,6 +45,8 @@ from jepsen_tpu.checker import timeline
 from jepsen_tpu.control import lit
 from jepsen_tpu.history import History
 from jepsen_tpu.workloads import adya as adya_wl
+from jepsen_tpu.workloads import list_append as list_append_wl
+from jepsen_tpu.workloads import rw_register as rw_register_wl
 from jepsen_tpu.workloads import bank as bank_wl
 from jepsen_tpu.workloads import linearizable_register as linreg_wl
 from jepsen_tpu.workloads import monotonic as monotonic_wl
@@ -952,6 +954,76 @@ class G2Client(SQLClient):
         return op.assoc(type="ok")
 
 
+class ElleListAppendClient(SQLClient):
+    """Elle list-append txns over SQL: one micro-op per statement, the
+    whole txn in ONE conn.txn so the SUT's isolation — not the client —
+    decides what interleaves.  Lists live as comma-joined text; reads
+    are scalar subqueries so every read mop yields exactly one row and
+    results align with mops by position."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS elle_la "
+           "(k INT PRIMARY KEY, val TEXT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "elle_la")
+        txn = list(op.value or [])
+        stmts = []
+        for f, k, v in txn:
+            if f == "append":
+                stmts.append(
+                    f"INSERT INTO elle_la (k, val) VALUES ({k}, '{v}') "
+                    f"ON CONFLICT (k) DO UPDATE SET val = "
+                    f"val || ',{v}'")
+            else:
+                stmts.append(f"SELECT {k}, (SELECT val FROM elle_la "
+                             f"WHERE k = {k})")
+        rows = with_txn_retry(lambda: self.conn.txn(stmts))
+        reads = iter(rows)
+        out = []
+        for f, k, v in txn:
+            if f != "r":
+                out.append([f, k, v])
+                continue
+            row = next(reads, None)
+            val = row[1] if row is not None and len(row) > 1 else None
+            if val in (None, ""):
+                out.append([f, k, None])
+            else:
+                out.append([f, k, [int(x) for x in
+                                   str(val).split(",") if x != ""]])
+        return op.assoc(type="ok", value=out)
+
+
+class ElleRwRegisterClient(SQLClient):
+    """Elle rw-register txns over SQL (same one-txn discipline)."""
+
+    DDL = "CREATE TABLE IF NOT EXISTS elle_rw (k INT PRIMARY KEY, v INT)"
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "elle_rw")
+        txn = list(op.value or [])
+        stmts = []
+        for f, k, v in txn:
+            if f == "w":
+                stmts.append(
+                    f"INSERT INTO elle_rw (k, v) VALUES ({k}, {v}) "
+                    f"ON CONFLICT (k) DO UPDATE SET v = {v}")
+            else:
+                stmts.append(f"SELECT {k}, (SELECT v FROM elle_rw "
+                             f"WHERE k = {k})")
+        rows = with_txn_retry(lambda: self.conn.txn(stmts))
+        reads = iter(rows)
+        out = []
+        for f, k, v in txn:
+            if f != "r":
+                out.append([f, k, v])
+                continue
+            row = next(reads, None)
+            val = row[1] if row is not None and len(row) > 1 else None
+            out.append([f, k, int(val) if val is not None else None])
+        return op.assoc(type="ok", value=out)
+
+
 # ---------------------------------------------------------------------------
 # Comments checker (comments.clj checker)
 # ---------------------------------------------------------------------------
@@ -1154,6 +1226,35 @@ def g2_test(opts) -> dict:
     return test
 
 
+def list_append_test(opts) -> dict:
+    """Elle list-append: the transactional-isolation hunt
+    (checker/elle.py) — every anomaly class from G0 to G2-item, with
+    the typed-cycle search batched on device."""
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = list_append_wl.workload(opts)
+    test = base_test(opts, nm, "list-append")
+    test["client"] = ElleListAppendClient()
+    test["checker"] = ck.compose({"elle": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 20, wl["generator"]), nm)
+    return test
+
+
+def rw_register_test(opts) -> dict:
+    """Elle rw-register: isolation anomalies inferred from
+    register traces (version orders recovered from evidence)."""
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = rw_register_wl.workload(opts)
+    test = base_test(opts, nm, "rw-register")
+    test["client"] = ElleRwRegisterClient()
+    test["checker"] = ck.compose({"elle": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 20, wl["generator"]), nm)
+    return test
+
+
 tests = {
     "bank": bank_test,
     "bank-multitable": multitable_bank_test,
@@ -1163,6 +1264,8 @@ tests = {
     "sets": sets_test,
     "sequential": sequential_test,
     "g2": g2_test,
+    "list-append": list_append_test,
+    "rw-register": rw_register_test,
 }
 
 

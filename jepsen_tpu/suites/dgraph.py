@@ -45,6 +45,7 @@ from jepsen_tpu.suites.cockroach import _rounded_concurrency
 from jepsen_tpu.workloads import (bank as bank_wl,
                                   linearizable_register as linreg_wl,
                                   long_fork as long_fork_wl,
+                                  rw_register as rw_register_wl,
                                   sequential as sequential_wl,
                                   sets as sets_wl,
                                   upsert as upsert_wl)
@@ -722,6 +723,22 @@ class LongForkClient(DgraphClient):
         raise ValueError(f"unknown f {op.f!r}")
 
 
+class ElleRwRegisterClient(DgraphClient):
+    """Elle rw-register txns over the KV surface: each micro-op one
+    conn call.  Dgraph promises the whole txn atomic server-side; when
+    it is not, the elle checker's inferred planes say so."""
+
+    def _invoke(self, test, op):
+        out = []
+        for f, k, v in (op.value or []):
+            if f == "w":
+                self.conn.set_kv(f"elle-{k}", v)
+                out.append([f, k, v])
+            else:
+                out.append([f, k, self.conn.get(f"elle-{k}")])
+        return op.assoc(type="ok", value=out)
+
+
 _tag_lock = threading.Lock()
 
 
@@ -982,10 +999,19 @@ def _long_fork(opts, test) -> dict:
                                    "perf": ck.perf()})}
 
 
+def _rw_register(opts, test) -> dict:
+    wl = rw_register_wl.workload(opts)
+    return {"client": ElleRwRegisterClient(),
+            "generator": wl["generator"],
+            "checker": ck.compose({"elle": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
 workloads = {
     "bank": _bank,
     "delete": _delete,
     "long-fork": _long_fork,
+    "rw-register": _rw_register,
     "linearizable-register": _register,
     "uid-linearizable-register": _uid_register,
     "upsert": _upsert,

@@ -42,6 +42,7 @@ from jepsen_tpu.suites.cockroach import (BankClient, RegisterClient,
                                          _rounded_concurrency)
 from jepsen_tpu.workloads import (bank as bank_wl, counter as counter_wl,
                                   linearizable_register as linreg_wl,
+                                  list_append as list_append_wl,
                                   long_fork as long_fork_wl,
                                   multi_key_acid as mka_wl,
                                   sets as sets_wl)
@@ -207,6 +208,45 @@ class LongForkClient(SQLClient):
             filled = [["r", k, got.get(k)] for k in ks]
             return op.assoc(type="ok", value=filled)
         raise ValueError(f"unknown f {op.f!r}")
+
+
+class ElleListAppendClient(SQLClient):
+    """Elle list-append txns (yugabyte speaks postgres SQL): lists as
+    comma-joined text, one micro-op per statement, whole txn atomic in
+    one conn.txn; scalar-subquery reads align rows with mops by
+    position."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS elle_la "
+           "(k INT PRIMARY KEY, val TEXT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "elle_la")
+        txn = list(op.value or [])
+        stmts = []
+        for f, k, v in txn:
+            if f == "append":
+                stmts.append(
+                    f"INSERT INTO elle_la (k, val) VALUES ({k}, '{v}') "
+                    f"ON CONFLICT (k) DO UPDATE SET val = "
+                    f"val || ',{v}'")
+            else:
+                stmts.append(f"SELECT {k}, (SELECT val FROM elle_la "
+                             f"WHERE k = {k})")
+        rows = with_txn_retry(lambda: self.conn.txn(stmts))
+        reads = iter(rows)
+        out = []
+        for f, k, v in txn:
+            if f != "r":
+                out.append([f, k, v])
+                continue
+            row = next(reads, None)
+            val = row[1] if row is not None and len(row) > 1 else None
+            if val in (None, ""):
+                out.append([f, k, None])
+            else:
+                out.append([f, k, [int(x) for x in
+                                   str(val).split(",") if x != ""]])
+        return op.assoc(type="ok", value=out)
 
 
 class MultiKeyAcidClient(SQLClient):
@@ -479,9 +519,19 @@ def _single_key_acid(opts, test) -> dict:
                                    "perf": ck.perf()})}
 
 
+def _list_append(opts, test) -> dict:
+    wl = list_append_wl.workload(opts)
+    return {"client": ElleListAppendClient(),
+            "generator": wl["generator"],
+            "final-generator": None,
+            "checker": ck.compose({"elle": wl["checker"],
+                                   "perf": ck.perf()})}
+
+
 workloads = {
     "bank": _bank,
     "counter": _counter,
+    "list-append": _list_append,
     "long-fork": _long_fork,
     "multi-key-acid": _multi_key_acid,
     "set": _set,

@@ -1,8 +1,11 @@
 """Micro-operation helpers for transactional workloads
 (reference: `txn/src/jepsen/txn/micro_op.clj`).
 
-A micro-op is a 3-element sequence [f, k, v] with f in {"r", "w"}; a
-transaction is a list of micro-ops carried in an op's value.
+A micro-op is a 3-element sequence [f, k, v] with f in {"r", "w",
+"append"}; a transaction is a list of micro-ops carried in an op's
+value.  "append" is the list-append workload's write (Elle §4: append
+a unique element to the list at key k; reads observe the whole list,
+which is what makes version orders recoverable from observations).
 """
 
 from __future__ import annotations
@@ -28,6 +31,10 @@ def is_write(mop) -> bool:
     return f(mop) in ("w", "write")
 
 
+def is_append(mop) -> bool:
+    return f(mop) == "append"
+
+
 def is_op(mop) -> bool:
     return (isinstance(mop, (list, tuple)) and len(mop) == 3
-            and f(mop) in ("r", "w", "read", "write"))
+            and f(mop) in ("r", "w", "read", "write", "append"))

@@ -7,8 +7,10 @@ the store (web.clj app :328), zip export of a whole test run
 `/telemetry` lists runs with a telemetry.jsonl, `/telemetry/<name>/<ts>`
 renders op-rate and p95-latency sparklines with nemesis fault windows
 shaded and the `cli metrics` summary inline, and `/metrics` is the
-process-global Prometheus text exposition for scraping.  Built on
-http.server so it runs anywhere the framework does.
+process-global Prometheus text exposition for scraping.
+`/elle/<name>/<ts>` renders the transactional anomaly section (ISSUE
+5): per-checker isolation verdicts plus the elle.txt report inline.
+Built on http.server so it runs anywhere the framework does.
 """
 
 from __future__ import annotations
@@ -88,6 +90,13 @@ def home_html() -> bytes:
     rows = []
     for name, ts, valid in _test_rows():
         base = f"/files/{quote(name)}/{quote(ts)}"
+        # anomaly-section link only for runs an elle checker rendered
+        # (a cheap existence probe, like the telemetry index)
+        elle = ""
+        if (store.BASE / store._sanitize(name) / ts
+                / "elle.txt").exists():
+            elle = (f"<a href='/elle/{quote(name)}/{quote(ts)}'>"
+                    "anomalies</a>")
         rows.append(
             f"<tr style='background:{_color(valid)}'>"
             f"<td>{html.escape(name)}</td>"
@@ -95,12 +104,14 @@ def home_html() -> bytes:
             f"<td>{html.escape(json.dumps(valid))}</td>"
             f"<td><a href='{base}/results.json'>results</a></td>"
             f"<td><a href='{base}/history.txt'>history</a></td>"
+            f"<td>{elle}</td>"
             f"<td><a href='/zip/{quote(name)}/{quote(ts)}'>zip</a></td>"
             "</tr>")
     body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
-            "<th>Valid?</th><th>Results</th><th>History</th><th>Zip</th>"
+            "<th>Valid?</th><th>Results</th><th>History</th>"
+            "<th>Anomalies</th><th>Zip</th>"
             "</tr>" + "".join(rows) + "</table>")
     return _page("Jepsen", body)
 
@@ -188,6 +199,59 @@ def telemetry_index_html() -> bytes:
     return _page("Telemetry", body)
 
 
+def _find_elle_results(tree, path="results") -> list:
+    """Recursively collect elle verdicts (dicts carrying
+    anomaly-types + txn-count) out of a results tree."""
+    out = []
+    if isinstance(tree, dict):
+        if "anomaly-types" in tree and "txn-count" in tree:
+            out.append((path, tree))
+        else:
+            for k, v in tree.items():
+                out.extend(_find_elle_results(v, f"{path}/{k}"))
+    return out
+
+
+def elle_html(name: str, ts: str) -> bytes:
+    """Transactional anomaly section for one run: per-checker verdict
+    rows (weakest violated isolation level, anomaly types, engine)
+    plus the rendered elle.txt report inline."""
+    body = [f"<h1>{html.escape(name)} / {html.escape(ts)} "
+            "&mdash; transactional isolation</h1>",
+            "<p><a href='/'>&larr; tests</a></p>"]
+    res = store.load_results(name, ts)
+    rows = _find_elle_results(res) if res else []
+    if rows:
+        cells = []
+        for path, r in rows:
+            kinds = r.get("anomaly-types") or []
+            color = _color(r.get("valid?"))
+            cells.append(
+                f"<tr style='background:{color}'>"
+                f"<td>{html.escape(path)}</td>"
+                f"<td>{html.escape(json.dumps(r.get('valid?')))}</td>"
+                f"<td>{r.get('txn-count')}</td>"
+                f"<td>{html.escape(', '.join(kinds) or '-')}</td>"
+                f"<td>{html.escape(r.get('weakest-violated') or '-')}"
+                "</td>"
+                f"<td>{html.escape(r.get('engine') or '?')}</td></tr>")
+        body.append("<table><tr><th>Checker</th><th>Valid?</th>"
+                    "<th>Txns</th><th>Anomalies</th>"
+                    "<th>Weakest violated</th><th>Engine</th></tr>"
+                    + "".join(cells) + "</table>")
+    else:
+        body.append("<p>(no transactional isolation verdicts in "
+                    "results.json)</p>")
+    try:
+        p = _safe_path(f"{name}/{ts}") / "elle.txt"
+        if p.exists():
+            body.append("<h2>Anomaly report</h2><pre>"
+                        + html.escape(p.read_text()) + "</pre>")
+    except (OSError, PermissionError):
+        pass
+    return _page(f"elle {name}/{ts}", "".join(body))
+
+
 def telemetry_run_html(name: str, ts: str) -> bytes:
     from jepsen_tpu import telemetry
     p = _safe_path(f"{name}/{ts}") / "telemetry.jsonl"
@@ -259,6 +323,12 @@ class Handler(BaseHTTPRequestHandler):
                          path[len("/telemetry/"):].strip("/").split("/")]
                 if len(parts) == 2:
                     return self._send(200, telemetry_run_html(*parts))
+                return self._send(404, b"not found", "text/plain")
+            if path.startswith("/elle/"):
+                parts = [unquote(x) for x in
+                         path[len("/elle/"):].strip("/").split("/")]
+                if len(parts) == 2:
+                    return self._send(200, elle_html(*parts))
                 return self._send(404, b"not found", "text/plain")
             if path.startswith("/files/"):
                 rel = unquote(path[len("/files/"):])

@@ -8,16 +8,18 @@ test map, mirroring how per-DB suites compose workloads
 
 from jepsen_tpu.workloads import (adya, bank, causal,  # noqa: F401
                                   counter, dirty_read, dirty_reads,
-                                  linearizable_register, long_fork,
-                                  monotonic, multi_key_acid, queue,
-                                  sequential, sets, single_key_acid,
-                                  upsert)
+                                  linearizable_register, list_append,
+                                  long_fork, monotonic, multi_key_acid,
+                                  queue, rw_register, sequential, sets,
+                                  single_key_acid, upsert)
 
 WORKLOADS = {
     "bank": bank.workload,
     "linearizable-register": linearizable_register.workload,
     "long-fork": long_fork.workload,
     "adya-g2": adya.workload,
+    "list-append": list_append.workload,
+    "rw-register": rw_register.workload,
     "causal": causal.workload,
     "monotonic": monotonic.workload,
     "sets": sets.workload,

@@ -1,0 +1,75 @@
+"""Elle list-append workload (Elle §4; `elle.list-append` in the
+reference ecosystem): transactions of `["append", k, v]` /
+`["r", k, nil]` micro-ops over keys holding lists.
+
+Append is the observability sweet spot: a read returns the WHOLE
+list, so one observation recovers the key's full version order —
+exactly what `jepsen_tpu.elle.infer` needs to emit ww/wr/rw planes
+with no guessing.  Values are unique per key (a global per-key
+counter), making every history recoverable.
+
+Keys rotate: each key accepts a bounded number of appends and then
+retires, so lists stay short and fresh keys keep the version-order
+inference dense late in the run.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import elle as elle_ck
+
+
+class ListAppendGenerator(gen.Generator):
+    def __init__(self, key_count: int = 3, min_len: int = 1,
+                 max_len: int = 4, max_writes_per_key: int = 32,
+                 read_ratio: float = 0.5):
+        self.lock = threading.Lock()
+        self.key_count = key_count
+        self.min_len = min_len
+        self.max_len = max_len
+        self.max_writes = max_writes_per_key
+        self.read_ratio = read_ratio
+        self.next_key = key_count
+        self.active = list(range(key_count))
+        self.counters = {k: 0 for k in self.active}
+
+    def _mop(self):
+        k = random.choice(self.active)
+        if random.random() < self.read_ratio:
+            return ["r", k, None]
+        with self.lock:
+            self.counters[k] = self.counters.get(k, 0) + 1
+            v = self.counters[k]
+            if v >= self.max_writes and k in self.active:
+                i = self.active.index(k)
+                self.active[i] = self.next_key
+                self.counters[self.next_key] = 0
+                self.next_key += 1
+        return ["append", k, v]
+
+    def op(self, test, process):
+        n = random.randint(self.min_len, self.max_len)
+        return {"type": "invoke", "f": "txn",
+                "value": [self._mop() for _ in range(n)]}
+
+
+def generator(opts=None) -> gen.Generator:
+    o = opts or {}
+    return ListAppendGenerator(
+        key_count=o.get("key-count", 3),
+        min_len=o.get("min-txn-length", 1),
+        max_len=o.get("max-txn-length", 4),
+        max_writes_per_key=o.get("max-writes-per-key", 32),
+        read_ratio=o.get("read-ratio", 0.5))
+
+
+def workload(opts=None) -> dict:
+    o = dict(opts or {})
+    return {"generator": generator(o),
+            "checker": elle_ck.checker(
+                workload="list-append",
+                include_order=o.get("include-order", True),
+                anomalies=o.get("anomalies"))}

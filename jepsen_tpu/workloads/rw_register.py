@@ -1,0 +1,70 @@
+"""Elle rw-register workload (`elle.rw-register`): transactions of
+`["w", k, v]` / `["r", k, nil]` micro-ops over single-value
+registers, every written value unique per key.
+
+Registers observe only their latest value, so version orders must be
+*inferred from evidence* (`jepsen_tpu.elle.infer`): the initial nil
+precedes everything, and a transaction that reads u before writing v
+proves u ≺ v.  The generator therefore biases hard toward
+read-modify-write transactions — each write preceded by a read of the
+same key in the same txn — which is what keeps the evidence chains
+long enough to catch cycles.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.checker import elle as elle_ck
+
+
+class RwRegisterGenerator(gen.Generator):
+    def __init__(self, key_count: int = 3, min_len: int = 1,
+                 max_len: int = 4, rmw_ratio: float = 0.7):
+        self.lock = threading.Lock()
+        self.key_count = key_count
+        self.min_len = min_len
+        self.max_len = max_len
+        self.rmw_ratio = rmw_ratio
+        self.counter = 0
+
+    def _next(self) -> int:
+        with self.lock:
+            self.counter += 1
+            return self.counter
+
+    def op(self, test, process):
+        mops = []
+        budget = random.randint(self.min_len, self.max_len)
+        while len(mops) < budget:
+            k = random.randrange(self.key_count)
+            r = random.random()
+            if r < self.rmw_ratio and len(mops) + 2 <= budget + 1:
+                # read-modify-write: the version-order evidence pair
+                mops.append(["r", k, None])
+                mops.append(["w", k, self._next()])
+            elif r < 0.85:
+                mops.append(["r", k, None])
+            else:
+                mops.append(["w", k, self._next()])
+        return {"type": "invoke", "f": "txn", "value": mops}
+
+
+def generator(opts=None) -> gen.Generator:
+    o = opts or {}
+    return RwRegisterGenerator(
+        key_count=o.get("key-count", 3),
+        min_len=o.get("min-txn-length", 1),
+        max_len=o.get("max-txn-length", 4),
+        rmw_ratio=o.get("rmw-ratio", 0.7))
+
+
+def workload(opts=None) -> dict:
+    o = dict(opts or {})
+    return {"generator": generator(o),
+            "checker": elle_ck.checker(
+                workload="rw-register",
+                include_order=o.get("include-order", True),
+                anomalies=o.get("anomalies"))}

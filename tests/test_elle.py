@@ -1,0 +1,597 @@
+"""Elle subsystem battery: planted-anomaly classification (one
+generated history per Adya class, asserting EXACTLY that class plus an
+explicit cycle witness), clean-history no-false-positive checks, a
+randomized differential sweep of the device planes kernel against the
+naive host oracle (the test_fuzz_differential pattern), checker/
+runner/batching integration, and the cockroach list-append suite end
+to end over the in-memory SQL backend."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu import independent
+from jepsen_tpu.checker import elle as elle_ck
+from jepsen_tpu.elle import infer as elle_infer
+from jepsen_tpu.history import (History, fail_op, info_op, invoke_op,
+                                ok_op)
+from jepsen_tpu.ops import elle_graph
+
+CYCLE_CLASSES = set(elle_graph.ANOMALY_CLASSES)
+
+
+def hist(ops) -> History:
+    return History(ops).index()
+
+
+def check(h, **kw):
+    kw.setdefault("include_order", False)
+    return elle_ck.Elle(**kw).check({}, h)
+
+
+# ---------------------------------------------------------------------------
+# Planted histories, one per anomaly class
+# ---------------------------------------------------------------------------
+
+def h_g0():
+    """ww-only cycle: two appenders, two keys, opposite version
+    orders."""
+    return hist([
+        invoke_op(0, "txn", [["append", "x", 1], ["append", "y", 1]]),
+        ok_op(0, "txn", [["append", "x", 1], ["append", "y", 1]]),
+        invoke_op(1, "txn", [["append", "x", 2], ["append", "y", 2]]),
+        ok_op(1, "txn", [["append", "x", 2], ["append", "y", 2]]),
+        invoke_op(2, "txn", [["r", "x", None], ["r", "y", None]]),
+        ok_op(2, "txn", [["r", "x", [1, 2]], ["r", "y", [2, 1]]]),
+    ])
+
+
+def h_g1a():
+    """Read of an element appended by a FAILED txn."""
+    return hist([
+        invoke_op(0, "txn", [["append", "x", 9]]),
+        fail_op(0, "txn", [["append", "x", 9]]),
+        invoke_op(1, "txn", [["r", "x", None]]),
+        ok_op(1, "txn", [["r", "x", [9]]]),
+    ])
+
+
+def h_g1b():
+    """Read exposing a txn's intermediate append without its final."""
+    return hist([
+        invoke_op(0, "txn", [["append", "x", 1], ["append", "x", 2]]),
+        ok_op(0, "txn", [["append", "x", 1], ["append", "x", 2]]),
+        invoke_op(1, "txn", [["r", "x", None]]),
+        ok_op(1, "txn", [["r", "x", [1]]]),
+    ])
+
+
+def h_g1c():
+    """wr + ww cycle, no rw: T1 observes T0's x-append, T0's y-append
+    lands after T1's in y's version order."""
+    return hist([
+        invoke_op(0, "txn", [["append", "x", 1], ["append", "y", 2]]),
+        invoke_op(1, "txn", [["r", "x", None], ["append", "y", 1]]),
+        ok_op(1, "txn", [["r", "x", [1]], ["append", "y", 1]]),
+        ok_op(0, "txn", [["append", "x", 1], ["append", "y", 2]]),
+        invoke_op(2, "txn", [["r", "y", None]]),
+        ok_op(2, "txn", [["r", "y", [1, 2]]]),
+    ])
+
+
+def h_gsingle():
+    """Read skew: T0 sees T1's y-append but misses its x-append."""
+    return hist([
+        invoke_op(0, "txn", [["r", "y", None], ["r", "x", None]]),
+        invoke_op(1, "txn", [["append", "x", 1], ["append", "y", 1]]),
+        ok_op(1, "txn", [["append", "x", 1], ["append", "y", 1]]),
+        ok_op(0, "txn", [["r", "y", [1]], ["r", "x", []]]),
+    ])
+
+
+def h_g2():
+    """Write skew: both txns read the other's key empty, then append."""
+    return hist([
+        invoke_op(0, "txn", [["r", "x", None], ["append", "y", 1]]),
+        invoke_op(1, "txn", [["r", "y", None], ["append", "x", 1]]),
+        ok_op(0, "txn", [["r", "x", []], ["append", "y", 1]]),
+        ok_op(1, "txn", [["r", "y", []], ["append", "x", 1]]),
+        invoke_op(2, "txn", [["r", "x", None], ["r", "y", None]]),
+        ok_op(2, "txn", [["r", "x", [1]], ["r", "y", [1]]]),
+    ])
+
+
+def h_clean():
+    """Strictly sequential append/read chain: serializable."""
+    return hist([
+        invoke_op(0, "txn", [["append", "x", 1]]),
+        ok_op(0, "txn", [["append", "x", 1]]),
+        invoke_op(1, "txn", [["r", "x", None], ["append", "x", 2]]),
+        ok_op(1, "txn", [["r", "x", [1]], ["append", "x", 2]]),
+        invoke_op(2, "txn", [["r", "x", None], ["append", "y", 10]]),
+        ok_op(2, "txn", [["r", "x", [1, 2]], ["append", "y", 10]]),
+        invoke_op(0, "txn", [["r", "y", None]]),
+        ok_op(0, "txn", [["r", "y", [10]]]),
+    ])
+
+
+def h_rw_gsingle():
+    """rw-register read skew, version order pinned by
+    write-follows-read evidence."""
+    return hist([
+        invoke_op(0, "txn", [["r", "y", None], ["r", "x", None]]),
+        invoke_op(1, "txn", [["r", "x", None], ["r", "y", None],
+                             ["w", "x", 10], ["w", "y", 11]]),
+        ok_op(1, "txn", [["r", "x", None], ["r", "y", None],
+                         ["w", "x", 10], ["w", "y", 11]]),
+        ok_op(0, "txn", [["r", "y", 11], ["r", "x", None]]),
+    ])
+
+
+def h_rw_clean():
+    """rw-register sequential RMW chain: serializable."""
+    return hist([
+        invoke_op(0, "txn", [["r", "x", None], ["w", "x", 1]]),
+        ok_op(0, "txn", [["r", "x", None], ["w", "x", 1]]),
+        invoke_op(1, "txn", [["r", "x", None], ["w", "x", 2]]),
+        ok_op(1, "txn", [["r", "x", 1], ["w", "x", 2]]),
+        invoke_op(2, "txn", [["r", "x", None]]),
+        ok_op(2, "txn", [["r", "x", 2]]),
+    ])
+
+
+def _assert_cycle_witness(v, cls, rw_exact=None, rw_min=None,
+                          forbid=()):
+    ws = v["anomalies"][cls]
+    assert ws, f"no witness recorded for {cls}"
+    w = ws[0]
+    steps, edges = w["steps"], w["edges"]
+    assert steps[0] == steps[-1], steps
+    assert len(steps) >= 3, steps                 # a real cycle, a != b
+    assert len(edges) == len(steps) - 1
+    n_rw = sum(1 for e in edges if e == "rw")
+    if rw_exact is not None:
+        assert n_rw == rw_exact, (edges, steps)
+    if rw_min is not None:
+        assert n_rw >= rw_min, (edges, steps)
+    for e in forbid:
+        assert e not in edges, (edges, steps)
+    # every hop must exist in SOME plane of the inference
+    assert all(e in ("ww", "wr", "rw", "po", "rt") for e in edges)
+
+
+class TestPlantedAnomalies:
+    """One history per Adya class; the verdict must name EXACTLY that
+    class, with an explicit witness."""
+
+    def test_g0(self):
+        v = check(h_g0())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G0"]
+        _assert_cycle_witness(v, "G0", rw_exact=0, forbid=("wr", "rw"))
+        assert v["weakest-violated"] == "read-uncommitted"
+        assert v["not"] == list(elle_ck.ISOLATION_LEVELS)
+
+    def test_g1a(self):
+        v = check(h_g1a())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G1a"]
+        w = v["anomalies"]["G1a"][0]
+        assert w["mop"] == ["r", "x", [9]]
+        assert w["kind"] == "aborted"
+        assert v["weakest-violated"] == "read-committed"
+
+    def test_g1b(self):
+        v = check(h_g1b())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G1b"]
+        w = v["anomalies"]["G1b"][0]
+        assert w["mop"] == ["r", "x", [1]]
+        assert v["weakest-violated"] == "read-committed"
+
+    def test_g1c(self):
+        v = check(h_g1c())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G1c"]
+        _assert_cycle_witness(v, "G1c", rw_exact=0)
+        assert "wr" in v["anomalies"]["G1c"][0]["edges"]
+        assert v["weakest-violated"] == "read-committed"
+
+    def test_g_single(self):
+        v = check(h_gsingle())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G-single"]
+        _assert_cycle_witness(v, "G-single", rw_exact=1)
+        assert v["weakest-violated"] == "snapshot-isolation"
+        assert "serializable" in v["not"]
+
+    def test_g2_item(self):
+        v = check(h_g2())
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G2-item"]
+        _assert_cycle_witness(v, "G2-item", rw_min=2)
+        assert v["weakest-violated"] == "serializable"
+        assert v["not"] == ["serializable"]
+
+    def test_clean_list_append(self):
+        v = check(h_clean())
+        assert v["valid?"] is True
+        assert v["anomaly-types"] == []
+        assert v["weakest-violated"] is None
+
+    def test_clean_rw_register(self):
+        v = check(h_rw_clean(), workload="rw-register")
+        assert v["valid?"] is True
+        assert v["anomaly-types"] == []
+
+    def test_rw_register_g_single(self):
+        v = check(h_rw_gsingle(), workload="rw-register")
+        assert v["valid?"] is False
+        assert v["anomaly-types"] == ["G-single"]
+        _assert_cycle_witness(v, "G-single", rw_exact=1)
+
+    def test_indeterminate_read_is_not_g1a(self):
+        """Reading a value whose txn crashed (:info) may be legal —
+        the write may have committed."""
+        h = hist([
+            invoke_op(0, "txn", [["append", "x", 5]]),
+            info_op(0, "txn", [["append", "x", 5]]),
+            invoke_op(1, "txn", [["r", "x", None]]),
+            ok_op(1, "txn", [["r", "x", [5]]]),
+        ])
+        v = check(h)
+        assert v["valid?"] is True, v["anomaly-types"]
+
+    def test_anomaly_filter(self):
+        """Everything is reported; only the configured subset fails
+        the verdict."""
+        v = check(h_g1c(), anomalies=["G2-item"])
+        assert v["valid?"] is True
+        assert v["anomaly-types"] == ["G1c"]
+        assert v["failing-anomaly-types"] == []
+
+    def test_unknown_anomaly_rejected(self):
+        with pytest.raises(ValueError):
+            elle_ck.Elle(anomalies=["G9"])
+
+    def test_empty_history(self):
+        v = check(hist([]))
+        assert v["valid?"] is True
+        assert v["txn-count"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Inference invariants
+# ---------------------------------------------------------------------------
+
+class TestInference:
+    def test_g1a_g1b_reads_emit_no_edges(self):
+        """Condemned reads must not contribute dependency edges."""
+        for h in (h_g1a(), h_g1b()):
+            inf = elle_infer.infer(h)
+            assert not inf.planes["wr"].any()
+            assert not inf.planes["rw"].any()
+
+    def test_incompatible_order(self):
+        h = hist([
+            invoke_op(0, "txn", [["append", "x", 1]]),
+            ok_op(0, "txn", [["append", "x", 1]]),
+            invoke_op(1, "txn", [["append", "x", 2]]),
+            ok_op(1, "txn", [["append", "x", 2]]),
+            invoke_op(2, "txn", [["r", "x", None]]),
+            ok_op(2, "txn", [["r", "x", [1, 2]]]),
+            invoke_op(0, "txn", [["r", "x", None]]),
+            ok_op(0, "txn", [["r", "x", [2]]]),
+        ])
+        v = check(h)
+        assert "incompatible-order" in v["anomaly-types"]
+        assert v["valid?"] is False
+
+    def test_duplicate_elements(self):
+        h = hist([
+            invoke_op(0, "txn", [["append", "x", 1]]),
+            ok_op(0, "txn", [["append", "x", 1]]),
+            invoke_op(1, "txn", [["append", "x", 1]]),
+            ok_op(1, "txn", [["append", "x", 1]]),
+        ])
+        v = check(h)
+        assert "duplicate-elements" in v["anomaly-types"]
+
+    def test_order_planes(self):
+        inf = elle_infer.infer(h_clean())
+        # process 0 ran txn 0 then txn 3: po edge
+        assert inf.planes["po"][0, 3]
+        # txn 0 completed before txn 1 invoked: rt edge
+        assert inf.planes["rt"][0, 1]
+        assert not inf.planes["rt"][1, 0]
+
+    def test_workload_sniffing(self):
+        assert elle_infer.detect_workload(h_g0()) == "list-append"
+        assert elle_infer.detect_workload(h_rw_clean()) == "rw-register"
+        # a failed append still marks the workload
+        assert elle_infer.detect_workload(h_g1a()) == "list-append"
+
+
+# ---------------------------------------------------------------------------
+# Differential: device planes kernel vs naive host oracle
+# ---------------------------------------------------------------------------
+
+def rand_stack(seed: int, n: int) -> np.ndarray:
+    """Random plane stack: sparse ww/wr/rw, acyclic po (chain pieces)
+    and rt (respecting a random topological order)."""
+    rng = np.random.RandomState(seed)
+    stack = np.zeros((len(elle_infer.PLANES), n, n), bool)
+    density = rng.choice([0.02, 0.06, 0.15])
+    for p in range(3):
+        stack[p] = rng.rand(n, n) < density
+        np.fill_diagonal(stack[p], False)
+    order = rng.permutation(n)
+    pos = np.empty(n, int)
+    pos[order] = np.arange(n)
+    # po: consecutive pairs of a few random process chains
+    for chain in np.array_split(order, rng.randint(1, 4)):
+        for a, b in zip(chain, chain[1:]):
+            stack[3, a, b] = True
+    # rt: random subset of topologically-forward pairs
+    fwd = pos[:, None] < pos[None, :]
+    stack[4] = fwd & (rng.rand(n, n) < 0.05)
+    return stack
+
+
+class TestDifferential:
+    def test_device_matches_host_oracle(self):
+        checked = 0
+        for seed in range(60, 84):
+            rng = random.Random(seed)
+            stacks = [rand_stack(seed * 31 + b,
+                                 rng.choice((5, 9, 17, 33)))
+                      for b in range(rng.choice((1, 3, 4)))]
+            include = seed % 2 == 0
+            dev = elle_graph.classify_batch(stacks,
+                                            include_order=include)
+            for s, d in zip(stacks, dev):
+                h = elle_graph.classify_host(s, include_order=include)
+                assert set(d["anomalies"]) == set(h["anomalies"]), (
+                    f"seed={seed} device={sorted(d['anomalies'])} "
+                    f"host={sorted(h['anomalies'])}")
+                checked += 1
+                # every found class must yield a walkable witness
+                for cls, edge in d["anomalies"].items():
+                    cyc = elle_graph.find_witness(
+                        s, cls, edge, include_order=include)
+                    assert cyc is not None, (seed, cls, edge)
+                    assert cyc[0] == cyc[-1]
+                    self._check_cycle_edges(s, cls, cyc, include)
+        assert checked >= 20
+
+    @staticmethod
+    def _check_cycle_edges(stack, cls, cyc, include):
+        ww, wr, rw, po, rt = (stack[i] for i in range(5))
+        order = (po | rt) if include else np.zeros_like(ww)
+        full = ww | wr | rw | order
+        hops = list(zip(cyc, cyc[1:]))
+        assert all(full[a, b] for a, b in hops), (cls, cyc)
+        if cls == "G0":
+            assert (ww | order)[cyc[0], cyc[1]] or ww[cyc[0], cyc[1]]
+            assert all((ww | order)[a, b] for a, b in hops[1:])
+        elif cls == "G1c":
+            assert wr[cyc[0], cyc[1]]
+            assert all((ww | wr | order)[a, b] for a, b in hops[1:])
+        elif cls == "G-single":
+            assert rw[cyc[0], cyc[1]]
+            assert all((ww | wr | order)[a, b] for a, b in hops[1:])
+        elif cls == "G2-item":
+            assert rw[cyc[0], cyc[1]]
+            assert any(rw[a, b] for a, b in hops[1:]), (cls, cyc)
+
+    def test_single_vs_batch_consistent(self):
+        stacks = [rand_stack(7 * b + 3, 12) for b in range(5)]
+        batched = elle_graph.classify_batch(stacks)
+        for s, row in zip(stacks, batched):
+            solo = elle_graph.classify_batch([s])[0]
+            assert set(solo["anomalies"]) == set(row["anomalies"])
+
+
+# ---------------------------------------------------------------------------
+# Checker integration: compose, runner resilience, batching, dispatch
+# ---------------------------------------------------------------------------
+
+class TestCheckerIntegration:
+    def test_compose(self):
+        c = ck.compose({"elle": elle_ck.checker(include_order=False),
+                        "opt": ck.unbridled_optimism()})
+        r = c.check({}, h_g2())
+        assert r["valid?"] is False
+        assert r["elle"]["anomaly-types"] == ["G2-item"]
+        assert r["opt"]["valid?"] is True
+
+    def test_dispatch_record(self):
+        v = check(h_g0())
+        d = v.get("dispatch")
+        assert d is not None
+        assert d["engine"] in ("elle-device", "elle-host")
+        assert d["planes"] == len(elle_infer.PLANES)
+        assert d["n_pad"] % 128 == 0
+        assert "fallback_chain" in d
+
+    def test_check_many_batches(self):
+        c = elle_ck.Elle(include_order=False)
+        vs = c.check_many({}, [h_g0(), h_clean(), h_g2()])
+        assert [v["valid?"] for v in vs] == [False, True, False]
+        assert vs[0]["anomaly-types"] == ["G0"]
+        assert vs[2]["anomaly-types"] == ["G2-item"]
+        assert all("dispatch" in v for v in vs)
+
+    def test_oom_bisects_to_singles(self, monkeypatch):
+        """A batch-sized device OOM must bisect down the history axis,
+        not abort: singles succeed."""
+        real = elle_graph.classify_batch
+        calls = []
+
+        def oomy(stacks, **kw):
+            calls.append(len(stacks))
+            if len(stacks) > 1:
+                raise ValueError("RESOURCE_EXHAUSTED: out of memory "
+                                 "while allocating planes")
+            return real(stacks, **kw)
+
+        monkeypatch.setattr(elle_graph, "classify_batch", oomy)
+        c = elle_ck.Elle(include_order=False)
+        vs = c.check_many({}, [h_g0(), h_clean(), h_g2(), h_gsingle()])
+        assert [v["valid?"] for v in vs] == [False, True, False, False]
+        assert max(calls) > 1 and 1 in calls     # bisected down
+
+    def test_host_fallback_when_no_device(self, monkeypatch):
+        def no_backend(stacks, **kw):
+            raise RuntimeError("Unable to initialize backend")
+
+        monkeypatch.setattr(elle_graph, "classify_batch", no_backend)
+        v = check(h_g2())
+        assert v["valid?"] is False
+        assert v["engine"] == "elle-host"
+        assert v["anomaly-types"] == ["G2-item"]
+
+    def test_forced_host(self):
+        v = check(h_gsingle(), algorithm="host")
+        assert v["anomaly-types"] == ["G-single"]
+        assert v["engine"] == "elle-host"
+
+    def test_corrupt_inference_quarantined(self, monkeypatch):
+        """A poisoned history inside a batch costs one quarantine
+        verdict, not the batch."""
+        real = elle_graph.classify_batch
+
+        def poison(stacks, **kw):
+            if any(s.shape[-1] == 2 for s in stacks):
+                raise KeyError("mangled planes")
+            return real(stacks, **kw)
+
+        monkeypatch.setattr(elle_graph, "classify_batch", poison)
+        c = elle_ck.Elle(include_order=False)
+        # h_g1a has 1 committed txn; h_g0 has 3; craft a 2-txn history
+        h2 = hist([
+            invoke_op(0, "txn", [["append", "x", 1]]),
+            ok_op(0, "txn", [["append", "x", 1]]),
+            invoke_op(1, "txn", [["r", "x", None]]),
+            ok_op(1, "txn", [["r", "x", [1]]]),
+        ])
+        vs = c.check_many({}, [h_g0(), h2, h_clean()])
+        assert vs[0]["valid?"] is False
+        assert vs[1]["valid?"] == "unknown"
+        assert vs[1].get("quarantined") is True
+        assert vs[2]["valid?"] is True
+
+
+class TestBatchChecker:
+    """independent.batch_checker routed through the elle engine: every
+    per-key subhistory one lane."""
+
+    @staticmethod
+    def _keyed(k, h):
+        out = []
+        for o in h:
+            out.append(o.assoc(value=independent.tuple_(k, o.value)))
+        return out
+
+    def test_per_key_batch(self):
+        ops = self._keyed(0, h_clean()) + self._keyed(1, h_g2())
+        h = hist([o for o in ops])
+        c = independent.batch_checker(
+            elle_ck.Elle(include_order=False))
+        r = c.check({}, h)
+        assert r["valid?"] is False
+        assert r["failures"] == [1]
+        assert r["results"][0]["valid?"] is True
+        assert r["results"][1]["anomaly-types"] == ["G2-item"]
+        assert all("dispatch" in v for v in r["results"].values())
+
+    def test_model_path_unchanged(self):
+        from jepsen_tpu import models
+        c = independent.batch_checker(models.CASRegister())
+        assert isinstance(c, independent.BatchedLinearizableChecker)
+
+
+# ---------------------------------------------------------------------------
+# Report + web rendering
+# ---------------------------------------------------------------------------
+
+class TestRendering:
+    def test_elle_section_invalid(self):
+        from jepsen_tpu import report
+        v = check(h_g2())
+        text = report.elle_section(v)
+        assert "G2-item" in text
+        assert "weakest violated isolation level: serializable" in text
+        assert "--rw-->" in text
+
+    def test_elle_section_clean(self):
+        from jepsen_tpu import report
+        text = report.elle_section(check(h_clean()))
+        assert "No anomalies detected" in text
+        assert "serializable" in text
+
+
+# ---------------------------------------------------------------------------
+# Suite end-to-end (cockroach over the in-memory SQL backend)
+# ---------------------------------------------------------------------------
+
+class TestSuiteEndToEnd:
+    def test_cockroach_list_append(self, tmp_path, monkeypatch):
+        from test_suites_small import MemSQL, dummy_handler
+
+        from jepsen_tpu import control, core, store, web
+        from jepsen_tpu.suites import cockroach
+
+        monkeypatch.setattr(store, "BASE", tmp_path / "store")
+        mem = MemSQL()
+        control.set_dummy_handler(dummy_handler([]))
+        try:
+            test = cockroach.list_append_test({
+                "nodes": ["n1", "n2", "n3"], "concurrency": 3,
+                "time-limit": 2, "ssh": {"dummy": True},
+                "sql-factory": mem.factory})
+            result = core.run(test)
+        finally:
+            control.set_dummy_handler(None)
+        res = result["results"]
+        elle = res["elle"]
+        # the in-memory backend serializes under one lock: no anomalies
+        assert elle["valid?"] is True, elle.get("anomaly-types")
+        assert elle["txn-count"] >= 10
+        assert elle["workload"] == "list-append"
+        assert elle["dispatch"]["engine"] in ("elle-device",
+                                              "elle-host")
+        # the anomaly section rendered into the store
+        p = elle.get("elle-report")
+        assert p and (tmp_path / "store") in __import__(
+            "pathlib").Path(p).parents
+        assert "Transactional isolation" in open(p).read()
+        # and the web surfaces render it
+        run_dir = __import__("pathlib").Path(p).parent
+        name, ts = run_dir.parent.name, run_dir.name
+        page = web.elle_html(name, ts).decode()
+        assert "transactional isolation" in page
+        assert "elle-device" in page or "elle-host" in page
+        home = web.home_html().decode()
+        assert "/elle/" in home
+
+    def test_cockroach_rw_register_client(self):
+        """Client mop/row alignment unit check (no full run): reads
+        align by position even when a key is missing."""
+        from test_suites_small import MemSQL
+
+        from jepsen_tpu.suites import cockroach
+        mem = MemSQL()
+        cl = cockroach.ElleRwRegisterClient(mem.factory)
+        cl = cl.open({"sql-factory": mem.factory}, "n1")
+        op = invoke_op(0, "txn", [["r", 1, None], ["w", 1, 7],
+                                  ["r", 2, None]])
+        out = cl._invoke({}, op)
+        assert out.value[0] == ["r", 1, None]
+        assert out.value[1] == ["w", 1, 7]
+        assert out.value[2] == ["r", 2, None]
+        op2 = invoke_op(0, "txn", [["r", 1, None]])
+        out2 = cl._invoke({}, op2)
+        assert out2.value[0] == ["r", 1, 7]
