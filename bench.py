@@ -230,6 +230,184 @@ def plant_stale_read(h: History, frac: float, vmax: int,
     return None
 
 
+def bench_live() -> dict:
+    """ISSUE 6: the always-on live verification service, priced as a
+    service rather than a one-shot engine — N concurrent synthetic
+    tenants, each a WAL-fed register run, checked incrementally by the
+    LiveScheduler with cross-tenant shape-bucketed micro-batches.
+
+    Three measurements:
+      * sustained drain throughput (ops/s across all tenants, warm
+        plan cache — the steady-state capacity of one checker daemon);
+      * p99 op-append→verdict lag under paced real-time feeders
+        (RATE ops/s per tenant appended with wall stamps, the
+        scheduler ticking between slices), exact quantile over every
+        checked window's journaled lag;
+      * detection lag for one violation planted mid-stream in one
+        tenant (append→flag, from the live-flag event).
+
+    vs_baseline is the numpy host engine draining the same tenant
+    shape (rate vs rate).  Returns the tail-JSON stats dict."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu.history import HistoryWAL
+    from jepsen_tpu.live import engine as live_engine
+    from jepsen_tpu.live.scheduler import LiveScheduler
+
+    N_TEN = 4
+    OPS_SUSTAINED = 25_000            # per tenant
+    OPS_HOST = 5_000                  # per tenant, host baseline
+    OPS_RT = 4_000                    # per tenant, paced phase
+    RATE = 2_000                      # completed ops/s per tenant
+    rootbase = pathlib.Path(tempfile.mkdtemp(prefix="bench-live-"))
+
+    def write_store(sub: str, n_ops: int, seeds: list) -> tuple:
+        root = rootbase / sub
+        n_inv = 0
+        for i, seed in enumerate(seeds):
+            d = root / f"tenant{i}" / "t1"
+            d.mkdir(parents=True)
+            h = make_history(n_ops, 4, seed=seed)
+            n_inv += sum(1 for o in h if o.is_invoke)
+            wal = HistoryWAL(d / "history.wal", fsync=False)
+            for o in h:
+                wal.append(o)
+            wal.close()
+            (d / "results.json").write_text('{"valid?": true}')
+        return root, n_inv
+
+    try:
+        # warm the compiled-plan cache on a small same-shaped store so
+        # the sustained figure is the no-compile steady state
+        warm_root, _ = write_store("warm", 2_000,
+                                   [7 + i for i in range(N_TEN)])
+        ws = LiveScheduler(warm_root, backend="device", scan_every=1)
+        ws.drain()
+        ws.close()
+
+        miss0 = live_engine.plan_cache_stats()["miss"]
+        main_root, n_inv = write_store(
+            "main", OPS_SUSTAINED, [100 + i for i in range(N_TEN)])
+        sched = LiveScheduler(main_root, backend="device",
+                              scan_every=1)
+        t0 = time.monotonic()
+        sched.drain()
+        drain_s = time.monotonic() - t0
+        clean = sched.flags_total == 0
+        sched.close()
+        new_misses = live_engine.plan_cache_stats()["miss"] - miss0
+        sustained = n_inv / drain_s
+
+        # host-engine baseline: same tenant shape, quarter load
+        host_root, n_inv_h = write_store(
+            "host", OPS_HOST, [300 + i for i in range(N_TEN)])
+        hs = LiveScheduler(host_root, backend="host", scan_every=1)
+        t0 = time.monotonic()
+        hs.drain()
+        host_s = time.monotonic() - t0
+        hs.close()
+        host_rate = n_inv_h / host_s
+
+        # paced real-time phase with one planted mid-stream violation
+        rt_root = rootbase / "rt"
+        feeders = []
+        for i in range(N_TEN):
+            d = rt_root / f"rt{i}" / "t1"
+            d.mkdir(parents=True)
+            ops = list(make_history(OPS_RT, 4, seed=500 + i))
+            feeders.append((d, ops))
+        planted_at = None
+        d0, ops0 = feeders[0]
+        for j, o in enumerate(ops0):
+            if (o.is_ok and o.f == "read" and o.value is not None
+                    and j > len(ops0) * 0.6):
+                o.value = 99          # vmax=4: provably never written
+                planted_at = j
+                break
+        wals = [HistoryWAL(d / "history.wal", fsync=False)
+                for d, _ in feeders]
+        rt = LiveScheduler(rt_root, backend="device", scan_every=1)
+        pos = [0] * N_TEN
+        t_start = time.monotonic()
+        while any(pos[i] < len(feeders[i][1]) for i in range(N_TEN)):
+            # entries ≈ 2 per completed op: pace the entry stream
+            target = int((time.monotonic() - t_start) * RATE * 2) + 8
+            for i, (_d, ops) in enumerate(feeders):
+                stop = min(target, len(ops))
+                while pos[i] < stop:
+                    wals[i].append(ops[pos[i]])
+                    pos[i] += 1
+            rt.tick()
+        for w in wals:
+            w.close()
+        for d, _ in feeders:
+            (d / "results.json").write_text('{"valid?": true}')
+        rt.drain()
+        rt.close()
+
+        lags: list = []
+        det_lag = None
+        for d, _ in feeders:
+            for ev in telemetry_mod.read_events(d / "live.jsonl"):
+                if ev.get("type") == "live-window" \
+                        and isinstance(ev.get("lag_s"), (int, float)):
+                    lags.append(ev["lag_s"])
+                elif ev.get("type") == "live-flag" and det_lag is None:
+                    det_lag = ev.get("detection_lag_s")
+        lags.sort()
+        p99 = lags[min(int(0.99 * len(lags)), len(lags) - 1)] \
+            if lags else None
+    finally:
+        shutil.rmtree(rootbase, ignore_errors=True)
+
+    if not clean:
+        print(json.dumps({"metric": "ERROR: live checker flagged a "
+                          "clean sustained-drain tenant", "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return {"error": True}
+    if planted_at is not None and det_lag is None:
+        print(json.dumps({"metric": "ERROR: live checker missed the "
+                          "planted mid-stream violation", "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return {"error": True}
+
+    print(json.dumps({
+        "metric": (f"live verification service: {N_TEN} concurrent "
+                   f"tenants x {OPS_SUSTAINED // 1000}k-op register "
+                   "WALs, sustained incremental drain (warm plan "
+                   "cache, cross-tenant micro-batched windows) vs "
+                   "the numpy host engine"),
+        "value": round(sustained, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(sustained / host_rate, 2)}),
+        file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"live p99 op-append->verdict lag under {N_TEN} "
+                   f"tenants x {RATE} ops/s paced feeders "
+                   f"({len(lags)} windows); planted-violation "
+                   "detection lag "
+                   f"{det_lag if det_lag is not None else 'n/a'}s"),
+        "value": round(p99, 4) if p99 is not None else 0,
+        "unit": "seconds",
+        "vs_baseline": round(det_lag, 4)
+        if det_lag is not None else 0}),
+        file=sys.stderr)
+    print(f"# live: sustained {sustained:.0f} ops/s over "
+          f"{N_TEN}x{OPS_SUSTAINED} ops in {drain_s:.2f}s "
+          f"({new_misses} plan compiles after warmup); host engine "
+          f"{host_rate:.0f} ops/s; paced-phase p99 lag "
+          f"{p99 if p99 is not None else float('nan'):.4f}s, "
+          f"detection lag {det_lag}s", file=sys.stderr)
+    return {"live_sustained_ops_s": round(sustained, 1),
+            "live_p99_lag_s": round(p99, 4) if p99 is not None
+            else None,
+            "live_detect_lag_s": round(det_lag, 4)
+            if det_lag is not None else None,
+            "live_vs_host": round(sustained / host_rate, 2)}
+
+
 def main() -> int:
     model = models.CASRegister()
     hists = [make_history(OPS_PER_KEY, CONCURRENCY, seed=1000 + k)
@@ -1004,6 +1182,10 @@ def main() -> int:
               f"{ew_med:.3f}s, {per_hist_e * 1e3:.0f}ms/history); "
               f"host {host_s:.2f}s ({host_note})", file=sys.stderr)
 
+    live_stats = bench_live()
+    if live_stats.get("error"):
+        return 1
+
     print(json.dumps({
         "metric": (f"linearizability check throughput, {N_KEYS} "
                    f"independent {OPS_PER_KEY}-op register histories "
@@ -1046,6 +1228,10 @@ def main() -> int:
         "elle_1k_vs_host": round(elle_stats[1_000][1], 2),
         "elle_10k_hist_s": round(elle_stats[10_000][0], 4),
         "elle_10k_vs_host": round(elle_stats[10_000][1], 2),
+        # the live verification service (BENCH_r06+): sustained
+        # multi-tenant incremental drain + p99 op-append->verdict lag
+        # under paced feeders (bench_live)
+        **{k: v for k, v in live_stats.items() if v is not None},
     }))
     print(f"# multi-key: {n_ops} ops / {N_KEYS} keys in {kernel_s:.3f}s "
           f"kernel (median {kernel_med:.3f}s; {warm_s:.2f}s wall incl. "

@@ -316,6 +316,97 @@ def serve_cmd_run(opts) -> int:
     return 0
 
 
+def serve_checker_cmd(opts) -> int:
+    """`serve-checker <store-root>`: the always-on live verification
+    daemon (ISSUE 6) — tails every run's history.wal under the root,
+    incrementally checks windows across tenants in shape-bucketed
+    device micro-batches, and writes per-run live.json / live.jsonl
+    verdict-so-far surfaces (rendered at /live when --port serves the
+    dashboard from the same process)."""
+    from jepsen_tpu.live.service import CheckerService
+    root = Path(opts.store_root)
+    if not root.is_dir():
+        print(f"no such store root: {root}", file=sys.stderr)
+        return 255
+    svc = CheckerService(
+        root,
+        poll_interval=opts.poll_interval,
+        web_port=(opts.port or None),
+        web_host=opts.host,
+        model=opts.model,
+        backend=opts.backend,
+        wild_init=(False if opts.strict_init else None),
+        bits=opts.max_open_bits,
+        max_states=opts.max_states,
+        max_window_events=opts.window_events,
+        tenant_budget_bytes=int(opts.tenant_budget_mb * (1 << 20)),
+        deadline_s=opts.deadline_s)
+    if opts.once:
+        ticks = svc.drain()
+        sched = svc.scheduler
+        print(f"drained in {ticks} tick(s): "
+              f"{len(sched.tenants) + len(sched.finished)} tenant(s), "
+              f"{sched.flags_total} violation flag(s)",
+              file=sys.stderr)
+        svc.close()
+        return 1 if sched.flags_total else 0
+    svc.run()
+    return 0
+
+
+def serve_checker_cmd_spec() -> dict:
+    def add_opts(parser):
+        parser.add_argument("store_root", metavar="STORE_ROOT",
+                            help="store/ directory whose runs to tail")
+        parser.add_argument("-b", "--host", default="0.0.0.0")
+        parser.add_argument("-p", "--port", type=int, default=0,
+                            metavar="PORT",
+                            help="also serve the web dashboard (with "
+                                 "/live pages + live /metrics gauges) "
+                                 "from this process; 0 disables")
+        parser.add_argument("--poll-interval", type=float,
+                            default=0.05, metavar="SECONDS",
+                            help="cursor poll cadence")
+        parser.add_argument("--model", default="cas-register",
+                            help="default model for runs whose "
+                                 "test.json names none")
+        parser.add_argument("--backend", default="auto",
+                            choices=["auto", "device", "host"],
+                            help="window engine backend")
+        parser.add_argument("--strict-init", action="store_true",
+                            help="trust the model's own initial state "
+                                 "instead of the wildcard ('any "
+                                 "initial value') default — only when "
+                                 "you KNOW what the SUT starts with, "
+                                 "or legal histories will false-flag")
+        parser.add_argument("--max-open-bits", type=int, default=6,
+                            metavar="B",
+                            help="open-op slot budget per lane "
+                                 "(plane rows = 2^B)")
+        parser.add_argument("--max-states", type=int, default=64,
+                            help="model-state table cap per lane")
+        parser.add_argument("--window-events", type=int, default=256,
+                            help="event budget per checked window")
+        parser.add_argument("--tenant-budget-mb", type=float,
+                            default=4.0,
+                            help="per-tenant memory budget before "
+                                 "cursor backpressure")
+        parser.add_argument("--deadline-s", type=float, default=None,
+                            help="per-tick dispatch budget; past it "
+                                 "the tick degrades to the host "
+                                 "engine (ResilientRunner semantics)")
+        parser.add_argument("--once", action="store_true",
+                            help="drain everything currently on disk "
+                                 "and exit (exit 1 if any violation "
+                                 "was flagged)")
+
+    return {"serve-checker": {
+        "opts": add_opts, "run": serve_checker_cmd,
+        "help": "Run the always-on live verification daemon over a "
+                "store/ root (incremental checking of in-flight "
+                "histories)."}}
+
+
 def single_test_cmd(test_fn: Callable[[dict], dict],
                     opt_fn: Optional[Callable] = None) -> dict:
     """The standard command map for a suite with one test constructor
@@ -357,6 +448,7 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                             "WAL and re-analyze it."},
         **metrics_cmd_spec(),
         **serve_cmd(),
+        **serve_checker_cmd_spec(),
     }
 
 
@@ -418,6 +510,7 @@ def standard_commands() -> dict:
                             "from its history.wal."},
         **metrics_cmd_spec(),
         **serve_cmd(),
+        **serve_checker_cmd_spec(),
     }
 
 

@@ -583,17 +583,139 @@ class ColumnJournal:
 # reference's checkers assume about ops whose process crashed).
 #
 # Record framing (history.wal):
-#     {"i": <seq>, "crc": "<crc32 of canonical op json>", "op": {...}}
+#     {"i": <seq>, "w": <append wall-clock s>, "crc": "<crc32 of
+#      canonical op json>", "op": {...}}
 #
 # The canonical payload is json.dumps(op_dict, sort_keys=True,
 # separators=(",", ":"), default=repr) — deterministic across the
 # write/read round trip, so a reader can re-derive and verify the crc
-# from the parsed record alone.
+# from the parsed record alone.  The `w` append stamp rides OUTSIDE the
+# crc-guarded payload (old readers ignore it; old WALs lack it): it is
+# what lets the live checker service measure true op-append→flag
+# detection latency (docs/live-checker.md).
 # ---------------------------------------------------------------------------
 
 def _wal_payload(op_dict: dict) -> str:
     return json.dumps(op_dict, sort_keys=True, separators=(",", ":"),
                       default=repr)
+
+
+@dataclasses.dataclass
+class FrameSegment:
+    """One `follow_frames` read: the validated records, plus the cursor
+    state to resume from.  `offset` always points at the first byte NOT
+    consumed (the start of the first incomplete or invalid line), so a
+    torn tail is re-read — and picked up whole — on the next call."""
+
+    records: list                       # validated envelope dicts
+    offset: int                         # byte offset to resume from
+    seq: int                            # next expected record seq
+    corrupt: bool = False               # a COMPLETE line failed a guard
+    stop_reason: Optional[str] = None
+    tail_bytes: int = 0                 # unconsumed bytes past `offset`
+
+
+def follow_frames(path, offset: int = 0, seq: int = 0,
+                  key: str = "op",
+                  max_records: Optional[int] = None) -> FrameSegment:
+    """Tail a crc/seq-framed JSONL log (history.wal, telemetry.jsonl —
+    both use the same framing discipline) from a byte offset.
+
+    Intact-prefix semantics, incrementally: every COMPLETE line from
+    `offset` is validated (parses, is a dict carrying `key`, sequence
+    number equals `seq`+position, crc re-derived from the canonical
+    payload matches); validation failure of a complete line marks the
+    stream `corrupt` — everything past it is unattributable, exactly as
+    in `recover`.  An INCOMPLETE trailing line (no newline yet: the
+    writer is mid-append, or died mid-write) is NOT consumed: `offset`
+    stays at its first byte and the next call re-reads it, so a
+    follower survives torn tails and resumes by offset alone.
+
+    `max_records` bounds one read (backpressure: a tailer ingesting
+    into bounded memory reads in slices); the returned offset/seq
+    resume exactly after the last consumed record."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        buf = f.read()
+    records: list = []
+    pos = 0
+    corrupt, reason = False, None
+    while max_records is None or len(records) < max_records:
+        nl = buf.find(b"\n", pos)
+        if nl < 0:
+            break
+        line = buf[pos:nl].decode("utf-8", errors="replace").strip()
+        if not line:
+            pos = nl + 1
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            corrupt, reason = True, \
+                f"record {seq}: unparseable complete record"
+            break
+        if not isinstance(rec, dict) or key not in rec:
+            corrupt, reason = True, f"record {seq}: not a {key!r} frame"
+            break
+        if rec.get("i") != seq:
+            corrupt, reason = True, (f"record {seq}: sequence break "
+                                     f"(expected {seq}, got "
+                                     f"{rec.get('i')})")
+            break
+        payload = _wal_payload(rec[key])
+        if f"{zlib.crc32(payload.encode()):08x}" != rec.get("crc"):
+            corrupt, reason = True, f"record {seq}: crc mismatch"
+            break
+        records.append(rec)
+        seq += 1
+        pos = nl + 1
+    return FrameSegment(records, offset + pos, seq, corrupt, reason,
+                        len(buf) - pos)
+
+
+@dataclasses.dataclass
+class WalSegment:
+    """One `follow` read of a history WAL: new ops (in append order)
+    with their append wall-clock stamps, plus resume cursor state."""
+
+    ops: list                           # Op per intact new record
+    walls: list                         # parallel wall s (None if old)
+    offset: int
+    seq: int
+    corrupt: bool = False
+    stop_reason: Optional[str] = None
+    tail_bytes: int = 0
+
+
+def follow(path, offset: int = 0, seq: int = 0,
+           max_records: Optional[int] = None) -> WalSegment:
+    """Resumable cursor over a (possibly still-being-written) history
+    WAL: the documented streaming alternative to `recover`'s full
+    re-read.  Returns the ops appended since `offset` whose records are
+    intact, and the (`offset`, `seq`) pair to pass to the next call.
+
+    Contract (the live checker service is built on it):
+      * records are validated exactly like `recover` — parse, seq,
+        crc — and only the intact prefix of the new bytes is returned;
+      * an incomplete trailing line is left unconsumed (`tail_bytes`),
+        so a follower polls through torn tails and loses nothing;
+      * a COMPLETE line failing validation sets `corrupt`: the stream
+        is permanently damaged past `offset` and following further
+        cannot be attributed (callers should fall back to `recover`
+        semantics for the final verdict);
+      * `walls[i]` is the writer's append wall-clock stamp (the `w`
+        envelope field) when present — detection-latency measurements
+        anchor on it — or None for WALs written before the field
+        existed."""
+    seg = follow_frames(path, offset, seq, key="op",
+                        max_records=max_records)
+    ops, walls = [], []
+    for rec in seg.records:
+        ops.append(Op.from_dict(rec["op"]))
+        w = rec.get("w")
+        walls.append(float(w) if isinstance(w, (int, float)) else None)
+    return WalSegment(ops, walls, seg.offset, seg.seq, seg.corrupt,
+                      seg.stop_reason, seg.tail_bytes)
 
 
 class HistoryWAL:
@@ -629,9 +751,12 @@ class HistoryWAL:
                 payload = _wal_payload(o.to_dict())
                 crc = zlib.crc32(payload.encode())
                 # embed the canonical payload verbatim (it is itself
-                # JSON) — the reader re-derives the crc from it alone
-                self._f.write(f'{{"i":{self._n},"crc":"{crc:08x}",'
-                              f'"op":{payload}}}\n')
+                # JSON) — the reader re-derives the crc from it alone.
+                # `w` (append wall clock) rides outside the guarded
+                # payload: follow()-based consumers measure detection
+                # lag from it; recover() ignores it.
+                self._f.write(f'{{"i":{self._n},"w":{time.time():.6f},'
+                              f'"crc":"{crc:08x}","op":{payload}}}\n')
                 self._f.flush()
                 if self.fsync:
                     t0 = time.monotonic()
@@ -653,6 +778,11 @@ class HistoryWAL:
             except Exception:
                 pass
 
+    # Resumable read cursor over a WAL file (typically someone ELSE's
+    # WAL — the live checker tails runs it did not write).  Static:
+    # the follower needs no handle on the writer.
+    follow = staticmethod(follow)
+
 
 def recover(path) -> History:
     """Rebuild a well-formed History from a (possibly truncated) WAL.
@@ -671,30 +801,12 @@ def recover(path) -> History:
                   guard>, "stop_reason": <str or None>}
     """
     p = Path(path)
-    ops: list[Op] = []
-    stop_reason = None
-    raw = p.read_bytes().decode("utf-8", errors="replace")
-    for lineno, line in enumerate(raw.splitlines()):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            stop_reason = f"line {lineno}: torn/unparseable record"
-            break
-        if not isinstance(rec, dict) or "op" not in rec:
-            stop_reason = f"line {lineno}: not a WAL record"
-            break
-        if rec.get("i") != len(ops):
-            stop_reason = (f"line {lineno}: sequence break "
-                           f"(expected {len(ops)}, got {rec.get('i')})")
-            break
-        payload = _wal_payload(rec["op"])
-        if f"{zlib.crc32(payload.encode()):08x}" != rec.get("crc"):
-            stop_reason = f"line {lineno}: crc mismatch"
-            break
-        ops.append(Op.from_dict(rec["op"]))
+    seg = follow(p)                      # one full-file cursor read
+    ops: list[Op] = list(seg.ops)
+    stop_reason = seg.stop_reason
+    if stop_reason is None and seg.tail_bytes:
+        stop_reason = (f"incomplete trailing record "
+                       f"({seg.tail_bytes} bytes)")
 
     # Close open invocations as :info (knossos treats such processes as
     # crashed; the invocation stays concurrent to everything after it).

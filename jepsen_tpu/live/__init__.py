@@ -1,0 +1,56 @@
+"""Always-on live verification service (ISSUE 6 tentpole).
+
+Jepsen's analysis phase is post-hoc: the run ends, `analyze()` fires,
+and a violation from minute one is reported an hour later.  This
+package inverts that shape into a long-lived, multi-tenant checker
+daemon that flags violations *while runs are still executing*:
+
+  * **cursor** — resumable follow-mode tails over many concurrent
+    runs' crash-safe `history.wal` / `telemetry.jsonl` streams
+    (`history.follow` / `telemetry.follow_events`, PR 2/4's seekable
+    inputs), surviving torn tails and resuming by byte offset.
+  * **windows** — per-run incremental checker state: completed ops are
+    paired, demultiplexed into per-key lanes, and sealed into windows
+    at quiescent cuts; each lane carries a *configuration plane* (the
+    open-set × model-state boolean frontier of Lowe's just-in-time
+    linearization) that is extended as windows are checked — the
+    streaming equivalent of wgl_seg's segment transfer matrices.
+  * **engine** — the window kernel: one jitted scan over invoke/return
+    events transforms the plane; lanes from *different tenants* are
+    micro-batched into single shape-bucketed device dispatches served
+    from a warm compiled-plan cache (no per-request compile after
+    warmup), with an independent numpy host oracle for fallback and
+    differential testing.
+  * **scheduler** — multi-tenant orchestration with bounded per-tenant
+    memory (cursor backpressure against a byte budget, frontier-
+    widening eviction when no quiescent cut lands), dispatch through
+    `ops/runner.ResilientRunner` (OOM bisection, poison quarantine,
+    deadline degradation to the host engine), per-run `live.json` +
+    `live.jsonl` surfaces, and detection-lag metrics.
+  * **service** — the daemon: `python -m jepsen_tpu.cli serve-checker
+    <store-root>`, with an optional embedded web dashboard exposing
+    `/live` pages and the Prometheus `/metrics` gauges.
+
+Live verdicts are advisory ("violation-so-far" / "clean-so-far"): the
+post-hoc `analyze()` remains the authoritative verdict.  The live
+engine is exact for windows it checks; where it cannot stay exact
+within its memory budget it *widens* (any state possible after an
+unchecked gap) and says so, never silently — see docs/live-checker.md.
+"""
+
+from jepsen_tpu.live.engine import LaneDispatch, check_batch
+
+__all__ = ["LaneDispatch", "check_batch", "LiveScheduler",
+           "CheckerService"]
+
+
+def __getattr__(name):
+    # scheduler/service import jax-adjacent machinery; resolve lazily
+    # so `from jepsen_tpu.live import engine` stays cheap
+    if name == "LiveScheduler":
+        from jepsen_tpu.live.scheduler import LiveScheduler
+        return LiveScheduler
+    if name == "CheckerService":
+        from jepsen_tpu.live.service import CheckerService
+        return CheckerService
+    raise AttributeError(name)

@@ -1,0 +1,380 @@
+"""The live window kernel: just-in-time linearization as a batched
+plane scan.
+
+Lowe (Testing for linearizability, 2017) and the WGL algorithm both
+observe that the linearizability search state is *incrementally
+extensible* as operations arrive: at any point in real time, the
+complete search state is the set of configurations
+
+    (L, s)   L = subset of currently-open ops already linearized,
+             s = model state reached by the linearization so far.
+
+This module represents that set as a dense boolean **plane** of shape
+`[2^B, Sn]` (B = open-op slot budget, Sn = model-state table size) and
+processes a *window* of events as one `lax.scan`:
+
+  * `invoke(j)`  installs op j's per-state transition table
+    (`next_idx[Sn]`, `legal[Sn]`, built host-side from the model) into
+    slot j;
+  * after every event the plane is closed under "linearize any open,
+    not-yet-linearized op" (≤ B expansion rounds reach the fixpoint:
+    each configuration gains at most B bits);
+  * `return(j)` kills configurations that never linearized j and
+    retires bit j from the survivors (`new[L] = old[L | bit_j]`);
+  * the first event after which the plane is empty is the violation
+    witness (`violated_event`); an empty plane can never repopulate,
+    so the witness is the *earliest* refutation in the window.
+
+The plane after a window IS the segment transfer state: it carries
+exactly the cross-window information (open residue + reachable model
+states) the next window needs, in O(2^B · Sn) memory per lane
+regardless of history length.
+
+Micro-batching: lanes from any number of tenants are grouped into
+shape buckets `(M=2^B, E_pad, Sn_pad)` (pow2-padded events/states,
+pow2-padded lane count) and each bucket runs as ONE vmapped device
+dispatch.  Compiled plans are cached per bucket (`plan_cache_stats`),
+so a warmed service never compiles on the request path — the same
+shape-bucketing discipline `telemetry.attach_dispatch` records for the
+batch engines.  `check_batch(..., backend="host")` is an independent
+numpy implementation of the same scan, used as the ResilientRunner
+degradation target and as the differential oracle in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+
+# Event kinds in `ev_kind` (0 is padding and must no-op).
+# EV_CANCEL retires a slot WITHOUT constraining: configurations that
+# linearized the op and configurations that didn't both survive, with
+# the bit dropped (`new[L] = old[L] | old[L | bit]`).  The window
+# builder emits it for an op that FAILED after its invoke was already
+# dispatched across a forced cut — the op never happened, but its
+# speculative linearizations can only widen the config set (lenient,
+# never a false flag).
+EV_PAD, EV_INVOKE, EV_RETURN, EV_CANCEL = 0, 1, 2, 3
+
+
+@dataclasses.dataclass
+class LaneDispatch:
+    """One lane's inputs for one window check.
+
+    plane      bool [M, Sn]   configuration plane carried in (M = 2^B)
+    slot_next  i32  [B, Sn]   per-slot transition target index
+    slot_legal bool [B, Sn]   per-slot transition legality
+    slot_open  bool [B]       slots occupied at window start (residue)
+    ev_kind    i32  [E]       EV_PAD / EV_INVOKE / EV_RETURN
+    ev_slot    i32  [E]       slot the event addresses
+    ev_next    i32  [E, Sn]   invoke events: transition table to install
+    ev_legal   bool [E, Sn]
+    """
+
+    plane: np.ndarray
+    slot_next: np.ndarray
+    slot_legal: np.ndarray
+    slot_open: np.ndarray
+    ev_kind: np.ndarray
+    ev_slot: np.ndarray
+    ev_next: np.ndarray
+    ev_legal: np.ndarray
+
+    @property
+    def bits(self) -> int:
+        return int(self.plane.shape[0]).bit_length() - 1
+
+    @property
+    def n_states(self) -> int:
+        return int(self.plane.shape[1])
+
+    @property
+    def n_events(self) -> int:
+        return int(self.ev_kind.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in (
+            self.plane, self.slot_next, self.slot_legal, self.slot_open,
+            self.ev_kind, self.ev_slot, self.ev_next, self.ev_legal))
+
+
+def _pow2(x: int, lo: int = 1) -> int:
+    p = lo
+    while p < x:
+        p *= 2
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Compiled-plan cache (the "warm kernel cache" of the ISSUE tentpole)
+# ---------------------------------------------------------------------------
+
+_PLAN_CACHE: dict = {}
+_CACHE_STATS = {"hit": 0, "miss": 0}
+
+
+def plan_cache_stats() -> dict:
+    return dict(_CACHE_STATS)
+
+
+def clear_plan_cache() -> None:
+    _PLAN_CACHE.clear()
+    _CACHE_STATS["hit"] = _CACHE_STATS["miss"] = 0
+
+
+def _compiled(T: int, E: int, M: int, Sn: int):
+    """The jitted bucket kernel for (lanes, events, plane rows, states)
+    — returns (fn, cache_hit)."""
+    key = (T, E, M, Sn)
+    fn = _PLAN_CACHE.get(key)
+    if fn is not None:
+        _CACHE_STATS["hit"] += 1
+        telemetry.REGISTRY.counter("live_plan_cache_total",
+                                   outcome="hit").inc()
+        return fn, True
+    import jax
+    import jax.numpy as jnp
+
+    B = M.bit_length() - 1
+    L_np = np.arange(M, dtype=np.int32)
+    # static per-slot row masks / row-permutations for the closure
+    nobit = np.stack([(L_np & (1 << j)) == 0 for j in range(B)])
+    xor_rows = np.stack([L_np ^ (1 << j) for j in range(B)])
+
+    def lane(plane, snext, slegal, sopen, evk, evs, evn, evl):
+        L = jnp.asarray(L_np)
+        col = jnp.arange(Sn, dtype=jnp.int32)
+
+        def step(carry, ev):
+            plane, snext_c, slegal_c, sopen_c, viol = carry
+            k, j, idx, nxt, leg = ev
+            is_inv = k == EV_INVOKE
+            is_ret = k == EV_RETURN
+            is_can = k == EV_CANCEL
+            snext_c = jnp.where(is_inv, snext_c.at[j].set(nxt), snext_c)
+            slegal_c = jnp.where(is_inv, slegal_c.at[j].set(leg),
+                                 slegal_c)
+            sopen_c = jnp.where(is_inv, sopen_c.at[j].set(True),
+                                jnp.where(is_ret | is_can,
+                                          sopen_c.at[j].set(False),
+                                          sopen_c))
+            # return(j): configurations lacking bit j die; survivors
+            # shed the bit — one fused filter+rename gather.
+            # cancel(j): nothing dies; both branches merge bit-less.
+            bit = jnp.int32(1) << j
+            hasbit = ((L & bit) != 0)[:, None]
+            ret_plane = jnp.where(hasbit, False, plane[L | bit])
+            can_plane = jnp.where(hasbit, False,
+                                  plane | plane[L | bit])
+            plane = jnp.where(is_ret, ret_plane,
+                              jnp.where(is_can, can_plane, plane))
+
+            def closure_with(pl):
+                def rnd(_, p):
+                    for jj in range(B):
+                        P = (slegal_c[jj][:, None] & sopen_c[jj]
+                             & (col[None, :]
+                                == snext_c[jj][:, None]))
+                        src = jnp.where(nobit[jj][:, None], p, False)
+                        moved = (src.astype(jnp.float32)
+                                 @ P.astype(jnp.float32)) > 0.5
+                        p = p | jnp.where((~nobit[jj])[:, None],
+                                          moved[xor_rows[jj]], False)
+                    return p
+                return jax.lax.fori_loop(0, B, rnd, pl)
+
+            plane = closure_with(plane)
+            alive = plane.any()
+            viol = jnp.where((~alive) & (viol < 0) & (k > EV_PAD),
+                             idx, viol)
+            return (plane, snext_c, slegal_c, sopen_c, viol), None
+
+        (plane, snext, slegal, sopen, viol), _ = jax.lax.scan(
+            step, (plane, snext, slegal, sopen, jnp.int32(-1)),
+            (evk, evs, jnp.arange(E, dtype=jnp.int32), evn, evl))
+        return plane, sopen, viol
+
+    fn = jax.jit(jax.vmap(lane))
+    _PLAN_CACHE[key] = fn
+    _CACHE_STATS["miss"] += 1
+    telemetry.REGISTRY.counter("live_plan_cache_total",
+                               outcome="miss").inc()
+    return fn, False
+
+
+# ---------------------------------------------------------------------------
+# Host oracle: the same scan in numpy (independent implementation)
+# ---------------------------------------------------------------------------
+
+def _check_lane_host(lane: LaneDispatch):
+    plane = lane.plane.copy()
+    snext = lane.slot_next.copy()
+    slegal = lane.slot_legal.copy()
+    sopen = lane.slot_open.copy()
+    M, Sn = plane.shape
+    B = lane.bits
+    L = np.arange(M, dtype=np.int64)
+    viol = -1
+    for idx in range(lane.n_events):
+        k = int(lane.ev_kind[idx])
+        if k == EV_PAD:
+            continue
+        j = int(lane.ev_slot[idx])
+        if k == EV_INVOKE:
+            snext[j] = lane.ev_next[idx]
+            slegal[j] = lane.ev_legal[idx]
+            sopen[j] = True
+        elif k == EV_RETURN:
+            bit = 1 << j
+            plane = np.where(((L & bit) != 0)[:, None], False,
+                             plane[L | bit])
+            sopen[j] = False
+        elif k == EV_CANCEL:
+            bit = 1 << j
+            plane = np.where(((L & bit) != 0)[:, None], False,
+                             plane | plane[L | bit])
+            sopen[j] = False
+        changed = True
+        while changed:                  # true fixpoint (== B rounds)
+            changed = False
+            for jj in range(B):
+                if not sopen[jj]:
+                    continue
+                bitj = 1 << jj
+                nob = (L & bitj) == 0
+                src = plane & nob[:, None]
+                if not src.any():
+                    continue
+                P = np.zeros((Sn, Sn), np.int32)
+                legal = np.asarray(slegal[jj], bool)
+                P[np.arange(Sn)[legal],
+                  np.asarray(snext[jj], np.int64)[legal]] = 1
+                moved = (src.astype(np.int32) @ P) > 0
+                add = np.zeros_like(plane)
+                add[~nob] = moved[L[~nob] ^ bitj]
+                new = plane | add
+                if (new != plane).any():
+                    plane = new
+                    changed = True
+        if viol < 0 and not plane.any():
+            viol = idx
+    return plane, sopen, viol
+
+
+# ---------------------------------------------------------------------------
+# The batch entry point
+# ---------------------------------------------------------------------------
+
+def check_batch(lanes: list, *, backend: str = "auto",
+                dispatches: Optional[list] = None) -> list:
+    """Check every lane's window; lanes are grouped into shape buckets
+    and each bucket is ONE device dispatch (or one host pass).
+
+    Returns one verdict dict per lane, in order:
+        {"valid?": True|False, "violated_event": int (-1 if clean),
+         "plane": bool [M, n_states], "slot_open": bool [B],
+         "engine": "live-jit"|"live-host", "cache": "hit"|"miss"}
+
+    `dispatches`, when given, collects one metadata dict per bucket
+    dispatch: {"bucket": (T_pad, E_pad, M, Sn_pad), "lanes": n,
+    "engine": ..., "cache": ..., "seconds": wall} — the scheduler turns
+    these into the inspectable dispatch records on /live pages.
+
+    backend: "device" raises on any device failure (the
+    ResilientRunner bisects/degrades around it); "host" is the numpy
+    oracle; "auto" tries device and falls back to host."""
+    if backend == "auto":
+        try:
+            return check_batch(lanes, backend="device",
+                               dispatches=dispatches)
+        except Exception:   # noqa: BLE001 - host path must be total
+            return check_batch(lanes, backend="host",
+                               dispatches=dispatches)
+
+    results: list = [None] * len(lanes)
+    # bucket by (plane rows, padded events, padded states).  The event
+    # floor is deliberately coarse (64): a trickling tenant's tiny
+    # windows pay some pad-scan cost but land in the SAME bucket as a
+    # backlogged tenant's full windows — one compiled plan, one shared
+    # dispatch, instead of a bucket per window size.
+    groups: dict = {}
+    for i, ln in enumerate(lanes):
+        key = (int(ln.plane.shape[0]), _pow2(max(ln.n_events, 1), 64),
+               _pow2(max(ln.n_states, 1), 8))
+        groups.setdefault(key, []).append(i)
+
+    for (M, E, Sn), idxs in sorted(groups.items()):
+        t0 = time.monotonic()
+        di = len(dispatches) if dispatches is not None else -1
+        if backend == "host":
+            cache = "n/a"
+            for i in idxs:
+                plane, sopen, viol = _check_lane_host(lanes[i])
+                results[i] = _verdict(plane, sopen, viol, "live-host",
+                                      cache)
+        else:
+            T = _pow2(len(idxs), 1)
+            B = M.bit_length() - 1
+            stack = _stack(lanes, idxs, T, E, M, Sn, B)
+            fn, hit = _compiled(T, E, M, Sn)
+            cache = "hit" if hit else "miss"
+            plane_o, sopen_o, viol_o = fn(*stack)
+            plane_o = np.asarray(plane_o)
+            sopen_o = np.asarray(sopen_o)
+            viol_o = np.asarray(viol_o)
+            for t, i in enumerate(idxs):
+                ln = lanes[i]
+                results[i] = _verdict(
+                    plane_o[t][:, :ln.n_states], sopen_o[t],
+                    int(viol_o[t]), "live-jit", cache)
+        if dispatches is not None:
+            for i in idxs:
+                results[i]["dispatch_index"] = di
+            dispatches.append({
+                "bucket": [len(idxs) if backend == "host"
+                           else _pow2(len(idxs), 1), E, M, Sn],
+                "lanes": len(idxs),
+                "engine": ("live-host" if backend == "host"
+                           else "live-jit"),
+                "cache": cache,
+                "seconds": round(time.monotonic() - t0, 6)})
+    return results
+
+
+def _verdict(plane, sopen, viol: int, engine: str, cache: str) -> dict:
+    return {"valid?": viol < 0, "violated_event": int(viol),
+            "plane": np.asarray(plane, bool),
+            "slot_open": np.asarray(sopen, bool),
+            "engine": engine, "cache": cache}
+
+
+def _stack(lanes, idxs, T, E, M, Sn, B):
+    """Pad each lane to the bucket shape and stack into [T, ...] device
+    inputs.  Pad lanes (beyond len(idxs)) are all-zero: kind-0 events
+    never flag, an empty plane stays empty."""
+    plane = np.zeros((T, M, Sn), bool)
+    snext = np.zeros((T, B, Sn), np.int32)
+    slegal = np.zeros((T, B, Sn), bool)
+    sopen = np.zeros((T, B), bool)
+    evk = np.zeros((T, E), np.int32)
+    evs = np.zeros((T, E), np.int32)
+    evn = np.zeros((T, E, Sn), np.int32)
+    evl = np.zeros((T, E, Sn), bool)
+    for t, i in enumerate(idxs):
+        ln = lanes[i]
+        ns, ne = ln.n_states, ln.n_events
+        plane[t, :, :ns] = ln.plane
+        snext[t, :, :ns] = ln.slot_next
+        slegal[t, :, :ns] = ln.slot_legal
+        sopen[t] = ln.slot_open
+        evk[t, :ne] = ln.ev_kind
+        evs[t, :ne] = ln.ev_slot
+        evn[t, :ne, :ns] = ln.ev_next
+        evl[t, :ne, :ns] = ln.ev_legal
+    return plane, snext, slegal, sopen, evk, evs, evn, evl

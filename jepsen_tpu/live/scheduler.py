@@ -1,0 +1,433 @@
+"""Multi-tenant live-checking scheduler.
+
+One `tick()` is the whole pipeline, driven synchronously so tests and
+the daemon share the exact same code path:
+
+  1. **discover** — scan the store root for run dirs carrying a
+     `history.wal` and adopt them as tenants (model resolved from the
+     run's `test.json` `model` key when present, else the service
+     default);
+  2. **ingest** — advance each unpaused tenant's WAL cursor
+     (`history.follow`, bounded records per tick) and feed the ops
+     through its lanes; a tenant whose tracked bytes exceed the budget
+     is *paused* (backpressure: the WAL is on disk, nothing is lost)
+     until dispatching drains it below the low-water mark;
+  3. **dispatch** — take at most one ready window per lane across ALL
+     tenants and check them as shape-bucketed micro-batches through
+     `ops/runner.ResilientRunner` (device OOM bisects the lane batch,
+     a poisoned lane quarantines alone, a blown deadline degrades the
+     rest of the tick to the numpy host engine via `cpu_fallback`);
+  4. **account** — fold verdicts back into lanes, emit `live-flag` /
+     `live-dispatch` / `live-window` events into each tenant's
+     `live.jsonl` (telemetry.EventLog framing), refresh the per-run
+     `live.json` snapshot (atomic replace — web.py renders it), and
+     update the Prometheus gauges (`live_detection_lag_seconds`,
+     `live_window_queue_depth{tenant=}`, docs/observability.md).
+
+Detection lag is measured from the WAL append wall stamp (`w` field,
+history.follow) to the flag emission — true op-append→flag latency
+when checker and run share a clock; `live_window_lag_seconds` tracks
+the same quantity for every checked window (clean ones included), and
+its p99 is the bench.py headline for the service.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+from jepsen_tpu import history as history_mod
+from jepsen_tpu import models as models_mod
+from jepsen_tpu import telemetry
+from jepsen_tpu.live import engine as engine_mod
+from jepsen_tpu.live.windows import Tenant
+from jepsen_tpu.ops.runner import ResilientRunner
+
+log = logging.getLogger("jepsen.live")
+
+# Detection-lag histogram buckets: sub-ms through tens of seconds.
+LAG_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _default_model(name: Optional[str]):
+    name = name or "cas-register"
+    ctor = models_mod.MODELS.get(name)
+    if ctor is None:
+        raise ValueError(f"unknown live model {name!r}; one of "
+                         f"{sorted(models_mod.MODELS)}")
+    return ctor()
+
+
+class LiveScheduler:
+    """The tick-driven scheduling core (no threads of its own — the
+    CheckerService wraps it in a loop)."""
+
+    def __init__(self, root, *, model: Optional[str] = None,
+                 backend: str = "auto",
+                 bits: int = 6, max_states: int = 64,
+                 max_window_events: int = 256,
+                 max_buffer_entries: int = 4096,
+                 wild_init: Optional[bool] = None,
+                 tenant_budget_bytes: int = 4 << 20,
+                 max_batch_records: int = 4096,
+                 deadline_s: Optional[float] = None,
+                 scan_every: int = 10,
+                 clock=time.time):
+        self.root = Path(root)
+        self.default_model = model
+        self.backend_opt = backend
+        self.backend: Optional[str] = None if backend == "auto" \
+            else backend
+        self.lane_opts = dict(bits=bits, max_states=max_states,
+                              max_window_events=max_window_events,
+                              max_buffer_entries=max_buffer_entries,
+                              wild_init=wild_init)
+        self.tenant_budget_bytes = tenant_budget_bytes
+        self.max_batch_records = max_batch_records
+        self.deadline_s = deadline_s
+        self.scan_every = max(1, scan_every)
+        self.clock = clock
+        self.tenants: dict = {}        # (name, ts) -> Tenant
+        self.finished: set = set()
+        self._logs: dict = {}          # (name, ts) -> EventLog
+        self._tick_n = 0
+        self._dispatch_seq = 0
+        self.flags_total = 0
+        self.last_detection_lag_s: Optional[float] = None
+
+    # -- backend resolution --------------------------------------------------
+
+    def resolve_backend(self) -> str:
+        """Probe the device path once; a host without a usable jax
+        backend degrades the whole service to the numpy engine with a
+        logged note (no per-dispatch thrash)."""
+        if self.backend is None:
+            try:
+                probe = _probe_lane()
+                engine_mod.check_batch([probe], backend="device")
+                self.backend = "device"
+            except Exception as e:  # noqa: BLE001 - resolve to host
+                log.warning("live device path unavailable (%s); "
+                            "serving from the numpy host engine", e)
+                self.backend = "host"
+        return self.backend
+
+    # -- discovery -----------------------------------------------------------
+
+    def discover(self) -> int:
+        """Adopt new run dirs under the root.  Returns tenants added."""
+        added = 0
+        if not self.root.is_dir():
+            return 0
+        for name_dir in sorted(self.root.iterdir()):
+            if not name_dir.is_dir() or name_dir.is_symlink() \
+                    or name_dir.name in ("ci", "current", "latest"):
+                continue
+            for ts_dir in sorted(p for p in name_dir.iterdir()
+                                 if p.is_dir()
+                                 and not p.is_symlink()):
+                key = (name_dir.name, ts_dir.name)
+                if key in self.tenants or key in self.finished:
+                    continue
+                if not (ts_dir / "history.wal").exists():
+                    continue
+                self.tenants[key] = Tenant(
+                    name_dir.name, ts_dir.name, ts_dir,
+                    self._model_for(ts_dir), **self.lane_opts)
+                self._logs[key] = telemetry.EventLog(
+                    ts_dir / "live.jsonl")
+                self._emit(key, "live-adopt", durable=True,
+                           model=type(self.tenants[key].model).__name__)
+                added += 1
+        return added
+
+    def _model_for(self, run_dir: Path):
+        try:
+            with open(run_dir / "test.json") as f:
+                name = json.load(f).get("model")
+        except Exception:  # noqa: BLE001 - absent/partial test.json
+            name = None
+        try:
+            return _default_model(name if isinstance(name, str)
+                                  else self.default_model)
+        except ValueError:
+            return _default_model(self.default_model)
+
+    # -- events --------------------------------------------------------------
+
+    def _emit(self, key, type_: str, durable: bool = False,
+              **fields) -> None:
+        lg = self._logs.get(key)
+        if lg is not None:
+            lg.append({"type": type_, **fields}, durable=durable)
+
+    # -- ingest --------------------------------------------------------------
+
+    def _ingest(self, key, t: Tenant) -> None:
+        if t.corrupt or t.done:
+            return
+        # backpressure: over budget -> stop reading (the cursor simply
+        # does not advance; disk holds the backlog); resume below the
+        # half-budget low-water mark
+        nbytes = t.nbytes
+        if t.paused:
+            if nbytes <= self.tenant_budget_bytes // 2:
+                t.paused = False
+                self._emit(key, "live-resume", durable=True,
+                           bytes=nbytes)
+            else:
+                return
+        elif nbytes > self.tenant_budget_bytes:
+            t.paused = True
+            telemetry.REGISTRY.counter(
+                "live_backpressure_total").inc()
+            self._emit(key, "live-backpressure", durable=True,
+                       bytes=nbytes,
+                       budget=self.tenant_budget_bytes)
+            return
+        wal = t.run_dir / "history.wal"
+        try:
+            seg = history_mod.follow(wal, t.offset, t.seq,
+                                     max_records=self.max_batch_records)
+        except OSError as e:
+            t.corrupt = f"wal unreadable: {e}"
+            return
+        if seg.ops:
+            now = self.clock()
+            walls = [w if w is not None else now for w in seg.walls]
+            t.ingest(seg.ops, walls)
+            t.offset, t.seq = seg.offset, seg.seq
+            telemetry.REGISTRY.counter(
+                "live_ops_ingested_total").inc(len(seg.ops))
+        if seg.corrupt:
+            t.corrupt = seg.stop_reason
+            self._emit(key, "live-corrupt", durable=True,
+                       reason=seg.stop_reason)
+        elif not seg.ops and seg.tail_bytes == 0 \
+                and (t.run_dir / "results.json").exists():
+            # run analyzed + nothing left to read: the tenant is done
+            # once its queued windows drain
+            t.done = True
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _collect(self) -> list:
+        items = []
+        for key, t in self.tenants.items():
+            for lane_key, lane in t.lanes.items():
+                w = lane.take_window()
+                if w is not None:
+                    w.lane_key = lane_key
+                    items.append((key, lane_key, lane, w))
+        return items
+
+    def _dispatch(self, items: list) -> None:
+        backend = self.resolve_backend()
+        dispatches: list = []
+
+        def live_engine(_model, lane_dispatches):
+            return engine_mod.check_batch(
+                list(lane_dispatches), backend=backend,
+                dispatches=dispatches)
+
+        def live_host(_model, lane_dispatch, time_limit=None):
+            return engine_mod.check_batch(
+                [lane_dispatch], backend="host",
+                dispatches=dispatches)[0]
+
+        live_host.__name__ = "live-host"
+        runner = ResilientRunner(engine=live_engine,
+                                 cpu_fallback=live_host,
+                                 deadline_s=self.deadline_s,
+                                 max_group=64)
+        verdicts = runner.check(None,
+                                [w.dispatch for (_k, _lk, _ln, w)
+                                 in items])
+
+        # one global id per bucket dispatch; every participating
+        # tenant journals it, so cross-tenant sharing is auditable
+        ids = {}
+        for di, d in enumerate(dispatches):
+            self._dispatch_seq += 1
+            d["id"] = ids[di] = f"d{self._dispatch_seq}"
+            d["tenants"] = sorted({f"{k[0]}/{k[1]}"
+                                   for (k, _lk, _ln, w), v
+                                   in zip(items, verdicts)
+                                   if isinstance(v, dict)
+                                   and v.get("dispatch_index") == di})
+            rec = telemetry.dispatch_record(
+                d["engine"], why="live window micro-batch",
+                cache=d["cache"], lanes=d["lanes"],
+                bucket=d["bucket"], dispatch_id=d["id"],
+                tenants=len(d["tenants"]))
+            telemetry.attach_dispatch([], rec)
+        seen_pairs = set()
+        now = self.clock()
+        for (key, lane_key, lane, w), v in zip(items, verdicts):
+            if not isinstance(v, dict):
+                continue
+            if v.get("quarantined"):
+                lane.saturated = ("live checking quarantined: "
+                                  + str(v.get("error") or v.get("why")
+                                        or "engine failure"))
+                self._emit(key, "live-quarantine", durable=True,
+                           lane=repr(lane_key),
+                           error=str(v.get("error"))[:200])
+                continue
+            di = v.get("dispatch_index", -1)
+            disp = dispatches[di] if 0 <= di < len(dispatches) else {}
+            if (key, di) not in seen_pairs and disp:
+                seen_pairs.add((key, di))
+                self._emit(key, "live-dispatch",
+                           dispatch_id=disp.get("id"),
+                           engine=disp.get("engine"),
+                           cache=disp.get("cache"),
+                           lanes=disp.get("lanes"),
+                           tenants=disp.get("tenants"),
+                           bucket=disp.get("bucket"),
+                           seconds=disp.get("seconds"))
+            flag = lane.apply_result(w, v)
+            lag = (now - w.last_wall) if w.last_wall else None
+            if lag is not None:
+                telemetry.REGISTRY.histogram(
+                    "live_window_lag_seconds",
+                    buckets=LAG_BUCKETS_S).observe(lag)
+            self._emit(key, "live-window",
+                       lane=repr(lane_key), ops=w.n_ops,
+                       events=int(w.dispatch.n_events),
+                       valid=bool(v.get("valid?")),
+                       lag_s=round(lag, 6) if lag is not None
+                       else None)
+            if flag is not None:
+                det = (now - flag["wall"]) if flag.get("wall") \
+                    else lag
+                self.flags_total += 1
+                self.last_detection_lag_s = det
+                telemetry.REGISTRY.counter("live_flags_total").inc()
+                if det is not None:
+                    telemetry.REGISTRY.gauge(
+                        "live_detection_lag_seconds").set(det)
+                    telemetry.REGISTRY.histogram(
+                        "live_detection_lag_histogram_seconds",
+                        buckets=LAG_BUCKETS_S).observe(det)
+                self._emit(key, "live-flag", durable=True,
+                           lane=repr(lane_key),
+                           op_index=flag.get("op_index"),
+                           f=flag.get("f"),
+                           value=flag.get("value"),
+                           event=flag.get("event"),
+                           detection_lag_s=round(det, 6)
+                           if det is not None else None,
+                           dispatch_id=disp.get("id"),
+                           engine=v.get("engine"),
+                           cache=v.get("cache"))
+
+    # -- snapshots -----------------------------------------------------------
+
+    def _write_live_json(self, key, t: Tenant) -> None:
+        stats = t.stats()
+        stats.update({
+            "backend": self.backend or self.backend_opt,
+            "plan_cache": engine_mod.plan_cache_stats(),
+            "budget_bytes": self.tenant_budget_bytes,
+            "updated": round(self.clock(), 3),
+        })
+        # flags rendered with their journaled detection lag
+        path = t.run_dir / "live.json"
+        tmp = t.run_dir / ".live.json.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(stats, f, indent=2, default=repr)
+            os.replace(tmp, path)
+        except OSError:
+            log.debug("live.json write failed for %s", key,
+                      exc_info=True)
+
+    def _gauges(self) -> None:
+        for (name, ts), t in self.tenants.items():
+            label = f"{name}/{ts}"
+            telemetry.REGISTRY.gauge("live_window_queue_depth",
+                                     tenant=label).set(t.queue_depth)
+            telemetry.REGISTRY.gauge("live_tenant_bytes",
+                                     tenant=label).set(t.nbytes)
+
+    # -- the tick ------------------------------------------------------------
+
+    def tick(self) -> dict:
+        if self._tick_n % self.scan_every == 0:
+            self.discover()
+        self._tick_n += 1
+        for key, t in list(self.tenants.items()):
+            self._ingest(key, t)
+        items = self._collect()
+        if items:
+            self._dispatch(items)
+        # snapshot + finalize
+        for key, t in list(self.tenants.items()):
+            self._write_live_json(key, t)
+            if t.done and t.queue_depth == 0:
+                self._emit(key, "live-done", durable=True,
+                           **{"verdict-so-far":
+                              t.stats()["verdict-so-far"]})
+                lg = self._logs.pop(key, None)
+                if lg is not None:
+                    lg.close()
+                self.finished.add(key)
+                del self.tenants[key]
+        self._gauges()
+        return {"tenants": len(self.tenants),
+                "finished": len(self.finished),
+                "windows": len(items),
+                "flags_total": self.flags_total}
+
+    def drain(self, max_ticks: int = 10_000) -> int:
+        """Tick until no new bytes, no ready windows, and no queued
+        chunks remain (the `--once` path and the test harness).
+        Returns ticks used."""
+        for n in range(1, max_ticks + 1):
+            stats = self.tick()
+            busy = (stats["windows"] > 0 or self._has_new_bytes()
+                    or any(t.queue_depth
+                           for t in self.tenants.values()))
+            if not busy:
+                return n
+        return max_ticks
+
+    def _has_new_bytes(self) -> bool:
+        for t in self.tenants.values():
+            if t.corrupt or t.done:
+                continue               # the cursor will never advance
+            try:
+                if (t.run_dir / "history.wal").stat().st_size \
+                        > t.offset:
+                    return True
+            except OSError:
+                continue
+        return False
+
+    def close(self) -> None:
+        for lg in self._logs.values():
+            lg.close()
+        self._logs.clear()
+
+
+def _probe_lane():
+    """A minimal one-event lane for the device probe."""
+    import numpy as np
+    from jepsen_tpu.live.engine import LaneDispatch
+    plane = np.zeros((2, 2), bool)
+    plane[0, 0] = True
+    return LaneDispatch(
+        plane=plane,
+        slot_next=np.zeros((1, 2), np.int32),
+        slot_legal=np.zeros((1, 2), bool),
+        slot_open=np.zeros(1, bool),
+        ev_kind=np.zeros(1, np.int32),
+        ev_slot=np.zeros(1, np.int32),
+        ev_next=np.zeros((1, 2), np.int32),
+        ev_legal=np.zeros((1, 2), bool))

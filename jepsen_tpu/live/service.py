@@ -1,0 +1,105 @@
+"""The checker daemon: a LiveScheduler in a poll loop.
+
+`python -m jepsen_tpu.cli serve-checker <store-root>` builds one of
+these; tests and bench drive `tick()` / `drain()` directly so the
+daemon loop and the deterministic path are the same code.
+
+With `web_port`, the same process serves the dashboard (web.py) — so
+`/live/<name>/<ts>` pages render the snapshots this service writes and
+`/metrics` exposes its `live_*` gauges (a separate dashboard process
+would only see the on-disk `live.json`, not the process-local
+registry)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from jepsen_tpu.live.scheduler import LiveScheduler
+
+log = logging.getLogger("jepsen.live")
+
+
+class CheckerService:
+    def __init__(self, root, *, poll_interval: float = 0.05,
+                 web_port: Optional[int] = None,
+                 web_host: str = "0.0.0.0", **scheduler_opts):
+        self.scheduler = LiveScheduler(root, **scheduler_opts)
+        self.poll_interval = poll_interval
+        self.web_port = web_port
+        self.web_host = web_host
+        self._web_srv = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- deterministic surface (tests / bench) -------------------------------
+
+    def tick(self) -> dict:
+        return self.scheduler.tick()
+
+    def drain(self, max_ticks: int = 10_000) -> int:
+        return self.scheduler.drain(max_ticks)
+
+    # -- the daemon ----------------------------------------------------------
+
+    def _maybe_serve_web(self):
+        if self.web_port is None:
+            return
+        from jepsen_tpu import store, web
+        # the dashboard renders the followed root, not the cwd store
+        store.BASE = self.scheduler.root
+        self._web_srv = web.serve(host=self.web_host,
+                                  port=self.web_port, block=False)
+        log.info("live dashboard on http://%s:%s/live", self.web_host,
+                 self._web_srv.server_address[1])
+
+    def run(self) -> None:
+        """Blocking daemon loop (the serve-checker foreground path)."""
+        self._maybe_serve_web()
+        backend = self.scheduler.resolve_backend()
+        log.info("live checker serving %s (engine backend: %s)",
+                 self.scheduler.root, backend)
+        try:
+            while not self._stop.is_set():
+                stats = self.tick()
+                if stats["tenants"] == 0 and stats["windows"] == 0:
+                    self._stop.wait(max(self.poll_interval, 0.2))
+                else:
+                    self._stop.wait(self.poll_interval)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.close()
+
+    def start(self) -> "CheckerService":
+        """Background thread (tests / bench feeders run alongside)."""
+        self._maybe_serve_web()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - the daemon must survive
+                log.warning("live tick failed", exc_info=True)
+            self._stop.wait(self.poll_interval)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self.close()
+
+    def close(self) -> None:
+        self.scheduler.close()
+        if self._web_srv is not None:
+            try:
+                self._web_srv.shutdown()
+                self._web_srv.server_close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._web_srv = None

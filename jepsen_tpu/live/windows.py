@@ -1,0 +1,749 @@
+"""Per-tenant incremental checker state: from a WAL op stream to
+checkable windows.
+
+A **tenant** is one followed run.  Its op stream is paired
+(invoke↔completion by process), demultiplexed into per-key **lanes**
+(`independent.KV`-valued ops check per-key linearizability, exactly
+like `independent.batch_checker`), and buffered per lane in real-time
+order.  Windows are cut from the buffered stream and checked through
+the configuration plane (live/engine.py); the plane is the ONLY
+cross-window state, so memory per lane is O(2^B · Sn) regardless of
+history length.
+
+**Cuts do not require quiescence.**  The lane prefers quiescent seals
+(no open op ⇒ the window is exact), but a busy workload may never go
+quiescent — then the buffer is force-sealed and ops *span* the cut:
+their invoke event is dispatched with a persistent slot that stays
+open in the plane, and the completion, arriving in a later window,
+resolves it:
+
+  * `ok`     → a return event on the carried slot (exact for writes
+               and cas, whose payload rides the invoke; a read whose
+               value was unknown at dispatch is checked unconstrained
+               — counted in `span_reads`, the price of a forced cut);
+  * `fail`   → a cancel event (`EV_CANCEL`): the op never happened;
+               both its speculative branches merge bit-less, which
+               can only widen the config set (lenient, no false flag);
+  * `info`   → the slot converts to **residue**: permanently open, its
+               transition table rebuilt against every later window,
+               so "applied at some point" and "never applied" are both
+               tracked.
+
+Completion semantics otherwise follow the post-hoc checkers exactly:
+`ok` constrains (invoke values back-filled from completions while the
+entry is still un-dispatched — History.complete semantics), `fail` is
+dropped, indeterminate reads are dropped, indeterminate mutations
+become residue.
+
+A lane's **initial frontier defaults to the wildcard** ("any initial
+value", revealed by the first constrained read) for register-family
+models: a daemon tailing arbitrary runs cannot know what state setup
+left in the SUT, and a wrong assumed init would false-flag legal
+histories.  `wild_init=False` restores the model's own initial state.
+
+Bounded memory is a hard guarantee, in two tiers: the scheduler stops
+reading a tenant's cursor past its byte budget (backpressure — the WAL
+is on disk, nothing is lost), and a lane that cannot stay exact within
+its slot/state budgets — window concurrency beyond its B bits, state
+table past its cap — is **evicted**: the offending stretch is dropped
+unchecked and the frontier *widens* to the wildcard, sound by
+over-approximation (violations inside the gap can be missed; a clean
+history can never be flagged).  Residue survives both widening and
+eviction.  Models without wildcard semantics (outside the register
+family) saturate instead: live checking stops with a recorded reason
+and the post-hoc verdict stays authoritative.  Every degradation is
+counted and surfaced — never silent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+from jepsen_tpu import models as models_mod
+from jepsen_tpu.history import FAIL, INFO, INVOKE, OK, Op
+from jepsen_tpu.live.engine import (EV_CANCEL, EV_INVOKE, EV_RETURN,
+                                    LaneDispatch)
+
+# Host-side cost model for the byte budget: one buffered/sealed entry
+# (a small dict) plus its share of index structures.
+ENTRY_COST_B = 96
+
+_MISSING = object()
+
+
+class _Wild:
+    """The wildcard model state: 'any value possible' after an
+    unchecked gap (or at init, when the SUT's start state is
+    unknown)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "WILD"
+
+
+WILD = _Wild()
+
+
+def wildcard_supported(model) -> bool:
+    """Wildcard transitions are defined for the value-register family
+    (state == last written value), which covers every register suite
+    and the kvd workload."""
+    return isinstance(model, (models_mod.Register,
+                              models_mod.CASRegister))
+
+
+def _wild_apply(model0, f, val):
+    """step(WILD, op): the state after an op applied to an unknown
+    register value.  Reads *reveal* the value; writes/cas determine
+    it.  Returns None when the op cannot apply from any state."""
+    cls = type(model0)
+    if f == "read":
+        return cls(val) if val is not None else WILD
+    if f == "write":
+        return cls(val)
+    if f == "cas" and isinstance(val, (list, tuple)) and len(val) == 2:
+        return cls(val[1])
+    return None
+
+
+def _vkey(val):
+    return tuple(val) if isinstance(val, list) else val
+
+
+@dataclasses.dataclass
+class Window:
+    """One checkable window for one lane: the engine inputs plus the
+    host-side mapping back to ops (for flag reporting and lag)."""
+
+    lane_key: Any
+    dispatch: LaneDispatch
+    op_refs: list                     # per event: dict
+    n_ops: int
+    first_wall: Optional[float]
+    last_wall: Optional[float]
+
+
+class LaneState:
+    """Incremental checker state for one (tenant, key) lane."""
+
+    def __init__(self, model, *, bits: int = 6, max_states: int = 64,
+                 max_window_events: int = 256,
+                 max_buffer_entries: int = 4096,
+                 wild_init: Optional[bool] = None):
+        self.model0 = model
+        self.bits = bits
+        self.M = 1 << bits
+        self.max_states = max_states
+        self.max_window_events = max_window_events
+        self.max_buffer_entries = max_buffer_entries
+        if wild_init is None:
+            wild_init = wildcard_supported(model)
+        init = WILD if (wild_init and wildcard_supported(model)) \
+            else model
+        self.states: list = [init]
+        self.state_idx: dict = {init: 0}
+        self.plane = np.zeros((self.M, 1), bool)
+        self.plane[0, 0] = True
+        self._table_cache: dict = {}
+        # slots: transient (freed at return/cancel), span (carried
+        # across a forced cut until the completion arrives), residue
+        # (info mutations: open forever)
+        self.free_slots = list(range(self.bits - 1, -1, -1))
+        self.span_slot: dict = {}     # process -> carried open slot
+        self.span_payload: dict = {}  # process -> (f, val)
+        self.residue: dict = {}       # slot -> (f, val, op_index)
+        # real-time buffers
+        self.buffer: list = []        # entry dicts since the last cut
+        self.open_refs: dict = {}     # process -> entry
+        self.open_in_buffer = 0
+        self.gen = 0                  # bumped at every seal
+        self.sealed: list = []        # chunks awaiting windowing
+        self.orphans: dict = {}       # process -> f (open at eviction)
+        # accounting / verdict
+        self.ops_seen = 0
+        self.windows_checked = 0
+        self.evictions = 0
+        self.evict_reasons: list = []  # last few, for live.json
+        self.span_reads = 0           # reads checked unconstrained
+        self.flags: list = []
+        self.saturated: Optional[str] = None
+
+    # -- memory accounting --------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.sealed)
+
+    @property
+    def nbytes(self) -> int:
+        n_entries = len(self.buffer) + sum(len(c["entries"])
+                                           for c in self.sealed)
+        return n_entries * ENTRY_COST_B + self.plane.nbytes
+
+    # -- ingest --------------------------------------------------------------
+
+    def on_invoke(self, process, f, val, op_index, wall) -> None:
+        if self.saturated:
+            return
+        entry = {"kind": "inv", "p": process, "f": f, "val": val,
+                 "idx": op_index, "wall": wall, "comp_idx": None,
+                 "slot": None, "gen": self.gen, "built": False}
+        self.buffer.append(entry)
+        self.open_refs[process] = entry
+        self.open_in_buffer += 1
+        if len(self.buffer) >= self.max_buffer_entries:
+            self._seal()               # forced cut: ops span it
+
+    def on_complete(self, process, outcome, comp_val, op_index,
+                    wall) -> None:
+        if self.saturated:
+            return
+        entry = self.open_refs.pop(process, None)
+        if entry is None:
+            # completion of an op dropped by an eviction: a mutation
+            # may have applied anywhere inside or after the gap —
+            # re-widen so the frontier covers it (reads constrain
+            # nothing and are ignored)
+            f = self.orphans.pop(process, None)
+            if f is not None and f != "read" and outcome != FAIL:
+                self._evict(f"orphan {outcome} {f} completion after "
+                            "eviction")
+            return
+        if entry["gen"] == self.gen:
+            self.open_in_buffer -= 1
+        self.ops_seen += 1
+        if entry["built"]:
+            # the invoke is already dispatched on a carried slot
+            if outcome == FAIL or (outcome == INFO
+                                   and entry["f"] == "read"):
+                self.buffer.append({"kind": "cancel", "p": process,
+                                    "f": entry["f"],
+                                    "val": entry["val"],
+                                    "idx": op_index, "wall": wall})
+            elif outcome == INFO:
+                j = self.span_slot.pop(process, None)
+                self.span_payload.pop(process, None)
+                if j is not None:
+                    self.residue[j] = (entry["f"], entry["val"],
+                                       entry["idx"])
+            else:
+                if entry["f"] == "read" and entry["val"] is None:
+                    self.span_reads += 1   # checked unconstrained
+                self.buffer.append({"kind": "ret", "p": process,
+                                    "f": entry["f"],
+                                    "val": entry["val"],
+                                    "idx": op_index, "wall": wall})
+        else:
+            if outcome == FAIL or (outcome == INFO
+                                   and entry["f"] == "read"):
+                entry["kind"] = "drop"
+            elif outcome == INFO:
+                entry["kind"] = "info"
+            else:                      # ok: back-fill observed value
+                if entry["val"] is None:
+                    entry["val"] = comp_val
+                entry["comp_idx"] = op_index
+                self.buffer.append({"kind": "ret", "p": process,
+                                    "f": entry["f"],
+                                    "val": entry["val"],
+                                    "idx": op_index, "wall": wall})
+        if self.open_in_buffer == 0 and self.buffer:
+            self._seal()               # quiescent cut: exact
+
+    def _seal(self) -> None:
+        if not self.buffer:
+            return
+        self.sealed.append({"entries": self.buffer})
+        self.buffer = []
+        self.gen += 1
+        self.open_in_buffer = 0
+
+    # -- eviction / widening -------------------------------------------------
+
+    def _evict(self, reason: str, count: bool = True) -> None:
+        """Widen the frontier to the wildcard state (register family)
+        or saturate (other models), dropping the un-sealed buffer.
+        Sealed chunks survive (checking them from the widened frontier
+        is merely lenient), as does residue.  Open and spanning ops
+        become orphans: their completions, when they arrive, re-widen
+        if they could have mutated state."""
+        for p, e in self.open_refs.items():
+            self.orphans[p] = e["f"]
+        for p in self.span_slot:
+            self.orphans.setdefault(p, "write")   # conservative
+        self.open_refs = {}
+        self.span_slot = {}
+        self.span_payload = {}
+        self.open_in_buffer = 0
+        self.buffer = []
+        self.free_slots = [j for j in range(self.bits - 1, -1, -1)
+                           if j not in self.residue]
+        if not wildcard_supported(self.model0):
+            self.saturated = f"live checking saturated: {reason}"
+            self.sealed = []
+            return
+        if WILD not in self.state_idx:
+            if len(self.states) >= self.max_states:
+                self._compact_states()     # dead states make room
+            if len(self.states) >= self.max_states:
+                self.saturated = ("live checking saturated: state "
+                                  "table full at widening")
+                self.sealed = []
+                return
+            self.state_idx[WILD] = len(self.states)
+            self.states.append(WILD)
+            self._table_cache.clear()
+            self._grow_plane()
+        if count:
+            self.evictions += 1
+            if len(self.evict_reasons) < 20:
+                self.evict_reasons.append(reason)
+        self.plane[:] = False
+        self.plane[0, self.state_idx[WILD]] = True
+
+    def _grow_plane(self) -> None:
+        want = len(self.states)
+        have = self.plane.shape[1]
+        if have < want:
+            self.plane = np.hstack(
+                [self.plane, np.zeros((self.M, want - have), bool)])
+
+    # -- state table ---------------------------------------------------------
+
+    def _apply(self, state, f, val):
+        if state is WILD:
+            return _wild_apply(self.model0, f, val)
+        ns = state.step(Op(process=0, type=OK, f=f, value=val))
+        return None if models_mod.is_inconsistent(ns) else ns
+
+    def _compact_states(self) -> None:
+        """Garbage-collect the state table.  Only states live in the
+        current plane frontier can influence any future verdict (every
+        window re-enumerates its own transition targets), so dead
+        columns are dropped and the table re-indexed.  This is what
+        keeps a long-running tenant bounded when its value domain
+        grows without end (counters, timestamps, monotonic ids): the
+        frontier stays small even though the history writes millions
+        of distinct values."""
+        live = np.flatnonzero(self.plane.any(axis=0)).tolist()
+        if len(live) >= len(self.states):
+            return
+        new_states = [self.states[c] for c in live]
+        if not new_states:
+            new_states = [self.states[0]]
+            live = [0]
+        new_plane = np.zeros((self.M, len(new_states)), bool)
+        for ni, c in enumerate(live):
+            new_plane[:, ni] = self.plane[:, c]
+        self.states = new_states
+        self.state_idx = {s: i for i, s in enumerate(new_states)}
+        self.plane = new_plane
+        self._table_cache.clear()
+
+    def _ensure_states(self, uops: list) -> bool:
+        """Close the state table under the window's micro-ops (and the
+        standing residue), compacting dead states when the cap is hit.
+        False only when the LIVE frontier itself exceeds the cap."""
+        all_uops = list(uops) + [(f, v) for (f, v, _i)
+                                 in self.residue.values()]
+        for attempt in (0, 1):
+            overflow = False
+            changed = True
+            while changed and not overflow:
+                changed = False
+                for f, val in all_uops:
+                    for s in list(self.states):
+                        ns = self._apply(s, f, val)
+                        if ns is None or ns in self.state_idx:
+                            continue
+                        if len(self.states) >= self.max_states:
+                            overflow = True
+                            break
+                        self.state_idx[ns] = len(self.states)
+                        self.states.append(ns)
+                        changed = True
+                    if overflow:
+                        break
+            if not overflow:
+                self._grow_plane()
+                return True
+            if attempt == 0:
+                self._compact_states()
+        return False
+
+    def _tables(self, f, val):
+        key = (f, _vkey(val), len(self.states))
+        hit = self._table_cache.get(key)
+        if hit is not None:
+            return hit
+        n = len(self.states)
+        nxt = np.zeros(n, np.int32)
+        leg = np.zeros(n, bool)
+        for si, s in enumerate(self.states):
+            ns = self._apply(s, f, val)
+            if ns is None:
+                continue
+            ti = self.state_idx.get(ns)
+            if ti is None:
+                return None            # enumeration cap was hit
+            nxt[si] = ti
+            leg[si] = True
+        self._table_cache[key] = (nxt, leg)
+        return (nxt, leg)
+
+    # -- window building -----------------------------------------------------
+
+    def take_window(self) -> Optional[Window]:
+        """Build one engine window from the sealed backlog (splitting
+        an oversized chunk at the event budget — cuts need no
+        quiescence).  None when nothing is ready or the lane is
+        saturated.  A window whose distinct values overflow the state
+        table retries at half the size (a smaller window references
+        fewer states); only an irreducible overflow evicts."""
+        budget = self.max_window_events
+        while not self.saturated and self.sealed:
+            w, retry_smaller = self._try_build(budget)
+            if w is not None:
+                return w
+            if retry_smaller and budget > 8:
+                budget //= 2
+                continue
+            budget = self.max_window_events
+        return None
+
+    def _take_entries(self, budget: int) -> list:
+        out = []
+        while self.sealed and budget > 0:
+            chunk = self.sealed[0]
+            if len(chunk["entries"]) <= budget:
+                out += chunk["entries"]
+                budget -= len(chunk["entries"])
+                self.sealed.pop(0)
+            else:
+                out += chunk["entries"][:budget]
+                chunk["entries"] = chunk["entries"][budget:]
+                budget = 0
+        return out
+
+    def _try_build(self, budget: int) -> tuple:
+        """(window, retry_smaller): retry_smaller asks take_window to
+        re-attempt with a halved event budget (state-table pressure is
+        proportional to the window's distinct values)."""
+        raw = self._take_entries(budget)
+        entries = [e for e in raw if e["kind"] != "drop"]
+        if not entries:
+            return None, False
+
+        uops = [(e["f"], e["val"]) for e in entries
+                if e["kind"] in ("inv", "info")]
+        if not self._ensure_states(uops):
+            # push the stretch back whole and retry smaller (nothing
+            # was mutated yet); an irreducible window evicts
+            self.sealed.insert(0, {"entries": raw})
+            if budget > 8:
+                return None, True
+            self.sealed.pop(0)
+            self._evict(f"state table exceeded {self.max_states} on "
+                        "an irreducible window")
+            return None, False
+
+        # rollback points: a failed build drops the stretch (gap) but
+        # must not leak half-installed slots or residue
+        free_snapshot = list(self.free_slots)
+        residue_snapshot = dict(self.residue)
+        span_snapshot = dict(self.span_slot)
+        payload_snapshot = dict(self.span_payload)
+
+        Sn = len(self.states)
+        ev_kind: list = []
+        ev_slot: list = []
+        ev_next: list = []
+        ev_legal: list = []
+        op_refs: list = []
+        walls: list = []
+        slot_of: dict = {}
+        payload_of: dict = {}
+        new_residue: set = set()
+        ok = True
+        for e in entries:
+            kind = e["kind"]
+            if kind in ("ret", "cancel"):
+                j = slot_of.pop(e["p"], None)
+                payload_of.pop(e["p"], None)
+                if j is None:
+                    j = self.span_slot.pop(e["p"], None)
+                    self.span_payload.pop(e["p"], None)
+                if j is None:
+                    continue           # orphan after an eviction
+                self.free_slots.append(j)
+                ev_kind.append(EV_RETURN if kind == "ret"
+                               else EV_CANCEL)
+                ev_slot.append(j)
+                ev_next.append(None)
+                ev_legal.append(None)
+                op_refs.append({"op_index": e["idx"], "process": e["p"],
+                                "f": e["f"], "value": e["val"],
+                                "wall": e["wall"]})
+                walls.append(e["wall"])
+                continue
+            tab = self._tables(e["f"], e["val"])
+            if tab is None or not self.free_slots:
+                ok = False
+                break
+            j = self.free_slots.pop()
+            ev_kind.append(EV_INVOKE)
+            ev_slot.append(j)
+            ev_next.append(tab[0])
+            ev_legal.append(tab[1])
+            # prefer the completion's history index for flags; either
+            # may be None (the run loop assigns indices as ops land)
+            ref_idx = e["comp_idx"] if isinstance(e["comp_idx"], int) \
+                else e["idx"]
+            op_refs.append({"op_index": ref_idx, "process": e["p"],
+                            "f": e["f"], "value": e["val"],
+                            "wall": e["wall"]})
+            walls.append(e["wall"])
+            if kind == "info":
+                self.residue[j] = (e["f"], e["val"], e["idx"])
+                new_residue.add(j)
+            else:
+                slot_of[e["p"]] = j
+                payload_of[e["p"]] = (e["f"], e["val"])
+                e["built"] = True
+                e["slot"] = j
+        if not ok:
+            self.free_slots = free_snapshot
+            self.residue = residue_snapshot
+            self.span_slot = span_snapshot
+            self.span_payload = payload_snapshot
+            self._evict("open-op slots exhausted (window concurrency "
+                        f"+ spans + residue > {self.bits} bits) or "
+                        "transition outside the state table")
+            return None, False
+        # ops still open at the window edge: their slots carry over
+        pre_spans = dict(self.span_slot)   # outstanding from earlier
+        self.span_slot.update(slot_of)
+        self.span_payload.update(payload_of)
+
+        # standing residue + spans from BEFORE this window ride in as
+        # open slots with their transition tables reinstalled (the
+        # kernel rebuilds slot tables per dispatch); slots opened by
+        # this window's own invoke events must not be double-opened
+        slot_next = np.zeros((self.bits, Sn), np.int32)
+        slot_legal = np.zeros((self.bits, Sn), bool)
+        slot_open = np.zeros(self.bits, bool)
+
+        def abort(why):
+            self.free_slots = free_snapshot
+            self.residue = residue_snapshot
+            self.span_slot = span_snapshot
+            self.span_payload = payload_snapshot
+            self._evict(why)
+
+        for j, (f, val, _i) in self.residue.items():
+            tab = self._tables(f, val)
+            if tab is None:
+                abort("residue transition outside the state table")
+                return None, False
+            slot_next[j] = tab[0]
+            slot_legal[j] = tab[1]
+            slot_open[j] = j not in new_residue
+        for p, j in pre_spans.items():
+            f, val = self.span_payload.get(p, ("read", None))
+            tab = self._tables(f, val)
+            if tab is None:
+                abort("span transition outside the state table")
+                return None, False
+            slot_next[j] = tab[0]
+            slot_legal[j] = tab[1]
+            slot_open[j] = True
+        disp = LaneDispatch(
+            plane=self.plane.copy(),
+            slot_next=slot_next, slot_legal=slot_legal,
+            slot_open=slot_open,
+            ev_kind=np.asarray(ev_kind, np.int32),
+            ev_slot=np.asarray(ev_slot, np.int32),
+            ev_next=np.stack([np.zeros(Sn, np.int32) if t is None
+                              else t for t in ev_next]),
+            ev_legal=np.stack([np.zeros(Sn, bool) if t is None
+                               else t for t in ev_legal]))
+        real_walls = [w for w in walls if w is not None]
+        return Window(lane_key=None, dispatch=disp, op_refs=op_refs,
+                      n_ops=sum(1 for k in ev_kind
+                                if k == EV_INVOKE),
+                      first_wall=min(real_walls) if real_walls
+                      else None,
+                      last_wall=max(real_walls) if real_walls
+                      else None), False
+
+    # -- result application --------------------------------------------------
+
+    def apply_result(self, window: Window,
+                     verdict: dict) -> Optional[dict]:
+        """Fold a window verdict back into the lane.  Returns a flag
+        dict when the window refuted linearizability-so-far."""
+        self.windows_checked += 1
+        plane = np.asarray(verdict["plane"], bool)
+        self.plane = plane[:, :len(self.states)].copy()
+        ev = int(verdict.get("violated_event", -1))
+        if ev < 0:
+            # eager GC: dead states would otherwise accumulate to the
+            # cap (bloating the shape bucket and defeating cross-
+            # tenant batching) before the lazy overflow path fired
+            if len(self.states) > 8 \
+                    and len(self.states) >= 2 * int(
+                        self.plane.any(axis=0).sum()):
+                self._compact_states()
+            return None
+        ref = window.op_refs[ev] if ev < len(window.op_refs) else {}
+        flag = {"event": ev,
+                "op_index": ref.get("op_index"),
+                "f": ref.get("f"),
+                "value": ref.get("value"),
+                "wall": ref.get("wall")}
+        self.flags.append(flag)
+        # re-arm past the refutation so later, independent violations
+        # can still surface (the verdict-so-far stays false); not a
+        # memory event, so it doesn't count as an eviction
+        self._evict("re-arm after violation flag", count=False)
+        return flag
+
+
+class Tenant:
+    """One followed run: cursor state + its lanes."""
+
+    def __init__(self, name: str, ts: str, run_dir, model, *,
+                 bits: int = 6, max_states: int = 64,
+                 max_window_events: int = 256,
+                 max_buffer_entries: int = 4096,
+                 wild_init: Optional[bool] = None):
+        self.name = name
+        self.ts = ts
+        self.run_dir = run_dir
+        self.model = model
+        self.lane_opts = dict(bits=bits, max_states=max_states,
+                              max_window_events=max_window_events,
+                              max_buffer_entries=max_buffer_entries,
+                              wild_init=wild_init)
+        self.lanes: dict = {}
+        self.open_by_process: dict = {}
+        # cursor state (scheduler-owned but persisted here)
+        self.offset = 0
+        self.seq = 0
+        self.corrupt: Optional[str] = None
+        self.paused = False            # backpressure
+        self.done = False
+        self.ops_ingested = 0
+        self.skipped = 0               # non-client / unroutable ops
+        self._record_n = 0             # WAL records seen (index synth)
+
+    # -- demux ---------------------------------------------------------------
+
+    @staticmethod
+    def _split_kv(value):
+        """(lane_key, inner_value): KV tuples demux per key; plain
+        values ride the single None lane."""
+        if type(value).__name__ == "KV" and isinstance(value, tuple) \
+                and len(value) == 2:
+            return value[0], value[1]
+        return None, value
+
+    def lane(self, key) -> LaneState:
+        ln = self.lanes.get(key)
+        if ln is None:
+            ln = self.lanes[key] = LaneState(self.model,
+                                             **self.lane_opts)
+        return ln
+
+    def ingest(self, ops: list, walls: list) -> None:
+        for op, wall in zip(ops, walls):
+            # the run loop assigns op.index at analyze time, not at
+            # journal time: synthesize the WAL position (the same
+            # order History.index() will stamp) so flags carry a real
+            # history index even mid-run
+            if op.index is None:
+                op.index = self._record_n
+            self._record_n += 1
+            p = op.process
+            if type(p) is not int or p < 0:
+                continue               # nemesis / non-client actor
+            if op.type == INVOKE:
+                key, val = self._split_kv(op.value)
+                self.open_by_process[p] = key
+                self.lane(key).on_invoke(p, op.f, val, op.index, wall)
+                self.ops_ingested += 1
+            elif op.type in (OK, FAIL, INFO):
+                key = self.open_by_process.pop(p, _MISSING)
+                if key is _MISSING:
+                    self.skipped += 1  # completion we never saw invoked
+                    continue
+                _k, val = self._split_kv(op.value)
+                self.lane(key).on_complete(p, op.type, val, op.index,
+                                           wall)
+            else:
+                self.skipped += 1
+
+    # -- aggregates ----------------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        return sum(ln.nbytes for ln in self.lanes.values())
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(ln.queue_depth for ln in self.lanes.values())
+
+    @property
+    def flags(self) -> list:
+        out = []
+        for key, ln in sorted(self.lanes.items(),
+                              key=lambda kv: repr(kv[0])):
+            for f in ln.flags:
+                out.append(dict(f, key=key))
+        return out
+
+    @property
+    def saturated(self) -> dict:
+        return {key: ln.saturated for key, ln in self.lanes.items()
+                if ln.saturated}
+
+    @property
+    def verdict_so_far(self):
+        """True = clean so far; False = flagged; 'unknown' = some lane
+        saturated or the stream went corrupt (post-hoc analyze stays
+        authoritative)."""
+        if self.flags:
+            return False
+        if self.corrupt or self.saturated:
+            return "unknown"
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "verdict-so-far": self.verdict_so_far,
+            "ops_ingested": self.ops_ingested,
+            "ops_checked": sum(ln.ops_seen
+                               for ln in self.lanes.values()),
+            "windows_checked": sum(ln.windows_checked
+                                   for ln in self.lanes.values()),
+            "lanes": len(self.lanes),
+            "queue_depth": self.queue_depth,
+            "bytes": self.nbytes,
+            "evictions": sum(ln.evictions
+                             for ln in self.lanes.values()),
+            "evict_reasons": [r for ln in self.lanes.values()
+                              for r in ln.evict_reasons][:20],
+            "span_reads": sum(ln.span_reads
+                              for ln in self.lanes.values()),
+            "flags": self.flags,
+            "saturated": {repr(k): v
+                          for k, v in self.saturated.items()},
+            "paused": self.paused,
+            "corrupt": self.corrupt,
+            "done": self.done,
+            "offset": self.offset,
+        }

@@ -119,6 +119,12 @@ class ResilientRunner:
     cpu_slice_floor_s: minimum per-history time_limit handed to the
         CPU oracle on deadline fallback, so a blown budget still makes
         bounded forward progress instead of checking nothing.
+    cpu_fallback: the per-history degradation target
+        `(model, history, time_limit=None) -> verdict dict`; defaults
+        to the wgl_cpu oracle.  Engines whose "histories" are not
+        History objects (the live checker's window lanes) supply their
+        own host-path callable here and keep the full deadline /
+        backend-unavailable semantics.
     clock / sleep: injectable for tests.
     """
 
@@ -132,6 +138,7 @@ class ResilientRunner:
                  backoff_cap_s: float = 2.0,
                  jitter_seed: int = 0,
                  cpu_slice_floor_s: float = 2.0,
+                 cpu_fallback: Optional[Callable] = None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         self.engine = engine
@@ -144,6 +151,7 @@ class ResilientRunner:
         self.backoff_cap_s = backoff_cap_s
         self.jitter_seed = jitter_seed
         self.cpu_slice_floor_s = cpu_slice_floor_s
+        self.cpu_fallback = cpu_fallback
         self.clock = clock
         self.sleep = sleep
 
@@ -333,7 +341,13 @@ class ResilientRunner:
 
         # -- CPU degradation ----------------------------------------------
         if cpu_rest:
-            from jepsen_tpu.ops import wgl_cpu
+            fb = self.cpu_fallback
+            if fb is None:
+                from jepsen_tpu.ops import wgl_cpu
+                fb = wgl_cpu.check
+                fb_engine = "wgl_cpu"
+            else:
+                fb_engine = getattr(fb, "__name__", "cpu-fallback")
             rem = remaining()
             slice_s = None
             if deadline_s is not None:
@@ -343,10 +357,10 @@ class ResilientRunner:
                               max(rem or 0.0, 0.0) / len(cpu_rest))
             for i in cpu_rest:
                 try:
-                    r = dict(wgl_cpu.check(model, histories[i],
-                                           time_limit=slice_s))
+                    r = dict(fb(model, histories[i],
+                                time_limit=slice_s))
                     r["backend"] = "cpu"
-                    r.setdefault("engine", "wgl_cpu")
+                    r.setdefault("engine", fb_engine)
                     if fallback_cause:
                         r["fallback"] = fallback_cause
                     results[i] = r

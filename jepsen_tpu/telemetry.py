@@ -312,35 +312,52 @@ class EventLog:
                 pass
 
 
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass
+class EventSegment:
+    """One `follow_events` read: the validated new events plus the
+    cursor state to resume from (history.FrameSegment semantics)."""
+
+    events: list
+    offset: int
+    seq: int
+    corrupt: bool = False
+    stop_reason: Optional[str] = None
+    tail_bytes: int = 0
+
+
+def follow_events(path, offset: int = 0, seq: int = 0,
+                  max_records: Optional[int] = None) -> EventSegment:
+    """Resumable cursor over a (possibly still-being-written) event
+    log — the streaming counterpart of `read_events`, sharing
+    `history.follow_frames`'s torn-tail contract: only intact complete
+    records since `offset` are returned; an incomplete trailing line is
+    left unconsumed and re-read whole on the next call; a COMPLETE line
+    failing a guard marks the stream `corrupt`.  Each event dict has
+    `t` (wall seconds) and `i` (sequence) merged in, like
+    `read_events`."""
+    from jepsen_tpu.history import follow_frames
+    seg = follow_frames(path, offset, seq, key="ev",
+                        max_records=max_records)
+    events = []
+    for rec in seg.records:
+        ev = dict(rec["ev"])
+        ev["t"] = rec.get("t")
+        ev["i"] = rec["i"]
+        events.append(ev)
+    return EventSegment(events, seg.offset, seg.seq, seg.corrupt,
+                        seg.stop_reason, seg.tail_bytes)
+
+
 def read_events(path) -> list[dict]:
     """Recover the intact prefix of an event log: records in order,
     stopping at the first torn/unparseable line, crc mismatch, or
     sequence break (everything past a tear is unattributable).  Each
     returned dict is the event payload with `t` (wall seconds) and `i`
-    (sequence) merged in."""
-    p = Path(path)
-    out: list[dict] = []
-    raw = p.read_bytes().decode("utf-8", errors="replace")
-    for line in raw.splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            break
-        if not isinstance(rec, dict) or "ev" not in rec:
-            break
-        if rec.get("i") != len(out):
-            break
-        if f"{zlib.crc32(_payload(rec['ev']).encode()):08x}" \
-                != rec.get("crc"):
-            break
-        ev = dict(rec["ev"])
-        ev["t"] = rec.get("t")
-        ev["i"] = rec["i"]
-        out.append(ev)
-    return out
+    (sequence) merged in.  One full-file `follow_events` read."""
+    return follow_events(path).events
 
 
 # ---------------------------------------------------------------------------

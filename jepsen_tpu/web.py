@@ -10,7 +10,11 @@ shaded and the `cli metrics` summary inline, and `/metrics` is the
 process-global Prometheus text exposition for scraping.
 `/elle/<name>/<ts>` renders the transactional anomaly section (ISSUE
 5): per-checker isolation verdicts plus the elle.txt report inline.
-Built on http.server so it runs anywhere the framework does.
+`/live` + `/live/<name>/<ts>` render the live verification surfaces
+(ISSUE 6): verdict-so-far, violation flags with detection lag, and the
+cross-tenant micro-batch dispatch records, from the checker daemon's
+live.json / live.jsonl.  Built on http.server so it runs anywhere the
+framework does.
 """
 
 from __future__ import annotations
@@ -108,6 +112,7 @@ def home_html() -> bytes:
             f"<td><a href='/zip/{quote(name)}/{quote(ts)}'>zip</a></td>"
             "</tr>")
     body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
+            "<a href='/live'>live</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
             "<th>Valid?</th><th>Results</th><th>History</th>"
@@ -252,6 +257,142 @@ def elle_html(name: str, ts: str) -> bytes:
     return _page(f"elle {name}/{ts}", "".join(body))
 
 
+# ---------------------------------------------------------------------------
+# Live verification pages (ISSUE 6): /live index + per-run
+# verdict-so-far, detection flags, and micro-batch dispatch records —
+# rendered from the checker daemon's live.json / live.jsonl surfaces
+# ---------------------------------------------------------------------------
+
+_LIVE_COLORS = {True: "#ADF6B0", False: "#F3BBBC",
+                "unknown": "#F3EABB"}
+
+
+def _live_color(verdict):
+    return _LIVE_COLORS.get(verdict, "#EAEAEA")
+
+
+def live_index_html() -> bytes:
+    rows = []
+    for name, stamps in sorted(store.tests().items()):
+        for ts in sorted(stamps, reverse=True):
+            p = store.BASE / store._sanitize(name) / ts / "live.json"
+            if not p.exists():
+                continue
+            try:
+                with open(p) as f:
+                    lj = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            v = lj.get("verdict-so-far")
+            rows.append(
+                f"<tr style='background:{_live_color(v)}'>"
+                f"<td>{html.escape(name)}</td>"
+                f"<td><a href='/live/{quote(name)}/{quote(ts)}'>"
+                f"{html.escape(ts)}</a></td>"
+                f"<td>{html.escape(json.dumps(v))}</td>"
+                f"<td>{lj.get('ops_checked', 0)}</td>"
+                f"<td>{lj.get('windows_checked', 0)}</td>"
+                f"<td>{len(lj.get('flags') or [])}</td>"
+                f"<td>{'yes' if lj.get('done') else 'tailing'}</td>"
+                "</tr>")
+    body = ("<h1>Live verification</h1>"
+            "<p><a href='/'>&larr; tests</a> &middot; "
+            "<a href='/metrics'>metrics</a></p>"
+            "<table><tr><th>Test</th><th>Run</th>"
+            "<th>Verdict so far</th><th>Ops checked</th>"
+            "<th>Windows</th><th>Flags</th><th>Done?</th></tr>"
+            + "".join(rows) + "</table>")
+    if not rows:
+        body += ("<p>(no runs under live checking — start "
+                 "<code>python -m jepsen_tpu.cli serve-checker "
+                 "store/</code>)</p>")
+    return _page("Live verification", body)
+
+
+def live_run_html(name: str, ts: str) -> bytes:
+    from jepsen_tpu import telemetry
+    d = _safe_path(f"{name}/{ts}")
+    lj_path = d / "live.json"
+    if not lj_path.exists():
+        raise FileNotFoundError(lj_path)
+    with open(lj_path) as f:
+        lj = json.load(f)
+    v = lj.get("verdict-so-far")
+    body = [f"<h1>{html.escape(name)} / {html.escape(ts)} "
+            "&mdash; live verification</h1>",
+            "<p><a href='/live'>&larr; live</a> &middot; "
+            f"<a href='/files/{quote(name)}/{quote(ts)}/live.jsonl'>"
+            "raw event log</a></p>",
+            f"<p style='background:{_live_color(v)};padding:.5em'>"
+            f"<b>verdict so far: {html.escape(json.dumps(v))}</b> "
+            f"({'run complete' if lj.get('done') else 'still tailing'}"
+            ")</p>"]
+    body.append(
+        "<table>"
+        + "".join(f"<tr><th>{html.escape(k)}</th>"
+                  f"<td>{html.escape(json.dumps(lj.get(k), default=repr))}"
+                  "</td></tr>"
+                  for k in ("ops_ingested", "ops_checked",
+                            "windows_checked", "lanes", "queue_depth",
+                            "bytes", "evictions", "backend",
+                            "plan_cache", "paused", "corrupt",
+                            "saturated"))
+        + "</table>")
+    events = []
+    ev_path = d / "live.jsonl"
+    if ev_path.exists():
+        events = telemetry.read_events(ev_path)
+    flags = [e for e in events if e.get("type") == "live-flag"]
+    if flags:
+        body.append("<h2>Violation flags</h2>"
+                    "<table><tr><th>Lane</th><th>Op index</th>"
+                    "<th>f</th><th>Value</th>"
+                    "<th>Detection lag (s)</th><th>Dispatch</th>"
+                    "<th>Engine</th><th>Plan cache</th></tr>")
+        for e in flags:
+            body.append(
+                "<tr style='background:#F3BBBC'>"
+                f"<td>{html.escape(str(e.get('lane')))}</td>"
+                f"<td>{e.get('op_index')}</td>"
+                f"<td>{html.escape(str(e.get('f')))}</td>"
+                f"<td>{html.escape(str(e.get('value')))}</td>"
+                f"<td>{e.get('detection_lag_s')}</td>"
+                f"<td>{html.escape(str(e.get('dispatch_id')))}</td>"
+                f"<td>{html.escape(str(e.get('engine')))}</td>"
+                f"<td>{html.escape(str(e.get('cache')))}</td></tr>")
+        body.append("</table>")
+    disps = [e for e in events if e.get("type") == "live-dispatch"]
+    if disps:
+        body.append("<h2>Micro-batch dispatches</h2>"
+                    "<table><tr><th>Id</th><th>Engine</th>"
+                    "<th>Plan cache</th><th>Lanes</th>"
+                    "<th>Tenants</th><th>Bucket (T,E,M,Sn)</th>"
+                    "<th>Seconds</th></tr>")
+        for e in disps[-50:]:
+            shared = len(e.get("tenants") or []) > 1
+            body.append(
+                f"<tr{' style=background:#D8E8F8' if shared else ''}>"
+                f"<td>{html.escape(str(e.get('dispatch_id')))}</td>"
+                f"<td>{html.escape(str(e.get('engine')))}</td>"
+                f"<td>{html.escape(str(e.get('cache')))}</td>"
+                f"<td>{e.get('lanes')}</td>"
+                f"<td>{html.escape(', '.join(e.get('tenants') or []))}"
+                "</td>"
+                f"<td>{html.escape(str(e.get('bucket')))}</td>"
+                f"<td>{e.get('seconds')}</td></tr>")
+        body.append("</table>")
+    windows = [e for e in events if e.get("type") == "live-window"]
+    lags = sorted(e["lag_s"] for e in windows
+                  if isinstance(e.get("lag_s"), (int, float)))
+    if lags:
+        p99 = lags[min(int(0.99 * len(lags)), len(lags) - 1)]
+        body.append(f"<p>{len(windows)} windows checked; "
+                    f"op-append&rarr;verdict lag p50="
+                    f"{lags[len(lags) // 2]:.4f}s "
+                    f"p99={p99:.4f}s max={lags[-1]:.4f}s</p>")
+    return _page(f"live {name}/{ts}", "".join(body))
+
+
 def telemetry_run_html(name: str, ts: str) -> bytes:
     from jepsen_tpu import telemetry
     p = _safe_path(f"{name}/{ts}") / "telemetry.jsonl"
@@ -316,6 +457,14 @@ class Handler(BaseHTTPRequestHandler):
                 return self._send(200, telemetry.snapshot().encode(),
                                   "text/plain; version=0.0.4; "
                                   "charset=utf-8")
+            if path == "/live" or path == "/live/":
+                return self._send(200, live_index_html())
+            if path.startswith("/live/"):
+                parts = [unquote(x) for x in
+                         path[len("/live/"):].strip("/").split("/")]
+                if len(parts) == 2:
+                    return self._send(200, live_run_html(*parts))
+                return self._send(404, b"not found", "text/plain")
             if path == "/telemetry" or path == "/telemetry/":
                 return self._send(200, telemetry_index_html())
             if path.startswith("/telemetry/"):

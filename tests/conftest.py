@@ -61,7 +61,29 @@ def pytest_sessionfinish(session, exitstatus):
             os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
             "store", "ci")
         os.makedirs(ci_dir, exist_ok=True)
-        with open(os.path.join(ci_dir, "last-tier1.json"), "w") as f:
+        artifact = os.path.join(ci_dir, "last-tier1.json")
+        # Wall-regression tripwire (ISSUE 6 CI satellite): diff this
+        # run's total wall against the previous artifact and warn at
+        # >25%, so new daemon/service tests can't silently bloat the
+        # tier.  Advisory (a warning line, not a failure): partial
+        # runs (-k, single files) legitimately differ wildly, so the
+        # comparison only fires when the test COUNT matches too.
+        prev_total = None
+        try:
+            with open(artifact) as f:
+                prev = _json.load(f)
+            prev_total = prev.get("total_wall_s")
+            if (prev_total and total
+                    and prev.get("tests") == len(per_test)
+                    and total > prev_total * 1.25):
+                print(f"\nWARNING: tier-1 wall {total:.1f}s regressed "
+                      f">25% vs previous {prev_total:.1f}s "
+                      "(store/ci/last-tier1.json); check the 'slowest' "
+                      "list for the new cost center")
+        except Exception:
+            pass
+        out["prev_total_wall_s"] = prev_total
+        with open(artifact, "w") as f:
             _json.dump(out, f, indent=2)
     except Exception:
         pass            # the artifact must never fail the suite
