@@ -13,8 +13,12 @@ isolation hierarchy and the standard Checker machinery:
     history axis instead of aborting, and a poisoned history costs a
     quarantine verdict, not the batch;
   * verdicts carry PR-4-style dispatch records
-    (`engine=elle-device|elle-host`, why, plane sizes) via
-    `telemetry.attach_dispatch`;
+    (`engine=elle-mesh|elle-device|elle-host`, why, plane sizes,
+    shard/round counts) via `telemetry.attach_dispatch`; the engine
+    tiers form a chain (bit-packed mesh-sharded closure above
+    `mesh_threshold` txns -> dense vmap device -> deadline-capped
+    host oracle), each degrading one step on a recoverable backend
+    failure;
   * `batch_checker()` is the key-independent form (one device program
     for every per-key subhistory — `independent.batch_checker`
     routes here when handed a Checker instead of a model);
@@ -30,7 +34,7 @@ from typing import Optional
 from jepsen_tpu import checker as ck
 from jepsen_tpu import errors as errors_mod
 from jepsen_tpu.elle import infer as infer_mod
-from jepsen_tpu.ops import elle_graph
+from jepsen_tpu.ops import elle_graph, elle_mesh
 
 # Adya's lattice, weakest first.  An anomaly maps to the WEAKEST level
 # that proscribes it; finding one rules out that level and everything
@@ -76,15 +80,25 @@ class Elle(ck.Checker):
     include_order: include the process/realtime order planes in every
         cycle combination (strict/strong-session flavor).  With False,
         pure Adya item anomalies only.
-    algorithm: "auto" (device, host on backend failure), "device",
-        "host".
+    algorithm: "auto" (mesh above mesh_threshold txns, else dense
+        device; one tier down on recoverable backend failure), "mesh"
+        (bit-packed row-sharded `ops.elle_mesh`, strict), "device"
+        (dense vmap `ops.elle_graph`, strict), "host".
+    mesh_threshold: txn count at which "auto" routes to the sharded
+        bit-packed engine — below it the dense vmap engine's one-shot
+        dispatch wins; above it the dense plane stack stops fitting.
+    host_deadline_s: wall budget for the numpy host oracle (fallback
+        tier): past it histories get an honest `unknown` degradation
+        verdict instead of a multi-minute hang (no-silent-caps).
     max_group: histories per device dispatch on the batched path (the
         ResilientRunner group size — also the OOM blast radius).
     """
 
     def __init__(self, workload: str = "auto", anomalies=None,
                  include_order: bool = True, algorithm: str = "auto",
-                 max_retries: int = 2, max_group: int = 8):
+                 max_retries: int = 2, max_group: int = 8,
+                 mesh_threshold: int = 8192,
+                 host_deadline_s: Optional[float] = 120.0):
         self.workload = workload
         self.anomalies = set(anomalies if anomalies is not None
                              else ALL_ANOMALIES)
@@ -92,54 +106,100 @@ class Elle(ck.Checker):
         if unknown:
             raise ValueError(f"unknown anomaly type(s): {sorted(unknown)}")
         self.include_order = include_order
-        if algorithm not in ("auto", "device", "host"):
+        if algorithm not in ("auto", "mesh", "device", "host"):
             raise ValueError(f"unknown algorithm {algorithm!r}")
         self.algorithm = algorithm
         self.max_retries = max_retries
         self.max_group = max_group
+        self.mesh_threshold = mesh_threshold
+        self.host_deadline_s = host_deadline_s
 
     # -- engine (ResilientRunner calling convention) -----------------------
 
+    @staticmethod
+    def _recoverable(e: Exception) -> bool:
+        """No-device-path shapes: a missing/uninitializable jax
+        backend (ImportError / RuntimeError) degrades one tier down;
+        OOM and poison re-raise so the runner bisects or
+        quarantines."""
+        err = errors_mod.classify(e)
+        return isinstance(err, errors_mod.BackendUnavailable) or (
+            isinstance(e, (ImportError, RuntimeError))
+            and not errors_mod.is_oom(e))
+
     def _engine(self, model, inferences, infer_s: float = 0.0):
-        """Batch engine: stacks -> classification -> verdicts.  Raises
-        DeviceOOM/poison through to the runner (bisection); only a
-        missing device path degrades to the host oracle in place —
-        the runner's own BackendUnavailable fallback is the WGL CPU
-        oracle, which cannot check txn planes.  Attaches the elle
-        dispatch record HERE, before the runner's generic accounting
-        can stamp these verdicts with its own."""
+        """Batch engine: stacks -> classification -> verdicts, down
+        the tier chain elle-mesh -> elle-device -> elle-host.  Raises
+        DeviceOOM/poison through to the runner (bisection along the
+        history axis); only a missing device path degrades a tier in
+        place — the runner's own BackendUnavailable fallback is the
+        WGL CPU oracle, which cannot check txn planes (check/
+        check_many also hand the runner `_host_fallback` for that
+        path).  Attaches the elle dispatch record HERE, before the
+        runner's generic accounting can stamp these verdicts with its
+        own."""
         del model
         t0 = time.monotonic()
         stacks = [inf.stacked() for inf in inferences]
+        n_max = max((inf.n for inf in inferences), default=0)
         engine = "elle-host"
         rows = None
-        if self.algorithm in ("auto", "device"):
+        if self.algorithm == "mesh" or (
+                self.algorithm == "auto"
+                and n_max >= self.mesh_threshold):
+            try:
+                rows = elle_mesh.classify_mesh(
+                    stacks, include_order=self.include_order)
+                engine = "elle-mesh"
+            except Exception as e:      # noqa: BLE001 - classified below
+                if not self._recoverable(e):
+                    raise
+                if self.algorithm == "mesh":
+                    # strict mesh has no lower device tier: surface the
+                    # recoverable failure as BackendUnavailable so the
+                    # runner routes to _host_fallback (a real elle
+                    # verdict) instead of quarantining
+                    raise errors_mod.BackendUnavailable(
+                        f"elle-mesh path failed: {e}",
+                        batch_size=len(stacks)) from e
+        if rows is None and self.algorithm in ("auto", "device"):
             try:
                 rows = elle_graph.classify_batch(
                     stacks, include_order=self.include_order)
                 engine = "elle-device"
             except Exception as e:      # noqa: BLE001 - classified below
-                err = errors_mod.classify(e, batch_size=len(stacks))
-                # no-device-path shapes: a missing/uninitializable jax
-                # backend (ImportError / RuntimeError) degrades to the
-                # host oracle; OOM and poison re-raise so the runner
-                # bisects or quarantines
-                recoverable = isinstance(
-                    err, errors_mod.BackendUnavailable) or (
-                    isinstance(e, (ImportError, RuntimeError))
-                    and not errors_mod.is_oom(e))
-                if self.algorithm == "device" or not recoverable:
+                if self.algorithm == "device" or not self._recoverable(e):
                     raise
         if rows is None:
             rows = [elle_graph.classify_host(
-                s, include_order=self.include_order) for s in stacks]
+                s, include_order=self.include_order,
+                deadline_s=self.host_deadline_s) for s in stacks]
+        classify_s = time.monotonic() - t0
+        stages = {"infer_s": infer_s, "classify_s": classify_s}
+        rounds = [r.get("rounds") for r in rows if r.get("rounds")]
+        if rounds:
+            # per-round attribution of the sharded closure (the mesh
+            # path's dominant cost is squaring rounds x all-gathers)
+            stages["round_s"] = classify_s / max(sum(rounds), 1)
         out = [self._verdict(inf, stack, row, engine)
                for inf, stack, row in zip(inferences, stacks, rows)]
         self._attach_dispatch(
-            out, inferences, batch=len(inferences),
-            stages={"infer_s": infer_s,
-                    "classify_s": time.monotonic() - t0})
+            out, inferences, batch=len(inferences), stages=stages)
         return out
+
+    def _host_fallback(self, model, inf, time_limit=None):
+        """Per-history degradation target for the ResilientRunner's
+        BackendUnavailable / deadline path: the deadline-capped host
+        oracle producing a REAL elle verdict (the runner's default
+        fallback is the WGL CPU oracle, which cannot read planes)."""
+        del model
+        stack = inf.stacked()
+        deadline = time_limit if time_limit is not None \
+            else self.host_deadline_s
+        row = elle_graph.classify_host(
+            stack, include_order=self.include_order,
+            deadline_s=deadline)
+        return self._verdict(inf, stack, row, "elle-host")
 
     # -- verdict shaping ----------------------------------------------------
 
@@ -158,6 +218,21 @@ class Elle(ck.Checker):
         return "?"
 
     def _verdict(self, inf, stack, row, engine: str) -> dict:
+        if row.get("unknown"):
+            # the oracle hit its own honest cap (deadline / probe
+            # bound): an `unknown` verdict merges through the checker
+            # validity lattice without masking real invalids
+            out = {"valid?": "unknown",
+                   "degraded": row.get("degraded"),
+                   "anomaly-types": [], "anomalies": {},
+                   "failing-anomaly-types": [],
+                   "txn-count": inf.n, "workload": inf.workload,
+                   "weakest-violated": None, "not": [],
+                   "engine": engine, "elle": dict(inf.meta)}
+            for k in ("deadline_s", "elapsed_s", "rw_probed"):
+                if k in row:
+                    out[k] = row[k]
+            return out
         found: dict = {k: list(v) for k, v in inf.direct.items()}
         for cls, edge in row["anomalies"].items():
             cyc = elle_graph.find_witness(
@@ -176,7 +251,7 @@ class Elle(ck.Checker):
                 "edges": labels})
         bad = sorted(set(found) & self.anomalies)
         levels = violated_levels(found)
-        return {
+        out = {
             "valid?": not bad,
             "anomaly-types": sorted(found),
             "anomalies": found,
@@ -188,6 +263,10 @@ class Elle(ck.Checker):
             "engine": engine,
             "elle": dict(inf.meta),
         }
+        for k in ("rounds", "shards"):     # mesh-path provenance
+            if k in row:
+                out[k] = row[k]
+        return out
 
     # -- Checker protocol ---------------------------------------------------
 
@@ -206,6 +285,7 @@ class Elle(ck.Checker):
             engine_kwargs={"infer_s": t_infer / max(len(infs), 1)},
             max_retries=self.max_retries,
             max_group=self.max_group,
+            cpu_fallback=self._host_fallback,
         ).check(None, infs)
 
     def _attach_dispatch(self, results, infs, batch: int,
@@ -218,19 +298,37 @@ class Elle(ck.Checker):
                     by_engine.setdefault(
                         r.get("engine", "elle-host"), []).append(r)
             n_max = max((inf.n for inf in infs), default=0)
+            whys = {
+                "elle-mesh": "bit-packed planes, row-sharded mesh "
+                             "closure with early exit",
+                "elle-device": "typed-plane closure on device",
+                "elle-host": "no device path; host closure oracle",
+            }
             for eng, rs in by_engine.items():
+                extra: dict = {}
+                if eng == "elle-mesh":
+                    shards = [r.get("shards") for r in rs
+                              if r.get("shards")]
+                    rounds = [r.get("rounds") for r in rs
+                              if r.get("rounds") is not None]
+                    extra["shards"] = max(shards) if shards else None
+                    extra["rounds"] = max(rounds) if rounds else None
+                    extra["n_pad"] = elle_mesh.pad_for_mesh(
+                        max(n_max, 1), extra["shards"] or 1)
+                else:
+                    extra["n_pad"] = elle_graph._pad_to_tile(
+                        max(n_max, 1))
                 telemetry.attach_dispatch(
                     rs, telemetry.dispatch_record(
                         eng,
-                        why=("typed-plane closure on device"
-                             if eng == "elle-device" else
-                             "no device path; host closure oracle"),
-                        fallback_chain=["elle-device", "elle-host"],
+                        why=whys.get(eng, "resilient degradation"),
+                        fallback_chain=["elle-mesh", "elle-device",
+                                        "elle-host"],
                         batch=batch,
                         planes=len(infer_mod.PLANES),
                         n_max=n_max,
-                        n_pad=elle_graph._pad_to_tile(max(n_max, 1)),
-                        include_order=self.include_order),
+                        include_order=self.include_order,
+                        **extra),
                     stages=stages)
         except Exception:           # noqa: BLE001 - telemetry is advisory
             pass
@@ -251,6 +349,7 @@ class Elle(ck.Checker):
                 engine_kwargs={"infer_s": t_infer},
                 max_retries=self.max_retries,
                 max_group=self.max_group,
+                cpu_fallback=self._host_fallback,
             ).check(None, [inf])[0]
         # the anomaly section: always rendered for named runs, so a
         # clean run's report SAYS it checked (report.clj discipline)

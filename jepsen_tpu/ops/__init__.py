@@ -1,11 +1,47 @@
 """Device kernels (JAX/TPU) and their CPU oracles.
 
-  prep.py     shared history -> call-record preprocessing
-  wgl_cpu.py  CPU just-in-time-linearization oracle (knossos-equivalent)
-  wgl.py      batched frontier WGL search on TPU — the centerpiece
-  fold.py     masked segmented reductions for O(n) checkers
-  cycle.py    dependency-graph reachability / SCC via bool matmul
-  runner.py   resilient execution layer around the batch entry points
-              (OOM bisection, deadline-bounded CPU fallback,
-              retry/quarantine, resumable verdict checkpoints)
+  prep.py       shared history -> call-record preprocessing
+  wgl_cpu.py    CPU just-in-time-linearization oracle (knossos-equivalent)
+  wgl.py        batched frontier WGL search on TPU — the centerpiece
+  fold.py       masked segmented reductions for O(n) checkers
+  cycle.py      dependency-graph reachability / SCC via bool matmul
+  elle_graph.py typed-cycle (Adya) classification, dense vmap engine
+  elle_mesh.py  bit-packed + mesh-sharded Elle closure engine
+  runner.py     resilient execution layer around the batch entry points
+                (OOM bisection, deadline-bounded CPU fallback,
+                retry/quarantine, resumable verdict checkpoints)
 """
+
+
+def shard_map_compat(body, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across the JAX-version drift this repo has to
+    survive (ADVICE r5): the export moved out of `jax.experimental`,
+    and the "skip the replication check" kwarg is spelled `check_vma`
+    on newer releases, `check_rep` on 0.4.x (where the default check
+    also has no rule for several primitives we shard).  Degrade through
+    the spellings on unknown-kwarg TypeError instead of raising; a
+    total miss is a BackendUnavailable, not a crash.
+
+    The check must be *skipped*, not satisfied: our sharded bodies are
+    per-device-independent (or use explicit collectives), and e.g.
+    pallas_call carries no varying-mesh-axes info for the checker to
+    consume.
+    """
+    import jax
+
+    from jepsen_tpu.errors import BackendUnavailable
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:        # pre-export-move JAX releases
+        from jax.experimental.shard_map import shard_map
+
+    specs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    for kwarg in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return shard_map(body, **specs,
+                             **kwarg)  # type: ignore[call-arg]
+        except TypeError:
+            continue
+    raise BackendUnavailable(
+        "jax.shard_map rejected every known kwarg spelling",
+        backend=jax.default_backend())

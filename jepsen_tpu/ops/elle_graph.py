@@ -42,8 +42,8 @@ BFS) used by the differential battery and as the no-device fallback
 
 from __future__ import annotations
 
-import functools
 import math
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -59,8 +59,42 @@ def _pad_to_tile(n: int) -> int:
     return max(_TILE, _TILE * math.ceil(n / _TILE))
 
 
-@functools.cache
+# Compiled-kernel cache, one entry per 128-aligned tile size — explicit
+# (not functools.cache) so the shape-bucket accounting below can tell a
+# warm bucket from a fresh compile.
+_KERNEL_CACHE: dict = {}
+_BUCKET_STATS = {"hits": 0, "misses": 0}
+
+
+def kernel_cache_stats() -> dict:
+    return dict(_BUCKET_STATS)
+
+
+def clear_kernel_cache() -> None:
+    _KERNEL_CACHE.clear()
+    _BUCKET_STATS.update(hits=0, misses=0)
+
+
 def _kernels(n_pad: int):
+    """Bucketed kernel lookup: one compiled program per tile size,
+    hit/miss counted into telemetry (`jepsen_elle_bucket_total`)."""
+    hit = n_pad in _KERNEL_CACHE
+    if hit:
+        _BUCKET_STATS["hits"] += 1
+    else:
+        _KERNEL_CACHE[n_pad] = _build_kernels(n_pad)
+        _BUCKET_STATS["misses"] += 1
+    try:
+        from jepsen_tpu import telemetry
+        telemetry.REGISTRY.counter(
+            "jepsen_elle_bucket_total",
+            result="hit" if hit else "miss").inc()
+    except Exception:           # noqa: BLE001 - telemetry is advisory
+        pass
+    return _KERNEL_CACHE[n_pad]
+
+
+def _build_kernels(n_pad: int):
     import jax
     import jax.numpy as jnp
 
@@ -132,32 +166,40 @@ def _pad_stack(stacks: Sequence[np.ndarray], n_pad: int) -> np.ndarray:
 
 def classify_batch(stacks: Sequence[np.ndarray],
                    include_order: bool = True) -> list:
-    """Classify MANY histories in one device program.
+    """Classify MANY histories, one device program per SHAPE BUCKET.
 
     stacks: one [len(PLANES), n, n] bool array per history (n may
-    differ; the batch pads to the largest 128-aligned tile).
+    differ).  Histories group by their own 128-aligned tile size —
+    a stray 10k-txn history costs its 1k-txn batchmates nothing (the
+    old behavior padded the whole batch to the largest tile, a 100x
+    cost amplifier); each bucket's compiled kernel is cached, with
+    hit/miss counts in `jepsen_elle_bucket_total`.
     include_order: include the po/rt planes in every combination
     (strict/strong-session variants); when False they are zeroed.
 
-    Returns one dict per history:
+    Returns one dict per history (input order preserved):
       {"anomalies": {cls: (a, b) defining edge}, "n": n, "n_pad": int}
     """
     if not stacks:
         return []
     import jax
 
-    ns = [s.shape[-1] for s in stacks]
-    n_pad = _pad_to_tile(max(ns))
-    batch = _pad_stack(stacks, n_pad)
-    if not include_order:
-        batch[:, 3:, :, :] = False
-    flags, edges = jax.device_get(_kernels(n_pad)(batch))
-    out = []
-    for i, n in enumerate(ns):
-        found = {cls: (int(edges[i, c, 0]), int(edges[i, c, 1]))
-                 for c, cls in enumerate(ANOMALY_CLASSES)
-                 if bool(flags[i, c])}
-        out.append({"anomalies": found, "n": n, "n_pad": n_pad})
+    buckets: dict = {}
+    for i, s in enumerate(stacks):
+        buckets.setdefault(_pad_to_tile(s.shape[-1]), []).append(i)
+    out: list = [None] * len(stacks)
+    for n_pad in sorted(buckets):
+        idxs = buckets[n_pad]
+        batch = _pad_stack([stacks[i] for i in idxs], n_pad)
+        if not include_order:
+            batch[:, 3:, :, :] = False
+        flags, edges = jax.device_get(_kernels(n_pad)(batch))
+        for j, i in enumerate(idxs):
+            found = {cls: (int(edges[j, c, 0]), int(edges[j, c, 1]))
+                     for c, cls in enumerate(ANOMALY_CLASSES)
+                     if bool(flags[j, c])}
+            out[i] = {"anomalies": found, "n": stacks[i].shape[-1],
+                      "n_pad": n_pad}
     return out
 
 
@@ -172,31 +214,55 @@ def _mm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return a.astype(np.float32) @ b.astype(np.float32) > 0
 
 
-def _host_closure(adj: np.ndarray) -> np.ndarray:
-    n = adj.shape[0]
-    r = adj.copy()
-    for _ in range(max(1, math.ceil(math.log2(max(n - 1, 2))))):
-        r = r | _mm(r, r)
-    return r
+class _HostDeadline(Exception):
+    pass
 
 
-def classify_host(stack: np.ndarray, include_order: bool = True) -> dict:
+def classify_host(stack: np.ndarray, include_order: bool = True,
+                  deadline_s: Optional[float] = None) -> dict:
     """Naive host classification of ONE history's plane stack —
-    same output row shape as classify_batch."""
+    same output row shape as classify_batch.
+
+    deadline_s caps the wall clock: the O(n^3 log n) numpy closure is
+    an accidental multi-minute hang when reached as a fallback at
+    sharded sizes, so past the budget it returns an honest `unknown`
+    degradation row ({"unknown": True, "degraded": "host-deadline"})
+    instead of either finishing hours later or silently passing."""
+    t0 = time.monotonic()
+
+    def tick():
+        if (deadline_s is not None
+                and time.monotonic() - t0 > deadline_s):
+            raise _HostDeadline
+
     ww, wr, rw, po, rt = (stack[i] for i in range(len(PLANES)))
     n = ww.shape[-1]
     if n == 0:
         return {"anomalies": {}, "n": 0, "n_pad": 0}
     order = (po | rt) if include_order else np.zeros_like(ww)
-    c_ww = _host_closure(ww | order)
-    c_wwr = _host_closure(ww | wr | order)
-    # ≥1-rw reachability via the same pair recurrence
-    p0 = (ww | wr | order) | np.eye(n, dtype=bool)
-    p1 = rw.copy()
-    for _ in range(max(1, math.ceil(math.log2(max(n - 1, 2))))):
-        n0 = p0 | _mm(p0, p0)
-        n1 = p1 | _mm(p0, p1) | _mm(p1, p0) | _mm(p1, p1)
-        p0, p1 = n0, n1
+    steps = max(1, math.ceil(math.log2(max(n - 1, 2))))
+    try:
+        tick()
+        c_ww = ww | order
+        for _ in range(steps):
+            c_ww = c_ww | _mm(c_ww, c_ww)
+            tick()
+        c_wwr = ww | wr | order
+        for _ in range(steps):
+            c_wwr = c_wwr | _mm(c_wwr, c_wwr)
+            tick()
+        # ≥1-rw reachability via the same pair recurrence
+        p0 = (ww | wr | order) | np.eye(n, dtype=bool)
+        p1 = rw.copy()
+        for _ in range(steps):
+            n0 = p0 | _mm(p0, p0)
+            n1 = p1 | _mm(p0, p1) | _mm(p1, p0) | _mm(p1, p1)
+            p0, p1 = n0, n1
+            tick()
+    except _HostDeadline:
+        return {"anomalies": {}, "n": n, "n_pad": n, "unknown": True,
+                "degraded": "host-deadline", "deadline_s": deadline_s,
+                "elapsed_s": round(time.monotonic() - t0, 3)}
     masks = {"G0": ww & c_ww.T, "G1c": wr & c_wwr.T,
              "G-single": rw & c_wwr.T,
              "G2-item": rw & p1.T & ~c_wwr.T}

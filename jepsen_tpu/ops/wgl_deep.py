@@ -684,12 +684,8 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:        # pre-export-move JAX releases
-        from jax.experimental.shard_map import shard_map
 
-    from jepsen_tpu.ops import wgl_seg
+    from jepsen_tpu.ops import shard_map_compat, wgl_seg
 
     spec = model.device_spec()
     if spec is None:
@@ -751,28 +747,14 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
                     interpret=(backend == "cpu"))
     pspec = PartitionSpec(mesh_axis)
     _body = lambda ev, aux: kern(ev[0], aux)[None]  # noqa: E731
-    _specs = dict(mesh=mesh, in_specs=(pspec, PartitionSpec()),
-                  out_specs=pspec)
-    # pallas_call's out_shape carries no varying-mesh-axes info; the
+    # pallas_call's out_shape carries no varying-mesh-axes info and the
     # per-device program is trivially independent (no collectives), so
     # the vma/rep check must be skipped rather than threaded through
-    # the kernel builder.  The kwarg spelling is version-sensitive
-    # (check_vma on newer JAX, check_rep on 0.4.x, where the default
-    # check also has no pallas_call rule at all), so degrade through
-    # the spellings on unknown-kwarg TypeError instead of raising
-    # (ADVICE r5).
-    fn = None
-    for kwarg in ({"check_vma": False}, {"check_rep": False}, {}):
-        try:
-            fn = shard_map(_body, **_specs,
-                           **kwarg)  # type: ignore[call-arg]
-            break
-        except TypeError:
-            continue
-    if fn is None:
-        raise BackendUnavailable(
-            "jax.shard_map rejected every known kwarg spelling",
-            backend=backend)
+    # the kernel builder — shard_map_compat degrades through the
+    # version-sensitive kwarg spellings (ADVICE r5).
+    fn = shard_map_compat(_body, mesh=mesh,
+                          in_specs=(pspec, PartitionSpec()),
+                          out_specs=pspec)
     ev_sharded = jax.device_put(
         ev_all, NamedSharding(mesh, pspec))
     outs = np.asarray(fn(ev_sharded, jnp.asarray(auxbuf)))  # [D, 1, 2]

@@ -53,6 +53,14 @@ def elle_section(result: dict) -> str:
     lines.append(f"txns analyzed:   {result.get('txn-count', 0)}"
                  f"  (workload {result.get('workload', '?')},"
                  f" engine {result.get('engine', '?')})")
+    if result.get("shards"):
+        lines.append(f"sharded closure: {result['shards']} device(s),"
+                     f" {result.get('rounds', '?')} squaring round(s)"
+                     " (bit-packed planes)")
+    if result.get("valid?") == "unknown" and result.get("degraded"):
+        lines += ["", f"VERDICT UNKNOWN: oracle degraded "
+                      f"({result['degraded']}) — bounds disclosed, "
+                      "not a pass."]
     kinds = result.get("anomaly-types") or []
     if not kinds:
         lines += ["", "No anomalies detected.",

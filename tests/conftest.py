@@ -41,6 +41,20 @@ def pytest_runtest_logreport(report):
     _ci_durations.append((report.nodeid, report.when, report.duration))
 
 
+def _mesh_device_count():
+    """The forced-host virtual device count the suite's mesh paths
+    (wgl_deep.check_mesh, ops.elle_mesh) actually ran against —
+    recorded in the tier-1 artifact so a conftest/env change that
+    silently collapses the mesh to one device (and with it all
+    sharded-path coverage) shows up as a diffable field across PRs,
+    not a still-green suite."""
+    try:
+        import jax as _jax
+        return len(_jax.devices())
+    except Exception:       # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def pytest_sessionfinish(session, exitstatus):
     import json as _json
     import time as _time
@@ -54,6 +68,7 @@ def pytest_sessionfinish(session, exitstatus):
             "total_wall_s": round(total, 3) if total is not None else None,
             "tests": len(per_test),
             "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
+            "mesh_devices": _mesh_device_count(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }
