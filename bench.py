@@ -37,14 +37,23 @@ import json
 import os
 import pathlib
 import random
+import subprocess
 import sys
+import tempfile
 import time
 
 import jax
 import numpy as np
 
-jax.config.update("jax_compilation_cache_dir",
-                  str(pathlib.Path(__file__).parent / ".cache" / "jax"))
+from jepsen_tpu.ops import planner
+
+# Persistent compiled-plan cache (ISSUE 8): XLA executables for every
+# shape-bucketed kernel land under store/plan-cache/, so driver re-runs
+# AND fresh CLI/suite processes skip the cold compile.  Respects an
+# already-configured jax_compilation_cache_dir (the cold/warm
+# subprocess row below points its children at their own dirs).
+planner.ensure_persistent_cache(
+    str(pathlib.Path(__file__).parent / "store" / "plan-cache"))
 
 from jepsen_tpu import models
 from jepsen_tpu.history import (History, fail_op, invoke_op, ok_op,
@@ -407,6 +416,79 @@ def bench_live() -> dict:
             "live_detect_lag_s": round(det_lag, 4)
             if det_lag is not None else None,
             "live_vs_host": round(sustained / host_rate, 2)}
+
+
+N_COLD_KEYS = 64         # plan-cache row: small enough that the child
+                         # process wall is compile-dominated, same
+                         # kernel SHAPES as any 64-key one-shot
+
+
+_CHILD_SRC = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["_BENCH_ROOT"])
+from jepsen_tpu.ops import planner
+planner.ensure_persistent_cache()      # dir from JEPSEN_TPU_PLAN_CACHE
+from jepsen_tpu import models
+from bench import N_COLD_KEYS, OPS_PER_KEY, CONCURRENCY, make_history
+from jepsen_tpu.ops import wgl_seg
+model = models.CASRegister()
+hs = [make_history(OPS_PER_KEY, CONCURRENCY, seed=90_000 + k)
+      for k in range(N_COLD_KEYS)]
+t0 = time.monotonic()
+rs = wgl_seg.check_many(model, hs)
+wall = time.monotonic() - t0
+assert all(r["valid?"] is True for r in rs), "plan-cache child verdicts"
+print(json.dumps({"check_s": wall,
+                  "compile_s": planner.cache_stats()["compile_s"]}))
+"""
+
+
+def bench_plan_cache() -> dict:
+    """Cold-vs-warm PROCESS row (ISSUE 8): one subprocess checks
+    N_COLD_KEYS keys against an empty plan-cache dir (true cold start:
+    it pays every XLA compile), then a second, identical subprocess
+    runs against the now-warm dir — the restart shape of CLI one-shots,
+    suite binaries, and serve-checker.  Compile seconds are disclosed
+    from the child's own planner accounting, and the speedup is
+    first-verdict wall vs first-verdict wall, nothing hidden in the
+    parent's warm state."""
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="plan-cache-") as d:
+        env = {**os.environ,
+               "_BENCH_ROOT": str(pathlib.Path(__file__).parent),
+               "JEPSEN_TPU_PLAN_CACHE": d}
+        walls = []
+        for label in ("cold", "warm"):
+            t0 = time.monotonic()
+            p = subprocess.run([sys.executable, "-c", _CHILD_SRC],
+                               env=env, capture_output=True,
+                               text=True, timeout=1200)
+            proc_s = time.monotonic() - t0
+            if p.returncode != 0:
+                print(json.dumps({
+                    "metric": f"ERROR: plan-cache {label} child failed: "
+                              + p.stderr[-300:],
+                    "value": 0, "unit": "s", "vs_baseline": 0}))
+                out["error"] = True
+                return out
+            child = json.loads(p.stdout.strip().splitlines()[-1])
+            out[f"plan_cache_{label}_s"] = child["check_s"]
+            out[f"plan_cache_{label}_compile_s"] = child["compile_s"]
+            walls.append((label, child["check_s"], proc_s))
+        speedup = out["plan_cache_cold_s"] / max(
+            out["plan_cache_warm_s"], 1e-9)
+        out["plan_cache_speedup"] = speedup
+        for label, check_s, proc_s in walls:
+            print(f"# plan-cache {label} process: first verdict in "
+                  f"{check_s:.2f}s ({proc_s:.1f}s incl. interpreter + "
+                  "jax import)", file=sys.stderr)
+        print(f"# plan-cache: second process {speedup:.1f}x faster to "
+              f"first verdict with a warm plan-cache dir "
+              f"({N_COLD_KEYS} x {OPS_PER_KEY}-op keys; compile "
+              f"{out['plan_cache_cold_compile_s']:.2f}s cold vs "
+              f"{out['plan_cache_warm_compile_s']:.2f}s warm, child-"
+              "disclosed)", file=sys.stderr)
+    return out
 
 
 def main() -> int:
@@ -1414,6 +1496,20 @@ def main() -> int:
     if live_stats.get("error"):
         return 1
 
+    plan_stats = bench_plan_cache()
+    if plan_stats.get("error"):
+        return 1
+
+    # Host-overlap attribution (ISSUE 8): the warm multi-key wall vs
+    # its kernel time — the double-buffered executor's target is
+    # <= 1.5x (plan+pack+dispatch of chunk k+1 hidden behind chunk k's
+    # device compute; was 4.4x with the monolithic pack).
+    overlap_ratio = warm_s / max(kernel_s, 1e-9)
+    print(f"# multi-key overlap: warm wall {warm_s:.3f}s / kernel "
+          f"{kernel_s:.3f}s = {overlap_ratio:.2f}x (target <= 1.5x; "
+          "host packing double-buffered against device compute)",
+          file=sys.stderr)
+
     print(json.dumps({
         "metric": (f"linearizability check throughput, {N_KEYS} "
                    f"independent {OPS_PER_KEY}-op register histories "
@@ -1485,6 +1581,14 @@ def main() -> int:
         # multi-tenant incremental drain + p99 op-append->verdict lag
         # under paced feeders (bench_live)
         **{k: v for k, v in live_stats.items() if v is not None},
+        # planner rows (BENCH_r08+): cold-vs-warm PROCESS start with
+        # the persistent compiled-plan cache (subprocess-measured,
+        # compile seconds child-disclosed) and the double-buffered
+        # executor's wall-vs-kernel ratio on the multi-key row
+        "plan_cache_cold_s": round(plan_stats["plan_cache_cold_s"], 2),
+        "plan_cache_warm_s": round(plan_stats["plan_cache_warm_s"], 2),
+        "plan_cache_speedup": round(plan_stats["plan_cache_speedup"], 2),
+        "overlap_wall_vs_kernel": round(overlap_ratio, 2),
     }))
     print(f"# multi-key: {n_ops} ops / {N_KEYS} keys in {kernel_s:.3f}s "
           f"kernel (median {kernel_med:.3f}s; {warm_s:.2f}s wall incl. "

@@ -34,7 +34,7 @@ from typing import Optional
 from jepsen_tpu import checker as ck
 from jepsen_tpu import errors as errors_mod
 from jepsen_tpu.elle import infer as infer_mod
-from jepsen_tpu.ops import elle_graph, elle_mesh
+from jepsen_tpu.ops import elle_graph, elle_mesh, planner
 
 # Adya's lattice, weakest first.  An anomaly maps to the WEAKEST level
 # that proscribes it; finding one rules out that level and everything
@@ -142,11 +142,16 @@ class Elle(ck.Checker):
         t0 = time.monotonic()
         stacks = [inf.stacked() for inf in inferences]
         n_max = max((inf.n for inf in inferences), default=0)
+        # THE tier decision (ops.planner): the plan's head names the
+        # tier to try first and its chain names what sits below; the
+        # try/except ladder here only *walks* the plan on recoverable
+        # backend failures, it no longer decides the routing.
+        pl = planner.plan_elle(n_max, batch=len(inferences),
+                               algorithm=self.algorithm,
+                               mesh_threshold=self.mesh_threshold)
         engine = "elle-host"
         rows = None
-        if self.algorithm == "mesh" or (
-                self.algorithm == "auto"
-                and n_max >= self.mesh_threshold):
+        if pl.engine == "elle-mesh":
             try:
                 rows = elle_mesh.classify_mesh(
                     stacks, include_order=self.include_order)
@@ -154,7 +159,7 @@ class Elle(ck.Checker):
             except Exception as e:      # noqa: BLE001 - classified below
                 if not self._recoverable(e):
                     raise
-                if self.algorithm == "mesh":
+                if "elle-device" not in pl.chain:
                     # strict mesh has no lower device tier: surface the
                     # recoverable failure as BackendUnavailable so the
                     # runner routes to _host_fallback (a real elle
@@ -162,7 +167,7 @@ class Elle(ck.Checker):
                     raise errors_mod.BackendUnavailable(
                         f"elle-mesh path failed: {e}",
                         batch_size=len(stacks)) from e
-        if rows is None and self.algorithm in ("auto", "device"):
+        if rows is None and "elle-device" in pl.chain:
             try:
                 rows = elle_graph.classify_batch(
                     stacks, include_order=self.include_order)
@@ -184,7 +189,8 @@ class Elle(ck.Checker):
         out = [self._verdict(inf, stack, row, engine)
                for inf, stack, row in zip(inferences, stacks, rows)]
         self._attach_dispatch(
-            out, inferences, batch=len(inferences), stages=stages)
+            out, inferences, batch=len(inferences), stages=stages,
+            plan=pl)
         return out
 
     def _host_fallback(self, model, inf, time_limit=None):
@@ -289,7 +295,8 @@ class Elle(ck.Checker):
         ).check(None, infs)
 
     def _attach_dispatch(self, results, infs, batch: int,
-                         stages: Optional[dict] = None) -> None:
+                         stages: Optional[dict] = None,
+                         plan: Optional["planner.Plan"] = None) -> None:
         try:
             from jepsen_tpu import telemetry
             by_engine: dict = {}
@@ -298,6 +305,10 @@ class Elle(ck.Checker):
                     by_engine.setdefault(
                         r.get("engine", "elle-host"), []).append(r)
             n_max = max((inf.n for inf in infs), default=0)
+            if plan is None:
+                plan = planner.plan_elle(
+                    n_max, batch=batch, algorithm=self.algorithm,
+                    mesh_threshold=self.mesh_threshold)
             whys = {
                 "elle-mesh": "bit-packed planes, row-sharded mesh "
                              "closure with early exit",
@@ -318,12 +329,15 @@ class Elle(ck.Checker):
                 else:
                     extra["n_pad"] = elle_graph._pad_to_tile(
                         max(n_max, 1))
+                # verdicts a lower tier produced keep the planner-
+                # emitted plan (head, chain, bucket) but say WHY this
+                # tier ran; the head's verdicts carry the plan's why
+                eng_plan = plan if eng == plan.engine else plan.refine(
+                    why=f"degraded from {plan.engine}: "
+                        + whys.get(eng, "resilient degradation"))
                 telemetry.attach_dispatch(
-                    rs, telemetry.dispatch_record(
-                        eng,
-                        why=whys.get(eng, "resilient degradation"),
-                        fallback_chain=["elle-mesh", "elle-device",
-                                        "elle-host"],
+                    rs, eng_plan.record(
+                        engine=eng,
                         batch=batch,
                         planes=len(infer_mod.PLANES),
                         n_max=n_max,

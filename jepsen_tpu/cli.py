@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 import traceback
 from pathlib import Path
@@ -324,10 +325,17 @@ def serve_checker_cmd(opts) -> int:
     verdict-so-far surfaces (rendered at /live when --port serves the
     dashboard from the same process)."""
     from jepsen_tpu.live.service import CheckerService
+    from jepsen_tpu.ops import planner
     root = Path(opts.store_root)
     if not root.is_dir():
         print(f"no such store root: {root}", file=sys.stderr)
         return 255
+    # persistent compiled-plan cache (ISSUE 8): a restarted daemon
+    # reuses the previous process's XLA executables for every warm
+    # bucket instead of re-paying the cold compile on the request path
+    planner.ensure_persistent_cache(
+        str(root / "plan-cache")
+        if os.environ.get("JEPSEN_TPU_PLAN_CACHE") is None else None)
     svc = CheckerService(
         root,
         poll_interval=opts.poll_interval,

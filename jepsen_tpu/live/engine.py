@@ -112,10 +112,13 @@ def _pow2(x: int, lo: int = 1) -> int:
 
 
 # ---------------------------------------------------------------------------
-# Compiled-plan cache (the "warm kernel cache" of the ISSUE tentpole)
+# Compiled-plan cache — storage now lives in the one engine planner
+# (ops.planner.compiled, keyed (engine, bucket, jax version, backend)
+# and persisted across processes via planner.ensure_persistent_cache's
+# JAX compilation cache).  The live-specific counters are kept so the
+# service's /live surfaces and tests keep their warm-cache pins.
 # ---------------------------------------------------------------------------
 
-_PLAN_CACHE: dict = {}
 _CACHE_STATS = {"hit": 0, "miss": 0}
 
 
@@ -124,20 +127,30 @@ def plan_cache_stats() -> dict:
 
 
 def clear_plan_cache() -> None:
-    _PLAN_CACHE.clear()
+    from jepsen_tpu.ops import planner
+    planner.clear_compiled()
     _CACHE_STATS["hit"] = _CACHE_STATS["miss"] = 0
 
 
 def _compiled(T: int, E: int, M: int, Sn: int):
     """The jitted bucket kernel for (lanes, events, plane rows, states)
-    — returns (fn, cache_hit)."""
-    key = (T, E, M, Sn)
-    fn = _PLAN_CACHE.get(key)
-    if fn is not None:
-        _CACHE_STATS["hit"] += 1
-        telemetry.REGISTRY.counter("live_plan_cache_total",
-                                   outcome="hit").inc()
-        return fn, True
+    — returns (fn, cache_hit).  The bucket key IS planner.plan_live's
+    bucket; storage and hit/miss accounting go through
+    planner.compiled."""
+    from jepsen_tpu.ops import planner
+    info: dict = {}
+    fn = planner.compiled("live-jit", (T, E, M, Sn),
+                          _build_bucket_kernel, T, E, M, Sn,
+                          info=info)
+    hit = bool(info.get("hit"))
+    _CACHE_STATS["hit" if hit else "miss"] += 1
+    telemetry.REGISTRY.counter("live_plan_cache_total",
+                               outcome="hit" if hit else "miss").inc()
+    return fn, hit
+
+
+def _build_bucket_kernel(T: int, E: int, M: int, Sn: int):
+    """Build + jit one bucket kernel (planner.compiled's builder)."""
     import jax
     import jax.numpy as jnp
 
@@ -200,12 +213,7 @@ def _compiled(T: int, E: int, M: int, Sn: int):
             (evk, evs, jnp.arange(E, dtype=jnp.int32), evn, evl))
         return plane, sopen, viol
 
-    fn = jax.jit(jax.vmap(lane))
-    _PLAN_CACHE[key] = fn
-    _CACHE_STATS["miss"] += 1
-    telemetry.REGISTRY.counter("live_plan_cache_total",
-                               outcome="miss").inc()
-    return fn, False
+    return jax.jit(jax.vmap(lane))
 
 
 # ---------------------------------------------------------------------------

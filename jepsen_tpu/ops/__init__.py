@@ -7,9 +7,15 @@
   cycle.py      dependency-graph reachability / SCC via bool matmul
   elle_graph.py typed-cycle (Adya) classification, dense vmap engine
   elle_mesh.py  bit-packed + mesh-sharded Elle closure engine
+  planner.py    THE engine-routing decision (shape -> terminating
+                engine chain, rendered into every dispatch record),
+                the persistent compiled-plan cache, and the host-side
+                planning/packing section (scanners, segmentation,
+                state enumeration, table packers)
   runner.py     resilient execution layer around the batch entry points
                 (OOM bisection, deadline-bounded CPU fallback,
-                retry/quarantine, resumable verdict checkpoints)
+                retry/quarantine, resumable verdict checkpoints) +
+                the async double-buffered executor (`overlap`)
 """
 
 

@@ -55,6 +55,42 @@ log = logging.getLogger("jepsen")
 _UNSET = object()
 
 
+def overlap(items, pack: Callable, dispatch: Callable, *,
+            depth: int = 2) -> list:
+    """Async double-buffered executor (ISSUE 8): for each item, run
+    `pack(item)` on the host and hand the payload to `dispatch`, an
+    asynchronous device call returning device buffers.  Because JAX
+    dispatch returns before the device finishes, packing item k+1
+    overlaps device compute of item k; `depth` bounds how far the host
+    may run ahead (older dispatches are blocked on past the window, so
+    in-flight device memory stays at ~depth payloads instead of the
+    whole batch).  The caller stacks the returned device outputs and
+    fetches once — the one-round-trip discipline every pipeline here
+    uses.
+
+    Exceptions propagate exactly as a serial loop's would (an OOM
+    raised at dispatch or at the deferred block surfaces to the
+    caller), so a ResilientRunner wrapping an overlapped engine keeps
+    its full bisection/quarantine semantics — including with donated
+    input buffers, since every dispatch packs a fresh host payload
+    (test_planner.py pins the OOM-mid-pipeline case)."""
+    import collections
+
+    pending: collections.deque = collections.deque()
+    outs: list = []
+    for it in items:
+        payload = pack(it)
+        out = dispatch(payload)
+        outs.append(out)
+        pending.append(out)
+        if len(pending) > max(1, depth):
+            old = pending.popleft()
+            block = getattr(old, "block_until_ready", None)
+            if block is not None:
+                block()
+    return outs
+
+
 def _resolve_engine(engine) -> Callable:
     """Engine name -> batch callable `(model, histories, **kw) -> list`.
     A callable passes through (the fault-injection tests hand in
@@ -398,17 +434,18 @@ class ResilientRunner:
                     by_kind.setdefault(kind, []).append(r)
             engine_name = self.engine if isinstance(self.engine, str) \
                 else getattr(self.engine, "__name__", "custom")
+            fb_name = getattr(self.cpu_fallback, "__name__", "wgl_cpu") \
+                if self.cpu_fallback is not None else "wgl_cpu"
+            from jepsen_tpu.ops import planner
             for kind, rs in by_kind.items():
+                pl = planner.runner_plan(
+                    engine_name, fb_name,
+                    why=(fallback_cause
+                         or ("quarantined after retries/bisection"
+                             if kind == "quarantine"
+                             else "resilient-runner degradation")))
                 telemetry_mod.attach_dispatch(
-                    rs,
-                    telemetry_mod.dispatch_record(
-                        kind,
-                        why=(fallback_cause
-                             or ("quarantined after retries/bisection"
-                                 if kind == "quarantine"
-                                 else "resilient-runner degradation")),
-                        fallback_chain=[engine_name, "wgl_cpu"],
-                        batch=n, **counts))
+                    rs, pl.record(engine=kind, batch=n, **counts))
         except Exception:   # noqa: BLE001
             log.debug("runner telemetry accounting failed",
                       exc_info=True)

@@ -240,26 +240,32 @@ def check_many(model, histories: Sequence, *,
             results[i] = {"valid?": "unknown", "cause": "frontier-overflow",
                           "op_count": pl.n_calls}
     _acc("assemble", t0)
-    # dispatch records (telemetry): batched lanes vs escalated lanes
+    # dispatch records (telemetry): batched lanes vs escalated lanes,
+    # both rendering the planner-emitted plan (ops.planner)
     from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu.ops import planner
     mesh_desc = (dict(zip(mesh.axis_names, mesh.devices.shape))
                  if mesh is not None else None)
+    batch_plan = planner.plan_engines(
+        planner.Shape(kind="batch-many", batch=len(histories),
+                      mesh=None if mesh is None else int(
+                          np.prod(list(mesh.shape.values()))))).refine(
+        why="vmap-over-keys frontier kernel "
+            f"(frontier_size={int(frontier_size)})",
+        bucket=("wgl_batch", int(frontier_size), int(R), int(C),
+                int(W), int(S)))
     batched_rs = [r for i, r in enumerate(results)
                   if isinstance(r, dict) and i not in set(escalated)]
     telemetry_mod.attach_dispatch(
         batched_rs,
-        telemetry_mod.dispatch_record(
-            "wgl_batch", why="vmap-over-keys frontier kernel "
-                             f"(frontier_size={int(frontier_size)})",
-            fallback_chain=["wgl"], batch=len(histories),
-            mesh=mesh_desc),
+        batch_plan.record(engine="wgl_batch", batch=len(histories),
+                          mesh=mesh_desc),
         stages=stats)
     for i in escalated:
         telemetry_mod.attach_dispatch(
             [results[i]],
-            telemetry_mod.dispatch_record(
-                results[i].get("engine", "wgl"),
+            batch_plan.refine(
                 why="frontier overflow on an invalid-looking lane; "
-                    "escalated to the adaptive serial kernel",
-                fallback_chain=["wgl_cpu"], batch=1))
+                    "escalated to the adaptive serial kernel").record(
+                engine=results[i].get("engine", "wgl"), batch=1))
     return [r for r in results]

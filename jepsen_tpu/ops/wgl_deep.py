@@ -71,29 +71,22 @@ from jepsen_tpu.errors import BackendUnavailable, CheckError
 _INTRA = (0x55555555, 0x33333333, 0x0F0F0F0F, 0x00FF00FF, 0x0000FFFF)
 _FULL = 0xFFFFFFFF
 
-R_MAX = 14          # 2^14-mask plane = [Sn, 512] words; past this the
-                    # plane itself outgrows the VPU's appetite
+from jepsen_tpu.ops import planner
+
+R_MAX = planner.DEEP_R_MAX   # 2^14-mask plane = [Sn, 512] words; past
+                             # this the plane outgrows the VPU's appetite
 EB = 512            # event rows per grid step (SMEM block budget)
 
 
 def supported(R: int, Sn: int, U: int, decomposed: bool,
               backend: str) -> bool:
-    """Gate shared with the wgl_seg dispatcher: the deep kernel takes
-    decomposable models with Sn <= 32 on TPU at any R <= R_MAX.  It is
-    *profitable* past the register-delta gate (R > 6); eligibility
-    below that is still correct and used by the differential tests.
-
-    The 'cpu' backend runs the Pallas INTERPRETER — a per-event Python
-    loop, orders of magnitude slower than the compiled candidate-table
-    fallback on long histories — so it is opt-in via
-    JEPSEN_TPU_DEEP_INTERPRET=1 (set by the test suite, which runs
-    deliberately tiny histories on the virtual CPU mesh); production
-    CPU deployments keep the existing compiled fallback chain."""
-    return (decomposed and 0 < R <= R_MAX and Sn <= 32 and U <= 32767
-            and (backend == "tpu"
-                 or (backend == "cpu" and os.environ.get(
-                     "JEPSEN_TPU_DEEP_INTERPRET") == "1"))
-            and os.environ.get("JEPSEN_TPU_NO_DEEP") != "1")
+    """Gate shared with the wgl_seg dispatcher — now owned by the one
+    engine planner (`planner.deep_supported`, ISSUE 8) so the routing
+    decision and this kernel's self-description cannot drift; kept as
+    a thin delegate for the long-standing callers.  See
+    planner.deep_supported for the scope and the
+    JEPSEN_TPU_DEEP_INTERPRET backend-capability semantics."""
+    return planner.deep_supported(R, Sn, U, decomposed, backend)
 
 
 def _snp(Sn: int) -> int:
@@ -478,8 +471,10 @@ def dispatch_tables(ret_t, islot_t, iuop_t, a1t, a2t, t0t,
         stats["wire_bytes"] = (stats.get("wire_bytes", 0)
                                + cbuf.nbytes + auxbuf.nbytes)
     Wd = max(1, (1 << R) // 32)
-    kern = _build_c(G, I, Wd, _snp(Sn), R, UP,
-                    interpret=(backend == "cpu"))
+    kern = planner.compiled(
+        "wgl_deep", (G, I, Wd, _snp(Sn), R, UP, backend),
+        _build_c, G, I, Wd, _snp(Sn), R, UP,
+        interpret=(backend == "cpu"))
     return kern(cbuf, auxbuf), G
 
 
@@ -634,18 +629,24 @@ def check_pipeline(model, histories, *, max_open_bits: int = 14,
                     res["op_index"] = w[1]
             results[i] = res
         _acc("assemble", t0)
-    # in-scope verdicts carry the deep pipeline's dispatch record +
-    # stage decomposition BEFORE the stragglers run, so the serial
-    # chain's verdicts keep their own engines' records
+    # in-scope verdicts carry the deep pipeline's plan + stage
+    # decomposition BEFORE the stragglers run, so the serial chain's
+    # verdicts keep their own engines' records
     from jepsen_tpu import telemetry as telemetry_mod
+    R_pend = max(p[5] for p in pend) if pend else 0
+    pipe_plan = planner.plan_engines(
+        planner.Shape(kind="deep-pipeline", R=R_pend,
+                      Sn=Sn or None, U=len(rows) or None,
+                      decomposed=True, batch=len(histories),
+                      max_states=max_states,
+                      max_open_bits=max_open_bits),
+        backend=backend)
     telemetry_mod.attach_dispatch(
         results,
-        telemetry_mod.dispatch_record(
-            "wgl_deep",
-            why="pipelined deep megakernel (async dispatch, one fetch)",
-            fallback_chain=["wgl_seg.check", "wgl"],
-            R=(max(p[5] for p in pend) if pend else None),
-            batch=len(histories), stragglers=len(strag) or None),
+        pipe_plan.record(engine="wgl_deep",
+                         R=R_pend or None,
+                         batch=len(histories),
+                         stragglers=len(strag) or None),
         stages=stats)
     for i in strag:
         try:
@@ -774,11 +775,16 @@ def check_mesh(model, histories, mesh, *, mesh_axis: str = "hists",
                 res["op_index"] = w[1]
         results.append(res)
     from jepsen_tpu import telemetry as telemetry_mod
+    mesh_plan = planner.plan_engines(
+        planner.Shape(kind="deep-mesh", R=R, Sn=int(Sn),
+                      U=len(rows), decomposed=True,
+                      batch=len(histories), mesh=n_dev,
+                      max_states=max_states),
+        backend=backend)
     telemetry_mod.attach_dispatch(
         results,
-        telemetry_mod.dispatch_record(
-            "wgl_deep", why="mesh-sharded deep megakernel "
-                            "(one history per device, no collectives)",
+        mesh_plan.record(
+            engine="wgl_deep",
             R=R, batch=len(histories),
             mesh=dict(zip(mesh.axis_names, mesh.devices.shape))))
     return results

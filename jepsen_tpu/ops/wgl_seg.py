@@ -66,7 +66,6 @@ the prefix through the first dead segment.
 
 from __future__ import annotations
 
-import dataclasses
 import functools
 import os
 import threading
@@ -75,762 +74,27 @@ from typing import Any, Optional
 
 import numpy as np
 
-from jepsen_tpu.errors import CheckError
 from jepsen_tpu.history import History, PackedHistory
-from jepsen_tpu.models import DeviceSpec
 from jepsen_tpu.ops.prep import PreparedHistory, prepare
 from jepsen_tpu.ops.frontier import (make_plane_ops as _bit_ops,
                                      reshape_shift as _reshape_shift)
 
-
-class Unsupported(CheckError):
-    """This history/model cannot use the segment-parallel engine; use
-    ops.wgl (device serial) or ops.wgl_cpu instead.  Part of the
-    jepsen_tpu.errors taxonomy (still a ValueError via CheckError);
-    errors.classify maps it to BackendUnavailable when a whole batch
-    falls out of device scope."""
-
-
-# ---------------------------------------------------------------------------
-# Host-side planning
-# ---------------------------------------------------------------------------
-
-@dataclasses.dataclass
-class SegPlan:
-    """K segments, each a padded table of return events.  L return
-    events per segment, C candidate slots per event, R mask bits,
-    Sn states, U distinct ops."""
-
-    ret_slot: np.ndarray    # int32 [K, L]      (-1 = padding)
-    cand_slot: np.ndarray   # int32 [K, L, C]
-    cand_uop: np.ndarray    # int32 [K, L, C]   (-1 = none)
-    legal: np.ndarray       # bool  [U, Sn]
-    next_state: np.ndarray  # int32 [U, Sn]
-    states: np.ndarray      # int32 [Sn, S] enumerated state table
-    seg_end_call: np.ndarray  # int32 [K] call id of last return per segment
-    n_calls: int
-    max_open: int
-    # Diagonal + rank-1 decomposition of the transition relation (set
-    # when every distinct op either keeps the state or sends all states
-    # to ONE target state — true for the whole register family, cas and
-    # mutex): next = diag_w·identity + const_w·(-> t0).  Lets the kernel
-    # replace the Sn² one-hot contraction with 3 elementwise passes.
-    diag_w: Optional[np.ndarray] = None    # f32 [U, Sn]
-    const_w: Optional[np.ndarray] = None   # f32 [U, Sn]
-    const_t0: Optional[np.ndarray] = None  # int32 [U]
-    # Per-segment flat snapshot arrays (the _fk_arrays form) for the
-    # register-delta kernel path; one _FastKey per segment.
-    seg_fk: Optional[list] = None
-
-
-def _encode_calls(calls, spec: DeviceSpec, seen: Optional[dict] = None,
-                  rows: Optional[list] = None):
-    """Encode each call's op as (f, a, b, ok) and dedupe to U distinct
-    rows.  Returns (uops int32[U, 4], call->uop int32[n]).  Pass shared
-    `seen`/`rows` to intern across several histories (multi-key batch)."""
-    from jepsen_tpu.ops.wgl import _generic_encode_op
-
-    encode_op = getattr(spec, "encode_op", None) or \
-        (lambda op: _generic_encode_op(op, spec.f_codes))
-    seen = {} if seen is None else seen
-    call_uop = np.zeros(len(calls), np.int32)
-    rows = [] if rows is None else rows
-    # Stage new rows locally and merge only once the whole history
-    # encodes: a key that raises Unsupported mid-walk must not leave its
-    # ops in the shared tables, where they would grow the enumerated
-    # state space for keys that never issue them.
-    new_seen: dict = {}
-    new_rows: list = []
-    for c in calls:
-        fc, av, bv, okv = encode_op(c.op)
-        if fc < 0:
-            raise Unsupported(f"model has no f-code for {c.op.f!r}")
-        if not (-2 ** 31 <= av < 2 ** 31 and -2 ** 31 <= bv < 2 ** 31):
-            raise Unsupported(
-                f"op value {c.op.value!r} exceeds the int32 device range")
-        key = (fc, av, bv, okv)
-        u = seen.get(key)
-        if u is None:
-            u = new_seen.get(key)
-        if u is None:
-            u = new_seen[key] = len(rows) + len(new_rows)
-            new_rows.append(key)
-        call_uop[c.id] = u
-    seen.update(new_seen)
-    rows.extend(new_rows)
-    return np.asarray(rows, np.int32).reshape(len(rows), 4), call_uop
-
-
-@functools.lru_cache(maxsize=32)
-def _expand_fn(step):
-    """Jitted state-space expansion, cached per model step function —
-    defining it inside _enumerate_states re-traced and re-compiled on
-    EVERY check call."""
-    import jax
-    import jax.numpy as jnp
-
-    @jax.jit
-    def expand(states, uops):
-        # [n, S], [U, 4] -> ([U, n, S] states', [U, n] legal)
-        def one(st):
-            def per_op(u):
-                st2, legal = step(st, u[0], u[1], u[2], u[3] != 0)
-                return st2.astype(jnp.int32), legal
-            return jax.vmap(per_op)(uops)
-        st2, legal = jax.vmap(one)(states)  # [n, U, S], [n, U]
-        return st2.transpose(1, 0, 2), legal.transpose(1, 0)
-
-    return expand
-
-
-def _enumerate_states(spec: DeviceSpec, init_state: np.ndarray,
-                      uops: np.ndarray, max_states: int):
-    """Close {init} under every distinct op's legal transition.  Returns
-    (states int32[Sn, S], legal bool[U, Sn], next int32[U, Sn])."""
-    import jax
-    import jax.numpy as jnp
-
-    step = spec.step
-    U = uops.shape[0]
-
-    # Pinned to CPU: the state space is tiny and the accelerator's
-    # compile latency (tens of seconds on a tunneled chip) would dwarf
-    # the work.
-    cpu = jax.devices("cpu")[0]
-    base = _expand_fn(step)
-
-    def expand(states):
-        return base(states, uops)
-
-    table: dict[bytes, int] = {}
-    states: list[np.ndarray] = []
-
-    def intern(row: np.ndarray) -> int:
-        key = row.tobytes()
-        idx = table.get(key)
-        if idx is None:
-            idx = table[key] = len(states)
-            states.append(row)
-        return idx
-
-    intern(np.asarray(init_state, np.int32))
-    frontier = 0
-    while frontier < len(states):
-        if len(states) > max_states:
-            raise Unsupported(
-                f"model state space exceeds max_states={max_states}")
-        batch = np.stack(states[frontier:], 0)
-        frontier = len(states)
-        with jax.default_device(cpu):
-            st2, legal = (np.asarray(x) for x in expand(batch))
-        for u in range(U):
-            for j in range(st2.shape[1]):
-                if legal[u, j]:
-                    intern(st2[u, j].astype(np.int32))
-
-    state_arr = np.stack(states, 0).astype(np.int32)
-    Sn = state_arr.shape[0]
-    with jax.default_device(cpu):
-        st2, legal = (np.asarray(x) for x in expand(state_arr))
-    next_state = np.zeros((U, Sn), np.int32)
-    for u in range(U):
-        for s in range(Sn):
-            if legal[u, s]:
-                next_state[u, s] = table[st2[u, s].astype(np.int32).tobytes()]
-    return state_arr, legal.astype(bool), next_state
-
-
-def plan(prep: PreparedHistory, spec: DeviceSpec, model, *,
-         max_states: int = 64, max_open_bits: int = 10,
-         target_returns_per_segment: int = 256,
-         pad_segments_pow2: bool = True) -> SegPlan:
-    calls = prep.calls
-    if any(c.is_crashed for c in calls):
-        raise Unsupported("history has crashed (:info) calls")
-    if prep.max_open > max_open_bits:
-        raise Unsupported(
-            f"max {prep.max_open} simultaneously-open calls exceeds "
-            f"max_open_bits={max_open_bits}")
-
-    uops, call_uop = _encode_calls(calls, spec)
-    init = np.asarray(spec.encode(model), np.int32)
-    states, legal, next_state = _enumerate_states(
-        spec, init, uops, max_states)
-
-    # Quiescent cuts: per-return flags (zero open calls after it) plus
-    # the event position just past each return, for segment slicing.
-    cut_flags = []
-    ret_event_end = []
-    open_count = 0
-    for i, (_, kind, _) in enumerate(prep.events):
-        open_count += 1 if kind == 0 else -1
-        if kind == 1:
-            cut_flags.append(1 if open_count == 0 else 0)
-            ret_event_end.append(i + 1)
-    if open_count != 0:
-        raise Unsupported("history ends with open calls")  # unreachable:
-        # crash-free histories always return every call (prep marks
-        # unreturned invokes as crashed, caught above)
-
-    seg_ret_ends = _segment_ends(cut_flags, target_returns_per_segment)
-    seg_bounds = [0] + [ret_event_end[r - 1] for r in seg_ret_ends]
-    if len(seg_bounds) < 2:
-        seg_bounds = [0, len(prep.events)]
-
-    segments = list(zip(seg_bounds[:-1], seg_bounds[1:]))
-    K = len(segments)
-    seg_tables = []
-    L = C = 1
-    for lo, hi in segments:
-        rets, _, open_calls = _assign_slots(prep.events[lo:hi])
-        assert not open_calls, "cut was not quiescent"
-        seg_tables.append(rets)
-        L = max(L, len(rets))
-        C = max(C, max((len(cs) for _, _, cs in rets), default=1))
-
-    if pad_segments_pow2:
-        L = _pad_len(L)
-        C = _next_pow2(C)
-
-    diag_w, const_w, const_t0 = _decompose(legal, next_state)
-    # seg_fk is only consumed by the register-delta kernel — skip the
-    # extra per-candidate appends when that path cannot engage.
-    want_fk = _regs_eligible(prep.max_open, uops.shape[0],
-                             states.shape[0], diag_w is not None)
-
-    ret_slot = np.full((K, L), -1, np.int32)
-    cand_slot = np.zeros((K, L, C), np.int32)
-    cand_uop = np.full((K, L, C), -1, np.int32)
-    seg_end_call = np.zeros(K, np.int32)
-    seg_fk = [] if want_fk else None
-    for k, rets in enumerate(seg_tables):
-        rs_f, cnt_f, cs_f, cu_f = [], [], [], []
-        for r, (cid, slot, cands) in enumerate(rets):
-            ret_slot[k, r] = slot
-            if want_fk:
-                rs_f.append(slot)
-                cnt_f.append(len(cands))
-            for j, (c2, s2) in enumerate(cands):
-                cand_slot[k, r, j] = s2
-                cand_uop[k, r, j] = call_uop[c2]
-                if want_fk:
-                    cs_f.append(s2)
-                    cu_f.append(call_uop[c2])
-        seg_end_call[k] = rets[-1][0] if rets else -1
-        if want_fk:
-            seg_fk.append(_FastKey(
-                None, prep.max_open, len(rets),
-                arrays=(np.asarray(rs_f, np.int32),
-                        np.asarray(cnt_f, np.int32),
-                        np.asarray(cs_f, np.int32),
-                        np.asarray(cu_f, np.int32))))
-
-    return SegPlan(ret_slot, cand_slot, cand_uop, legal, next_state,
-                   states, seg_end_call, n_calls=len(calls),
-                   max_open=prep.max_open,
-                   diag_w=diag_w, const_w=const_w, const_t0=const_t0,
-                   seg_fk=seg_fk)
-
-
-def _next_pow2(x: int) -> int:
-    b = 1
-    while b < x:
-        b *= 2
-    return b
-
-
-def _segment_ends(cut_flags: np.ndarray, target: int) -> list:
-    """Greedy quiescent-cut segmentation over returns — the ONE
-    segmentation policy (shared by plan() and the fast scan path):
-    cut_flags[r] marks quiescence after return r; a segment closes at
-    the first quiescent return >= `target` returns in, and the last cut
-    always closes the tail.  Iterates once per SEGMENT (searchsorted
-    over the cut positions), not once per cut — low-concurrency
-    histories are quiescent at a large fraction of returns.  target
-    clamps to >= 1 (0 used to mean cut-everywhere in the per-cut loop;
-    the searchsorted form would re-find the consumed cut forever)."""
-    target = max(int(target), 1)
-    pos = np.nonzero(np.asarray(cut_flags))[0]
-    if not len(pos):
-        return []
-    last = int(pos[-1])
-    ends: list = []
-    start = 0
-    while True:
-        j = np.searchsorted(pos, start + target - 1, side="left")
-        if j >= len(pos):
-            break
-        c = int(pos[j])
-        ends.append(c + 1)
-        start = c + 1
-    if not ends or ends[-1] != last + 1:
-        ends.append(last + 1)
-    return ends
-
-
-def _pad_len(x: int) -> int:
-    """Event-axis padding: pow2 below 64, 64-multiples above.  The scan
-    runs this many serial steps for EVERY lane, so pow2 padding wasted
-    up to 2x; 64-granularity keeps the compiled-shape set small without
-    the waste."""
-    return _next_pow2(x) if x <= 64 else ((x + 63) // 64) * 64
-
-
-def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool,
-                   r_cap: int = 6, sn_cap: int = 32) -> bool:
-    """One gate for the register-delta kernel, shared by check(),
-    check_many() and the relaxed tier so they cannot silently diverge:
-    fixed rounds stay exact and compile small only for R <= r_cap, the
-    uop index must fit int16, and the transition form must fit the
-    decomposed (Sn <= sn_cap) or nibble (Sn <= 8) tables.  The Pallas /
-    dynamic-rounds toggles imply the candidate-table path.  (The
-    crashed-call path passes r_cap=8: its extra permanent slots are
-    worth a bigger compile; the wide-state relaxed tier passes
-    sn_cap=64 — its aux masks ride as sn_words=2 uint32 words.)"""
-    return (R <= r_cap and U <= 32767
-            and ((decomposed and Sn <= sn_cap)
-                 or (not decomposed and Sn <= 8))
-            and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
-            and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1")
-
-
-# Crashed-call tolerance of the fast single-history path: each crashed
-# call doubles the entry-config axis (J = Sn * 2^nc), so cap it low —
-# histories beyond the cap fall back to the serial/CPU engines.
-_MAX_CRASHED = 4
-
-
-class _FastKey:
-    """One batchable key, produced by a single fused host pass:
-    rets[r] = (slot, [(open_slot, open_uop), ...]) per return event —
-    or, from the native scanner, the same data as flat int32 arrays
-    (ret_slots, cand_counts, cand_slots, cand_uops).  `cuts[r]` marks
-    returns after which the key is QUIESCENT (zero open NORMAL calls) —
-    the segmentation points the batch engine parallelizes across.
-
-    Crashed-tolerant scans additionally set `nc` (crashed-call count)
-    and `rn` (first crashed slot = max normal open): crashed calls hold
-    permanent slots rn..rn+nc-1 and appear in every snapshot from their
-    invoke onward."""
-
-    __slots__ = ("rets", "max_open", "n_calls", "arrays", "cuts",
-                 "nc", "rn", "deltas", "positions")
-
-    def __init__(self, rets, max_open, n_calls, arrays=None, cuts=None,
-                 nc=0, rn=None, deltas=None, positions=None):
-        self.rets = rets
-        self.max_open = max_open
-        self.n_calls = n_calls
-        self.arrays = arrays
-        self.cuts = cuts
-        self.nc = nc
-        self.rn = rn
-        # From the columnar scanner: (d_counts[nr], d_slots[n_calls],
-        # d_uops[n_calls]) — the calls invoked since the previous
-        # return, attributed to each return in stream order.  Feeds
-        # _pack_regs_single without re-deriving deltas from snapshots.
-        self.deltas = deltas
-        # int32[n_rets]: original op position of each return (from the
-        # native scanners) — lets invalid verdicts slice out JUST the
-        # dead segment's ops for witness localization.  None from the
-        # pure-Python twin; localization then uses the prefix oracle.
-        self.positions = positions
-
-    @property
-    def n_rets(self):
-        return (len(self.arrays[0]) if self.arrays is not None
-                else len(self.rets))
-
-
-def _native_scan(ops: list, spec, seen: dict, rows: list,
-                 max_open_bits: int):
-    """The C twin of _fast_scan (native/histscan.c) — ~8x faster on
-    the host; returns None for out-of-scope keys just like it."""
-    from jepsen_tpu import native
-
-    if getattr(spec, "encode_op", None) is not None:
-        return None    # C scanner encodes via f_codes only; slow path
-    mod = native.histscan()
-    if mod is None:
-        return False                 # extension unavailable
-    out = mod.fast_scan(ops, spec.f_codes, seen, rows, max_open_bits)
-    return _fastkey_from_native(out)
-
-
-def _fastkey_from_native(out):
-    if out is None:
-        return None
-    n_calls, max_open, rs, counts, cs, cu, cuts, *rest = out
-    # Py_BuildValue turns a NULL pointer (empty vec) into None
-    deltas = None
-    positions = None
-    if len(rest) == 1:               # object scan: + ret positions
-        positions = np.frombuffer(rest[0] or b"", np.int32)
-    elif len(rest) == 4:             # cols scan: + deltas + positions
-        dc, dslot, duop, pos = rest
-        deltas = (np.frombuffer(dc or b"", np.int32),
-                  np.frombuffer(dslot or b"", np.int32),
-                  np.frombuffer(duop or b"", np.int32))
-        positions = np.frombuffer(pos or b"", np.int32)
-    return _FastKey(None, max_open, n_calls,
-                    arrays=(np.frombuffer(rs or b"", np.int32),
-                            np.frombuffer(counts or b"", np.int32),
-                            np.frombuffer(cs or b"", np.int32),
-                            np.frombuffer(cu or b"", np.int32)),
-                    cuts=np.frombuffer(cuts or b"", np.int32),
-                    deltas=deltas, positions=positions)
-
-
-def _cols_args(packed, spec):
-    """The six contiguous column buffers the C columnar scanners take,
-    or None when this (packed, spec) pair can't feed them (custom
-    encode_op, no packed columns).  vkind==4 gates every out-of-int32
-    value before it is read, so the wrapping casts below never reach
-    the kernel tables."""
-    if getattr(spec, "encode_op", None) is not None:
-        return None
-    if packed is None or getattr(packed, "vkind", None) is None:
-        return None
-    nf = len(packed.f_codes)
-    fcol = packed.f
-    if nf == 0:
-        fmap = np.full(len(fcol), -1, np.int32)
-    else:
-        f2spec = np.full(nf, -1, np.int32)
-        for tag, hid in packed.f_codes.items():
-            code = spec.f_codes.get(tag)
-            if code is not None:
-                f2spec[hid] = code
-        fmap = np.where((fcol >= 0) & (fcol < nf),
-                        f2spec[np.clip(fcol, 0, nf - 1)],
-                        np.int32(-1)).astype(np.int32, copy=False)
-    # The spec-INDEPENDENT contiguous casts (the int32 value columns
-    # are ~2 ms per 100k-op history) are a pure representation
-    # transform of the packed journal — cache them on it, like
-    # packed_columns() itself; only fmap depends on the spec.  The
-    # cache is GUARDED by (packed.version, len(packed)): in-place
-    # column mutators bump `version` via History.invalidate_packed()
-    # (or PackedHistory directly), and a length change (journal grew
-    # between scans) also invalidates — a stale cache here would feed
-    # the native scanners columns the Python oracle no longer sees.
-    tag = (getattr(packed, "version", 0), len(packed))
-    cached = getattr(packed, "_scan_cols", None)
-    fixed = cached[1] if cached is not None and cached[0] == tag \
-        else None
-    if fixed is None:
-        fixed = (np.ascontiguousarray(packed.process, dtype=np.int32),
-                 np.ascontiguousarray(packed.type, dtype=np.uint8),
-                 np.ascontiguousarray(packed.value[:, 0].astype(
-                     np.int32)),
-                 np.ascontiguousarray(packed.value[:, 1].astype(
-                     np.int32)),
-                 np.ascontiguousarray(packed.vkind, dtype=np.uint8))
-        packed._scan_cols = (tag, fixed)
-    return (fixed[0], fixed[1], np.ascontiguousarray(fmap),
-            fixed[2], fixed[3], fixed[4])
-
-
-def _native_scan_cols(packed, spec, seen: dict, rows: list,
-                      max_open_bits: int, want_snaps: bool = True):
-    """Columnar twin of _native_scan: runs the fused C scan over the
-    history's native struct-of-arrays representation (built
-    incrementally by history.ColumnJournal at journal time, SURVEY.md
-    §7) — no per-op Python objects at all, ~25x the object walk.
-    Returns False when unavailable (no packed columns / no extension),
-    None when out of scope, else a _FastKey."""
-    from jepsen_tpu import native
-
-    if getattr(spec, "encode_op", None) is not None:
-        return None
-    mod = native.histscan()
-    if mod is None or not hasattr(mod, "fast_scan_cols"):
-        return False                 # cheap check BEFORE the casts
-    cols = _cols_args(packed, spec)
-    if cols is None:
-        return False
-    out = mod.fast_scan_cols(*cols, seen, rows, max_open_bits,
-                             1 if want_snaps else 0)
-    return _fastkey_from_native(out)
-
-
-class _StreamKey:
-    """The stream scanner's product: one scanned history already in
-    the grouped pipeline's wire layout (I = 1 compact row streams +
-    segment cum table) — see native/histscan.c fast_scan_streams.
-    Duck-types the _FastKey fields the pipeline reads (n_calls,
-    max_open, positions)."""
-
-    __slots__ = ("n_calls", "max_open", "n_rets", "lp_min", "ret32",
-                 "islot32", "iuop32", "cum", "seg_ends", "positions")
-
-    def __init__(self, n_calls, max_open, n_rets, lp_min, ret32,
-                 islot32, iuop32, cum, seg_ends, positions):
-        self.n_calls = n_calls
-        self.max_open = max_open
-        self.n_rets = n_rets
-        self.lp_min = lp_min
-        self.ret32 = ret32
-        self.islot32 = islot32
-        self.iuop32 = iuop32
-        self.cum = cum
-        self.seg_ends = seg_ends
-        self.positions = positions
-
-    @property
-    def k(self):
-        return len(self.seg_ends)
-
-    @property
-    def rtot(self):
-        return int(self.cum[-1]) if len(self.cum) else 0
-
-
-def _native_scan_streams(packed, spec, seen: dict, rows: list,
-                         max_open_bits: int, target: int):
-    """One fused C pass from packed columns to the grouped pipeline's
-    wire layout: scan + quiescent-cut segmentation + I=1 row streams
-    (native/histscan.c fast_scan_streams).  Returns False when
-    unavailable, None when out of scope, else a _StreamKey."""
-    from jepsen_tpu import native
-
-    # Scope check FIRST, mirroring _native_scan_cols: a custom
-    # encode_op is out of SCOPE for the C scanners (None — callers
-    # must not retry other native forms), not merely unavailable
-    # (False).  Checking module availability first conflated the two
-    # sentinels whenever the extension was missing (ADVICE r5).
-    if getattr(spec, "encode_op", None) is not None:
-        return None
-    mod = native.histscan()
-    if mod is None or not hasattr(mod, "fast_scan_streams"):
-        return False                 # cheap check BEFORE the casts
-    cols = _cols_args(packed, spec)
-    if cols is None:
-        return False
-    out = mod.fast_scan_streams(*cols, seen, rows, max_open_bits,
-                                target)
-    if out is None:
-        return None
-    n_calls, max_open, n_rets, lp_min, rs, isl, iu, cum, se, pos = out
-    return _StreamKey(
-        n_calls, max_open, n_rets, lp_min,
-        np.frombuffer(rs or b"", np.int32),
-        np.frombuffer(isl or b"", np.int32),
-        np.frombuffer(iu or b"", np.int32),
-        np.frombuffer(cum or b"", np.int32),
-        np.frombuffer(se or b"", np.int32),
-        np.frombuffer(pos or b"", np.int32))
-
-
-def _fill_block_stream(sk: "_StreamKey", Rp: int, Kp: int, U: int):
-    """Pad one _StreamKey into the common wire block (the same layout
-    _regs_fill_compact emits): rows u8[Rp] (ret+1 | (islot+1)<<4) ++
-    iuop u8|u16[Rp] ++ cum i32[Kp+1]."""
-    rtot = sk.rtot
-    rows_s = np.zeros(Rp, np.uint8)
-    rows_s[:rtot] = ((sk.ret32 + 1)
-                     | ((sk.islot32 + 1) << 4)).astype(np.uint8)
-    ud = np.uint8 if U <= 255 else np.uint16
-    iuop_s = np.zeros(Rp, ud)
-    iuop_s[:rtot] = sk.iuop32.astype(ud)
-    cum = np.zeros(Kp + 1, np.int32)
-    k = sk.k
-    cum[1:k + 1] = sk.cum[1:]
-    cum[k + 1:] = sk.cum[k]
-    return np.concatenate([rows_s, iuop_s.view(np.uint8),
-                           cum.view(np.uint8)])
-
-
-def _fast_scan(history, spec, seen: dict, rows: list,
-               max_open_bits: int, max_crashed: int = 0):
-    """Fused pairing + slot assignment + op interning for one key —
-    ONE pass over the ops instead of prepare() + _assign_slots() +
-    _encode_calls() building per-op objects (the host side dominated
-    multi-key bench wall time).  Returns a _FastKey, or None when the
-    key is outside the batch engine's scope (crashed calls beyond
-    `max_crashed`, too-deep concurrency, un-internable ops, custom
-    encode_op) — the caller sends those through the slow path.  Shared
-    seen/rows are only touched on success.
-
-    With `max_crashed > 0`, up to that many crashed (:info / unpaired)
-    calls are tolerated: each holds a permanent slot above the normal
-    range (see _FastKey.nc/.rn) and joins every snapshot from its
-    invoke onward; quiescent cuts count NORMAL open calls only."""
-    if getattr(spec, "encode_op", None) is not None:
-        return None                  # custom encodings take the slow path
-    ops = history.ops if isinstance(history, History) else \
-        History(history).ops
-    f_codes = spec.f_codes
-
-    # Pass 1: completion for each invocation position.
-    open_by_process: dict = {}
-    fate: dict = {}
-    n_client = 0
-    for pos, o in enumerate(ops):
-        p = o.process
-        if not (type(p) is int and p >= 0):
-            continue
-        n_client += 1
-        if o.type == "invoke":
-            if p in open_by_process:
-                # malformed history: send it to the slow path, whose
-                # prepare() raises the descriptive ValueError (the C
-                # twin does the same)
-                return None
-            open_by_process[p] = pos
-        else:
-            ip = open_by_process.pop(p, None)
-            if ip is not None:
-                fate[ip] = o
-    if open_by_process and max_crashed == 0:
-        return None                  # unpaired invokes stay open: crashed
-    if n_client == 0:
-        return _FastKey([], 0, 0)
-
-    # Pass 2: slots + interning + return records.
-    new_seen: dict = {}
-    new_rows: list = []
-    free: list = []
-    next_slot = 0
-    slot_of: dict = {}
-    uop_of: dict = {}
-    open_list: list = []
-    crashed_list: list = []          # [(temp slot -2-j, uop), ...]
-    rets: list = []
-    cuts: list = []
-    max_open = 0
-    n_calls = 0
-    INT32 = 2 ** 31
-    for pos, o in enumerate(ops):
-        p = o.process
-        if not (type(p) is int and p >= 0):
-            continue
-        t = o.type
-        if t == "invoke":
-            comp = fate.get(pos)
-            crashed = comp is None or comp.type == "info"
-            if crashed and (max_crashed == 0
-                            or len(crashed_list) >= max_crashed):
-                return None          # crashed call (or too many)
-            if not crashed and comp.type == "fail":
-                continue             # the pair never happened: dropped
-            v = o.value if (o.value is not None or comp is None) \
-                else comp.value
-            fc = f_codes.get(o.f, -1)
-            if fc < 0:
-                return None          # model has no f-code for this op
-            # _generic_encode_op, inlined — isinstance (not exact-type)
-            # checks so int subclasses (IntEnum, ...) encode by VALUE
-            # exactly as the serial engines do
-            if isinstance(v, bool):
-                av, bv, okv = int(v), 0, True
-            elif isinstance(v, int):
-                av, bv, okv = v, 0, True
-            elif isinstance(v, (list, tuple)) and len(v) == 2 \
-                    and isinstance(v[0], int) and isinstance(v[1], int) \
-                    and not isinstance(v[0], bool) \
-                    and not isinstance(v[1], bool):
-                av, bv, okv = v[0], v[1], True
-            else:
-                av, bv, okv = 0, 0, False
-            if not (-INT32 <= av < INT32 and -INT32 <= bv < INT32):
-                return None          # outside the int32 device range
-            key = (fc, av, bv, okv)
-            u = seen.get(key)
-            if u is None:
-                u = new_seen.get(key)
-            if u is None:
-                u = new_seen[key] = len(rows) + len(new_rows)
-                new_rows.append(key)
-            if crashed:
-                # permanent pseudo-slot, remapped to rn+j at the end
-                crashed_list.append((-2 - len(crashed_list), u))
-                n_calls += 1
-                continue
-            s = free.pop() if free else next_slot
-            if s == next_slot:
-                next_slot += 1
-            slot_of[p] = s
-            uop_of[p] = u
-            open_list.append(p)
-            if len(open_list) > max_open:
-                max_open = len(open_list)
-                if max_open > max_open_bits:
-                    return None      # too many simultaneously-open calls
-            n_calls += 1
-        elif t == "ok":
-            s = slot_of.get(p)
-            if s is None:
-                continue
-            rets.append((s, [(slot_of[q], uop_of[q])
-                             for q in open_list] + list(crashed_list)))
-            open_list.remove(p)
-            del slot_of[p]
-            del uop_of[p]
-            free.append(s)
-            cuts.append(1 if not open_list else 0)
-
-    seen.update(new_seen)
-    rows.extend(new_rows)
-    nc = len(crashed_list)
-    if nc:
-        # remap crashed pseudo-slots above the normal range
-        rn = max_open
-        rets = [(s, [(q if q >= 0 else rn + (-2 - q), u)
-                     for q, u in cands]) for s, cands in rets]
-        return _FastKey(rets, max_open, n_calls,
-                        cuts=np.asarray(cuts, np.int32), nc=nc, rn=rn)
-    return _FastKey(rets, max_open, n_calls,
-                    cuts=np.asarray(cuts, np.int32))
-
-
-def _assign_slots(events):
-    """Free-list slot assignment over (pos, kind, call_id) events.
-    Returns (rets, n_slots, still_open) where each ret is
-    (call_id, slot, [(open_call_id, open_slot), ...]) — the open set at
-    that return, target included."""
-    free: list[int] = []
-    next_slot = 0
-    slot_of: dict[int, int] = {}
-    open_calls: list[int] = []
-    rets: list[tuple[int, int, list[tuple[int, int]]]] = []
-    for _, kind, cid in events:
-        if kind == 0:
-            s = free.pop() if free else next_slot
-            if s == next_slot:
-                next_slot += 1
-            slot_of[cid] = s
-            open_calls.append(cid)
-        else:
-            rets.append((cid, slot_of[cid],
-                         [(c2, slot_of[c2]) for c2 in open_calls]))
-            open_calls.remove(cid)
-            free.append(slot_of[cid])
-    return rets, next_slot, open_calls
-
-
-def _decompose(legal: np.ndarray, next_state: np.ndarray):
-    """Diagonal + rank-1 decomposition (see SegPlan): decomposable iff
-    each op's state-changing transitions all target one state.  Returns
-    (diag_w, const_w, const_t0) or (None, None, None)."""
-    U, Sn = legal.shape
-    diag_w = np.zeros((U, Sn), np.float32)
-    const_w = np.zeros((U, Sn), np.float32)
-    const_t0 = np.zeros(U, np.int32)
-    for u in range(U):
-        targets = set()
-        for s in range(Sn):
-            if not legal[u, s]:
-                continue
-            if next_state[u, s] == s:
-                diag_w[u, s] = 1.0
-            else:
-                const_w[u, s] = 1.0
-                targets.add(int(next_state[u, s]))
-        if len(targets) > 1:
-            return None, None, None
-        if targets:
-            const_t0[u] = targets.pop()
-    return diag_w, const_w, const_t0
+# Host-side planning (scanning, segmentation, slot assignment, state
+# enumeration, decomposition) and the engine-routing decision live in
+# ops.planner (ISSUE 8); every name is re-exported here for the
+# long-standing `wgl_seg.<name>` callers and the differential
+# batteries.  This module keeps the device kernels and entry points.
+from jepsen_tpu.ops import planner
+from jepsen_tpu.ops.planner import (  # noqa: F401 - re-exports
+    _MAX_CRASHED, SegPlan, Unsupported, _FastKey, _RegsLayout,
+    _StreamKey, _assign_slots, _cols_args, _compact_many_block,
+    _compose_transfer, _decompose, _encode_calls, _enumerate_states,
+    _expand_fn, _fast_scan, _fastkey_from_native, _fill_block_stream,
+    _fk_arrays, _native_scan, _native_scan_cols, _native_scan_streams,
+    _next_pow2, _pack_cand_tables, _pack_regs, _pack_regs_single,
+    _pack_uop_tables, _pad_len, _regs_eligible, _regs_fill,
+    _regs_fill_compact, _scan_history, _segment_ends,
+    _segments_from_fk, _split_crashed, plan)
 
 
 # ---------------------------------------------------------------------------
@@ -1371,209 +635,6 @@ def _build_kernel_regs_packed(K: int, L: int, I: int, Wd: int, Sn: int,
     return jax.jit(fn)
 
 
-def _pack_uop_tables(legal: np.ndarray, next_state: np.ndarray,
-                     diag_w, const_w, const_t0, sn_words: int = 1):
-    """[U]-indexed transition tables for the register kernel — the same
-    decomposed / nibble forms _pack_cand_tables gathers on host, left
-    un-gathered for device-side lookup.  With sn_words = W > 1 the
-    decomposed state bitmasks come back as [U, W] uint32 (state s ->
-    word s // 32, bit s % 32) for the wide-state relaxed tier."""
-    U, Sn = legal.shape
-    if sn_words > 1:
-        assert diag_w is not None
-        a1 = np.zeros((U, sn_words), np.uint32)
-        a2 = np.zeros((U, sn_words), np.uint32)
-        for sw in range(sn_words):
-            lo, hi = sw * 32, min((sw + 1) * 32, Sn)
-            pw = (1 << np.arange(hi - lo, dtype=np.uint64)) \
-                .astype(np.uint64)
-            a1[:, sw] = ((diag_w[:, lo:hi] > 0).astype(np.uint64)
-                         * pw).sum(1).astype(np.uint32)
-            a2[:, sw] = ((const_w[:, lo:hi] > 0).astype(np.uint64)
-                         * pw).sum(1).astype(np.uint32)
-        return a1, a2, const_t0.astype(np.int32)
-    pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
-    if diag_w is not None:
-        aux1 = ((diag_w > 0).astype(np.uint64) * pow2).sum(1)
-        aux2 = ((const_w > 0).astype(np.uint64) * pow2).sum(1)
-        t0 = const_t0.astype(np.int32)
-    else:
-        aux1 = (legal.astype(np.uint64) * pow2).sum(1)
-        nib = (1 << (4 * np.arange(Sn, dtype=np.uint64))).astype(np.uint64)
-        aux2 = (next_state.astype(np.uint64) * nib).sum(1)
-        t0 = np.zeros(U, np.int32)
-    return (aux1.astype(np.uint32), aux2.astype(np.uint32), t0)
-
-
-def _pack_regs(batch, Kp: int, R: int, U: int, I: int):
-    """Delta-encode the whole batch for _build_kernel_regs: per return,
-    only the calls invoked since the previous return (derived from
-    consecutive candidate snapshots — between two returns a slot hosts
-    at most one new occupant, so a changed (slot -> uop) cell IS the new
-    invoke; an unchanged cell re-registers identical aux words, a
-    no-op).  Bursts beyond I spill into virtual rows (ret -1) BEFORE
-    their return's row.  Returns (ret_t [L', K], islot_t, iuop_t
-    [L', K, I], L')."""
-    # --- flatten all keys' snapshots ----------------------------------
-    rs_parts, cnt_parts, cs_parts, cu_parts, nr_parts = [], [], [], [], []
-    for _, fk in batch:
-        rs, counts, cs, cu = _fk_arrays(fk)
-        rs_parts.append(rs)
-        cnt_parts.append(counts)
-        cs_parts.append(cs)
-        cu_parts.append(cu)
-        nr_parts.append(len(rs))
-    rs_all = np.concatenate(rs_parts)
-    cnt_all = np.concatenate(cnt_parts)
-    cs_all = np.concatenate(cs_parts).astype(np.int64)
-    cu_all = np.concatenate(cu_parts)
-    nr_all = np.asarray(nr_parts, np.int64)
-    NR = len(rs_all)
-    ret_key = np.repeat(np.arange(len(batch)), nr_all)
-    key_start = np.concatenate([[0], np.cumsum(nr_all)[:-1]])
-    first_ret = key_start                       # global idx of row 0 per key
-
-    # dense snapshot matrix M[r, slot] = uop at return r, -1 empty
-    M = np.full((NR, R), -1, np.int64)
-    rowidx = np.repeat(np.arange(NR), cnt_all)
-    M[rowidx, cs_all] = cu_all
-    # previous snapshot with the returning slot freed
-    Oprev = np.full_like(M, -1)
-    Oprev[1:] = M[:-1]
-    idx = np.arange(1, NR)
-    Oprev[idx, rs_all[:-1].astype(np.int64)] = -1
-    Oprev[first_ret] = -1
-    D = (M != -1) & (M != Oprev)
-    c = D.sum(1).astype(np.int64)               # deltas per return
-
-    # --- row layout with virtual spill rows ---------------------------
-    e = np.maximum(0, (c + I - 1) // I - 1)     # virtual rows per return
-    ecum = np.cumsum(e)
-    ebase = np.concatenate([[0], ecum])[key_start]   # e-cumsum before key
-    r_local = np.arange(NR) - key_start[ret_key]
-    rho = r_local + (ecum - ebase[ret_key])     # local row of return r
-    rows_per_key = np.zeros(len(batch), np.int64)
-    np.maximum.at(rows_per_key, ret_key, rho + 1)
-    Lp = int(rows_per_key.max())
-    Lp = _pad_len(Lp)
-
-    ret_slot = np.full((Kp, Lp), -1, np.int8)
-    ret_slot[ret_key, rho] = rs_all.astype(np.int8)
-
-    # --- scatter delta entries into (row, col) ------------------------
-    ent_ret, ent_slot = np.nonzero(D)           # ordered by (ret, slot)
-    ent_uop = M[ent_ret, ent_slot]
-    starts = np.cumsum(c) - c
-    j = np.arange(len(ent_ret)) - starts[ent_ret]
-    from_end = c[ent_ret] - 1 - j
-    row = rho[ent_ret] - from_end // I
-    col = from_end % I
-    uop_dtype = np.int8 if U <= 127 else np.int16
-    inv_slot = np.full((Kp, Lp, I), -1, np.int8)
-    inv_uop = np.full((Kp, Lp, I), -1, uop_dtype)
-    inv_slot[ret_key[ent_ret], row, col] = ent_slot.astype(np.int8)
-    inv_uop[ret_key[ent_ret], row, col] = ent_uop.astype(uop_dtype)
-
-    ret_t = np.ascontiguousarray(ret_slot.T)
-    islot_t = np.ascontiguousarray(inv_slot.transpose(1, 0, 2))
-    iuop_t = np.ascontiguousarray(inv_uop.transpose(1, 0, 2))
-    return ret_t, islot_t, iuop_t, Lp
-
-
-class _RegsLayout:
-    """Row/column placement of one scanned key's delta stream across
-    its segments — everything _regs_fill needs to scatter the tables,
-    plus the minimal (Lp, K) shape.  Computing layouts for a whole
-    pipeline batch first lets every history fill DIRECTLY at the
-    common padded shape (no per-history np.pad / transpose copies)."""
-
-    __slots__ = ("ret_key", "rho", "rs", "ent_key", "row", "col",
-                 "dslot", "duop", "lp_min", "k", "rows_per_key")
-
-    def __init__(self, fk, seg_ends, I: int):
-        rs = _fk_arrays(fk)[0]
-        dc, dslot, duop = fk.deltas
-        NR = len(rs)
-        K = len(seg_ends)
-        nr_all = np.diff(np.concatenate([[0], seg_ends]))
-        key_end = np.cumsum(nr_all)
-        ret_key = np.repeat(np.arange(K), nr_all)
-        key_start = np.concatenate([[0], key_end[:-1]])
-        c = dc.astype(np.int64)
-        e = np.maximum(0, (c + I - 1) // I - 1)
-        ecum = np.cumsum(e)
-        ebase = np.concatenate([[0], ecum])[key_start]
-        r_local = np.arange(NR) - key_start[ret_key]
-        rho = r_local + (ecum - ebase[ret_key])
-        ent_ret = np.repeat(np.arange(NR), c)
-        starts = np.cumsum(c) - c
-        j = np.arange(len(dslot)) - starts[ent_ret]
-        from_end = c[ent_ret] - 1 - j
-        self.ret_key = ret_key
-        self.rho = rho
-        self.rs = rs
-        self.ent_key = ret_key[ent_ret]
-        self.row = rho[ent_ret] - from_end // I
-        self.col = from_end % I
-        self.dslot = dslot
-        self.duop = duop
-        # rho is monotone within a segment, so each segment's row count
-        # sits at its LAST return — no np.maximum.at (whose buffered
-        # scatter was the single hottest line of the pipeline's host
-        # side at ~3 ms per 100k-op history)
-        self.rows_per_key = (rho[key_end - 1] + 1 if NR and K
-                             else np.zeros(K, np.int64))
-        self.lp_min = int(self.rows_per_key.max()) if K and NR else 0
-        self.k = K
-
-
-def _regs_fill(lay: "_RegsLayout", Lp: int, K: int, U: int, I: int):
-    """Scatter one layout into [Lp, K(, I)] tables (already in the
-    kernel's transposed orientation — no copies).  Padding rows/lanes
-    beyond the layout's own shape are exact no-ops (ret -1, no
-    invokes)."""
-    ret_t = np.full((Lp, K), -1, np.int8)
-    ret_t[lay.rho, lay.ret_key] = lay.rs.astype(np.int8)
-    uop_dtype = np.int8 if U <= 127 else np.int16
-    islot_t = np.full((Lp, K, I), -1, np.int8)
-    iuop_t = np.full((Lp, K, I), -1, uop_dtype)
-    islot_t[lay.row, lay.ent_key, lay.col] = lay.dslot.astype(np.int8)
-    iuop_t[lay.row, lay.ent_key, lay.col] = lay.duop.astype(uop_dtype)
-    return ret_t, islot_t, iuop_t
-
-
-def _regs_fill_compact(lay: "_RegsLayout", Rp: int, Kp: int, U: int):
-    """Pack one layout (I = 1) into the COMPACT wire block the grouped
-    pipeline ships: segment-major row streams with NO [Lp, K] padding —
-    rows u8[Rp] (low nibble ret+1, high nibble islot+1; 0 = the -1
-    sentinel, so a slot id s rides as s+1 <= 15 — the R <= 14 gate
-    guarantees the fit) ++ iuop u8[Rp] (2-byte LE when U > 255) ++
-    cum i32[Kp + 1].  cum[k] is segment k's start row in the streams;
-    the device rebuilds the padded [L, K] tables with a masked gather
-    (see _build_kernel_regs_group_c), so the tunnel carries ~10x fewer
-    bytes than the padded tables did — on the tunneled chip the wire,
-    not compute, bounds the easy regime (BENCH_r05's north-star
-    decomposition).  Rows beyond a segment's count and rows in
-    cum[lay.k]..Rp are sentinel (0 nibbles): exact no-ops in the
-    kernel."""
-    cum = np.zeros(Kp + 1, np.int32)
-    np.cumsum(lay.rows_per_key, out=cum[1:lay.k + 1])
-    cum[lay.k + 1:] = cum[lay.k]
-    rtot = int(cum[lay.k])
-    rows_s = np.zeros(Rp, np.uint8)
-    base = cum[lay.ret_key]
-    rows_s[base + lay.rho] = (lay.rs + 1).astype(np.uint8)
-    idx = cum[lay.ent_key] + lay.row
-    rows_s[idx] |= ((lay.dslot + 1).astype(np.uint8) << 4)
-    if U <= 255:
-        iuop_s = np.zeros(Rp, np.uint8)
-        iuop_s[idx] = lay.duop.astype(np.uint8)
-        iu8 = iuop_s
-    else:
-        iuop_s = np.zeros(Rp, np.uint16)
-        iuop_s[idx] = lay.duop.astype(np.uint16)
-        iu8 = iuop_s.view(np.uint8)
-    return np.concatenate([rows_s, iu8, cum.view(np.uint8)]), rtot
 
 
 @functools.lru_cache(maxsize=32)
@@ -1663,7 +724,8 @@ def _build_kernel_regs_group_c(B: int, K: int, L: int, Wd: int,
 @functools.lru_cache(maxsize=32)
 def _build_kernel_regs_many_c(K: int, L: int, Wd: int, Sn: int, R: int,
                               decomposed: bool, rounds: int,
-                              unroll: int, U: int, Rp: int):
+                              unroll: int, U: int, Rp: int,
+                              donate: bool = False):
     """Compact-wire twin of check_many's J=1 register kernel (I = 1):
     the whole key batch travels as ONE uint8 buffer of key-major row
     streams (rows u8[Rp]: ret+1 | (islot+1)<<4; iuop u8|u16[Rp]; cum
@@ -1671,7 +733,14 @@ def _build_kernel_regs_many_c(K: int, L: int, Wd: int, Sn: int, R: int,
     masked gathers — the multi-key bench's padded tables were ~3x the
     stream bytes, and on the tunneled chip the wire bounds the batch
     wall (BENCH_r05 wire model, docs/environments.md).  Output
-    [K, 1, Sn] like the padded form."""
+    [K, 1, Sn] like the padded form.
+
+    `donate=True` donates the per-chunk event buffer (arg 0) to the
+    executable so the double-buffered executor's chunk k buffer is
+    reclaimed as chunk k+1 transfers — every dispatch re-packs a fresh
+    host buffer, so an OOM retry never touches a consumed donation.
+    (Callers gate it off the 'cpu' backend, where XLA ignores donation
+    with a warning.)"""
     import jax
     import jax.numpy as jnp
 
@@ -1705,83 +774,9 @@ def _build_kernel_regs_many_c(K: int, L: int, Wd: int, Sn: int, R: int,
                                           jnp.int32)
         return kern(ret, islot, iuop, a1, a2, t0)
 
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(0,)) if donate else jax.jit(fn)
 
 
-def _compact_many_block(ret_t, islot_t, iuop_t, Kp: int, U: int):
-    """Compress _pack_regs' I=1 padded tables into the key-major
-    compact stream block _build_kernel_regs_many_c consumes.  Each
-    lane's live rows are a contiguous prefix (returns + spills in
-    stream order, padding after), so the block is one ragged gather."""
-    Lp = ret_t.shape[0]
-    valid = (ret_t != -1) | (islot_t[:, :, 0] != -1)    # [Lp, Kp]
-    n_rows = np.where(valid, np.arange(Lp)[:, None] + 1, 0) \
-        .max(axis=0).astype(np.int64)                   # [Kp]
-    cum = np.zeros(Kp + 1, np.int32)
-    np.cumsum(n_rows, out=cum[1:])
-    total = int(cum[-1])
-    Rp = ((total + 8191) // 8192) * 8192
-    key_of = np.repeat(np.arange(Kp), n_rows)
-    row_of = np.arange(total) - np.repeat(cum[:-1].astype(np.int64),
-                                          n_rows)
-    rows_s = np.zeros(Rp, np.uint8)
-    rows_s[:total] = (
-        (ret_t[row_of, key_of].astype(np.int32) + 1)
-        | ((islot_t[row_of, key_of, 0].astype(np.int32) + 1)
-           << 4)).astype(np.uint8)
-    ud = np.uint8 if U <= 255 else np.uint16
-    iuop_s = np.zeros(Rp, ud)
-    iuop_s[:total] = np.maximum(
-        iuop_t[row_of, key_of, 0].astype(np.int32), 0).astype(ud)
-    return np.concatenate([rows_s, iuop_s.view(np.uint8),
-                           cum.view(np.uint8)]), Rp
-
-
-def _pack_regs_single(fk, seg_ends: np.ndarray, R: int, U: int, I: int):
-    """Delta-encode ONE scanned key split at `seg_ends` — the fast twin
-    of _pack_regs for the single-history path.  The columnar scanner
-    already emitted the invoke-delta stream (fk.deltas), so no dense
-    snapshot matrices are rebuilt here: segment boundaries sit at
-    quiescent cuts where nothing is open, which is exactly why the
-    per-return delta stream is valid for ANY such segmentation (the
-    first return of a segment registers precisely the calls invoked
-    since the cut).  Layout math (virtual spill rows before their
-    return) is identical to _pack_regs."""
-    lay = _RegsLayout(fk, seg_ends, I)
-    Lp = _pad_len(lay.lp_min)
-    ret_t, islot_t, iuop_t = _regs_fill(lay, Lp, lay.k, U, I)
-    return ret_t, islot_t, iuop_t, Lp
-
-
-def _pack_cand_tables(cand_uop: np.ndarray, legal: np.ndarray,
-                      next_state: np.ndarray, diag_w, const_w, const_t0):
-    """Host-side packing of per-candidate transition tables into the
-    uint32 bitmask form _build_kernel_bits consumes (aux1, aux2, t0 —
-    all shaped like cand_uop).  Decomposed: aux1/aux2 = diag/const
-    state-bitmasks.  Non-decomposed (Sn <= 8): aux1 = legality bitmask,
-    aux2 = next-state nibble-pack."""
-    U, Sn = legal.shape
-    ju = np.clip(cand_uop, 0, None)
-    live = cand_uop >= 0
-    pow2 = (1 << np.arange(Sn, dtype=np.uint64)).astype(np.uint64)
-    # Narrowest bitmask dtype that holds Sn bits: host->device transfer
-    # of these [L, K, C] tables dominates large batches.
-    bm_dtype = (np.uint8 if Sn <= 8 else
-                np.uint16 if Sn <= 16 else np.uint32)
-    if diag_w is not None:
-        diag_u = ((diag_w > 0).astype(np.uint64) * pow2).sum(1)
-        const_u = ((const_w > 0).astype(np.uint64) * pow2).sum(1)
-        aux1 = (diag_u[ju] * live).astype(bm_dtype)
-        aux2 = (const_u[ju] * live).astype(bm_dtype)
-        t0 = const_t0[ju].astype(np.int8)
-    else:
-        legal_u = (legal.astype(np.uint64) * pow2).sum(1)
-        nib = (1 << (4 * np.arange(Sn, dtype=np.uint64))).astype(np.uint64)
-        next_u = (next_state.astype(np.uint64) * nib).sum(1)
-        aux1 = (legal_u[ju] * live).astype(bm_dtype)
-        aux2 = (next_u[ju] * live).astype(np.uint32)
-        t0 = np.zeros_like(cand_uop, dtype=np.int8)
-    return aux1, aux2, t0
 
 
 # ---------------------------------------------------------------------------
@@ -2153,50 +1148,6 @@ def _localize_segment(model, spec, ops, fk, seg_ends, dead: int,
     return o
 
 
-def _compose_transfer(T: np.ndarray, Sn: int) -> int:
-    """Compose transfer matrices left-to-right from entry state 0
-    (K tiny matvecs); returns the first dead segment or -1."""
-    v = np.zeros(Sn, bool)
-    v[0] = True
-    for k in range(T.shape[0]):
-        v = v @ T[k]
-        if not v.any():
-            return k
-    return -1
-
-
-def _split_crashed(ops):
-    """One host pass over a key's ops: find crashed client calls
-    (:info completion, or invoke with no completion).  Returns
-    (drop bool[n], crashed) where drop marks crashed invokes and their
-    :info completions and crashed lists (inv_pos, info_pos | -1, op) in
-    invocation order — or None for malformed histories (double invoke),
-    which the slow path's prepare() rejects with the descriptive
-    error."""
-    open_by_process: dict = {}
-    info_of: dict = {}
-    for pos, o in enumerate(ops):
-        p = o.process
-        if not (type(p) is int and p >= 0):
-            continue
-        if o.type == "invoke":
-            if p in open_by_process:
-                return None
-            open_by_process[p] = pos
-        else:
-            ip = open_by_process.pop(p, None)
-            if ip is not None and o.type == "info":
-                info_of[ip] = pos
-    crashed_pos = sorted(set(open_by_process.values()) | set(info_of))
-    drop = np.zeros(len(ops), bool)
-    crashed = []
-    for ip in crashed_pos:
-        cp = info_of.get(ip, -1)
-        drop[ip] = True
-        if cp >= 0:
-            drop[cp] = True
-        crashed.append((ip, cp, ops[ip]))
-    return drop, crashed
 
 
 def _relaxed_refute(model, spec, history, ops, drop, crashed,
@@ -2546,44 +1497,6 @@ def _check_crashed_fast(model, spec, history, *, max_states,
         backend_name=backend_name, localize=localize, t0=t0)
 
 
-def _segments_from_fk(fk, R: int, seg_ends):
-    """Slice one key's scanned return stream at the given segment ends
-    (quiescent cuts, from _segment_ends); returns per-segment
-    _FastKeys."""
-    rs, counts, cs, cu = _fk_arrays(fk)
-    cand_off = np.concatenate([[0], np.cumsum(counts)])
-    seg_fk = []
-    lo = 0
-    for hi in seg_ends:
-        seg_fk.append(_FastKey(
-            None, R, int(hi - lo),
-            arrays=(rs[lo:hi], counts[lo:hi],
-                    cs[cand_off[lo]:cand_off[hi]],
-                    cu[cand_off[lo]:cand_off[hi]])))
-        lo = hi
-    return seg_fk
-
-
-def _scan_history(h, ops, spec, seen: dict, rows: list,
-                  max_open_bits: int, want_snaps: bool = True):
-    """The one scan-fallback policy shared by every engine entry point:
-    columnar C scan when the history carries packed columns, then the
-    object C scan, then the pure-Python twin.  Returns a _FastKey or
-    None (out of scope — crashed calls, deep concurrency, unencodable
-    values); all three scanners are differentially pinned to classify
-    identically.  want_snaps=False skips candidate-snapshot emission
-    for callers that consume only the delta stream (fk.arrays then
-    carries empty cand_slots/cand_uops)."""
-    fk = _native_scan_cols(
-        h.packed_columns() if isinstance(h, History) else None,
-        spec, seen, rows, max_open_bits, want_snaps)
-    if fk is False or fk is None:
-        fk = _native_scan(ops, spec, seen, rows, max_open_bits)
-    if fk is False:
-        fk = _fast_scan(h, spec, seen, rows, max_open_bits)
-    return fk
-
-
 def _check_deep(model, ops, fk, legal, next_state,
                 diag_w, const_w, const_t0, *, R, Sn, nc, localize,
                 backend_name, t0):
@@ -2699,24 +1612,32 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
     Sn = states.shape[0]
     R = rn + nc if nc else int(fk.max_open)
     diag_w, const_w, const_t0 = _decompose(legal, next_state)
-    if (not _regs_eligible(R, legal.shape[0], Sn, diag_w is not None,
-                           r_cap=8 if nc else 6)
-            or (Sn << nc) > 128):
-        # Deep-overlap regime (or a crash set too wide for the
-        # J = Sn * 2^nc entry axis): the serial Pallas megakernel
-        # walks the whole history with the 2^R plane in VMEM —
-        # crashed calls are just permanent slots there (ops.wgl_deep).
-        # Only the REGIME diverts here: the JEPSEN_TPU_NO_REGS /
-        # JEPSEN_TPU_DYN_ROUNDS escape hatches keep their documented
-        # meaning (the candidate-table path) — see _regs_eligible.
-        if (mesh is None
-                and os.environ.get("JEPSEN_TPU_NO_REGS") != "1"
-                and os.environ.get("JEPSEN_TPU_DYN_ROUNDS") != "1"
-                and (R > (8 if nc else 6) or (Sn << nc) > 128)):
-            return _check_deep(
+    # THE routing decision (ops.planner): register-delta segment kernel
+    # vs deep-overlap Pallas megakernel (crashed calls are permanent
+    # slots there; a crash set too wide for the J = Sn * 2^nc entry
+    # axis diverts the same way) vs the candidate-table plan() route
+    # (None).  The JEPSEN_TPU_NO_REGS / JEPSEN_TPU_DYN_ROUNDS escape
+    # hatches keep their documented meaning (the candidate-table path)
+    # via the planner's prune table.
+    route = planner.plan_engines(
+        planner.Shape(kind="linear", R=rn if nc else int(fk.max_open),
+                      crashes=nc, Sn=int(Sn), U=int(legal.shape[0]),
+                      decomposed=diag_w is not None,
+                      n_ops=int(fk.n_calls),
+                      mesh=None if mesh is None else int(
+                          np.prod(mesh.devices.shape)),
+                      max_states=max_states,
+                      max_open_bits=max_open_bits),
+        backend=backend_name)
+    if route.engine != "wgl_seg_regs":
+        if route.engine == "wgl_deep" and mesh is None:
+            r = _check_deep(
                 model, ops, fk, legal, next_state,
                 diag_w, const_w, const_t0, R=R, Sn=Sn, nc=nc,
                 localize=localize, backend_name=backend_name, t0=t0)
+            if isinstance(r, dict):
+                r["_plan"] = route
+            return r
         return None
 
     # segment at quiescent cuts, >= target returns per segment
@@ -2757,6 +1678,9 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         "sharded": sharded,
         "time_plan_s": t_plan,
         "time_kernel_s": t_kernel,
+        "_plan": route.refine(
+            bucket=("wgl_seg_regs", R, int(Sn), int(legal.shape[0]),
+                    K)),
     }
     if nc:
         result["crashed"] = nc
@@ -2796,22 +1720,41 @@ def check(model, history, *, max_states: int = 64, max_open_bits: int = 10,
                     max_open_bits=max_open_bits,
                     target_returns_per_segment=target_returns_per_segment,
                     localize=localize, mesh=mesh, mesh_axis=mesh_axis)
-    if isinstance(r, dict) and "dispatch" not in r:
-        telemetry_mod.attach_dispatch(
-            [r],
-            telemetry_mod.dispatch_record(
-                r.get("engine", "wgl_seg"),
-                why=(r.get("refutation") or r.get("crash_tier")
-                     or "scalar segment chain"),
-                fallback_chain=["wgl_seg._check_crashed_fast",
-                                "wgl_deep", "wgl", "wgl_cpu"],
-                R=r.get("max_open"),
-                crashes=r.get("crashed_ignored"),
-                batch=1,
-                mesh=(getattr(mesh, "shape", None)
-                      if mesh is not None else None)),
-            stages={"plan": r.get("time_plan_s"),
-                    "kernel": r.get("time_kernel_s")})
+    if isinstance(r, dict):
+        # the fast path stashed the planner-emitted Plan; the crash
+        # tiers and the plan() route synthesize one so EVERY verdict
+        # renders a plan (why + fallbacks + bucket) verbatim
+        pl = r.pop("_plan", None)
+        if pl is None and "dispatch" not in r:
+            # crash tiers / the plan() route: re-derive the plan from
+            # what the verdict discloses (same pure function, so the
+            # env-knob prunes render here too), keeping the tier's own
+            # why when it named one
+            pl = planner.plan_engines(
+                planner.Shape(
+                    kind="linear",
+                    R=int(r.get("max_open") or 0),
+                    crashes=int(r.get("crashed")
+                                or r.get("crashed_ignored") or 0),
+                    Sn=r.get("states"),
+                    max_states=max_states,
+                    max_open_bits=max_open_bits),
+                backend=r.get("backend"))
+            tier_why = r.get("refutation") or r.get("crash_tier")
+            if tier_why:
+                pl = pl.refine(why=str(tier_why))
+        if "dispatch" not in r:
+            telemetry_mod.attach_dispatch(
+                [r],
+                pl.record(
+                    engine=r.get("engine", "wgl_seg"),
+                    R=r.get("max_open"),
+                    crashes=r.get("crashed_ignored"),
+                    batch=1,
+                    mesh=(getattr(mesh, "shape", None)
+                          if mesh is not None else None)),
+                stages={"plan": r.get("time_plan_s"),
+                        "kernel": r.get("time_kernel_s")})
     return r
 
 
@@ -3165,7 +2108,11 @@ def check_pipeline(model, histories, *, max_states: int = 64,
             fn = None
         if fn is None:
             spec_rounds = min(R_cur, spec_rounds_env)
-            fn = _build_kernel_regs_group_c(
+            fn = planner.compiled(
+                "wgl_seg_pipeline",
+                (G, K_c, Lp_c, R_cur, int(Sn), U, Rp_c, spec_rounds,
+                 unroll, diag_w is not None),
+                _build_kernel_regs_group_c,
                 G, K_c, Lp_c, max(1, (1 << R_cur) // 32), int(Sn),
                 R_cur, diag_w is not None, spec_rounds, unroll, U,
                 Rp_c)
@@ -3253,19 +2200,24 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                                 res[key] = oracle[key]
                 results[i] = res
         _acc("assemble", t0)
-    # pipelined verdicts carry the pipeline's dispatch record + stage
+    # pipelined verdicts carry the pipeline's plan + stage
     # decomposition; stragglers (checked below through check()'s own
-    # chain) carry the record check() attaches for the engine that
+    # chain) carry the plan check() attaches for the engine that
     # actually produced them
     from jepsen_tpu import telemetry as telemetry_mod
+    pipe_plan = planner.plan_engines(
+        planner.Shape(kind="linear-pipeline", R=R_cur, Sn=Sn or None,
+                      U=len(rows) or None, decomposed=True, batch=n,
+                      max_states=max_states,
+                      max_open_bits=max_open_bits),
+        backend=backend_name).refine(
+        bucket=("wgl_seg_pipeline", R_cur, int(Sn), G, Lp_c, K_c,
+                Rp_c))
     telemetry_mod.attach_dispatch(
         results,
-        telemetry_mod.dispatch_record(
-            "wgl_seg",
-            why="pipelined segment engine (grouped dispatch, one fetch)",
-            fallback_chain=["wgl_seg.check", "wgl_deep", "wgl",
-                            "wgl_cpu"],
-            R=R_cur or None, batch=n, stragglers=len(strag) or None),
+        pipe_plan.record(engine="wgl_seg",
+                         R=R_cur or None, batch=n,
+                         stragglers=len(strag) or None),
         stages=stats)
     for i in strag:
         results[i] = check(model, histories[i], max_states=max_states,
@@ -3274,22 +2226,6 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                            target_returns_per_segment,
                            localize=localize)
     return results
-
-
-def _fk_arrays(fk: "_FastKey"):
-    """Flat (ret_slots, cand_counts, cand_slots, cand_uops) arrays for
-    either scanner form."""
-    if fk.arrays is not None:
-        return fk.arrays
-    rs = np.fromiter((r[0] for r in fk.rets), np.int32,
-                     count=len(fk.rets))
-    counts = np.fromiter((len(r[1]) for r in fk.rets), np.int32,
-                         count=len(fk.rets))
-    cs = np.fromiter((s for _, cands in fk.rets for s, _ in cands),
-                     np.int32)
-    cu = np.fromiter((u for _, cands in fk.rets for _, u in cands),
-                     np.int32)
-    return rs, counts, cs, cu
 
 
 def _run_segmented(batch, legal, next_state, diag_w, const_w, const_t0,
@@ -3441,6 +2377,90 @@ def _emit_batch_result(results, i, fk, ok: bool, backend_name: str,
 # Multi-key batch mode (jepsen.independent on device)
 # ---------------------------------------------------------------------------
 
+def _overlap_chunk() -> int:
+    """Keys per double-buffered dispatch chunk (0 disables chunking:
+    one monolithic pack + dispatch, the pre-overlap behavior)."""
+    return int(os.environ.get("JEPSEN_TPU_OVERLAP_CHUNK", "1024"))
+
+
+def _run_many_overlapped(batch, R: int, U: int, Sn: int, M: int,
+                         decomposed: bool, unroll: int,
+                         buf32: np.ndarray, stats: dict, _acc_s,
+                         backend_name: str):
+    """check_many's compact register-delta path through the async
+    double-buffered executor (ops.runner.overlap): the key batch is cut
+    into chunks; chunk k+1's host packing (_pack_regs +
+    _compact_many_block — the dominant host cost on the 3400-key bench
+    row) runs while the device executes chunk k's kernel (JAX dispatch
+    is asynchronous), and ALL chunk verdicts are stacked on device and
+    fetched in ONE round trip.  Per-chunk event buffers are donated to
+    the executable off-CPU (fresh host buffer per dispatch, so an OOM
+    retry never touches a consumed donation).  Chunks share one padded
+    lane count, and Lp/Rp bucket at 64/8192 granularity, so a uniform
+    batch reuses ONE compiled executable (planner.compiled counts the
+    hits).  Verdict-identical to the monolithic dispatch: keys are
+    independent and chunking only partitions the lane axis
+    (differentially pinned in tests/test_planner.py).
+
+    Returns (ok bool[len(batch)], kernel+fetch seconds)."""
+    from jepsen_tpu.ops import runner as runner_mod
+
+    chunk = _overlap_chunk()
+    if chunk <= 0 or len(batch) <= chunk:
+        chunks = [batch]
+    else:
+        chunks = [batch[k:k + chunk]
+                  for k in range(0, len(batch), chunk)]
+    Kp = max(128, ((min(len(batch), chunk or len(batch)) + 127)
+                   // 128) * 128)
+    donate = backend_name not in ("cpu", "unknown") \
+        and os.environ.get("JEPSEN_TPU_NO_DONATE") != "1"
+
+    def pack(ch):
+        t0 = time.monotonic()
+        ret_t, islot_t, iuop_t, Lp = _pack_regs(ch, Kp, R, U, 1)
+        buf8, Rp = _compact_many_block(ret_t, islot_t, iuop_t, Kp, U)
+        _acc_s("fill", t0)
+        stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                               + buf8.nbytes + buf32.nbytes)
+        return buf8, int(Lp), Rp
+
+    def dispatch(payload):
+        import jax
+
+        buf8, Lp, Rp = payload
+        # AOT: jit(...).lower(...).compile() inside planner.compiled,
+        # so the XLA compile is timed and charged to
+        # cache_stats()['compile_s'] (and lands in the persistent
+        # plan cache) instead of hiding in the first device call
+        kern = planner.compiled(
+            "wgl_seg_batch_regs",
+            (Kp, Lp, R, Sn, U, Rp, unroll, decomposed, donate),
+            _build_kernel_regs_many_c,
+            Kp, Lp, max(1, M // 32), Sn, R, decomposed, R, unroll,
+            U, Rp, donate,
+            lower_args=(jax.ShapeDtypeStruct(buf8.shape, buf8.dtype),
+                        jax.ShapeDtypeStruct(buf32.shape,
+                                             buf32.dtype)))
+        return kern(buf8, buf32)        # async device call
+
+    t1 = time.monotonic()
+    outs = runner_mod.overlap(chunks, pack, dispatch, depth=2)
+    if len(outs) == 1:
+        T = np.asarray(outs[0])                      # [Kp, 1, Sn]
+        ok = (T[:, 0, :] > 0.5).any(axis=1)[:len(batch)]
+    else:
+        stacked = _build_stack(len(outs))(*outs)     # ONE fetch
+        T = np.asarray(stacked)                      # [G, Kp, 1, Sn]
+        ok_all = (T[:, :, 0, :] > 0.5).any(axis=2)   # [G, Kp]
+        ok = np.concatenate(
+            [ok_all[g][:len(ch)] for g, ch in enumerate(chunks)])
+    t_kernel = time.monotonic() - t1
+    stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
+    stats["overlap_chunks"] = len(chunks)
+    return ok, t_kernel
+
+
 def check_many(model, histories, *, max_states: int = 64,
                max_open_bits: int = 10, localize: bool = True,
                mesh=None, mesh_axis: Optional[str] = None,
@@ -3536,6 +2556,7 @@ def check_many(model, histories, *, max_states: int = 64,
         ts = _acc_s("tables", ts)
 
     R_batch = None
+    route = None
     if batch:
         Sn = states.shape[0]
         R = max(fk.max_open for _, fk in batch)
@@ -3545,19 +2566,27 @@ def check_many(model, histories, *, max_states: int = 64,
         # calls, <= R.
         L = _pad_len(max(fk.n_rets for _, fk in batch))
         C = int(R)
+        diag_w, const_w, const_t0 = _decompose(legal, next_state)
 
-        # Opt-in segmented engine (JEPSEN_TPU_SEGMENT=1): cutting at
-        # quiescent points turns returns-per-key serial depth into
-        # returns-per-segment.  Measured on a v5e-1 it LOSES to the
-        # single-lane layout at both bench shapes — 300-op keys
-        # (2.0s vs 0.83s kernel) and 3000-op keys (1.6s vs 0.96s) —
-        # because the J=Sn entry-state axis multiplies total work ~Sn x
-        # while XLA keeps per-step cost low even at depth 4096.  Kept
-        # verdict-identical (differential tests) as the scaling path
-        # for workloads whose per-key depth actually binds.
-        if (mesh is None
-                and os.environ.get("JEPSEN_TPU_SEGMENT") == "1"):
-            diag_w, const_w, const_t0 = _decompose(legal, next_state)
+        # THE routing decision (ops.planner): register-delta compact
+        # lanes vs candidate-table lanes vs the opt-in segmented
+        # engine (JEPSEN_TPU_SEGMENT=1 prunes the single-lane layouts
+        # so the segmented tier surfaces — measured on a v5e-1 it
+        # LOSES to them at both bench shapes, 2.0s vs 0.83s kernel at
+        # 300-op keys, because the J=Sn entry-state axis multiplies
+        # total work ~Sn x; kept verdict-identical as the scaling path
+        # for workloads whose per-key depth actually binds).
+        route = planner.plan_engines(
+            planner.Shape(kind="linear-many", R=int(R), Sn=int(Sn),
+                          U=len(rows), decomposed=diag_w is not None,
+                          batch=len(batch),
+                          mesh=None if mesh is None else int(
+                              np.prod(mesh.devices.shape)),
+                          max_states=max_states,
+                          max_open_bits=max_open_bits),
+            backend=backend_name)
+
+        if route.engine == "wgl_seg_batch_seg":
             ok_b, t_kernel = _run_segmented(
                 batch, legal, next_state, diag_w, const_w, const_t0,
                 int(Sn), int(R), int(M), int(C))
@@ -3576,55 +2605,58 @@ def check_many(model, histories, *, max_states: int = 64,
             mult = int(np.lcm(mult, mesh.shape[mesh_axis]))
         Kp = max(mult, ((Kk + mult - 1) // mult) * mult)
 
-        diag_w, const_w, const_t0 = _decompose(legal, next_state)
         decomposed = diag_w is not None
         U = legal.shape[0]
 
         # Register-delta path (default): ship only per-return invoke
         # deltas and let the device maintain the open set — see
-        # _build_kernel_regs and the shared _regs_eligible gate.
-        if _regs_eligible(int(R), int(U), int(Sn), decomposed):
+        # _build_kernel_regs and the shared _regs_eligible gate
+        # (planner._linear_candidates routes on exactly that gate).
+        if route.engine == "wgl_seg_batch_regs":
             unroll = int(os.environ.get("JEPSEN_TPU_SCAN_UNROLL", "4"))
             a1t, a2t, t0t = _pack_uop_tables(
                 legal, next_state, diag_w, const_w, const_t0)
             if mesh is None:
-                # compact wire (I = 1): the whole batch as key-major
-                # row streams, tables rebuilt on device — ~3x fewer
-                # bytes than the padded tables, and the tunnel wire
-                # bounds this batch's wall
-                I = 1
-                ret_t, islot_t, iuop_t, Lp = _pack_regs(
-                    batch, Kp, int(R), int(U), I)
-                buf8, Rp = _compact_many_block(
-                    ret_t, islot_t, iuop_t, Kp, int(U))
+                # compact wire (I = 1): key-major row streams, tables
+                # rebuilt on device — ~3x fewer bytes than the padded
+                # tables, and the tunnel wire bounds this batch's
+                # wall.  Large batches run through the async
+                # double-buffered executor (ops.runner.overlap): host
+                # packing of chunk k+1 overlaps device compute of
+                # chunk k, all verdicts fetched ONCE at the end.
                 buf32 = np.concatenate(
                     [a1t, a2t, t0t.view(np.uint32)])
-                kern = _build_kernel_regs_many_c(
-                    Kp, int(Lp), max(1, M // 32), int(Sn), int(R),
-                    decomposed, int(R), unroll, int(U), Rp)
-                args = [buf8, buf32]
+                ok_k, t_kernel = _run_many_overlapped(
+                    batch, int(R), int(U), int(Sn), int(M),
+                    decomposed, unroll, buf32, stats, _acc_s,
+                    backend_name)
+                ts = _mt_s()
             else:
                 I = min(2, int(R))
                 ret_t, islot_t, iuop_t, Lp = _pack_regs(
                     batch, Kp, int(R), int(U), I)
-                kern = _build_kernel_regs(
+                kern = planner.compiled(
+                    "wgl_seg_batch_regs",
+                    (Kp, int(Lp), I, int(R), int(Sn), int(U),
+                     unroll, decomposed, "mesh"),
+                    _build_kernel_regs,
                     Kp, int(Lp), I, max(1, M // 32),
                     int(Sn), int(R), decomposed,
                     rounds=int(R), unroll=unroll)
                 args = _shard_args(
                     mesh, mesh_axis,
                     [ret_t, islot_t, iuop_t, a1t, a2t, t0t], 3)
-            ts = _acc_s("fill", ts)
-            stats["wire_bytes"] = (stats.get("wire_bytes", 0)
-                                   + sum(a.nbytes for a in args
-                                         if hasattr(a, "nbytes")))
-            t1 = time.monotonic()
-            T = np.asarray(kern(*args))                  # [Kp, 1, Sn]
-            t_kernel = time.monotonic() - t1
-            stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
-            ts = _mt_s()
+                ts = _acc_s("fill", ts)
+                stats["wire_bytes"] = (stats.get("wire_bytes", 0)
+                                       + sum(a.nbytes for a in args
+                                             if hasattr(a, "nbytes")))
+                t1 = time.monotonic()
+                T = np.asarray(kern(*args))              # [Kp, 1, Sn]
+                t_kernel = time.monotonic() - t1
+                stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
+                ts = _mt_s()
+                ok_k = (T[:, 0, :] > 0.5).any(axis=1)
             engine_name = "wgl_seg_batch_regs"
-            ok_k = (T[:, 0, :] > 0.5).any(axis=1)
             for kk, (i, fk) in enumerate(batch):
                 _emit_batch_result(results, i, fk, bool(ok_k[kk]),
                                    backend_name, engine_name, t_kernel,
@@ -3733,8 +2765,9 @@ def check_many(model, histories, *, max_states: int = 64,
             r["time_total_s"] = t_total
     # Dispatch records, grouped by the engine that actually produced
     # each verdict (batched kernel lanes, exact single-key crash
-    # chains, serial fallbacks): one shared record per engine, so the
-    # attribution costs dict references, not per-verdict env scans.
+    # chains, serial fallbacks): one shared plan-rendered record per
+    # engine, so the attribution costs dict references, not
+    # per-verdict env scans.
     from jepsen_tpu import telemetry as telemetry_mod
     by_engine: dict = {}
     for r in results:
@@ -3742,12 +2775,18 @@ def check_many(model, histories, *, max_states: int = 64,
             by_engine.setdefault(r.get("engine", "wgl_seg_batch"),
                                  []).append(r)
     n_crash = sum(stripped_note.values()) if stripped_note else None
+    if route is None:
+        route = planner.plan_engines(
+            planner.Shape(kind="linear-many", R=0,
+                          batch=len(histories),
+                          max_states=max_states,
+                          max_open_bits=max_open_bits),
+            backend=backend_name)
     for eng, rs in by_engine.items():
         telemetry_mod.attach_dispatch(
             rs,
-            telemetry_mod.dispatch_record(
-                eng, why="independent-keys batch (one lane per key)",
-                fallback_chain=["wgl_seg.check", "wgl", "wgl_cpu"],
+            route.record(
+                engine=eng,
                 R=R_batch, crashes=n_crash, batch=len(histories),
                 mesh=(getattr(mesh, "shape", None)
                       if mesh is not None else None)),

@@ -557,11 +557,13 @@ def attach_dispatch(results, record: dict,
     record the engine mix + stage seconds in the registry, and emit a
     `dispatch` event into the active run's log.  Returns the record."""
     st = None
+    _counts = ("wire_bytes", "overlap_chunks")   # ints, not seconds
     if stages:
         st = {k: round(float(v), 6) for k, v in stages.items()
-              if isinstance(v, (int, float)) and k != "wire_bytes"}
-        if "wire_bytes" in stages:
-            st["wire_bytes"] = int(stages["wire_bytes"])
+              if isinstance(v, (int, float)) and k not in _counts}
+        for k in _counts:
+            if k in stages:
+                st[k] = int(stages[k])
     n = 0
     for r in results if isinstance(results, (list, tuple)) else [results]:
         if isinstance(r, dict) and "dispatch" not in r:
@@ -573,7 +575,7 @@ def attach_dispatch(results, record: dict,
                      engine=record["engine"]).inc(max(n, 1))
     if st:
         for k, v in st.items():
-            if k != "wire_bytes":
+            if k not in _counts:
                 REGISTRY.counter("jepsen_stage_seconds_total",
                                  engine=record["engine"], stage=k).inc(v)
     if _active is not None:
@@ -652,7 +654,8 @@ def summarize(events: list[dict]) -> str:
         mix[rec.get("engine")] = mix.get(rec.get("engine"), 0) \
             + (e.get("verdicts") or 1)
         for k, v in (e.get("stages") or {}).items():
-            if k != "wire_bytes" and isinstance(v, (int, float)):
+            if k not in ("wire_bytes", "overlap_chunks") \
+                    and isinstance(v, (int, float)):
                 stages_acc[k] = stages_acc.get(k, 0.0) + v
     if mix:
         lines.append("engine mix: " + ", ".join(
@@ -661,6 +664,27 @@ def summarize(events: list[dict]) -> str:
     if stages_acc:
         lines.append("stage seconds: " + " ".join(
             f"{k}={v:.3f}" for k, v in sorted(stages_acc.items())))
+
+    # -- dispatch plans (ISSUE 8): the planner-emitted why + fallback
+    # chain behind each distinct routing decision, rendered verbatim —
+    # not the opaque engine-name list the pre-planner records carried
+    plans: dict = {}
+    for e in dispatches:
+        rec = e.get("record") or {}
+        key = (rec.get("engine"), rec.get("why"),
+               tuple(rec.get("fallback_chain") or ()))
+        plans.setdefault(key, rec.get("plan") or {})
+    shown = [(k, v) for k, v in plans.items() if k[1] or k[2]]
+    if shown:
+        lines.append("dispatch plans:")
+        for (eng, why, fb), pl in shown[:12]:
+            chain = " -> ".join((eng,) + fb) if fb else (eng or "?")
+            lines.append(f"  {chain}: {why or '?'}")
+            if pl.get("pruned"):
+                lines.append("    pruned by env: " + ", ".join(
+                    f"{knob} -{e2}" for knob, e2 in pl["pruned"]))
+        if len(shown) > 12:
+            lines.append(f"  ... {len(shown) - 12} more plans")
 
     # -- fault windows -----------------------------------------------------
     windows = pair_fault_windows(events)

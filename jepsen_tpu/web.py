@@ -411,9 +411,50 @@ def telemetry_run_html(name: str, ts: str) -> bytes:
         body.append(_sparkline_svg(series["p95_ms"], series["windows"],
                                    "#A5703B",
                                    label="op latency p95 (ms)"))
+    body.append(_dispatch_plans_html(events))
     body.append("<h2>Summary</h2><pre>"
                 + html.escape(telemetry.summarize(events)) + "</pre>")
     return _page(f"telemetry {name}/{ts}", "".join(body))
+
+
+def _dispatch_plans_html(events) -> str:
+    """The dispatch-plans panel (ISSUE 8): one row per distinct
+    planner-emitted plan — engine, WHY it was chosen, the fallback
+    chain below it, the compiled-shape bucket, and any env-knob
+    prunes — rendered from the `plan` field attach_dispatch records on
+    every verdict."""
+    seen: dict = {}
+    for e in events:
+        if e.get("type") != "dispatch":
+            continue
+        rec = e.get("record") or {}
+        key = (rec.get("engine"), rec.get("why"),
+               tuple(rec.get("fallback_chain") or ()))
+        if key in seen:
+            seen[key]["verdicts"] += e.get("verdicts") or 1
+        else:
+            seen[key] = {"rec": rec,
+                         "verdicts": e.get("verdicts") or 1}
+    if not seen:
+        return ""
+    rows = []
+    for (eng, why, fb), info in seen.items():
+        pl = info["rec"].get("plan") or {}
+        pruned = ", ".join(f"{k} &minus;{html.escape(str(e2))}"
+                           for k, e2 in (pl.get("pruned") or []))
+        rows.append(
+            "<tr>"
+            f"<td>{html.escape(str(eng))}</td>"
+            f"<td>{html.escape(str(why or ''))}</td>"
+            f"<td>{html.escape(' → '.join(fb))}</td>"
+            f"<td>{html.escape(str(pl.get('bucket') or ''))}</td>"
+            f"<td>{pruned}</td>"
+            f"<td>{info['verdicts']}</td></tr>")
+    return ("<h2>Dispatch plans</h2>"
+            "<table><tr><th>Engine</th><th>Why</th>"
+            "<th>Fallback chain</th><th>Bucket</th>"
+            "<th>Pruned by env</th><th>Verdicts</th></tr>"
+            + "".join(rows) + "</table>")
 
 
 def zip_bytes(name: str, ts: str) -> bytes:

@@ -55,6 +55,21 @@ def _mesh_device_count():
         return None
 
 
+def _plan_cache_stats():
+    """Compiled-plan cache hit/miss counters from the one engine
+    planner (ISSUE 8) — recorded per tier-1 run so a cache regression
+    (a shape-bucket change that turns warm hits into per-call
+    compiles) shows up as a diffable field across PRs, not a
+    still-green-but-slower suite."""
+    try:
+        from jepsen_tpu.ops import planner
+        st = planner.cache_stats()
+        st["compile_s"] = round(st.get("compile_s", 0.0), 3)
+        return st
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def pytest_sessionfinish(session, exitstatus):
     import json as _json
     import time as _time
@@ -69,6 +84,7 @@ def pytest_sessionfinish(session, exitstatus):
             "tests": len(per_test),
             "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
             "mesh_devices": _mesh_device_count(),
+            "plan_cache": _plan_cache_stats(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }

@@ -285,8 +285,12 @@ class TestCheckerMeshTier:
         d = v["dispatch"]
         assert d["engine"] == "elle-mesh"
         assert d["shards"] == 8
-        assert d["fallback_chain"] == ["elle-mesh", "elle-device",
-                                       "elle-host"]
+        # planner-emitted plan (ISSUE 8): strict mesh genuinely has no
+        # device tier below it — the chain says so instead of printing
+        # the whole tier family
+        assert d["fallback_chain"] == ["elle-host"]
+        assert d["plan"]["engine"] == "elle-mesh"
+        assert d["plan"]["why"]
         assert "round_s" in v["stages"]
 
     def test_auto_threshold_routes(self):
