@@ -1500,14 +1500,29 @@ def main() -> int:
     if plan_stats.get("error"):
         return 1
 
-    # Host-overlap attribution (ISSUE 8): the warm multi-key wall vs
+    # Host-overlap attribution (ISSUE 8/9): the warm multi-key wall vs
     # its kernel time — the double-buffered executor's target is
     # <= 1.5x (plan+pack+dispatch of chunk k+1 hidden behind chunk k's
-    # device compute; was 4.4x with the monolithic pack).
+    # device compute; was 4.4x with the monolithic pack), and the
+    # native parallel ingest layer (ISSUE 9) shrinks the host pack
+    # itself.  host_pack_s / pack_backend / pack_threads come off the
+    # verdicts' own stage decomposition + dispatch record, so the
+    # parsed artifact attributes the host side per the no-silent-caps
+    # principle.
     overlap_ratio = warm_s / max(kernel_s, 1e-9)
+    mk_stages = results[0].get("stages") or {}
+    mk_rec = results[0].get("dispatch") or {}
+    host_pack_s = mk_stages.get("pack", mk_stages.get("fill", 0.0))
+    host_scan_s = mk_stages.get("scan", 0.0)
+    mk_pack_backend = mk_rec.get("pack_backend") or \
+        (mk_rec.get("plan") or {}).get("pack_backend") or "python"
+    mk_pack_threads = mk_rec.get("pack_threads") or \
+        (mk_rec.get("plan") or {}).get("pack_threads") or 0
     print(f"# multi-key overlap: warm wall {warm_s:.3f}s / kernel "
           f"{kernel_s:.3f}s = {overlap_ratio:.2f}x (target <= 1.5x; "
-          "host packing double-buffered against device compute)",
+          f"host pack {host_pack_s:.3f}s + scan {host_scan_s:.3f}s on "
+          f"pack_backend={mk_pack_backend} x{mk_pack_threads}, "
+          "double-buffered against device compute)",
           file=sys.stderr)
 
     print(json.dumps({
@@ -1589,6 +1604,17 @@ def main() -> int:
         "plan_cache_warm_s": round(plan_stats["plan_cache_warm_s"], 2),
         "plan_cache_speedup": round(plan_stats["plan_cache_speedup"], 2),
         "overlap_wall_vs_kernel": round(overlap_ratio, 2),
+        # native parallel ingest attribution on the 3400-key row
+        # (BENCH_r06+, ISSUE 9): host pack seconds from the verdict's
+        # own stage decomposition, the ingest backend + thread count
+        # that ACTUALLY packed (from its dispatch record), and the
+        # headline wall-vs-kernel ratio the ingest layer targets
+        # (acceptance: <= 1.6x, from 4.4x in BENCH_r05)
+        "host_pack_s": round(host_pack_s, 4),
+        "host_scan_s": round(host_scan_s, 4),
+        "pack_backend": mk_pack_backend,
+        "pack_threads": int(mk_pack_threads),
+        "wall_vs_kernel": round(overlap_ratio, 2),
     }))
     print(f"# multi-key: {n_ops} ops / {N_KEYS} keys in {kernel_s:.3f}s "
           f"kernel (median {kernel_med:.3f}s; {warm_s:.2f}s wall incl. "

@@ -153,8 +153,12 @@ class Elle(ck.Checker):
         rows = None
         if pl.engine == "elle-mesh":
             try:
+                # packed planes come from the inference edge lists
+                # (sparse word-insertion on the native ingest layer),
+                # not a re-pack of the dense stacks
                 rows = elle_mesh.classify_mesh(
-                    stacks, include_order=self.include_order)
+                    stacks, include_order=self.include_order,
+                    inferences=inferences)
                 engine = "elle-mesh"
             except Exception as e:      # noqa: BLE001 - classified below
                 if not self._recoverable(e):

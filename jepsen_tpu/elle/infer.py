@@ -36,6 +36,7 @@ device kernels' job (`jepsen_tpu.ops.elle_graph`).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import numpy as np
 
@@ -60,6 +61,7 @@ class Inference:
     direct: dict                  # anomaly name -> [witness dicts]
     workload: str
     meta: dict = dataclasses.field(default_factory=dict)
+    edge_lists: Optional[dict] = None   # plane -> (src i64[], dst i64[])
 
     @property
     def n(self) -> int:
@@ -69,18 +71,82 @@ class Inference:
         """Planes as one [len(PLANES), n, n] bool array."""
         return np.stack([self.planes[p] for p in PLANES])
 
+    def packed_stacked(self, n_pad: Optional[int] = None,
+                       n_dev: int = 1) -> np.ndarray:
+        """Planes as one bit-packed uint32 [len(PLANES), n_pad, W]
+        stack — built by sparse word-insertion from the inference's
+        edge lists (ops.elle_mesh.set_bits, which rides the native
+        ingest layer), never materializing a second dense [P, n, n]
+        detour.  Equal to elle_mesh.pack_planes(self.stacked())."""
+        from jepsen_tpu.ops import elle_mesh
+        if n_pad is None:
+            n_pad = elle_mesh.pad_for_mesh(self.n, n_dev)
+        out = np.zeros((len(PLANES), n_pad, n_pad // 32), np.uint32)
+        if self.edge_lists is not None:
+            for pi, p in enumerate(PLANES):
+                src, dst = self.edge_lists[p]
+                elle_mesh.set_bits(out[pi], src, dst)
+        else:
+            return elle_mesh.pack_planes(self.stacked(), n_pad=n_pad,
+                                         n_dev=n_dev)
+        return out
+
 
 class _Edges:
+    """Edge accumulator: per-plane (src, dst) lists, scattered into
+    dense planes ONCE at finalize() — the per-edge `plane[a, b] =
+    True` writes were the Python hot loop of large-history inference
+    (ISSUE 9); the lists also feed the bit-packed layout directly
+    (Inference.packed_stacked), so the mesh tier never needs the
+    dense detour."""
+
     def __init__(self, n: int):
-        self.planes = {p: np.zeros((n, n), bool) for p in PLANES}
+        self.n = n
+        self._src = {p: [] for p in PLANES}
+        self._dst = {p: [] for p in PLANES}
+        self._dense: dict = {}      # planes installed whole (rt)
         self.types: dict = {}
 
     def add(self, plane: str, a: int, b: int) -> None:
         if a == b or a is None or b is None:
             return
-        self.planes[plane][a, b] = True
+        self._src[plane].append(a)
+        self._dst[plane].append(b)
         if plane in DEP_PLANES:
             self.types.setdefault((a, b), set()).add(plane)
+
+    def set_plane(self, name: str, dense: np.ndarray) -> None:
+        self._dense[name] = dense
+
+    def edge_arrays(self) -> dict:
+        """plane -> (src int64[], dst int64[]), dense-installed planes
+        converted via nonzero (rt is already the vectorized O(n^2)
+        pair set)."""
+        out = {}
+        for p in PLANES:
+            if p in self._dense:
+                s, d = np.nonzero(self._dense[p])
+                src = s.astype(np.int64)
+                dst = d.astype(np.int64)
+            else:
+                src = np.asarray(self._src[p], np.int64)
+                dst = np.asarray(self._dst[p], np.int64)
+            out[p] = (src, dst)
+        return out
+
+    def finalize(self) -> dict:
+        """Materialize the dense bool planes (one vectorized scatter
+        per plane)."""
+        planes = {}
+        for p in PLANES:
+            m = self._dense.get(p)
+            if m is None:
+                m = np.zeros((self.n, self.n), bool)
+            if self._src[p]:
+                m[np.asarray(self._src[p], np.int64),
+                  np.asarray(self._dst[p], np.int64)] = True
+            planes[p] = m
+        return planes
 
 
 def txn_mops(okop) -> list:
@@ -153,7 +219,7 @@ def _order_planes(txns: list, edges: _Edges) -> None:
         rt = (ok_idx[:, None] < inv_idx[None, :]) \
             & known[:, None] & known[None, :]
         np.fill_diagonal(rt, False)
-        edges.planes["rt"] = rt
+        edges.set_plane("rt", rt)
 
 
 # ---------------------------------------------------------------------------
@@ -414,8 +480,10 @@ def infer(history, workload: str = "auto") -> Inference:
     else:
         raise ValueError(f"unknown elle workload {workload!r}")
     _order_planes(txns, edges)
+    planes = edges.finalize()
     meta["txn-count"] = len(txns)
-    meta["edge-counts"] = {p: int(edges.planes[p].sum()) for p in PLANES}
-    return Inference(txns=txns, planes=edges.planes,
+    meta["edge-counts"] = {p: int(planes[p].sum()) for p in PLANES}
+    return Inference(txns=txns, planes=planes,
                      edge_types=edges.types, direct=direct,
-                     workload=workload, meta=meta)
+                     workload=workload, meta=meta,
+                     edge_lists=edges.edge_arrays())

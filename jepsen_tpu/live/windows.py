@@ -659,7 +659,56 @@ class Tenant:
                                              **self.lane_opts)
         return ln
 
+    _TYPE_OF_KIND = (INVOKE, OK, FAIL, INFO)
+
+    def _route_native(self, ops: list):
+        """One C pass over the batch (packext.route_ops): per-op
+        kind/process/index classification + KV key split, including
+        the missing-index synthesis — the attribute-access half of the
+        ingest loop.  None = native path unavailable (the Python loop
+        below is the behavior-identical fallback, pinned by
+        tests/test_packext.py)."""
+        from jepsen_tpu import native
+        from jepsen_tpu.ops import planner
+        if planner.pack_threads_effective() <= 0:
+            return None
+        mod = native.packext()
+        if mod is None or not hasattr(mod, "route_ops"):
+            return None
+        try:
+            return mod.route_ops(ops, self._record_n)
+        except Exception:       # noqa: BLE001 - degrade to the loop
+            return None
+
     def ingest(self, ops: list, walls: list) -> None:
+        routed = self._route_native(ops) if ops else None
+        if routed is not None:
+            kinds, procs_b, idxs_b, fs, keys, vals = routed
+            procs = np.frombuffer(procs_b, np.int64)
+            idxs = np.frombuffer(idxs_b, np.int64)
+            self._record_n += len(ops)
+            for i, wall in enumerate(walls):
+                k = kinds[i]
+                if k >= 5:
+                    continue           # nemesis / non-client actor
+                p = int(procs[i])
+                if k == 0:             # invoke
+                    key = keys[i]
+                    self.open_by_process[p] = key
+                    self.lane(key).on_invoke(p, fs[i], vals[i],
+                                             int(idxs[i]), wall)
+                    self.ops_ingested += 1
+                elif k == 4:           # unknown op type
+                    self.skipped += 1
+                else:                  # ok / fail / info
+                    key = self.open_by_process.pop(p, _MISSING)
+                    if key is _MISSING:
+                        self.skipped += 1
+                        continue
+                    self.lane(key).on_complete(
+                        p, self._TYPE_OF_KIND[k], vals[i],
+                        int(idxs[i]), wall)
+            return
         for op, wall in zip(ops, walls):
             # the run loop assigns op.index at analyze time, not at
             # journal time: synthesize the WAL position (the same

@@ -2,14 +2,25 @@
 
 The reference keeps its hot paths on the JVM and its fault injectors in
 C (SURVEY.md §2.2); here the compute path is JAX/XLA and the native
-layer accelerates the *host* runtime around it — currently `_histscan`,
-the fused history scan feeding the batched device kernels
-(ops/wgl_seg).  Everything degrades gracefully: if no compiler is
-available the pure-Python twin runs instead, bit-identically.
+layer accelerates the *host* runtime around it — `_histscan` (the fused
+history scan feeding the batched device kernels, ops/wgl_seg),
+`_wgloracle` (the C twin of the CPU oracle's hot loop), and `_packext`
+(the GIL-released parallel ingest layer: work-stealing scan-and-pack
+for the key axis, batch word-OR for the Elle packed planes, and the
+live scheduler's routing pass — ISSUE 9).  Everything degrades
+gracefully: if no compiler is available the pure-Python twin runs
+instead, bit-identically.
+
+Rebuilds are md5-staleness-gated (the faultfs.py install discipline):
+a stamp file beside the .so records the source+header digest, so a
+source edit rebuilds exactly once and an unchanged tree never pays the
+compiler, regardless of checkout mtimes.  `_packext` builds with
+`-Wall -Werror` — a warning in the parallel ingest layer is a bug.
 """
 
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
 import subprocess
@@ -28,36 +39,51 @@ def _so_path(name: str) -> str:
     return os.path.join(_BUILD, name + suffix)
 
 
-def _build(name: str, source: str) -> Optional[str]:
-    """cc -shared -fPIC — rebuilt whenever the source is newer."""
+def _src_digest(paths) -> str:
+    h = hashlib.md5()
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _build(name: str, source: str, flags: tuple = ()) -> Optional[str]:
+    """cc -shared -fPIC — rebuilt whenever the source md5 changes
+    (stamp file beside the .so; the faultfs.py staleness discipline —
+    mtimes lie across checkouts, digests don't)."""
     out = _so_path(name)
     src = os.path.join(_DIR, source)
     hdr = os.path.join(_DIR, "scancommon.h")
+    stamp = out + ".md5"
     try:
-        newest = max([os.path.getmtime(src)]
-                     + ([os.path.getmtime(hdr)]
-                        if os.path.exists(hdr) else []))
-        if os.path.exists(out) and os.path.getmtime(out) >= newest:
-            return out
+        digest = _src_digest([src] + ([hdr] if os.path.exists(hdr)
+                                      else [])) \
+            + ("+" + " ".join(flags) if flags else "")
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return out
         os.makedirs(_BUILD, exist_ok=True)
         include = sysconfig.get_paths()["include"]
         cc = os.environ.get("CC", "cc")
-        cmd = [cc, "-shared", "-fPIC", "-O2", f"-I{include}",
+        cmd = [cc, "-shared", "-fPIC", "-O2", *flags, f"-I{include}",
                src, "-o", out]
         r = subprocess.run(cmd, capture_output=True, timeout=120)
         if r.returncode != 0:
             return None
+        with open(stamp, "w") as f:
+            f.write(digest)
         return out
     except (OSError, subprocess.SubprocessError):
         return None
 
 
-def _load(name: str, source: str):
+def _load(name: str, source: str, flags: tuple = ()):
     with _lock:
         if name in _cache:
             return _cache[name]
         mod = None
-        path = _build(name, source)
+        path = _build(name, source, flags)
         if path is not None:
             try:
                 spec = importlib.util.spec_from_file_location(name, path)
@@ -81,3 +107,14 @@ def wgloracle():
     if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
         return None
     return _load("_wgloracle", "wgloracle.c")
+
+
+def packext():
+    """The _packext parallel-ingest extension, or None (Python
+    fallback).  Strict build: -Wall -Werror (plus -pthread for the
+    work-stealing pool) — any warning fails the build and the pure
+    Python/numpy twins take over, never a questionable native pack."""
+    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+        return None
+    return _load("_packext", "packext.c",
+                 flags=("-Wall", "-Werror", "-pthread"))

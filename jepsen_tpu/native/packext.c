@@ -1,0 +1,978 @@
+/* Native parallel ingest: GIL-released, work-stealing scan-and-pack
+ * (CPython extension; ISSUE 9).
+ *
+ * The device kernels left the host behind: on the 3400-key north-star
+ * row the WGL kernel runs 0.285s inside 1.25s of warm wall, and the
+ * single-threaded numpy pack (planner._pack_regs +
+ * _compact_many_block) is most of the difference — PR 8's overlap
+ * executor can only HIDE host work behind device compute, not shrink
+ * it.  This module shrinks it: the per-key work (columnar scan,
+ * snapshot-delta derivation, compact row-stream packing) is perfectly
+ * parallel across the key axis, so a small thread pool does it with
+ * the GIL released, writing straight into one arena laid out exactly
+ * as the compact wire block the device kernel consumes — results go
+ * zero-copy (np.frombuffer -> jax.device_put) into the overlap
+ * executor with no Python-side reassembly.
+ *
+ * Scheduling is work-stealing: each thread owns a contiguous key
+ * range with an atomic claim cursor; a thread whose range drains
+ * claims from the next live range with the same atomic op, so a few
+ * expensive keys cannot serialize the batch and the schedule never
+ * affects output bytes (each key writes only its own arena segment).
+ *
+ * Every entry point is a bit-identical twin of existing Python/numpy
+ * code and degrades to it on any error (tests/test_packext.py pins
+ * the equivalence; the planner records pack_backend/pack_threads so
+ * no degradation is silent):
+ *
+ *   pack_compact_many(keys, Kp, R, U, n_threads)
+ *       keys: list of (ret_slots, cand_counts, cand_slots, cand_uops)
+ *       int32 buffers — the planner._fk_arrays form, one per scanned
+ *       key.  Derives each key's per-return invoke deltas from its
+ *       candidate snapshots in SLOT order (exactly np.nonzero's order
+ *       inside planner._pack_regs) and packs the chunk into the
+ *       compact wire block _compact_many_block emits: rows u8[Rp]
+ *       (low nibble ret+1, high nibble islot+1) ++ iuop u8|u16[Rp] ++
+ *       cum i32[Kp+1].  Returns (arena bytes, Rp, lp_min).
+ *
+ *   scan_cols_many(cols_list, seen, rows, max_open_bits, n_threads)
+ *       Parallel twin of histscan.fast_scan_cols over MANY keys.
+ *       Threads intern uops into key-local tables; a serial merge in
+ *       key order assigns global ids in exactly the order the serial
+ *       per-key scan would have (first encounter across key order,
+ *       stream order within a key), then a second parallel pass
+ *       remaps the uop columns.  Out-of-scope keys yield None and
+ *       stage nothing.  Returns a list parallel to cols_list of
+ *       fast_scan_cols-shaped tuples (or None per key).
+ *
+ *   or_words(plane, words, masks)
+ *       plane.ravel()[words[i]] |= masks[i] over a writable uint32
+ *       buffer, GIL released — the batch set_bits word-insertion the
+ *       Elle packed planes (ops/elle_mesh) ride.
+ *
+ *   route_ops(ops, start_index)
+ *       One attribute-access pass over Op objects for the live
+ *       scheduler's pairing/demux loop (live/windows.Tenant.ingest):
+ *       kind/process/index classification + KV key split in C.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "scancommon.h"
+
+/* ---------------------------------------------------------------- */
+/* Work-stealing pool: per-thread ranges with atomic claim cursors.  */
+
+typedef struct {
+    void (*fn)(void *ctx, long i);
+    void *ctx;
+    long *lo;          /* atomic claim cursor per range */
+    long *hi;          /* fixed range ends */
+    int nr;
+} pk_pool;
+
+typedef struct { pk_pool *p; int self; } pk_arg;
+
+static void pk_drain(pk_pool *p, int self) {
+    for (int off = 0; off < p->nr; off++) {
+        int r = (self + off) % p->nr;       /* own range, then steal */
+        for (;;) {
+            long i = __atomic_fetch_add(&p->lo[r], 1, __ATOMIC_RELAXED);
+            if (i >= p->hi[r]) break;
+            p->fn(p->ctx, i);
+        }
+    }
+}
+
+static void *pk_thread(void *a) {
+    pk_arg *pa = a;
+    pk_drain(pa->p, pa->self);
+    return NULL;
+}
+
+/* Run fn(ctx, i) for i in [0, n).  Caller must NOT hold the GIL and
+ * fn must not touch Python state.  Claiming is atomic, so any subset
+ * of successfully-spawned threads (plus the calling thread, which
+ * always participates) completes ALL work — spawn failure degrades
+ * to fewer workers, never to lost keys. */
+static void pk_parallel(long n, int n_threads,
+                        void (*fn)(void *, long), void *ctx) {
+    if (n <= 0) return;
+    if (n_threads < 1) n_threads = 1;
+    if (n_threads > 64) n_threads = 64;
+    if ((long)n_threads > n) n_threads = (int)n;
+    long lo[64], hi[64];
+    pk_pool p = {fn, ctx, lo, hi, n_threads};
+    for (int r = 0; r < n_threads; r++) {
+        lo[r] = n * r / n_threads;
+        hi[r] = n * (r + 1) / n_threads;
+    }
+    pthread_t tid[64];
+    pk_arg args[64];
+    int spawned = 0;
+    for (int t = 0; t + 1 < n_threads; t++) {
+        args[t].p = &p;
+        args[t].self = t;
+        if (pthread_create(&tid[spawned], NULL, pk_thread, &args[t]))
+            break;
+        spawned++;
+    }
+    pk_drain(&p, n_threads - 1);
+    for (int t = 0; t < spawned; t++)
+        pthread_join(tid[t], NULL);
+}
+
+/* ---------------------------------------------------------------- */
+/* malloc-based containers for thread workers (PyMem needs the GIL). */
+
+typedef struct { int32_t *d; long len, cap; } mvec;
+
+static int mvec_push(mvec *v, int32_t x) {
+    if (v->len == v->cap) {
+        long nc = v->cap ? v->cap * 2 : 256;
+        int32_t *nd = realloc(v->d, (size_t)nc * sizeof(int32_t));
+        if (!nd) return -1;
+        v->d = nd;
+        v->cap = nc;
+    }
+    v->d[v->len++] = x;
+    return 0;
+}
+
+/* local intern table: (f, a, b, ok) -> key-local dense id */
+typedef struct { int64_t f, a, b, ok; long u; } pent;
+typedef struct { pent *e; long cap, n; } ptab;
+
+static int ptab_init(ptab *t, long cap) {
+    long c = 64;
+    while (c < cap) c <<= 1;
+    t->e = malloc((size_t)c * sizeof(pent));
+    if (!t->e) return -1;
+    for (long i = 0; i < c; i++) t->e[i].u = -1;
+    t->cap = c;
+    t->n = 0;
+    return 0;
+}
+
+static long ptab_slot(ptab *t, int64_t f, int64_t a, int64_t b,
+                      int64_t ok) {
+    uint64_t m = (uint64_t)t->cap - 1;
+    uint64_t i = utab_hash(f, a, b, ok) & m;   /* the ONE shared hash */
+    for (;;) {
+        pent *e = &t->e[i];
+        if (e->u < 0 || (e->f == f && e->a == a && e->b == b
+                         && e->ok == ok))
+            return (long)i;
+        i = (i + 1) & m;
+    }
+}
+
+static int ptab_grow(ptab *t) {
+    pent *old = t->e;
+    long ocap = t->cap;
+    t->e = malloc((size_t)(2 * ocap) * sizeof(pent));
+    if (!t->e) { t->e = old; return -1; }
+    t->cap = 2 * ocap;
+    for (long i = 0; i < t->cap; i++) t->e[i].u = -1;
+    for (long i = 0; i < ocap; i++)
+        if (old[i].u >= 0) {
+            long s = ptab_slot(t, old[i].f, old[i].a, old[i].b,
+                               old[i].ok);
+            t->e[s] = old[i];
+        }
+    free(old);
+    return 0;
+}
+
+/* ---------------------------------------------------------------- */
+/* pack_compact_many                                                 */
+
+typedef struct {
+    const int32_t *rs, *cnt, *cs, *cu;
+    long nr, tc;
+    uint8_t *rows8;     /* per-key scratch stream, malloc'd */
+    uint8_t *iu;
+    long rows_k;
+    int err;            /* 0 ok, 1 nomem, 2 malformed input */
+} pk_key;
+
+typedef struct {
+    pk_key *keys;
+    long R;
+    int ud;
+} pk_scan_ctx;
+
+/* Phase 1: one key's snapshot-delta scan + local row-stream pack.
+ * Bit-identical to planner._pack_regs at I = 1: per return, the slots
+ * whose occupant changed since the previous snapshot (with the
+ * previous return's slot freed first), ascending slot order; the
+ * last delta rides the return's own row, earlier ones are spill rows
+ * (ret nibble 0); a delta-less return is a lone row. */
+static void pk_scan_key(void *vctx, long i) {
+    pk_scan_ctx *ctx = vctx;
+    pk_key *K = &ctx->keys[i];
+    long R = ctx->R;
+    int ud = ctx->ud;
+    int32_t prev[16], cur[16], dslot[16], duop[16];
+    for (long s = 0; s < R; s++) prev[s] = -1;
+    long cap = K->nr + K->tc;
+    K->rows8 = malloc(cap ? (size_t)cap : 1);
+    K->iu = malloc((cap ? (size_t)cap : 1) * (size_t)ud);
+    if (!K->rows8 || !K->iu) { K->err = 1; return; }
+    long coff = 0, w = 0;
+    for (long r = 0; r < K->nr; r++) {
+        long c = K->cnt[r];
+        long ret = K->rs[r];
+        if (ret < 0 || ret >= R || c < 0 || coff + c > K->tc) {
+            K->err = 2;
+            return;
+        }
+        for (long s = 0; s < R; s++) cur[s] = -1;
+        for (long j = 0; j < c; j++) {
+            long sl = K->cs[coff + j];
+            if (sl < 0 || sl >= R) { K->err = 2; return; }
+            cur[sl] = K->cu[coff + j];
+        }
+        coff += c;
+        long nd = 0;
+        for (long s = 0; s < R; s++)
+            if (cur[s] != -1 && cur[s] != prev[s]) {
+                dslot[nd] = (int32_t)s;
+                duop[nd] = cur[s];
+                nd++;
+            }
+        if (nd == 0) {
+            K->rows8[w] = (uint8_t)(ret + 1);
+            if (ud == 1) K->iu[w] = 0;
+            else { K->iu[2 * w] = 0; K->iu[2 * w + 1] = 0; }
+            w++;
+        } else {
+            for (long j = 0; j < nd; j++) {
+                uint8_t low = (j == nd - 1) ? (uint8_t)(ret + 1) : 0;
+                K->rows8[w] = (uint8_t)(low
+                                        | (uint8_t)((dslot[j] + 1) << 4));
+                if (ud == 1) K->iu[w] = (uint8_t)duop[j];
+                else {
+                    uint16_t u16 = (uint16_t)duop[j];
+                    memcpy(K->iu + 2 * w, &u16, 2);
+                }
+                w++;
+            }
+        }
+        for (long s = 0; s < R; s++) prev[s] = cur[s];
+        prev[ret] = -1;
+    }
+    K->rows_k = w;
+}
+
+typedef struct {
+    pk_key *keys;
+    uint8_t *rows_out;  /* arena: rows stream */
+    uint8_t *iu_out;    /* arena: iuop stream */
+    const int32_t *cum;
+    int ud;
+} pk_copy_ctx;
+
+/* Phase 3: copy each key's local stream into its arena segment. */
+static void pk_copy_key(void *vctx, long i) {
+    pk_copy_ctx *ctx = vctx;
+    pk_key *K = &ctx->keys[i];
+    long base = ctx->cum[i];
+    if (K->rows_k) {
+        memcpy(ctx->rows_out + base, K->rows8, (size_t)K->rows_k);
+        memcpy(ctx->iu_out + (size_t)base * (size_t)ctx->ud, K->iu,
+               (size_t)K->rows_k * (size_t)ctx->ud);
+    }
+}
+
+static void pk_free_keys(pk_key *keys, Py_ssize_t nk) {
+    if (!keys) return;
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        free(keys[i].rows8);
+        free(keys[i].iu);
+    }
+    free(keys);
+}
+
+static PyObject *pack_compact_many(PyObject *self, PyObject *args) {
+    PyObject *key_list;
+    long Kp, R, U, n_threads;
+    if (!PyArg_ParseTuple(args, "O!llll", &PyList_Type, &key_list,
+                          &Kp, &R, &U, &n_threads))
+        return NULL;
+    if (R < 1 || R > 15) {
+        PyErr_SetString(PyExc_ValueError,
+                        "pack_compact_many needs 1 <= R <= 15 (slot "
+                        "ids ride 4-bit nibbles)");
+        return NULL;
+    }
+    Py_ssize_t nk = PyList_GET_SIZE(key_list);
+    if (nk > Kp) {
+        PyErr_SetString(PyExc_ValueError, "len(keys) > Kp");
+        return NULL;
+    }
+    int ud = (U <= 255) ? 1 : 2;
+
+    Py_buffer *bufs = PyMem_Calloc((size_t)(nk ? nk : 1) * 4,
+                                   sizeof(Py_buffer));
+    pk_key *keys = calloc(nk ? (size_t)nk : 1, sizeof(pk_key));
+    int32_t *cum = NULL;
+    PyObject *out = NULL, *arena = NULL;
+    Py_ssize_t acquired = 0;
+    if (!bufs || !keys) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        PyObject *t = PyList_GET_ITEM(key_list, i);
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 4) {
+            PyErr_SetString(PyExc_TypeError,
+                            "keys must be 4-tuples of int32 buffers");
+            goto done;
+        }
+        for (int j = 0; j < 4; j++) {
+            if (PyObject_GetBuffer(PyTuple_GET_ITEM(t, j),
+                                   &bufs[4 * i + j], PyBUF_SIMPLE) < 0)
+                goto done;
+            acquired++;
+        }
+        pk_key *K = &keys[i];
+        K->rs = bufs[4 * i].buf;
+        K->cnt = bufs[4 * i + 1].buf;
+        K->cs = bufs[4 * i + 2].buf;
+        K->cu = bufs[4 * i + 3].buf;
+        K->nr = (long)(bufs[4 * i].len / 4);
+        K->tc = (long)(bufs[4 * i + 2].len / 4);
+        if (bufs[4 * i + 1].len / 4 != bufs[4 * i].len / 4
+            || bufs[4 * i + 3].len != bufs[4 * i + 2].len) {
+            PyErr_SetString(PyExc_ValueError,
+                            "key buffer length mismatch");
+            goto done;
+        }
+    }
+
+    {
+        pk_scan_ctx ctx = {keys, R, ud};
+        Py_BEGIN_ALLOW_THREADS
+        pk_parallel((long)nk, (int)n_threads, pk_scan_key, &ctx);
+        Py_END_ALLOW_THREADS
+    }
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        if (keys[i].err == 1) { PyErr_NoMemory(); goto done; }
+        if (keys[i].err == 2) {
+            PyErr_SetString(PyExc_ValueError,
+                            "malformed key arrays (slot out of range)");
+            goto done;
+        }
+    }
+
+    cum = malloc((size_t)(Kp + 1) * sizeof(int32_t));
+    if (!cum) { PyErr_NoMemory(); goto done; }
+    cum[0] = 0;
+    long lp_min = 0;
+    for (long k = 0; k < Kp; k++) {
+        long rk = (k < nk) ? keys[k].rows_k : 0;
+        if (rk > lp_min) lp_min = rk;
+        cum[k + 1] = cum[k] + (int32_t)rk;
+    }
+    long total = cum[Kp];
+    /* exactly _compact_many_block's rounding (0 rows -> Rp 0, an
+     * arena of just the cum table — bit-identical twins even there) */
+    long Rp = ((total + 8191) / 8192) * 8192;
+    Py_ssize_t nbytes = (Py_ssize_t)Rp * (1 + ud)
+        + (Py_ssize_t)(Kp + 1) * 4;
+    arena = PyBytes_FromStringAndSize(NULL, nbytes);
+    if (!arena) goto done;
+    {
+        uint8_t *base = (uint8_t *)PyBytes_AS_STRING(arena);
+        pk_copy_ctx cctx = {keys, base, base + Rp, cum, ud};
+        Py_BEGIN_ALLOW_THREADS
+        /* zero the stream padding, then parallel-copy the live rows */
+        memset(base + total, 0, (size_t)(Rp - total));
+        memset(base + Rp + (size_t)total * ud, 0,
+               (size_t)(Rp - total) * (size_t)ud);
+        memcpy(base + (size_t)Rp * (1 + ud), cum,
+               (size_t)(Kp + 1) * 4);
+        pk_parallel((long)nk, (int)n_threads, pk_copy_key, &cctx);
+        Py_END_ALLOW_THREADS
+    }
+    out = Py_BuildValue("(Oll)", arena, Rp, lp_min);
+
+done:
+    Py_XDECREF(arena);
+    free(cum);
+    pk_free_keys(keys, nk);
+    if (bufs) {
+        for (Py_ssize_t i = 0; i < acquired; i++)
+            PyBuffer_Release(&bufs[i]);
+        PyMem_Free(bufs);
+    }
+    return out;
+}
+
+/* ---------------------------------------------------------------- */
+/* scan_cols_many                                                    */
+
+typedef struct {
+    /* inputs (borrowed buffer pointers, valid while GIL released) */
+    const int32_t *proc, *fmap, *va, *vb;
+    const uint8_t *typ, *vk;
+    long n;
+    /* outputs */
+    int status;         /* 0 ok, 1 out-of-scope, 2 nomem */
+    long n_calls, max_open;
+    mvec ret_slots, cand_counts, cand_slots, cand_uops, cut_flags,
+         d_counts, d_slots, d_uops, ret_pos;
+    int64_t *uops;      /* distinct (f,a,b,ok) quads, encounter order */
+    long n_uops, cap_uops;
+    ptab tab;
+    long *remap;        /* local id -> global id (merge phase) */
+} sc_key;
+
+typedef struct {
+    sc_key *keys;
+    long max_open_bits;
+    int remap_pass;     /* 0 = scan, 1 = remap cand/d uop columns */
+} sc_ctx;
+
+static long sc_intern(sc_key *K, long fc, long a, long b, long okv) {
+    long s = ptab_slot(&K->tab, fc, a, b, okv);
+    if (K->tab.e[s].u >= 0) return K->tab.e[s].u;
+    if (K->n_uops == K->cap_uops) {
+        long nc = K->cap_uops ? K->cap_uops * 2 : 64;
+        int64_t *nd = realloc(K->uops, (size_t)nc * 4 * sizeof(int64_t));
+        if (!nd) return -2;
+        K->uops = nd;
+        K->cap_uops = nc;
+    }
+    long u = K->n_uops++;
+    int64_t *q = K->uops + 4 * u;
+    q[0] = fc; q[1] = a; q[2] = b; q[3] = okv;
+    pent e = {fc, a, b, okv, u};
+    K->tab.e[s] = e;
+    if (++K->tab.n * 2 > K->tab.cap && ptab_grow(&K->tab) < 0)
+        return -2;
+    return u;
+}
+
+/* One key's columnar scan — the logic of histscan.fast_scan_cols with
+ * key-LOCAL interning (no Python calls; bit-identical outputs after
+ * the merge remaps local ids to the serial scan's global order). */
+static void sc_scan_key(void *vctx, long ki) {
+    sc_ctx *ctx = vctx;
+    sc_key *K = &ctx->keys[ki];
+    if (ctx->remap_pass) {
+        if (K->status == 0 && K->remap) {
+            for (long i = 0; i < K->cand_uops.len; i++)
+                K->cand_uops.d[i] =
+                    (int32_t)K->remap[K->cand_uops.d[i]];
+            for (long i = 0; i < K->d_uops.len; i++)
+                K->d_uops.d[i] = (int32_t)K->remap[K->d_uops.d[i]];
+        }
+        return;
+    }
+    long n = K->n;
+    long max_open_bits = ctx->max_open_bits;
+    if (max_open_bits > MAX_OPEN_HARD) max_open_bits = MAX_OPEN_HARD;
+    Py_ssize_t *fate = malloc((n ? (size_t)n : 1) * sizeof(Py_ssize_t));
+    if (!fate || ptab_init(&K->tab, 256) < 0) {
+        free(fate);
+        K->status = 2;
+        return;
+    }
+
+    /* pass 1: pair completions with invokes */
+    {
+        int32_t open_p[MAX_OPEN_HARD];
+        long open_i[MAX_OPEN_HARD];
+        long n_open1 = 0;
+        for (long i = 0; i < n; i++) fate[i] = -1;
+        for (long i = 0; i < n; i++) {
+            int32_t p = K->proc[i];
+            if (p == -2) goto out_of_scope;  /* out-of-int32 client id */
+            if (p < 0) continue;
+            uint8_t t = K->typ[i];
+            long j = -1;
+            for (long k = 0; k < n_open1; k++)
+                if (open_p[k] == p) { j = k; break; }
+            if (t == 0) {
+                if (j >= 0) goto out_of_scope;      /* double invoke */
+                if (n_open1 >= MAX_OPEN_HARD) goto out_of_scope;
+                open_p[n_open1] = p;
+                open_i[n_open1] = i;
+                n_open1++;
+            } else if (j >= 0) {
+                fate[open_i[j]] = i;
+                open_p[j] = open_p[n_open1 - 1];
+                open_i[j] = open_i[n_open1 - 1];
+                n_open1--;
+            }
+        }
+        if (n_open1 > 0) goto out_of_scope;         /* crashed calls */
+    }
+
+    /* pass 2: slots + local interning + returns */
+    {
+        long slot_of[MAX_OPEN_HARD], uop_of[MAX_OPEN_HARD];
+        int32_t open_procs[MAX_OPEN_HARD];
+        long free_slots[MAX_OPEN_HARD];
+        long n_free = 0, next_slot = 0, n_open = 0;
+        long max_open = 0, n_calls = 0;
+        long d_emitted = 0;
+
+        for (long i = 0; i < n; i++) {
+            int32_t p = K->proc[i];
+            if (p < 0) continue;
+            uint8_t t = K->typ[i];
+            if (t == 0) {
+                Py_ssize_t ci = fate[i];
+                if (ci < 0 || K->typ[ci] == 3) goto out_of_scope;
+                if (K->typ[ci] == 2) continue;      /* fail pair */
+                long a, b, okv;
+                uint8_t k = K->vk[i];
+                long vi = i;
+                if (k == 0) { k = K->vk[ci]; vi = ci; }
+                if (k == 4) goto out_of_scope;      /* out of int32 */
+                if (k == 0 || k == 3) { a = 0; b = 0; okv = 0; }
+                else {
+                    a = K->va[vi];
+                    b = (k == 2) ? K->vb[vi] : 0;
+                    okv = 1;
+                }
+                long fc = K->fmap[i];
+                if (fc < 0) goto out_of_scope;      /* f not in spec */
+                long u = sc_intern(K, fc, a, b, okv);
+                if (u == -2) goto nomem;
+                long s = n_free ? free_slots[--n_free] : next_slot++;
+                if (n_open >= MAX_OPEN_HARD) goto out_of_scope;
+                open_procs[n_open] = p;
+                slot_of[n_open] = s;
+                uop_of[n_open] = u;
+                n_open++;
+                if (n_open > max_open) {
+                    max_open = n_open;
+                    if (max_open > max_open_bits) goto out_of_scope;
+                }
+                n_calls++;
+                if (mvec_push(&K->d_slots, (int32_t)s) < 0 ||
+                    mvec_push(&K->d_uops, (int32_t)u) < 0)
+                    goto nomem;
+            } else if (t == 1) {
+                long idx = -1;
+                for (long j = 0; j < n_open; j++)
+                    if (open_procs[j] == p) { idx = j; break; }
+                if (idx < 0) continue;
+                if (mvec_push(&K->d_counts,
+                              (int32_t)(K->d_slots.len - d_emitted)) < 0)
+                    goto nomem;
+                d_emitted = K->d_slots.len;
+                if (mvec_push(&K->ret_slots,
+                              (int32_t)slot_of[idx]) < 0 ||
+                    mvec_push(&K->cand_counts, (int32_t)n_open) < 0 ||
+                    mvec_push(&K->ret_pos, (int32_t)i) < 0)
+                    goto nomem;
+                for (long j = 0; j < n_open; j++) {
+                    if (mvec_push(&K->cand_slots,
+                                  (int32_t)slot_of[j]) < 0 ||
+                        mvec_push(&K->cand_uops,
+                                  (int32_t)uop_of[j]) < 0)
+                        goto nomem;
+                }
+                free_slots[n_free++] = slot_of[idx];
+                for (long j = idx; j < n_open - 1; j++) {
+                    open_procs[j] = open_procs[j + 1];
+                    slot_of[j] = slot_of[j + 1];
+                    uop_of[j] = uop_of[j + 1];
+                }
+                n_open--;
+                if (mvec_push(&K->cut_flags, n_open == 0 ? 1 : 0) < 0)
+                    goto nomem;
+            }
+        }
+        K->n_calls = n_calls;
+        K->max_open = max_open;
+        K->status = 0;
+    }
+    free(fate);
+    return;
+
+out_of_scope:
+    free(fate);
+    K->status = 1;
+    return;
+
+nomem:
+    free(fate);
+    K->status = 2;
+}
+
+static void sc_free_key(sc_key *K) {
+    free(K->ret_slots.d);
+    free(K->cand_counts.d);
+    free(K->cand_slots.d);
+    free(K->cand_uops.d);
+    free(K->cut_flags.d);
+    free(K->d_counts.d);
+    free(K->d_slots.d);
+    free(K->d_uops.d);
+    free(K->ret_pos.d);
+    free(K->uops);
+    free(K->tab.e);
+    PyMem_Free(K->remap);
+}
+
+static PyObject *scan_cols_many(PyObject *self, PyObject *args) {
+    PyObject *cols_list, *seen, *rows;
+    long max_open_bits, n_threads;
+    if (!PyArg_ParseTuple(args, "O!O!O!ll", &PyList_Type, &cols_list,
+                          &PyDict_Type, &seen, &PyList_Type, &rows,
+                          &max_open_bits, &n_threads))
+        return NULL;
+    Py_ssize_t nk = PyList_GET_SIZE(cols_list);
+
+    Py_buffer *bufs = PyMem_Calloc((size_t)(nk ? nk : 1) * 6,
+                                   sizeof(Py_buffer));
+    sc_key *keys = calloc(nk ? (size_t)nk : 1, sizeof(sc_key));
+    PyObject *result = NULL, *new_rows = NULL, *out_list = NULL;
+    utab g = {0};
+    Py_ssize_t acquired = 0;
+    if (!bufs || !keys) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        PyObject *t = PyList_GET_ITEM(cols_list, i);
+        if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 6) {
+            PyErr_SetString(PyExc_TypeError,
+                            "cols_list items must be 6-tuples of "
+                            "column buffers");
+            goto done;
+        }
+        for (int j = 0; j < 6; j++) {
+            if (PyObject_GetBuffer(PyTuple_GET_ITEM(t, j),
+                                   &bufs[6 * i + j], PyBUF_SIMPLE) < 0)
+                goto done;
+            acquired++;
+        }
+        sc_key *K = &keys[i];
+        long n = (long)(bufs[6 * i].len / 4);
+        K->proc = bufs[6 * i].buf;
+        K->typ = bufs[6 * i + 1].buf;
+        K->fmap = bufs[6 * i + 2].buf;
+        K->va = bufs[6 * i + 3].buf;
+        K->vb = bufs[6 * i + 4].buf;
+        K->vk = bufs[6 * i + 5].buf;
+        K->n = n;
+        if ((long)bufs[6 * i + 1].len != n
+            || (long)(bufs[6 * i + 2].len / 4) != n
+            || (long)(bufs[6 * i + 3].len / 4) != n
+            || (long)(bufs[6 * i + 4].len / 4) != n
+            || (long)bufs[6 * i + 5].len != n) {
+            PyErr_SetString(PyExc_ValueError,
+                            "column length mismatch");
+            goto done;
+        }
+    }
+
+    {
+        sc_ctx ctx = {keys, max_open_bits, 0};
+        Py_BEGIN_ALLOW_THREADS
+        pk_parallel((long)nk, (int)n_threads, sc_scan_key, &ctx);
+        Py_END_ALLOW_THREADS
+    }
+    for (Py_ssize_t i = 0; i < nk; i++)
+        if (keys[i].status == 2) { PyErr_NoMemory(); goto done; }
+
+    /* serial merge, key order: global ids land in exactly the order
+     * the serial per-key scan would have assigned them */
+    new_rows = PyList_New(0);
+    if (!new_rows || utab_init(&g, 1024) < 0) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    {
+        Py_ssize_t base_rows = PyList_GET_SIZE(rows);
+        int seen_nonempty = PyDict_GET_SIZE(seen) > 0;
+        for (Py_ssize_t i = 0; i < nk; i++) {
+            sc_key *K = &keys[i];
+            if (K->status != 0 || K->n_uops == 0) continue;
+            K->remap = PyMem_Malloc((size_t)K->n_uops * sizeof(long));
+            if (!K->remap) { PyErr_NoMemory(); goto done; }
+            for (long li = 0; li < K->n_uops; li++) {
+                const int64_t *q = K->uops + 4 * li;
+                long u = intern_uop(&g, seen, seen_nonempty, rows,
+                                    new_rows, (long)q[0], (long)q[1],
+                                    (long)q[2], (long)q[3]);
+                if (u < 0) goto done;
+                K->remap[li] = u;
+            }
+        }
+        {
+            sc_ctx ctx = {keys, max_open_bits, 1};
+            Py_BEGIN_ALLOW_THREADS
+            pk_parallel((long)nk, (int)n_threads, sc_scan_key, &ctx);
+            Py_END_ALLOW_THREADS
+        }
+        if (publish_interning(seen, rows, new_rows, base_rows) < 0)
+            goto done;
+    }
+
+    out_list = PyList_New(nk);
+    if (!out_list) goto done;
+    for (Py_ssize_t i = 0; i < nk; i++) {
+        sc_key *K = &keys[i];
+        PyObject *item;
+        if (K->status != 0) {
+            item = Py_None;
+            Py_INCREF(item);
+        } else {
+            item = Py_BuildValue(
+                "(lly#y#y#y#y#y#y#y#y#)", K->n_calls, K->max_open,
+                (char *)K->ret_slots.d,
+                K->ret_slots.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->cand_counts.d,
+                K->cand_counts.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->cand_slots.d,
+                K->cand_slots.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->cand_uops.d,
+                K->cand_uops.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->cut_flags.d,
+                K->cut_flags.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->d_counts.d,
+                K->d_counts.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->d_slots.d,
+                K->d_slots.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->d_uops.d,
+                K->d_uops.len * (Py_ssize_t)sizeof(int32_t),
+                (char *)K->ret_pos.d,
+                K->ret_pos.len * (Py_ssize_t)sizeof(int32_t));
+            if (!item) goto done;
+        }
+        PyList_SET_ITEM(out_list, i, item);
+    }
+    result = out_list;
+    out_list = NULL;
+
+done:
+    Py_XDECREF(out_list);
+    Py_XDECREF(new_rows);
+    PyMem_Free(g.e);
+    if (keys) {
+        for (Py_ssize_t i = 0; i < nk; i++)
+            sc_free_key(&keys[i]);
+        free(keys);
+    }
+    if (bufs) {
+        for (Py_ssize_t i = 0; i < acquired; i++)
+            PyBuffer_Release(&bufs[i]);
+        PyMem_Free(bufs);
+    }
+    return result;
+}
+
+/* ---------------------------------------------------------------- */
+/* or_words: plane.ravel()[words[i]] |= masks[i], GIL released.      */
+
+static PyObject *or_words(PyObject *self, PyObject *args) {
+    Py_buffer plane = {0}, words = {0}, masks = {0};
+    if (!PyArg_ParseTuple(args, "w*y*y*", &plane, &words, &masks))
+        return NULL;
+    PyObject *result = NULL;
+    Py_ssize_t m = words.len / 8;
+    Py_ssize_t nw = plane.len / 4;
+    if (masks.len / 4 != m) {
+        PyErr_SetString(PyExc_ValueError, "words/masks length mismatch");
+        goto done;
+    }
+    {
+        uint32_t *p = plane.buf;
+        const int64_t *w = words.buf;
+        const uint32_t *mk = masks.buf;
+        int bad = 0;
+        Py_BEGIN_ALLOW_THREADS
+        for (Py_ssize_t i = 0; i < m; i++) {
+            int64_t idx = w[i];
+            if (idx < 0 || idx >= (int64_t)nw) { bad = 1; break; }
+            p[idx] |= mk[i];
+        }
+        Py_END_ALLOW_THREADS
+        if (bad) {
+            PyErr_SetString(PyExc_IndexError,
+                            "word index outside the plane");
+            goto done;
+        }
+    }
+    result = Py_None;
+    Py_INCREF(result);
+
+done:
+    PyBuffer_Release(&plane);
+    PyBuffer_Release(&words);
+    PyBuffer_Release(&masks);
+    return result;
+}
+
+/* ---------------------------------------------------------------- */
+/* route_ops: the live scheduler's pairing/demux attribute pass.     */
+
+static PyObject *s_process, *s_type, *s_f, *s_value, *s_index;
+static PyObject *t_invoke, *t_ok, *t_fail, *t_info;
+
+static int ro_type(PyObject *op) {      /* 0..3, 4 other, -2 error */
+    PyObject *t = PyObject_GetAttr(op, s_type);
+    if (!t) return -2;
+    int out = 4;
+    if (t == t_invoke) out = 0;
+    else if (t == t_ok) out = 1;
+    else if (t == t_fail) out = 2;
+    else if (t == t_info) out = 3;
+    else {
+        int r;
+        if ((r = PyObject_RichCompareBool(t, t_invoke, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 0;
+        else if ((r = PyObject_RichCompareBool(t, t_ok, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 1;
+        else if ((r = PyObject_RichCompareBool(t, t_fail, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 2;
+        else if ((r = PyObject_RichCompareBool(t, t_info, Py_EQ)) != 0)
+            out = r < 0 ? -2 : 3;
+    }
+    Py_DECREF(t);
+    return out;
+}
+
+static PyObject *route_ops(PyObject *self, PyObject *args) {
+    PyObject *ops;
+    long start_index;
+    if (!PyArg_ParseTuple(args, "O!l", &PyList_Type, &ops,
+                          &start_index))
+        return NULL;
+    Py_ssize_t n = PyList_GET_SIZE(ops);
+    uint8_t *kinds = PyMem_Malloc(n ? (size_t)n : 1);
+    int64_t *procs = PyMem_Malloc((n ? (size_t)n : 1) * sizeof(int64_t));
+    int64_t *idxs = PyMem_Malloc((n ? (size_t)n : 1) * sizeof(int64_t));
+    PyObject *fs = PyList_New(n);
+    PyObject *keys = PyList_New(n);
+    PyObject *vals = PyList_New(n);
+    PyObject *result = NULL;
+    if (!kinds || !procs || !idxs || !fs || !keys || !vals) {
+        PyErr_NoMemory();
+        goto done;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *op = PyList_GET_ITEM(ops, i);
+        /* index: synthesize the WAL position when unset (the same
+         * order History.index() will stamp) */
+        PyObject *ix = PyObject_GetAttr(op, s_index);
+        if (!ix) goto done;
+        if (ix == Py_None) {
+            Py_DECREF(ix);
+            ix = PyLong_FromLong(start_index + (long)i);
+            if (!ix || PyObject_SetAttr(op, s_index, ix) < 0) {
+                Py_XDECREF(ix);
+                goto done;
+            }
+        }
+        idxs[i] = (int64_t)PyLong_AsLongLong(ix);
+        Py_DECREF(ix);
+        if (idxs[i] == -1 && PyErr_Occurred()) goto done;
+        /* process: exact int >= 0 is a client actor */
+        PyObject *p = PyObject_GetAttr(op, s_process);
+        if (!p) goto done;
+        long long pv = -1;
+        int client = 0;
+        if (PyLong_CheckExact(p)) {
+            pv = PyLong_AsLongLong(p);
+            if (pv == -1 && PyErr_Occurred()) { Py_DECREF(p); goto done; }
+            client = pv >= 0;
+        }
+        Py_DECREF(p);
+        procs[i] = client ? (int64_t)pv : -1;
+        if (!client) {
+            kinds[i] = 5;            /* non-client actor */
+            PyList_SET_ITEM(fs, i, Py_None);
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(keys, i, Py_None);
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(vals, i, Py_None);
+            Py_INCREF(Py_None);
+            continue;
+        }
+        int t = ro_type(op);
+        if (t == -2) goto done;
+        kinds[i] = (uint8_t)t;
+        PyObject *f = PyObject_GetAttr(op, s_f);
+        if (!f) goto done;
+        PyList_SET_ITEM(fs, i, f);
+        /* KV split: type(value).__name__ == "KV" tuples demux per
+         * key, everything else rides the single None lane */
+        PyObject *v = PyObject_GetAttr(op, s_value);
+        if (!v) goto done;
+        PyObject *key = Py_None, *val = v;
+        if (PyTuple_Check(v) && PyTuple_GET_SIZE(v) == 2
+            && strcmp(Py_TYPE(v)->tp_name, "KV") == 0) {
+            key = PyTuple_GET_ITEM(v, 0);
+            val = PyTuple_GET_ITEM(v, 1);
+        }
+        Py_INCREF(key);
+        PyList_SET_ITEM(keys, i, key);
+        Py_INCREF(val);
+        PyList_SET_ITEM(vals, i, val);
+        Py_DECREF(v);
+    }
+    result = Py_BuildValue(
+        "(y#y#y#OOO)", (char *)kinds, n,
+        (char *)procs, n * (Py_ssize_t)sizeof(int64_t),
+        (char *)idxs, n * (Py_ssize_t)sizeof(int64_t),
+        fs, keys, vals);
+
+done:
+    PyMem_Free(kinds);
+    PyMem_Free(procs);
+    PyMem_Free(idxs);
+    Py_XDECREF(fs);
+    Py_XDECREF(keys);
+    Py_XDECREF(vals);
+    return result;
+}
+
+/* ---------------------------------------------------------------- */
+
+static PyMethodDef methods[] = {
+    {"pack_compact_many", pack_compact_many, METH_VARARGS,
+     "Parallel snapshot-delta pack of one key chunk into the compact "
+     "wire block (bit-identical to _pack_regs + _compact_many_block)."},
+    {"scan_cols_many", scan_cols_many, METH_VARARGS,
+     "Parallel columnar scan over many keys with two-phase interning "
+     "(bit-identical to serial fast_scan_cols per key)."},
+    {"or_words", or_words, METH_VARARGS,
+     "plane.ravel()[words] |= masks over a writable uint32 buffer."},
+    {"route_ops", route_ops, METH_VARARGS,
+     "Pairing/demux attribute pass for the live scheduler's ingest."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_packext", NULL, -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__packext(void) {
+    s_process = PyUnicode_InternFromString("process");
+    s_type = PyUnicode_InternFromString("type");
+    s_f = PyUnicode_InternFromString("f");
+    s_value = PyUnicode_InternFromString("value");
+    s_index = PyUnicode_InternFromString("index");
+    t_invoke = PyUnicode_InternFromString("invoke");
+    t_ok = PyUnicode_InternFromString("ok");
+    t_fail = PyUnicode_InternFromString("fail");
+    t_info = PyUnicode_InternFromString("info");
+    if (!s_process || !s_type || !s_f || !s_value || !s_index
+        || !t_invoke || !t_ok || !t_fail || !t_info)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

@@ -1,7 +1,12 @@
 /* Shared helpers for the native history scanners/oracle:
  * growable int32 vector, open-addressing uop-interning hash, and the
- * hard bound on simultaneously-open calls.  Included by histscan.c
- * and wgloracle.c so the interning semantics live in ONE place. */
+ * hard bound on simultaneously-open calls.  Included by histscan.c,
+ * wgloracle.c and packext.c so the interning semantics live in ONE
+ * place (static inline: packext builds -Wall -Werror and must not
+ * trip unused-function on the helpers it doesn't call).  The PyMem-
+ * based containers here require the GIL; packext's thread workers use
+ * their own malloc-based twins and only touch these from the serial
+ * merge phase. */
 #ifndef JEPSEN_TPU_SCANCOMMON_H
 #define JEPSEN_TPU_SCANCOMMON_H
 
@@ -15,7 +20,7 @@ typedef struct {
     Py_ssize_t len, cap;
 } vec;
 
-static int vec_push(vec *v, int32_t x) {
+static inline int vec_push(vec *v, int32_t x) {
     if (v->len == v->cap) {
         Py_ssize_t ncap = v->cap ? v->cap * 2 : 256;
         int32_t *nd = PyMem_Realloc(v->data, ncap * sizeof(int32_t));
@@ -31,7 +36,7 @@ static int vec_push(vec *v, int32_t x) {
 typedef struct { int64_t f, a, b, ok; long u; } uent;
 typedef struct { uent *e; long cap, n; } utab;
 
-static int utab_init(utab *t, long cap) {
+static inline int utab_init(utab *t, long cap) {
     long c = 64;
     while (c < cap) c <<= 1;
     t->e = PyMem_Malloc(c * sizeof(uent));
@@ -42,7 +47,7 @@ static int utab_init(utab *t, long cap) {
     return 0;
 }
 
-static uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
+static inline uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
     uint64_t h = 1469598103934665603ULL;
     h = (h ^ (uint64_t)f) * 1099511628211ULL;
     h = (h ^ (uint64_t)a) * 1099511628211ULL;
@@ -52,7 +57,7 @@ static uint64_t utab_hash(int64_t f, int64_t a, int64_t b, int64_t ok) {
 }
 
 /* find slot for key; returns index into t->e (occupied or empty) */
-static long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
+static inline long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
                       int64_t ok) {
     uint64_t m = (uint64_t)t->cap - 1;
     uint64_t i = utab_hash(f, a, b, ok) & m;
@@ -65,7 +70,7 @@ static long utab_slot(utab *t, int64_t f, int64_t a, int64_t b,
     }
 }
 
-static int utab_grow(utab *t) {
+static inline int utab_grow(utab *t) {
     uent *old = t->e;
     long ocap = t->cap;
     t->e = PyMem_Malloc(2 * ocap * sizeof(uent));
@@ -85,7 +90,7 @@ static int utab_grow(utab *t) {
 /* Intern (f, a, b, ok) against the shared Python `seen`/staged
  * `new_rows`, with the C hash as the fast path.  Returns the uop id,
  * or -1 on error (Python exception set). */
-static long intern_uop(utab *ut, PyObject *seen, int seen_nonempty,
+static inline long intern_uop(utab *ut, PyObject *seen, int seen_nonempty,
                        PyObject *rows, PyObject *new_rows,
                        long fc, long a, long b, long okv) {
     long s2 = utab_slot(ut, fc, a, b, okv);
@@ -119,7 +124,7 @@ static long intern_uop(utab *ut, PyObject *seen, int seen_nonempty,
 }
 
 /* publish staged interning rows into the shared seen/rows */
-static int publish_interning(PyObject *seen, PyObject *rows,
+static inline int publish_interning(PyObject *seen, PyObject *rows,
                              PyObject *new_rows, Py_ssize_t base_rows) {
     Py_ssize_t m = PyList_GET_SIZE(new_rows);
     for (Py_ssize_t i = 0; i < m; i++) {

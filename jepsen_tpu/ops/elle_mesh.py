@@ -129,12 +129,40 @@ def pack_planes(stack, n_pad: Optional[int] = None,
 
 def set_bits(plane: np.ndarray, src, dst) -> None:
     """Sparse edge insertion into one packed plane [n_pad, W]:
-    plane[src, dst//32] |= 1 << (dst%32), vectorized (the bench's
-    100k/1M generators build planes without a dense detour)."""
+    plane[src, dst//32] |= 1 << (dst%32) (the bench's 100k/1M
+    generators and elle/infer's plane construction build packed planes
+    without a dense detour).  Rides the native ingest layer's batch
+    word-OR (packext.or_words, GIL released) when available; the numpy
+    fallback is the raveled-index form of np.bitwise_or.at — one flat
+    word index per edge instead of a 2-d fancy tuple, measurably
+    faster and pinned bit-identical to the per-edge loop by
+    tests/test_packext.py."""
     src = np.asarray(src, np.int64)
     dst = np.asarray(dst, np.int64)
-    np.bitwise_or.at(plane, (src, dst // 32),
-                     np.uint32(1) << (dst % 32).astype(np.uint32))
+    if not len(src):
+        return
+    W = plane.shape[-1]
+    masks = (np.uint32(1) << (dst & 31).astype(np.uint32))
+    if plane.flags.c_contiguous:
+        words = src * np.int64(W) + (dst >> 5)
+        mod = _packext()
+        if mod is not None:
+            mod.or_words(plane, np.ascontiguousarray(words),
+                         np.ascontiguousarray(masks))
+            return
+        np.bitwise_or.at(plane.reshape(-1), words, masks)
+        return
+    np.bitwise_or.at(plane, (src, dst >> 5), masks)
+
+
+def _packext():
+    """The native ingest extension, honoring the pack-threads knob
+    (JEPSEN_TPU_PACK_THREADS=0 pins the pure-numpy twins)."""
+    from jepsen_tpu import native
+    from jepsen_tpu.ops import planner
+    if planner.pack_threads_effective() <= 0:
+        return None
+    return native.packext()
 
 def _get_bit(row: np.ndarray, j: int) -> bool:
     return bool((row[j // 32] >> np.uint32(j % 32)) & np.uint32(1))
@@ -405,13 +433,25 @@ def classify_packed(packed_stacks: Sequence[np.ndarray],
 def classify_mesh(stacks: Sequence[np.ndarray],
                   include_order: bool = True,
                   devices=None,
-                  max_devices: Optional[int] = None) -> list:
+                  max_devices: Optional[int] = None,
+                  inferences=None) -> list:
     """Dense-stack front door (the checker's path): packs each
     [len(PLANES), n, n] bool stack and classifies on the row-sharded
     mesh.  Output rows match `elle_graph.classify_batch` plus
-    `rounds`/`shards`."""
+    `rounds`/`shards`.
+
+    With `inferences` (the elle/infer.Inference objects the stacks
+    came from), the packed planes are built by sparse word-insertion
+    from the inference edge lists (Inference.packed_stacked — the
+    native ingest layer's or_words fast path) instead of re-packing
+    the dense stacks; equal bytes either way, pinned by
+    tests/test_packext.py."""
     devs = _devices(devices, max_devices)
-    packed = [pack_planes(s, n_dev=len(devs)) for s in stacks]
+    if inferences is not None:
+        packed = [inf.packed_stacked(n_dev=len(devs))
+                  for inf in inferences]
+    else:
+        packed = [pack_planes(s, n_dev=len(devs)) for s in stacks]
     return classify_packed(packed, [s.shape[-1] for s in stacks],
                            include_order=include_order, devices=devs)
 

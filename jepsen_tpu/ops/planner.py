@@ -153,6 +153,13 @@ class Plan:
     pruned: tuple = ()              # ((knob, engine), ...)
     rejected: tuple = ()            # ((engine, reason), ...)
     shape: Optional[Shape] = None
+    # Host-ingest routing (ISSUE 9): which pack backend the plan's
+    # host side rides (native parallel ingest vs the pure-Python
+    # packers) and at how many threads.  NOT part of the compiled
+    # bucket — both backends emit bit-identical buffers, so the
+    # executable cache is backend-agnostic.
+    pack_backend: str = "python"
+    pack_threads: int = 0
 
     @property
     def chain(self) -> tuple:
@@ -165,7 +172,9 @@ class Plan:
 
     def to_dict(self) -> dict:
         d = {"engine": self.engine, "fallbacks": list(self.fallbacks),
-             "why": self.why, "bucket": list(self.bucket)}
+             "why": self.why, "bucket": list(self.bucket),
+             "pack_backend": self.pack_backend,
+             "pack_threads": self.pack_threads}
         if self.pruned:
             d["pruned"] = [list(p) for p in self.pruned]
         if self.rejected:
@@ -477,7 +486,9 @@ def plan_engines(shape: Shape, env: Optional[dict] = None,
     bucket = _bucket_for(head, s)
     return Plan(engine=head, fallbacks=tuple(chain[1:]),
                 why=why.get(head, "eligible"), bucket=bucket,
-                pruned=pruned, rejected=tuple(rejected), shape=s)
+                pruned=pruned, rejected=tuple(rejected), shape=s,
+                pack_backend=pack_backend_effective(env),
+                pack_threads=pack_threads_effective(env))
 
 
 def _bucket_for(engine: str, s: Shape) -> tuple:
@@ -528,7 +539,9 @@ def plan_elle(n_max: int, batch: int = 1, *, algorithm: str = "auto",
     bucket = ("elle", chain[0], _next_pow2(max(n_max, 1)),
               _next_pow2(max(batch, 1)))
     return Plan(engine=chain[0], fallbacks=tuple(chain[1:]), why=why,
-                bucket=bucket, rejected=tuple(rejected))
+                bucket=bucket, rejected=tuple(rejected),
+                pack_backend=pack_backend_effective(env),
+                pack_threads=pack_threads_effective(env))
 
 
 def plan_live(lanes: int, events: int, bits: int, states: int,
@@ -1472,6 +1485,124 @@ def _fk_arrays(fk: "_FastKey"):
     cu = np.fromiter((u for _, cands in fk.rets for _, u in cands),
                      np.int32)
     return rs, counts, cs, cu
+
+
+# ---------------------------------------------------------------------------
+# Native parallel ingest (ISSUE 9): the GIL-released, work-stealing
+# scan-and-pack layer (native/packext.c).  The Python packers below
+# remain the bit-for-bit differential twin and the total fallback —
+# a missing compiler or ANY native-path error lands back on them
+# (counted, never a silent wrong pack), and plans record which
+# backend ran (Plan.pack_backend / pack_threads).
+# ---------------------------------------------------------------------------
+
+def pack_threads_effective(env: Optional[dict] = None) -> int:
+    """Thread count for the native ingest layer.  The knob
+    JEPSEN_TPU_PACK_THREADS overrides (0 = pure-Python packers);
+    default min(8, cpu_count) — the pack is memory-bound past that."""
+    env = _snapshot_env(env)
+    raw = env.get("JEPSEN_TPU_PACK_THREADS")
+    if raw is not None:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            return 0
+    return min(8, os.cpu_count() or 1)
+
+
+def pack_backend_effective(env: Optional[dict] = None) -> str:
+    """'native' when the packext extension is buildable/loaded and the
+    thread knob admits it, else 'python'.  Like the jax backend, the
+    extension's availability is a process-constant capability input —
+    plans stay reproducible within a process."""
+    if pack_threads_effective(env) <= 0:
+        return "python"
+    from jepsen_tpu import native
+    return "python" if native.packext() is None else "native"
+
+
+def _count_pack(backend: str, outcome: str) -> None:
+    try:
+        from jepsen_tpu import telemetry
+        telemetry.REGISTRY.counter("jepsen_pack_total",
+                                   backend=backend,
+                                   outcome=outcome).inc()
+    except Exception:           # noqa: BLE001 - counters must not break
+        pass
+
+
+def _native_pack_compact(batch, Kp: int, R: int, U: int):
+    """C twin of `_pack_regs(I=1)` + `_compact_many_block` over one
+    key chunk: snapshot-delta derivation and compact-stream packing in
+    parallel across the key axis, written once into one arena
+    (native/packext.c pack_compact_many — bit-identical bytes, pinned
+    by tests/test_packext.py).  Returns (buf8 uint8[...], Rp, Lp) or
+    None when the native path is unavailable or errored — callers then
+    run the Python packers, the total fallback."""
+    nt = pack_threads_effective()
+    if nt <= 0 or not (0 < R <= 15):
+        return None
+    from jepsen_tpu import native
+    mod = native.packext()
+    if mod is None:
+        return None
+    keys = []
+    for _, fk in batch:
+        rs, counts, cs, cu = _fk_arrays(fk)
+        keys.append((np.ascontiguousarray(rs, np.int32),
+                     np.ascontiguousarray(counts, np.int32),
+                     np.ascontiguousarray(cs, np.int32),
+                     np.ascontiguousarray(cu, np.int32)))
+    try:
+        buf, Rp, lp_min = mod.pack_compact_many(
+            keys, int(Kp), int(R), int(U), int(nt))
+    except Exception:           # noqa: BLE001 - degrade, never mis-pack
+        _count_pack("native", "error")
+        return None
+    _count_pack("native", "ok")
+    return np.frombuffer(buf, np.uint8), int(Rp), _pad_len(int(lp_min))
+
+
+def _scan_cols_many(histories, spec, seen: dict, rows: list,
+                    max_open_bits: int):
+    """Parallel columnar scan over a whole key batch (packext
+    scan_cols_many): per-key work on a work-stealing pool, uop ids
+    merged in key order so they land exactly where the serial per-key
+    ladder would have put them.  Returns {index: _FastKey | None}
+    (None = out of the batch engine's scope, same as the serial
+    scanners) for the keys that carried packed columns, or None when
+    the parallel path shouldn't run — no extension, a custom
+    encode_op, or fewer than 2 effective threads (the two-phase
+    interning costs one extra pass over the uop columns, a loss on a
+    single core; measured on the 1-core CI host)."""
+    nt = pack_threads_effective()
+    if nt < 2 or getattr(spec, "encode_op", None) is not None:
+        return None
+    from jepsen_tpu import native
+    mod = native.packext()
+    if mod is None or not hasattr(mod, "scan_cols_many"):
+        return None
+    idxs: list = []
+    cols_list: list = []
+    for i, h in enumerate(histories):
+        if not isinstance(h, History):
+            continue
+        cols = _cols_args(h.packed_columns(), spec)
+        if cols is None:
+            continue
+        idxs.append(i)
+        cols_list.append(cols)
+    if not cols_list:
+        return {}
+    try:
+        outs = mod.scan_cols_many(cols_list, seen, rows,
+                                  int(max_open_bits), int(nt))
+    except MemoryError:
+        raise
+    except Exception:           # noqa: BLE001 - degrade to serial scan
+        _count_pack("native-scan", "error")
+        return None
+    return {i: _fastkey_from_native(o) for i, o in zip(idxs, outs)}
 
 
 # ---------------------------------------------------------------------------

@@ -2415,14 +2415,34 @@ def _run_many_overlapped(batch, R: int, U: int, Sn: int, M: int,
                    // 128) * 128)
     donate = backend_name not in ("cpu", "unknown") \
         and os.environ.get("JEPSEN_TPU_NO_DONATE") != "1"
+    n_native = [0]
 
     def pack(ch):
+        import jax
+
         t0 = time.monotonic()
-        ret_t, islot_t, iuop_t, Lp = _pack_regs(ch, Kp, R, U, 1)
-        buf8, Rp = _compact_many_block(ret_t, islot_t, iuop_t, Kp, U)
-        _acc_s("fill", t0)
+        # Native parallel ingest (ISSUE 9): GIL-released work-stealing
+        # snapshot-delta pack straight into one arena, bit-identical
+        # to the numpy packers below (the permanent differential twin
+        # and total fallback — any native error degrades here, never a
+        # silent wrong pack; planner counts both outcomes).
+        nat = planner._native_pack_compact(ch, Kp, int(R), int(U))
+        if nat is not None:
+            buf8, Rp, Lp = nat
+            n_native[0] += 1
+        else:
+            ret_t, islot_t, iuop_t, Lp = _pack_regs(ch, Kp, R, U, 1)
+            buf8, Rp = _compact_many_block(ret_t, islot_t, iuop_t,
+                                           Kp, U)
+        _acc_s("pack", t0)
         stats["wire_bytes"] = (stats.get("wire_bytes", 0)
                                + buf8.nbytes + buf32.nbytes)
+        if donate:
+            # start the H2D transfer of the arena now, while the NEXT
+            # chunk packs — the executable then consumes (and donates)
+            # an already-on-device buffer instead of paying transfer
+            # inside its own dispatch
+            buf8 = jax.device_put(buf8)
         return buf8, int(Lp), Rp
 
     def dispatch(payload):
@@ -2458,6 +2478,13 @@ def _run_many_overlapped(batch, R: int, U: int, Sn: int, M: int,
     t_kernel = time.monotonic() - t1
     stats["kernel"] = stats.get("kernel", 0.0) + t_kernel
     stats["overlap_chunks"] = len(chunks)
+    # which ingest backend actually packed (vs the plan's intent):
+    # popped into the dispatch RECORD by check_many — "mixed" means a
+    # native error degraded some chunks to the Python twin
+    stats["pack_backend"] = (
+        "native" if n_native[0] == len(chunks)
+        else "mixed" if n_native[0] else "python")
+    stats["pack_threads"] = planner.pack_threads_effective()
     return ok, t_kernel
 
 
@@ -2493,19 +2520,32 @@ def check_many(model, histories, *, max_states: int = 64,
     ts = _mt_s()
 
     # Partition keys: batchable vs fallback — one fused host pass per
-    # key (no per-op objects).
+    # key (no per-op objects).  With the native ingest layer and >= 2
+    # threads, the whole batch's columnar scans run on the
+    # work-stealing pool first (GIL released); keys it couldn't take
+    # (no packed columns) and out-of-scope keys ride the serial
+    # ladder below, with identical interning order either way.
     seen: dict = {}
     rows: list = []
     batch: list = []        # (key index, _FastKey)
     fall: list = []
     stripped_note: dict = {}  # key idx -> crash count (stripped twin batched)
     native_ok = getattr(spec, "encode_op", None) is None
+    pre = planner._scan_cols_many(histories, spec, seen, rows,
+                                  max_open_bits)
     for i, h in enumerate(histories):
         if isinstance(h, PreparedHistory):
             fall.append(i)  # pre-prepped callers take the slow path
             continue
         ops = h.ops if isinstance(h, History) else History(h).ops
-        fk = _scan_history(h, ops, spec, seen, rows, max_open_bits)
+        if pre is not None and pre.get(i) is not None:
+            fk = pre[i]
+        else:
+            # includes keys the batch scan judged out of scope: the
+            # serial ladder's object-scan retry can still recover
+            # regimes outside the COLUMNAR scope (e.g. out-of-int32
+            # client ids), exactly as before
+            fk = _scan_history(h, ops, spec, seen, rows, max_open_bits)
         if fk is None:
             # Crashed keys ride the batch as their crash-stripped twin:
             # stripped-valid => valid (a crashed call carries no
@@ -2782,6 +2822,11 @@ def check_many(model, histories, *, max_states: int = 64,
                           max_states=max_states,
                           max_open_bits=max_open_bits),
             backend=backend_name)
+    # the ingest backend that ACTUALLY packed (may differ from the
+    # plan's pack_backend when a native error degraded mid-batch) —
+    # strings ride the record, not the numeric stage decomposition
+    pack_used = stats.pop("pack_backend", None)
+    pack_nt = stats.pop("pack_threads", None)
     for eng, rs in by_engine.items():
         telemetry_mod.attach_dispatch(
             rs,
@@ -2789,6 +2834,7 @@ def check_many(model, histories, *, max_states: int = 64,
                 engine=eng,
                 R=R_batch, crashes=n_crash, batch=len(histories),
                 mesh=(getattr(mesh, "shape", None)
-                      if mesh is not None else None)),
+                      if mesh is not None else None),
+                pack_backend=pack_used, pack_threads=pack_nt),
             stages=stats)
     return results

@@ -673,13 +673,24 @@ def summarize(events: list[dict]) -> str:
         rec = e.get("record") or {}
         key = (rec.get("engine"), rec.get("why"),
                tuple(rec.get("fallback_chain") or ()))
-        plans.setdefault(key, rec.get("plan") or {})
+        pl = dict(rec.get("plan") or {})
+        # the record-level pack fields are what ACTUALLY ran (the plan
+        # carries the intent) — surface the actual when present
+        if rec.get("pack_backend") is not None:
+            pl["pack_backend"] = rec["pack_backend"]
+            pl["pack_threads"] = rec.get("pack_threads")
+        plans.setdefault(key, pl)
     shown = [(k, v) for k, v in plans.items() if k[1] or k[2]]
     if shown:
         lines.append("dispatch plans:")
         for (eng, why, fb), pl in shown[:12]:
             chain = " -> ".join((eng,) + fb) if fb else (eng or "?")
-            lines.append(f"  {chain}: {why or '?'}")
+            pack = ""
+            if pl.get("pack_backend"):
+                pack = (f" [pack={pl['pack_backend']}"
+                        + (f" x{pl['pack_threads']}"
+                           if pl.get("pack_threads") else "") + "]")
+            lines.append(f"  {chain}: {why or '?'}{pack}")
             if pl.get("pruned"):
                 lines.append("    pruned by env: " + ", ".join(
                     f"{knob} -{e2}" for knob, e2 in pl["pruned"]))

@@ -439,20 +439,27 @@ def _dispatch_plans_html(events) -> str:
         return ""
     rows = []
     for (eng, why, fb), info in seen.items():
-        pl = info["rec"].get("plan") or {}
+        rec = info["rec"]
+        pl = rec.get("plan") or {}
         pruned = ", ".join(f"{k} &minus;{html.escape(str(e2))}"
                            for k, e2 in (pl.get("pruned") or []))
+        # record-level pack fields are what actually ran; the plan's
+        # are the intent (they differ when a native error degraded)
+        pb = rec.get("pack_backend") or pl.get("pack_backend")
+        pt = rec.get("pack_threads", pl.get("pack_threads"))
+        pack = f"{pb} ×{pt}" if pb and pt else (pb or "")
         rows.append(
             "<tr>"
             f"<td>{html.escape(str(eng))}</td>"
             f"<td>{html.escape(str(why or ''))}</td>"
             f"<td>{html.escape(' → '.join(fb))}</td>"
             f"<td>{html.escape(str(pl.get('bucket') or ''))}</td>"
+            f"<td>{html.escape(pack)}</td>"
             f"<td>{pruned}</td>"
             f"<td>{info['verdicts']}</td></tr>")
     return ("<h2>Dispatch plans</h2>"
             "<table><tr><th>Engine</th><th>Why</th>"
-            "<th>Fallback chain</th><th>Bucket</th>"
+            "<th>Fallback chain</th><th>Bucket</th><th>Pack</th>"
             "<th>Pruned by env</th><th>Verdicts</th></tr>"
             + "".join(rows) + "</table>")
 

@@ -70,6 +70,20 @@ def _plan_cache_stats():
         return None
 
 
+def _pack_backend():
+    """Which ingest backend this tier-1 run exercised (ISSUE 9):
+    'native' means the strict -Wall -Werror packext build succeeded
+    and the differential battery ran against it; 'python' means the
+    suite only covered the numpy twins (no compiler on the host).
+    Recorded so a coverage regression — a host change silently
+    dropping the native layer out of the tier — diffs across PRs."""
+    try:
+        from jepsen_tpu.ops import planner
+        return planner.pack_backend_effective()
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def pytest_sessionfinish(session, exitstatus):
     import json as _json
     import time as _time
@@ -85,6 +99,7 @@ def pytest_sessionfinish(session, exitstatus):
             "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
             "mesh_devices": _mesh_device_count(),
             "plan_cache": _plan_cache_stats(),
+            "pack_backend": _pack_backend(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }
@@ -121,11 +136,26 @@ def pytest_sessionfinish(session, exitstatus):
 
 
 def pytest_collection_modifyitems(config, items):
-    """Auto-skip the `fuse` marker where FUSE mounts are impossible
-    (like the kill9 marker, the battery is tier-1-safe where it CAN
-    run; elsewhere tier-1 must stay green rather than error).  The
-    probe actually mounts and detaches a transient fs — the exact
-    mechanism the battery uses — so it cannot pass spuriously."""
+    """Auto-skip markers whose mechanism the host cannot provide
+    (tier-1 must stay green rather than error; the batteries run in
+    full where they CAN).
+
+    `fuse`: the probe actually mounts and detaches a transient fs —
+    the exact mechanism the battery uses — so it cannot pass
+    spuriously.  `packext`: the probe is the strict -Wall -Werror
+    build itself (native.packext() compiles on first call, md5-gated
+    thereafter) — no compiler, or any warning in the C, skips the
+    native half of the differential battery and the tier-1 artifact
+    records pack_backend="python" so the coverage loss is diffable."""
+    pk_items = [it for it in items if "packext" in it.keywords]
+    if pk_items:
+        from jepsen_tpu import native
+        if native.packext() is None:
+            skip = pytest.mark.skip(
+                reason="packext unavailable (no C compiler, or the "
+                       "strict -Wall -Werror build failed)")
+            for item in pk_items:
+                item.add_marker(skip)
     fuse_items = [it for it in items if "fuse" in it.keywords]
     if not fuse_items:
         return

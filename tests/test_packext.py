@@ -1,0 +1,549 @@
+"""Native parallel ingest battery (ISSUE 9): the packext extension's
+scan/pack/or_words/route paths pinned bit-identical to their
+pure-Python twins, which stay the permanent differential oracle and
+total fallback.
+
+The `packext`-marked half needs the strict -Wall -Werror C build
+(auto-skipped by conftest when no compiler); the knob/fallback tests
+run everywhere.
+"""
+
+import copy
+import os
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import models, native
+from jepsen_tpu.history import History, HistoryWAL, Op, pack_history
+from jepsen_tpu.history import recover as wal_recover
+from jepsen_tpu.independent import KV
+from jepsen_tpu.ops import elle_mesh, planner, wgl_seg
+from jepsen_tpu.ops.planner import (_compact_many_block, _cols_args,
+                                    _fastkey_from_native, _fk_arrays,
+                                    _pack_regs, _pad_len, _scan_history)
+
+packext = pytest.mark.packext
+
+
+def make_history(n_ops, conc, seed=0, vmax=9, crash_p=0.0,
+                 violate=False, packed=True):
+    """Random register history; crash_p > 0 leaves some calls :info."""
+    rng = random.Random(seed)
+    ops, open_p, reg = [], {}, 0
+    for _ in range(n_ops):
+        if open_p and (len(open_p) >= conc or rng.random() < 0.5):
+            p = rng.choice(sorted(open_p))
+            f, v = open_p.pop(p)
+            if f == "write":
+                reg = v
+            t = "info" if rng.random() < crash_p else "ok"
+            ops.append({"process": p, "type": t, "f": f, "value": v})
+        else:
+            p = rng.randrange(10_000)
+            while p in open_p:
+                p = rng.randrange(10_000)
+            f, v = (("write", rng.randrange(vmax))
+                    if rng.random() < 0.5 else ("read", reg))
+            open_p[p] = (f, v)
+            ops.append({"process": p, "type": "invoke", "f": f,
+                        "value": v})
+    for p, (f, v) in sorted(open_p.items()):
+        ops.append({"process": p, "type": "ok", "f": f, "value": v})
+    if violate:
+        ops += [{"process": 9998, "type": "invoke", "f": "write",
+                 "value": 7},
+                {"process": 9998, "type": "ok", "f": "write",
+                 "value": 7},
+                {"process": 9999, "type": "invoke", "f": "read",
+                 "value": 3},
+                {"process": 9999, "type": "ok", "f": "read",
+                 "value": 3}]
+    h = History(ops)
+    if packed:
+        h.attach_packed(pack_history(h))
+    return h
+
+
+def torn_wal_history(tmp_path, n_ops=60, seed=5):
+    """A history rebuilt from a truncated WAL (open invocations closed
+    :info by recover) — the crash-recovered shape the ingest layer
+    must take bit-identically to the Python twins."""
+    src = make_history(n_ops, 4, seed=seed, packed=False)
+    wal = HistoryWAL(tmp_path / "history.wal", fsync=False)
+    for o in src.ops:
+        wal.append(o)
+    wal.close()
+    p = tmp_path / "history.wal"
+    data = p.read_bytes()
+    p.write_bytes(data[:int(len(data) * 0.8)])   # torn tail
+    h = wal_recover(p)
+    h.attach_packed(pack_history(h))
+    return h
+
+
+def scan_batch(hists, model, max_open_bits=10):
+    """Serial-ladder scan of a batch (the Python/serial-C reference):
+    (batch, seen, rows) with out-of-scope keys dropped."""
+    spec = model.device_spec()
+    seen, rows, batch = {}, [], []
+    for i, h in enumerate(hists):
+        fk = _scan_history(h, h.ops, spec, seen, rows, max_open_bits)
+        if fk is not None and fk.n_calls:
+            batch.append((i, fk))
+    return batch, seen, rows
+
+
+def python_pack(batch, Kp, R, U):
+    ret_t, islot_t, iuop_t, Lp = _pack_regs(batch, Kp, R, U, 1)
+    buf8, Rp = _compact_many_block(ret_t, islot_t, iuop_t, Kp, U)
+    return buf8, Rp, Lp
+
+
+# ---------------------------------------------------------------------------
+# pack differential battery
+# ---------------------------------------------------------------------------
+
+@packext
+class TestPackDifferential:
+    def _assert_pack_identical(self, hists, Kp=128, threads=(1, 2, 8)):
+        model = models.Register(0)
+        batch, seen, rows = scan_batch(hists, model)
+        assert batch, "battery needs at least one in-scope key"
+        R = max(fk.max_open for _, fk in batch)
+        U = len(rows)
+        buf_py, Rp_py, Lp_py = python_pack(batch, Kp, R, U)
+        mod = native.packext()
+        keys = [tuple(np.ascontiguousarray(a, np.int32)
+                      for a in _fk_arrays(fk)) for _, fk in batch]
+        for nt in threads:
+            buf, Rp, lp_min = mod.pack_compact_many(keys, Kp, R, U, nt)
+            nat = np.frombuffer(buf, np.uint8)
+            assert Rp == Rp_py
+            assert _pad_len(lp_min) == Lp_py
+            assert nat.shape == buf_py.shape
+            assert (nat == buf_py).all(), (
+                f"native pack diverged at threads={nt}")
+        return buf_py
+
+    def test_random_batch_thread_sweep(self):
+        hists = [make_history(150, 4, seed=s) for s in range(40)]
+        self._assert_pack_identical(hists)
+
+    def test_single_op_and_tiny_keys(self):
+        hists = [History([{"process": 0, "type": "invoke", "f": "write",
+                           "value": 1},
+                          {"process": 0, "type": "ok", "f": "write",
+                           "value": 1}]),
+                 make_history(2, 1, seed=1),
+                 make_history(6, 3, seed=2)]
+        for h in hists:
+            h.attach_packed(pack_history(h))
+        self._assert_pack_identical(hists, threads=(1, 8))
+
+    def test_crash_stripped_keys_ride_identically(self):
+        """Crashed keys enter the batch as stripped twins (object
+        scan, rets-form _FastKeys) — the pack must take BOTH scanner
+        forms bit-identically."""
+        model = models.Register(0)
+        hists = [make_history(120, 4, seed=s,
+                              crash_p=0.06 if s % 2 else 0.0)
+                 for s in range(16)]
+        spec = model.device_spec()
+        seen, rows, batch = {}, [], []
+        for i, h in enumerate(hists):
+            fk = _scan_history(h, h.ops, spec, seen, rows, 10)
+            if fk is None:
+                drop, crashed = planner._split_crashed(h.ops)
+                stripped = [o for pos, o in enumerate(h.ops)
+                            if not drop[pos]]
+                fk = planner._fast_scan(History(stripped), spec, seen,
+                                        rows, 10)
+            if fk is not None and fk.n_calls:
+                batch.append((i, fk))
+        assert any(fk.arrays is None for _, fk in batch), \
+            "expected at least one rets-form (python-scanned) key"
+        R = max(fk.max_open for _, fk in batch)
+        U = len(rows)
+        buf_py, Rp_py, Lp_py = python_pack(batch, 128, R, U)
+        keys = [tuple(np.ascontiguousarray(a, np.int32)
+                      for a in _fk_arrays(fk)) for _, fk in batch]
+        buf, Rp, lp = native.packext().pack_compact_many(
+            keys, 128, R, U, 4)
+        assert Rp == Rp_py and _pad_len(lp) == Lp_py
+        assert (np.frombuffer(buf, np.uint8) == buf_py).all()
+
+    def test_torn_wal_recovered_history(self, tmp_path):
+        hists = [torn_wal_history(tmp_path / str(s), n_ops=80,
+                                  seed=50 + s) for s in range(6)]
+        for d in range(6):
+            (tmp_path / str(d)).mkdir(exist_ok=True)
+        model = models.Register(0)
+        # recovered histories carry :info-closed calls (recover closes
+        # the open invocations of the torn tail), so they enter the
+        # batch exactly as check_many routes them: as crash-stripped
+        # twins
+        spec = model.device_spec()
+        seen, rows, batch = {}, [], []
+        for i, h in enumerate(hists):
+            fk = _scan_history(h, h.ops, spec, seen, rows, 10)
+            if fk is None:
+                drop, _crashed = planner._split_crashed(h.ops)
+                stripped = [o for pos, o in enumerate(h.ops)
+                            if not drop[pos]]
+                fk = planner._fast_scan(History(stripped), spec, seen,
+                                        rows, 10)
+            if fk is not None and fk.n_calls:
+                batch.append((i, fk))
+        assert batch, "stripped twins of recovered keys must batch"
+        R = max(fk.max_open for _, fk in batch)
+        U = len(rows)
+        buf_py, Rp_py, _ = python_pack(batch, 128, R, U)
+        keys = [tuple(np.ascontiguousarray(a, np.int32)
+                      for a in _fk_arrays(fk)) for _, fk in batch]
+        buf, Rp, _ = native.packext().pack_compact_many(
+            keys, 128, R, U, 2)
+        assert Rp == Rp_py
+        assert (np.frombuffer(buf, np.uint8) == buf_py).all()
+
+    def test_wide_uop_alphabet_u16_lane(self):
+        """U > 255 flips the iuop stream to 2-byte lanes."""
+        hists = [make_history(200, 3, seed=s, vmax=300)
+                 for s in range(6)]
+        buf = self._assert_pack_identical(hists, threads=(1, 4))
+        assert buf is not None
+
+    def test_planner_wrapper_gates_and_matches(self, monkeypatch):
+        hists = [make_history(90, 4, seed=s) for s in range(12)]
+        model = models.Register(0)
+        batch, seen, rows = scan_batch(hists, model)
+        R = max(fk.max_open for _, fk in batch)
+        U = len(rows)
+        buf_py, Rp_py, Lp_py = python_pack(batch, 128, R, U)
+        out = planner._native_pack_compact(batch, 128, R, U)
+        assert out is not None
+        buf8, Rp, Lp = out
+        assert (buf8 == buf_py).all() and Rp == Rp_py and Lp == Lp_py
+        # the knob pins the pure-Python packers
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "0")
+        assert planner._native_pack_compact(batch, 128, R, U) is None
+        # out-of-nibble R is refused before reaching C
+        monkeypatch.delenv("JEPSEN_TPU_PACK_THREADS", raising=False)
+        assert planner._native_pack_compact(batch, 128, 16, U) is None
+
+
+# ---------------------------------------------------------------------------
+# parallel scan differential
+# ---------------------------------------------------------------------------
+
+@packext
+class TestScanColsMany:
+    def test_bit_identical_to_serial_scan(self):
+        model = models.Register(0)
+        spec = model.device_spec()
+        hs = native.histscan()
+        assert hs is not None
+        hists = [make_history(140, 4, seed=s,
+                              crash_p=0.05 if s % 5 == 0 else 0.0)
+                 for s in range(24)]
+        cols_list = [_cols_args(h.packed_columns(), spec)
+                     for h in hists]
+        seen_s, rows_s, refs = {}, [], []
+        for c in cols_list:
+            refs.append(hs.fast_scan_cols(*c, seen_s, rows_s, 10, 1))
+        mod = native.packext()
+        for nt in (1, 2, 8):
+            seen_p, rows_p = {}, []
+            outs = mod.scan_cols_many(cols_list, seen_p, rows_p, 10, nt)
+            assert rows_p == rows_s and seen_p == seen_s
+            for i, (a, b) in enumerate(zip(outs, refs)):
+                assert (a is None) == (b is None), (nt, i)
+                if a is not None:
+                    assert a == b, (nt, i)
+
+    def test_out_of_scope_keys_stage_nothing(self):
+        """A crashed key must not leak its uops into the shared
+        interning tables (same discipline as the serial scanners)."""
+        model = models.Register(0)
+        spec = model.device_spec()
+        crashed = History([{"process": 0, "type": "invoke",
+                            "f": "write", "value": 777}])
+        crashed.attach_packed(pack_history(crashed))
+        clean = make_history(40, 3, seed=9)
+        cols_list = [_cols_args(h.packed_columns(), spec)
+                     for h in (crashed, clean)]
+        seen, rows = {}, []
+        outs = native.packext().scan_cols_many(cols_list, seen, rows,
+                                               10, 2)
+        assert outs[0] is None
+        assert outs[1] is not None
+        assert all(r[1] != 777 for r in rows), \
+            "crashed key's uop leaked into the shared tables"
+
+    def test_fastkey_wrapping_matches_serial_ladder(self):
+        """planner._scan_cols_many (>= 2 threads) produces _FastKeys
+        whose arrays equal the serial ladder's, including the delta
+        stream and positions."""
+        model = models.Register(0)
+        spec = model.device_spec()
+        hists = [make_history(100, 4, seed=s) for s in range(10)]
+        seen_a, rows_a = {}, []
+        serial = [_scan_history(h, h.ops, spec, seen_a, rows_a, 10)
+                  for h in hists]
+        seen_b, rows_b = {}, []
+        os.environ["JEPSEN_TPU_PACK_THREADS"] = "2"
+        try:
+            pre = planner._scan_cols_many(hists, spec, seen_b, rows_b,
+                                          10)
+        finally:
+            del os.environ["JEPSEN_TPU_PACK_THREADS"]
+        assert pre is not None and len(pre) == len(hists)
+        assert rows_a == rows_b
+        for i, fk_s in enumerate(serial):
+            fk_p = pre[i]
+            assert fk_p.n_calls == fk_s.n_calls
+            assert fk_p.max_open == fk_s.max_open
+            for a, b in zip(_fk_arrays(fk_p), _fk_arrays(fk_s)):
+                assert (np.asarray(a) == np.asarray(b)).all()
+            assert (fk_p.cuts == fk_s.cuts).all()
+            assert (fk_p.positions == fk_s.positions).all()
+            for a, b in zip(fk_p.deltas, fk_s.deltas):
+                assert (np.asarray(a) == np.asarray(b)).all()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: check_many verdicts across backends
+# ---------------------------------------------------------------------------
+
+class TestCheckManyBackendParity:
+    def _verdicts(self, hists, model):
+        return [r["valid?"] for r in wgl_seg.check_many(model, hists)]
+
+    def test_verdicts_identical_python_vs_native(self, monkeypatch):
+        model = models.Register(0)
+        hists = [make_history(90, 4, seed=s,
+                              crash_p=0.05 if s % 7 == 0 else 0.0,
+                              violate=(s == 5)) for s in range(24)]
+        hists.append(History([]))
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "0")
+        v_py = self._verdicts(hists, model)
+        for nt in ("1", "2", "8"):
+            monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", nt)
+            assert self._verdicts(hists, model) == v_py, \
+                f"verdicts diverged at pack_threads={nt}"
+        assert v_py[5] is False and v_py.count(False) == 1
+
+    @packext
+    def test_dispatch_record_carries_pack_attribution(self):
+        model = models.Register(0)
+        hists = [make_history(60, 3, seed=s) for s in range(8)]
+        rs = wgl_seg.check_many(model, hists)
+        rec = rs[0]["dispatch"]
+        assert rec.get("pack_backend") in ("native", "python", "mixed")
+        assert isinstance(rec.get("pack_threads"), int)
+        assert rec["plan"]["pack_backend"] in ("native", "python")
+        assert "pack" in rs[0]["stages"]
+
+    def test_plan_fields_follow_knob(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "0")
+        pl = planner.plan_engines(planner.Shape(kind="linear-many",
+                                                R=3, Sn=4, U=4,
+                                                decomposed=True,
+                                                batch=8))
+        assert pl.pack_backend == "python" and pl.pack_threads == 0
+        d = pl.to_dict()
+        assert d["pack_backend"] == "python"
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "3")
+        pl2 = planner.plan_engines(planner.Shape(kind="linear-many",
+                                                 R=3, Sn=4, U=4,
+                                                 decomposed=True,
+                                                 batch=8))
+        assert pl2.pack_threads == 3
+        assert pl2.pack_backend == planner.pack_backend_effective()
+
+
+# ---------------------------------------------------------------------------
+# elle: set_bits twins (satellite: vectorized numpy fallback pinned
+# against the old per-edge loop) + packed_stacked equivalence
+# ---------------------------------------------------------------------------
+
+class TestSetBits:
+    def _reference_loop(self, n, W, src, dst):
+        """The original per-edge semantics, kept as the pin oracle."""
+        ref = np.zeros((n, W), np.uint32)
+        for s, d in zip(src, dst):
+            ref[s, d // 32] |= np.uint32(1) << np.uint32(d % 32)
+        return ref
+
+    def test_numpy_raveled_matches_loop(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "0")
+        rng = np.random.default_rng(1)
+        n, W = 300, 8
+        src = rng.integers(0, n, 5000)
+        dst = rng.integers(0, W * 32, 5000)
+        plane = np.zeros((n, W), np.uint32)
+        elle_mesh.set_bits(plane, src, dst)
+        assert (plane == self._reference_loop(n, W, src, dst)).all()
+        # empty insert is a no-op
+        elle_mesh.set_bits(plane, np.empty(0, np.int64),
+                           np.empty(0, np.int64))
+
+    @packext
+    def test_native_or_words_matches_loop(self):
+        rng = np.random.default_rng(2)
+        n, W = 257, 9
+        src = rng.integers(0, n, 4000)
+        dst = rng.integers(0, W * 32, 4000)
+        plane = np.zeros((n, W), np.uint32)
+        elle_mesh.set_bits(plane, src, dst)
+        assert (plane == self._reference_loop(n, W, src, dst)).all()
+
+    def test_noncontiguous_plane_falls_back(self):
+        rng = np.random.default_rng(3)
+        n, W = 64, 4
+        src = rng.integers(0, n, 500)
+        dst = rng.integers(0, W * 32, 500)
+        plane = np.zeros((n, W * 2), np.uint32)[:, ::2]
+        elle_mesh.set_bits(plane, src, dst)
+        assert (plane == self._reference_loop(n, W, src, dst)).all()
+
+    def test_packed_stacked_equals_dense_pack(self):
+        from jepsen_tpu.elle import infer as infer_mod
+        from jepsen_tpu.history import invoke_op, ok_op
+        rng = random.Random(13)
+        ops, states = [], {"x": [], "y": []}
+        v = 0
+        for p in range(40):
+            k = rng.choice(("x", "y"))
+            if rng.random() < 0.5:
+                v += 1
+                states[k] = states[k] + [v]
+                mops = [["append", k, v]]
+            else:
+                mops = [["r", k, list(states[k])]]
+            inv = [["r", k, None]] if mops[0][0] == "r" else mops
+            ops.append(invoke_op(p, "txn", inv))
+            ops.append(ok_op(p, "txn", mops))
+        h = History(ops).index()
+        inf = infer_mod.infer(h)
+        assert inf.edge_lists is not None
+        for n_dev in (1, 2):
+            packed = inf.packed_stacked(n_dev=n_dev)
+            dense = elle_mesh.pack_planes(inf.stacked(), n_dev=n_dev)
+            assert packed.shape == dense.shape
+            assert (packed == dense).all()
+
+
+# ---------------------------------------------------------------------------
+# live: route_ops / Tenant.ingest parity
+# ---------------------------------------------------------------------------
+
+class TestLiveRouting:
+    def _ops(self, n=300, seed=11):
+        rng = random.Random(seed)
+        ops, open_p = [], {}
+        for _ in range(n):
+            if open_p and (len(open_p) >= 5 or rng.random() < 0.5):
+                p = rng.choice(sorted(open_p))
+                f, v, k = open_p.pop(p)
+                t = rng.choice(["ok", "ok", "ok", "fail", "info"])
+                ops.append(Op(process=p, type=t, f=f, value=KV(k, v)))
+            else:
+                p = rng.randrange(100)
+                while p in open_p:
+                    p = rng.randrange(100)
+                f, v, k = "write", rng.randrange(5), rng.randrange(3)
+                open_p[p] = (f, v, k)
+                ops.append(Op(process=p, type="invoke", f=f,
+                              value=KV(k, v)))
+        ops.append(Op(process="nemesis", type="info", f="kill",
+                      value=None))
+        ops.append(Op(process=77, type="weird", f="x", value=1))
+        return ops
+
+    def test_ingest_native_equals_python(self, monkeypatch):
+        from jepsen_tpu.live.windows import Tenant
+        model = models.Register(0)
+        ops = self._ops()
+        walls = [float(i) for i in range(len(ops))]
+        t_nat = Tenant("a", "ts", None, model)
+        t_nat.ingest([copy.copy(o) for o in ops], walls)
+        monkeypatch.setenv("JEPSEN_TPU_PACK_THREADS", "0")
+        t_py = Tenant("a", "ts", None, model)
+        t_py.ingest([copy.copy(o) for o in ops], walls)
+        assert t_nat.stats() == t_py.stats()
+        assert t_nat._record_n == t_py._record_n
+        assert sorted(map(repr, t_nat.lanes)) == \
+            sorted(map(repr, t_py.lanes))
+        for k, ln in t_nat.lanes.items():
+            other = t_py.lanes[k]
+            assert ln.ops_seen == other.ops_seen
+            assert len(ln.buffer) == len(other.buffer)
+            assert len(ln.sealed) == len(other.sealed)
+
+    @packext
+    def test_route_ops_classification(self):
+        mod = native.packext()
+        ops = [Op(process=3, type="invoke", f="write", value=KV(1, 2)),
+               Op(process=3, type="ok", f="write", value=KV(1, 2)),
+               Op(process="nemesis", type="info", f="kill", value=None),
+               Op(process=4, type="weird", f="x", value=(1, 2)),
+               Op(process=5, type="invoke", f="read", value=None)]
+        kinds, procs_b, idxs_b, fs, keys, vals = mod.route_ops(ops, 10)
+        procs = np.frombuffer(procs_b, np.int64)
+        idxs = np.frombuffer(idxs_b, np.int64)
+        assert list(kinds) == [0, 1, 5, 4, 0]
+        assert list(procs) == [3, 3, -1, 4, 5]
+        # missing indices synthesized in WAL order
+        assert list(idxs) == [10, 11, 12, 13, 14]
+        assert all(o.index is not None for o in ops)
+        assert keys[0] == 1 and vals[0] == 2       # KV split
+        assert keys[3] is None and vals[3] == (1, 2)  # plain tuple
+        assert fs[0] == "write" and fs[2] is None
+
+
+# ---------------------------------------------------------------------------
+# build discipline
+# ---------------------------------------------------------------------------
+
+class TestBuildDiscipline:
+    def test_md5_stamp_gates_rebuild(self, tmp_path, monkeypatch):
+        """An unchanged source never re-invokes the compiler; a stamp
+        mismatch does (the faultfs md5 discipline, locally)."""
+        calls = []
+        real_run = native.subprocess.run
+
+        def counting_run(cmd, **kw):
+            calls.append(cmd)
+            return real_run(cmd, **kw)
+
+        monkeypatch.setattr(native.subprocess, "run", counting_run)
+        out = native._build("_histscan", "histscan.c")
+        if out is None:
+            pytest.skip("no C compiler on this host")
+        assert calls == []       # stamp fresh from the earlier build
+        stamp = out + ".md5"
+        with open(stamp, "w") as f:
+            f.write("stale")
+        out2 = native._build("_histscan", "histscan.c")
+        assert out2 == out
+        assert len(calls) == 1   # exactly one rebuild
+        with open(stamp) as f:
+            assert f.read().strip() != "stale"
+
+    @packext
+    def test_packext_exports(self):
+        mod = native.packext()
+        for name in ("pack_compact_many", "scan_cols_many",
+                     "or_words", "route_ops"):
+            assert hasattr(mod, name)
+
+    def test_no_native_knob_disables(self, monkeypatch):
+        monkeypatch.setenv("JEPSEN_TPU_NO_NATIVE", "1")
+        native._cache.clear()
+        try:
+            assert native.packext() is None
+            assert planner.pack_backend_effective() == "python"
+        finally:
+            native._cache.clear()
