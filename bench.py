@@ -928,13 +928,16 @@ def main() -> int:
           f"{badw_med:.3f}s) with exact witness op "
           f"{rbw.get('op_index')} == planted read", file=sys.stderr)
 
-    # (d) the DEEP regime (VERDICT r4 #3, extended per r5 Next #7): a
-    # subtle legal-value stale read planted at 90% depth of R = 10 /
-    # 12 / 14 histories — the full invalid-half of the envelope, at
-    # the SAME depths as the valid half below.  The wgl_deep kernel
-    # reports the exact failing event; witness equality vs the capped
-    # oracle is asserted whenever the oracle finishes.
-    for mo_d, seed_d in ((10, 53), (12, 57), (14, 59)):
+    # (d) the DEEP regime (VERDICT r4 #3, extended per r5 Next #7 and
+    # ISSUE 10): a subtle legal-value stale read planted at 90% depth
+    # of R = 10 / 12 / 14 / 15 / 16 histories — the full invalid-half
+    # of the envelope at the SAME depths as the valid half below,
+    # R = 15/16 now on the word-split sub-plane stack instead of the
+    # serial chain.  The wgl_deep kernel reports the exact failing
+    # event; witness equality vs the capped oracle is asserted
+    # whenever the oracle finishes.
+    for mo_d, seed_d in ((10, 53), (12, 57), (14, 59), (15, 61),
+                         (16, 63)):
         badd = make_history(20_000, 16, seed=seed_d, vmax=9,
                             max_open=mo_d)
         planted_d = plant_stale_read(badd, 0.9, 9)
@@ -955,13 +958,14 @@ def main() -> int:
         # the optional localize tier replays a capped oracle on the
         # prefix for final-paths artifacts, which would time the
         # oracle, not the device (the same measurement choice as the
-        # crash-regime lines).  max_open_bits=15 admits the R=14 row
-        # (the depth cap is the kernel's R_MAX, not this plan gate).
-        wgl_seg.check(model, badd, max_open_bits=15,          # warm
+        # crash-regime lines).  max_open_bits=17 admits every depth up
+        # to the word-split boundary (the depth cap is
+        # planner.deep_r_max, not this plan gate).
+        wgl_seg.check(model, badd, max_open_bits=17,          # warm
                       localize=False)
         badd_wall, badd_med, rbd = timed(
             lambda badd=badd: wgl_seg.check(model, badd,
-                                            max_open_bits=15,
+                                            max_open_bits=17,
                                             localize=False))
         if rbd["valid?"] is not False \
                 or rbd.get("engine") != "wgl_deep" \
@@ -1025,7 +1029,11 @@ def main() -> int:
     N_DEEP = 16
     env_wins = []
     shallow_win = None
-    for mo in (6, 8, 10, 12, 14):
+    # per-depth engine-variant disclosure (ISSUE 10 no-silent-caps:
+    # which depths ran the resident plane vs word-split vs hypercube)
+    deep_variants: dict = {}
+    deep_exchange_rounds: dict = {}
+    for mo in (6, 8, 10, 12, 14, 15, 16):
         ehs = [make_history(20_000, 16, seed=41 + mo + 101 * s,
                             vmax=9, max_open=mo)
                for s in range(N_DEEP)]
@@ -1062,6 +1070,9 @@ def main() -> int:
                               + str(env_run_bad[:5]), "value": 0,
                               "unit": "ops/sec", "vs_baseline": 0}))
             return 1
+        deep_variants[str(mo)] = (
+            "seg" if mo <= 6 else
+            "word-split" if mo > wgl_deep.R_BASE else "plane")
         per = emin / N_DEEP
         if mo > 6:
             env_wins.append(nmin / per)
@@ -1074,13 +1085,84 @@ def main() -> int:
               f"{ne / per:.0f} ops/s/history ({N_DEEP}x pipelined, "
               f"min {emin:.2f}s median {emed:.2f}s batch; "
               + ("register-delta segment engine" if mo <= 6 else
-                 "wgl_deep megakernel")
+                 "wgl_deep megakernel" if mo <= wgl_deep.R_BASE else
+                 "wgl_deep megakernel, word-split x"
+                 f"{2 ** (mo - wgl_deep.R_BASE)}")
               + f"); native oracle {ne / nmin:.0f} ops/s "
               f"(min {nmin * 1e3:.0f}ms median {nmed * 1e3:.0f}ms) "
               f"-> device {nmin / per:.2f}x", file=sys.stderr)
-    # mixed-depth batch: one R = 15 history (beyond R_MAX) rides the
-    # deep pipeline's straggler fallback without poisoning the batch
-    # (VERDICT r4 #2); correctness asserted, not timed.
+    # --- R = 17 on the hypercube mask shard (ISSUE 10): the top
+    # log2(D) mask bits live on the device axis; one pairwise ppermute
+    # per high slot per event round.  Runs only where a power-of-2
+    # mesh >= 8 exists; a skipped mesh is DISCLOSED in the parsed
+    # tail, never silent.  The refutation twin at the same depth
+    # asserts the exact planted witness.
+    n_devs = len(jax.devices())
+    deep_r_max_eff = planner.deep_r_max(None, n_devs)
+    if n_devs >= 8:
+        from jax.sharding import Mesh
+        hmesh = Mesh(np.array(jax.devices()[:8]), ("cfg",))
+        base17 = make_history(4_000, 20, seed=987, vmax=9, max_open=14)
+        b17 = [invoke_op(300 + p, "write", p % 10) for p in range(17)] \
+            + [ok_op(300 + p, "write", p % 10) for p in range(17)]
+        h17 = History(list(base17.ops) + b17).index()
+        h17.attach_packed(pack_history(h17))
+        wgl_deep.check_hypercube(model, [h17], hmesh)       # warm
+        hc_wall, hc_med, hcres = timed(
+            lambda: wgl_deep.check_hypercube(model, [h17], hmesh), n=3)
+        r17 = hcres[0]
+        n17 = sum(1 for o in h17 if o.is_invoke)
+        planted_17 = plant_stale_read(h17, 0.9, 9)
+        if (r17["valid?"] is not True
+                or r17.get("deep_variant") != "hypercube"
+                or planted_17 is None):
+            print(json.dumps({"metric": "ERROR: R=17 hypercube row "
+                              "failed (valid/variant/plant): "
+                              + str({k: r17.get(k) for k in
+                                     ("valid?", "deep_variant")}),
+                              "value": 0, "unit": "ops/sec",
+                              "vs_baseline": 0}))
+            return 1
+        p17 = h17.ops[planted_17[0]].process
+        inv17 = planted_17[0]
+        while inv17 >= 0 and not (h17.ops[inv17].process == p17
+                                  and h17.ops[inv17].type == "invoke"):
+            inv17 -= 1
+        rb17 = wgl_deep.check_hypercube(model, [h17], hmesh)[0]
+        if rb17["valid?"] is not False \
+                or rb17.get("op_index") != h17.ops[inv17].index:
+            print(json.dumps({"metric": "ERROR: R=17 hypercube "
+                              "refutation twin missed the planted "
+                              "witness: " + str({k: rb17.get(k) for k
+                                                 in ("valid?",
+                                                     "op_index")}),
+                              "value": 0, "unit": "ops/sec",
+                              "vs_baseline": 0}))
+            return 1
+        deep_variants["17"] = "hypercube"
+        deep_exchange_rounds["17"] = int(r17["exchange_rounds"])
+        print(json.dumps({
+            "metric": (f"deep hypercube: one {n17}-op R=17 history "
+                       "mask-sharded over 8 devices (one ppermute per "
+                       "high slot per event round), valid wall + "
+                       "planted-witness refutation twin asserted"),
+            "value": round(n17 / hc_wall, 1), "unit": "ops/sec",
+            "vs_baseline": round(r17["exchange_rounds"], 0)}),
+            file=sys.stderr)
+        print(f"# hypercube R=17: {n17} ops in {hc_wall:.2f}s (median "
+              f"{hc_med:.2f}s) over shards={r17['shards']}, "
+              f"{r17['exchange_rounds']} pairwise exchanges; planted "
+              f"witness op {rb17.get('op_index')} exact",
+              file=sys.stderr)
+    else:
+        deep_variants["17"] = f"skipped (mesh has {n_devs} < 8 devices)"
+        print(f"# hypercube R=17 row SKIPPED: {n_devs} devices < 8 "
+              "(disclosed in the parsed tail)", file=sys.stderr)
+
+    # mixed-depth batch (VERDICT r4 #2, boundary moved by ISSUE 10):
+    # R <= 14 histories + one R = 15 (now IN scope, word-split) + one
+    # R = 18 beyond every device tier, which must ride the serial
+    # fallback chain without poisoning the batch.
     mixed = [make_history(20_000, 16, seed=977 + s, vmax=9,
                           max_open=14) for s in range(3)]
     deep15 = make_history(1_200, 18, seed=981, vmax=9, max_open=14)
@@ -1088,54 +1170,89 @@ def main() -> int:
         + [ok_op(100 + p, "write", p % 10) for p in range(15)]
     h15 = History(list(deep15.ops) + burst).index()
     h15.attach_packed(pack_history(h15))
-    mixed.append(h15)                # guaranteed R = 15 > R_MAX
+    mixed.append(h15)                # guaranteed R = 15: word-split
+    deep18 = make_history(1_200, 22, seed=983, vmax=9, max_open=14)
+    burst18 = [invoke_op(100 + p, "write", p % 10) for p in range(18)] \
+        + [ok_op(100 + p, "write", p % 10) for p in range(18)]
+    h18 = History(list(deep18.ops) + burst18).index()
+    h18.attach_packed(pack_history(h18))
+    mixed.append(h18)                # guaranteed R = 18 > deep_r_max
     mres = wgl_deep.check_pipeline(model, mixed)
     m_bad = [i for i, r in enumerate(mres) if r["valid?"] is not True]
-    if m_bad or mres[-1].get("engine") == "wgl_deep" and \
-            mres[-1].get("max_open", 0) > wgl_deep.R_MAX:
+    if m_bad \
+            or mres[3].get("deep_variant") != "word-split" \
+            or mres[-1].get("engine") == "wgl_deep":
         print(json.dumps({"metric": "ERROR: mixed-depth deep batch "
-                          f"judged invalid: {m_bad[:5]}", "value": 0,
+                          f"judged invalid ({m_bad[:5]}) or "
+                          "mis-routed: R=15 -> "
+                          + str(mres[3].get("deep_variant"))
+                          + ", R=18 -> "
+                          + str(mres[-1].get("engine", "wgl-serial")),
+                          "value": 0,
                           "unit": "ops/sec", "vs_baseline": 0}))
         return 1
-    print(f"# envelope mixed-depth: R<=14 batch + one R=15 straggler "
-          f"-> all valid; straggler engine="
-          f"{mres[-1].get('engine', 'wgl-serial')}", file=sys.stderr)
-    # PRICE the R >= 15 serial-chain concession (VERDICT r5 Next #3):
-    # the straggler rides the serial fallback chain one history at a
-    # time — measure what that concession actually costs per straggler
-    # against the capped native oracle on the SAME history, so the
-    # "mixed batches still work" claim carries its bill.
-    strag_wall, strag_med, sres = timed(
+    print(f"# envelope mixed-depth: R<=14 batch + R=15 (word-split, "
+          f"stayed on-device) + R=18 straggler -> all valid; "
+          f"straggler engine="
+          f"{mres[-1].get('engine', 'wgl-serial')} — the serial "
+          "fallback provably still engages beyond the new boundary",
+          file=sys.stderr)
+    # PRICE the sharding win and the residual serial concession
+    # (VERDICT r5 Next #3, ISSUE 10): the SAME R = 15 history on the
+    # word-split device path vs the serial chain it used to ride
+    # (forced via JEPSEN_TPU_NO_DEEP_SHARD — a prune, so the old
+    # routing is exactly reproduced), vs the capped native oracle.
+    r15_wall, r15_med, r15res = timed(
         lambda: wgl_deep.check_pipeline(model, [h15]), n=3)
-    if sres[0]["valid?"] is not True:
-        print(json.dumps({"metric": "ERROR: R=15 straggler judged "
-                          + str(sres[0]["valid?"]), "value": 0,
+    if r15res[0]["valid?"] is not True \
+            or r15res[0].get("deep_variant") != "word-split":
+        print(json.dumps({"metric": "ERROR: R=15 device row not "
+                          "word-split valid: "
+                          + str({k: r15res[0].get(k) for k in
+                                 ("valid?", "deep_variant")}),
+                          "value": 0,
                           "unit": "ops/sec", "vs_baseline": 0}))
         return 1
+    os.environ["JEPSEN_TPU_NO_DEEP_SHARD"] = "1"
+    try:
+        wgl_deep.check_pipeline(model, [h15])           # warm serial
+        strag_wall, strag_med, sres = timed(
+            lambda: wgl_deep.check_pipeline(model, [h15]), n=3)
+    finally:
+        del os.environ["JEPSEN_TPU_NO_DEEP_SHARD"]
+    if sres[0]["valid?"] is not True \
+            or sres[0].get("engine") == "wgl_deep":
+        print(json.dumps({"metric": "ERROR: forced-serial R=15 "
+                          "straggler judged "
+                          + str(sres[0]["valid?"]) + " on "
+                          + str(sres[0].get("engine")), "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return 1
+    deep_r15_vs_serial = strag_wall / r15_wall
     wgl_cpu_native.check(model, h15)                    # warm
     nat15_s, _, rn15 = timed(
         lambda: wgl_cpu_native.check(model, h15, time_limit=HARD_CPU_CAP),
         n=3)
     n15 = sum(1 for o in h15 if o.is_invoke)
     print(json.dumps({
-        "metric": (f"mixed-depth straggler price: one {n15}-op R=15 "
-                   "history (beyond R_MAX) on the serial-chain "
-                   "fallback, wall per straggler vs the capped native "
-                   "oracle on the SAME history"),
-        "value": round(strag_wall, 4), "unit": "s/straggler",
-        "vs_baseline": round(nat15_s / strag_wall, 2)}),
+        "metric": (f"R=15 ceiling broken: one {n15}-op R=15 history "
+                   "on the word-split device path vs the serial chain "
+                   "it rode before ISSUE 10 (same history, serial "
+                   "forced by JEPSEN_TPU_NO_DEEP_SHARD)"),
+        "value": round(r15_wall, 4), "unit": "s/history",
+        "vs_baseline": round(deep_r15_vs_serial, 2)}),
         file=sys.stderr)
-    print(f"# straggler price: serial chain {strag_wall * 1e3:.0f}ms "
-          f"(median {strag_med * 1e3:.0f}ms, engine "
-          f"{sres[0].get('engine', 'wgl-serial')}) vs native oracle "
+    print(f"# R=15 pricing: word-split device {r15_wall * 1e3:.0f}ms "
+          f"(median {r15_med * 1e3:.0f}ms) vs forced serial chain "
+          f"{strag_wall * 1e3:.0f}ms (median {strag_med * 1e3:.0f}ms, "
+          f"engine {sres[0].get('engine', 'wgl-serial')}) -> "
+          f"{deep_r15_vs_serial:.1f}x; native oracle "
           f"{nat15_s * 1e3:.0f}ms (verdict {rn15['valid?']}) on the "
-          f"same history -> oracle/serial = {nat15_s / strag_wall:.2f}x"
-          " (values < 1 mean each straggler costs MORE than just "
-          "running the native oracle on it — the honest bill for the "
-          "R>=15 concession)", file=sys.stderr)
+          "same history", file=sys.stderr)
     print(json.dumps({
         "metric": ("deep-overlap envelope: 20k-op histories at "
-                   "max_open 8/10/12/14, pipelined wgl_deep vs warmed "
+                   "max_open 8/10/12/14/15/16 (word-split sub-plane "
+                   "stacks past 14), pipelined wgl_deep vs warmed "
                    "native C oracle; value = min speedup across "
                    "deep depths"),
         "value": round(min(env_wins), 2), "unit": "x vs native",
@@ -1560,6 +1677,16 @@ def main() -> int:
         "wire_mb_s": round(wire_mb_s, 1),
         "straggler_r15_s": round(strag_wall, 4),
         "straggler_vs_native": round(nat15_s / strag_wall, 2),
+        # the deep envelope past the old R=14 ceiling (ISSUE 10): the
+        # effective boundary on THIS host's mesh, the R=15 word-split
+        # device wall vs the serial chain it replaced, and the
+        # per-depth variant + exchange-schedule disclosure (depths the
+        # host could not run sharded are named, never silent)
+        "deep_r_max_effective": deep_r_max_eff,
+        "deep_r15_device_s": round(r15_wall, 4),
+        "deep_r15_vs_serial": round(deep_r15_vs_serial, 2),
+        "deep_variants": deep_variants,
+        "deep_exchange_rounds": deep_exchange_rounds,
         # the new transactional-isolation engine's trajectory
         # (BENCH_r06+): device seconds per history for the batched
         # typed-plane closure, and its speedup vs the host oracle

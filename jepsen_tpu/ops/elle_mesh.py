@@ -255,7 +255,9 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec
 
-    from jepsen_tpu.ops import shard_map_compat
+    from jepsen_tpu.ops.shard_map_compat import (all_gather_frontier,
+                                                 frontier_settled,
+                                                 shard_map_compat)
 
     n_dev = len(devs)
     m = n_pad // n_dev
@@ -303,7 +305,7 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
         base = ww | wr | od
 
         def gather(x):
-            return jax.lax.all_gather(x, "rows", tiled=True)
+            return all_gather_frontier(x, "rows")
 
         def cond(st):
             _, _, _, rounds, done = st
@@ -318,7 +320,7 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
             p1n = p1 | pmm(q, p1_f) | pmm(p1, q_f)
             ch = (jnp.any(cww2 != cww) | jnp.any(p0n != p0)
                   | jnp.any(p1n != p1))
-            done = jax.lax.psum(ch.astype(jnp.int32), "rows") == 0
+            done = frontier_settled(ch, "rows")
             return cww2, p0n, p1n, rounds + 1, done
 
         cww, p0, p1, rounds, _ = jax.lax.while_loop(

@@ -104,8 +104,14 @@ PRUNE_KNOBS: dict = {
                            "wgl_seg_pipeline", "wgl_deep"),
     "JEPSEN_TPU_DYN_ROUNDS": ("wgl_seg_regs", "wgl_seg_batch_regs",
                               "wgl_seg_pipeline", "wgl_deep"),
-    "JEPSEN_TPU_NO_DEEP": ("wgl_deep", "wgl_deep_pipeline",
+    "JEPSEN_TPU_NO_DEEP": ("wgl_deep", "wgl_deep_split",
+                           "wgl_deep_hc", "wgl_deep_pipeline",
                            "wgl_deep_mesh"),
+    # the sharded deep variants (word-split sub-plane stacks and the
+    # hypercube mask shard, ISSUE 10) can be pruned without touching
+    # the classic single-plane kernel: routing collapses back to the
+    # R <= DEEP_R_BASE boundary and the serial chain beyond it
+    "JEPSEN_TPU_NO_DEEP_SHARD": ("wgl_deep_split", "wgl_deep_hc"),
     # opt-in segmented batch engine: the knob prunes the single-lane
     # engines ABOVE it in the base chain so the segmented tier surfaces
     "JEPSEN_TPU_SEGMENT": ("wgl_seg_batch_regs", "wgl_seg_batch"),
@@ -160,6 +166,15 @@ class Plan:
     # executable cache is backend-agnostic.
     pack_backend: str = "python"
     pack_threads: int = 0
+    # Deep-envelope provenance (ISSUE 10): which mask-plane variant the
+    # head engine runs ("plane" | "word-split" | "hypercube" |
+    # "replicated"), over how many shards (stacked sub-planes on one
+    # device, or mesh devices), and how many pairwise hypercube
+    # exchanges ONE closure round costs (= the high mask bits living on
+    # the device axis; 0 for device-resident planes).
+    deep_variant: str = ""
+    shards: int = 0
+    exchange_rounds: int = 0
 
     @property
     def chain(self) -> tuple:
@@ -175,6 +190,10 @@ class Plan:
              "why": self.why, "bucket": list(self.bucket),
              "pack_backend": self.pack_backend,
              "pack_threads": self.pack_threads}
+        if self.deep_variant:
+            d["deep_variant"] = self.deep_variant
+            d["shards"] = self.shards
+            d["exchange_rounds"] = self.exchange_rounds
         if self.pruned:
             d["pruned"] = [list(p) for p in self.pruned]
         if self.rejected:
@@ -236,18 +255,64 @@ def _regs_eligible(R: int, U: int, Sn: int, decomposed: bool,
 
 
 #: wgl_deep's scope constants, owned here so the planner and the kernel
-#: module cannot drift (wgl_deep re-exports R_MAX).
-DEEP_R_MAX = 14
+#: module cannot drift (wgl_deep re-exports them).  DEEP_R_BASE is the
+#: overlap depth ONE resident [Sn, 2^R/32] uint32 plane covers — the
+#: hard `DEEP_R_MAX = 14` cap it replaces (ISSUE 10); past it the mask
+#: axis is partitioned instead of refused, so the routing boundary is
+#: the function `deep_r_max(backend, n_devices)` below, not a constant.
+DEEP_R_BASE = 14
+#: Sub-planes the single-device word-split path may stack (2 buys
+#: R = 15, 4 buys R = 16): each sub-plane stays one base-sized
+#: [Sn, 512]-word tile, so per-op VPU appetite is unchanged and only
+#: the stack (and the event walk's per-bit work) grows.
+DEEP_SPLIT_MAX = 4
 DEEP_SN_MAX = 32
 
 
+def deep_split_planes(R: int) -> int:
+    """Sub-plane count the word-split deep kernel stacks at overlap
+    depth R (1 = the classic single resident plane)."""
+    return 1 << max(0, int(R) - DEEP_R_BASE)
+
+
+def deep_r_max(backend: Optional[str] = None,
+               n_devices: Optional[int] = None,
+               env: Optional[dict] = None) -> int:
+    """THE deep-overlap boundary, replacing the hard DEEP_R_MAX = 14:
+
+      * one device covers DEEP_R_BASE with a single resident plane and
+        + log2(DEEP_SPLIT_MAX) more by word-splitting the plane into a
+        stack of base-sized sub-planes (R = 15/16);
+      * an n-device mesh covers DEEP_R_BASE + log2(n_devices) by
+        mapping the top mask bits onto the device axis (the hypercube
+        shard — R = 17 on 8 devices), whichever is larger.
+
+    `backend` is part of the signature so per-backend envelopes can
+    diverge without another call-site sweep; today the tpu kernel and
+    its cpu interpreter share one boundary (whether the backend can run
+    the deep engine AT ALL stays `deep_supported`'s concern).
+    JEPSEN_TPU_NO_DEEP_SHARD=1 collapses both extensions back to the
+    single-plane base — a prune, never an invention (PRUNE_KNOBS)."""
+    del backend
+    env = _snapshot_env(env)
+    if env.get("JEPSEN_TPU_NO_DEEP_SHARD") == "1":
+        return DEEP_R_BASE
+    r = DEEP_R_BASE + (DEEP_SPLIT_MAX.bit_length() - 1)
+    if n_devices and int(n_devices) > 1:
+        r = max(r, DEEP_R_BASE + (int(n_devices).bit_length() - 1))
+    return r
+
+
 def deep_supported(R: int, Sn: int, U: int, decomposed: bool,
-                   backend: str, env: Optional[dict] = None) -> bool:
+                   backend: str, env: Optional[dict] = None,
+                   n_devices: Optional[int] = None) -> bool:
     """Gate shared with the wgl_seg dispatcher: the deep kernel takes
-    decomposable models with Sn <= 32 on TPU at any R <= DEEP_R_MAX.
-    It is *profitable* past the register-delta gate (R > 6);
-    eligibility below that is still correct and used by the
-    differential tests.
+    decomposable models with Sn <= 32 on TPU at any
+    R <= deep_r_max(backend, n_devices) — the single-device word-split
+    envelope by default, the hypercube-mesh envelope when `n_devices`
+    names the mesh a caller can shard over.  It is *profitable* past
+    the register-delta gate (R > 6); eligibility below that is still
+    correct and used by the differential tests.
 
     The 'cpu' backend runs the Pallas INTERPRETER — a per-event Python
     loop, orders of magnitude slower than the compiled candidate-table
@@ -258,7 +323,8 @@ def deep_supported(R: int, Sn: int, U: int, decomposed: bool,
     knob is a backend-capability input, not a prune knob (see module
     docstring)."""
     env = _snapshot_env(env)
-    return (decomposed and 0 < R <= DEEP_R_MAX and Sn <= DEEP_SN_MAX
+    return (decomposed and 0 < R <= deep_r_max(backend, n_devices, env)
+            and Sn <= DEEP_SN_MAX
             and U <= 32767
             and (backend == "tpu"
                  or (backend == "cpu"
@@ -338,16 +404,37 @@ def _linear_candidates(s: Shape, env: dict, backend: str):
             f"R={R_eff} Sn={Sn}: register-delta segment kernel "
             "(quiescent cuts, device-maintained open set)")
 
+    dmax1 = deep_r_max(backend, 1, env=env)
     if deep_supported(R_eff, Sn, U, decomposed, backend, env=env):
-        cands.append("wgl_deep")
-        why["wgl_deep"] = (
-            f"R={R_eff} <= {DEEP_R_MAX}, Sn={Sn} <= {DEEP_SN_MAX} "
-            "decomposed: deep-overlap Pallas megakernel"
-            + (f" ({nc} crashed calls as permanent slots)" if nc else ""))
+        dname = "wgl_deep" if R_eff <= DEEP_R_BASE else "wgl_deep_split"
+        cands.append(dname)
+        if dname == "wgl_deep":
+            why[dname] = (
+                f"R={R_eff} <= {DEEP_R_BASE}, Sn={Sn} <= {DEEP_SN_MAX} "
+                "decomposed: deep-overlap Pallas megakernel"
+                + (f" ({nc} crashed calls as permanent slots)"
+                   if nc else ""))
+        else:
+            why[dname] = (
+                f"R={R_eff} <= {dmax1}, Sn={Sn} <= {DEEP_SN_MAX} "
+                "decomposed: word-split deep kernel "
+                f"({deep_split_planes(R_eff)} stacked sub-planes)"
+                + (f" ({nc} crashed calls as permanent slots)"
+                   if nc else ""))
     else:
         rejected.append(("wgl_deep",
                          f"R={R_eff}/Sn={Sn}/backend={backend} outside "
                          "the deep megakernel gate"))
+    # beyond one device's stack but within the mesh envelope: the
+    # hypercube mask shard (top mask bits -> device index)
+    if (s.mesh or 0) > 1 and R_eff > dmax1 and deep_supported(
+            R_eff, Sn, U, decomposed, backend, env=env,
+            n_devices=s.mesh):
+        cands.append("wgl_deep_hc")
+        why["wgl_deep_hc"] = (
+            f"R={R_eff} <= {deep_r_max(backend, s.mesh, env=env)} on "
+            f"the {s.mesh}-device hypercube shard (top mask bits -> "
+            "device index, one ppermute per high slot per event round)")
 
     if nc == 0 and s.R <= s.max_open_bits and Sn <= s.max_states:
         cands.append("wgl_seg")
@@ -447,26 +534,48 @@ def plan_engines(shape: Shape, env: Optional[dict] = None,
         Sn = s.Sn if s.Sn is not None else 1
         U = s.U if s.U is not None else 1
         dec = s.decomposed if s.decomposed is not None else True
-        if deep_supported(max(s.R, 1), Sn, U, dec, backend,
-                          env=_availability_env(env)):
+        avail = _availability_env(env)
+        if deep_supported(max(s.R, 1), Sn, U, dec, backend, env=avail):
             cands.append("wgl_deep_pipeline")
             why["wgl_deep_pipeline"] = (
-                "pipelined deep megakernel (async dispatch, one fetch)")
+                "pipelined deep megakernel (async dispatch, one fetch)"
+                + (f"; word-split x{deep_split_planes(s.R)} past "
+                   f"R={DEEP_R_BASE}" if s.R > DEEP_R_BASE else ""))
         else:
             rejected.append(("wgl_deep_pipeline",
                              f"R={s.R}/Sn={Sn}/backend={backend} "
                              "outside the deep gate"))
+        if (s.mesh or 0) > 1 and deep_supported(
+                max(s.R, 1), Sn, U, dec, backend, env=avail,
+                n_devices=s.mesh):
+            # the pipeline's deep stragglers (R past one device's
+            # stack) ride the hypercube shard before the serial chain
+            cands.append("wgl_deep_hc")
+            why.setdefault("wgl_deep_hc", (
+                f"hypercube straggler tier over {s.mesh} devices"))
         cands.extend(["wgl_seg", "wgl", "wgl_cpu"])
         why.setdefault("wgl_seg", "per-straggler single-history chain")
         why.setdefault("wgl", "serial device frontier kernel")
         why.setdefault("wgl_cpu", "exact CPU oracle (total)")
     elif s.kind == "deep-mesh":
-        cands = ["wgl_deep_mesh", "wgl_deep_pipeline", "wgl_seg",
-                 "wgl", "wgl_cpu"]
         rejected = []
-        why = {"wgl_deep_mesh": (
-            f"one history per device over {s.mesh or '?'} devices, "
-            "no collectives")}
+        if s.R > deep_r_max(backend, 1, env=_availability_env(env)):
+            cands = ["wgl_deep_hc", "wgl_seg", "wgl", "wgl_cpu"]
+            why = {"wgl_deep_hc": (
+                f"R={s.R} beyond the single-device stack: mask-sharded "
+                f"hypercube over {s.mesh or '?'} devices (top "
+                f"{max((s.mesh or 2).bit_length() - 1, 1)} mask bits "
+                "-> device index)")}
+            rejected.append(("wgl_deep_mesh",
+                             f"R={s.R} exceeds one device's plane "
+                             "stack; replicated one-history-per-device "
+                             "layout cannot hold it"))
+        else:
+            cands = ["wgl_deep_mesh", "wgl_deep_pipeline", "wgl_seg",
+                     "wgl", "wgl_cpu"]
+            why = {"wgl_deep_mesh": (
+                f"one history per device over {s.mesh or '?'} devices, "
+                "no collectives")}
     elif s.kind == "batch-many":
         cands = ["wgl_batch", "wgl", "wgl_cpu"]
         rejected = []
@@ -488,7 +597,28 @@ def plan_engines(shape: Shape, env: Optional[dict] = None,
                 why=why.get(head, "eligible"), bucket=bucket,
                 pruned=pruned, rejected=tuple(rejected), shape=s,
                 pack_backend=pack_backend_effective(env),
-                pack_threads=pack_threads_effective(env))
+                pack_threads=pack_threads_effective(env),
+                **_deep_extras(head, s))
+
+
+def _deep_extras(engine: str, s: Shape) -> dict:
+    """The deep-envelope provenance fields a plan carries when its head
+    is a deep variant (deep_variant / shards / exchange_rounds)."""
+    if not engine.startswith("wgl_deep"):
+        return {}
+    R = int(s.R + s.crashes)
+    if engine == "wgl_deep_hc":
+        d = max(int(s.mesh or 2), 2)
+        return {"deep_variant": "hypercube", "shards": d,
+                "exchange_rounds": d.bit_length() - 1}
+    if engine == "wgl_deep_split" or (
+            engine == "wgl_deep_pipeline" and R > DEEP_R_BASE):
+        return {"deep_variant": "word-split",
+                "shards": deep_split_planes(R)}
+    if engine == "wgl_deep_mesh":
+        return {"deep_variant": "replicated",
+                "shards": int(s.mesh or 0)}
+    return {"deep_variant": "plane", "shards": 1}
 
 
 def _bucket_for(engine: str, s: Shape) -> tuple:
@@ -496,8 +626,12 @@ def _bucket_for(engine: str, s: Shape) -> tuple:
     under — the components knowable at plan time; entry points refine
     with exact padded dims once packing has run (`Plan.refine`)."""
     if engine.startswith("wgl_seg") or engine.startswith("wgl_deep"):
-        return (engine, int(s.R + s.crashes), s.Sn, s.U,
+        base = (engine, int(s.R + s.crashes), s.Sn, s.U,
                 _next_pow2(max(s.batch, 1)))
+        # the hypercube shard compiles per mesh size (the device axis
+        # IS a kernel dimension there, unlike the replicated layouts)
+        return base + (int(s.mesh),) if engine == "wgl_deep_hc" \
+            else base
     if engine == "wgl_batch":
         return (engine, _next_pow2(max(s.batch, 1)))
     return (engine,)

@@ -1,6 +1,16 @@
 """Resilient checker runtime: fault-tolerant execution around the
 batch entry points (`wgl_seg.check_pipeline` / `check_many`,
-`wgl_deep.check_pipeline` / `check_mesh`, `wgl_batch.check_many`).
+`wgl_deep.check_pipeline` / `check_mesh` / `check_hypercube`,
+`wgl_batch.check_many`).
+
+The deep engines' sub-plane stacks (ISSUE 10) compose with the OOM
+machinery on two axes: a batch-level OOM (e.g. the stacked verdict
+fetch of many word-split histories) bisects the HISTORY axis here,
+down to one history per dispatch; a single history whose stack still
+does not fit is demoted by `wgl_deep.check_pipeline` itself onto its
+straggler chain (hypercube mesh when available, then the serial
+engines) — counted in `jepsen_deep_oom_demotions_total`, never a
+silent wrong verdict.
 
 Long device-bound verification runs over large multi-history batches
 fail the way inference stacks fail, not the way unit tests fail: one
@@ -104,6 +114,7 @@ def _resolve_engine(engine) -> Callable:
         "seg_many": wgl_seg.check_many,
         "deep_pipeline": wgl_deep.check_pipeline,
         "deep_mesh": wgl_deep.check_mesh,
+        "deep_hc": wgl_deep.check_hypercube,
         "batch_many": wgl_batch.check_many,
     }
     try:

@@ -1,0 +1,95 @@
+"""Mesh-collective helpers shared by every shard_map engine.
+
+Two engines shard a monotone-frontier fixpoint over a device mesh —
+`ops.elle_mesh` (packed Adya closure, row-sharded) and
+`ops.wgl_deep.check_hypercube` (configuration-mask shard, ISSUE 10) —
+and both need the same three pieces of glue:
+
+  * `shard_map_compat` — `jax.shard_map` across the JAX-version drift
+    this repo has to survive (export location + the replication-check
+    kwarg spelling);
+  * `all_gather_frontier` — the per-round frontier all-gather (tiled,
+    so a row-shard gathers to the full operand every device's local
+    product needs);
+  * `frontier_settled` — the exact device-side early-exit test: the
+    closure state is monotone, so a round that changed nothing on ANY
+    device (psum of the per-device change flags is zero) IS the
+    fixpoint.
+
+The deep hypercube shard adds `hypercube_exchange`: with the top
+log2(D) mask bits mapped onto the device axis, a transition that flips
+high bit k is a deterministic pairwise `ppermute` with the partner
+`d XOR 2^k` — one exchange per high slot per event round, no
+all-to-all.  Extracted here (ISSUE 10 satellite) so the kwarg-drift
+handling and the frontier early-exit idiom exist ONCE; `ops/__init__`
+re-exports `shard_map_compat` for the long-standing callers
+(identity-pinned by tests/test_elle_mesh.py)."""
+
+from __future__ import annotations
+
+
+def shard_map_compat(body, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` across the JAX-version drift this repo has to
+    survive (ADVICE r5): the export moved out of `jax.experimental`,
+    and the "skip the replication check" kwarg is spelled `check_vma`
+    on newer releases, `check_rep` on 0.4.x (where the default check
+    also has no rule for several primitives we shard).  Degrade through
+    the spellings on unknown-kwarg TypeError instead of raising; a
+    total miss is a BackendUnavailable, not a crash.
+
+    The check must be *skipped*, not satisfied: our sharded bodies are
+    per-device-independent (or use explicit collectives), and e.g.
+    pallas_call carries no varying-mesh-axes info for the checker to
+    consume.
+    """
+    import jax
+
+    from jepsen_tpu.errors import BackendUnavailable
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:        # pre-export-move JAX releases
+        from jax.experimental.shard_map import shard_map
+
+    specs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    for kwarg in ({"check_vma": False}, {"check_rep": False}, {}):
+        try:
+            return shard_map(body, **specs,
+                             **kwarg)  # type: ignore[call-arg]
+        except TypeError:
+            continue
+    raise BackendUnavailable(
+        "jax.shard_map rejected every known kwarg spelling",
+        backend=jax.default_backend())
+
+
+def all_gather_frontier(x, axis: str):
+    """Gather a sharded frontier operand to its full extent along
+    `axis` (tiled: shards concatenate, no new leading axis) — the
+    per-round right-operand gather of every sharded closure here."""
+    import jax
+
+    return jax.lax.all_gather(x, axis, tiled=True)
+
+
+def frontier_settled(changed, axis: str):
+    """Exact mesh-wide fixpoint test for a MONOTONE frontier: True when
+    no device changed anything this round (psum of the boolean change
+    flags is zero).  Monotonicity is what makes this exact — an
+    unchanged round can never be followed by a changing one."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.lax.psum(changed.astype(jnp.int32), axis) == 0
+
+
+def hypercube_exchange(x, axis: str, bit: int, n_devices: int):
+    """One deterministic pairwise exchange on the hypercube: every
+    device swaps `x` with its partner `d XOR 2^bit` along `axis`
+    (a single ppermute — the full pairing permutation is its own
+    inverse).  Callers pre-mask `x` to the sending side, so the value
+    received on the non-sending side is exactly the moved data and the
+    sending side receives zeros."""
+    import jax
+
+    pairs = [(d, d ^ (1 << bit)) for d in range(int(n_devices))]
+    return jax.lax.ppermute(x, axis, perm=pairs)

@@ -1501,7 +1501,8 @@ def _check_deep(model, ops, fk, legal, next_state,
                 diag_w, const_w, const_t0, *, R, Sn, nc, localize,
                 backend_name, t0):
     """Deep-overlap single history on the ops.wgl_deep Pallas
-    megakernel (R > the register-delta gate, up to wgl_deep.R_MAX;
+    megakernel (R > the register-delta gate, up to the word-split
+    boundary planner.deep_r_max(backend, 1);
     crashed calls ride as permanent slots — no J-axis width limit).
     Returns a knossos-shaped result, or None when out of scope
     (callers fall through to the serial engines)."""
@@ -1534,6 +1535,9 @@ def _check_deep(model, ops, fk, legal, next_state,
         "time_plan_s": t_plan,
         "time_kernel_s": res["time_kernel_s"],
     }
+    for key in ("deep_variant", "shards"):   # word-split provenance
+        if key in res:
+            result[key] = res[key]
     if nc:
         result["crashed"] = nc
     if res["valid?"]:
@@ -1630,7 +1634,8 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
                       max_open_bits=max_open_bits),
         backend=backend_name)
     if route.engine != "wgl_seg_regs":
-        if route.engine == "wgl_deep" and mesh is None:
+        if route.engine in ("wgl_deep", "wgl_deep_split") \
+                and mesh is None:
             r = _check_deep(
                 model, ops, fk, legal, next_state,
                 diag_w, const_w, const_t0, R=R, Sn=Sn, nc=nc,

@@ -690,7 +690,16 @@ def summarize(events: list[dict]) -> str:
                 pack = (f" [pack={pl['pack_backend']}"
                         + (f" x{pl['pack_threads']}"
                            if pl.get("pack_threads") else "") + "]")
-            lines.append(f"  {chain}: {why or '?'}{pack}")
+            deep = ""
+            if pl.get("deep_variant"):
+                # mask-plane provenance (ISSUE 10): which deep variant
+                # and over how many shards / exchanges per round
+                deep = (f" [{pl['deep_variant']}"
+                        + (f" x{pl['shards']}" if pl.get("shards")
+                           else "")
+                        + (f" ex{pl['exchange_rounds']}"
+                           if pl.get("exchange_rounds") else "") + "]")
+            lines.append(f"  {chain}: {why or '?'}{pack}{deep}")
             if pl.get("pruned"):
                 lines.append("    pruned by env: " + ", ".join(
                     f"{knob} -{e2}" for knob, e2 in pl["pruned"]))

@@ -448,6 +448,12 @@ def _dispatch_plans_html(events) -> str:
         pb = rec.get("pack_backend") or pl.get("pack_backend")
         pt = rec.get("pack_threads", pl.get("pack_threads"))
         pack = f"{pb} ×{pt}" if pb and pt else (pb or "")
+        # deep mask-plane provenance (ISSUE 10): record-level fields
+        # are what actually ran (e.g. a forced hypercube), the plan's
+        # are the route
+        dv = rec.get("deep_variant") or pl.get("deep_variant")
+        dsh = rec.get("shards", pl.get("shards"))
+        deep = f"{dv} ×{dsh}" if dv and dsh else (dv or "")
         rows.append(
             "<tr>"
             f"<td>{html.escape(str(eng))}</td>"
@@ -455,11 +461,13 @@ def _dispatch_plans_html(events) -> str:
             f"<td>{html.escape(' → '.join(fb))}</td>"
             f"<td>{html.escape(str(pl.get('bucket') or ''))}</td>"
             f"<td>{html.escape(pack)}</td>"
+            f"<td>{html.escape(deep)}</td>"
             f"<td>{pruned}</td>"
             f"<td>{info['verdicts']}</td></tr>")
     return ("<h2>Dispatch plans</h2>"
             "<table><tr><th>Engine</th><th>Why</th>"
             "<th>Fallback chain</th><th>Bucket</th><th>Pack</th>"
+            "<th>Deep shard</th>"
             "<th>Pruned by env</th><th>Verdicts</th></tr>"
             + "".join(rows) + "</table>")
 

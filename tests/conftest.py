@@ -55,6 +55,23 @@ def _mesh_device_count():
         return None
 
 
+def _deep_r_max():
+    """The deep-overlap envelope this suite ran against (ISSUE 10):
+    backend/mesh-aware — the word-split single-device boundary and the
+    hypercube boundary over the forced-host mesh actually in effect.
+    Recorded so an env/planner change that silently shrinks the
+    envelope (collapsing the R=15..17 coverage back to the serial
+    chain) diffs across PRs instead of hiding in a green suite."""
+    try:
+        import jax as _jax
+
+        from jepsen_tpu.ops import planner
+        return {"device": planner.deep_r_max(None, 1),
+                "mesh": planner.deep_r_max(None, len(_jax.devices()))}
+    except Exception:       # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _plan_cache_stats():
     """Compiled-plan cache hit/miss counters from the one engine
     planner (ISSUE 8) — recorded per tier-1 run so a cache regression
@@ -98,6 +115,7 @@ def pytest_sessionfinish(session, exitstatus):
             "tests": len(per_test),
             "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
             "mesh_devices": _mesh_device_count(),
+            "deep_r_max": _deep_r_max(),
             "plan_cache": _plan_cache_stats(),
             "pack_backend": _pack_backend(),
             "slowest": [{"test": n, "s": round(s, 3)}

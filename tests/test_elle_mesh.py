@@ -384,12 +384,18 @@ class TestSurfaces:
 
     def test_shard_map_compat_shim(self):
         """The shared kwarg-drift shim (also wgl_deep.check_mesh's)
-        runs a collective body on the virtual mesh."""
+        runs a collective body on the virtual mesh.  The shim moved
+        into its own module alongside the frontier helpers (ISSUE 10
+        satellite); the long-standing `ops.shard_map_compat` import
+        stays identity-pinned to the module's function."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
         from jepsen_tpu.ops import shard_map_compat
+        from jepsen_tpu.ops.shard_map_compat import (
+            shard_map_compat as shim_fn)
+        assert shard_map_compat is shim_fn      # re-export identity
         mesh = Mesh(np.array(jax.devices()), ("rows",))
 
         def body(x):
@@ -404,3 +410,33 @@ class TestSurfaces:
             NamedSharding(mesh, PartitionSpec("rows")))
         out = np.asarray(fn(x))
         assert out.shape == (8, 1) and (out == 120.0).all()
+
+    def test_mesh_collective_helpers(self):
+        """The extracted frontier helpers (ISSUE 10 satellite): the
+        monotone early-exit psum and the pairwise hypercube exchange
+        behave as specified on the virtual mesh."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec
+
+        from jepsen_tpu.ops.shard_map_compat import (
+            frontier_settled, hypercube_exchange, shard_map_compat)
+        mesh = Mesh(np.array(jax.devices()), ("rows",))
+
+        def body(x):
+            d = jax.lax.axis_index("rows")
+            # settled iff NO device changed; device 3 claims a change
+            settled = frontier_settled(d == 3, "rows")
+            quiet = frontier_settled(jnp.bool_(False), "rows")
+            # bit-1 exchange pairs d <-> d^2
+            partner = hypercube_exchange(d, "rows", 1, 8)
+            return jnp.stack([settled.astype(jnp.int32)[None],
+                              quiet.astype(jnp.int32)[None],
+                              partner.astype(jnp.int32)[None]], 1)
+
+        fn = shard_map_compat(body, mesh=mesh, in_specs=(
+            PartitionSpec("rows"),), out_specs=PartitionSpec("rows"))
+        out = np.asarray(fn(jnp.zeros((8, 1), np.int32)))
+        assert (out[:, 0] == 0).all()          # a change anywhere -> go on
+        assert (out[:, 1] == 1).all()          # nothing changed -> settled
+        assert out[:, 2].tolist() == [d ^ 2 for d in range(8)]

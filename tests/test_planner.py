@@ -36,7 +36,8 @@ def rand_shape(rng) -> planner.Shape:
 def rand_env(rng) -> dict:
     env = {}
     for knob in ("JEPSEN_TPU_NO_REGS", "JEPSEN_TPU_DYN_ROUNDS",
-                 "JEPSEN_TPU_NO_DEEP", "JEPSEN_TPU_SEGMENT"):
+                 "JEPSEN_TPU_NO_DEEP", "JEPSEN_TPU_SEGMENT",
+                 "JEPSEN_TPU_NO_DEEP_SHARD"):
         if rng.random() < 0.3:
             env[knob] = "1"
     return env
@@ -180,11 +181,57 @@ class TestPlanProperties:
         # wgl_deep.supported delegates — the gates cannot drift
         assert wgl_seg._regs_eligible is planner._regs_eligible
         for args in ((9, 4, 6, True), (3, 33, 6, True),
-                     (14, 32, 100, True), (15, 4, 6, True)):
+                     (14, 32, 100, True), (15, 4, 6, True),
+                     (17, 4, 6, True)):
             for backend in ("cpu", "tpu"):
-                assert wgl_deep.supported(*args, backend) == \
-                    planner.deep_supported(*args, backend)
-        assert wgl_deep.R_MAX == planner.DEEP_R_MAX
+                for nd in (None, 2, 8):
+                    assert wgl_deep.supported(
+                        *args, backend, n_devices=nd) == \
+                        planner.deep_supported(*args, backend,
+                                               n_devices=nd)
+        assert wgl_deep.R_BASE == planner.DEEP_R_BASE
+
+    def test_deep_r_max_envelope(self):
+        # ISSUE 10: the hard DEEP_R_MAX constant is gone; the boundary
+        # is backend/mesh-aware and the shard knob only shrinks it
+        assert not hasattr(planner, "DEEP_R_MAX")
+        assert planner.deep_r_max("tpu", 1) == 16       # word-split
+        assert planner.deep_r_max("tpu", 2) == 16
+        assert planner.deep_r_max("tpu", 8) == 17       # hypercube
+        assert planner.deep_r_max(
+            "tpu", 8, env={"JEPSEN_TPU_NO_DEEP_SHARD": "1"}) == 14
+        assert planner.deep_split_planes(14) == 1
+        assert planner.deep_split_planes(15) == 2
+        assert planner.deep_split_planes(16) == 4
+
+    def test_deep_variant_routes_and_shard_knob(self):
+        S = planner.Shape
+        # R=15 single device: word-split head, plan carries provenance
+        pl = planner.plan_engines(
+            S(kind="linear", R=15, Sn=4, U=6, decomposed=True),
+            env={}, backend="tpu")
+        assert pl.engine == "wgl_deep_split"
+        assert pl.deep_variant == "word-split" and pl.shards == 2
+        # R=17 with an 8-device mesh: the hypercube tier is in chain
+        pl = planner.plan_engines(
+            S(kind="linear", R=17, Sn=4, U=6, decomposed=True, mesh=8),
+            env={}, backend="tpu")
+        assert "wgl_deep_hc" in pl.chain
+        # deep-mesh batches beyond one device's stack route hypercube
+        pl = planner.plan_engines(
+            S(kind="deep-mesh", R=17, Sn=4, U=6, decomposed=True,
+              mesh=8), env={}, backend="tpu")
+        assert pl.engine == "wgl_deep_hc"
+        assert pl.deep_variant == "hypercube"
+        assert pl.shards == 8 and pl.exchange_rounds == 3
+        # the new knob PRUNES the sharded variants (attributed), never
+        # invents — the chain falls back to the serial engines
+        pl = planner.plan_engines(
+            S(kind="linear", R=15, Sn=4, U=6, decomposed=True),
+            env={"JEPSEN_TPU_NO_DEEP_SHARD": "1"}, backend="tpu")
+        assert pl.engine == "wgl"
+        assert ("JEPSEN_TPU_NO_DEEP_SHARD", "wgl_deep_split") \
+            in pl.pruned
 
 
 # ---------------------------------------------------------------------------
