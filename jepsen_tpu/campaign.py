@@ -1,0 +1,928 @@
+"""Coverage-guided nemesis campaigns — search the fault space, don't
+sample it (ROADMAP #4; ISSUE 13).
+
+Jepsen's nemeses were always hand-scripted schedules (the nemesis is
+just another process drawing from a generator, PAPER.md); Kingsbury &
+Alvaro leave open how to *explore* the space of fault schedules rather
+than sample it.  A device-speed checker makes verdicts nearly free, so
+a thousands-of-scenarios search loop is affordable — this module is
+that loop: a fuzzer whose fitness function is the checker.
+
+    schedule --run--> outcome --reduce--> coverage signature
+        ^                                       |
+        '------- mutate the novel ones <--------'
+
+**Schedule grammar** (JSON-able, fully determined by the campaign
+seed):
+
+    {"id": "s0007", "gen": 1, "parent": "s0002",
+     "workload": "register", "time_limit": 1.2,
+     "windows": [{"name": "partition", "at": 0.3, "dur": 0.5},
+                 {"name": "disk-eio",  "at": 0.6, "dur": 0.4}]}
+
+Windows name entries in the target's named-nemesis registry
+(nemesis.named_nemesis maps — the currency every suite's --nemesis
+flag deals in); `schedule_nemesis_map` compiles them into ONE named
+map whose `during` generator is the exact timed start/stop sequence
+(tagged fs routed through nemesis.compose, like compose_named).
+
+**Coverage signature** — the checker-as-fitness-function reduction,
+assembled from the run's results tree plus the PR 4 telemetry
+EventLog and dispatch records:
+
+    verdict x anomaly classes x engine path x detection-lag bucket
+            x fault-window/op overlap
+
+Two runs with the same signature taught us nothing new; dedupe them.
+A novel signature spawns `mutants_per_novel` mutated children
+(jitter/add/drop/swap a window, flip the workload) onto a BOUNDED
+frontier (deque maxlen: the search degrades gracefully instead of
+exploding), and `k_dry` consecutive non-novel schedules stop the
+campaign (the K-dry-rounds stop).
+
+**Robustness is the headline contract**:
+
+  * the campaign ledger (store/campaigns/<name>/ledger.jsonl) uses
+    the HistoryWAL/EventLog crc+seq framing (history.follow_frames)
+    with NO wall-clock in the frame, so same seed + deterministic
+    target => byte-identical ledgers — including across a SIGKILL
+    mid-run + `campaign --resume` (tests/test_campaign.py pins this);
+  * every `scheduled` record is fsynced BEFORE its run starts; resume
+    replays the intact prefix (truncating at worst one torn tail),
+    re-runs the one schedule that has no result, and does NOT
+    re-journal it — the resumed ledger converges to the
+    uninterrupted one;
+  * each schedule runs under a deadline in an abandonable worker
+    thread (ResilientRunner discipline applied to whole runs): a
+    wedged SUT gets its run drained (the pre-seeded drain/abort
+    events core.run honors), is journaled `quarantined`, reaped
+    (target-specific cleanup), and the loop continues;
+  * between every pair of schedules the FaultLedger heal-backstop is
+    asserted empty (nemesis.FaultLedger.assert_empty): a leaked fault
+    is journaled as a durable `campaign-leak` event and healed — never
+    silently.
+
+Surfaces: `cli campaign` / `cli campaign status`, the `/campaign`
+coverage-matrix pages in web.py (nemesis x workload x anomaly class,
+gaps visible), and `jepsen_campaign_*` registry counters (recorded
+into store/ci/last-tier1.json by conftest).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+from pathlib import Path
+from typing import Callable, Optional
+
+from jepsen_tpu import store, telemetry
+from jepsen_tpu.history import _wal_payload, follow_frames
+
+log = logging.getLogger("jepsen.campaign")
+
+# detection-lag bucket edges (seconds): coarse on purpose — the bucket
+# is a signature component, and a signature must not split on wall
+# noise (a cold compile lands one bucket up; identical warm runs land
+# together)
+LAG_BUCKETS_S = (2.0, 8.0, 30.0)
+
+
+def lag_bucket(lag_s) -> str:
+    if lag_s is None:
+        return "na"
+    for edge in LAG_BUCKETS_S:
+        if lag_s < edge:
+            return f"lt{edge:g}s"
+    return f"ge{LAG_BUCKETS_S[-1]:g}s"
+
+
+# ---------------------------------------------------------------------------
+# Paths
+# ---------------------------------------------------------------------------
+
+def campaigns_root() -> Path:
+    return store.campaigns_root()
+
+
+def campaign_dir(name: str) -> Path:
+    return store.campaign_dir(name)
+
+
+# ---------------------------------------------------------------------------
+# Schedule generation + mutation (pure, seed-determined)
+# ---------------------------------------------------------------------------
+
+def _rng(*parts) -> random.Random:
+    """A deterministic RNG keyed by string parts — stable across
+    processes (random.Random(str) hashes the string arithmetically,
+    not via PYTHONHASHSEED)."""
+    return random.Random("|".join(str(p) for p in parts))
+
+
+def generate_schedule(seed, index: int, names: list, workloads: list,
+                      base_time_limit: float) -> dict:
+    """A fresh (generation-0) schedule, fully determined by
+    (seed, index): 1-3 fault windows with composition and timing drawn
+    from the derived RNG, inside a jittered time limit."""
+    rng = _rng(seed, "fresh", index)
+    tl = round(base_time_limit * rng.choice((0.75, 1.0, 1.25)), 3)
+    windows = []
+    for _ in range(rng.randint(1, 3)):
+        at = round(rng.uniform(0.05, 0.6) * tl, 3)
+        dur = round(rng.uniform(0.15, 0.5) * tl, 3)
+        windows.append({"name": rng.choice(sorted(names)),
+                        "at": at, "dur": min(dur, round(tl - at, 3))})
+    windows.sort(key=lambda w: (w["at"], w["name"]))
+    return {"id": f"s{index:04d}", "gen": 0, "parent": None,
+            "workload": rng.choice(sorted(workloads)),
+            "time_limit": tl, "windows": windows}
+
+
+def mutate_schedule(parent: dict, seed, child: int, index: int,
+                    names: list, workloads: list) -> dict:
+    """One mutated child, fully determined by
+    (seed, parent id, child ordinal): jitter a window's timing, add or
+    drop a window, swap a window's nemesis, or flip the workload."""
+    rng = _rng(seed, "mut", parent["id"], child)
+    s = {"id": f"s{index:04d}", "gen": parent["gen"] + 1,
+         "parent": parent["id"], "workload": parent["workload"],
+         "time_limit": parent["time_limit"],
+         "windows": [dict(w) for w in parent["windows"]]}
+    tl = s["time_limit"]
+    ops = ["jitter", "add", "swap", "workload"]
+    if len(s["windows"]) > 1:
+        ops.append("drop")
+    op = rng.choice(ops)
+    if op == "jitter":
+        w = rng.choice(s["windows"])
+        w["at"] = round(min(max(
+            w["at"] * rng.uniform(0.6, 1.4), 0.05), tl * 0.8), 3)
+        w["dur"] = round(min(max(
+            w["dur"] * rng.uniform(0.6, 1.4), 0.05), tl - w["at"]), 3)
+    elif op == "add":
+        at = round(rng.uniform(0.05, 0.6) * tl, 3)
+        s["windows"].append({"name": rng.choice(sorted(names)),
+                             "at": at,
+                             "dur": round(min(rng.uniform(0.15, 0.5)
+                                              * tl, tl - at), 3)})
+    elif op == "drop":
+        s["windows"].remove(rng.choice(s["windows"]))
+    elif op == "swap":
+        rng.choice(s["windows"])["name"] = rng.choice(sorted(names))
+    else:                                           # workload flip
+        s["workload"] = rng.choice(sorted(workloads))
+    s["windows"].sort(key=lambda w: (w["at"], w["name"]))
+    return s
+
+
+def schedule_nemesis_map(schedule: dict, registry: dict) -> dict:
+    """Compile a schedule into ONE named nemesis map: the `during`
+    generator is the exact timed start/stop sequence over the named
+    windows (ops tagged (name, f) and routed back to their owning
+    clients, exactly compose_named's discipline), `final` stops every
+    name in reverse-start order."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import nemesis as nem
+    names: list = []
+    for w in schedule["windows"]:
+        if w["name"] not in names:
+            names.append(w["name"])
+    maps = {}
+    for n in names:
+        try:
+            maps[n] = registry[n]()
+        except KeyError:
+            raise ValueError(f"unknown nemesis {n!r}; "
+                             f"one of {sorted(registry)}")
+    routes = {}
+    for n, m in maps.items():
+        def route(f, _name=n):
+            if isinstance(f, tuple) and len(f) == 2 and f[0] == _name:
+                return f[1]
+            return None
+        routes[route] = m["client"]
+
+    def tagged(name, f):
+        return lambda t, p: {"type": "info", "f": (name, f)}
+
+    events = []
+    for w in schedule["windows"]:
+        events.append((w["at"], w["name"], "start"))
+        events.append((round(w["at"] + w["dur"], 3), w["name"], "stop"))
+    events.sort(key=lambda e: (e[0], e[1], e[2] != "stop"))
+    seq, t = [], 0.0
+    for at, name, f in events:
+        if at > t:
+            seq.append(gen.sleep(at - t))
+            t = at
+        seq.append(tagged(name, f))
+    return {"name": "+".join(names) if names else "blank",
+            "clocks": any(m.get("clocks") for m in maps.values()),
+            "client": nem.compose(routes) if routes else nem.Noop(),
+            "during": gen.gseq(seq),
+            "final": gen.gseq([tagged(n, "stop")
+                               for n in reversed(names)])}
+
+
+# ---------------------------------------------------------------------------
+# Outcome reduction: results tree + telemetry -> coverage signature
+# ---------------------------------------------------------------------------
+
+def anomaly_classes(results) -> list:
+    """The anomaly classes a results tree exhibits: every
+    `anomaly-types` entry anywhere (the elle checkers), one
+    `invalid:<checker>` per top-level checker subtree containing a
+    false verdict, and `unknown` for an indeterminate top level."""
+    out: set = set()
+
+    def collect_types(node):
+        if isinstance(node, dict):
+            for a in node.get("anomaly-types") or []:
+                out.add(str(a))
+            for v in node.values():
+                collect_types(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                collect_types(v)
+
+    def has_false(node):
+        if isinstance(node, dict):
+            if node.get("valid?") is False:
+                return True
+            return any(has_false(v) for v in node.values())
+        if isinstance(node, (list, tuple)):
+            return any(has_false(v) for v in node)
+        return False
+
+    if isinstance(results, dict):
+        collect_types(results)
+        for k, sub in results.items():
+            if isinstance(sub, dict) and has_false(sub):
+                out.add(f"invalid:{k}")
+        if results.get("valid?") == "unknown":
+            out.add("unknown")
+    return sorted(out)
+
+
+def windows_overlap(events: list) -> str:
+    """How the run's fault windows overlapped its op stream: 'all' /
+    'some' / 'none' of the paired windows contained at least one op,
+    'nowin' when no window ever opened.  Computed from the telemetry
+    fault-start/stop pairs and op events (PR 4)."""
+    op_ts = [e["t"] for e in events
+             if e.get("type") == "op" and e.get("t") is not None]
+    pairs = [(t0, t1) for _k, t0, t1
+             in telemetry.pair_fault_windows(events)
+             if t0 is not None]
+    if not pairs:
+        return "nowin"
+    hit = sum(1 for t0, t1 in pairs
+              if any(t0 <= t <= (t1 if t1 is not None else
+                                 float("inf")) for t in op_ts))
+    return "all" if hit == len(pairs) else ("some" if hit else "none")
+
+
+def outcome_from_telemetry(results, events: list) -> dict:
+    """Reduce one finished run to the outcome fields the signature is
+    built from.  Detection lag anchors at the LAST fault-stop (else
+    the last op) and ends at the first analysis dispatch — how long
+    after the faults were done the checker had looked."""
+    engines = sorted({(e.get("record") or {}).get("engine")
+                      for e in events if e.get("type") == "dispatch"
+                      and (e.get("record") or {}).get("engine")})
+    stops = [e["t"] for e in events if e.get("type") == "fault-stop"
+             and e.get("t") is not None]
+    ops = [e["t"] for e in events
+           if e.get("type") == "op" and e.get("t") is not None]
+    marks = [e["t"] for e in events
+             if e.get("type") in ("dispatch", "analyze")
+             and e.get("t") is not None]
+    lag_s = None
+    anchor = max(stops) if stops else (max(ops) if ops else None)
+    if anchor is not None and marks:
+        later = [m for m in marks if m >= anchor]
+        lag_s = max(0.0, (min(later) if later else max(marks))
+                    - anchor)
+    verdict = (results or {}).get("valid?")
+    if verdict not in (True, False):
+        verdict = "unknown"
+    return {"verdict": verdict,
+            "anomalies": anomaly_classes(results or {}),
+            "engines": engines,
+            "lag_bucket": lag_bucket(lag_s),
+            "overlap": windows_overlap(events)}
+
+
+def signature(outcome: dict) -> str:
+    """The canonical coverage-signature string (the dedupe key):
+    verdict x anomaly classes x engine path x detection-lag bucket x
+    fault-window overlap."""
+    return json.dumps([outcome.get("verdict"),
+                       sorted(outcome.get("anomalies") or []),
+                       sorted(outcome.get("engines") or []),
+                       outcome.get("lag_bucket"),
+                       outcome.get("overlap")],
+                      sort_keys=True, separators=(",", ":"))
+
+
+# ---------------------------------------------------------------------------
+# Campaign ledger: crc+seq frames, no wall-clock (byte-determinism)
+# ---------------------------------------------------------------------------
+
+class CampaignLedger:
+    """Append-only crc+seq-framed JSONL (the HistoryWAL/EventLog
+    framing via history.follow_frames, key='ev') with NO wall-clock in
+    the frame: a deterministic campaign writes byte-identical ledgers
+    for the same seed, and a kill+resume converges to the
+    uninterrupted file.  Every append is fsynced — a record IS the
+    crash-safety contract."""
+
+    def __init__(self, path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a")
+        self._n = 0
+
+    def append(self, ev: dict) -> None:
+        payload = _wal_payload(ev)
+        crc = zlib.crc32(payload.encode())
+        self._f.write(f'{{"i":{self._n},"crc":"{crc:08x}",'
+                      f'"ev":{payload}}}\n')
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._n += 1
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:
+            pass
+
+    @classmethod
+    def recover(cls, path) -> tuple:
+        """(records, ledger-open-for-append): validate the intact
+        prefix, truncate at worst one torn tail, refuse a corrupt
+        COMPLETE record (everything past it is unattributable), and
+        return the ledger positioned to continue the sequence."""
+        seg = follow_frames(path, key="ev")
+        if seg.corrupt:
+            raise ValueError(f"campaign ledger corrupt: "
+                             f"{seg.stop_reason}")
+        if seg.tail_bytes:
+            with open(path, "r+b") as f:
+                f.truncate(seg.offset)
+        led = cls(path)
+        led._n = seg.seq
+        return [r["ev"] for r in seg.records], led
+
+
+# ---------------------------------------------------------------------------
+# Targets: how a schedule becomes a run
+# ---------------------------------------------------------------------------
+#
+# A target is {"nemeses": registry-or-names, "workloads": [...],
+# "runner": fn(schedule, campaign) -> outcome dict, "reap": fn()}.
+# The runner owns deadline/quarantine handling support: it must return
+# an outcome even for a wedged run.  Outcome fields: verdict,
+# anomalies, engines, lag_bucket, overlap (signature inputs) plus
+# quarantined, leaked, error, run (store-relative run dir; kept OUT of
+# the canonical ledger record).
+
+
+def _run_bounded(fn: Callable, deadline_s: float,
+                 on_timeout: Optional[Callable] = None):
+    """Run fn() on an abandonable worker thread (ResilientRunner
+    discipline applied to a whole run): past the deadline, fire
+    on_timeout (drain/abort the run) and give it a short grace, then
+    abandon the thread.  Returns (value, error, finished)."""
+    box, err = [None], [None]
+    done = threading.Event()
+
+    def run():
+        try:
+            box[0] = fn()
+        except BaseException as e:      # noqa: BLE001 - reported
+            err[0] = e
+        finally:
+            done.set()
+
+    th = threading.Thread(target=run, daemon=True, name="campaign-run")
+    th.start()
+    if done.wait(deadline_s):
+        return box[0], err[0], True
+    if on_timeout is not None:
+        try:
+            on_timeout()
+        except Exception:               # noqa: BLE001
+            pass
+        if done.wait(5.0):
+            return box[0], err[0], True
+    return None, None, False
+
+
+class KvdTarget:
+    """The in-tree SUT: kvd over the local transport, with the full
+    partition/disk/kill-pause/clock nemesis menu (suites/kvd.py) and
+    two workloads — `register` (the standard independent-keys
+    register) and `register-racy` (--unsafe-cas: the deliberately
+    racy CAS whose nonlinearizable histories the search can hunt)."""
+
+    name = "kvd"
+    workloads = ("register", "register-racy")
+
+    def __init__(self):
+        from jepsen_tpu.suites import kvd
+        self.kvd = kvd
+
+    @property
+    def nemeses(self) -> dict:
+        return self.kvd.nemeses
+
+    def build(self, schedule: dict, campaign: "Campaign") -> dict:
+        from jepsen_tpu import nemesis as nem
+        names = [w["name"] for w in schedule["windows"]]
+        opts = {"time-limit": schedule["time_limit"],
+                "nodes": ["n1"], "concurrency": 2,
+                "threads-per-key": 2, "ops-per-key": 60,
+                "stagger": 0.01, "value-max": 4,
+                "invoke-timeout": 3,
+                "nemesis": names,
+                "nemesis-map": schedule_nemesis_map(schedule,
+                                                    self.nemeses)}
+        if schedule["workload"] == "register-racy":
+            opts.update({"unsafe-cas": True, "value-max": 1,
+                         "threads-per-key": 4, "stagger": 0.002})
+        test = self.kvd.kvd_test(opts)
+        test["name"] = f"campaign-{campaign.name}-{schedule['id']}"
+        test["fault_ledger"] = nem.FaultLedger()
+        test["stall_budget_s"] = max(5.0, schedule["time_limit"])
+        test["deadline_s"] = schedule["time_limit"] + 15
+        test["drain_event"] = threading.Event()
+        test["abort_event"] = threading.Event()
+        return test
+
+    def run(self, schedule: dict, campaign: "Campaign") -> dict:
+        from jepsen_tpu import core
+        test = self.build(schedule, campaign)
+        deadline = schedule["time_limit"] + campaign.run_grace_s
+
+        def drain_then_abort():
+            test["drain_event"].set()
+            time.sleep(2.0)
+            test["abort_event"].set()
+
+        completed, error, finished = _run_bounded(
+            lambda: core.run(test), deadline,
+            on_timeout=drain_then_abort)
+        leaked = test["fault_ledger"].assert_empty(
+            context=f"{campaign.name}/{schedule['id']}")
+        if not finished:
+            self.reap()
+            return {"verdict": "quarantined", "anomalies": [],
+                    "engines": [], "lag_bucket": "na",
+                    "overlap": "nowin", "quarantined": True,
+                    "leaked": leaked, "error": "deadline"}
+        run_dir = None
+        events: list = []
+        results = (completed or {}).get("results") if completed else None
+        try:
+            src = completed if completed else test
+            if src.get("name") and src.get("start-time"):
+                p = store.path(src, "telemetry.jsonl")
+                run_dir = str(store.test_dir(src))
+                if p.exists():
+                    events = telemetry.read_events(p)
+        except Exception:               # noqa: BLE001
+            pass
+        out = outcome_from_telemetry(results, events)
+        if error is not None:
+            out["verdict"] = "crashed"
+            out["error"] = type(error).__name__
+        out.update(quarantined=False, leaked=leaked, run=run_dir)
+        return out
+
+    def reap(self) -> None:
+        """Best-effort cleanup after a quarantined run: un-pause and
+        kill any surviving daemon, drop the faultfs mount — the next
+        schedule needs the port and the mountpoint back."""
+        import subprocess
+        subprocess.run(["pkill", "-CONT", "-f", "[k]vd.py"],
+                       capture_output=True)
+        subprocess.run(["pkill", "-9", "-f", "[k]vd.py"],
+                       capture_output=True)
+        try:
+            from jepsen_tpu import faultfs
+            faultfs.unmount(self.kvd.DATA_DIR)
+        except Exception:               # noqa: BLE001
+            pass
+
+
+class MockTarget:
+    """A deterministic simulated SUT: outcomes are a pure function of
+    the schedule, instant, with a planted 'bug region' (a kill window
+    opening in (0.4, 1.6) x dur > 0.6 on the racy workload flips the
+    verdict) so the search loop has something real to find.  This is
+    the self-test target behind the byte-identical-ledger and
+    kill+resume batteries — and a fast way to exercise the whole
+    orchestrator without a SUT."""
+
+    name = "mock"
+    workloads = ("register", "register-racy")
+    nemeses = {"partition": None, "disk-eio": None, "disk-torn": None,
+               "kill": None, "pause": None, "clock-skew": None}
+
+    def __init__(self, pace_s: float = 0.0):
+        self.pace_s = pace_s
+
+    def run(self, schedule: dict, campaign: "Campaign") -> dict:
+        if self.pace_s:
+            time.sleep(self.pace_s)
+        hit = any(w["name"] == "kill" and 0.4 < w["at"] < 1.6
+                  and w["dur"] > 0.6 for w in schedule["windows"])
+        racy = schedule["workload"] == "register-racy"
+        anomalies = []
+        verdict = True
+        if hit and racy:
+            verdict, anomalies = False, ["invalid:linear"]
+        elif any(w["name"] == "disk-torn" and w["dur"] > 1.0
+                 for w in schedule["windows"]):
+            verdict, anomalies = "unknown", ["unknown"]
+        engines = (["wgl-seg-compact"] if schedule["time_limit"] < 1.2
+                   else ["wgl-seg-compact", "wgl_cpu"])
+        overlap = ("all" if all(w["at"] < schedule["time_limit"] * 0.8
+                                for w in schedule["windows"])
+                   else "some")
+        return {"verdict": verdict, "anomalies": anomalies,
+                "engines": engines,
+                "lag_bucket": lag_bucket(0.1
+                                         * len(schedule["windows"])),
+                "overlap": overlap, "quarantined": False,
+                "leaked": [], "run": None}
+
+    def reap(self) -> None:
+        pass
+
+
+TARGETS = {"kvd": KvdTarget, "mock": MockTarget}
+
+
+def suite_target(name: str, test_fn: Callable, registry: dict,
+                 workloads=("default",)):
+    """A campaign target over any suite built on
+    _template.resolve_named_nemeses: the suite's test_fn receives the
+    compiled nemesis-map (+ the schedule's names/time-limit) through
+    its opts, exactly like --nemesis argv would."""
+
+    class _SuiteTarget(KvdTarget):          # reuse the run/quarantine
+        def __init__(self):                 # machinery, not the SUT
+            self.nemeses_ = registry
+
+        name_ = name
+
+        @property
+        def name(self):
+            return self.name_
+
+        @property
+        def nemeses(self):
+            return self.nemeses_
+
+        workloads_ = tuple(workloads)
+
+        @property
+        def workloads(self):
+            return self.workloads_
+
+        def build(self, schedule, campaign):
+            from jepsen_tpu import nemesis as nem
+            opts = {"time-limit": schedule["time_limit"],
+                    "nemesis": [w["name"]
+                                for w in schedule["windows"]],
+                    "nemesis-map": schedule_nemesis_map(
+                        schedule, self.nemeses_)}
+            if schedule["workload"] != "default":
+                opts["workload"] = schedule["workload"]
+            test = test_fn(opts)
+            test["name"] = (f"campaign-{campaign.name}-"
+                            f"{schedule['id']}")
+            test["fault_ledger"] = nem.FaultLedger()
+            test["stall_budget_s"] = max(5.0, schedule["time_limit"])
+            test["deadline_s"] = schedule["time_limit"] + 15
+            test["drain_event"] = threading.Event()
+            test["abort_event"] = threading.Event()
+            return test
+
+        def reap(self):
+            pass
+
+    return _SuiteTarget
+
+
+# ---------------------------------------------------------------------------
+# The campaign engine
+# ---------------------------------------------------------------------------
+
+def _count(outcome: str, n: int = 1) -> None:
+    telemetry.REGISTRY.counter("jepsen_campaign_schedules_total",
+                               outcome=outcome).inc(n)
+
+
+class Campaign:
+    """One coverage-guided search loop over a target's fault space.
+
+    The driver is a strictly sequential state machine so that RESUME
+    IS REPLAY: every state transition is either journaled in the
+    ledger (`scheduled`, `result`, `end`) or a deterministic function
+    of journaled records (mutant generation, frontier contents, the
+    dry counter) — `resume()` feeds the ledger back through the same
+    transitions and lands in exactly the state the killed process was
+    in."""
+
+    def __init__(self, name: str, target, seed=0, schedules: int = 20,
+                 k_dry: int = 8, frontier_max: int = 16,
+                 mutants_per_novel: int = 2,
+                 base_time_limit: float = 1.2,
+                 run_grace_s: float = 30.0, bootstrap: int = 0,
+                 runner: Optional[Callable] = None):
+        self.name = name
+        self.target = target
+        self.seed = seed
+        self.budget = int(schedules)
+        self.bootstrap = int(bootstrap)
+        self.k_dry = int(k_dry)
+        self.frontier_max = int(frontier_max)
+        self.mutants_per_novel = int(mutants_per_novel)
+        self.base_time_limit = float(base_time_limit)
+        self.run_grace_s = float(run_grace_s)
+        self.runner = runner            # injectable for tests
+        self.dir = campaign_dir(name)
+        self.names = sorted(target.nemeses)
+        self.workloads = sorted(target.workloads)
+        # --- search state (rebuilt identically by resume) ---
+        self.frontier: collections.deque = collections.deque(
+            maxlen=self.frontier_max)
+        self.seen: dict = {}            # signature -> first schedule id
+        self.matrix: dict = {}          # nemesis -> workload -> class -> n
+        self.counts = {"run": 0, "novel": 0, "deduped": 0,
+                       "quarantined": 0, "crashed": 0, "leaks": 0,
+                       "mutants": 0}
+        self.next_index = 0
+        self.fresh_drawn = 0
+        self.dry = 0
+        self.done = False
+        self.reason = None
+        self.pending: Optional[dict] = None   # scheduled, result not in
+        self.ledger: Optional[CampaignLedger] = None
+        self._t0 = time.monotonic()
+
+    # -- config record (record 0: resume MUST reuse it verbatim) -----------
+
+    def _config_ev(self) -> dict:
+        return {"type": "config", "name": self.name,
+                "sut": getattr(self.target, "name", "?"),
+                "seed": self.seed, "schedules": self.budget,
+                "bootstrap": self.bootstrap,
+                "k_dry": self.k_dry, "frontier_max": self.frontier_max,
+                "mutants_per_novel": self.mutants_per_novel,
+                "base_time_limit": self.base_time_limit,
+                "nemeses": self.names, "workloads": self.workloads}
+
+    def _apply_config(self, ev: dict) -> None:
+        mine = getattr(self.target, "name", "?")
+        if ev.get("sut") not in (None, mine):
+            raise ValueError(
+                f"campaign {self.name!r} was recorded against sut "
+                f"{ev.get('sut')!r}; resuming with {mine!r} would "
+                "diverge — pass the matching --sut")
+        self.seed = ev["seed"]
+        self.budget = int(ev["schedules"])
+        self.bootstrap = int(ev.get("bootstrap", 0))
+        self.k_dry = int(ev["k_dry"])
+        self.frontier_max = int(ev["frontier_max"])
+        self.mutants_per_novel = int(ev["mutants_per_novel"])
+        self.base_time_limit = float(ev["base_time_limit"])
+        self.names = list(ev["nemeses"])
+        self.workloads = list(ev["workloads"])
+        self.frontier = collections.deque(self.frontier,
+                                          maxlen=self.frontier_max)
+
+    # -- deterministic transitions ------------------------------------------
+
+    def _draw(self) -> dict:
+        # the bootstrap phase draws FRESH schedules regardless of the
+        # frontier, so the campaign's opening fault-class mix is a
+        # pure function of the seed (not of run outcomes) — a smoke
+        # campaign can then GUARANTEE it mixes partition/disk/kill/
+        # clock windows before the search starts steering
+        if self.frontier and self.fresh_drawn >= self.bootstrap:
+            return self.frontier.popleft()
+        s = generate_schedule(self.seed, self.next_index, self.names,
+                              self.workloads, self.base_time_limit)
+        self.next_index += 1
+        self.fresh_drawn += 1
+        return s
+
+    def _apply_result(self, schedule: dict, ev: dict) -> None:
+        """The one novelty/dedupe/mutation transition, shared verbatim
+        by the live loop and resume-replay."""
+        self.counts["run"] += 1
+        sig = ev["sig"]
+        if ev.get("quarantined"):
+            self.counts["quarantined"] += 1
+        if ev.get("verdict") == "crashed":
+            self.counts["crashed"] += 1
+        self.counts["leaks"] += len(ev.get("leaked") or [])
+        for w in schedule["windows"]:
+            cell = self.matrix.setdefault(w["name"], {}).setdefault(
+                schedule["workload"], {})
+            for cls in (ev.get("anomalies") or ["none"]):
+                cell[cls] = cell.get(cls, 0) + 1
+        if sig in self.seen:
+            self.counts["deduped"] += 1
+            self.dry += 1
+            return
+        self.seen[sig] = schedule["id"]
+        self.counts["novel"] += 1
+        self.dry = 0
+        if ev.get("quarantined"):
+            return                      # never breed from a wedge
+        for child in range(self.mutants_per_novel):
+            m = mutate_schedule(schedule, self.seed, child,
+                                self.next_index, self.names,
+                                self.workloads)
+            self.next_index += 1
+            self.counts["mutants"] += 1
+            self.frontier.append(m)     # deque maxlen: bounded
+
+    # -- ledger I/O ---------------------------------------------------------
+
+    def _result_ev(self, schedule: dict, outcome: dict) -> dict:
+        return {"type": "result", "id": schedule["id"],
+                "sig": signature(outcome),
+                "verdict": outcome.get("verdict"),
+                "anomalies": sorted(outcome.get("anomalies") or []),
+                "engines": sorted(outcome.get("engines") or []),
+                "lag_bucket": outcome.get("lag_bucket"),
+                "overlap": outcome.get("overlap"),
+                "quarantined": bool(outcome.get("quarantined")),
+                "leaked": list(outcome.get("leaked") or [])}
+
+    def _write_surfaces(self, final: bool = False) -> None:
+        """coverage.json is canonical (byte-determinism contract);
+        status.json is the operator sidecar (wall clock allowed)."""
+        cov = {"nemeses": self.names, "workloads": self.workloads,
+               "cells": {n: {w: dict(sorted(cls.items()))
+                             for w, cls in sorted(wl.items())}
+                         for n, wl in sorted(self.matrix.items())}}
+        with open(self.dir / "coverage.json", "w") as f:
+            json.dump(cov, f, indent=2, sort_keys=True)
+            f.write("\n")
+        status = {"name": self.name,
+                  "sut": getattr(self.target, "name", "?"),
+                  "seed": self.seed, "budget": self.budget,
+                  **self.counts, "frontier": len(self.frontier),
+                  "dry": self.dry, "k_dry": self.k_dry,
+                  "signatures": len(self.seen),
+                  "done": self.done, "reason": self.reason,
+                  "wall_s": round(time.monotonic() - self._t0, 3)}
+        with open(self.dir / "status.json", "w") as f:
+            json.dump(status, f, indent=2)
+            f.write("\n")
+
+    # -- the loop -----------------------------------------------------------
+
+    def _run_schedule(self, schedule: dict) -> dict:
+        runner = self.runner or self.target.run
+        try:
+            return runner(schedule, self)
+        except Exception as e:          # noqa: BLE001 - the loop survives
+            log.warning("campaign runner crashed on %s",
+                        schedule["id"], exc_info=True)
+            return {"verdict": "crashed", "anomalies": [],
+                    "engines": [], "lag_bucket": "na",
+                    "overlap": "nowin", "quarantined": False,
+                    "leaked": [], "error": type(e).__name__}
+
+    def run(self, resume: bool = False) -> dict:
+        """Drive the campaign to its stop condition (budget exhausted
+        or k_dry consecutive non-novel schedules).  With resume=True,
+        replay the ledger first and continue from the exact killed
+        state."""
+        path = self.dir / "ledger.jsonl"
+        if resume:
+            self._replay(path)
+        else:
+            if path.exists() and path.stat().st_size:
+                raise ValueError(
+                    f"campaign {self.name!r} already has a ledger; "
+                    "use --resume (or a new --name)")
+            self.ledger = CampaignLedger(path)
+            self.ledger.append(self._config_ev())
+        while not self.done:
+            if self.pending is not None:
+                schedule, journal = self.pending, False
+                self.pending = None
+            elif self.counts["run"] >= self.budget:
+                self._finish("budget")
+                break
+            elif self.dry >= self.k_dry:
+                self._finish("dry")
+                break
+            else:
+                schedule, journal = self._draw(), True
+            if journal:
+                # fsynced BEFORE the run: a SIGKILL mid-run leaves the
+                # schedule journaled, and resume re-runs it without
+                # re-journaling (ledger convergence)
+                self.ledger.append({"type": "scheduled",
+                                    "schedule": schedule})
+            outcome = self._run_schedule(schedule)
+            ev = self._result_ev(schedule, outcome)
+            self.ledger.append(ev)
+            _count("run")
+            pre = dict(self.counts)
+            self._apply_result(schedule, ev)
+            for k in ("novel", "deduped", "quarantined", "crashed"):
+                if self.counts[k] > pre[k]:
+                    _count(k, self.counts[k] - pre[k])
+            self._write_surfaces()
+            # stop-condition check happens at the top of the loop so
+            # resume sees identical ordering
+        self._write_surfaces(final=True)
+        if self.ledger is not None:
+            self.ledger.close()
+        return dict(self.counts, done=self.done, reason=self.reason,
+                    signatures=len(self.seen))
+
+    def _finish(self, reason: str) -> None:
+        self.done = True
+        self.reason = reason
+        self.ledger.append({"type": "end", "reason": reason,
+                            "counts": dict(sorted(
+                                self.counts.items()))})
+
+    def _replay(self, path) -> None:
+        """Resume = replay: feed the intact ledger prefix back through
+        the same transitions the live loop uses."""
+        if not Path(path).exists():
+            raise FileNotFoundError(
+                f"no campaign ledger to resume at {path}")
+        records, self.ledger = CampaignLedger.recover(path)
+        if not records or records[0].get("type") != "config":
+            raise ValueError("campaign ledger has no config record")
+        self._apply_config(records[0])
+        scheduled: dict = {}
+        for ev in records[1:]:
+            if ev["type"] == "scheduled":
+                sched = ev["schedule"]
+                drawn = self._draw()
+                if drawn != sched:
+                    # the ledger is the truth; a mismatch means the
+                    # config/seed changed underneath it
+                    raise ValueError(
+                        f"resume divergence at {sched.get('id')}: "
+                        "ledger schedule does not match the "
+                        "deterministic replay")
+                scheduled[sched["id"]] = sched
+                self.pending = sched
+            elif ev["type"] == "result":
+                sched = scheduled.get(ev["id"])
+                if sched is None:
+                    raise ValueError(f"result for unknown schedule "
+                                     f"{ev['id']!r}")
+                self._apply_result(sched, ev)
+                self.pending = None
+            elif ev["type"] == "end":
+                self.done = True
+                self.reason = ev.get("reason")
+        log.info("campaign %s resumed: %d run, %d novel, pending=%s",
+                 self.name, self.counts["run"], self.counts["novel"],
+                 self.pending["id"] if self.pending else None)
+
+
+def ci_summary() -> Optional[dict]:
+    """The campaign counters this process accumulated (conftest
+    records them into store/ci/last-tier1.json beside
+    plan_cache/deep_r_max); None when no campaign ran."""
+    try:
+        coll = telemetry.REGISTRY.collect()
+        kind, by_label = coll.get("jepsen_campaign_schedules_total",
+                                  (None, {}))
+        out = {}
+        for key, m in by_label.items():
+            out[dict(key).get("outcome", "?")] = int(m.value)
+        if not out:
+            return None
+        _k, leaks = coll.get("jepsen_campaign_leaks_total",
+                             (None, {}))
+        out["leaks"] = int(sum(m.value for m in leaks.values())) \
+            if leaks else 0
+        return out
+    except Exception:   # noqa: BLE001 - the artifact must never fail
+        return None

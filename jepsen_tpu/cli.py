@@ -311,6 +311,149 @@ def metrics_cmd_spec() -> dict:
                                 "windows)."}}
 
 
+def campaign_cmd(opts, test_fn: Optional[Callable] = None,
+                 registry: Optional[dict] = None) -> int:
+    """`campaign [run|status]`: the coverage-guided nemesis-campaign
+    orchestrator (ISSUE 13 / ROADMAP #4) — generate seeded fault
+    schedules from the named-nemesis registries, run each against the
+    SUT, dedupe outcomes by coverage signature, and mutate the novel
+    ones; `status` prints the ledger-backed counters and the coverage
+    matrix.  From a suite binary with a registry the campaign targets
+    THAT suite; standalone, --sut picks an in-tree target (kvd under
+    the local transport, or the deterministic mock)."""
+    from jepsen_tpu import campaign as campaign_mod
+    name = opts.name
+    if opts.action == "status":
+        d = store.campaigns_root()
+        if name != "default" or (d / name).is_dir():
+            if not (d / name).is_dir():
+                print(f"no campaign {name!r} under store/campaigns/",
+                      file=sys.stderr)
+                return 255
+            names = [name]
+        else:
+            names = sorted(p.name for p in d.iterdir()
+                           if p.is_dir()) if d.is_dir() else []
+        if not names:
+            print("no campaigns under store/campaigns/",
+                  file=sys.stderr)
+            return 255
+        for n in names:
+            sp = d / n / "status.json"
+            if not sp.exists():
+                print(f"{n}: (no status yet)")
+                continue
+            with open(sp) as f:
+                st = json.load(f)
+            print(f"{n}: sut={st.get('sut')} seed={st.get('seed')} "
+                  f"run={st.get('run')}/{st.get('budget')} "
+                  f"novel={st.get('novel')} "
+                  f"deduped={st.get('deduped')} "
+                  f"quarantined={st.get('quarantined')} "
+                  f"leaks={st.get('leaks')} "
+                  f"{'done (' + str(st.get('reason')) + ')' if st.get('done') else 'in progress'}")
+            cp = d / n / "coverage.json"
+            if cp.exists():
+                with open(cp) as f:
+                    cov = json.load(f)
+                for nem_name in cov.get("nemeses") or []:
+                    cells = (cov.get("cells") or {}).get(nem_name, {})
+                    row = ", ".join(
+                        f"{w}: " + "+".join(
+                            f"{c}({k})"
+                            for c, k in sorted(cls.items()))
+                        for w, cls in sorted(cells.items())) or "-"
+                    print(f"  {nem_name}: {row}")
+        return 0
+    if test_fn is not None and registry is not None:
+        if isinstance(registry, dict):
+            target = campaign_mod.suite_target(
+                "suite", test_fn, registry)()
+        else:
+            # a suite may hand over a ready campaign target factory
+            # (kvd: the full KvdTarget with workload variants + reap)
+            target = registry() if callable(registry) else registry
+    else:
+        try:
+            target = campaign_mod.TARGETS[opts.sut](
+                **({"pace_s": opts.pace} if opts.sut == "mock"
+                   and opts.pace else {}))
+        except KeyError:
+            print(f"unknown --sut {opts.sut!r}; one of "
+                  f"{sorted(campaign_mod.TARGETS)}", file=sys.stderr)
+            return 255
+    c = campaign_mod.Campaign(
+        name, target, seed=opts.seed, schedules=opts.schedules,
+        k_dry=opts.k_dry, frontier_max=opts.frontier_max,
+        mutants_per_novel=opts.mutants, bootstrap=opts.bootstrap,
+        base_time_limit=opts.time_limit)
+    try:
+        out = c.run(resume=opts.resume)
+    except (ValueError, FileNotFoundError) as e:
+        print(str(e), file=sys.stderr)
+        return 255
+    print(f"campaign {name}: {out['run']} schedule(s) run "
+          f"({out['reason']}), {out['novel']} novel / "
+          f"{out['deduped']} deduped / {out['quarantined']} "
+          f"quarantined, {out['signatures']} signature(s), "
+          f"{out['leaks']} fault leak(s)", file=sys.stderr)
+    return 0
+
+
+def campaign_cmd_spec(test_fn: Optional[Callable] = None,
+                      registry: Optional[dict] = None) -> dict:
+    def add_opts(parser):
+        parser.add_argument("action", nargs="?", default="run",
+                            choices=["run", "status"],
+                            help="run the search loop, or print the "
+                                 "ledger-backed status + coverage "
+                                 "matrix")
+        parser.add_argument("--name", default="default",
+                            help="campaign name (the ledger lives at "
+                                 "store/campaigns/<name>/)")
+        if test_fn is None or registry is None:
+            parser.add_argument("--sut", default="kvd",
+                                choices=["kvd", "mock"],
+                                help="in-tree target: kvd over the "
+                                     "local transport, or the "
+                                     "deterministic mock SUT")
+        parser.add_argument("--seed", type=int, default=0)
+        parser.add_argument("--schedules", type=int, default=20,
+                            metavar="N", help="schedule budget")
+        parser.add_argument("--k-dry", type=int, default=8,
+                            metavar="K",
+                            help="stop after K consecutive schedules "
+                                 "with no novel coverage")
+        parser.add_argument("--frontier-max", type=int, default=16,
+                            help="mutation frontier bound")
+        parser.add_argument("--mutants", type=int, default=2,
+                            help="mutated children per novel "
+                                 "signature")
+        parser.add_argument("--bootstrap", type=int, default=0,
+                            metavar="N",
+                            help="draw the first N schedules fresh "
+                                 "(seed-determined fault-class mix) "
+                                 "before the frontier steers")
+        parser.add_argument("--time-limit", type=float, default=1.2,
+                            metavar="SECONDS",
+                            help="base per-schedule run length "
+                                 "(schedules jitter around it)")
+        parser.add_argument("--pace", type=float, default=0.0,
+                            help="mock target: seconds per simulated "
+                                 "run (kill/resume testing)")
+        parser.add_argument("--resume", action="store_true",
+                            help="replay the ledger and continue a "
+                                 "killed campaign from its exact "
+                                 "state")
+
+    return {"campaign": {
+        "opts": add_opts,
+        "run": lambda opts: campaign_cmd(opts, test_fn, registry),
+        "help": "Coverage-guided nemesis campaign: search the fault "
+                "space with the checker as the fitness function "
+                "(crash-safe ledger, --resume after SIGKILL)."}}
+
+
 def serve_cmd_run(opts) -> int:
     from jepsen_tpu import web
     web.serve(host=opts.host, port=opts.port, block=True)
@@ -416,9 +559,13 @@ def serve_checker_cmd_spec() -> dict:
 
 
 def single_test_cmd(test_fn: Callable[[dict], dict],
-                    opt_fn: Optional[Callable] = None) -> dict:
+                    opt_fn: Optional[Callable] = None,
+                    nemesis_registry: Optional[dict] = None) -> dict:
     """The standard command map for a suite with one test constructor
-    (cli.clj:323-397): test / analyze share the test options."""
+    (cli.clj:323-397): test / analyze share the test options.  With a
+    `nemesis_registry` (the suite's named-nemesis map registry) the
+    binary also gains `campaign`, targeting THIS suite through its own
+    test constructor (campaign.suite_target)."""
 
     def add_opts(parser):
         test_opt_spec(parser)
@@ -457,6 +604,8 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
         **metrics_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),
+        **(campaign_cmd_spec(test_fn, nemesis_registry)
+           if nemesis_registry is not None else campaign_cmd_spec()),
     }
 
 
@@ -519,6 +668,7 @@ def standard_commands() -> dict:
         **metrics_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),
+        **campaign_cmd_spec(),
     }
 
 

@@ -597,7 +597,10 @@ def run_case(test) -> History:
         test["active_histories"].add((history, lock))
     watchdog = None
     if test.get("stall_budget_s") or test.get("deadline_s"):
-        test["drain_event"] = threading.Event()
+        # setdefault: an orchestrator driving many runs (campaign.py)
+        # may pre-seed the drain event so it can force a graceful
+        # drain from OUTSIDE after core.run copied the test map
+        test.setdefault("drain_event", threading.Event())
     try:
         nodes = test.get("nodes") or []
         n = test["concurrency"]
@@ -665,8 +668,17 @@ def analyze(test) -> dict:
     if test.get("name") and test.get("start-time"):
         from jepsen_tpu import store
         opts["checkpoint_dir"] = str(store.path(test, "checkpoints"))
+    t0 = time_mod.monotonic()
     test["results"] = checker_mod.check_safe(
         test["checker"], test, history, opts)
+    # one durable marker per analysis: wall seconds + validity, so a
+    # telemetry log alone anchors op-append -> verdict lag (the
+    # campaign orchestrator's detection-lag buckets read this)
+    from jepsen_tpu import telemetry as telemetry_mod
+    telemetry_mod.of(test).event(
+        "analyze", durable=True,
+        seconds=round(time_mod.monotonic() - t0, 6),
+        valid=(test["results"] or {}).get("valid?"))
     log.info("Analysis complete")
     if test.get("name"):
         from jepsen_tpu import store
@@ -696,7 +708,10 @@ def run(test: dict) -> dict:
     test["barrier"] = threading.Barrier(len(nodes)) if nodes else NO_BARRIER
     test["active_histories"] = set()
     test["active_histories_lock"] = threading.Lock()
-    test["abort_event"] = threading.Event()
+    # setdefault: a caller that keeps a handle on the event (the
+    # campaign orchestrator's per-schedule quarantine) can abort a
+    # wedged run from outside even though run() copied the test map
+    test.setdefault("abort_event", threading.Event())
     from jepsen_tpu import nemesis as nemesis_mod
     test.setdefault("fault_ledger", nemesis_mod.FaultLedger())
     test["threads"] = gen.sort_processes(

@@ -93,6 +93,44 @@ class FaultLedger:
             self._window("stop", key, healed=True)
         return results
 
+    def assert_empty(self, context=None) -> list:
+        """Inter-schedule backstop for loops that run many cases
+        against one process (campaign.py): the ledger MUST be empty
+        between schedules — run_case's teardown heal already reversed
+        everything on every exit path, so anything still outstanding
+        here means a prior schedule's faults survived into the gap.
+
+        Never silent: leaked faults are journaled as a durable
+        `campaign-leak` telemetry event (through self.telemetry when
+        wired, else the active run's log), counted in
+        `jepsen_campaign_leaks_total`, logged, and THEN healed.
+        Returns the leaked keys' descriptions (empty when clean)."""
+        out = self.outstanding()
+        if not out:
+            return []
+        keys = [repr(k) for k, _ in out]
+        import logging
+        logging.getLogger("jepsen").error(
+            "campaign-leak: %d fault(s) survived a schedule (%s)%s",
+            len(keys), keys, f" [{context}]" if context else "")
+        try:
+            from jepsen_tpu import telemetry as telemetry_mod
+            telemetry_mod.REGISTRY.counter(
+                "jepsen_campaign_leaks_total").inc(len(keys))
+            ev = {"keys": keys}
+            if context is not None:
+                ev["context"] = str(context)
+            t = self.telemetry if (self.telemetry is not None
+                                   and self.telemetry.enabled) else None
+            if t is not None:
+                t.event("campaign-leak", durable=True, **ev)
+            else:
+                telemetry_mod.emit("campaign-leak", durable=True, **ev)
+        except Exception:   # noqa: BLE001 - telemetry never fails a run
+            pass
+        self.heal_all()
+        return keys
+
 
 def ledger(test) -> FaultLedger:
     """The test's fault ledger (created by core.run; tests driving

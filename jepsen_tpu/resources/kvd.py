@@ -14,6 +14,22 @@ Line protocol (one request per line, one reply line):
     CAS k old new    -> OK | FAIL | NIL | ERR disk <errno>
 Every mutation is logged to the --log file (the harness downloads it).
 
+Fault control verbs (the campaign nemeses' in-SUT fault surface —
+REAL faults at the daemon's own network/clock layer, injectable on a
+shared host where iptables or `date -s` would be destructive):
+    PART 1|0         -> OK      partition: while set, every data
+                                request is HELD (no reply) until the
+                                partition heals or the client hangs
+                                up — clients see exactly what a
+                                dropped link looks like; healing
+                                releases held requests (late
+                                delivery), like a real network
+    SKEW ms          -> OK      clock skew: the daemon's wall clock
+                                (its only use of time: mutation-log
+                                timestamps) runs offset by ms
+Control verbs are processed BEFORE the partition hold, so the nemesis
+can always heal what it broke.
+
 With --data-dir the daemon is DURABLE: every mutation is appended to
 <data-dir>/kvd.data with write+fsync BEFORE it is applied in memory,
 and the file is replayed at startup.  That data dir is the surface the
@@ -35,6 +51,8 @@ class Store:
         self.kv = {}
         self.lock = threading.Lock()
         self.unsafe_cas = unsafe_cas
+        self.partitioned = False     # PART: hold data requests
+        self.skew_ms = 0.0           # SKEW: logical wall-clock offset
         self.log = open(log_path, "a", buffering=1)
         self.data_path = None
         self.data = None
@@ -75,7 +93,10 @@ class Store:
             raise
 
     def logline(self, msg):
-        self.log.write("%.6f %s\n" % (time.time(), msg))
+        # the daemon's ONLY clock use — SKEW shifts it, so a clock
+        # nemesis has a real, observable (and harmless) effect
+        self.log.write("%.6f %s\n" % (time.time() + self.skew_ms / 1e3,
+                                      msg))
 
 
 class Handler(socketserver.StreamRequestHandler):
@@ -86,6 +107,26 @@ class Handler(socketserver.StreamRequestHandler):
             if not parts:
                 continue
             cmd, args = parts[0].upper(), parts[1:]
+            # control verbs first: the nemesis must be able to heal a
+            # partition even while data requests are being held
+            if cmd == "PART" and len(args) == 1:
+                store.partitioned = args[0] not in ("0", "off")
+                store.logline(f"PART {int(store.partitioned)}")
+                self.wfile.write(b"OK\n")
+                continue
+            if cmd == "SKEW" and len(args) == 1:
+                try:
+                    store.skew_ms = float(args[0])
+                    out = "OK"
+                except ValueError:
+                    out = "ERR"
+                self.wfile.write((out + "\n").encode())
+                continue
+            # partition hold: no reply until healed or the client
+            # hangs up — a healed partition delivers late, like a
+            # real network (the client may have abandoned by then)
+            while store.partitioned:
+                time.sleep(0.02)
             if cmd == "GET" and len(args) == 1:
                 v = store.kv.get(args[0])
                 out = "NIL" if v is None else f"VAL {v}"

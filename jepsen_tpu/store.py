@@ -171,7 +171,8 @@ def tests(name: Optional[str] = None) -> dict:
         return out
     names = [name] if name else [p.name for p in BASE.iterdir()
                                  if p.is_dir() and p.name not in
-                                 ("latest", "current")]
+                                 ("latest", "current", "campaigns",
+                                  "ci", "plan-cache")]
     for n in names:
         d = BASE / _sanitize(n)
         if not d.is_dir():
@@ -248,6 +249,26 @@ def wal_path(test) -> Path:
     store/<name>/<ts>/history.wal — one definition shared by the run
     loop, `history.recover`, and the CLI `recover` subcommand."""
     return path(test, "history.wal")
+
+
+# ---------------------------------------------------------------------------
+# Campaign ledgers (campaign.py)
+# ---------------------------------------------------------------------------
+#
+# Layout: store/campaigns/<name>/{ledger.jsonl, coverage.json,
+# status.json} — the crash-safe search-loop ledger (crc+seq frames,
+# resumable), the canonical coverage matrix, and the operator status
+# sidecar.  One definition shared by campaign.py, web.py's /campaign
+# pages, and the CLI `campaign status` subcommand.
+
+def campaigns_root() -> Path:
+    return BASE / "campaigns"
+
+
+def campaign_dir(name: str) -> Path:
+    d = campaigns_root() / _sanitize(name)
+    d.mkdir(parents=True, exist_ok=True)
+    return d
 
 
 def append_checkpoint(path, record: dict) -> None:

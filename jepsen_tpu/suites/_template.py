@@ -119,18 +119,27 @@ class QueueClient(client_mod.Client):
 
 
 def resolve_named_nemeses(registry: dict, opts: dict,
-                          default: Optional[list] = None
-                          ) -> Optional[dict]:
+                          default: Optional[list] = None,
+                          recadence: bool = True) -> Optional[dict]:
     """--nemesis names -> ONE named nemesis map ({name client during
     final clocks}), composed via nem.compose_named when several names
     are given.  Names come from opts["nemesis"], the CLI's argv-options
     submap, or `default`; None when none of those yield names (the
-    suite's own default nemesis applies).  Every registry entry is a
-    single-gen map, so each is re-cadenced to --nemesis-interval before
-    composition (after composition the fs carry routing tags and the
-    cadence is baked in)."""
+    suite's own default nemesis applies).  With `recadence` (the small
+    suites: every registry entry is a standard single-gen map) each is
+    re-cadenced to --nemesis-interval before composition; suites whose
+    registries carry bespoke generators (cockroach's double-gen and
+    strobe ladders) pass recadence=False to keep them.
+
+    An explicit opts["nemesis-map"] (a fully-built named map — e.g. a
+    campaign schedule's timed window sequence, campaign.py) wins over
+    names and is returned verbatim, so every suite on this resolver is
+    uniformly campaign-targetable."""
     opts = dict(opts or {})
     av = opts.get("argv-options") or {}
+    nm = opts.get("nemesis-map") or av.get("nemesis-map")
+    if nm is not None:
+        return nm
     names = opts.get("nemesis") or av.get("nemesis") or default
     if not names:
         return None
@@ -139,9 +148,10 @@ def resolve_named_nemeses(registry: dict, opts: dict,
     except KeyError as e:
         raise ValueError(
             f"unknown nemesis {e.args[0]!r}; one of {sorted(registry)}")
-    interval = opts.get("nemesis-interval", 5)
-    for m in maps:
-        m["during"] = gen.start_stop(interval, interval)
+    if recadence:
+        interval = opts.get("nemesis-interval", 5)
+        for m in maps:
+            m["during"] = gen.start_stop(interval, interval)
     return maps[0] if len(maps) == 1 else nem.compose_named(maps)
 
 
@@ -233,10 +243,14 @@ def queue_test(name: str, db, client: client_mod.Client,
     return test
 
 
-def simple_main(test_fn: Callable, opt_fn: Optional[Callable] = None):
-    """Build the standard -main for a small suite."""
+def simple_main(test_fn: Callable, opt_fn: Optional[Callable] = None,
+                nemesis_registry: Optional[dict] = None):
+    """Build the standard -main for a small suite.  A
+    `nemesis_registry` adds the `campaign` subcommand targeting this
+    suite (cli.single_test_cmd)."""
     def main(argv=None):
-        cli.run(cli.single_test_cmd(test_fn, opt_fn), argv)
+        cli.run(cli.single_test_cmd(test_fn, opt_fn,
+                                    nemesis_registry), argv)
     return main
 
 

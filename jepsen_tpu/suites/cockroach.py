@@ -594,6 +594,16 @@ def split() -> dict:
                 client=SplitNemesis(), clocks=False)
 
 
+def _disk_recipes() -> dict:
+    """The universal disk-fault recipes (PR 3 moved them out of this
+    suite into faultfs/nemesis.py and the --nemesis plumbing never
+    came back): re-published here so `--nemesis disk-eio` and the
+    campaign orchestrator can target cockroach's data dir through the
+    same registry currency as every other fault."""
+    from jepsen_tpu import faultfs
+    return dict(faultfs.nemeses)
+
+
 nemeses = {
     "none": none,
     "parts": parts,
@@ -609,6 +619,8 @@ nemeses = {
     "start-stop-2": lambda: startstop(2),
     "start-kill": lambda: startkill(1),
     "start-kill-2": lambda: startkill(2),
+    **{name: (lambda name=name: _disk_recipes()[name]())
+       for name in ("disk-eio", "disk-slow", "disk-torn")},
 }
 
 
@@ -1098,11 +1110,23 @@ def _rounded_concurrency(opts, tpk: int) -> int:
 
 
 def _nemesis_for(opts) -> dict:
-    chosen = [nemeses[nm]() for nm in (opts.get("nemesis") or ["none"])]
-    extra = [nemeses[nm]() for nm in (opts.get("nemesis2") or [])]
-    if len(chosen) + len(extra) > 1:
-        return compose_named(chosen + extra)
-    return (chosen + extra)[0]
+    """--nemesis/--nemesis2 names -> ONE named map, resolved through
+    the shared registry resolver (_template.resolve_named_nemeses,
+    recadence=False: this registry carries bespoke cadences — the
+    double-gen ladder, strobe's sleepless loop — that must not be
+    flattened to start/stop intervals).  An explicit
+    opts["nemesis-map"] (a campaign schedule's compiled window
+    sequence) wins, which is what makes cockroach
+    campaign-targetable."""
+    # late import: _template imports _rounded_concurrency from here
+    from jepsen_tpu.suites._template import resolve_named_nemeses
+    names = list(opts.get("nemesis") or []) \
+        + list(opts.get("nemesis2") or [])
+    nm = resolve_named_nemeses(
+        nemeses, dict(opts, nemesis=names or ["none"]),
+        recadence=False)
+    assert nm is not None
+    return nm
 
 
 def bank_test(opts) -> dict:
@@ -1292,23 +1316,22 @@ def test_for(opts) -> dict:
 
 def _opt_fn(parser):
     """runner.clj opt-spec: workload + repeatable nemesis registries
-    (runner.clj:42-76)."""
+    (runner.clj:42-76) — the --nemesis flag through the shared
+    cli.nemesis_opt_spec, like every registry-carrying suite."""
     parser.add_argument("--workload", default="register",
                         choices=sorted(tests),
                         help="which workload to run")
-    parser.add_argument("--nemesis", action="append", dest="nemesis",
-                        choices=sorted(nemeses), metavar="NAME",
-                        help="nemesis to use (repeat to mix): "
-                        + ", ".join(sorted(nemeses)))
+    cli.nemesis_opt_spec(parser, nemeses, default="none")
     parser.add_argument("--nemesis2", action="append", dest="nemesis2",
                         choices=sorted(nemeses), metavar="NAME",
                         help="an additional nemesis to mix in")
 
 
 def main(argv=None):
-    """runner.clj -main: test / analyze / serve with workload +
-    nemesis registries."""
-    cli.run(cli.single_test_cmd(test_for, _opt_fn), argv)
+    """runner.clj -main: test / analyze / serve / campaign with
+    workload + nemesis registries."""
+    cli.run(cli.single_test_cmd(test_for, _opt_fn,
+                                nemesis_registry=nemeses), argv)
 
 
 if __name__ == "__main__":

@@ -67,19 +67,30 @@ class KvdDB(db_mod.DB, db_mod.LogFiles):
     def setup(self, test, node):
         c.execute("mkdir", "-p", DIR)
         c.upload(SRC, f"{DIR}/kvd.py")
-        import sys
-        extra = ["--unsafe-cas"] if self.unsafe_cas else []
         env = None
         if self.disk_faults:
             mech = faultfs.mount(test, node, DATA_DIR,
                                  port=self.faultfs_port)
             env = mech["env"] or None
+        self._env = env
+        self.launch(test, node)
+
+    def launch(self, test, node):
+        """(Re)start the daemon with this DB's configured args and
+        await TCP liveness — factored out of setup so the kill/restart
+        nemesis can bring a SIGKILLed daemon back mid-run (the stale
+        pidfile is fine: start-stop-daemon sees the dead pid and
+        proceeds, --make-pidfile rewrites it)."""
+        import sys
+        extra = ["--unsafe-cas"] if self.unsafe_cas else []
+        if self.disk_faults:
             extra += ["--data-dir", DATA_DIR]
         cu.start_daemon(sys.executable, f"{DIR}/kvd.py",
                         "--port", str(PORT),
                         "--log", f"{DIR}/kvd.log", *extra,
                         chdir=DIR, logfile=f"{DIR}/daemon.log",
-                        pidfile=f"{DIR}/kvd.pid", env=env)
+                        pidfile=f"{DIR}/kvd.pid",
+                        env=getattr(self, "_env", None))
         c.execute(lit(
             "for i in $(seq 1 30); do "
             f"python3 -c 'import socket; socket.create_connection("
@@ -167,8 +178,109 @@ def _pause() -> dict:
     return nem.named_nemesis("pause", pauser())
 
 
+class KvdControlNemesis(nem.Nemesis):
+    """start/stop nemesis driving one of kvd's in-daemon fault verbs
+    (PART, SKEW — see resources/kvd.py) over the client port: REAL
+    faults at the SUT's own network/clock layer, usable on a shared
+    host where iptables or `date -s` would take out the machine.
+
+    Ledger discipline matches every other nemesis: the undo registers
+    BEFORE the fault is injected, so a nemesis worker SIGKILLed
+    mid-fault still gets its partition healed by the run_case
+    backstop.  Control calls are socket-timeout-bounded (a SIGSTOPped
+    daemon must cost a 2s :info, not a wedged worker)."""
+
+    def __init__(self, name: str, start_cmd: str, stop_cmd: str):
+        self.name = name
+        self.start_cmd = start_cmd
+        self.stop_cmd = stop_cmd
+
+    @property
+    def _ledger_key(self):
+        return f"nemesis.kvd-{self.name}"
+
+    def _cmd(self, line: str) -> str:
+        sock = socket.create_connection(("127.0.0.1", PORT), 2)
+        try:
+            sock.settimeout(2)
+            sock.sendall((line + "\n").encode())
+            return (sock.makefile("r").readline() or "").strip()
+        finally:
+            sock.close()
+
+    def invoke(self, test, op):
+        if op.f == "start":
+            nem.ledger(test).register(
+                self._ledger_key, lambda: self._cmd(self.stop_cmd),
+                self.start_cmd)
+            out = self._cmd(self.start_cmd)
+            return op.assoc(type="info", value=[self.name, out])
+        if op.f == "stop":
+            out = self._cmd(self.stop_cmd)
+            nem.ledger(test).resolve(self._ledger_key)
+            return op.assoc(type="info",
+                            value=[f"{self.name}-healed", out])
+        raise ValueError(f"{self.name} nemesis can't handle {op.f!r}")
+
+    def teardown(self, test):
+        try:
+            self._cmd(self.stop_cmd)
+        except OSError:
+            pass                     # daemon already dead: fault gone
+        nem.ledger(test).resolve(self._ledger_key)
+
+
+def _partition() -> dict:
+    """Hold every data request at the daemon (clients see a dropped
+    link; healing delivers late) — kvd's partition-class fault."""
+    return nem.named_nemesis(
+        "partition", KvdControlNemesis("partition", "PART 1", "PART 0"))
+
+
+def _clock_skew(ms: float = 300_000) -> dict:
+    """Skew the daemon's wall clock (its mutation-log timestamps) by
+    +ms — kvd's clock-class fault; per-process, host clock untouched."""
+    return nem.named_nemesis(
+        "clock-skew",
+        KvdControlNemesis("clock-skew", f"SKEW {ms:g}", "SKEW 0"),
+        clocks=True)
+
+
+def killer():
+    """kill -9 the daemon on :start, restart it (KvdDB.launch, same
+    args + liveness wait) on :stop — the kill-class fault.  A
+    non-durable kvd genuinely loses acked writes across the restart,
+    so the checker SHOULD flag these histories; with --data-dir the
+    fsynced log replays and they should pass.  Both verdicts are true
+    statements about the SUT — exactly the coverage axis a campaign
+    searches."""
+    import random
+
+    def start(test, node):
+        c.execute("sh", "-c",
+                  f"kill -9 $(cat {DIR}/kvd.pid)", check=False)
+        return ["killed", "kvd"]
+
+    def stop(test, node):
+        db = test.get("db")
+        if isinstance(db, KvdDB):
+            db.launch(test, node)
+            return ["restarted", "kvd"]
+        return ["no-db", "kvd"]
+
+    return nem.node_start_stopper(
+        lambda nodes: random.choice(list(nodes)), start, stop)
+
+
+def _kill() -> dict:
+    return nem.named_nemesis("kill", killer())
+
+
 nemeses = {
     "pause": _pause,
+    "kill": _kill,
+    "partition": _partition,
+    "clock-skew": _clock_skew,
     **{name: (lambda ctor=ctor: _localized(ctor()))
        for name, ctor in faultfs.nemeses.items()},
 }
@@ -197,7 +309,7 @@ def kvd_test(opts) -> dict:
     av = opts.get("argv-options") or {}
     names = list(opts.get("nemesis") or av.get("nemesis") or [])
     nm = resolve_named_nemeses(nemeses, dict(opts, nemesis=names)) \
-        if names else None
+        if (names or opts.get("nemesis-map") is not None) else None
     disk = any(n in faultfs.DISK_NEMESES for n in names)
     test = register_test("kvd",
                          KvdDB(unsafe_cas=bool(opts.get("unsafe-cas")),
@@ -219,7 +331,16 @@ def _opt_fn(parser):
     cli.nemesis_opt_spec(parser, nemeses, default="pause")
 
 
-main = simple_main(kvd_test, _opt_fn)
+def _campaign_target():
+    """The kvd binary's `campaign` subcommand targets the full
+    KvdTarget (workload variants + quarantine reap), not the generic
+    suite adapter."""
+    from jepsen_tpu import campaign
+    return campaign.KvdTarget()
+
+
+main = simple_main(kvd_test, _opt_fn,
+                   nemesis_registry=_campaign_target)
 
 if __name__ == "__main__":
     main()

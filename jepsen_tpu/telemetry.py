@@ -46,6 +46,10 @@ sequence, `t` wall-clock seconds, `crc`):
     {"type": "dispatch", "record": {engine, why, fallback_chain, R,
      crashes, batch, mesh, env}, "stages": {stage: seconds}}
     {"type": "span", "span": {...}}                  # trace.py bridge
+    {"type": "analyze", "seconds": ..., "valid": ...}
+    {"type": "campaign-leak", "keys": [...]}   # a prior schedule's
+        faults survived into the inter-schedule gap (campaign.py /
+        nemesis.FaultLedger.assert_empty) — journaled, then healed
     {"type": "metrics", "snapshot": "<prometheus text>"}
     {"type": "run-end"}
 
@@ -723,6 +727,13 @@ def summarize(events: list[dict]) -> str:
     stalls = sum(1 for e in events if e.get("type") == "watchdog-stall")
     if stalls:
         lines.append(f"watchdog stalls: {stalls}")
+    leaks = [e for e in events if e.get("type") == "campaign-leak"]
+    if leaks:
+        lines.append(
+            f"campaign leaks: {len(leaks)} (faults that survived a "
+            "schedule and were backstop-healed): "
+            + "; ".join(", ".join(e.get("keys") or [])
+                        for e in leaks[:5]))
     rn = [e for e in events if e.get("type") == "runner"]
     for e in rn:
         lines.append(

@@ -113,6 +113,7 @@ def home_html() -> bytes:
             "</tr>")
     body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
             "<a href='/live'>live</a> &middot; "
+            "<a href='/campaign'>campaigns</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
             "<th>Valid?</th><th>Results</th><th>History</th>"
@@ -393,6 +394,124 @@ def live_run_html(name: str, ts: str) -> bytes:
     return _page(f"live {name}/{ts}", "".join(body))
 
 
+# ---------------------------------------------------------------------------
+# Campaign pages (ISSUE 13): /campaign index + per-campaign coverage
+# matrix (nemesis x workload x anomaly class, gaps visible) — rendered
+# from store/campaigns/<name>/{status,coverage}.json
+# ---------------------------------------------------------------------------
+
+def _campaign_safe_dir(name: str) -> Path:
+    base = store.campaigns_root().resolve()
+    p = (base / name).resolve()
+    try:
+        p.relative_to(base)
+    except ValueError:
+        raise PermissionError(name)
+    return p
+
+
+def campaign_index_html() -> bytes:
+    rows = []
+    root = store.campaigns_root()
+    names = sorted(p.name for p in root.iterdir()
+                   if p.is_dir()) if root.is_dir() else []
+    for n in names:
+        st = {}
+        sp = root / n / "status.json"
+        if sp.exists():
+            try:
+                with open(sp) as f:
+                    st = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
+        state = (f"done ({st.get('reason')})" if st.get("done")
+                 else "in progress")
+        rows.append(
+            "<tr>"
+            f"<td><a href='/campaign/{quote(n)}'>{html.escape(n)}</a>"
+            "</td>"
+            f"<td>{html.escape(str(st.get('sut', '?')))}</td>"
+            f"<td>{st.get('seed', '?')}</td>"
+            f"<td>{st.get('run', 0)}/{st.get('budget', '?')}</td>"
+            f"<td>{st.get('novel', 0)}</td>"
+            f"<td>{st.get('deduped', 0)}</td>"
+            f"<td>{st.get('quarantined', 0)}</td>"
+            f"<td>{st.get('leaks', 0)}</td>"
+            f"<td>{html.escape(state)}</td></tr>")
+    body = ("<h1>Nemesis campaigns</h1>"
+            "<p><a href='/'>&larr; tests</a></p>"
+            "<table><tr><th>Campaign</th><th>SUT</th><th>Seed</th>"
+            "<th>Schedules</th><th>Novel</th><th>Deduped</th>"
+            "<th>Quarantined</th><th>Leaks</th><th>State</th></tr>"
+            + "".join(rows) + "</table>")
+    if not rows:
+        body += ("<p>(no campaigns — start one with "
+                 "<code>python -m jepsen_tpu.cli campaign run</code>)"
+                 "</p>")
+    return _page("Campaigns", body)
+
+
+def campaign_html(name: str) -> bytes:
+    """The coverage matrix: one table per workload, nemesis rows x
+    anomaly-class columns — EVERY registry nemesis gets a row, so a
+    fault class the search never produced coverage for is a visible
+    gap, not a missing line."""
+    d = _campaign_safe_dir(name)
+    if not d.is_dir():
+        raise FileNotFoundError(name)
+    st, cov = {}, {}
+    for fname, box in (("status.json", st), ("coverage.json", cov)):
+        p = d / fname
+        if p.exists():
+            try:
+                with open(p) as f:
+                    box.update(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                pass
+    body = [f"<h1>campaign {html.escape(name)}</h1>",
+            "<p><a href='/campaign'>&larr; campaigns</a> &middot; "
+            f"<a href='/files/campaigns/{quote(name)}/ledger.jsonl'>"
+            "raw ledger</a></p>"]
+    if st:
+        body.append(
+            "<p>" + " &middot; ".join(
+                f"<b>{html.escape(k)}</b>: "
+                f"{html.escape(json.dumps(st.get(k)))}"
+                for k in ("sut", "seed", "run", "budget", "novel",
+                          "deduped", "quarantined", "crashed",
+                          "leaks", "signatures", "frontier", "dry",
+                          "done", "reason")) + "</p>")
+    nemeses = cov.get("nemeses") or []
+    workloads = cov.get("workloads") or []
+    cells = cov.get("cells") or {}
+    classes = sorted({cls for wl in cells.values()
+                      for cc in wl.values() for cls in cc})
+    if not classes:
+        classes = ["none"]
+    for wl in workloads:
+        body.append(f"<h2>workload: {html.escape(wl)}</h2>"
+                    "<table><tr><th>Nemesis</th>"
+                    + "".join(f"<th>{html.escape(c)}</th>"
+                              for c in classes) + "</tr>")
+        for n in nemeses:
+            row = (cells.get(n) or {}).get(wl) or {}
+            tds = []
+            for c in classes:
+                v = row.get(c, 0)
+                # gaps (never-covered cells) stay visibly grey
+                style = "" if v else " style='background:#EAEAEA'"
+                tds.append(f"<td{style}>{v or ''}</td>")
+            covered = bool(row)
+            nm_style = "" if covered else \
+                " style='background:#F3EABB'"
+            body.append(f"<tr><td{nm_style}>{html.escape(n)}</td>"
+                        + "".join(tds) + "</tr>")
+        body.append("</table>")
+    if not workloads:
+        body.append("<p>(no coverage yet)</p>")
+    return _page(f"campaign {name}", "".join(body))
+
+
 def telemetry_run_html(name: str, ts: str) -> bytes:
     from jepsen_tpu import telemetry
     p = _safe_path(f"{name}/{ts}") / "telemetry.jsonl"
@@ -520,6 +639,14 @@ class Handler(BaseHTTPRequestHandler):
                          path[len("/live/"):].strip("/").split("/")]
                 if len(parts) == 2:
                     return self._send(200, live_run_html(*parts))
+                return self._send(404, b"not found", "text/plain")
+            if path == "/campaign" or path == "/campaign/":
+                return self._send(200, campaign_index_html())
+            if path.startswith("/campaign/"):
+                parts = [unquote(x) for x in
+                         path[len("/campaign/"):].strip("/").split("/")]
+                if len(parts) == 1:
+                    return self._send(200, campaign_html(parts[0]))
                 return self._send(404, b"not found", "text/plain")
             if path == "/telemetry" or path == "/telemetry/":
                 return self._send(200, telemetry_index_html())

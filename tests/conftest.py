@@ -101,6 +101,20 @@ def _pack_backend():
         return None
 
 
+def _campaign_summary():
+    """The tier-1 smoke campaign's counters (ISSUE 13):
+    run/novel/deduped/quarantined schedule counts from the registry —
+    recorded so a regression that collapses the campaign's coverage
+    search (e.g. every schedule suddenly deduping to one signature, or
+    quarantines eating the budget) diffs across PRs instead of hiding
+    in a green suite.  None when no campaign ran this session."""
+    try:
+        from jepsen_tpu import campaign
+        return campaign.ci_summary()
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def pytest_sessionfinish(session, exitstatus):
     import json as _json
     import time as _time
@@ -118,6 +132,7 @@ def pytest_sessionfinish(session, exitstatus):
             "deep_r_max": _deep_r_max(),
             "plan_cache": _plan_cache_stats(),
             "pack_backend": _pack_backend(),
+            "campaign": _campaign_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }

@@ -1,0 +1,544 @@
+"""Coverage-guided nemesis campaigns (ISSUE 13): schedule-grammar and
+mutation determinism, signature reduction, the crash-safe campaign
+ledger (byte-identical across same-seed runs AND across SIGKILL +
+--resume), the FaultLedger.assert_empty inter-schedule backstop, and
+the tier-1 smoke campaign — ~10 seeded schedules against the REAL kvd
+daemon over the local transport, mixing partition/disk/kill/clock
+nemeses, with dedupe-by-signature, mutation-from-novel-coverage, no
+fault leaks between schedules, and the /campaign coverage matrix."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import campaign as cp
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import store, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+    subprocess.run(["pkill", "-CONT", "-f", "[k]vd.py"],
+                   capture_output=True)
+    subprocess.run(["pkill", "-9", "-f", "[k]vd.py"],
+                   capture_output=True)
+
+
+NAMES = ["partition", "disk-eio", "kill", "pause", "clock-skew"]
+WLS = ["register", "register-racy"]
+
+
+# ---------------------------------------------------------------------------
+# Schedule grammar: generation + mutation (pure, seed-determined)
+# ---------------------------------------------------------------------------
+
+class TestScheduleGrammar:
+    def test_generation_is_deterministic(self):
+        a = cp.generate_schedule(7, 3, NAMES, WLS, 1.2)
+        b = cp.generate_schedule(7, 3, NAMES, WLS, 1.2)
+        assert a == b
+        assert a != cp.generate_schedule(7, 4, NAMES, WLS, 1.2)
+        assert a != cp.generate_schedule(8, 3, NAMES, WLS, 1.2)
+
+    def test_windows_fit_inside_the_time_limit(self):
+        for i in range(50):
+            s = cp.generate_schedule(11, i, NAMES, WLS, 1.5)
+            assert s["id"] == f"s{i:04d}" and s["gen"] == 0
+            assert s["workload"] in WLS
+            assert 1 <= len(s["windows"]) <= 3
+            for w in s["windows"]:
+                assert w["name"] in NAMES
+                assert 0 < w["at"] < s["time_limit"]
+                assert w["at"] + w["dur"] <= s["time_limit"] + 1e-9
+            assert s["windows"] == sorted(
+                s["windows"], key=lambda w: (w["at"], w["name"]))
+
+    def test_mutation_is_deterministic_and_well_formed(self):
+        parent = cp.generate_schedule(7, 0, NAMES, WLS, 1.2)
+        m1 = cp.mutate_schedule(parent, 7, 0, 5, NAMES, WLS)
+        m2 = cp.mutate_schedule(parent, 7, 0, 5, NAMES, WLS)
+        assert m1 == m2
+        assert m1["parent"] == parent["id"]
+        assert m1["gen"] == parent["gen"] + 1
+        assert m1["id"] == "s0005"
+        # different child ordinal -> (eventually) different mutation
+        kids = [cp.mutate_schedule(parent, 7, c, 5, NAMES, WLS)
+                for c in range(8)]
+        assert len({json.dumps(k["windows"], sort_keys=True)
+                    + k["workload"] for k in kids}) > 1
+        for k in kids:
+            for w in k["windows"]:
+                assert w["at"] + w["dur"] <= k["time_limit"] + 1e-6
+
+    def test_schedule_compiles_to_a_timed_nemesis_map(self):
+        from jepsen_tpu import generator as gen
+
+        class Rec(nem.Nemesis):
+            def __init__(self):
+                self.calls = []
+
+            def invoke(self, test, op):
+                self.calls.append(op.f)
+                return op
+
+        recs = {n: Rec() for n in ("a", "b")}
+        registry = {n: (lambda n=n: nem.named_nemesis(n, recs[n]))
+                    for n in recs}
+        sched = {"id": "s0000", "gen": 0, "parent": None,
+                 "workload": "register", "time_limit": 0.2,
+                 "windows": [{"name": "a", "at": 0.01, "dur": 0.02},
+                             {"name": "b", "at": 0.02, "dur": 0.03}]}
+        nmap = cp.schedule_nemesis_map(sched, registry)
+        assert nmap["name"] == "a+b"
+        test = {"nodes": ["n1"]}
+        ops = []
+        while True:
+            o = gen.op(nmap["during"], test, gen.NEMESIS)
+            if o is None:
+                break
+            ops.append(o["f"] if isinstance(o, dict) else o.f)
+        assert ops == [("a", "start"), ("b", "start"),
+                       ("a", "stop"), ("b", "stop")]
+        # the composed client routes tagged fs back to their owners
+        from jepsen_tpu.history import Op
+        client = nmap["client"]
+        client.invoke(test, Op(process="nemesis", type="info",
+                               f=("a", "start")))
+        assert recs["a"].calls == ["start"] and not recs["b"].calls
+
+    def test_unknown_nemesis_name_is_rejected(self):
+        sched = {"id": "s0000", "gen": 0, "parent": None,
+                 "workload": "register", "time_limit": 1.0,
+                 "windows": [{"name": "nope", "at": 0.1, "dur": 0.1}]}
+        with pytest.raises(ValueError, match="unknown nemesis"):
+            cp.schedule_nemesis_map(sched, {"a": None})
+
+
+# ---------------------------------------------------------------------------
+# Signature reduction
+# ---------------------------------------------------------------------------
+
+class TestSignature:
+    def test_anomaly_classes(self):
+        results = {
+            "valid?": False,
+            "linear": {"valid?": False,
+                       "results": {"3": {"valid?": False}}},
+            "elle": {"valid?": False, "anomaly-types": ["G-single"],
+                     "txn-count": 10},
+            "perf": {"valid?": True},
+        }
+        assert cp.anomaly_classes(results) == \
+            ["G-single", "invalid:elle", "invalid:linear"]
+        assert cp.anomaly_classes({"valid?": True}) == []
+        assert cp.anomaly_classes({"valid?": "unknown"}) == ["unknown"]
+
+    def test_lag_buckets(self):
+        assert cp.lag_bucket(None) == "na"
+        assert cp.lag_bucket(0.3) == "lt2s"
+        assert cp.lag_bucket(5) == "lt8s"
+        assert cp.lag_bucket(100) == "ge30s"
+
+    def test_windows_overlap(self):
+        evs = [{"type": "fault-start", "key": "k", "t": 1.0},
+               {"type": "op", "t": 1.5},
+               {"type": "fault-stop", "key": "k", "t": 2.0},
+               {"type": "fault-start", "key": "j", "t": 5.0},
+               {"type": "fault-stop", "key": "j", "t": 6.0}]
+        assert cp.windows_overlap(evs) == "some"
+        assert cp.windows_overlap(evs[:3]) == "all"
+        assert cp.windows_overlap([{"type": "op", "t": 1.0}]) == "nowin"
+
+    def test_signature_dedupes_on_content_not_identity(self):
+        a = {"verdict": True, "anomalies": [], "engines": ["e1"],
+             "lag_bucket": "lt2s", "overlap": "all"}
+        b = dict(a, engines=["e1"])
+        assert cp.signature(a) == cp.signature(b)
+        assert cp.signature(a) != cp.signature(
+            dict(a, verdict=False))
+
+
+# ---------------------------------------------------------------------------
+# Campaign ledger framing
+# ---------------------------------------------------------------------------
+
+class TestCampaignLedger:
+    def test_roundtrip_and_no_wall_clock_in_frames(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        led = cp.CampaignLedger(p)
+        led.append({"type": "config", "seed": 1})
+        led.append({"type": "scheduled", "schedule": {"id": "s0000"}})
+        led.close()
+        for line in p.read_text().splitlines():
+            rec = json.loads(line)
+            # byte-determinism contract: crc+seq framing, NO wall time
+            assert sorted(rec) == ["crc", "ev", "i"]
+        records, led2 = cp.CampaignLedger.recover(p)
+        assert [r["type"] for r in records] == ["config", "scheduled"]
+        led2.append({"type": "end"})
+        led2.close()
+        records3, _ = cp.CampaignLedger.recover(p)
+        assert [r["i"] for r in
+                [json.loads(x) for x in
+                 p.read_text().splitlines()]] == [0, 1, 2]
+
+    def test_torn_tail_is_truncated_on_recover(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        led = cp.CampaignLedger(p)
+        led.append({"type": "config"})
+        led.append({"type": "scheduled"})
+        led.close()
+        whole = p.read_text()
+        with open(p, "w") as f:          # torn mid-record, no newline
+            f.write(whole + '{"i":2,"crc":"dead')
+        records, led2 = cp.CampaignLedger.recover(p)
+        assert len(records) == 2
+        led2.append({"type": "end"})
+        led2.close()
+        recs = [json.loads(x) for x in p.read_text().splitlines()]
+        assert [r["i"] for r in recs] == [0, 1, 2]
+
+    def test_corrupt_complete_record_refuses_resume(self, tmp_path):
+        p = tmp_path / "ledger.jsonl"
+        led = cp.CampaignLedger(p)
+        led.append({"type": "config"})
+        led.close()
+        body = p.read_text().replace('"config"', '"CONFIG"')
+        p.write_text(body)               # crc now mismatches
+        with pytest.raises(ValueError, match="corrupt"):
+            cp.CampaignLedger.recover(p)
+
+
+# ---------------------------------------------------------------------------
+# FaultLedger.assert_empty (satellite: the inter-schedule backstop)
+# ---------------------------------------------------------------------------
+
+class TestAssertEmpty:
+    def test_clean_ledger_is_a_noop(self):
+        led = nem.FaultLedger()
+        assert led.assert_empty() == []
+
+    def test_leak_is_journaled_counted_and_healed(self, tmp_path):
+        led = nem.FaultLedger()
+        log = telemetry.EventLog(tmp_path / "t.jsonl")
+        led.telemetry = telemetry.Telemetry(enabled=True, log=log)
+        healed = []
+        led.register("leaky.fault", lambda: healed.append(1),
+                     "desc")
+        before = telemetry.REGISTRY.counter(
+            "jepsen_campaign_leaks_total").value
+        leaked = led.assert_empty(context="c1/s0001")
+        assert leaked == ["'leaky.fault'"]
+        assert healed == [1]             # never silently dropped:
+        assert not led.outstanding()     # journaled AND healed
+        assert telemetry.REGISTRY.counter(
+            "jepsen_campaign_leaks_total").value == before + 1
+        log.close()
+        evs = telemetry.read_events(tmp_path / "t.jsonl")
+        leak_evs = [e for e in evs if e["type"] == "campaign-leak"]
+        assert leak_evs and leak_evs[0]["keys"] == ["'leaky.fault'"]
+        assert leak_evs[0]["context"] == "c1/s0001"
+        # and `cli metrics` surfaces it
+        assert "campaign leaks: 1" in telemetry.summarize(evs)
+
+
+# ---------------------------------------------------------------------------
+# The mock-target engine: determinism, dedupe, frontier, stops
+# ---------------------------------------------------------------------------
+
+def _mock_campaign(name, **kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("schedules", 30)
+    kw.setdefault("k_dry", 100)
+    return cp.Campaign(name, cp.MockTarget(), **kw)
+
+
+class TestMockCampaign:
+    def test_same_seed_byte_identical_ledger_and_coverage(
+            self, tmp_path, monkeypatch):
+        outs, bodies = [], []
+        for sub in ("a", "b"):
+            monkeypatch.setattr(store, "BASE", tmp_path / sub)
+            outs.append(_mock_campaign("same").run())
+            d = tmp_path / sub / "campaigns" / "same"
+            bodies.append(((d / "ledger.jsonl").read_bytes(),
+                           (d / "coverage.json").read_bytes()))
+        assert outs[0] == outs[1]
+        assert bodies[0][0] == bodies[1][0], "ledger bytes differ"
+        assert bodies[0][1] == bodies[1][1], "coverage bytes differ"
+        assert outs[0]["run"] == 30
+        assert outs[0]["deduped"] > 0 and outs[0]["novel"] > 0
+
+    def test_dedupe_collapses_repeated_signatures(self):
+        out = _mock_campaign("dd").run()
+        led = store.campaign_dir("dd") / "ledger.jsonl"
+        sigs = [json.loads(x)["ev"]["sig"]
+                for x in led.read_text().splitlines()
+                if json.loads(x)["ev"]["type"] == "result"]
+        assert len(sigs) == 30
+        assert len(set(sigs)) == out["signatures"] < len(sigs)
+
+    def test_novel_coverage_spawns_mutants_and_they_run(self):
+        _mock_campaign("mu").run()
+        led = store.campaign_dir("mu") / "ledger.jsonl"
+        scheds = [json.loads(x)["ev"]["schedule"]
+                  for x in led.read_text().splitlines()
+                  if json.loads(x)["ev"]["type"] == "scheduled"]
+        assert any(s["parent"] is not None for s in scheds), \
+            "no mutated schedule ever ran"
+
+    def test_k_dry_rounds_stop(self):
+        out = _mock_campaign("dry", schedules=500, k_dry=5).run()
+        assert out["reason"] == "dry"
+        assert out["run"] < 500
+
+    def test_frontier_is_bounded(self):
+        c = _mock_campaign("fr", schedules=60, mutants_per_novel=8,
+                           frontier_max=4)
+        c.run()
+        assert len(c.frontier) <= 4
+
+    def test_fresh_run_refuses_an_existing_ledger(self):
+        _mock_campaign("dup", schedules=3).run()
+        with pytest.raises(ValueError, match="--resume"):
+            _mock_campaign("dup", schedules=3).run()
+
+    def test_resume_without_ledger_refuses(self):
+        with pytest.raises(FileNotFoundError):
+            _mock_campaign("ghost").run(resume=True)
+
+    def test_resume_completes_an_interrupted_campaign_identically(
+            self, tmp_path, monkeypatch):
+        # uninterrupted reference
+        monkeypatch.setattr(store, "BASE", tmp_path / "ref")
+        _mock_campaign("ir", schedules=20).run()
+        ref = (tmp_path / "ref" / "campaigns" / "ir"
+               / "ledger.jsonl").read_bytes()
+        # interrupted: run a stub runner that dies mid-campaign by
+        # raising KeyboardInterrupt past the ledger append of run 7
+        monkeypatch.setattr(store, "BASE", tmp_path / "cut")
+        boom = {"n": 0}
+        mock = cp.MockTarget()
+
+        def dying(schedule, campaign):
+            boom["n"] += 1
+            if boom["n"] == 8:
+                raise KeyboardInterrupt   # simulated kill mid-run
+            return mock.run(schedule, campaign)
+
+        c = cp.Campaign("ir", cp.MockTarget(), seed=7, schedules=20,
+                        k_dry=100, runner=dying)
+        with pytest.raises(KeyboardInterrupt):
+            c.run()
+        # resume replays + finishes; final bytes converge to the
+        # uninterrupted ledger (the pending schedule is re-run, not
+        # re-journaled)
+        c2 = cp.Campaign("ir", cp.MockTarget(), seed=0, schedules=1,
+                         k_dry=1)        # config comes from record 0,
+        out = c2.run(resume=True)        # CLI flags are ignored
+        assert out["run"] == 20
+        cut = (tmp_path / "cut" / "campaigns" / "ir"
+               / "ledger.jsonl").read_bytes()
+        assert cut == ref
+
+    def test_resume_divergence_is_detected(self, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setattr(store, "BASE", tmp_path / "dv")
+        c = _mock_campaign("dv", schedules=4)
+        c.run()
+        led = tmp_path / "dv" / "campaigns" / "dv" / "ledger.jsonl"
+        # tamper with the seed in the config record (recompute crc so
+        # framing passes; replay must still catch the divergence)
+        lines = led.read_text().splitlines()
+        import zlib
+        from jepsen_tpu.history import _wal_payload
+        ev = json.loads(lines[0])["ev"]
+        ev["seed"] = 999
+        payload = _wal_payload(ev)
+        lines[0] = (f'{{"i":0,"crc":'
+                    f'"{zlib.crc32(payload.encode()):08x}",'
+                    f'"ev":{payload}}}')
+        led.write_text("\n".join(lines) + "\n")
+        with pytest.raises(ValueError, match="divergence"):
+            cp.Campaign("dv", cp.MockTarget()).run(resume=True)
+
+    def test_quarantined_schedules_do_not_breed(self):
+        mock = cp.MockTarget()
+
+        def sometimes_wedged(schedule, campaign):
+            out = mock.run(schedule, campaign)
+            if schedule["id"] == "s0000":
+                out = dict(out, verdict="quarantined",
+                           quarantined=True)
+            return out
+
+        c = cp.Campaign("qq", cp.MockTarget(), seed=7, schedules=6,
+                        k_dry=100, runner=sometimes_wedged)
+        out = c.run()
+        assert out["quarantined"] == 1
+        led = store.campaign_dir("qq") / "ledger.jsonl"
+        evs = [json.loads(x)["ev"]
+               for x in led.read_text().splitlines()]
+        assert not any(s.get("schedule", {}).get("parent") == "s0000"
+                       for s in evs if s["type"] == "scheduled"), \
+            "a quarantined schedule was mutated"
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid-campaign + `campaign --resume` (the acceptance pin):
+# a real kill -9 against the CLI process, resumed to byte-identical
+# convergence with an uninterrupted run
+# ---------------------------------------------------------------------------
+
+class TestKillResume:
+    def _run_cli(self, cwd, *args, wait=True):
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu.cli", "campaign",
+             "run", "--sut", "mock", "--seed", "13",
+             "--schedules", "25", "--k-dry", "100",
+             "--name", "kr", *args],
+            cwd=cwd, env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        if wait:
+            assert p.wait(timeout=120) == 0
+        return p
+
+    @pytest.mark.kill9
+    def test_sigkill_then_resume_converges(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        a.mkdir(), b.mkdir()
+        self._run_cli(a)                 # uninterrupted reference
+        # paced run, killed once the ledger shows real progress
+        p = self._run_cli(b, "--pace", "0.25", wait=False)
+        led = b / "store" / "campaigns" / "kr" / "ledger.jsonl"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if led.exists() and len(led.read_bytes()
+                                    .splitlines()) >= 6:
+                break
+            time.sleep(0.05)
+        else:
+            p.kill()
+            raise AssertionError("campaign never made progress")
+        os.kill(p.pid, signal.SIGKILL)
+        p.wait(timeout=30)
+        mid = led.read_bytes()
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "jepsen_tpu.cli", "campaign",
+             "run", "--sut", "mock", "--name", "kr", "--resume"],
+            cwd=b, env=env, capture_output=True, text=True,
+            timeout=120)
+        assert out.returncode == 0, out.stderr
+        final = led.read_bytes()
+        ref = (a / "store" / "campaigns" / "kr"
+               / "ledger.jsonl").read_bytes()
+        assert len(mid) < len(final)
+        assert final == ref, "resumed ledger diverged from the " \
+                             "uninterrupted run"
+        assert (b / "store" / "campaigns" / "kr"
+                / "coverage.json").read_bytes() == \
+            (a / "store" / "campaigns" / "kr"
+             / "coverage.json").read_bytes()
+
+
+# ---------------------------------------------------------------------------
+# CLI surfaces
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_campaign_status(self, capsys):
+        _mock_campaign("st", schedules=5).run()
+        from jepsen_tpu import cli
+        rc = cli.main(cli.standard_commands(),
+                      ["campaign", "status", "--name", "st"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "st:" in out and "run=5/5" in out
+
+    def test_campaign_status_without_campaigns(self):
+        from jepsen_tpu import cli
+        rc = cli.main(cli.standard_commands(),
+                      ["campaign", "status", "--name", "nope"])
+        assert rc == 255
+
+    def test_unknown_resume_name_exits_255(self):
+        from jepsen_tpu import cli
+        rc = cli.main(cli.standard_commands(),
+                      ["campaign", "run", "--sut", "mock",
+                       "--name", "nothere", "--resume"])
+        assert rc == 255
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 smoke campaign: REAL kvd over the local transport
+# ---------------------------------------------------------------------------
+
+class TestKvdSmokeCampaign:
+    def test_seeded_smoke_campaign(self):
+        """Seed 0, 10 schedules, bootstrap 6: the first six schedules
+        are pure seed draws whose windows provably mix all four fault
+        classes (partition / disk / kill+pause / clock — a property of
+        the seed, independent of run outcomes); the rest drain the
+        mutation frontier.  Dedupe collapses repeated outcomes, novel
+        coverage breeds mutants that RUN, no faults leak between
+        schedules, and /campaign renders the coverage matrix — the
+        ISSUE 13 acceptance scenario."""
+        c = cp.Campaign("smoke", cp.KvdTarget(), seed=0,
+                        schedules=10, k_dry=50, bootstrap=6,
+                        base_time_limit=1.0)
+        out = c.run()
+        assert out["run"] == 10 and out["reason"] == "budget"
+        # dedupe provably collapsed repeated outcomes
+        assert out["deduped"] >= 1
+        assert out["novel"] >= 2
+        assert out["signatures"] == out["novel"]
+        # the FaultLedger was empty between every pair of schedules
+        assert out["leaks"] == 0
+        led = store.campaign_dir("smoke") / "ledger.jsonl"
+        evs = [json.loads(x)["ev"]
+               for x in led.read_text().splitlines()]
+        scheds = {e["schedule"]["id"]: e["schedule"]
+                  for e in evs if e["type"] == "scheduled"}
+        results = {e["id"]: e for e in evs if e["type"] == "result"}
+        # every journaled schedule completed with a result record
+        assert sorted(scheds) == sorted(results)
+        assert all(r["leaked"] == [] for r in results.values())
+        # at least one mutated schedule (novel-coverage child) RAN
+        assert any(s["parent"] is not None for s in scheds.values())
+        # the campaign mixed all four fault classes
+        names = {w["name"] for s in scheds.values()
+                 for w in s["windows"]}
+        assert names & {"partition"}
+        assert names & {"disk-eio", "disk-slow", "disk-torn"}
+        assert names & {"kill", "pause"}
+        assert names & {"clock-skew"}
+        # dedupe evidence at the signature level
+        sigs = [r["sig"] for r in results.values()]
+        assert len(sigs) - len(set(sigs)) == out["deduped"]
+        # the searched space did real verification: every run carries
+        # an engine path and the runs' store dirs exist
+        assert any(r["engines"] for r in results.values())
+        # the process-global counters feed the CI artifact
+        summary = cp.ci_summary()
+        assert summary and summary["run"] >= 10
+        # /campaign renders the coverage matrix with visible gaps
+        from jepsen_tpu import web
+        page = web.campaign_html("smoke").decode()
+        assert "workload: register" in page
+        for n in sorted(c.target.nemeses):
+            assert n in page             # every registry row present
+        assert "background:#EAEAEA" in page   # uncovered cells = gaps
+        idx = web.campaign_index_html().decode()
+        assert "smoke" in idx
