@@ -126,11 +126,18 @@ def _rng(*parts) -> random.Random:
 
 
 def generate_schedule(seed, index: int, names: list, workloads: list,
-                      base_time_limit: float) -> dict:
-    """A fresh (generation-0) schedule, fully determined by
-    (seed, index): 1-3 fault windows with composition and timing drawn
-    from the derived RNG, inside a jittered time limit."""
-    rng = _rng(seed, "fresh", index)
+                      base_time_limit: float,
+                      ordinal: Optional[int] = None) -> dict:
+    """A fresh (generation-0) schedule: 1-3 fault windows with
+    composition and timing drawn from the derived RNG, inside a
+    jittered time limit.  The draw is keyed by (seed, ordinal) —
+    `ordinal` is the count of fresh draws so far, NOT the schedule
+    index: ids share the index sequence with mutants, so keying the
+    CONTENT by index would make the Nth fresh draw depend on how many
+    mutants earlier outcomes happened to breed, silently breaking the
+    bootstrap contract (the opening fault-class mix must be a pure
+    function of the seed).  Defaults to `index` for standalone use."""
+    rng = _rng(seed, "fresh", index if ordinal is None else ordinal)
     tl = round(base_time_limit * rng.choice((0.75, 1.0, 1.25)), 3)
     windows = []
     for _ in range(rng.randint(1, 3)):
@@ -723,7 +730,8 @@ class Campaign:
         if self.frontier and self.fresh_drawn >= self.bootstrap:
             return self.frontier.popleft()
         s = generate_schedule(self.seed, self.next_index, self.names,
-                              self.workloads, self.base_time_limit)
+                              self.workloads, self.base_time_limit,
+                              ordinal=self.fresh_drawn)
         self.next_index += 1
         self.fresh_drawn += 1
         return s

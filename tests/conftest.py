@@ -28,6 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _ci_durations: list = []
 _ci_t0: list = []
+_ci_failed: list = []
 
 
 def pytest_sessionstart(session):
@@ -39,6 +40,11 @@ def pytest_runtest_logreport(report):
     # setup+call+teardown all count toward a test's bill (fixtures like
     # the kvd daemon are real wall time)
     _ci_durations.append((report.nodeid, report.when, report.duration))
+    # a red run must name its failures in the artifact — an exitstatus
+    # of 1 with no culprit is undiagnosable once the pytest cache is
+    # overwritten by the next (green) run
+    if report.failed and report.nodeid not in _ci_failed:
+        _ci_failed.append(report.nodeid)
 
 
 def _mesh_device_count():
@@ -115,10 +121,35 @@ def _campaign_summary():
         return None
 
 
+def _is_partial_run(session) -> bool:
+    """True when this invocation selected a subset of the tier (-k, a
+    narrowing -m, or explicit file/nodeid args): partial runs must not
+    overwrite store/ci/last-tier1.json, or the committed baseline (and
+    the >25% wall-regression tripwire keyed off prev_total_wall_s)
+    degrades to whatever slice somebody last ran by hand.  The default
+    tier (`-m "not slow"` from pytest.ini) and the full matrix
+    (`-m ""`) both count as full runs; anything narrower does not."""
+    cfg = session.config
+    if cfg.getoption("keyword", ""):
+        return True
+    if cfg.getoption("markexpr", "") not in ("", "not slow"):
+        return True
+    inv_dir = str(getattr(cfg, "invocation_params", None)
+                  and cfg.invocation_params.dir or "")
+    for a in cfg.args:
+        p = a.split("::")[0]
+        if not (os.path.isdir(p)
+                or os.path.isdir(os.path.join(inv_dir, p))):
+            return True
+    return False
+
+
 def pytest_sessionfinish(session, exitstatus):
     import json as _json
     import time as _time
     try:
+        if _is_partial_run(session):
+            return
         per_test: dict = {}
         for nodeid, _when, dur in _ci_durations:
             per_test[nodeid] = per_test.get(nodeid, 0.0) + dur
@@ -128,6 +159,7 @@ def pytest_sessionfinish(session, exitstatus):
             "total_wall_s": round(total, 3) if total is not None else None,
             "tests": len(per_test),
             "exitstatus": int(getattr(exitstatus, "value", exitstatus)),
+            "failed": list(_ci_failed),
             "mesh_devices": _mesh_device_count(),
             "deep_r_max": _deep_r_max(),
             "plan_cache": _plan_cache_stats(),
@@ -164,6 +196,7 @@ def pytest_sessionfinish(session, exitstatus):
         out["prev_total_wall_s"] = prev_total
         with open(artifact, "w") as f:
             _json.dump(out, f, indent=2)
+            f.write("\n")
     except Exception:
         pass            # the artifact must never fail the suite
 

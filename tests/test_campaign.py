@@ -25,6 +25,21 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(autouse=True)
+def fresh_metrics(request, monkeypatch):
+    """Swap in a throwaway MetricsRegistry for every test here EXCEPT
+    the tier-1 smoke campaign: most of this file deliberately induces
+    leaks/quarantines/crashes to exercise those paths, and the
+    process-global counters they would pollute are exactly what
+    conftest's campaign row in store/ci/last-tier1.json records —
+    docs/campaigns.md treats any leak there as a real teardown bug, so
+    only the REAL smoke campaign may write the global registry."""
+    if "TestKvdSmokeCampaign" not in request.node.nodeid:
+        monkeypatch.setattr(telemetry, "REGISTRY",
+                            telemetry.MetricsRegistry())
+    yield
+
+
+@pytest.fixture(autouse=True)
 def store_tmpdir(tmp_path, monkeypatch):
     monkeypatch.setattr(store, "BASE", tmp_path / "store")
     yield
@@ -307,6 +322,45 @@ class TestMockCampaign:
                            frontier_max=4)
         c.run()
         assert len(c.frontier) <= 4
+
+    def test_bootstrap_draws_are_outcome_independent(self, tmp_path,
+                                                     monkeypatch):
+        """The opening fault-class mix must be a pure function of the
+        seed: fresh-draw CONTENT is keyed by the fresh ordinal, not
+        by the index sequence the mutant ids share.  A runner whose
+        every schedule breeds mutants and one that never breeds must
+        draw identical bootstrap windows — keying by index made the
+        Nth fresh draw depend on how many mutants earlier (timing-
+        sensitive) outcomes happened to spawn, which is exactly the
+        flake that dropped kill/pause from the smoke campaign's
+        'guaranteed' mix."""
+        sigs = iter(range(10 ** 6))
+
+        def novel_runner(schedule, campaign):
+            return {"verdict": True, "anomalies": [f"a{next(sigs)}"],
+                    "engines": [], "lag_bucket": "na",
+                    "overlap": "nowin", "quarantined": False,
+                    "leaked": []}
+
+        def dull_runner(schedule, campaign):
+            return {"verdict": True, "anomalies": [], "engines": [],
+                    "lag_bucket": "na", "overlap": "nowin",
+                    "quarantined": False, "leaked": []}
+
+        boots = []
+        for sub, runner in (("nv", novel_runner), ("dl", dull_runner)):
+            monkeypatch.setattr(store, "BASE", tmp_path / sub)
+            c = cp.Campaign(sub, cp.MockTarget(), seed=3,
+                            schedules=12, k_dry=100, bootstrap=4,
+                            runner=runner)
+            c.run()
+            led = store.campaign_dir(sub) / "ledger.jsonl"
+            scheds = [json.loads(x)["ev"]["schedule"]
+                      for x in led.read_text().splitlines()
+                      if json.loads(x)["ev"]["type"] == "scheduled"]
+            boots.append([{k: v for k, v in s.items() if k != "id"}
+                          for s in scheds if s["gen"] == 0][:4])
+        assert boots[0] == boots[1]
 
     def test_fresh_run_refuses_an_existing_ledger(self):
         _mock_campaign("dup", schedules=3).run()
