@@ -418,6 +418,217 @@ def bench_live() -> dict:
             "live_vs_host": round(sustained / host_rate, 2)}
 
 
+def bench_fleet() -> dict:
+    """ISSUE 14: the horizontal serve-checker fleet, priced two ways.
+
+    (a) **2-worker vs 1-worker sustained drain** over the PR 6
+    paced-feeder tenant shape: the same N-tenant register store
+    drained by one lease-less scheduler vs two lease-coordinated
+    workers ticking concurrently (leases partition the tenants; the
+    workers share nothing but the filesystem).  On a small CPU host
+    the two tick loops contend for the GIL and the device, so the
+    ratio is an honest "what does a second local worker buy" number,
+    not a marketing 2x — real fleets put workers on separate hosts.
+
+    (b) **takeover gap**: two lease-owned workers drain paced
+    feeders; one worker's tick loop stops dead (the in-process
+    SIGKILL analog — no close, no release); wall seconds until the
+    survivor's journaled `lease-takeover` lands is
+    `live_fleet_takeover_s` (lease TTL disclosed; the subprocess
+    twin of this scenario is pinned by tests/test_fleet.py kill9).
+
+    CPU-scaled per the PR 11 cpu_count discipline; scaled values ride
+    the metric labels and the bench_cpus tail key."""
+    import shutil
+    import tempfile
+    import threading
+
+    from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu.history import HistoryWAL
+    from jepsen_tpu.live.scheduler import LiveScheduler
+
+    cpus = os.cpu_count() or 1
+    n_ten = 4 if cpus >= 8 else 2
+    ops = int(os.environ.get("JEPSEN_TPU_BENCH_FLEET_OPS",
+                             12_000 if cpus >= 8 else 3_000))
+    ttl = 0.4
+    rootbase = pathlib.Path(tempfile.mkdtemp(prefix="bench-fleet-"))
+
+    def write_store(sub: str, n_ops: int, seed0: int) -> tuple:
+        root = rootbase / sub
+        n_inv = 0
+        for i in range(n_ten):
+            d = root / f"tenant{i}" / "t1"
+            d.mkdir(parents=True)
+            h = make_history(n_ops, 4, seed=seed0 + i)
+            n_inv += sum(1 for o in h if o.is_invoke)
+            wal = HistoryWAL(d / "history.wal", fsync=False)
+            for o in h:
+                wal.append(o)
+            wal.close()
+            (d / "results.json").write_text('{"valid?": true}')
+        return root, n_inv
+
+    def drain_fleet(root, n_workers: int) -> float:
+        """Wall seconds for N lease-coordinated workers (threads) to
+        finish every tenant."""
+        scheds = [LiveScheduler(root, backend="device", scan_every=1,
+                                worker_id=f"bw{i}", lease_ttl=5.0)
+                  for i in range(n_workers)]
+        stop = threading.Event()
+
+        def drive(s):
+            while not stop.is_set():
+                s.tick()
+                if not s.tenants and not s._has_new_bytes():
+                    time.sleep(0.002)
+
+        ths = [threading.Thread(target=drive, args=(s,), daemon=True)
+               for s in scheds]
+        t0 = time.monotonic()
+        for t in ths:
+            t.start()
+        while sum(len(s.finished) for s in scheds) < n_ten \
+                and time.monotonic() - t0 < 1200:
+            time.sleep(0.01)
+        wall = time.monotonic() - t0
+        stop.set()
+        for t in ths:
+            t.join(5)
+        flags = sum(s.flags_total for s in scheds)
+        for s in scheds:
+            s.close()
+        assert flags == 0, "fleet bench flagged a clean tenant"
+        return wall
+
+    try:
+        # warm the plan cache on a small same-shaped store
+        warm_root, _ = write_store("warm", 1_000, 7)
+        ws = LiveScheduler(warm_root, backend="device", scan_every=1)
+        ws.drain()
+        ws.close()
+
+        root1, n_inv = write_store("single", ops, 100)
+        s1 = LiveScheduler(root1, backend="device", scan_every=1)
+        t0 = time.monotonic()
+        s1.drain()
+        one_s = time.monotonic() - t0
+        clean = s1.flags_total == 0
+        s1.close()
+        if not clean:
+            print(json.dumps({"metric": "ERROR: fleet bench single-"
+                              "worker flagged a clean tenant",
+                              "value": 0, "unit": "ops/sec",
+                              "vs_baseline": 0}))
+            return {"error": True}
+        rate1 = n_inv / one_s
+
+        root2, n_inv2 = write_store("fleet", ops, 100)  # same content
+        two_s = drain_fleet(root2, 2)
+        rate2 = n_inv2 / two_s
+
+        # takeover gap: paced feeders, stop one worker dead
+        root3 = rootbase / "takeover"
+        feeders = []
+        for i in range(n_ten):
+            d = root3 / f"rt{i}" / "t1"
+            d.mkdir(parents=True)
+            feeders.append((d, list(make_history(ops // 4, 4,
+                                                 seed=700 + i))))
+        wals = [HistoryWAL(d / "history.wal", fsync=False)
+                for d, _ in feeders]
+        A = LiveScheduler(root3, backend="device", scan_every=1,
+                          worker_id="fA", lease_ttl=ttl)
+        B = LiveScheduler(root3, backend="device", scan_every=1,
+                          worker_id="fB", lease_ttl=ttl)
+        a_stop, all_stop = threading.Event(), threading.Event()
+
+        def drive2(s, gate):
+            while not all_stop.is_set() and not gate.is_set():
+                s.tick()
+
+        tha = threading.Thread(target=drive2, args=(A, a_stop),
+                               daemon=True)
+        thb = threading.Thread(target=drive2,
+                               args=(B, threading.Event()),
+                               daemon=True)
+        tha.start()
+        thb.start()
+        pos = [0] * n_ten
+        t0 = time.monotonic()
+        kill_at = None
+        gap = None
+        while any(pos[i] < len(feeders[i][1])
+                  for i in range(n_ten)) \
+                or kill_at is None or gap is None:
+            el = time.monotonic() - t0
+            target = int(el * 2_000) + 8
+            for i, (_d, fops) in enumerate(feeders):
+                stop_i = min(target, len(fops))
+                while pos[i] < stop_i:
+                    wals[i].append(fops[pos[i]])
+                    pos[i] += 1
+            if kill_at is None and el > 0.5 and A.tenants:
+                a_stop.set()           # the in-process SIGKILL analog
+                tha.join(5)
+                kill_at = time.monotonic()
+            if kill_at is not None and gap is None:
+                for d, _f in feeders:
+                    p = d / "live.jsonl"
+                    if not p.exists():
+                        continue
+                    if any(e.get("type") == "lease-takeover"
+                           for e in telemetry_mod.read_events(p)):
+                        gap = time.monotonic() - kill_at
+                        break
+            if time.monotonic() - t0 > 300:
+                break
+            time.sleep(0.01)
+        for w in wals:
+            w.close()
+        for d, _f in feeders:
+            (d / "results.json").write_text('{"valid?": true}')
+        all_stop.set()
+        thb.join(5)
+        B.drain()
+        A.close()
+        B.close()
+    finally:
+        shutil.rmtree(rootbase, ignore_errors=True)
+
+    if gap is None:
+        print(json.dumps({"metric": "ERROR: fleet bench survivor "
+                          "never took over the dead worker's "
+                          "tenants", "value": 0, "unit": "s",
+                          "vs_baseline": 0}))
+        return {"error": True}
+
+    print(json.dumps({
+        "metric": (f"serve-checker fleet: 2 lease-coordinated "
+                   f"workers vs 1 over {n_ten} tenants x "
+                   f"{ops // 1000}k-op register WALs, sustained "
+                   "drain (same host: GIL/device contention "
+                   "disclosed — fleets scale across hosts)"),
+        "value": round(rate2, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(rate2 / rate1, 2)}), file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"fleet takeover gap after a worker dies "
+                   f"mid-drain (lease ttl {ttl}s, {n_ten} paced "
+                   "tenants; wall from death to the survivor's "
+                   "journaled lease-takeover)"),
+        "value": round(gap, 3),
+        "unit": "seconds",
+        "vs_baseline": round(gap / ttl, 2)}), file=sys.stderr)
+    print(f"# fleet: 1-worker {rate1:.0f} ops/s ({one_s:.2f}s), "
+          f"2-worker {rate2:.0f} ops/s ({two_s:.2f}s); takeover gap "
+          f"{gap:.3f}s at ttl {ttl}s", file=sys.stderr)
+    return {"live_fleet_takeover_s": round(gap, 3),
+            "live_fleet_vs_single": round(rate2 / rate1, 2),
+            "live_fleet_2w_ops_s": round(rate2, 1),
+            "live_fleet_ttl_s": ttl}
+
+
 N_COLD_KEYS = 64         # plan-cache row: small enough that the child
                          # process wall is compile-dominated, same
                          # kernel SHAPES as any 64-key one-shot
@@ -1640,6 +1851,10 @@ def main() -> int:
     if live_stats.get("error"):
         return 1
 
+    fleet_stats = bench_fleet()
+    if fleet_stats.get("error"):
+        return 1
+
     plan_stats = bench_plan_cache()
     if plan_stats.get("error"):
         return 1
@@ -1770,6 +1985,10 @@ def main() -> int:
         # multi-tenant incremental drain + p99 op-append->verdict lag
         # under paced feeders (bench_live)
         **{k: v for k, v in live_stats.items() if v is not None},
+        # the serve-checker fleet (ISSUE 14): 2-worker vs 1-worker
+        # sustained drain + the measured takeover gap after a worker
+        # dies mid-drain (bench_fleet; ttl disclosed)
+        **{k: v for k, v in fleet_stats.items() if v is not None},
         # planner rows (BENCH_r08+): cold-vs-warm PROCESS start with
         # the persistent compiled-plan cache (subprocess-measured,
         # compile seconds child-disclosed) and the double-buffered

@@ -576,7 +576,259 @@ class MockTarget:
         pass
 
 
-TARGETS = {"kvd": KvdTarget, "mock": MockTarget}
+class FleetTarget:
+    """The checker's OWN fault space as a campaign target (ISSUE 14):
+    the SUT is a 2-worker serve-checker fleet draining paced tenants
+    with planted violations, and the nemesis kills / pauses the
+    *workers* — so the campaign searches the lease/fencing/takeover
+    protocol for the exact bug class (lost flags, duplicate flags,
+    stale-epoch publishes) the fleet exists to prevent.
+
+    Window names:
+      * `kill-worker`  — SIGKILL a worker at `at`, respawn at window
+        end (the supervisor-restart shape);
+      * `pause-worker` — SIGSTOP at `at`, SIGCONT at window end (the
+        fencing shape: a paused worker's lease expires, a peer takes
+        over, and the resumed stale-epoch worker must refuse to
+        publish).
+
+    The outcome's anomaly classes describe FLEET behavior: `flag-lost`
+    / `flag-dup` are protocol violations (verdict False — a real
+    finding), `takeover` / `fenced` are coverage classes (the fault
+    actually exercised the handoff path).  Verdict True = every
+    planted violation flagged exactly once."""
+
+    name = "fleet"
+    workloads = ("register",)
+    nemeses = {"kill-worker": None, "pause-worker": None}
+
+    def __init__(self, workers: int = 2, tenants: int = 2,
+                 lease_ttl: float = 0.5, ops_per_tenant: int = 160):
+        self.workers = workers
+        self.tenants = tenants
+        self.lease_ttl = lease_ttl
+        self.ops_per_tenant = ops_per_tenant
+        self._procs: list = []
+
+    # -- worker process management ------------------------------------------
+
+    def _spawn(self, root, i: int):
+        import subprocess
+        import sys as sys_mod
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        argv = [sys_mod.executable, "-m", "jepsen_tpu.cli",
+                "serve-checker", str(root),
+                "--worker-id", f"f{i}",
+                "--lease-ttl", str(self.lease_ttl),
+                "--backend", "host",
+                "--poll-interval", "0.02"]
+        return subprocess.Popen(
+            argv, cwd=repo,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def run(self, schedule: dict, campaign: "Campaign") -> dict:
+        import shutil
+        import signal
+        import tempfile
+        from jepsen_tpu.history import (HistoryWAL, invoke_op,
+                                        ok_op)
+        rng = _rng(campaign.seed, "fleet", schedule["id"])
+        tl = max(schedule["time_limit"], 3 * self.lease_ttl)
+        root = Path(tempfile.mkdtemp(prefix="fleet-campaign-"))
+        outcome = {"verdict": "unknown", "anomalies": [],
+                   "engines": ["fleet"], "lag_bucket": "na",
+                   "overlap": "nowin", "quarantined": False,
+                   "leaked": [], "run": None}
+        try:
+            n_ops = self.ops_per_tenant
+            plant_at = [int(n_ops * rng.uniform(0.45, 0.8))
+                        for _ in range(self.tenants)]
+            dirs, wals = [], []
+            for ti in range(self.tenants):
+                d = root / f"tenant{ti}" / "t1"
+                d.mkdir(parents=True)
+                dirs.append(d)
+                wals.append(HistoryWAL(d / "history.wal",
+                                       fsync=False))
+            self._procs = [self._spawn(root, i)
+                           for i in range(self.workers)]
+            events = []
+            for wi, w in enumerate(schedule["windows"]):
+                victim = wi % self.workers
+                events.append((w["at"], w["name"], "start", victim))
+                events.append((min(w["at"] + w["dur"], tl - 0.05),
+                               w["name"], "stop", victim))
+            events.sort(key=lambda e: e[0])
+
+            t0 = time.monotonic()
+            pos = [0] * self.tenants
+            ev_box = [0]
+            planted_idx = []            # (tenant, op_index)
+
+            def fire_windows():
+                """Apply every fault window whose time has come to
+                its victim worker process."""
+                el = time.monotonic() - t0
+                while ev_box[0] < len(events) \
+                        and el >= events[ev_box[0]][0]:
+                    _at, nm, phase, victim = events[ev_box[0]]
+                    ev_box[0] += 1
+                    proc = self._procs[victim]
+                    try:
+                        if nm == "kill-worker":
+                            if phase == "start":
+                                proc.send_signal(signal.SIGKILL)
+                                proc.wait(5)
+                            else:
+                                self._procs[victim] = self._spawn(
+                                    root, victim + 10)
+                        elif nm == "pause-worker":
+                            proc.send_signal(
+                                signal.SIGSTOP if phase == "start"
+                                else signal.SIGCONT)
+                    except Exception:   # noqa: BLE001
+                        pass
+
+            while any(p < 2 * n_ops for p in pos):
+                el = time.monotonic() - t0
+                fire_windows()
+                # pace the entry stream across the schedule window
+                target = min(2 * n_ops,
+                             int(el / max(tl * 0.6, 0.1)
+                                 * 2 * n_ops) + 4)
+                for ti in range(self.tenants):
+                    while pos[ti] < target:
+                        j = pos[ti] // 2
+                        if pos[ti] % 2 == 0:
+                            f, v = ("read", None) \
+                                if j == plant_at[ti] \
+                                else ("write", j % 5)
+                            wals[ti].append(invoke_op(
+                                0, f, v, index=pos[ti]))
+                        else:
+                            if j == plant_at[ti]:
+                                wals[ti].append(ok_op(
+                                    0, "read", 99, index=pos[ti]))
+                                planted_idx.append((ti, pos[ti]))
+                            else:
+                                wals[ti].append(ok_op(
+                                    0, "write", j % 5,
+                                    index=pos[ti]))
+                        pos[ti] += 1
+                time.sleep(0.01)
+            for ti, w in enumerate(wals):
+                w.close()
+                (dirs[ti] / "results.json").write_text(
+                    '{"valid?": false}')
+            # make sure at least one worker survives to drain
+            if all(p.poll() is not None for p in self._procs):
+                self._procs.append(self._spawn(root, 90))
+            deadline = time.monotonic() + tl + 20 * self.lease_ttl \
+                + 5.0
+            flags = {}
+            while time.monotonic() < deadline:
+                # windows scheduled past the feed still fire here (a
+                # respawn or un-pause can land during the drain)
+                fire_windows()
+                flags = self._collect_flags(dirs)
+                if all((ti, idx) in flags
+                       for ti, idx in planted_idx) \
+                        and self._all_done(dirs):
+                    break
+                time.sleep(0.1)
+            outcome.update(self._reduce(root, dirs, planted_idx,
+                                        flags))
+            outcome["overlap"] = \
+                "all" if schedule["windows"] and all(
+                    w["at"] < tl for w in schedule["windows"]) \
+                else ("some" if schedule["windows"] else "nowin")
+        except Exception as e:          # noqa: BLE001 - harness error
+            outcome["verdict"] = "crashed"
+            outcome["error"] = type(e).__name__
+            log.warning("fleet target crashed on %s",
+                        schedule["id"], exc_info=True)
+        finally:
+            self.reap()
+            shutil.rmtree(root, ignore_errors=True)
+        return outcome
+
+    @staticmethod
+    def _collect_flags(dirs) -> dict:
+        """{(tenant_i, op_index): count} over every live.jsonl."""
+        out: dict = {}
+        for ti, d in enumerate(dirs):
+            p = d / "live.jsonl"
+            if not p.exists():
+                continue
+            for e in telemetry.read_events(p):
+                if e.get("type") == "live-flag":
+                    k = (ti, e.get("op_index"))
+                    out[k] = out.get(k, 0) + 1
+        return out
+
+    @staticmethod
+    def _all_done(dirs) -> bool:
+        for d in dirs:
+            try:
+                with open(d / "live.json") as f:
+                    if not json.load(f).get("done"):
+                        return False
+            except (OSError, json.JSONDecodeError):
+                return False
+        return True
+
+    def _reduce(self, root, dirs, planted_idx, flags) -> dict:
+        anomalies = set()
+        for k in planted_idx:
+            n = flags.get(k, 0)
+            if n == 0:
+                anomalies.add("flag-lost")
+            elif n > 1:
+                anomalies.add("flag-dup")
+        takeover_lag = None
+        for d in dirs:
+            p = d / "live.jsonl"
+            if not p.exists():
+                continue
+            for e in telemetry.read_events(p):
+                if e.get("type") == "lease-takeover":
+                    anomalies.add("takeover")
+                    s = e.get("silent_s")
+                    if isinstance(s, (int, float)):
+                        takeover_lag = max(takeover_lag or 0.0, s)
+        fenced = 0
+        for p in sorted((root / "fleet").glob("*.jsonl")) \
+                if (root / "fleet").is_dir() else []:
+            for e in telemetry.read_events(p):
+                if e.get("type") == "lease-fenced":
+                    fenced += 1
+        if fenced:
+            anomalies.add("fenced")
+        verdict = not ({"flag-lost", "flag-dup"} & anomalies)
+        return {"verdict": verdict,
+                "anomalies": sorted(anomalies),
+                "lag_bucket": lag_bucket(takeover_lag),
+                "fenced": fenced}
+
+    def reap(self) -> None:
+        """Kill every worker this target spawned.  SIGCONT first so a
+        SIGSTOPped child reaps promptly after the kill."""
+        import signal
+        for p in self._procs:
+            try:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGCONT)
+                    p.send_signal(signal.SIGKILL)
+                    p.wait(5)
+            except Exception:           # noqa: BLE001
+                pass
+        self._procs = []
+
+
+TARGETS = {"kvd": KvdTarget, "mock": MockTarget,
+           "fleet": FleetTarget}
 
 
 def suite_target(name: str, test_fn: Callable, registry: dict,

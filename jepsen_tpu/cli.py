@@ -413,10 +413,13 @@ def campaign_cmd_spec(test_fn: Optional[Callable] = None,
                                  "store/campaigns/<name>/)")
         if test_fn is None or registry is None:
             parser.add_argument("--sut", default="kvd",
-                                choices=["kvd", "mock"],
+                                choices=["kvd", "mock", "fleet"],
                                 help="in-tree target: kvd over the "
-                                     "local transport, or the "
-                                     "deterministic mock SUT")
+                                     "local transport, the "
+                                     "deterministic mock SUT, or the "
+                                     "serve-checker fleet itself "
+                                     "(nemesis kills/pauses checker "
+                                     "workers)")
         parser.add_argument("--seed", type=int, default=0)
         parser.add_argument("--schedules", type=int, default=20,
                             metavar="N", help="schedule budget")
@@ -466,19 +469,31 @@ def serve_checker_cmd(opts) -> int:
     incrementally checks windows across tenants in shape-bucketed
     device micro-batches, and writes per-run live.json / live.jsonl
     verdict-so-far surfaces (rendered at /live when --port serves the
-    dashboard from the same process)."""
+    dashboard from the same process).
+
+    Fleet mode (ISSUE 14): `--lease-ttl` turns adoption into
+    per-tenant ownership leases (live/lease.py) so N workers can
+    share one root with fenced, SIGKILL-survivable handoff;
+    `--workers N` runs a local supervisor that spawns N such workers
+    and restarts dead ones with backoff (the dashboard, including
+    `/fleet`, is served from the supervisor)."""
+    if getattr(opts, "workers", 0):
+        return serve_checker_fleet(opts)
     from jepsen_tpu.live.service import CheckerService
-    from jepsen_tpu.ops import planner
     root = Path(opts.store_root)
     if not root.is_dir():
         print(f"no such store root: {root}", file=sys.stderr)
         return 255
-    # persistent compiled-plan cache (ISSUE 8): a restarted daemon
-    # reuses the previous process's XLA executables for every warm
-    # bucket instead of re-paying the cold compile on the request path
-    planner.ensure_persistent_cache(
-        str(root / "plan-cache")
-        if os.environ.get("JEPSEN_TPU_PLAN_CACHE") is None else None)
+    if opts.backend != "host":
+        # persistent compiled-plan cache (ISSUE 8): a restarted daemon
+        # reuses the previous process's XLA executables for every warm
+        # bucket instead of re-paying the cold compile on the request
+        # path (pointless — and a slow import — for the numpy engine)
+        from jepsen_tpu.ops import planner
+        planner.ensure_persistent_cache(
+            str(root / "plan-cache")
+            if os.environ.get("JEPSEN_TPU_PLAN_CACHE") is None
+            else None)
     svc = CheckerService(
         root,
         poll_interval=opts.poll_interval,
@@ -491,17 +506,127 @@ def serve_checker_cmd(opts) -> int:
         max_states=opts.max_states,
         max_window_events=opts.window_events,
         tenant_budget_bytes=int(opts.tenant_budget_mb * (1 << 20)),
-        deadline_s=opts.deadline_s)
+        deadline_s=opts.deadline_s,
+        worker_id=opts.worker_id,
+        lease_ttl=(opts.lease_ttl or None))
     if opts.once:
         ticks = svc.drain()
         sched = svc.scheduler
+        # final snapshots for runs this worker never managed to adopt
+        # (foreign lease, mangled WAL): /fleet and /live must show
+        # them as visibly unowned rather than absent
+        unowned = sched.finalize_unadopted()
+        svc.write_worker_status()
         print(f"drained in {ticks} tick(s): "
               f"{len(sched.tenants) + len(sched.finished)} tenant(s), "
-              f"{sched.flags_total} violation flag(s)",
+              f"{sched.flags_total} violation flag(s)"
+              + (f", {unowned} unowned run(s)" if unowned else ""),
               file=sys.stderr)
         svc.close()
         return 1 if sched.flags_total else 0
     svc.run()
+    return 0
+
+
+def serve_checker_fleet(opts) -> int:
+    """The `--workers N` local supervisor: spawn N single-worker
+    serve-checker children over the same root (each with its own
+    worker id and the shared lease TTL), restart any that die with
+    exponential backoff (reset after a healthy stretch), and serve
+    the dashboard — `/fleet` included — from this process.  The
+    children coordinate purely through lease.json files, so killing
+    the supervisor orphans nothing a peer can't take over."""
+    import signal
+    import subprocess
+    import time as time_mod
+    root = Path(opts.store_root)
+    if not root.is_dir():
+        print(f"no such store root: {root}", file=sys.stderr)
+        return 255
+    n = int(opts.workers)
+    ttl = opts.lease_ttl or 5.0
+    prefix = opts.worker_id or "w"
+
+    def child_argv(i: int) -> list:
+        argv = [sys.executable, "-m", "jepsen_tpu.cli",
+                "serve-checker", str(root),
+                "--worker-id", f"{prefix}{i}",
+                "--lease-ttl", str(ttl),
+                "--poll-interval", str(opts.poll_interval),
+                "--model", opts.model,
+                "--backend", opts.backend,
+                "--max-open-bits", str(opts.max_open_bits),
+                "--max-states", str(opts.max_states),
+                "--window-events", str(opts.window_events),
+                "--tenant-budget-mb", str(opts.tenant_budget_mb)]
+        if opts.strict_init:
+            argv.append("--strict-init")
+        if opts.deadline_s is not None:
+            argv += ["--deadline-s", str(opts.deadline_s)]
+        return argv
+
+    web_srv = None
+    if opts.port:
+        from jepsen_tpu import store as store_mod
+        from jepsen_tpu import web
+        store_mod.BASE = root
+        web_srv = web.serve(host=opts.host, port=opts.port,
+                            block=False)
+        print(f"fleet dashboard on http://{opts.host}:"
+              f"{web_srv.server_address[1]}/fleet", file=sys.stderr)
+
+    children: list = [None] * n
+    backoff = [0.5] * n
+    next_start = [0.0] * n
+    started_at = [0.0] * n
+    stop = False
+
+    def shutdown(*_a):
+        nonlocal stop
+        stop = True
+
+    try:
+        signal.signal(signal.SIGTERM, shutdown)
+    except ValueError:                  # not the main thread (tests)
+        pass
+    try:
+        while not stop:
+            now = time_mod.monotonic()
+            for i in range(n):
+                c = children[i]
+                if c is not None and c.poll() is None:
+                    if now - started_at[i] > 30.0:
+                        backoff[i] = 0.5     # healthy: reset backoff
+                    continue
+                if c is not None:
+                    log.warning("fleet worker %s%d exited rc=%s; "
+                                "restarting in %.1fs", prefix, i,
+                                c.returncode, backoff[i])
+                    next_start[i] = max(next_start[i],
+                                        now + backoff[i])
+                    backoff[i] = min(backoff[i] * 2, 10.0)
+                    children[i] = None
+                if now >= next_start[i]:
+                    children[i] = subprocess.Popen(child_argv(i))
+                    started_at[i] = time_mod.monotonic()
+            time_mod.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        for c in children:
+            if c is not None and c.poll() is None:
+                c.terminate()
+        deadline = time_mod.monotonic() + 10
+        for c in children:
+            if c is None:
+                continue
+            try:
+                c.wait(max(deadline - time_mod.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                c.kill()
+        if web_srv is not None:
+            web_srv.shutdown()
+            web_srv.server_close()
     return 0
 
 
@@ -549,7 +674,26 @@ def serve_checker_cmd_spec() -> dict:
         parser.add_argument("--once", action="store_true",
                             help="drain everything currently on disk "
                                  "and exit (exit 1 if any violation "
-                                 "was flagged)")
+                                 "was flagged); runs never adopted "
+                                 "get a final unowned live.json")
+        parser.add_argument("--worker-id", default=None,
+                            metavar="ID",
+                            help="fleet worker identity for lease "
+                                 "ownership (default: w<pid>; with "
+                                 "--workers, the id prefix)")
+        parser.add_argument("--lease-ttl", type=float, default=0.0,
+                            metavar="SECONDS",
+                            help="per-tenant ownership leases with "
+                                 "this TTL (fleet mode: N workers "
+                                 "may share the root; 0 disables "
+                                 "— classic single daemon)")
+        parser.add_argument("--workers", type=int, default=0,
+                            metavar="N",
+                            help="local fleet supervisor: spawn N "
+                                 "lease-coordinated workers over the "
+                                 "root and restart dead ones with "
+                                 "backoff (implies --lease-ttl, "
+                                 "default 5s)")
 
     return {"serve-checker": {
         "opts": add_opts, "run": serve_checker_cmd,

@@ -30,6 +30,11 @@ daemon that flags violations *while runs are still executing*:
   * **service** — the daemon: `python -m jepsen_tpu.cli serve-checker
     <store-root>`, with an optional embedded web dashboard exposing
     `/live` pages and the Prometheus `/metrics` gauges.
+  * **lease** — fleet mode (ISSUE 14): per-tenant ownership leases
+    (atomic `lease.json` with epoch fencing tokens, monotonic expiry,
+    and frontier-carrying safe cursors) let N workers share one store
+    root with SIGKILL-survivable, exactly-once-flag handoff — see
+    docs/live-checker.md §fleet and `cli serve-checker --workers`.
 
 Live verdicts are advisory ("violation-so-far" / "clean-so-far"): the
 post-hoc `analyze()` remains the authoritative verdict.  The live

@@ -1,0 +1,321 @@
+"""Per-tenant ownership leases for the serve-checker fleet.
+
+One `lease.json` per run dir is the whole coordination surface: N
+workers over one store root never talk to each other, they talk to the
+filesystem with the same atomicity discipline the WAL and `live.json`
+already rely on.  A lease carries
+
+    {"owner": "w1", "epoch": 3, "ttl": 1.0,
+     "cursor": {"offset": 4096, "seq": 17},
+     "beat": 42, "stamp": <wall s>, "deadline": <wall s>,
+     "released": false}
+
+* **owner / epoch** — who may publish for this tenant, and the fencing
+  token: every takeover bumps `epoch`, and a writer whose in-memory
+  epoch is behind the on-disk one must refuse to publish (the
+  split-brain bug class Jepsen analyses keep finding in real lock
+  services — a verifier must not ship it).
+* **cursor** — the `history.follow` resume point (byte offset + record
+  seq) last known *safe*: every op before it was ingested, checked,
+  and its events published.  A takeover resumes exactly here; anything
+  between the cursor and the dead worker's true progress is re-checked
+  and de-duplicated against the tenant's own `live.jsonl` (flags are
+  exactly-once because re-emission is suppressed, not because the
+  cursor is always fresh).
+* **beat / stamp / deadline** — liveness.  `beat` increments on every
+  renewal so the file's bytes change; **expiry is judged by monotonic
+  observation, not by comparing wall clocks**: a worker considers a
+  foreign lease expired only after watching its bytes stay unchanged
+  for `ttl` seconds of the *observer's own* monotonic clock
+  (`LeaseObserver`).  `stamp`/`deadline` are advisory wall stamps for
+  operators and the `/fleet` page — a skewed clock can make them lie,
+  and nothing correctness-critical reads them.
+* **released** — a clean shutdown marks the lease released so the next
+  worker can take over immediately instead of waiting out the TTL.
+
+Atomicity:
+
+* **fresh acquire** — write a unique tmp file (fsynced), then
+  `os.link(tmp, lease.json)`: hard-linking onto an existing path
+  fails, so exactly one of N racing workers wins.
+* **takeover** — `os.rename(lease.json, <claim>)` first: exactly one
+  claimant gets the source (the losers see ENOENT), verifies the
+  claimed bytes still match what it observed expiring, then publishes
+  the successor lease (epoch+1) with an atomic replace.  A fresh
+  acquirer that slips into the empty window writes epoch 1 and is
+  immediately fenced by the claimant's higher epoch on its next check.
+* **renewal** — read-verify-replace.  A paused-then-resumed worker
+  whose lease was taken over sees a higher epoch and learns it is
+  fenced; conversely a lower on-disk epoch (the pathological
+  stale-clobber race) is repaired by the rightful higher-epoch owner.
+
+A torn / unparseable `lease.json` is **treated as expired, not as a
+crash**: the claim-rename path still serializes claimants, and the
+successor starts from cursor (0, 0) — re-checking from the top is
+merely lenient (live.jsonl de-dup keeps flags exactly-once).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import logging
+import os
+import time
+import zlib
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger("jepsen.live")
+
+LEASE_FILE = "lease.json"
+
+
+def lease_path(run_dir) -> Path:
+    return Path(run_dir) / LEASE_FILE
+
+
+@dataclasses.dataclass
+class Lease:
+    """One parsed lease.json (or the corrupt placeholder for a torn
+    one — `corrupt` leases are expired by definition)."""
+
+    owner: Optional[str] = None
+    epoch: int = 0
+    ttl: float = 0.0
+    offset: int = 0
+    seq: int = 0
+    beat: int = 0
+    stamp: Optional[float] = None
+    deadline: Optional[float] = None
+    released: bool = False
+    state: Optional[dict] = None        # checker frontier @ cursor
+    corrupt: Optional[str] = None       # why the file failed to parse
+    fp: int = 0                         # crc32 of the raw bytes
+
+    @property
+    def cursor(self) -> tuple:
+        return (self.offset, self.seq)
+
+    def to_json(self) -> dict:
+        out = {"owner": self.owner, "epoch": self.epoch,
+               "ttl": self.ttl,
+               "cursor": {"offset": self.offset, "seq": self.seq},
+               "beat": self.beat, "stamp": self.stamp,
+               "deadline": self.deadline, "released": self.released}
+        if self.state is not None:
+            out["state"] = self.state
+        return out
+
+
+def read(run_dir) -> Optional[Lease]:
+    """The on-disk lease, None when absent, or a `corrupt`-marked
+    Lease for a torn/partial file (expired, not a crash)."""
+    p = lease_path(run_dir)
+    try:
+        raw = p.read_bytes()
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        return Lease(corrupt=f"unreadable: {e}")
+    fp = zlib.crc32(raw)
+    try:
+        d = json.loads(raw)
+        if not isinstance(d, dict):
+            raise ValueError("not a dict")
+        cur = d.get("cursor") or {}
+        return Lease(owner=d.get("owner"),
+                     epoch=int(d.get("epoch") or 0),
+                     ttl=float(d.get("ttl") or 0.0),
+                     offset=int(cur.get("offset") or 0),
+                     seq=int(cur.get("seq") or 0),
+                     beat=int(d.get("beat") or 0),
+                     stamp=d.get("stamp"),
+                     deadline=d.get("deadline"),
+                     released=bool(d.get("released")),
+                     state=d.get("state")
+                     if isinstance(d.get("state"), dict) else None,
+                     fp=fp)
+    except (ValueError, TypeError) as e:
+        return Lease(corrupt=f"torn/unparseable lease.json: {e}",
+                     fp=fp)
+
+
+_tmp_seq = itertools.count()
+
+
+def _write_tmp(run_dir, ls: Lease, tag: str) -> Path:
+    # unique per call: concurrent acquirers in one process (threads)
+    # must not clobber or unlink each other's staging file
+    tmp = Path(run_dir) / (f".lease.{tag}.{os.getpid()}."
+                           f"{next(_tmp_seq)}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(ls.to_json(), f)
+        f.flush()
+        os.fsync(f.fileno())
+    return tmp
+
+
+def try_acquire(run_dir, worker_id: str, ttl: float,
+                now: Optional[float] = None) -> Optional[Lease]:
+    """Fresh acquire of a never-leased run dir: exactly one of N
+    racing workers wins (hard-link onto the lease path fails for the
+    rest).  Returns the owned Lease or None."""
+    now = time.time() if now is None else now
+    ls = Lease(owner=worker_id, epoch=1, ttl=ttl, beat=0,
+               stamp=now, deadline=now + ttl)
+    tmp = _write_tmp(run_dir, ls, "acq")
+    try:
+        os.link(tmp, lease_path(run_dir))
+        return ls
+    except FileExistsError:
+        return None
+    except OSError as e:                # exotic fs without link(2)
+        log.warning("lease link failed for %s: %s", run_dir, e)
+        return None
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def takeover(run_dir, worker_id: str, ttl: float, observed: Lease,
+             now: Optional[float] = None) -> Optional[Lease]:
+    """Claim an expired (or torn, or released) lease: rename it to a
+    unique claim path — exactly one claimant gets the source — verify
+    the claimed bytes are still the ones observed expiring, and
+    publish the epoch+1 successor carrying the recorded cursor.
+    Returns the owned Lease or None (lost the race, or the holder
+    renewed between observation and claim)."""
+    now = time.time() if now is None else now
+    lp = lease_path(run_dir)
+    claim = Path(run_dir) / f".lease.claim.{worker_id}.{os.getpid()}"
+    try:
+        os.rename(lp, claim)
+    except FileNotFoundError:
+        return None                     # someone else claimed first
+    except OSError as e:
+        log.warning("lease claim failed for %s: %s", run_dir, e)
+        return None
+    try:
+        try:
+            claimed_fp = zlib.crc32(claim.read_bytes())
+        except OSError:
+            claimed_fp = 0
+        if observed.fp and claimed_fp != observed.fp:
+            # the holder wrote between our read and our claim: it is
+            # alive — put the lease back (link-if-absent: if a third
+            # party already published a new one, leave theirs)
+            try:
+                os.link(claim, lp)
+            except OSError:
+                pass
+            return None
+        ls = Lease(owner=worker_id, epoch=observed.epoch + 1, ttl=ttl,
+                   offset=observed.offset, seq=observed.seq, beat=0,
+                   stamp=now, deadline=now + ttl,
+                   state=observed.state)
+        tmp = _write_tmp(run_dir, ls, "tak")
+        try:
+            os.replace(tmp, lp)
+        finally:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        return ls
+    finally:
+        try:
+            os.unlink(claim)
+        except OSError:
+            pass
+
+
+def renew(run_dir, mine: Lease, *, cursor: Optional[tuple] = None,
+          state: Optional[dict] = None,
+          now: Optional[float] = None,
+          released: bool = False) -> Optional[Lease]:
+    """Heartbeat: refresh the deadline (and optionally the safe
+    cursor + checker-frontier state) of a lease this worker believes
+    it owns.  Read-verify first: a higher on-disk epoch (or another
+    owner at our epoch) means we were fenced — return None and
+    PUBLISH NOTHING; a lower on-disk epoch is a stale clobber we
+    repair.  Returns the renewed Lease, or None when fenced."""
+    now = time.time() if now is None else now
+    disk = read(run_dir)
+    if disk is not None and not disk.corrupt:
+        if disk.epoch > mine.epoch or (disk.epoch == mine.epoch
+                                       and disk.owner != mine.owner):
+            return None                 # fenced
+    # cursor and state are a PAIR (the frontier is only meaningful at
+    # the cursor it was captured beside): when the caller supplies a
+    # cursor, the supplied state — even None — replaces the old one
+    nxt = Lease(owner=mine.owner, epoch=mine.epoch, ttl=mine.ttl,
+                offset=(cursor[0] if cursor else mine.offset),
+                seq=(cursor[1] if cursor else mine.seq),
+                beat=mine.beat + 1, stamp=now,
+                deadline=now + mine.ttl, released=released,
+                state=state if cursor else mine.state)
+    tmp = _write_tmp(run_dir, nxt, "ren")
+    try:
+        os.replace(tmp, lease_path(run_dir))
+    except OSError as e:
+        log.warning("lease renew failed for %s: %s", run_dir, e)
+        return None
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return nxt
+
+
+def check_fence(run_dir, mine: Lease) -> bool:
+    """True while this worker's (owner, epoch) still matches the disk
+    — the cheap pre-publish guard.  Missing, torn, released, or
+    reassigned leases all read as fenced (publishing is refused unless
+    ownership is positively confirmed)."""
+    disk = read(run_dir)
+    if disk is None or disk.corrupt or disk.released:
+        return False
+    return disk.owner == mine.owner and disk.epoch == mine.epoch
+
+
+class LeaseObserver:
+    """Monotonic expiry tracking for leases this worker does NOT own.
+
+    Wall stamps in lease files are advisory: clocks skew, and a writer
+    stamping the future must not hold a tenant hostage (nor one
+    stamping the past lose it while alive).  Instead the observer
+    watches the file's *bytes*: a renewal changes them (`beat`), so
+    "unchanged for >= ttl of my own monotonic clock" is a
+    skew-immune liveness judgment.  First sight of a lease starts its
+    silence clock at zero — worst-case takeover delay is one TTL plus
+    one scan interval past the holder's death."""
+
+    def __init__(self, mono=time.monotonic):
+        self.mono = mono
+        self._seen: dict = {}           # key -> (fp, first_seen_mono)
+
+    def silent_s(self, key, ls: Lease) -> float:
+        """Seconds this lease's bytes have been observed unchanged."""
+        now = self.mono()
+        prev = self._seen.get(key)
+        if prev is None or prev[0] != ls.fp:
+            self._seen[key] = (ls.fp, now)
+            return 0.0
+        return now - prev[1]
+
+    def expired(self, key, ls: Lease, default_ttl: float) -> bool:
+        """Corrupt and released leases are expired immediately; live
+        ones only after ttl of observed silence."""
+        if ls.corrupt or ls.released:
+            self.silent_s(key, ls)      # keep the clock primed
+            return True
+        ttl = ls.ttl if ls.ttl > 0 else default_ttl
+        return self.silent_s(key, ls) >= ttl
+
+    def forget(self, key) -> None:
+        self._seen.pop(key, None)

@@ -29,6 +29,25 @@ history.follow) to the flag emission — true op-append→flag latency
 when checker and run share a clock; `live_window_lag_seconds` tracks
 the same quantity for every checked window (clean ones included), and
 its p99 is the bench.py headline for the service.
+
+**Fleet mode** (`worker_id` + `lease_ttl`, ISSUE 14): N schedulers
+over one root partition the tenants through per-run `lease.json`
+ownership leases (live/lease.py).  Adoption becomes
+acquire-under-lease (a worker only acquires while under its
+`fleet_budget_bytes`), leases are renewed with the tenant's *safe*
+WAL cursor (every op before it checked AND published), an expired
+lease — judged by monotonic observed silence, wall stamps advisory —
+is taken over with an epoch bump and resumed from that cursor, and
+every publish is fenced: a stale-epoch worker refuses to write,
+drops the tenant, and counts `live_lease_fenced_total`.  Flags stay
+exactly-once across takeovers because the successor de-duplicates
+against the flags already journaled in the tenant's `live.jsonl`
+(whose sequence it resumes rather than restarts).  Lease transitions
+are durable `lease-acquire` / `lease-expire` / `lease-takeover`
+events in the tenant's `live.jsonl`; `lease-fenced` goes to the
+stale worker's own `store/fleet/<worker>.jsonl` log (the tenant log
+is strictly single-writer-under-lease — a fenced writer touching it
+would race the new owner's sequence).
 """
 
 from __future__ import annotations
@@ -36,6 +55,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Optional
@@ -44,6 +64,7 @@ from jepsen_tpu import history as history_mod
 from jepsen_tpu import models as models_mod
 from jepsen_tpu import telemetry
 from jepsen_tpu.live import engine as engine_mod
+from jepsen_tpu.live import lease as lease_mod
 from jepsen_tpu.live.windows import Tenant
 from jepsen_tpu.ops.runner import ResilientRunner
 
@@ -52,6 +73,12 @@ log = logging.getLogger("jepsen.live")
 # Detection-lag histogram buckets: sub-ms through tens of seconds.
 LAG_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# Store-root entries that are bookkeeping, never run dirs: the same
+# exclusion class store.tests() applies (campaigns/ci from PR 11,
+# fleet/ worker status + lease bookkeeping from ISSUE 14).
+NON_RUN_DIRS = ("ci", "current", "latest", "campaigns", "plan-cache",
+                "fleet")
 
 
 def _default_model(name: Optional[str]):
@@ -77,7 +104,11 @@ class LiveScheduler:
                  max_batch_records: int = 4096,
                  deadline_s: Optional[float] = None,
                  scan_every: int = 10,
-                 clock=time.time):
+                 clock=time.time,
+                 worker_id: Optional[str] = None,
+                 lease_ttl: Optional[float] = None,
+                 fleet_budget_bytes: int = 32 << 20,
+                 mono=time.monotonic):
         self.root = Path(root)
         self.default_model = model
         self.backend_opt = backend
@@ -99,6 +130,24 @@ class LiveScheduler:
         self._dispatch_seq = 0
         self.flags_total = 0
         self.last_detection_lag_s: Optional[float] = None
+        # -- fleet mode (ISSUE 14): lease-owned tenants ------------------
+        self.worker_id = worker_id or f"w{os.getpid()}"
+        self.lease_ttl = float(lease_ttl) if lease_ttl else None
+        self.fleet_budget_bytes = fleet_budget_bytes
+        self.mono = mono
+        self._leases: dict = {}        # key -> owned lease_mod.Lease
+        self._lease_lock = threading.Lock()
+        self._observer = lease_mod.LeaseObserver(mono=mono)
+        self._fence_checked: dict = {}  # key -> mono stamp of last ok
+        self._last_renew = mono()
+        self._last_discover = mono()
+        self.unadopted: dict = {}      # key -> why (for /fleet + --once)
+        self.takeovers = 0
+        self.fenced_writes = 0
+        self.max_takeover_lag_s = 0.0
+        if self.lease_ttl:
+            telemetry.REGISTRY.counter(
+                "live_fleet_workers_total").inc()
 
     # -- backend resolution --------------------------------------------------
 
@@ -119,32 +168,176 @@ class LiveScheduler:
 
     # -- discovery -----------------------------------------------------------
 
-    def discover(self) -> int:
-        """Adopt new run dirs under the root.  Returns tenants added."""
-        added = 0
+    def _run_dirs(self):
+        """(key, ts_dir) for every run dir under the root carrying a
+        history.wal — bookkeeping dirs (NON_RUN_DIRS) skipped."""
         if not self.root.is_dir():
-            return 0
+            return
         for name_dir in sorted(self.root.iterdir()):
             if not name_dir.is_dir() or name_dir.is_symlink() \
-                    or name_dir.name in ("ci", "current", "latest"):
+                    or name_dir.name in NON_RUN_DIRS:
                 continue
             for ts_dir in sorted(p for p in name_dir.iterdir()
                                  if p.is_dir()
                                  and not p.is_symlink()):
-                key = (name_dir.name, ts_dir.name)
-                if key in self.tenants or key in self.finished:
-                    continue
-                if not (ts_dir / "history.wal").exists():
-                    continue
-                self.tenants[key] = Tenant(
-                    name_dir.name, ts_dir.name, ts_dir,
-                    self._model_for(ts_dir), **self.lane_opts)
-                self._logs[key] = telemetry.EventLog(
-                    ts_dir / "live.jsonl")
-                self._emit(key, "live-adopt", durable=True,
-                           model=type(self.tenants[key].model).__name__)
+                if (ts_dir / "history.wal").exists():
+                    yield (name_dir.name, ts_dir.name), ts_dir
+
+    def discover(self) -> int:
+        """Adopt new run dirs under the root.  Returns tenants added.
+        In fleet mode adoption is acquire-under-lease: a run dir is
+        only adopted once this worker owns its lease (fresh acquire,
+        or takeover of an expired/torn/released one), and only while
+        this worker's tracked bytes leave room under its fleet
+        budget."""
+        added = 0
+        for key, ts_dir in self._run_dirs():
+            if key in self.tenants or key in self.finished:
+                continue
+            if not self.lease_ttl:
+                self._adopt(key, ts_dir)
                 added += 1
+                continue
+            try:
+                owned, via = self._acquire(key, ts_dir)
+            except Exception:  # noqa: BLE001 - one bad dir must not
+                log.warning("lease acquire failed for %s", ts_dir,
+                            exc_info=True)   # wedge the scan
+                self.unadopted[key] = "acquire error"
+                continue
+            if owned is None:
+                continue
+            self._adopt(key, ts_dir, owned=owned, via=via)
+            added += 1
         return added
+
+    def _owned_bytes(self) -> int:
+        """What this worker is already on the hook for: tracked
+        in-memory bytes plus each owned tenant's unread on-disk WAL
+        backlog (at adoption time the former is always zero — the
+        backlog is what 'can I afford another tenant' must price)."""
+        total = 0
+        for t in self.tenants.values():
+            total += t.nbytes
+            try:
+                total += max((t.run_dir / "history.wal")
+                             .stat().st_size - t.offset, 0)
+            except OSError:
+                pass
+        return total
+
+    def _acquire(self, key, ts_dir):
+        """(lease, how) when this worker should adopt `key`; (None, _)
+        otherwise.  `how` is 'acquire' or 'takeover'."""
+        ls = lease_mod.read(ts_dir)
+        if ls is not None and not ls.corrupt \
+                and ls.owner == self.worker_id \
+                and key in self.tenants:
+            return None, None           # already ours and adopted
+        if self._owned_bytes() > self.fleet_budget_bytes:
+            self.unadopted[key] = "over fleet byte budget"
+            return None, None           # can't afford another tenant
+        if ls is None:
+            got = lease_mod.try_acquire(ts_dir, self.worker_id,
+                                        self.lease_ttl,
+                                        now=self.clock())
+            if got is None:
+                self.unadopted[key] = "lost an acquire race"
+                return None, None
+            self.unadopted.pop(key, None)
+            telemetry.REGISTRY.counter(
+                "live_lease_acquired_total").inc()
+            return got, "acquire"
+        silent = self._observer.silent_s(key, ls)
+        if not self._observer.expired(key, ls, self.lease_ttl):
+            self.unadopted[key] = (f"lease held by {ls.owner} "
+                                   f"(epoch {ls.epoch})")
+            return None, None
+        got = lease_mod.takeover(ts_dir, self.worker_id,
+                                 self.lease_ttl, ls,
+                                 now=self.clock())
+        if got is None:
+            self.unadopted[key] = "lost a takeover race"
+            return None, None
+        self.unadopted.pop(key, None)
+        self._observer.forget(key)
+        self.takeovers += 1
+        lag = max(silent, 0.0)
+        self.max_takeover_lag_s = max(self.max_takeover_lag_s, lag)
+        telemetry.REGISTRY.counter("live_lease_takeover_total").inc()
+        telemetry.REGISTRY.counter("live_lease_expired_total").inc()
+        telemetry.REGISTRY.gauge(
+            "live_lease_max_takeover_lag_seconds").set(
+            self.max_takeover_lag_s)
+        got._takeover_of = ls           # for the journal entry
+        got._silent_s = lag
+        return got, "takeover"
+
+    def _adopt(self, key, ts_dir, owned=None, via=None) -> None:
+        t = self.tenants[key] = Tenant(
+            key[0], key[1], ts_dir,
+            self._model_for(ts_dir), **self.lane_opts)
+        # takeovers resume the tenant log's sequence (and truncate a
+        # torn tail) instead of restarting at 0, so the timeline stays
+        # one readable log across owners; flags already journaled are
+        # loaded for exactly-once de-duplication
+        resume = bool(self.lease_ttl)
+        if resume and (ts_dir / "live.jsonl").exists():
+            try:
+                for ev in telemetry.read_events(ts_dir / "live.jsonl"):
+                    if ev.get("type") == "live-flag":
+                        t.flags_emitted.add((ev.get("lane"),
+                                             ev.get("op_index")))
+            except Exception:  # noqa: BLE001 - dedupe is best-effort
+                pass
+        self._logs[key] = telemetry.EventLog(
+            ts_dir / "live.jsonl", resume=resume)
+        if owned is not None:
+            with self._lease_lock:
+                self._leases[key] = owned
+            self._fence_checked[key] = self.mono()
+            # resume from the recorded safe cursor, seeding the lanes
+            # with the lease-carried checker frontier (captured at
+            # that exact cursor).  No restorable frontier -> re-check
+            # from byte 0 instead: leniently resuming wild mid-stream
+            # could MISS a violation whose constraining writes predate
+            # the cursor, and a full replay only costs time (flags
+            # de-dup against live.jsonl, so still exactly-once).
+            restored = 0
+            if owned.state and (owned.offset or owned.seq):
+                restored = t.restore_frontier(owned.state)
+            if restored or not (owned.offset or owned.seq):
+                t.offset, t.seq = owned.offset, owned.seq
+                t._record_n = owned.seq
+                t.safe_offset, t.safe_seq = owned.offset, owned.seq
+                t.safe_state = owned.state
+            else:
+                telemetry.REGISTRY.counter(
+                    "live_fleet_full_replays_total").inc()
+        self._emit(key, "live-adopt", durable=True,
+                   model=type(t.model).__name__)
+        if via == "acquire":
+            self._emit(key, "lease-acquire", durable=True,
+                       worker=self.worker_id, epoch=owned.epoch,
+                       ttl=owned.ttl)
+        elif via == "takeover":
+            old = getattr(owned, "_takeover_of", None)
+            self._emit(key, "lease-expire", durable=True,
+                       worker=getattr(old, "owner", None),
+                       epoch=getattr(old, "epoch", None),
+                       reason=(getattr(old, "corrupt", None)
+                               or ("released"
+                                   if getattr(old, "released", False)
+                                   else "heartbeat silent")),
+                       silent_s=round(
+                           getattr(owned, "_silent_s", 0.0), 3))
+            self._emit(key, "lease-takeover", durable=True,
+                       worker=self.worker_id, epoch=owned.epoch,
+                       from_worker=getattr(old, "owner", None),
+                       cursor={"offset": owned.offset,
+                               "seq": owned.seq},
+                       silent_s=round(
+                           getattr(owned, "_silent_s", 0.0), 3))
 
     def _model_for(self, run_dir: Path):
         try:
@@ -165,6 +358,123 @@ class LiveScheduler:
         lg = self._logs.get(key)
         if lg is not None:
             lg.append({"type": type_, **fields}, durable=durable)
+
+    # -- fencing (fleet mode) ------------------------------------------------
+
+    def _fenced(self, key, fresh: bool = False) -> bool:
+        """True when this worker may no longer publish for `key`.
+        Cached reads are re-validated after a quarter-TTL — measured
+        on OUR monotonic clock, so a SIGSTOP/resume gap (the exact
+        split-brain window) invalidates the cache by construction.
+        `fresh=True` forces a re-read (the pre-flag hard check)."""
+        if not self.lease_ttl:
+            return False
+        with self._lease_lock:
+            mine = self._leases.get(key)
+        if mine is None:
+            return True
+        now = self.mono()
+        if not fresh:
+            last = self._fence_checked.get(key)
+            if last is not None and now - last < self.lease_ttl / 4:
+                return False
+        t = self.tenants.get(key)
+        if t is None or not lease_mod.check_fence(t.run_dir, mine):
+            return True
+        self._fence_checked[key] = now
+        return False
+
+    def _drop_fenced(self, key) -> None:
+        """A stale-epoch worker refusing to publish: release the
+        tenant WITHOUT writing anything into its run dir (the new
+        owner holds the log now), count it, and journal the refusal
+        into this worker's own fleet log."""
+        self.fenced_writes += 1
+        telemetry.REGISTRY.counter("live_lease_fenced_total").inc()
+        with self._lease_lock:
+            mine = self._leases.pop(key, None)
+        self._fence_checked.pop(key, None)
+        self._observer.forget(key)
+        t = self.tenants.pop(key, None)
+        lg = self._logs.pop(key, None)
+        if lg is not None:
+            lg.close()
+        log.warning("worker %s fenced off %s/%s (stale epoch %s); "
+                    "publish refused, tenant dropped", self.worker_id,
+                    key[0], key[1],
+                    getattr(mine, "epoch", "?"))
+        self._fleet_log("lease-fenced", tenant=f"{key[0]}/{key[1]}",
+                        epoch=getattr(mine, "epoch", None),
+                        offset=getattr(t, "offset", None))
+
+    _fleet_logger = None
+
+    def _fleet_log(self, type_: str, **fields) -> None:
+        """Append to this worker's own store/fleet/<worker>.jsonl —
+        the single-writer home for events about the WORKER (fencing
+        refusals) rather than a tenant it may no longer own."""
+        if not self.lease_ttl:
+            return
+        try:
+            if self._fleet_logger is None:
+                d = self.root / "fleet"
+                d.mkdir(parents=True, exist_ok=True)
+                self._fleet_logger = telemetry.EventLog(
+                    d / f"{self.worker_id}.jsonl", resume=True)
+            self._fleet_logger.append(
+                {"type": type_, "worker": self.worker_id, **fields},
+                durable=True)
+        except Exception:  # noqa: BLE001 - bookkeeping must not wedge
+            log.debug("fleet log write failed", exc_info=True)
+
+    def renew_leases(self, force: bool = False) -> int:
+        """Heartbeat: re-stamp every owned lease with its tenant's
+        safe cursor.  Called from the tick loop (quarter-TTL cadence)
+        and from the service's heartbeat thread (so a long device
+        dispatch cannot silently expire us).  A failed renewal means
+        we were fenced — the tenant is dropped without publishing.
+        Returns leases renewed."""
+        if not self.lease_ttl:
+            return 0
+        now = self.mono()
+        if not force and now - self._last_renew < self.lease_ttl / 4:
+            return 0
+        self._last_renew = now
+        renewed = 0
+        with self._lease_lock:
+            items = list(self._leases.items())
+        for key, mine in items:
+            t = self.tenants.get(key)
+            cursor = (t.safe_offset, t.safe_seq) if t is not None \
+                else None
+            nxt = lease_mod.renew(t.run_dir if t is not None
+                                  else self.root / key[0] / key[1],
+                                  mine, cursor=cursor,
+                                  state=getattr(t, "safe_state", None),
+                                  now=self.clock())
+            if nxt is None:
+                self._drop_fenced(key)
+                continue
+            with self._lease_lock:
+                if key in self._leases:
+                    self._leases[key] = nxt
+            self._fence_checked[key] = self.mono()
+            telemetry.REGISTRY.counter(
+                "live_lease_renewals_total").inc()
+            renewed += 1
+        return renewed
+
+    def _release_lease(self, key, t) -> None:
+        """Mark an owned lease released (clean handoff: the next
+        worker may take over immediately, no TTL wait)."""
+        with self._lease_lock:
+            mine = self._leases.pop(key, None)
+        self._fence_checked.pop(key, None)
+        if mine is not None and t is not None:
+            lease_mod.renew(t.run_dir, mine,
+                            cursor=(t.safe_offset, t.safe_seq),
+                            state=getattr(t, "safe_state", None),
+                            now=self.clock(), released=True)
 
     # -- ingest --------------------------------------------------------------
 
@@ -267,9 +577,10 @@ class LiveScheduler:
                 tenants=len(d["tenants"]))
             telemetry.attach_dispatch([], rec)
         seen_pairs = set()
+        fenced_keys = set()
         now = self.clock()
         for (key, lane_key, lane, w), v in zip(items, verdicts):
-            if not isinstance(v, dict):
+            if not isinstance(v, dict) or key in fenced_keys:
                 continue
             if v.get("quarantined"):
                 lane.saturated = ("live checking quarantined: "
@@ -304,6 +615,23 @@ class LiveScheduler:
                        lag_s=round(lag, 6) if lag is not None
                        else None)
             if flag is not None:
+                # fleet discipline around the one emission that MUST
+                # be exactly-once: a takeover replaying from the safe
+                # cursor suppresses flags already journaled, and a
+                # stale-epoch worker re-reads the lease (fresh, not
+                # cached) and refuses to publish at all
+                t = self.tenants.get(key)
+                fkey = (repr(lane_key), flag.get("op_index"))
+                if t is not None and fkey in t.flags_emitted:
+                    telemetry.REGISTRY.counter(
+                        "live_fleet_flags_suppressed_total").inc()
+                    continue
+                if self._fenced(key, fresh=True):
+                    fenced_keys.add(key)
+                    self._drop_fenced(key)
+                    continue
+                if t is not None:
+                    t.flags_emitted.add(fkey)
                 det = (now - flag["wall"]) if flag.get("wall") \
                     else lag
                 self.flags_total += 1
@@ -337,6 +665,13 @@ class LiveScheduler:
             "budget_bytes": self.tenant_budget_bytes,
             "updated": round(self.clock(), 3),
         })
+        if self.lease_ttl:
+            with self._lease_lock:
+                mine = self._leases.get(key)
+            if mine is None:
+                return                 # fenced (possibly mid-tick by
+            stats["worker"] = self.worker_id  # the heartbeat thread)
+            stats["epoch"] = mine.epoch
         # flags rendered with their journaled detection lag
         path = t.run_dir / "live.json"
         tmp = t.run_dir / ".live.json.tmp"
@@ -355,13 +690,38 @@ class LiveScheduler:
                                      tenant=label).set(t.queue_depth)
             telemetry.REGISTRY.gauge("live_tenant_bytes",
                                      tenant=label).set(t.nbytes)
+        if self.lease_ttl:
+            telemetry.REGISTRY.gauge(
+                "live_fleet_owned_tenants",
+                worker=self.worker_id).set(len(self._leases))
+            telemetry.REGISTRY.gauge(
+                "live_fleet_owned_bytes",
+                worker=self.worker_id).set(self._owned_bytes())
 
     # -- the tick ------------------------------------------------------------
 
     def tick(self) -> dict:
-        if self._tick_n % self.scan_every == 0:
+        due = self._tick_n % self.scan_every == 0
+        # fleet mode: expiry is judged by observed silence, so the
+        # scan cadence bounds takeover latency — rescan at least every
+        # quarter-TTL of wall time regardless of tick count, keeping
+        # "survivor takes over within ~one TTL" true even for an idle
+        # worker whose ticks are slow
+        if not due and self.lease_ttl \
+                and self.mono() - self._last_discover \
+                >= self.lease_ttl / 4:
+            due = True
+        if due:
             self.discover()
+            self._last_discover = self.mono()
         self._tick_n += 1
+        # fleet mode: verify ownership BEFORE touching a tenant's run
+        # dir this tick — a fenced (stale-epoch) worker must refuse to
+        # publish, not interleave with the new owner
+        if self.lease_ttl:
+            for key in list(self.tenants):
+                if self._fenced(key):
+                    self._drop_fenced(key)
         for key, t in list(self.tenants.items()):
             self._ingest(key, t)
         items = self._collect()
@@ -370,15 +730,29 @@ class LiveScheduler:
         # snapshot + finalize
         for key, t in list(self.tenants.items()):
             self._write_live_json(key, t)
+            # advance the lease-recorded SAFE cursor only at fully
+            # quiescent points: everything before it was checked and
+            # published, so a takeover resuming here loses nothing
+            # (re-checks between here and the dead worker's true
+            # progress de-dup against live.jsonl)
+            if not t.open_by_process and t.queue_depth == 0 \
+                    and all(not ln.buffer for ln in t.lanes.values()):
+                t.safe_offset, t.safe_seq = t.offset, t.seq
+                if self.lease_ttl:
+                    # the frontier capture pairs with THIS cursor: a
+                    # successor restoring it resumes exactly here
+                    t.safe_state = t.frontier_state()
             if t.done and t.queue_depth == 0:
                 self._emit(key, "live-done", durable=True,
                            **{"verdict-so-far":
                               t.stats()["verdict-so-far"]})
+                self._release_lease(key, t)
                 lg = self._logs.pop(key, None)
                 if lg is not None:
                     lg.close()
                 self.finished.add(key)
                 del self.tenants[key]
+        self.renew_leases()
         self._gauges()
         return {"tenants": len(self.tenants),
                 "finished": len(self.finished),
@@ -410,10 +784,47 @@ class LiveScheduler:
                 continue
         return False
 
+    def finalize_unadopted(self) -> int:
+        """Write a final atomic `live.json` for every run this
+        scheduler saw but never managed to adopt (foreign unexpired
+        lease, a lost race, an adoption error over a mangled WAL), so
+        `/fleet` and `/live` can show them as *visibly unowned* rather
+        than absent — the `--once` drain-summary satellite.  Never
+        clobbers a real owner's snapshot.  Returns summaries
+        written."""
+        written = 0
+        for key, ts_dir in self._run_dirs():
+            if key in self.tenants or key in self.finished:
+                continue
+            if (ts_dir / "live.json").exists():
+                continue                # someone's snapshot: keep it
+            why = self.unadopted.get(key, "never adopted")
+            stats = {"verdict-so-far": "unknown", "unowned": True,
+                     "reason": why, "flags": [],
+                     "updated": round(self.clock(), 3)}
+            tmp = ts_dir / ".live.json.tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(stats, f, indent=2, default=repr)
+                os.replace(tmp, ts_dir / "live.json")
+                written += 1
+            except OSError:
+                log.debug("unowned live.json write failed for %s",
+                          key, exc_info=True)
+        return written
+
     def close(self) -> None:
+        # clean shutdown releases every owned lease so a peer can take
+        # the tenants over immediately (no TTL wait)
+        if self.lease_ttl:
+            for key, t in list(self.tenants.items()):
+                self._release_lease(key, t)
         for lg in self._logs.values():
             lg.close()
         self._logs.clear()
+        if self._fleet_logger is not None:
+            self._fleet_logger.close()
+            self._fleet_logger = None
 
 
 def _probe_lane():

@@ -8,15 +8,26 @@ With `web_port`, the same process serves the dashboard (web.py) — so
 `/live/<name>/<ts>` pages render the snapshots this service writes and
 `/metrics` exposes its `live_*` gauges (a separate dashboard process
 would only see the on-disk `live.json`, not the process-local
-registry)."""
+registry).
+
+In fleet mode (`lease_ttl` set) the service additionally runs a
+**heartbeat thread**: lease renewals must not depend on the tick
+loop's cadence (one long device dispatch would otherwise silently
+expire every lease this worker holds), and each beat refreshes the
+worker's `store/fleet/<worker>.json` status sidecar — the `/fleet`
+page's per-worker row (owned tenants, takeovers, fenced writes, lag
+percentiles, last-beat wall stamp)."""
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import threading
+import time
 from typing import Optional
 
-from jepsen_tpu.live.scheduler import LiveScheduler
+from jepsen_tpu.live.scheduler import LAG_BUCKETS_S, LiveScheduler
 
 log = logging.getLogger("jepsen.live")
 
@@ -31,6 +42,7 @@ class CheckerService:
         self.web_host = web_host
         self._web_srv = None
         self._thread: Optional[threading.Thread] = None
+        self._heartbeat: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
     # -- deterministic surface (tests / bench) -------------------------------
@@ -54,9 +66,65 @@ class CheckerService:
         log.info("live dashboard on http://%s:%s/live", self.web_host,
                  self._web_srv.server_address[1])
 
+    # -- fleet heartbeat -----------------------------------------------------
+
+    def _maybe_start_heartbeat(self):
+        sched = self.scheduler
+        if not sched.lease_ttl or self._heartbeat is not None:
+            return
+        period = max(sched.lease_ttl / 3.0, 0.02)
+
+        def beat():
+            while not self._stop.wait(period):
+                try:
+                    sched.renew_leases(force=True)
+                    self.write_worker_status()
+                except Exception:  # noqa: BLE001 - must keep beating
+                    log.warning("lease heartbeat failed",
+                                exc_info=True)
+
+        self._heartbeat = threading.Thread(target=beat, daemon=True,
+                                           name="lease-heartbeat")
+        self._heartbeat.start()
+
+    def write_worker_status(self) -> None:
+        """Atomic store/fleet/<worker>.json — the /fleet page's
+        per-worker row.  Wall stamps here are presentation only."""
+        from jepsen_tpu import telemetry
+        sched = self.scheduler
+        if not sched.lease_ttl:
+            return
+        lag = telemetry.REGISTRY.histogram(
+            "live_window_lag_seconds", buckets=LAG_BUCKETS_S)
+        st = {"worker": sched.worker_id, "pid": os.getpid(),
+              "updated": round(time.time(), 3),
+              "lease_ttl": sched.lease_ttl,
+              "tenants": sorted(f"{k[0]}/{k[1]}"
+                                for k in sched.tenants),
+              "owned": len(sched.tenants),
+              "finished": len(sched.finished),
+              "flags_total": sched.flags_total,
+              "takeovers": sched.takeovers,
+              "fenced_writes": sched.fenced_writes,
+              "max_takeover_lag_s": round(
+                  sched.max_takeover_lag_s, 4),
+              "lag_p50_s": round(lag.quantile(0.5), 4),
+              "lag_p99_s": round(lag.quantile(0.99), 4),
+              "bytes": sched._owned_bytes()}
+        d = sched.root / "fleet"
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+            tmp = d / f".{sched.worker_id}.json.tmp"
+            with open(tmp, "w") as f:
+                json.dump(st, f, indent=2)
+            os.replace(tmp, d / f"{sched.worker_id}.json")
+        except OSError:
+            log.debug("worker status write failed", exc_info=True)
+
     def run(self) -> None:
         """Blocking daemon loop (the serve-checker foreground path)."""
         self._maybe_serve_web()
+        self._maybe_start_heartbeat()
         backend = self.scheduler.resolve_backend()
         log.info("live checker serving %s (engine backend: %s)",
                  self.scheduler.root, backend)
@@ -75,6 +143,7 @@ class CheckerService:
     def start(self) -> "CheckerService":
         """Background thread (tests / bench feeders run alongside)."""
         self._maybe_serve_web()
+        self._maybe_start_heartbeat()
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
         return self
@@ -95,6 +164,11 @@ class CheckerService:
         self.close()
 
     def close(self) -> None:
+        self._stop.set()
+        if self._heartbeat is not None:
+            self._heartbeat.join(2.0)
+            self._heartbeat = None
+        self.write_worker_status()     # final beat: owned counts -> 0
         self.scheduler.close()
         if self._web_srv is not None:
             try:

@@ -580,6 +580,66 @@ class LaneState:
                       last_wall=max(real_walls) if real_walls
                       else None), False
 
+    # -- frontier capture / restore (fleet handoff) --------------------------
+
+    def frontier_state(self) -> Optional[list]:
+        """JSON-able capture of this lane's entire cross-window state
+        at a fully quiescent point: the set of reachable model values
+        (row 0 of the plane — with no open slots, spans, or residue,
+        every configuration has an empty open set).  None when the
+        lane cannot be captured exactly (open work, residue, or
+        non-scalar state values) — the successor then starts that lane
+        wild, which is lenient, never a false flag."""
+        if self.saturated or self.residue or self.span_slot \
+                or self.buffer or self.sealed or self.open_refs:
+            return None
+        if self.plane[1:].any():
+            return None                # an open slot we cannot carry
+        out = []
+        for c in np.flatnonzero(self.plane[0]).tolist():
+            s = self.states[c]
+            if s is WILD:
+                out.append(["w"])
+            else:
+                v = getattr(s, "value", _MISSING)
+                if v is _MISSING or not isinstance(
+                        v, (int, float, str, bool, type(None))):
+                    return None
+                out.append(["v", v])
+        return out if out else None
+
+    def restore_frontier(self, entries: list) -> bool:
+        """Seed a fresh lane from a `frontier_state` capture — the
+        takeover path: the successor resumes checking with exactly the
+        reachable-state set the dead worker had proven, instead of the
+        (lenient) wildcard."""
+        cls = type(self.model0)
+        states: list = []
+        try:
+            for e in entries:
+                if not isinstance(e, (list, tuple)) or not e:
+                    return False
+                if e[0] == "w":
+                    states.append(WILD)
+                elif e[0] == "v" and len(e) == 2:
+                    states.append(cls(e[1]))
+                else:
+                    return False
+        except Exception:  # noqa: BLE001 - a bad capture restores wild
+            return False
+        if not states:
+            return False
+        seen: dict = {}
+        for s in states:
+            if s not in seen:
+                seen[s] = len(seen)
+        self.states = list(seen)
+        self.state_idx = dict(seen)
+        self.plane = np.zeros((self.M, len(self.states)), bool)
+        self.plane[0, :] = True
+        self._table_cache.clear()
+        return True
+
     # -- result application --------------------------------------------------
 
     def apply_result(self, window: Window,
@@ -634,6 +694,17 @@ class Tenant:
         # cursor state (scheduler-owned but persisted here)
         self.offset = 0
         self.seq = 0
+        # the SAFE cursor: every op before it was ingested, checked,
+        # and published — what a fleet lease records, and where a
+        # takeover resumes (live/lease.py); advanced only at fully
+        # quiescent points (no open ops, no buffered/queued entries)
+        self.safe_offset = 0
+        self.safe_seq = 0
+        self.safe_state: Optional[dict] = None  # frontier @ safe cursor
+        # flags already journaled in live.jsonl, keyed (lane repr,
+        # op_index): a takeover replaying from the safe cursor
+        # suppresses re-emission so every violation flags exactly once
+        self.flags_emitted: set = set()
         self.corrupt: Optional[str] = None
         self.paused = False            # backpressure
         self.done = False
@@ -735,6 +806,44 @@ class Tenant:
                                            wall)
             else:
                 self.skipped += 1
+
+    # -- frontier capture / restore (fleet handoff) --------------------------
+
+    def frontier_state(self) -> Optional[dict]:
+        """The tenant's checkable-state capture for the ownership
+        lease: per-lane reachable frontiers, valid exactly at the safe
+        cursor it is recorded beside.  Lanes that cannot be captured
+        (open work, residue, exotic keys/values) are omitted — the
+        successor starts those wild.  None when nothing is
+        capturable."""
+        lanes = []
+        for key, ln in self.lanes.items():
+            if not isinstance(key, (int, str, bool, type(None))):
+                continue               # JSON round-trip must be exact
+            cap = ln.frontier_state()
+            if cap is not None:
+                lanes.append([key, cap])
+        if not lanes:
+            return None
+        return {"model": type(self.model).__name__, "lanes": lanes}
+
+    def restore_frontier(self, state: dict) -> int:
+        """Seed lanes from a lease-carried capture; returns lanes
+        restored.  A model-class mismatch (differently configured
+        workers) restores nothing — wild init stays, lenient."""
+        if not isinstance(state, dict) \
+                or state.get("model") != type(self.model).__name__:
+            return 0
+        restored = 0
+        for entry in state.get("lanes") or []:
+            if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+                continue
+            key, cap = entry
+            if isinstance(key, list):
+                continue
+            if self.lane(key).restore_frontier(cap):
+                restored += 1
+        return restored
 
     # -- aggregates ----------------------------------------------------------
 

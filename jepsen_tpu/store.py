@@ -172,7 +172,7 @@ def tests(name: Optional[str] = None) -> dict:
     names = [name] if name else [p.name for p in BASE.iterdir()
                                  if p.is_dir() and p.name not in
                                  ("latest", "current", "campaigns",
-                                  "ci", "plan-cache")]
+                                  "ci", "plan-cache", "fleet")]
     for n in names:
         d = BASE / _sanitize(n)
         if not d.is_dir():
@@ -263,6 +263,20 @@ def wal_path(test) -> Path:
 
 def campaigns_root() -> Path:
     return BASE / "campaigns"
+
+
+# ---------------------------------------------------------------------------
+# Fleet bookkeeping (live/lease.py, ISSUE 14)
+# ---------------------------------------------------------------------------
+#
+# Layout: store/fleet/<worker-id>.json (atomic per-worker status
+# sidecar) + store/fleet/<worker-id>.jsonl (the worker's own event
+# log: lease-fenced refusals and other events about the WORKER rather
+# than a tenant it may no longer own).  Excluded from tests() and run
+# discovery like campaigns/ and ci/ — bookkeeping, never a test name.
+
+def fleet_root() -> Path:
+    return BASE / "fleet"
 
 
 def campaign_dir(name: str) -> Path:

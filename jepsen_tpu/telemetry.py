@@ -276,15 +276,37 @@ class EventLog:
 
     Never raises after construction: a write failure (disk full, fs
     gone) logs once and disables the log — telemetry must never fail a
-    run."""
+    run.
 
-    def __init__(self, path, fsync: bool = True):
+    `resume=True` continues an existing log's sequence instead of
+    restarting at 0 (which would break every follow_frames reader at
+    the first new record): the intact prefix is scanned, a torn
+    trailing line — a writer killed mid-append — is truncated away,
+    and appends pick up at the next sequence number.  This is the
+    fleet-takeover path: a new lease owner keeps the dead worker's
+    live.jsonl timeline readable as ONE log."""
+
+    def __init__(self, path, fsync: bool = True, resume: bool = False):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.fsync = fsync
         self.lock = threading.Lock()
         self._n = 0
         self._dead = False
+        if resume and self.path.exists() \
+                and self.path.stat().st_size:
+            try:
+                from jepsen_tpu.history import follow_frames
+                seg = follow_frames(self.path, key="ev")
+                if seg.tail_bytes and not seg.corrupt:
+                    with open(self.path, "r+b") as f:
+                        f.truncate(seg.offset)
+                # a corrupt COMPLETE record is left in place (readers
+                # stop there); continuing the sequence past it keeps
+                # appends harmless either way
+                self._n = seg.seq
+            except Exception:  # noqa: BLE001 - resume is best-effort
+                pass
         self._f = open(self.path, "a")
 
     def append(self, ev: dict, durable: bool = False) -> None:

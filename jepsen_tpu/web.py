@@ -113,6 +113,7 @@ def home_html() -> bytes:
             "</tr>")
     body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
             "<a href='/live'>live</a> &middot; "
+            "<a href='/fleet'>fleet</a> &middot; "
             "<a href='/campaign'>campaigns</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
@@ -395,6 +396,184 @@ def live_run_html(name: str, ts: str) -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Fleet page (ISSUE 14): /fleet — the serve-checker fleet aggregate:
+# workers (from store/fleet/<id>.json status sidecars), lease-owned
+# tenants with owner/epoch/cursor, the takeover/fencing timeline
+# (lease-* events merged from tenant live.jsonl + worker fleet logs),
+# and runs nobody ever owned, visibly flagged rather than absent
+# ---------------------------------------------------------------------------
+
+def _fleet_workers() -> list:
+    out = []
+    root = store.fleet_root()
+    if not root.is_dir():
+        return out
+    for p in sorted(root.glob("*.json")):
+        try:
+            with open(p) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _fleet_tenants() -> list:
+    """(name, ts, lease-dict-or-None, live-dict-or-None) for every run
+    dir carrying a history.wal."""
+    from jepsen_tpu.live import lease as lease_mod
+    rows = []
+    for name, stamps in sorted(store.tests().items()):
+        for ts in sorted(stamps, reverse=True):
+            d = store.BASE / store._sanitize(name) / ts
+            if not (d / "history.wal").exists():
+                continue
+            ls = lease_mod.read(d)
+            lj = None
+            try:
+                with open(d / "live.json") as f:
+                    lj = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                pass
+            rows.append((name, ts, ls, lj))
+    return rows
+
+
+def _fleet_events(limit: int = 50) -> list:
+    """The takeover timeline: lease-* events from every tenant's
+    live.jsonl merged with the workers' own fleet logs (the home of
+    lease-fenced refusals), newest first."""
+    from jepsen_tpu import telemetry
+    evs = []
+    for name, ts, ls, _lj in _fleet_tenants():
+        if ls is None:
+            continue
+        p = store.BASE / store._sanitize(name) / ts / "live.jsonl"
+        if not p.exists():
+            continue
+        for e in telemetry.read_events(p):
+            if str(e.get("type", "")).startswith("lease-"):
+                evs.append(dict(e, tenant=f"{name}/{ts}"))
+    root = store.fleet_root()
+    if root.is_dir():
+        for p in sorted(root.glob("*.jsonl")):
+            for e in telemetry.read_events(p):
+                if str(e.get("type", "")).startswith("lease-"):
+                    evs.append(e)
+    evs.sort(key=lambda e: e.get("t") or 0.0, reverse=True)
+    return evs[:limit]
+
+
+def fleet_html() -> bytes:
+    import time as time_mod
+    now = time_mod.time()
+    body = ["<h1>Checker fleet</h1>",
+            "<p><a href='/'>&larr; tests</a> &middot; "
+            "<a href='/live'>live</a> &middot; "
+            "<a href='/metrics'>metrics</a></p>"]
+
+    workers = _fleet_workers()
+    if workers:
+        body.append("<h2>Workers</h2>"
+                    "<table><tr><th>Worker</th><th>Owned</th>"
+                    "<th>Flags</th><th>Takeovers</th>"
+                    "<th>Fenced writes</th>"
+                    "<th>Max takeover lag (s)</th>"
+                    "<th>Window lag p50/p99 (s)</th>"
+                    "<th>Last beat</th></tr>")
+        for w in workers:
+            age = now - w.get("updated", 0)
+            ttl = w.get("lease_ttl") or 5.0
+            stale = age > 3 * ttl
+            body.append(
+                f"<tr{' style=background:#F3EABB' if stale else ''}>"
+                f"<td>{html.escape(str(w.get('worker')))}</td>"
+                f"<td>{w.get('owned')}</td>"
+                f"<td>{w.get('flags_total')}</td>"
+                f"<td>{w.get('takeovers')}</td>"
+                f"<td>{w.get('fenced_writes')}</td>"
+                f"<td>{w.get('max_takeover_lag_s')}</td>"
+                f"<td>{w.get('lag_p50_s')} / {w.get('lag_p99_s')}</td>"
+                f"<td>{age:.1f}s ago"
+                f"{' (stale)' if stale else ''}</td></tr>")
+        body.append("</table>")
+    else:
+        body.append("<p>(no worker status files under store/fleet/ — "
+                    "start workers with <code>serve-checker store/ "
+                    "--workers 2</code> or <code>--lease-ttl "
+                    "5</code>)</p>")
+
+    tenants = _fleet_tenants()
+    owned_rows, never_rows = [], []
+    for name, ts, ls, lj in tenants:
+        v = (lj or {}).get("verdict-so-far")
+        if ls is None:
+            never_rows.append(
+                "<tr style='background:#F3EABB'>"
+                f"<td>{html.escape(name)}/"
+                f"<a href='/live/{quote(name)}/{quote(ts)}'>"
+                f"{html.escape(ts)}</a></td>"
+                "<td colspan=4><b>never owned</b>"
+                + (" &mdash; " + html.escape(str(
+                    (lj or {}).get("reason")))
+                   if (lj or {}).get("unowned") else "")
+                + "</td>"
+                f"<td>{html.escape(json.dumps(v))}</td></tr>")
+            continue
+        status = "released" if ls.released else \
+            ("torn" if ls.corrupt else "held")
+        owned_rows.append(
+            f"<tr style='background:{_live_color(v)}'>"
+            f"<td>{html.escape(name)}/"
+            f"<a href='/live/{quote(name)}/{quote(ts)}'>"
+            f"{html.escape(ts)}</a></td>"
+            f"<td>{html.escape(str(ls.owner))}</td>"
+            f"<td>{ls.epoch}</td>"
+            f"<td>{html.escape(status)}</td>"
+            f"<td>{ls.offset}/{ls.seq}</td>"
+            f"<td>{html.escape(json.dumps(v))}</td></tr>")
+    if owned_rows or never_rows:
+        body.append("<h2>Tenants</h2>"
+                    "<table><tr><th>Run</th><th>Owner</th>"
+                    "<th>Epoch</th><th>Lease</th>"
+                    "<th>Safe cursor (off/seq)</th>"
+                    "<th>Verdict so far</th></tr>"
+                    + "".join(owned_rows) + "".join(never_rows)
+                    + "</table>")
+
+    evs = _fleet_events()
+    if evs:
+        body.append("<h2>Takeover / fencing timeline</h2>"
+                    "<table><tr><th>When</th><th>Event</th>"
+                    "<th>Tenant</th><th>Worker</th><th>Epoch</th>"
+                    "<th>Detail</th></tr>")
+        for e in evs:
+            t = e.get("t")
+            detail = []
+            if e.get("from_worker"):
+                detail.append(f"from {e['from_worker']}")
+            if e.get("silent_s") is not None:
+                detail.append(f"silent {e['silent_s']}s")
+            if e.get("reason"):
+                detail.append(str(e["reason"]))
+            if e.get("cursor"):
+                detail.append(f"cursor {e['cursor']}")
+            color = {"lease-takeover": "#D8E8F8",
+                     "lease-fenced": "#F3BBBC"}.get(e.get("type"), "")
+            body.append(
+                f"<tr{f' style=background:{color}' if color else ''}>"
+                f"<td>{now - t:.1f}s ago</td>" if t else
+                "<tr><td>?</td>")
+            body.append(
+                f"<td>{html.escape(str(e.get('type')))}</td>"
+                f"<td>{html.escape(str(e.get('tenant', '-')))}</td>"
+                f"<td>{html.escape(str(e.get('worker', '-')))}</td>"
+                f"<td>{e.get('epoch', '')}</td>"
+                f"<td>{html.escape('; '.join(detail))}</td></tr>")
+        body.append("</table>")
+    return _page("Checker fleet", "".join(body))
+
+
+# ---------------------------------------------------------------------------
 # Campaign pages (ISSUE 13): /campaign index + per-campaign coverage
 # matrix (nemesis x workload x anomaly class, gaps visible) — rendered
 # from store/campaigns/<name>/{status,coverage}.json
@@ -632,6 +811,8 @@ class Handler(BaseHTTPRequestHandler):
                 return self._send(200, telemetry.snapshot().encode(),
                                   "text/plain; version=0.0.4; "
                                   "charset=utf-8")
+            if path == "/fleet" or path == "/fleet/":
+                return self._send(200, fleet_html())
             if path == "/live" or path == "/live/":
                 return self._send(200, live_index_html())
             if path.startswith("/live/"):

@@ -107,6 +107,42 @@ def _pack_backend():
         return None
 
 
+def _fleet_summary():
+    """The fleet batteries' lease-protocol counters (ISSUE 14):
+    in-process workers constructed, takeovers, fenced (refused)
+    stale-epoch writes, and the max observed takeover lag — recorded
+    so a regression that silently stops exercising the handoff path
+    (no takeovers in a green suite) or weakens fencing (fenced-writes
+    drops to 0 while the two-writers test still passes vacuously)
+    diffs across PRs.  Counts cover THIS process only; the kill9
+    subprocess workers keep their own registries.  None when no
+    fleet-mode scheduler ran."""
+    try:
+        from jepsen_tpu import telemetry
+        coll = telemetry.REGISTRY.collect()
+
+        def total(name):
+            _k, by_label = coll.get(name, (None, {}))
+            return int(sum(m.value for m in by_label.values())) \
+                if by_label else 0
+
+        workers = total("live_fleet_workers_total")
+        if not workers:
+            return None
+        _k, lag = coll.get("live_lease_max_takeover_lag_seconds",
+                           (None, {}))
+        return {"workers": workers,
+                "takeovers": total("live_lease_takeover_total"),
+                "fenced_writes": total("live_lease_fenced_total"),
+                "flags_suppressed":
+                    total("live_fleet_flags_suppressed_total"),
+                "max_takeover_lag_s": round(
+                    max((m.value for m in lag.values()), default=0.0),
+                    4) if lag else 0.0}
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _campaign_summary():
     """The tier-1 smoke campaign's counters (ISSUE 13):
     run/novel/deduped/quarantined schedule counts from the registry —
@@ -165,6 +201,7 @@ def pytest_sessionfinish(session, exitstatus):
             "plan_cache": _plan_cache_stats(),
             "pack_backend": _pack_backend(),
             "campaign": _campaign_summary(),
+            "fleet": _fleet_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }

@@ -1,0 +1,759 @@
+"""Fleet-grade serve-checker tests (ISSUE 14): lease-file atomicity
+and edge cases (torn files, clock skew, racing acquires), the
+lease-owned scheduler (acquire-under-budget, fenced stale-epoch
+publishes, cursor+frontier takeover resume, exactly-once flags), the
+`/fleet` web surface, the `--once` unowned summary, the store/discover
+fleet-dir exclusions, and the kill9 subprocess battery — two real
+workers, SIGKILL one mid-dispatch, the survivor takes over within one
+lease TTL with every planted violation flagged exactly once."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import cli, store, telemetry, web
+from jepsen_tpu.history import (HistoryWAL, follow_frames, invoke_op,
+                                ok_op)
+from jepsen_tpu.live import lease as lease_mod
+from jepsen_tpu.live.scheduler import LiveScheduler
+from jepsen_tpu.live.service import CheckerService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def write_wal(run_dir, ops, fsync=False):
+    run_dir.mkdir(parents=True, exist_ok=True)
+    wal = HistoryWAL(run_dir / "history.wal", fsync=fsync)
+    for o in ops:
+        wal.append(o)
+    wal.close()
+
+
+def register_ops(n, vmax=5, start_index=0):
+    ops = []
+    i = start_index
+    for k in range(n):
+        ops.append(invoke_op(0, "write", k % vmax, index=i))
+        ops.append(ok_op(0, "write", k % vmax, index=i + 1))
+        i += 2
+    return ops
+
+
+class FakeMono:
+    """An injectable monotonic clock advancing a fixed step per read —
+    lets lease-expiry tests skip real sleeps."""
+
+    def __init__(self, step=0.0, t=1000.0):
+        self.step = step
+        self.t = t
+
+    def __call__(self):
+        self.t += self.step
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# lease.json semantics (satellite: torn files, clock skew, races)
+# ---------------------------------------------------------------------------
+
+class TestLeaseFile:
+    def test_acquire_renew_release_roundtrip(self, tmp_path):
+        got = lease_mod.try_acquire(tmp_path, "w1", 1.0)
+        assert got is not None and got.epoch == 1
+        disk = lease_mod.read(tmp_path)
+        assert disk.owner == "w1" and disk.epoch == 1
+        assert not disk.corrupt and not disk.released
+        ren = lease_mod.renew(tmp_path, got, cursor=(128, 7),
+                              state={"model": "CASRegister",
+                                     "lanes": [[None, [["v", 3]]]]})
+        assert ren is not None and ren.beat == 1
+        disk = lease_mod.read(tmp_path)
+        assert disk.cursor == (128, 7)
+        assert disk.state["lanes"] == [[None, [["v", 3]]]]
+        rel = lease_mod.renew(tmp_path, ren, released=True)
+        assert rel is not None
+        assert lease_mod.read(tmp_path).released
+
+    def test_second_acquire_loses(self, tmp_path):
+        assert lease_mod.try_acquire(tmp_path, "w1", 1.0) is not None
+        assert lease_mod.try_acquire(tmp_path, "w2", 1.0) is None
+        assert lease_mod.read(tmp_path).owner == "w1"
+
+    def test_racing_acquires_exactly_one_winner(self, tmp_path):
+        """N threads racing one fresh acquire: exactly one wins via
+        the link(2) atomicity — the satellite race pin."""
+        for round_ in range(5):
+            d = tmp_path / f"r{round_}"
+            d.mkdir()
+            wins, barrier = [], threading.Barrier(8)
+
+            def race(i, d=d):
+                barrier.wait()
+                got = lease_mod.try_acquire(d, f"w{i}", 1.0)
+                if got is not None:
+                    wins.append(i)
+
+            ths = [threading.Thread(target=race, args=(i,))
+                   for i in range(8)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join()
+            assert len(wins) == 1
+            assert lease_mod.read(d).owner == f"w{wins[0]}"
+
+    def test_torn_lease_is_expired_not_crash(self, tmp_path):
+        """The satellite: a torn/partial lease.json reads as corrupt
+        (=> expired immediately), never raises, and a takeover over it
+        starts the epoch chain at 1."""
+        (tmp_path / "lease.json").write_text('{"owner": "w1", "ep')
+        ls = lease_mod.read(tmp_path)
+        assert ls is not None and ls.corrupt
+        obs = lease_mod.LeaseObserver(mono=FakeMono())
+        assert obs.expired(("k",), ls, default_ttl=5.0)  # immediate
+        got = lease_mod.takeover(tmp_path, "w2", 1.0, ls)
+        assert got is not None and got.owner == "w2"
+        assert got.epoch == 1 and got.cursor == (0, 0)
+        assert lease_mod.read(tmp_path).owner == "w2"
+
+    def test_clock_skew_wall_stamps_advisory(self, tmp_path):
+        """The satellite: expiry is monotonic observed silence, wall
+        stamps advisory.  A lease stamped a year into the future still
+        expires once its holder stops renewing; one stamped in the
+        past stays live while renewals keep landing."""
+        far_future = time.time() + 365 * 86400
+        got = lease_mod.try_acquire(tmp_path, "w1", 0.5,
+                                    now=far_future)
+        assert lease_mod.read(tmp_path).deadline > time.time() + 86400
+        mono = FakeMono()
+        obs = lease_mod.LeaseObserver(mono=mono)
+        ls = lease_mod.read(tmp_path)
+        assert not obs.expired("k", ls, 0.5)      # first sight: 0s
+        mono.t += 0.6                             # silent past ttl
+        assert obs.expired("k", lease_mod.read(tmp_path), 0.5)
+        # ...but a holder actively renewing (even with a PAST wall
+        # stamp) never expires: every beat changes the bytes
+        mine = got
+        for _ in range(5):
+            mine = lease_mod.renew(tmp_path, mine,
+                                   now=time.time() - 9999)
+            assert mine is not None
+            mono.t += 0.4                         # under ttl per beat
+            assert not obs.expired("k", lease_mod.read(tmp_path), 0.5)
+
+    def test_takeover_aborts_if_holder_renewed(self, tmp_path):
+        got = lease_mod.try_acquire(tmp_path, "w1", 1.0)
+        observed = lease_mod.read(tmp_path)
+        # the holder renews between observation and claim
+        lease_mod.renew(tmp_path, got)
+        out = lease_mod.takeover(tmp_path, "w2", 1.0, observed)
+        assert out is None
+        disk = lease_mod.read(tmp_path)
+        assert disk.owner == "w1" and disk.beat == 1
+
+    def test_renew_detects_fence_and_repairs_stale_clobber(
+            self, tmp_path):
+        got = lease_mod.try_acquire(tmp_path, "w1", 1.0)
+        new = lease_mod.takeover(tmp_path, "w2", 1.0,
+                                 lease_mod.read(tmp_path))
+        assert new.epoch == 2
+        # the stale epoch-1 holder is fenced: renew refuses, writes
+        # nothing
+        assert lease_mod.renew(tmp_path, got) is None
+        assert lease_mod.read(tmp_path).owner == "w2"
+        # a lower-epoch clobber (pathological pause race) is repaired
+        # by the rightful owner's next renewal
+        lease_mod._write_tmp(tmp_path, got, "x")
+        stale = lease_mod.Lease(owner="w1", epoch=1, ttl=1.0)
+        p = lease_mod._write_tmp(tmp_path, stale, "clobber")
+        os.replace(p, lease_mod.lease_path(tmp_path))
+        assert lease_mod.read(tmp_path).epoch == 1
+        fixed = lease_mod.renew(tmp_path, new)
+        assert fixed is not None
+        assert lease_mod.read(tmp_path).owner == "w2"
+        assert lease_mod.read(tmp_path).epoch == 2
+
+
+# ---------------------------------------------------------------------------
+# lease-owned scheduling (in-process)
+# ---------------------------------------------------------------------------
+
+class TestFleetScheduler:
+    def test_acquire_under_lease_and_surfaces(self, tmp_path):
+        root = store.BASE
+        d = root / "r" / "t1"
+        write_wal(d, register_ops(6))
+        s = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="w1", lease_ttl=5.0)
+        s.tick()
+        disk = lease_mod.read(d)
+        assert disk.owner == "w1" and disk.epoch == 1
+        lj = json.loads((d / "live.json").read_text())
+        assert lj["worker"] == "w1" and lj["epoch"] == 1
+        ev = telemetry.read_events(d / "live.jsonl")
+        acq = [e for e in ev if e["type"] == "lease-acquire"]
+        assert acq and acq[0]["worker"] == "w1"
+        # renewal records the SAFE cursor + the checker frontier
+        s.renew_leases(force=True)
+        disk = lease_mod.read(d)
+        assert disk.cursor[1] == 12          # all 12 records published
+        assert disk.state and disk.state["model"] == "CASRegister"
+        s.close()
+        assert lease_mod.read(d).released    # clean handoff
+
+    def test_fleet_byte_budget_bounds_acquisition(self, tmp_path):
+        """A worker only acquires tenants it can afford: with the
+        whole WAL backlog of one tenant over budget, one discover
+        pass adopts exactly one; the next is only acquired after the
+        first drains."""
+        root = store.BASE
+        for i in range(3):
+            write_wal(root / f"r{i}" / "t1", register_ops(40))
+        s = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="w1", lease_ttl=5.0,
+                          fleet_budget_bytes=2000)  # < one WAL backlog
+        s.discover()
+        assert len(s.tenants) == 1               # first is free...
+        assert sum(1 for why in s.unadopted.values()
+                   if "budget" in why) == 2      # ...the rest priced
+        s.tick()                                 # drains tenant 1
+        s.tick()                                 # affords the next
+        assert len(s.tenants) == 2
+        s.close()
+
+    def test_takeover_resumes_cursor_and_frontier(self, tmp_path):
+        """The handoff core: B resumes at A's recorded cursor WITH
+        A's proven reachable-state frontier, so a violation whose
+        constraining writes predate the cursor still flags — exactly
+        once."""
+        root = store.BASE
+        d = root / "r" / "t1"
+        write_wal(d, register_ops(8))
+        A = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="A", lease_ttl=0.5)
+        A.tick()
+        A.renew_leases(force=True)
+        rec = lease_mod.read(d)
+        assert rec.cursor[1] == 16 and rec.state
+        # A dies (no close: lease never released); B observes silence
+        B = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="B", lease_ttl=0.5,
+                          mono=FakeMono(step=0.3))
+        for _ in range(6):
+            B.tick()
+        assert len(B.tenants) == 1 and B.takeovers == 1
+        t = next(iter(B.tenants.values()))
+        assert (t.offset, t.seq) == rec.cursor   # cursor resume
+        # a read of a never-written value AFTER the cursor must flag:
+        # only the restored frontier (last write = 2) can refute it
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        wal._n = 16
+        wal.append(invoke_op(0, "read", None, index=16))
+        wal.append(ok_op(0, "read", 99, index=17))
+        wal.close()
+        B.tick()
+        B.tick()
+        assert B.flags_total == 1
+        ev = telemetry.read_events(d / "live.jsonl")
+        types = [e["type"] for e in ev]
+        assert "lease-expire" in types and "lease-takeover" in types
+        assert sum(1 for e in ev if e["type"] == "live-flag") == 1
+        lj = json.loads((d / "live.json").read_text())
+        assert lj["worker"] == "B" and lj["epoch"] == 2
+        A.close()
+        B.close()
+
+    def test_two_writers_one_epoch_behind(self, tmp_path):
+        """THE fencing pin: a paused-then-resumed worker whose lease
+        was taken over must refuse to publish — no live.json clobber,
+        no events in the tenant log, the refusal counted and
+        journaled in ITS OWN fleet log — while the new owner flags
+        the violation exactly once."""
+        root = store.BASE
+        d = root / "r" / "t1"
+        write_wal(d, register_ops(6))
+        fenced0 = telemetry.REGISTRY.counter(
+            "live_lease_fenced_total").value
+        A = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="A", lease_ttl=0.4)
+        A.tick()                       # A owns epoch 1
+        B = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="B", lease_ttl=0.4,
+                          mono=FakeMono(step=0.3))
+        for _ in range(6):
+            B.tick()                   # B takes over: epoch 2
+        assert B.takeovers == 1
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        wal._n = 12
+        wal.append(invoke_op(0, "read", None, index=12))
+        wal.append(ok_op(0, "read", 77, index=13))
+        wal.close()
+        time.sleep(0.15)               # A's fence cache (ttl/4) lapses
+        before = (d / "live.json").read_bytes()
+        A.tick()                       # the stale-epoch writer
+        assert A.fenced_writes == 1
+        assert len(A.tenants) == 0     # dropped without publishing
+        assert A.flags_total == 0
+        assert telemetry.REGISTRY.counter(
+            "live_lease_fenced_total").value == fenced0 + 1
+        lj = json.loads((d / "live.json").read_text())
+        assert lj["worker"] == "B" and lj["epoch"] == 2
+        # the refusal is journaled in A's own fleet log, not the
+        # tenant's (single-writer-under-lease)
+        fev = telemetry.read_events(root / "fleet" / "A.jsonl")
+        assert any(e["type"] == "lease-fenced" for e in fev)
+        B.tick()
+        B.tick()
+        assert B.flags_total == 1
+        ev = telemetry.read_events(d / "live.jsonl")
+        assert sum(1 for e in ev if e["type"] == "live-flag") == 1
+        # lease-fenced lives in the worker's own log, never the
+        # tenant's (single-writer-under-lease)
+        assert not any(e["type"] == "lease-fenced" for e in ev)
+        A.close()
+        B.close()
+
+    def test_takeover_without_state_replays_and_dedupes(
+            self, tmp_path):
+        """A lease carrying a cursor but no restorable frontier
+        forces a full replay from byte 0 — and flags already
+        journaled by the dead worker are NOT re-emitted (exactly-once
+        via live.jsonl de-dup)."""
+        root = store.BASE
+        d = root / "r" / "t1"
+        ops = register_ops(5)
+        ops += [invoke_op(0, "read", None, index=10),
+                ok_op(0, "read", 99, index=11)]     # planted
+        write_wal(d, ops)
+        A = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="A", lease_ttl=0.5)
+        A.tick()
+        A.tick()
+        assert A.flags_total == 1      # A flagged it...
+        A.renew_leases(force=True)
+        # ...then died; strip the frontier out of the recorded lease
+        # (simulates a lane that was never capturable)
+        disk = lease_mod.read(d)
+        mutated = lease_mod.Lease(
+            owner=disk.owner, epoch=disk.epoch, ttl=disk.ttl,
+            offset=disk.offset, seq=disk.seq, beat=disk.beat,
+            stamp=disk.stamp, deadline=disk.deadline)
+        p = lease_mod._write_tmp(d, mutated, "strip")
+        os.replace(p, lease_mod.lease_path(d))
+        B = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="B", lease_ttl=0.5,
+                          mono=FakeMono(step=0.4))
+        for _ in range(8):
+            B.tick()
+        assert B.takeovers == 1
+        t = next(iter(B.tenants.values()))
+        assert t.offset > 0            # replayed the whole WAL
+        ev = telemetry.read_events(d / "live.jsonl")
+        flags = [e for e in ev if e["type"] == "live-flag"]
+        assert len(flags) == 1         # A's flag; B's replay deduped
+        assert telemetry.REGISTRY.counter(
+            "live_fleet_flags_suppressed_total").value >= 1
+        A.close()
+        B.close()
+
+    def test_store_and_discovery_skip_fleet_bookkeeping(
+            self, tmp_path):
+        """Satellite regression (PR 11's campaigns/ci fix class):
+        store/fleet/ and per-run lease.json must be invisible to
+        store.tests(), the /live index, and run discovery."""
+        root = store.BASE
+        d = root / "real" / "t1"
+        write_wal(d, register_ops(2))
+        (root / "fleet").mkdir(parents=True)
+        (root / "fleet" / "w1.json").write_text('{"worker": "w1"}')
+        (root / "fleet" / "w1.jsonl").write_text("")
+        lease_mod.try_acquire(d, "w9", 5.0)
+        names = set(store.tests())
+        assert "fleet" not in names and "real" in names
+        idx = web.live_index_html().decode()
+        assert "fleet" not in idx
+        s = LiveScheduler(root, backend="host", scan_every=1)
+        s.discover()
+        assert set(s.tenants) == {("real", "t1")}
+        s.close()
+
+    def test_once_writes_unowned_summary(self, tmp_path):
+        """Satellite: `--once` writes a final live.json for runs it
+        never adopted (here: a foreign unexpired lease), so /fleet
+        shows them as visibly unowned rather than absent."""
+        root = store.BASE
+        held = root / "held" / "t1"
+        mine = root / "mine" / "t1"
+        write_wal(held, register_ops(3))
+        write_wal(mine, register_ops(3))
+        lease_mod.try_acquire(held, "other-worker", 600.0)
+        rc = cli.main(cli.standard_commands(),
+                      ["serve-checker", str(root), "--once",
+                       "--backend", "host", "--lease-ttl", "5",
+                       "--worker-id", "me"])
+        assert rc == 0
+        lj = json.loads((held / "live.json").read_text())
+        assert lj["unowned"] is True
+        assert lj["verdict-so-far"] == "unknown"
+        assert "other-worker" in lj["reason"]
+        ljm = json.loads((mine / "live.json").read_text())
+        assert ljm.get("unowned") is None
+        assert ljm["verdict-so-far"] is True
+
+
+# ---------------------------------------------------------------------------
+# /fleet web surface
+# ---------------------------------------------------------------------------
+
+class TestFleetWeb:
+    def _mk_fleet_store(self):
+        root = store.BASE
+        d = root / "r" / "t1"
+        write_wal(d, register_ops(4))
+        never = root / "orphan" / "t1"
+        write_wal(never, register_ops(2))
+        svc = CheckerService(root, backend="host", scan_every=1,
+                             worker_id="w1", lease_ttl=0.5,
+                             fleet_budget_bytes=1)  # leaves orphan
+        svc.tick()
+        svc.tick()
+        svc.write_worker_status()
+        svc.scheduler.finalize_unadopted()
+        svc.close()
+
+    def test_fleet_page_renders(self):
+        self._mk_fleet_store()
+        page = web.fleet_html().decode()
+        assert "Workers" in page and "w1" in page
+        assert "Tenants" in page
+        assert "never owned" in page       # the orphan is flagged
+        assert "lease-acquire" in page     # the timeline renders
+
+    def test_fleet_route_over_http(self):
+        self._mk_fleet_store()
+        import urllib.request
+        srv = web.serve(host="127.0.0.1", port=0, block=False)
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            with urllib.request.urlopen(base + "/fleet",
+                                        timeout=10) as r:
+                body = r.read().decode()
+                assert r.status == 200
+                assert "w1" in body and "never owned" in body
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_empty_fleet_page(self):
+        page = web.fleet_html().decode()
+        assert "--workers 2" in page       # the hint renders
+
+
+# ---------------------------------------------------------------------------
+# kill9: two real workers, SIGKILL one mid-dispatch
+# ---------------------------------------------------------------------------
+
+def spawn_worker(root, wid, ttl=0.8):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         str(root), "--worker-id", wid, "--lease-ttl", str(ttl),
+         "--backend", "host", "--poll-interval", "0.02"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.03)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+@pytest.mark.kill9
+class TestFleetKill9:
+    TTL = 0.8
+
+    def test_sigkill_mid_dispatch_survivor_takes_over(self, tmp_path):
+        """The ISSUE 14 acceptance scenario: 2 real workers over one
+        root, paced tenant, SIGKILL the owner mid-stream.  The
+        survivor must take over within ~one lease TTL (observed
+        silence is the mechanism — pinned via the journaled
+        silent_s), resume from the recorded WAL cursor, and flag both
+        planted violations exactly once (the pre-kill one was already
+        flagged by the victim and must NOT re-flag; the post-kill one
+        only the survivor can flag)."""
+        root = tmp_path / "store"
+        d = root / "r" / "t1"
+        d.mkdir(parents=True)
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        procs = [spawn_worker(root, "A", self.TTL),
+                 spawn_worker(root, "B", self.TTL)]
+        try:
+            i = 0
+            for k in range(20):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.005)
+            ls = wait_for(lambda: lease_mod.read(d), 30,
+                          "a worker to acquire the tenant")
+            owner = ls.owner
+            victim = procs[0] if owner == "A" else procs[1]
+            survivor_id = "B" if owner == "A" else "A"
+            # keep the stream moving, plant the PRE-kill violation
+            wal.append(invoke_op(0, "read", None, index=i))
+            wal.append(ok_op(0, "read", 99, index=i + 1))
+            pre_kill_idx = i + 1
+            i += 2
+            wait_for(lambda: [
+                e for e in telemetry.read_events(d / "live.jsonl")
+                if e.get("type") == "live-flag"], 30,
+                "the victim to flag the pre-kill violation")
+            # wait until a heartbeat has recorded real progress into
+            # the lease — the takeover must resume from a mid-stream
+            # cursor, not byte 0
+            wait_for(lambda: (lambda l2: l2 is not None
+                              and l2.seq > 0)(lease_mod.read(d)),
+                     self.TTL * 4 + 5,
+                     "a renewal to record the safe cursor")
+            # mid-dispatch: ops still flowing when the kill lands
+            for k in range(10):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10)
+            t_kill = time.monotonic()
+            # the survivor must claim within ~one TTL (+ scan slack)
+            new = wait_for(
+                lambda: (lambda ls2: ls2 if ls2 is not None
+                         and ls2.owner == survivor_id else None)(
+                    lease_mod.read(d)),
+                self.TTL * 4 + 10, "the survivor takeover")
+            gap = time.monotonic() - t_kill
+            assert new.epoch == 2
+            assert gap < self.TTL * 2 + 2.0, \
+                f"takeover took {gap:.2f}s (ttl {self.TTL})"
+            # post-kill violation: only the survivor can flag it
+            for k in range(6):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+            wal.append(invoke_op(0, "read", None, index=i))
+            wal.append(ok_op(0, "read", 88, index=i + 1))
+            post_kill_idx = i + 1
+            wal.close()
+            (d / "results.json").write_text('{"valid?": false}')
+            wait_for(lambda: (lambda lj: lj.get("done"))(
+                json.loads((d / "live.json").read_text()))
+                if (d / "live.json").exists() else None,
+                30, "the survivor to drain the tenant")
+
+            ev = telemetry.read_events(d / "live.jsonl")
+            flags = [e for e in ev if e["type"] == "live-flag"]
+            by_idx = {}
+            for f in flags:
+                by_idx[f["op_index"]] = by_idx.get(f["op_index"],
+                                                   0) + 1
+            # exactly once each: no loss, no duplicates
+            assert by_idx == {pre_kill_idx: 1, post_kill_idx: 1}, \
+                by_idx
+            # the lease events reconstruct the takeover timeline, and
+            # the journaled silence proves the TTL mechanism fired
+            tak = [e for e in ev if e["type"] == "lease-takeover"]
+            assert len(tak) == 1
+            assert tak[0]["worker"] == survivor_id
+            assert tak[0]["from_worker"] == owner
+            assert tak[0]["epoch"] == 2
+            assert self.TTL * 0.9 <= tak[0]["silent_s"] \
+                <= self.TTL * 2 + 2.0
+            exp = [e for e in ev if e["type"] == "lease-expire"]
+            assert exp and exp[0]["worker"] == owner
+            # cursor resume: the takeover cursor is a real mid-stream
+            # position, not byte 0 (the victim had published progress)
+            assert tak[0]["cursor"]["seq"] > 0
+            # live.json reconstructs ownership; /fleet renders it all
+            lj = json.loads((d / "live.json").read_text())
+            assert lj["worker"] == survivor_id and lj["epoch"] == 2
+            assert lj["verdict-so-far"] is False
+            old_base = store.BASE
+            store.BASE = root
+            try:
+                page = web.fleet_html().decode()
+                assert "lease-takeover" in page
+                assert survivor_id in page
+            finally:
+                store.BASE = old_base
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGINT)
+            for p in procs:
+                try:
+                    p.wait(10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+    def test_paused_worker_is_fenced_after_resume(self, tmp_path):
+        """SIGSTOP the owner past its TTL: a peer takes over; on
+        SIGCONT the stale-epoch worker must fence itself — counted in
+        its own fleet log — and the tenant log stays single-writer
+        (every live-flag exactly once)."""
+        root = tmp_path / "store"
+        d = root / "r" / "t1"
+        d.mkdir(parents=True)
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        for k in range(15):
+            wal.append(invoke_op(0, "write", k % 5, index=2 * k))
+            wal.append(ok_op(0, "write", k % 5, index=2 * k + 1))
+        procs = [spawn_worker(root, "A", self.TTL),
+                 spawn_worker(root, "B", self.TTL)]
+        try:
+            ls = wait_for(lambda: lease_mod.read(d), 30,
+                          "a worker to acquire")
+            owner = ls.owner
+            victim = procs[0] if owner == "A" else procs[1]
+            survivor_id = "B" if owner == "A" else "A"
+            victim.send_signal(signal.SIGSTOP)
+            wait_for(
+                lambda: (lambda l2: l2 is not None
+                         and l2.owner == survivor_id)(
+                    lease_mod.read(d)),
+                self.TTL * 4 + 10, "takeover from the paused worker")
+            wal.append(invoke_op(0, "read", None, index=30))
+            wal.append(ok_op(0, "read", 99, index=31))
+            wal.close()
+            (d / "results.json").write_text('{"valid?": false}')
+            victim.send_signal(signal.SIGCONT)
+            # the resumed stale worker must fence itself
+            fenced = wait_for(
+                lambda: [e for e in telemetry.read_events(
+                    root / "fleet" / f"{owner}.jsonl")
+                    if e.get("type") == "lease-fenced"]
+                if (root / "fleet" / f"{owner}.jsonl").exists()
+                else None,
+                30, "the stale worker to journal its fencing")
+            assert fenced[0]["worker"] == owner
+            wait_for(lambda: (lambda lj: lj.get("done"))(
+                json.loads((d / "live.json").read_text()))
+                if (d / "live.json").exists() else None,
+                30, "the survivor to drain")
+            ev = telemetry.read_events(d / "live.jsonl")
+            flags = [e for e in ev if e["type"] == "live-flag"]
+            assert len(flags) == 1 and flags[0]["op_index"] == 31
+            lj = json.loads((d / "live.json").read_text())
+            assert lj["worker"] == survivor_id and lj["epoch"] == 2
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGCONT)
+                    p.send_signal(signal.SIGINT)
+            for p in procs:
+                try:
+                    p.wait(10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+@pytest.mark.kill9
+class TestFleetSupervisor:
+    def test_workers_supervisor_restarts_dead_children(self, tmp_path):
+        """`--workers N`: the local supervisor spawns N
+        lease-coordinated workers and restarts a SIGKILLed one with
+        backoff."""
+        root = tmp_path / "store"
+        write_wal(root / "r" / "t1", register_ops(10))
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+             str(root), "--workers", "2", "--lease-ttl", "0.8",
+             "--backend", "host", "--poll-interval", "0.02",
+             "--worker-id", "sup-w"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            def child_pids():
+                out = subprocess.run(
+                    ["pgrep", "-f", "worker-id sup-w"],
+                    capture_output=True, text=True)
+                return sorted(int(p) for p in out.stdout.split())
+
+            # both workers come up and write their status sidecars
+            wait_for(lambda: len(child_pids()) >= 2, 30,
+                     "two fleet workers to start")
+            wait_for(lambda: (root / "fleet" / "sup-w0.json").exists()
+                     and (root / "fleet" / "sup-w1.json").exists(),
+                     30, "worker status sidecars")
+            before = child_pids()
+            os.kill(before[0], signal.SIGKILL)
+            # the supervisor restarts it (0.5s backoff + poll)
+            wait_for(lambda: len(child_pids()) >= 2
+                     and child_pids() != before, 30,
+                     "the supervisor to restart the dead worker")
+        finally:
+            sup.terminate()
+            try:
+                sup.wait(15)
+            except subprocess.TimeoutExpired:
+                sup.kill()
+            subprocess.run(["pkill", "-9", "-f", "worker-id sup-w"],
+                           capture_output=True)
+        # supervisor shutdown took its children with it
+        time.sleep(0.3)
+        out = subprocess.run(["pgrep", "-f", "worker-id sup-w"],
+                             capture_output=True, text=True)
+        assert not out.stdout.strip()
+
+
+# ---------------------------------------------------------------------------
+# the FleetTarget campaign smoke (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.kill9
+class TestFleetCampaign:
+    def test_fleet_target_campaign_smoke(self, tmp_path):
+        """A small coverage-guided campaign whose nemesis kills and
+        pauses CHECKER workers: both schedules complete, the fleet
+        keeps every planted flag exactly-once (verdict True — a
+        False here would be a real lease-protocol finding), and the
+        coverage matrix records which fault windows exercised the
+        takeover path."""
+        from jepsen_tpu import campaign as campaign_mod
+        target = campaign_mod.FleetTarget(
+            workers=2, tenants=1, lease_ttl=0.4, ops_per_tenant=60)
+        c = campaign_mod.Campaign(
+            "fleet-smoke", target, seed=7, schedules=2, bootstrap=2,
+            k_dry=8, mutants_per_novel=0, base_time_limit=1.4)
+        out = c.run()
+        assert out["run"] == 2
+        assert out["quarantined"] == 0
+        led = store.campaigns_root() / "fleet-smoke" / "ledger.jsonl"
+        assert led.exists()
+        results = [r["ev"] for r in follow_frames(led, key="ev").records
+                   if r["ev"]["type"] == "result"]
+        assert len(results) == 2
+        # no harness crashes, and no lost/duplicated flags: the fleet
+        # survived its own fault schedule
+        for r in results:
+            assert r["verdict"] is True, r
+            assert "flag-lost" not in r["anomalies"], r
+            assert "flag-dup" not in r["anomalies"], r
+        cov = json.loads((store.campaigns_root() / "fleet-smoke"
+                          / "coverage.json").read_text())
+        assert set(cov["nemeses"]) == {"kill-worker", "pause-worker"}
+        assert cov["cells"]
