@@ -166,6 +166,8 @@ class Linearizable(Checker):
         try:
             return wgl_seg.check(self.model, history, **seg_kw)
         except wgl_seg.Unsupported:
+            from jepsen_tpu import telemetry
+            telemetry.count_fallback("wgl_seg", "serial-frontier")
             return wgl.check(self.model, history, **ser_kw)
 
     _CPU_KEYS = ("max_configs", "time_limit")

@@ -311,6 +311,105 @@ def metrics_cmd_spec() -> dict:
                                 "windows)."}}
 
 
+def lint_cmd(opts) -> int:
+    """`lint [paths...]`: the repo-invariant linter + jaxpr auditor
+    (ISSUE 15).  The ast pass checks the discipline rules
+    (docs/lint.md) with inline `# lint: <token>-ok(reason)` waivers;
+    `--trace` additionally drives planner.plan_engines over the seeded
+    shape sweep and statically audits every traceable engine's
+    ClosedJaxpr (collective uniformity, callbacks, dtype exactness,
+    bucket determinism).  Findings ratchet against
+    store/ci/lint-baseline.json: exit 0 means nothing beyond the
+    baseline; `--write-baseline` accepts the current state (growing it
+    is a reviewable diff, shrinking it is the point)."""
+    from jepsen_tpu import lint as lint_mod
+    from jepsen_tpu.lint import baseline as baseline_mod
+    rules = list(opts.rule) if opts.rule else None
+    rep = lint_mod.run_lint(paths=(opts.paths or None), rules=rules)
+    findings = list(rep.findings)
+    audit = None
+    if opts.trace:
+        # The audit is about program STRUCTURE — trace it on a virtual
+        # 8-CPU mesh rather than initializing a hardware backend from
+        # an operator CLI (same recipe as tests/conftest.py; only when
+        # jax has not already been initialized by the embedder).
+        if "jax" not in sys.modules \
+                and os.environ.get("JAX_PLATFORMS") is None:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + " --xla_force_host_platform_device_count=8"
+                ).strip()
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        from jepsen_tpu.lint import trace_audit
+        audit = trace_audit.sweep(per_engine=opts.trace_per_engine)
+        findings += [f for f in audit.findings
+                     if rules is None or f.rule in rules]
+    bl_path = Path(opts.baseline) if opts.baseline \
+        else baseline_mod.baseline_path()
+    if opts.write_baseline:
+        p = baseline_mod.write(findings, bl_path)
+        print(f"baseline written: {p} "
+              f"({len(findings)} finding(s))", file=sys.stderr)
+        return 0
+    new = baseline_mod.new_findings(findings,
+                                    baseline_mod.load(bl_path))
+    if opts.json:
+        out = rep.to_json()
+        if audit is not None:
+            out["audit"] = audit.to_json()
+        out["baseline"] = str(bl_path)
+        out["new_findings"] = [f.to_json() for f in new]
+        print(json.dumps(out, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        n_base = len(findings) - len(new)
+        print(f"lint: {rep.files} file(s), {len(findings)} finding(s)"
+              f" ({len(new)} new, {n_base} baselined), "
+              f"{len(rep.waivers)} waiver(s)"
+              + (f"; trace: {audit.traced} kernel(s) across "
+                 f"{len(audit.summary()['engines'])} engine(s), "
+                 f"{len(audit.findings)} finding(s)"
+                 if audit is not None else ""),
+              file=sys.stderr)
+        for rel, err in rep.errors:
+            print(f"  unparseable: {rel}: {err}", file=sys.stderr)
+    return 1 if new else 0
+
+
+def lint_cmd_spec() -> dict:
+    def add_opts(parser):
+        parser.add_argument("paths", nargs="*", metavar="PATH",
+                            help="files/dirs to lint (default: the "
+                                 "jepsen_tpu source tree)")
+        parser.add_argument("--trace", action="store_true",
+                            help="also trace-audit every engine the "
+                                 "planner can emit over the seeded "
+                                 "shape sweep (jaxpr collective/dtype "
+                                 "audit)")
+        parser.add_argument("--rule", action="append", metavar="ID",
+                            help="restrict to specific rule id(s) "
+                                 "(repeatable)")
+        parser.add_argument("--json", action="store_true",
+                            help="machine-readable report on stdout")
+        parser.add_argument("--baseline", default=None, metavar="FILE",
+                            help="ratchet file (default: "
+                                 "store/ci/lint-baseline.json)")
+        parser.add_argument("--write-baseline", action="store_true",
+                            help="accept the current findings as the "
+                                 "new baseline")
+        parser.add_argument("--trace-per-engine", type=int, default=3,
+                            metavar="N",
+                            help="trace at most N buckets per engine")
+
+    return {"lint": {"opts": add_opts, "run": lint_cmd,
+                     "help": "Repo-invariant linter + jaxpr "
+                             "collective/dtype auditor, ratcheted "
+                             "against store/ci/lint-baseline.json."}}
+
+
 def campaign_cmd(opts, test_fn: Optional[Callable] = None,
                  registry: Optional[dict] = None) -> int:
     """`campaign [run|status]`: the coverage-guided nemesis-campaign
@@ -746,6 +845,7 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                     "help": "Rebuild a SIGKILLed run's history from its "
                             "WAL and re-analyze it."},
         **metrics_cmd_spec(),
+        **lint_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),
         **(campaign_cmd_spec(test_fn, nemesis_registry)
@@ -810,6 +910,7 @@ def standard_commands() -> dict:
                     "help": "Rebuild a SIGKILLed run's history files "
                             "from its history.wal."},
         **metrics_cmd_spec(),
+        **lint_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),
         **campaign_cmd_spec(),

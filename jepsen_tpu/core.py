@@ -702,6 +702,7 @@ def run(test: dict) -> dict:
     analyze, tear down.  Returns the test map with :history and
     :results."""
     test = dict(test)
+    # lint: wall-ok(run id / store-dir stamp, operator-facing; ordering is per-op monotonic ns)
     test["start-time"] = __import__("datetime").datetime.now().isoformat()
     test.setdefault("concurrency", len(test.get("nodes") or []))
     nodes = test.get("nodes") or []

@@ -305,6 +305,7 @@ def set_lost_fsync(host: str, prob_per_100k: int,
 def break_all(host: str, port: int = DEFAULT_PORT,
               timeout: float = 10.0) -> str:
     """All reads/writes/fsyncs fail EIO (charybdefs.clj break-all :72)."""
+    # lint: inject-ok(mechanism wrapper; nemeses register before dispatching)
     return set_fault(host, prob_per_100k=100000, port=port,
                      timeout=timeout)
 
@@ -312,6 +313,7 @@ def break_all(host: str, port: int = DEFAULT_PORT,
 def break_one_percent(host: str, port: int = DEFAULT_PORT,
                       timeout: float = 10.0) -> str:
     """1% of ops fail EIO (charybdefs.clj break-one-percent :77)."""
+    # lint: inject-ok(mechanism wrapper; nemeses register before dispatching)
     return set_fault(host, prob_per_100k=1000, port=port, timeout=timeout)
 
 
@@ -439,6 +441,7 @@ class DiskFaultNemesis(nem.Nemesis):
 
     def _apply(self, test, node, recipe) -> dict:
         host = self._addr(test, node)
+        # lint: inject-ok(invoke registered the clear-all undo before calling _apply)
         out = {"set": self._retry(node, lambda: set_fault(
             host,
             errno=recipe.get("errno", errno_mod.EIO),
@@ -447,10 +450,12 @@ class DiskFaultNemesis(nem.Nemesis):
             ops=recipe.get("ops", "read,write,fsync"),
             port=self.port, timeout=self.timeout))}
         if recipe.get("torn"):
+            # lint: inject-ok(invoke registered the clear-all undo before calling _apply)
             out["torn"] = self._retry(node, lambda: set_torn(
                 host, recipe["torn"], recipe.get("torn_bytes", 512),
                 port=self.port, timeout=self.timeout))
         if recipe.get("lost_fsync"):
+            # lint: inject-ok(invoke registered the clear-all undo before calling _apply)
             out["lostsync"] = self._retry(node, lambda: set_lost_fsync(
                 host, recipe["lost_fsync"], port=self.port,
                 timeout=self.timeout))

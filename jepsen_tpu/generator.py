@@ -28,6 +28,18 @@ from jepsen_tpu.history import Op
 
 NEMESIS = "nemesis"
 
+# Draw discipline (ISSUE 15, global-rng-in-draw): every random draw in
+# a generator goes through this module-scoped instance, never the
+# process-global `random` module — suites and campaigns can `reseed()`
+# the op stream deterministically without perturbing (or being
+# perturbed by) any other component's use of the global RNG.
+_rng = random.Random()
+
+
+def reseed(seed=None) -> None:
+    """Seed the generator draw stream (reproducible op mixes)."""
+    _rng.seed(seed)
+
 
 # ---------------------------------------------------------------------------
 # Dynamic bindings: *threads* and the time-limit deadline stack
@@ -246,7 +258,7 @@ def sleep(dt):
 def stagger(dt, gen):
     """Uniform random delay in [0, 2dt) — mean dt (generator.clj:197-202)."""
     assert dt > 0
-    return DelayFn(lambda: random.uniform(0, 2 * dt), gen)
+    return DelayFn(lambda: _rng.uniform(0, 2 * dt), gen)
 
 
 class DelayTil(Generator):
@@ -394,7 +406,7 @@ class Mix(Generator):
         self.gens = list(gens)
 
     def op(self, test, process):
-        return op(random.choice(self.gens), test, process)
+        return op(_rng.choice(self.gens), test, process)
 
 
 def mix(gens):
@@ -427,14 +439,14 @@ class _Cas(Generator):
     (generator.clj:358-372)."""
 
     def op(self, test, process):
-        r = random.random()
+        r = _rng.random()
         if r > 0.66:
             return {"type": "invoke", "f": "read", "value": None}
         if r > 0.33:
             return {"type": "invoke", "f": "write",
-                    "value": random.randint(0, 4)}
+                    "value": _rng.randint(0, 4)}
         return {"type": "invoke", "f": "cas",
-                "value": [random.randint(0, 4), random.randint(0, 4)]}
+                "value": [_rng.randint(0, 4), _rng.randint(0, 4)]}
 
 
 cas = _Cas()
@@ -449,7 +461,7 @@ class QueueGen(Generator):
         self.lock = threading.Lock()
 
     def op(self, test, process):
-        if random.random() < 0.5:
+        if _rng.random() < 0.5:
             with self.lock:
                 self.i += 1
                 v = self.i

@@ -755,6 +755,7 @@ class HistoryWAL:
                 # `w` (append wall clock) rides outside the guarded
                 # payload: follow()-based consumers measure detection
                 # lag from it; recover() ignores it.
+                # lint: wall-ok(advisory envelope stamp; recovery orders by i/crc, never w)
                 self._f.write(f'{{"i":{self._n},"w":{time.time():.6f},'
                               f'"crc":"{crc:08x}","op":{payload}}}\n')
                 self._f.flush()

@@ -1,0 +1,32 @@
+"""jlint: the repo-invariant linter + jaxpr collective/dtype auditor
+(ISSUE 15).
+
+Two static analyzers behind one `python -m jepsen_tpu.cli lint`
+command and one tier-1 test:
+
+  * `lint.rules` / `lint.engine` — Python `ast` rules enforcing the
+    tree's distributed-systems disciplines (monotonic-only decisions,
+    fsync-before-rename publishes, register-before-inject fault
+    hygiene, seeded draws, counted fallbacks, single-writer surfaces,
+    thread/loop hygiene), each with an id, span, fix hint, and an
+    inline-waiver grammar.
+  * `lint.trace_audit` — traces every engine the planner can emit
+    (via `planner.register_traceable` / `planner.traceable`) to its
+    ClosedJaxpr and statically verifies the collective-uniformity,
+    callback, dtype-exactness, and bucket-determinism invariants.
+
+Findings ratchet against `store/ci/lint-baseline.json`
+(`lint.baseline`): the tier-1 test fails on any finding not in the
+baseline, and shrinking the baseline is a one-line commit.  See
+docs/lint.md for the rule catalog and workflow.
+"""
+
+from jepsen_tpu.lint.baseline import (baseline_path, load,  # noqa: F401
+                                      new_findings, write)
+from jepsen_tpu.lint.engine import (Report, Waiver,  # noqa: F401
+                                    discover, lint_source, run_lint)
+from jepsen_tpu.lint.rules import RULES, Finding  # noqa: F401
+
+__all__ = ["Finding", "Report", "Waiver", "RULES", "discover",
+           "lint_source", "run_lint", "baseline_path", "load",
+           "new_findings", "write"]

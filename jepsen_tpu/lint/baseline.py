@@ -1,0 +1,73 @@
+"""The baseline ratchet: findings can only go DOWN.
+
+`store/ci/lint-baseline.json` holds the accepted finding counts keyed
+`rule::path::qualname` (stable across unrelated line churn).  The
+tier-1 lint test fails on any finding NOT covered by the baseline —
+never on pre-existing ones — so adopting a new rule is not a flag day:
+commit the found set as the baseline, then shrink it as fixes land.
+Shrinking is a one-line diff; growing it is a reviewable decision.
+
+Format:
+
+    {"version": 1,
+     "findings": {"<rule>::<path>::<qualname>": <count>, ...}}
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+__all__ = ["baseline_path", "load", "counts", "new_findings", "write"]
+
+
+def baseline_path(root=None) -> Path:
+    from jepsen_tpu.lint.engine import default_root
+    root = Path(root) if root is not None else default_root()
+    return root / "store" / "ci" / "lint-baseline.json"
+
+
+def load(path=None) -> dict:
+    """{key: count}; a missing baseline is the empty (strictest)
+    baseline, so a fresh tree starts fully ratcheted."""
+    p = Path(path) if path is not None else baseline_path()
+    if not p.exists():
+        return {}
+    with open(p) as f:
+        d = json.load(f)
+    out = d.get("findings", d) if isinstance(d, dict) else {}
+    return {str(k): int(v) for k, v in out.items()}
+
+
+def counts(findings) -> dict:
+    out: dict = {}
+    for f in findings:
+        out[f.key] = out.get(f.key, 0) + 1
+    return out
+
+
+def new_findings(findings, baseline: dict) -> list:
+    """Findings beyond the baseline's per-key allowance, in report
+    order — the set that fails the ratchet."""
+    budget = dict(baseline)
+    out: list = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+def write(findings, path=None) -> Path:
+    """Serialize the current finding counts as the new baseline
+    (deterministic ordering, trailing newline — diff-friendly)."""
+    p = Path(path) if path is not None else baseline_path()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    out = {"version": 1,
+           "findings": dict(sorted(counts(findings).items()))}
+    with open(p, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    return p

@@ -1,0 +1,219 @@
+"""The lint driver: file discovery, waiver application, repo pass.
+
+Discovery follows the same discipline `store.tests()` uses for run
+directories: artifact trees are never parsed as source.  `store/`
+(campaign ledgers, fleet sidecars, CI artifacts), `.cache/` (the JAX
+compilation cache), and `__pycache__` are skipped at ANY depth, as are
+symlinked directories (`store/latest` and friends are symlink cycles
+waiting to happen).  Regression-pinned by tests/test_lint.py.
+
+Waiver grammar:  `# lint: <token>-ok(<reason>)` on the flagged line or
+the line directly above.  The token is the rule's short name
+(rules.WAIVER_TOKENS: wall, rename, inject, rng, fallback, writer,
+thread, sleep).  A waiver with an empty reason does not waive — it IS
+a finding (`reasonless-waiver`): the whole point is that every
+exception to a discipline carries its justification in-line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import time
+from pathlib import Path
+from typing import Optional
+
+from jepsen_tpu.lint.rules import RULES, WAIVER_TOKENS, Finding, lint_tree
+
+__all__ = ["discover", "lint_source", "run_lint", "Report", "Waiver",
+           "EXCLUDE_DIRS", "LAST"]
+
+#: Directory names never descended into — the store.tests() discipline
+#: (campaign/fleet/CI artifacts are data, not source) plus the usual
+#: tooling litter.
+EXCLUDE_DIRS = frozenset({
+    "store", ".cache", "__pycache__", ".git", ".pytest_cache",
+    ".eggs", "build", "node_modules",
+})
+
+_WAIVER_MARK = re.compile(r"#\s*lint:\s*")
+_WAIVER_RE = re.compile(r"([a-z0-9_]+)-ok\(([^()]*)\)")
+
+#: The last run's report/audit, for the tier-1 CI artifact
+#: (tests/conftest.py reads it without re-running the pass).
+LAST: dict = {"report": None, "audit": None}
+
+
+@dataclasses.dataclass(frozen=True)
+class Waiver:
+    rule: str
+    path: str
+    line: int
+    reason: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list
+    waivers: list
+    files: int = 0
+    errors: list = dataclasses.field(default_factory=list)
+    wall_s: float = 0.0
+
+    def by_rule(self) -> dict:
+        out: dict = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def to_json(self) -> dict:
+        return {"findings": [f.to_json() for f in self.findings],
+                "waivers": [w.to_json() for w in self.waivers],
+                "files": self.files,
+                "errors": list(self.errors),
+                "wall_s": round(self.wall_s, 3)}
+
+
+def discover(paths, root: Optional[Path] = None) -> list:
+    """All lintable .py files under `paths` (files pass through),
+    sorted, with EXCLUDE_DIRS and symlinked directories skipped at any
+    depth — store/, .cache/ and __pycache__ hold campaign ledgers,
+    fleet sidecars and compile caches that must never be parsed as
+    source."""
+    out: list = []
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            if p.suffix == ".py":
+                out.append(p)
+            continue
+        if not p.is_dir():
+            continue
+        stack = [p]
+        while stack:
+            d = stack.pop()
+            try:
+                entries = sorted(d.iterdir())
+            except OSError:
+                continue
+            for e in entries:
+                if e.is_dir():
+                    if e.name in EXCLUDE_DIRS or e.is_symlink():
+                        continue
+                    stack.append(e)
+                elif e.suffix == ".py" and not e.is_symlink():
+                    out.append(e)
+    return sorted(set(out))
+
+
+def _parse_waivers(src: str) -> dict:
+    """{lineno: [(token, reason), ...]} for every waiver comment."""
+    out: dict = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        mark = _WAIVER_MARK.search(line)
+        if mark is None:
+            continue
+        # several `<token>-ok(reason)` waivers may share one `# lint:`
+        # marker (a line can trip more than one rule)
+        for m in _WAIVER_RE.finditer(line[mark.end():]):
+            out.setdefault(i, []).append(
+                (m.group(1), m.group(2).strip()))
+    return out
+
+
+def lint_source(src: str, relpath: str, rules=None):
+    """(findings, waivers) for one module's source.  Rule findings with
+    a matching reasoned waiver on their line (or the line above) are
+    converted to Waiver records; reasonless waivers surface as
+    `reasonless-waiver` findings at the waiver site."""
+    tree = ast.parse(src)
+    raw = lint_tree(tree, relpath, rules=rules)
+    waiver_lines = _parse_waivers(src)
+
+    findings: list = []
+    waivers: list = []
+    for f in raw:
+        token = WAIVER_TOKENS.get(f.rule)
+        reason = None
+        for ln in (f.line, f.line - 1):
+            for tok, why in waiver_lines.get(ln, []):
+                if tok == token and why:
+                    reason = why
+                    break
+            if reason:
+                break
+        if reason:
+            waivers.append(Waiver(f.rule, f.path, f.line, reason))
+        else:
+            findings.append(f)
+    for ln, toks in sorted(waiver_lines.items()):
+        for tok, why in toks:
+            if not why:
+                findings.append(Finding(
+                    "reasonless-waiver", relpath, ln, 0,
+                    f"waiver `{tok}-ok()` without a reason",
+                    "every waiver must say WHY the discipline doesn't "
+                    "apply: `# lint: " + tok + "-ok(<reason>)`"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, waivers
+
+
+def default_root() -> Path:
+    """The repo root the CLI and baseline anchor to: the parent of the
+    installed jepsen_tpu package (stable regardless of cwd)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def default_paths() -> list:
+    """What `cli lint` checks with no path arguments: the package
+    source tree."""
+    return [Path(__file__).resolve().parent.parent]
+
+
+def run_lint(paths=None, root: Optional[Path] = None, rules=None,
+             counters: bool = True) -> Report:
+    """The repo pass: discover, parse, rule-check, waive.  Unparseable
+    files land in report.errors (a linter must degrade, not crash the
+    suite).  Findings/waivers are counted into the process registry
+    (`jepsen_lint_total{rule=,kind=}`) unless counters=False."""
+    t0 = time.monotonic()
+    root = Path(root) if root is not None else default_root()
+    files = discover(paths if paths is not None else default_paths(),
+                     root)
+    findings: list = []
+    waivers: list = []
+    errors: list = []
+    for p in files:
+        try:
+            rel = p.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = p.as_posix()
+        try:
+            src = p.read_text(encoding="utf-8", errors="replace")
+            fs, ws = lint_source(src, rel, rules=rules)
+        except (SyntaxError, ValueError, OSError) as e:
+            errors.append((rel, f"{type(e).__name__}: {e}"))
+            continue
+        findings.extend(fs)
+        waivers.extend(ws)
+    rep = Report(findings=findings, waivers=waivers, files=len(files),
+                 errors=errors, wall_s=time.monotonic() - t0)
+    if paths is None:
+        # the canonical repo pass only: ad-hoc passes over explicit
+        # paths (CLI on a fixture dir, tests on tmp trees) must not
+        # clobber the row the tier-1 CI artifact reads
+        LAST["report"] = rep
+    if counters:
+        try:
+            from jepsen_tpu import telemetry
+            for f in findings:
+                telemetry.count_lint(f.rule, "finding")
+            for w in waivers:
+                telemetry.count_lint(w.rule, "waiver")
+        except Exception:   # noqa: BLE001 - telemetry is advisory
+            pass
+    return rep

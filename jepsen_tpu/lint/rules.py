@@ -1,0 +1,594 @@
+"""The repo-invariant rule catalog (ISSUE 15).
+
+Fourteen PRs of distributed-systems discipline live in this tree as
+*conventions*: monotonic-only expiry decisions, fsync-before-rename
+atomic publishes, register-before-inject fault hygiene, seeded draw
+paths, counted fallback ladders, single-writer-under-lease surfaces.
+Until now each was enforced only by whatever runtime battery happened
+to exercise the violating path.  Elle's core lesson (Kingsbury &
+Alvaro, PVLDB'20) is that soundness arguments should be *checkable
+properties*; this module makes each convention a small `ast` visitor
+with an id, a span, and a fix hint.
+
+Every rule supports an inline waiver: `# lint: <token>-ok(<reason>)`
+on the flagged line (or the line above) downgrades the finding to a
+counted waiver — but only with a non-empty reason; a reasonless waiver
+is itself a finding (`reasonless-waiver`).  See docs/lint.md for the
+catalog with the *why* behind each discipline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+__all__ = ["Finding", "RULES", "WAIVER_TOKENS", "lint_tree"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule hit: id, span, fix hint, and the enclosing qualname
+    (the baseline key is (rule, path, qualname) — stable across the
+    line churn of unrelated edits, unlike raw line numbers)."""
+
+    rule: str
+    path: str                   # root-relative posix path
+    line: int
+    col: int
+    msg: str
+    hint: str = ""
+    qualname: str = "<module>"
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}::{self.path}::{self.qualname}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        out = (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+               f"[{self.qualname}] {self.msg}")
+        return out + (f"\n    fix: {self.hint}" if self.hint else "")
+
+
+# rule id -> waiver token (the `<token>-ok(reason)` spelling)
+WAIVER_TOKENS = {
+    "wall-clock-in-frame": "wall",
+    "unfsynced-rename": "rename",
+    "inject-before-register": "inject",
+    "global-rng-in-draw": "rng",
+    "bare-fallback": "fallback",
+    "stray-writer": "writer",
+    "unjoined-thread": "thread",
+    "naked-sleep-loop": "sleep",
+}
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+def _attr_chain(func):
+    """(base_name | None, [attr, ...]) for a Name/Attribute call target:
+    `os.replace` -> ("os", ["replace"]); `__import__("x").datetime.now`
+    -> (None, ["datetime", "now"]) — a non-Name base is None so rules
+    can still match trailing attribute patterns."""
+    attrs: list = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        attrs.append(node.attr)
+        node = node.value
+    attrs.reverse()
+    return (node.id if isinstance(node, ast.Name) else None), attrs
+
+
+def _dotted(func):
+    base, attrs = _attr_chain(func)
+    if base is None:
+        return None
+    return ".".join([base] + attrs)
+
+
+def _last_name(func):
+    """The final identifier of a call target (`x.y.z` -> 'z',
+    `z` -> 'z')."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _iter_scoped(tree):
+    """Yield (node, scope_stack) with scope_stack the enclosing
+    FunctionDef/AsyncFunctionDef/ClassDef chain (innermost last; a def
+    node's own stack includes itself)."""
+    stack: list = []
+
+    def rec(node):
+        scoped = isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef))
+        if scoped:
+            stack.append(node)
+        yield node, tuple(stack)
+        for child in ast.iter_child_nodes(node):
+            yield from rec(child)
+        if scoped:
+            stack.pop()
+
+    yield from rec(tree)
+
+
+def _qualname(stack) -> str:
+    return ".".join(n.name for n in stack) or "<module>"
+
+
+def _innermost_func(stack):
+    for node in reversed(stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    return None
+
+
+def _enclosing_class(stack):
+    for node in reversed(stack):
+        if isinstance(node, ast.ClassDef):
+            return node
+    return None
+
+
+def _calls_in(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _docstring_consts(tree) -> set:
+    """id()s of docstring Constant nodes, so literal scans can ignore
+    prose."""
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = getattr(node, "body", [])
+            if body and isinstance(body[0], ast.Expr) \
+                    and isinstance(body[0].value, ast.Constant) \
+                    and isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wall-clock-in-frame
+# ---------------------------------------------------------------------------
+#
+# WHY: crc'd frame envelopes (history WAL, telemetry EventLog,
+# live.jsonl) and every lease/breaker *expiry decision* must be
+# monotonic-only — wall clocks skew, and Jepsen's own clock nemeses
+# exist precisely because systems that decide with time.time() lie
+# under skew.  Advisory wall stamps (operator display, run ids, SUT
+# workloads) are legitimate but must say so: `# lint: wall-ok(why)`.
+
+def _rule_wall_clock(ctx) -> list:
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        base, attrs = _attr_chain(node.func)
+        tail = attrs[-1] if attrs else None
+        hit = False
+        if tail in ("time", "time_ns"):
+            prev = attrs[-2] if len(attrs) >= 2 else base
+            hit = prev == "time"
+        elif tail in ("now", "utcnow"):
+            prev = attrs[-2] if len(attrs) >= 2 else base
+            hit = prev == "datetime"
+        if hit:
+            out.append(Finding(
+                "wall-clock-in-frame", ctx.relpath, node.lineno,
+                node.col_offset,
+                "wall-clock read on a frame/decision path "
+                "(monotonic-only discipline)",
+                "decide with time.monotonic(); an advisory wall stamp "
+                "needs `# lint: wall-ok(<reason>)`",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unfsynced-rename
+# ---------------------------------------------------------------------------
+#
+# WHY: the atomic-publish discipline (lease.json / live.json / ledger
+# frames) is tmp-write -> fsync -> rename; an os.replace whose source
+# was never fsynced can publish a zero-length file after power loss —
+# exactly the torn-surface class the fleet's takeover path defends
+# against.  The fsync may live in a local helper (e.g. `_write_tmp`);
+# the rule resolves module-local helpers transitively.
+
+def _fsyncing_functions(tree) -> set:
+    """Names of module functions whose bodies (transitively, within the
+    module) call os.fsync."""
+    funcs = {node.name: node for node in ast.walk(tree)
+             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    syncing: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, node in funcs.items():
+            if name in syncing:
+                continue
+            for call in _calls_in(node):
+                if _dotted(call.func) == "os.fsync" \
+                        or _last_name(call.func) in syncing:
+                    syncing.add(name)
+                    changed = True
+                    break
+    return syncing
+
+
+def _rule_unfsynced_rename(ctx) -> list:
+    out = []
+    syncing = _fsyncing_functions(ctx.tree)
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func) not in ("os.rename", "os.replace"):
+            continue
+        scope = _innermost_func(stack) or ctx.tree
+        ok = False
+        for call in _calls_in(scope):
+            if call.lineno > node.lineno:
+                continue
+            if _dotted(call.func) == "os.fsync" \
+                    or _last_name(call.func) in syncing:
+                ok = True
+                break
+        if not ok:
+            out.append(Finding(
+                "unfsynced-rename", ctx.relpath, node.lineno,
+                node.col_offset,
+                "atomic publish without a preceding fsync "
+                "(rename of never-synced bytes)",
+                "fsync the staged file (or a helper that does) before "
+                "the rename; a non-publish rename needs "
+                "`# lint: rename-ok(<reason>)`",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# inject-before-register
+# ---------------------------------------------------------------------------
+#
+# WHY: PR 4's fault hygiene — a nemesis records its undo in the
+# FaultLedger BEFORE injecting, so a nemesis that dies mid-fault (or a
+# run torn down with one active) still gets healed by the run_case
+# backstop, and campaign.assert_empty can prove no fault leaked.  An
+# unregistered injection is invisible to both.
+
+_INJECT_FILES = ("nemesis.py", "nemesis_time.py", "faultfs.py")
+_INJECT_CALLS = frozenset({
+    "drop_all", "set_time", "bump_time", "strobe_time",
+    "set_fault", "set_torn", "set_lost_fsync",
+})
+
+
+def _rule_inject_before_register(ctx) -> list:
+    if ctx.basename not in _INJECT_FILES:
+        return []
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _last_name(node.func)
+        if name not in _INJECT_CALLS:
+            continue
+        # the primitive's own definition is mechanism, not injection
+        if any(isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and s.name == name for s in stack):
+            continue
+        registered = False
+        for scope in stack:
+            if not isinstance(scope, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                continue
+            for call in _calls_in(scope):
+                if call.lineno < node.lineno \
+                        and _last_name(call.func) == "register":
+                    registered = True
+                    break
+            if registered:
+                break
+        if not registered:
+            out.append(Finding(
+                "inject-before-register", ctx.relpath, node.lineno,
+                node.col_offset,
+                f"fault injection `{name}` without a preceding "
+                "FaultLedger.register",
+                "register the undo in the test's fault ledger before "
+                "injecting; heal/teardown paths need "
+                "`# lint: inject-ok(<reason>)`",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# global-rng-in-draw
+# ---------------------------------------------------------------------------
+#
+# WHY: campaign schedule draws and generator op draws must thread
+# explicit seeds (random.Random(seed)) or campaigns stop being
+# resumable and coverage stops being reproducible — the PR 11 fixup
+# exists because one outcome-dependent draw silently diverged replays.
+
+_RNG_FILES = ("campaign.py", "generator.py")
+_RNG_CALLS = frozenset({
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "expovariate",
+    "betavariate", "triangular", "getrandbits", "seed",
+})
+
+
+def _rule_global_rng(ctx) -> list:
+    if ctx.basename not in _RNG_FILES:
+        return []
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        base, attrs = _attr_chain(node.func)
+        if base == "random" and len(attrs) == 1 \
+                and attrs[0] in _RNG_CALLS:
+            out.append(Finding(
+                "global-rng-in-draw", ctx.relpath, node.lineno,
+                node.col_offset,
+                f"process-global random.{attrs[0]}() in a draw path",
+                "thread an explicit random.Random(seed) instance "
+                "through the draw",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# bare-fallback
+# ---------------------------------------------------------------------------
+#
+# WHY: the engine fallback ladders degrade by design (Unsupported ->
+# next tier), but a rung taken SILENTLY is how a perf cliff hides in a
+# green suite — every typed-error handler must leave a telemetry trace
+# (jepsen_engine_fallback_total) or re-raise, so `cli metrics` and the
+# CI artifact can show the engine mix actually run.
+
+_TYPED_ERRORS = frozenset({
+    "Unsupported", "CheckError", "DeviceOOM", "DeadlineExceeded",
+    "BackendUnavailable", "CorruptHistory",
+})
+_COUNTED_CALLS = frozenset({
+    "count_fallback", "emit", "fault_window", "attach_dispatch",
+    "_count_pack",
+})
+
+
+def _handler_types(handler) -> set:
+    t = handler.type
+    nodes = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+    return {_last_name(n) for n in nodes} - {None}
+
+
+def _rule_bare_fallback(ctx) -> list:
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_handler_types(node) & _TYPED_ERRORS):
+            continue
+        counted = False
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Raise):
+                    counted = True
+                elif isinstance(sub, ast.Call):
+                    if _last_name(sub.func) in _COUNTED_CALLS \
+                            or _last_name(sub.func) == "inc":
+                        counted = True
+                if counted:
+                    break
+            if counted:
+                break
+        if not counted:
+            out.append(Finding(
+                "bare-fallback", ctx.relpath, node.lineno,
+                node.col_offset,
+                "typed engine error swallowed without a telemetry "
+                "count or re-raise (silent fallback rung)",
+                "telemetry.count_fallback(<engine>, <reason>) in the "
+                "handler, or re-raise",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stray-writer
+# ---------------------------------------------------------------------------
+#
+# WHY: live.jsonl and lease.json are single-writer-under-lease
+# surfaces — the fleet's exactly-once and fencing guarantees hold only
+# because every write goes through the scheduler's lease check.  Any
+# other module opening them for write is a fenced-bypass bug waiting
+# for a fault schedule to find it.
+
+_GUARDED_FILES = ("live.jsonl", "lease.json")
+_ALLOWED_WRITERS = ("live/scheduler.py", "live/lease.py")
+_WRITE_ATTRS = frozenset({"write_text", "write_bytes"})
+
+
+def _mentions_guarded(node, doc_ids) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
+                and id(sub) not in doc_ids \
+                and any(g in sub.value for g in _GUARDED_FILES):
+            return True
+    return False
+
+
+def _is_write_call(call) -> bool:
+    name = _last_name(call.func)
+    if name in _WRITE_ATTRS or name == "EventLog":
+        return True
+    if _dotted(call.func) in ("os.replace", "os.rename", "os.link"):
+        return True
+    if isinstance(call.func, ast.Name) and call.func.id == "open" \
+            or name == "open":
+        mode = None
+        if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+            mode = call.args[1].value
+        for kw in call.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) \
+            and any(c in mode for c in "wax+")
+    return False
+
+
+def _rule_stray_writer(ctx) -> list:
+    if ctx.relpath.endswith(_ALLOWED_WRITERS):
+        return []
+    doc_ids = _docstring_consts(ctx.tree)
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call) or not _is_write_call(node):
+            continue
+        scope = _innermost_func(stack) or ctx.tree
+        # taint: the call's own subtree, or a name bound to a guarded
+        # literal within the enclosing scope
+        tainted_names: set = set()
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Assign) \
+                    and _mentions_guarded(sub.value, doc_ids):
+                for tgt in sub.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            tainted_names.add(n.id)
+        hit = _mentions_guarded(node, doc_ids) or any(
+            isinstance(n, ast.Name) and n.id in tainted_names
+            for a in (list(node.args)
+                      + [kw.value for kw in node.keywords])
+            for n in ast.walk(a))
+        if hit:
+            out.append(Finding(
+                "stray-writer", ctx.relpath, node.lineno,
+                node.col_offset,
+                "write to a single-writer-under-lease surface "
+                "(live.jsonl / lease.json) outside scheduler/lease "
+                "code",
+                "route the write through live/scheduler.py (lease-"
+                "checked) or live/lease.py",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unjoined-thread / naked-sleep-loop (hygiene)
+# ---------------------------------------------------------------------------
+#
+# WHY: a non-daemon thread nobody joins outlives the test that spawned
+# it and bleeds state into the next one (the CI-leak class PR 11's
+# fixup chased); a `while True` that sleeps with no exit edge can only
+# be killed, never drained — both are the stuff of flaky tier-1 runs.
+
+def _rule_unjoined_thread(ctx) -> list:
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        base, attrs = _attr_chain(node.func)
+        if not ((attrs and attrs[-1] == "Thread")
+                or (base == "Thread" and not attrs)):
+            continue
+        daemon = any(kw.arg == "daemon"
+                     and isinstance(kw.value, ast.Constant)
+                     and kw.value.value is True
+                     for kw in node.keywords)
+        if daemon:
+            continue
+        search = [_innermost_func(stack) or ctx.tree]
+        cls = _enclosing_class(stack)
+        if cls is not None:
+            search.append(cls)
+        joined = any(_last_name(call.func) == "join"
+                     for scope in search
+                     for call in _calls_in(scope))
+        if not joined:
+            out.append(Finding(
+                "unjoined-thread", ctx.relpath, node.lineno,
+                node.col_offset,
+                "non-daemon Thread that is never joined in its scope",
+                "daemon=True for background workers, or join() on "
+                "every exit path",
+                _qualname(stack)))
+    return out
+
+
+def _rule_naked_sleep_loop(ctx) -> list:
+    out = []
+    for node, stack in _iter_scoped(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        if not (isinstance(node.test, ast.Constant)
+                and node.test.value):
+            continue
+        body_nodes = [n for stmt in node.body for n in ast.walk(stmt)]
+        sleeps = any(isinstance(n, ast.Call)
+                     and _dotted(n.func) == "time.sleep"
+                     for n in body_nodes)
+        exits = any(isinstance(n, (ast.Break, ast.Return, ast.Raise))
+                    for n in body_nodes)
+        if sleeps and not exits:
+            out.append(Finding(
+                "naked-sleep-loop", ctx.relpath, node.lineno,
+                node.col_offset,
+                "unbounded `while True` sleep loop with no exit edge",
+                "poll a stop Event / deadline, or break on a "
+                "condition",
+                _qualname(stack)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+RULES = {
+    "wall-clock-in-frame": _rule_wall_clock,
+    "unfsynced-rename": _rule_unfsynced_rename,
+    "inject-before-register": _rule_inject_before_register,
+    "global-rng-in-draw": _rule_global_rng,
+    "bare-fallback": _rule_bare_fallback,
+    "stray-writer": _rule_stray_writer,
+    "unjoined-thread": _rule_unjoined_thread,
+    "naked-sleep-loop": _rule_naked_sleep_loop,
+}
+
+
+@dataclasses.dataclass
+class _Ctx:
+    tree: ast.AST
+    relpath: str
+    basename: str
+
+
+def lint_tree(tree: ast.AST, relpath: str, rules=None) -> list:
+    """Run the (selected) rules over one parsed module.  Waiver
+    application happens in engine.lint_source — this is the raw rule
+    pass."""
+    ctx = _Ctx(tree=tree, relpath=relpath.replace("\\", "/"),
+               basename=relpath.replace("\\", "/").rsplit("/", 1)[-1])
+    selected = RULES if rules is None else {
+        r: RULES[r] for r in rules if r in RULES}
+    out: list = []
+    for fn in selected.values():
+        out.extend(fn(ctx))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

@@ -1,0 +1,630 @@
+"""Static jaxpr audit of the engines a plan can emit (ISSUE 15).
+
+A non-uniform collective inside `shard_map` is not a test failure — it
+is a silent fleet hang: one device exits the `while_loop` early, its
+peers block in `ppermute`/`psum` forever, and the first symptom is a
+wedged mesh in production.  The deep hypercube shard and the mesh Elle
+closure avoid this by construction (every trip decision is a psum'd
+frontier count, uniform across devices — the rendezvous invariant
+PR 10 could only pin dynamically); this module verifies it
+*statically*, on the traced ClosedJaxpr, for every engine the planner
+can emit over its seeded shape sweep.
+
+Checks per traced kernel:
+
+  * **trace-nonuniform-collective** — every `while_loop` whose body
+    contains a rendezvous collective must have a mesh-uniform trip
+    condition.  Uniformity is a dataflow fixpoint over the jaxpr:
+    full-axis `psum`/`pmin`/`pmax`/`all_gather` outputs are uniform;
+    `axis_index`, `ppermute`, `all_to_all` and sharded inputs are
+    varying; everything else propagates its inputs.
+  * **trace-host-callback** — no host callbacks (implicit D2H
+    round-trips) inside dispatch bodies.
+  * **trace-dot-inexact** — closure matmuls must keep 0/1-exactness:
+    bf16 operands require f32+ accumulation (or a contracting dim
+    <= 256, bf16's exact-integer range); f16 and f64 operands are
+    findings outright (f64 is a 4x VMEM bill for a boolean product).
+  * **trace-dynamic-shape** — no data-dependent output shapes: every
+    traced aval must be fully static.
+  * **trace-bucket-collision** — every traced shape is a function of
+    the plan's bucket key alone; two sweeps of the same bucket tracing
+    different signatures means the executable cache key under-keys and
+    a recompile storm ships as a bench regression.
+  * **trace-undonated** — donated buffers must actually donate: on
+    backends that implement donation, a dropped-donation warning at
+    lower time is a finding (skipped — and counted as skipped — on
+    cpu, where XLA ignores donation by design).
+
+Engines are obtained through the planner's traceable-callable hook
+(`planner.register_traceable` / `planner.traceable`): this module
+registers builders for `elle-mesh`, `wgl_deep_hc`,
+`wgl_deep`/`wgl_deep_split`/`wgl_deep_pipeline`, and `live-jit`;
+builders derive every example shape from the plan BUCKET alone, which
+is what makes the bucket-collision check meaningful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+from typing import Optional
+
+from jepsen_tpu.lint.rules import Finding
+
+__all__ = ["audit_closed_jaxpr", "sweep", "seeded_shapes",
+           "register_builtin_traceables", "AuditResult"]
+
+#: Primitives that rendezvous across the mesh (a device missing one
+#: hangs its peers).
+COLLECTIVES = frozenset({
+    "psum", "ppermute", "all_gather", "all_to_all", "pmin", "pmax",
+    "reduce_scatter", "pgather", "psum2",
+})
+#: Full-axis reductions whose result is identical on every device —
+#: the uniformity sources (gated on axis_index_groups is None).
+UNIFORMIZING = frozenset({"psum", "pmin", "pmax", "all_gather",
+                          "psum2"})
+#: Host-callback primitives: an implicit D2H round-trip inside a
+#: dispatch body ("debug_callback" is excluded — prints are not on the
+#: verdict path).
+CALLBACKS = frozenset({"pure_callback", "io_callback", "callback",
+                       "outside_call", "host_callback_call"})
+
+
+# ---------------------------------------------------------------------------
+# Uniformity dataflow
+# ---------------------------------------------------------------------------
+
+def _inner_jaxpr(obj):
+    """Open jaxpr from an open/closed jaxpr param."""
+    return obj.jaxpr if hasattr(obj, "jaxpr") else obj
+
+
+def _sub_jaxprs(eqn):
+    """Every jaxpr nested in an eqn's params (while/scan/cond/pjit/
+    pallas_call/custom_* alike), as open jaxprs."""
+    out = []
+    for v in eqn.params.values():
+        if hasattr(v, "eqns") or (hasattr(v, "jaxpr")
+                                  and hasattr(v.jaxpr, "eqns")):
+            out.append(_inner_jaxpr(v))
+        elif isinstance(v, (tuple, list)):
+            for b in v:
+                if hasattr(b, "eqns") or (hasattr(b, "jaxpr")
+                                          and hasattr(b.jaxpr, "eqns")):
+                    out.append(_inner_jaxpr(b))
+    return out
+
+
+def _contains_collective(jaxpr) -> bool:
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in COLLECTIVES:
+            return True
+        for sub in _sub_jaxprs(eqn):
+            if _contains_collective(sub):
+                return True
+    return False
+
+
+def _is_uniformizing(eqn) -> bool:
+    return eqn.primitive.name in UNIFORMIZING \
+        and eqn.params.get("axis_index_groups") is None
+
+
+class _Uniformity:
+    """Dataflow over one mesh-body jaxpr: which values are provably
+    identical across the mesh axis.  Conservative: anything not proven
+    uniform is varying, so a false `nonuniform` is possible (waivable)
+    but a false `uniform` is not — the analysis errs toward flagging.
+    """
+
+    def __init__(self, findings: list, where: str):
+        self.findings = findings
+        self.where = where
+
+    def run(self, jaxpr, uniform_in) -> list:
+        """Propagate through one open jaxpr; returns out-var
+        uniformity.  constvars (host-baked numpy constants) are
+        uniform by construction."""
+        env: dict = {}
+
+        def get(atom) -> bool:
+            # Literals are uniform; unknown vars (constvars) default
+            # uniform — they were closed over from the host
+            return env.get(id(atom), True) \
+                if type(atom).__name__ != "Literal" else True
+
+        def put(var, val: bool) -> None:
+            env[id(var)] = bool(val)
+
+        for var, u in zip(jaxpr.invars, uniform_in):
+            put(var, u)
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ins = [get(a) for a in eqn.invars]
+            if name == "axis_index":
+                outs = [False] * len(eqn.outvars)
+            elif name in COLLECTIVES:
+                outs = [_is_uniformizing(eqn)] * len(eqn.outvars)
+            elif name == "while":
+                outs = self._while(eqn, ins)
+            elif name == "scan":
+                outs = self._scan(eqn, ins)
+            elif name == "cond":
+                outs = self._cond(eqn, ins)
+            elif name in ("pjit", "closed_call", "core_call",
+                          "custom_jvp_call", "custom_vjp_call",
+                          "remat", "checkpoint", "custom_vmap_call"):
+                subs = _sub_jaxprs(eqn)
+                if subs:
+                    sub_out = self.run(subs[0],
+                                       ins[:len(subs[0].invars)]
+                                       + [True] * max(
+                                           0, len(subs[0].invars)
+                                           - len(ins)))
+                    outs = sub_out[:len(eqn.outvars)] \
+                        + [all(ins)] * max(0, len(eqn.outvars)
+                                           - len(sub_out))
+                else:
+                    outs = [all(ins)] * len(eqn.outvars)
+            else:
+                # default: pointwise/structural — uniform iff every
+                # input is.  Nested jaxprs (e.g. pallas_call) run on
+                # one device; no mesh semantics inside.
+                outs = [all(ins)] * len(eqn.outvars)
+            for var, u in zip(eqn.outvars, outs):
+                put(var, u)
+        return [get(v) for v in jaxpr.outvars]
+
+    def _while(self, eqn, ins) -> list:
+        cond_j = _inner_jaxpr(eqn.params["cond_jaxpr"])
+        body_j = _inner_jaxpr(eqn.params["body_jaxpr"])
+        cn = eqn.params.get("cond_nconsts", 0)
+        bn = eqn.params.get("body_nconsts", 0)
+        cconst, bconst = ins[:cn], ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        # fixpoint: a carry slot is uniform only if its init is AND
+        # the body preserves it (monotone meet; findings from nested
+        # eqns are collected once, after convergence)
+        sink = _Uniformity([], self.where)
+        for _ in range(len(carry) + 2):
+            out = sink.run(body_j, bconst + carry)
+            nxt = [a and b for a, b in zip(carry, out)]
+            if nxt == carry:
+                break
+            carry = nxt
+        body_out = self.run(body_j, bconst + carry)
+        trip = _Uniformity([], self.where).run(cond_j, cconst + carry)
+        trip_uniform = all(trip) if trip else True
+        if _contains_collective(body_j) and not trip_uniform:
+            self.findings.append(Finding(
+                "trace-nonuniform-collective", self.where, 0, 0,
+                "while_loop body rendezvouses on a collective but its "
+                "trip condition is not provably mesh-uniform (one "
+                "device can exit while peers block — a silent fleet "
+                "hang)",
+                "derive the trip decision from a psum'd frontier "
+                "count (shard_map_compat.frontier_settled)",
+                "while"))
+        return [a and b for a, b in zip(carry, body_out)]
+
+    def _scan(self, eqn, ins) -> list:
+        body_j = _sub_jaxprs(eqn)[0]
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        const, carry = ins[:nc], list(ins[nc:nc + ncar])
+        xs = ins[nc + ncar:]
+        sink = _Uniformity([], self.where)
+        for _ in range(len(carry) + 2):
+            out = sink.run(body_j, const + carry + xs)
+            nxt = [a and b for a, b in zip(carry, out[:ncar])]
+            if nxt == carry:
+                break
+            carry = nxt
+        out = self.run(body_j, const + carry + xs)
+        return carry + out[ncar:]
+
+    def _cond(self, eqn, ins) -> list:
+        branches = [_inner_jaxpr(b) for b in eqn.params["branches"]]
+        idx_u, op_ins = ins[0], ins[1:]
+        outs = None
+        for b in branches:
+            o = self.run(b, op_ins)
+            outs = o if outs is None else [a and c
+                                           for a, c in zip(outs, o)]
+        outs = outs or []
+        return [idx_u and o for o in outs] \
+            + [idx_u] * max(0, len(eqn.outvars) - len(outs))
+
+
+# ---------------------------------------------------------------------------
+# Per-eqn audits
+# ---------------------------------------------------------------------------
+
+def _audit_dot(eqn, where: str, findings: list) -> None:
+    lhs = eqn.invars[0].aval
+    out = eqn.outvars[0].aval
+    # name-based: bf16 is an ml_dtypes extension type that
+    # np.issubdtype does not classify as floating
+    name = str(lhs.dtype)
+    if name not in ("float64", "float32", "float16", "bfloat16"):
+        return
+    dims = eqn.params.get("dimension_numbers")
+    contract = 1
+    if dims:
+        for d in dims[0][0]:
+            contract *= int(lhs.shape[d])
+    if name == "float64":
+        findings.append(Finding(
+            "trace-dot-inexact", where, 0, 0,
+            "f64 matmul in a closure kernel (4x the VMEM/HBM bill of "
+            "the bf16 0/1-exact form)",
+            "cast 0/1 operands to bf16 with "
+            "preferred_element_type=f32", "dot_general"))
+    elif name == "float16":
+        findings.append(Finding(
+            "trace-dot-inexact", where, 0, 0,
+            "f16 matmul: 10 mantissa bits cannot carry the closure "
+            "counts bf16+f32 accumulation keeps exact",
+            "use bf16 operands with preferred_element_type=f32",
+            "dot_general"))
+    elif name == "bfloat16" and str(out.dtype) == "bfloat16" \
+            and contract > 256:
+        findings.append(Finding(
+            "trace-dot-inexact", where, 0, 0,
+            f"bf16 matmul accumulating in bf16 over a {contract}-wide "
+            "contraction: 0/1 sums past 256 lose exactness",
+            "preferred_element_type=jnp.float32 on the dot",
+            "dot_general"))
+
+
+def _audit_eqns(jaxpr, where: str, findings: list, stats: dict,
+                in_mesh: bool) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        stats["eqns"] = stats.get("eqns", 0) + 1
+        if name in COLLECTIVES:
+            stats["collectives"] = stats.get("collectives", 0) + 1
+        if name == "while":
+            stats["whiles"] = stats.get("whiles", 0) + 1
+        if name in CALLBACKS:
+            findings.append(Finding(
+                "trace-host-callback", where, 0, 0,
+                f"host callback `{name}` inside a dispatch body "
+                "(implicit D2H round-trip on the verdict path)",
+                "hoist host work out of the jitted dispatch", name))
+        if name == "dot_general":
+            _audit_dot(eqn, where, findings)
+        if name == "shard_map":
+            inner = _inner_jaxpr(eqn.params["jaxpr"])
+            in_names = eqn.params.get("in_names") \
+                or eqn.params.get("in_specs") or ()
+            uniform_in = [not bool(n) for n in in_names]
+            if len(uniform_in) != len(inner.invars):
+                uniform_in = [False] * len(inner.invars)
+            _Uniformity(findings, where).run(inner, uniform_in)
+            _audit_eqns(inner, where, findings, stats, in_mesh=True)
+            continue
+        for var in eqn.outvars:
+            aval = getattr(var, "aval", None)
+            shape = getattr(aval, "shape", ())
+            if any(not isinstance(d, int) for d in shape):
+                findings.append(Finding(
+                    "trace-dynamic-shape", where, 0, 0,
+                    f"data-dependent output shape {shape} from "
+                    f"`{name}`",
+                    "pad to the plan bucket's static shape", name))
+        for sub in _sub_jaxprs(eqn):
+            _audit_eqns(sub, where, findings, stats, in_mesh)
+
+
+def audit_closed_jaxpr(closed, where: str):
+    """(findings, stats) for one traced ClosedJaxpr.  `where` names the
+    kernel in finding paths (e.g. `<jaxpr:elle-mesh>`), and the
+    enclosing bucket rides in the finding qualname via the sweep."""
+    findings: list = []
+    stats: dict = {}
+    _audit_eqns(closed.jaxpr, where, findings, stats, in_mesh=False)
+    return findings, stats
+
+
+# ---------------------------------------------------------------------------
+# Plan -> traceable builders (registered into the planner hook)
+# ---------------------------------------------------------------------------
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(tuple(shape), getattr(jnp, dtype))
+
+
+def _build_elle_mesh(plan, devices):
+    from jepsen_tpu.ops import elle_mesh
+    devs = tuple(devices)
+    tile = elle_mesh.mesh_tile(len(devs))
+    n_pad = tile                    # smallest legal mesh bucket
+    fn, _mesh = elle_mesh._build_kernel(n_pad, devs,
+                                        elle_mesh._block_for(n_pad))
+    args = [_sds((n_pad, n_pad // 32), "uint32") for _ in range(4)]
+    return fn, args, {"n_pad": n_pad, "devices": len(devs)}
+
+
+def _build_deep_hc(plan, devices):
+    from jepsen_tpu.ops import wgl_deep
+    R = int(plan.bucket[1])
+    Sn = int(plan.bucket[2] or 1)
+    D = len(devices)
+    D = 1 << max(1, D.bit_length() - 1)     # power-of-two slab
+    if D < 2 or (1 << R) < 32 * D:
+        return None
+    devs = tuple(devices[:D])
+    Wdl = (1 << R) // 32 // D
+    SnP = wgl_deep._snp(min(Sn, 32))
+    L2, I, UP = 64, 2, 64
+    fn = wgl_deep._build_hc(L2, I, Wdl, SnP, R, UP, devs, "cfg")
+    args = [_sds((L2,), "int32"), _sds((L2, I), "int32"),
+            _sds((L2, I), "int32"), _sds((UP,), "uint32"),
+            _sds((UP,), "uint32"), _sds((UP,), "int32")]
+    return fn, args, {"R": R, "devices": D, "Wdl": Wdl}
+
+
+def _build_deep(plan, devices):
+    from jepsen_tpu.ops import planner, wgl_deep
+    R = int(plan.bucket[1])
+    Sn = int(plan.bucket[2] or 1)
+    if R < 1:
+        return None
+    P = planner.deep_split_planes(R)
+    Wd = max(1, (1 << R) // 32 // P)
+    SnP = wgl_deep._snp(min(Sn, 32))
+    G, I, UP = 1, 2, 64
+    fn = wgl_deep._build(G, I, Wd, SnP, R, UP, P, True)
+    # evbuf rides 3-D with a unit middle axis (Mosaic wants the
+    # block's last two dims to equal the array's — see _build.kern)
+    args = [_sds((G, 1, wgl_deep.EB * (1 + 2 * I)), "int32"),
+            _sds((1, 3 * UP + 16), "uint32")]
+    return fn, args, {"R": R, "split": P}
+
+
+def _build_seg_pipeline(plan, devices):
+    """The grouped register-delta pipeline's donated compact-wire
+    kernel (wgl_seg._build_kernel_regs_many_c, donate=True): the one
+    engine that promises buffer donation, so the sweep's donation
+    audit has a real target."""
+    from jepsen_tpu.ops import wgl_seg
+    R = int(plan.bucket[1])
+    Sn = min(int(plan.bucket[2] or 1), 32)
+    U = min(int(plan.bucket[3] or 8), 255)
+    K = min(int(plan.bucket[4] or 1), 16)
+    if R < 1 or R > 8:
+        return None
+    L, Wd, Rp = 64, 1, 128
+    fn = wgl_seg._build_kernel_regs_many_c(
+        K, L, Wd, Sn, R, True, R + 1, 1, U, Rp, donate=True)
+    args = [_sds((Rp * 2 + 4 * (K + 1),), "uint8"),
+            _sds((3 * U,), "uint32")]
+    return fn, args, {"R": R, "keys": K, "donate": True}
+
+
+def audit_donation(fn, args, where: str):
+    """trace-undonated: donated buffers must actually donate.  Lower +
+    compile under a warning trap and flag any dropped-donation
+    warning.  On backends where XLA ignores donation by design (cpu)
+    the check is recorded as skipped, never passed vacuously."""
+    import warnings
+
+    import jax
+    if jax.default_backend() not in ("tpu", "gpu"):
+        return [], {"donation": "skipped (backend ignores donation)"}
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            fn.lower(*args).compile()
+    except Exception as e:   # noqa: BLE001 - audit reports, never dies
+        return [], {"donation": f"error: {type(e).__name__}: {e}"}
+    dropped = [str(w.message) for w in caught
+               if "donat" in str(w.message).lower()]
+    findings = [Finding(
+        "trace-undonated", where, 0, 0,
+        f"donation dropped at compile time: {msg[:120]}",
+        "align the donated argument's layout/aliasing with the "
+        "output, or stop promising donation", "donation")
+        for msg in dropped]
+    return findings, {"donation": f"{len(dropped)} dropped"
+                      if dropped else "ok"}
+
+
+def _build_live(plan, devices):
+    from jepsen_tpu.live import engine as live_engine
+    _tag, T, E, M, Sn = plan.bucket
+    B = int(M).bit_length() - 1
+    if B < 1:
+        return None
+    T, E, M, Sn = int(T), int(E), int(M), int(Sn)
+    fn = live_engine._build_bucket_kernel(T, E, M, Sn)
+    args = [_sds((T, M, Sn), "bool_"), _sds((T, B, Sn), "int32"),
+            _sds((T, B, Sn), "bool_"), _sds((T, B), "bool_"),
+            _sds((T, E), "int32"), _sds((T, E), "int32"),
+            _sds((T, E, Sn), "int32"), _sds((T, E, Sn), "bool_")]
+    return fn, args, {"lanes": T, "events": E}
+
+
+_REGISTERED = False
+
+
+def register_builtin_traceables() -> None:
+    """Install the built-in plan -> traceable builders into the
+    planner hook (idempotent)."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from jepsen_tpu.ops import planner
+    planner.register_traceable("elle-mesh", _build_elle_mesh)
+    planner.register_traceable("wgl_deep_hc", _build_deep_hc)
+    planner.register_traceable("wgl_deep", _build_deep)
+    planner.register_traceable("wgl_deep_split", _build_deep)
+    planner.register_traceable("wgl_deep_pipeline", _build_deep)
+    planner.register_traceable("wgl_seg_pipeline", _build_seg_pipeline)
+    planner.register_traceable("live-jit", _build_live)
+    _REGISTERED = True
+
+
+# ---------------------------------------------------------------------------
+# The seeded sweep driver
+# ---------------------------------------------------------------------------
+
+def seeded_shapes(n: int = 400, seed: int = 11) -> list:
+    """The planner's seeded-random shape sweep (the same generator
+    family tests/test_planner.py pins routing with), widened with the
+    elle/live kinds so every engine family the planner can emit shows
+    up."""
+    from jepsen_tpu.ops import planner
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        kind = rng.choice(["linear", "linear-many", "linear-pipeline",
+                           "deep-pipeline", "deep-mesh", "batch-many",
+                           "elle", "live"])
+        mesh = rng.choice([None, 2, 8])
+        if kind == "deep-mesh":
+            mesh = mesh or 2            # a meshless mesh shape is
+        out.append(planner.Shape(       # caller error, not a route
+            kind=kind,
+            R=rng.randrange(1, 20) if kind != "live"
+            else rng.randrange(1, 8),
+            crashes=rng.choice([0, 0, 0, 1, 2, 5]),
+            Sn=rng.choice([None, 1, 2, 5, 8, 16, 32]),
+            U=rng.choice([None, 1, 50, 4000]),
+            decomposed=rng.choice([None, True]),
+            batch=rng.choice([1, 3, 16, 128]),
+            n_ops=rng.randrange(0, 10_000),
+            mesh=mesh,
+            device=True,
+            max_states=rng.choice([16, 64]),
+            max_open_bits=rng.choice([10, 14])))
+    return out
+
+
+@dataclasses.dataclass
+class AuditResult:
+    findings: list
+    rows: list                      # per-(engine, bucket) audit rows
+    plans: int = 0
+    traced: int = 0
+    skipped: int = 0
+
+    def summary(self) -> dict:
+        engines = sorted({r["engine"] for r in self.rows})
+        return {"engines": engines, "plans": self.plans,
+                "traced": self.traced, "skipped": self.skipped,
+                "findings": len(self.findings)}
+
+    def to_json(self) -> dict:
+        return {**self.summary(),
+                "rows": self.rows,
+                "finding_list": [f.to_json() for f in self.findings]}
+
+
+def sweep(n: int = 400, seed: int = 11, per_engine: int = 3,
+          backend: Optional[str] = None, devices=None,
+          shapes=None) -> AuditResult:
+    """Drive plan_engines over the seeded sweep, dedupe plans by
+    (engine, bucket), and statically audit up to `per_engine` traced
+    kernels per engine (smallest buckets first — the audit is about
+    program STRUCTURE, which the smallest legal bucket already
+    exhibits; larger buckets of the same builder only scale dims).
+    Plans whose engine has no registered traceable are counted, not
+    failed — the hook is additive."""
+    import jax
+
+    from jepsen_tpu.ops import planner
+    register_builtin_traceables()
+    devices = list(devices) if devices is not None else \
+        list(jax.devices())
+    backend = backend or jax.default_backend()
+    env = {"JEPSEN_TPU_DEEP_INTERPRET": "1"} if backend == "cpu" \
+        else {}
+
+    by_key: dict = {}
+    shapes = shapes if shapes is not None else seeded_shapes(n, seed)
+    for shape in shapes:
+        try:
+            plan = planner.plan_engines(shape, env=env,
+                                        backend=backend)
+        except ValueError:
+            continue
+        by_key.setdefault((plan.engine, plan.bucket), plan)
+
+    findings: list = []
+    rows: list = []
+    traced = skipped = 0
+    per_eng_count: dict = {}
+    sigs: dict = {}          # (engine, bucket) -> traced aval signature
+    for (engine, bucket), plan in sorted(
+            by_key.items(), key=lambda kv: (kv[0][0], str(kv[0][1]))):
+        if engine not in planner.traceable_engines():
+            continue
+        if per_eng_count.get(engine, 0) >= per_engine:
+            skipped += 1
+            continue
+        where = f"<jaxpr:{engine}>"
+        try:
+            built = planner.traceable(plan, devices=devices)
+        except Exception as e:   # noqa: BLE001 - audit must report, not die
+            rows.append({"engine": engine, "bucket": list(bucket),
+                         "error": f"build: {type(e).__name__}: {e}"})
+            skipped += 1
+            continue
+        if built is None:
+            skipped += 1
+            continue
+        fn, args, meta = built
+        per_eng_count[engine] = per_eng_count.get(engine, 0) + 1
+        try:
+            closed = jax.make_jaxpr(fn)(*args)
+        except Exception as e:   # noqa: BLE001
+            rows.append({"engine": engine, "bucket": list(bucket),
+                         "error": f"trace: {type(e).__name__}: {e}"})
+            skipped += 1
+            continue
+        traced += 1
+        fs, stats = audit_closed_jaxpr(closed, where)
+        if meta.get("donate"):
+            dfs, dstats = audit_donation(fn, args, where)
+            fs += dfs
+            stats.update(dstats)
+        fs = [dataclasses.replace(f, qualname=repr(tuple(bucket)))
+              for f in fs]
+        sig = tuple(str(a.aval) for a in closed.jaxpr.invars) \
+            + tuple(str(v.aval) for v in closed.jaxpr.outvars)
+        prev = sigs.setdefault((engine, bucket), sig)
+        if prev != sig:
+            fs.append(Finding(
+                "trace-bucket-collision", where, 0, 0,
+                "same plan bucket traced two different shape "
+                "signatures — the executable cache under-keys "
+                "(recompile storm)",
+                "fold the distinguishing dimension into "
+                "planner._bucket_for", repr(tuple(bucket))))
+        findings.extend(fs)
+        row = {"engine": engine, "bucket": list(bucket),
+               "meta": meta, "findings": len(fs),
+               **{k: stats.get(k, 0)
+                  for k in ("eqns", "collectives", "whiles")}}
+        if "donation" in stats:
+            row["donation"] = stats["donation"]
+        rows.append(row)
+    res = AuditResult(findings=findings, rows=rows,
+                      plans=len(by_key), traced=traced,
+                      skipped=skipped)
+    try:
+        from jepsen_tpu import telemetry
+        for f in findings:
+            telemetry.count_lint(f.rule, "finding")
+        telemetry.REGISTRY.counter(
+            "jepsen_lint_trace_audited_total").inc(traced)
+    except Exception:   # noqa: BLE001 - telemetry is advisory
+        pass
+    from jepsen_tpu.lint import engine as lint_engine
+    lint_engine.LAST["audit"] = res.summary()
+    return res

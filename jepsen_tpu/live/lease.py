@@ -162,6 +162,7 @@ def try_acquire(run_dir, worker_id: str, ttl: float,
     """Fresh acquire of a never-leased run dir: exactly one of N
     racing workers wins (hard-link onto the lease path fails for the
     rest).  Returns the owned Lease or None."""
+    # lint: wall-ok(stamp/deadline are advisory; expiry is LeaseObserver's monotonic silence)
     now = time.time() if now is None else now
     ls = Lease(owner=worker_id, epoch=1, ttl=ttl, beat=0,
                stamp=now, deadline=now + ttl)
@@ -189,10 +190,12 @@ def takeover(run_dir, worker_id: str, ttl: float, observed: Lease,
     publish the epoch+1 successor carrying the recorded cursor.
     Returns the owned Lease or None (lost the race, or the holder
     renewed between observation and claim)."""
+    # lint: wall-ok(stamp/deadline are advisory; expiry is LeaseObserver's monotonic silence)
     now = time.time() if now is None else now
     lp = lease_path(run_dir)
     claim = Path(run_dir) / f".lease.claim.{worker_id}.{os.getpid()}"
     try:
+        # lint: rename-ok(claim rename CONSUMES the old lease; the successor publish below is fsynced)
         os.rename(lp, claim)
     except FileNotFoundError:
         return None                     # someone else claimed first
@@ -243,6 +246,7 @@ def renew(run_dir, mine: Lease, *, cursor: Optional[tuple] = None,
     owner at our epoch) means we were fenced — return None and
     PUBLISH NOTHING; a lower on-disk epoch is a stale clobber we
     repair.  Returns the renewed Lease, or None when fenced."""
+    # lint: wall-ok(stamp/deadline are advisory; expiry is LeaseObserver's monotonic silence)
     now = time.time() if now is None else now
     disk = read(run_dir)
     if disk is not None and not disk.corrupt:

@@ -678,6 +678,7 @@ class LiveScheduler:
         try:
             with open(tmp, "w") as f:
                 json.dump(stats, f, indent=2, default=repr)
+            # lint: rename-ok(per-tick snapshot rewritten constantly; atomicity is the contract, and an fsync here would put a disk sync on the hot scan loop — durable state lives in live.jsonl/lease.json)
             os.replace(tmp, path)
         except OSError:
             log.debug("live.json write failed for %s", key,
@@ -806,6 +807,8 @@ class LiveScheduler:
             try:
                 with open(tmp, "w") as f:
                     json.dump(stats, f, indent=2, default=repr)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, ts_dir / "live.json")
                 written += 1
             except OSError:

@@ -97,7 +97,7 @@ class CheckerService:
         lag = telemetry.REGISTRY.histogram(
             "live_window_lag_seconds", buckets=LAG_BUCKETS_S)
         st = {"worker": sched.worker_id, "pid": os.getpid(),
-              "updated": round(time.time(), 3),
+              "updated": round(time.time(), 3),  # lint: wall-ok(operator display on /fleet)
               "lease_ttl": sched.lease_ttl,
               "tenants": sorted(f"{k[0]}/{k[1]}"
                                 for k in sched.tenants),
@@ -117,6 +117,8 @@ class CheckerService:
             tmp = d / f".{sched.worker_id}.json.tmp"
             with open(tmp, "w") as f:
                 json.dump(st, f, indent=2)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, d / f"{sched.worker_id}.json")
         except OSError:
             log.debug("worker status write failed", exc_info=True)

@@ -464,18 +464,35 @@ def set_time(t: float) -> None:
 
 class ClockScrambler(Nemesis):
     """Randomizes node clocks within a ±dt-second window
-    (nemesis.clj:219-234)."""
+    (nemesis.clj:219-234).
+
+    Skews are registered in the fault ledger BEFORE injection
+    (register-before-inject, ISSUE 15): a scrambler that dies
+    mid-skew still gets every clock snapped back by the run_case
+    backstop, and campaign.assert_empty can prove no skew leaked."""
+
+    LEDGER_KEY = "nemesis.clock-scrambler"
 
     def __init__(self, dt: float):
         self.dt = dt
 
+    def _heal(self, test):
+        # lint: wall-ok(restoring TRUE wall time IS the heal) inject-ok(heal path, not an injection)
+        c.on_nodes(test, lambda tst, node: set_time(time.time()))
+
     def invoke(self, test, op):
+        ledger(test).register(self.LEDGER_KEY,
+                              lambda: self._heal(test),
+                              {"dt": self.dt})
+
         def f(tst, node):
+            # lint: wall-ok(the injected skew is relative to wall time)
             set_time(time.time() + random.randint(-self.dt, self.dt))
         return op.assoc(value=c.on_nodes(test, f))
 
     def teardown(self, test):
-        c.on_nodes(test, lambda tst, node: set_time(time.time()))
+        self._heal(test)
+        ledger(test).resolve(self.LEDGER_KEY)
 
 
 def clock_scrambler(dt):

@@ -72,6 +72,7 @@ def clock_offset_s() -> float:
     """Observed node wall clock minus control wall clock, seconds
     (time.clj current-offset)."""
     remote = float(c.execute("date", "+%s.%N"))
+    # lint: wall-ok(the node-vs-control wall offset IS the measurement)
     return remote - time.time()
 
 
@@ -85,7 +86,16 @@ class ClockNemesis(nem.Nemesis):
         {f: "check-offsets"}
 
     Every completion gets a {node: offset_s} map under
-    op.extra["clock-offsets"]."""
+    op.extra["clock-offsets"].
+
+    Bumps and strobes are registered in the test's fault ledger BEFORE
+    injection (register-before-inject, ISSUE 15), with the reset-all
+    heal as the undo: a nemesis that dies mid-skew still gets every
+    clock snapped back by the run_case backstop, and a reset op (or
+    teardown) resolves the entry so campaign.assert_empty stays
+    clean."""
+
+    LEDGER_KEY = "nemesis.clock"
 
     def setup(self, test):
         c.on_nodes(test, lambda t, n: install(t, n))
@@ -95,18 +105,31 @@ class ClockNemesis(nem.Nemesis):
             log.warning("initial clock reset failed: %s", e)
         return self
 
+    def _reset_all(self, test):
+        try:
+            c.on_nodes(test, lambda t, n: reset_time(t))
+        except Exception as e:
+            log.warning("clock reset failed: %s", e)
+
     def invoke(self, test, op):
         f = op.f
         if f == "reset":
             nodes = op.value or test.get("nodes")
             c.on_nodes(test, lambda t, n: reset_time(t), nodes)
+            nem.ledger(test).resolve(self.LEDGER_KEY)
         elif f == "bump":
             deltas = op.value or {}
+            nem.ledger(test).register(self.LEDGER_KEY,
+                                      lambda: self._reset_all(test),
+                                      {"bump-ms": dict(deltas)})
             c.on_nodes(test,
                        lambda t, n: bump_time(deltas.get(n, 0)),
                        list(deltas))
         elif f == "strobe":
             v = op.value or {}
+            nem.ledger(test).register(self.LEDGER_KEY,
+                                      lambda: self._reset_all(test),
+                                      {"strobe": dict(v)})
             c.on_nodes(test, lambda t, n: strobe_time(
                 v.get("delta", 200), v.get("period", 10),
                 v.get("duration", 10)))
@@ -118,10 +141,8 @@ class ClockNemesis(nem.Nemesis):
         return op.assoc(**{"clock-offsets": offsets})
 
     def teardown(self, test):
-        try:
-            c.on_nodes(test, lambda t, n: reset_time(t))
-        except Exception as e:
-            log.warning("clock reset on teardown failed: %s", e)
+        self._reset_all(test)
+        nem.ledger(test).resolve(self.LEDGER_KEY)
 
 
 def _safe_offset():

@@ -705,6 +705,44 @@ def runner_plan(engine_name: str, fallback_name: str = "wgl_cpu",
 
 
 # ---------------------------------------------------------------------------
+# Traceable-callable hook (ISSUE 15): plan -> (fn, example_args, meta)
+# ---------------------------------------------------------------------------
+#
+# The static jaxpr auditor (lint/trace_audit.py) needs to see the
+# ClosedJaxpr of every engine a plan can emit WITHOUT running anything:
+# engine modules (or the auditor) register a builder per engine name
+# that reconstructs the engine's jitted callable and example
+# ShapeDtypeStructs from the plan BUCKET alone.  Deriving the trace
+# signature from the bucket — and nothing else — is itself one of the
+# audited invariants: if two sweeps of one bucket trace different
+# shapes, the executable cache under-keys and a recompile storm ships
+# as a bench regression instead of a lint failure.
+
+_TRACEABLES: dict = {}
+
+
+def register_traceable(engine: str, builder) -> None:
+    """Register `builder(plan, devices=...) -> (fn, example_args,
+    meta) | None` for an engine name.  `fn` must be traceable by
+    jax.make_jaxpr over `example_args` (ShapeDtypeStructs); returning
+    None means "this bucket is not traceable here" (e.g. a mesh wider
+    than the host).  Last registration wins (tests may stub)."""
+    _TRACEABLES[engine] = builder
+
+
+def traceable(plan: Plan, **kw):
+    """Resolve a plan's head engine to its registered traceable, or
+    None when no builder is registered — the hook is additive, so an
+    unregistered engine is unaudited, never an error."""
+    b = _TRACEABLES.get(plan.engine)
+    return None if b is None else b(plan, **kw)
+
+
+def traceable_engines() -> list:
+    return sorted(_TRACEABLES)
+
+
+# ---------------------------------------------------------------------------
 # Persistent compiled-plan cache
 # ---------------------------------------------------------------------------
 

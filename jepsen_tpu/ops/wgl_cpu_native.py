@@ -107,6 +107,8 @@ def check(model, history, *,
         states, legal, next_state = _enumerate_states(
             spec, init, uops, 4096)
     except Unsupported:
+        from jepsen_tpu import telemetry
+        telemetry.count_fallback("wgl_cpu_native", "state-space")
         return wgl_cpu.check(model, history, max_configs=max_configs,
                              time_limit=time_limit)
     Sn = states.shape[0]

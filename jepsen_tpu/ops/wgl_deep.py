@@ -653,6 +653,9 @@ def check_pipeline(model, histories, *, max_open_bits=None,
                 # the alphabet (and with it the state space) only
                 # grows: everything from here on is a straggler —
                 # already-dispatched in-scope verdicts stay valid
+                from jepsen_tpu import telemetry as telemetry_mod
+                telemetry_mod.count_fallback("wgl_deep_pipeline",
+                                             "state-space")
                 strag.extend(range(i, len(histories)))
                 break
             Sn = states.shape[0]
@@ -753,7 +756,9 @@ def check_pipeline(model, histories, *, max_open_bits=None,
                     max_states=max_states)[0]
                 continue
             except CheckError:
-                pass             # out of the mesh envelope too: serial
+                # out of the mesh envelope too: serial
+                telemetry_mod.count_fallback("wgl_deep_hc",
+                                             "mesh-envelope")
         try:
             results[i] = wgl_seg.check(model, histories[i],
                                        max_states=max_states,
@@ -762,6 +767,7 @@ def check_pipeline(model, histories, *, max_open_bits=None,
         except wgl_seg.Unsupported:
             # beyond every batched gate (R past deep_r_max): the
             # serial frontier engine has no overlap-depth limit
+            telemetry_mod.count_fallback("wgl_deep", "beyond-gates")
             why = ("deep straggler beyond every batched gate "
                    "(serial frontier engine)")
         except Exception as e:   # noqa: BLE001 - OOM-only degradation

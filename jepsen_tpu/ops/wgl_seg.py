@@ -1425,6 +1425,9 @@ def _check_crashed_fast(model, spec, history, *, max_states,
         states, legal, next_state = _enumerate_states(
             spec, init, uops, max_states)
     except Unsupported:
+        from jepsen_tpu import telemetry as telemetry_mod
+        telemetry_mod.count_fallback("wgl_seg_crash_fast",
+                                     "state-space")
         return None
     eye = np.arange(legal.shape[1])
     inert = [u >= 0 and bool(legal[u].all())
@@ -1480,6 +1483,9 @@ def _check_crashed_fast(model, spec, history, *, max_states,
                         target_returns_per_segment,
                         localize=False, mesh=mesh, mesh_axis=mesh_axis)
         except Unsupported:
+            from jepsen_tpu import telemetry as telemetry_mod
+            telemetry_mod.count_fallback("wgl_seg_crash_fast",
+                                         "stripped-chain")
             res = None
     if res is not None and res.get("valid?") is True:
         res["crashed_ignored"] = len(crashed)
@@ -1612,6 +1618,8 @@ def _check_fast(model, spec, history, *, max_states, max_open_bits,
         states, legal, next_state = _enumerate_states(
             spec, init, uops, max_states)
     except Unsupported:
+        from jepsen_tpu import telemetry as telemetry_mod
+        telemetry_mod.count_fallback("wgl_seg_regs", "state-space")
         return None
     Sn = states.shape[0]
     R = rn + nc if nc else int(fk.max_open)
@@ -2080,6 +2088,9 @@ def check_pipeline(model, histories, *, max_states: int = 64,
                 # state space outgrew max_states: this group (and any
                 # later one — the alphabet only grows) goes through
                 # check()'s own fallback chain
+                from jepsen_tpu import telemetry as telemetry_mod
+                telemetry_mod.count_fallback("wgl_seg_pipeline",
+                                             "state-space")
                 strag.extend(i for i, *_ in grp)
                 continue
         R_g = max(fk.max_open for _, fk, _, _ in grp)
@@ -2596,6 +2607,9 @@ def check_many(model, histories, *, max_states: int = 64,
             states, legal, next_state = _enumerate_states(
                 spec, init, uops, max_states)
         except Unsupported:
+            from jepsen_tpu import telemetry as telemetry_mod
+            telemetry_mod.count_fallback("wgl_seg_batch",
+                                         "state-space")
             fall.extend(i for i, _ in batch)
             batch = []
         ts = _acc_s("tables", ts)
@@ -2783,6 +2797,9 @@ def check_many(model, histories, *, max_states: int = 64,
                                    max_open_bits=max_open_bits,
                                    localize=localize)
             except Unsupported:
+                from jepsen_tpu import telemetry as telemetry_mod
+                telemetry_mod.count_fallback("wgl_seg_batch",
+                                             "per-key-chain")
                 results[i] = None
                 fall.append(i)
 

@@ -95,6 +95,7 @@ class Store:
     def logline(self, msg):
         # the daemon's ONLY clock use — SKEW shifts it, so a clock
         # nemesis has a real, observable (and harmless) effect
+        # lint: wall-ok(the SUT's own skewed wall clock is the thing under test)
         self.log.write("%.6f %s\n" % (time.time() + self.skew_ms / 1e3,
                                       msg))
 

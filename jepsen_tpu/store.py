@@ -329,6 +329,7 @@ def start_logging(test) -> None:
     with _log_lock:
         stop_logging_unlocked()
         test.setdefault("start-time",
+                        # lint: wall-ok(store-dir name, operator-facing)
                         datetime.datetime.now().strftime("%Y%m%dT%H%M%S"))
         logfile = make_path(test, "jepsen.log")
         fh = logging.FileHandler(logfile)

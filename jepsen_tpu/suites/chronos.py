@@ -257,7 +257,7 @@ class ChronosClient(client_mod.Client):
                 # read time from start-epoch + op.time would be early
                 # by the whole setup duration and shrink the target
                 # cutoff (silent false negatives).
-                wall = time.time()
+                wall = time.time()  # lint: wall-ok(chronos schedules jobs in SUT wall time)
                 return op.assoc(type="ok",
                                 value=self.conn.read_runs(test),
                                 wall_invoke=wall)
@@ -289,7 +289,7 @@ class AddJobGen(gen.Generator):
                     + random.randint(0, 30) * s)
         return {"type": "invoke", "f": "add-job",
                 "value": {"name": name,
-                          "start": time.time() + head_start,
+                          "start": time.time() + head_start,  # lint: wall-ok(job start is SUT wall-time domain)
                           "count": 1 + random.randint(0, 99),
                           "duration": duration,
                           "epsilon": epsilon,
@@ -345,7 +345,7 @@ def chronos_test(opts) -> dict:
         "db": ChronosDB(),
         "net": net.iptables,
         "chronos-factory": opts.get("chronos-factory"),
-        "start-epoch": time.time(),
+        "start-epoch": time.time(),  # lint: wall-ok(checker anchors job windows to SUT wall time)
         "nemesis": ResurrectionHub(nem.partition_random_halves()),
         "checker": ck.compose({"chronos": ChronosChecker(),
                                "perf": ck.perf()}),

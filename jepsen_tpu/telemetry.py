@@ -316,6 +316,7 @@ class EventLog:
             try:
                 payload = _payload(ev)
                 crc = zlib.crc32(payload.encode())
+                # lint: wall-ok(advisory envelope stamp; readers order by i/crc, never t)
                 self._f.write(f'{{"i":{self._n},"t":{time.time():.6f},'
                               f'"crc":"{crc:08x}","ev":{payload}}}\n')
                 self._f.flush()
@@ -538,6 +539,25 @@ def breaker_transition(node, to: str, failures: int) -> None:
                      node=str(node), to=to).inc()
     emit("breaker", durable=True, node=str(node), to=to,
          failures=failures)
+
+
+def count_fallback(engine: str, reason: str = "unsupported") -> None:
+    """A fallback-ladder rung was taken: a typed engine error was
+    absorbed and a lower tier will produce the verdict.  The bare-
+    fallback lint rule (ISSUE 15) requires every such handler to leave
+    this trace (or re-raise) so silent degradation shows up in
+    `jepsen_engine_fallback_total` instead of hiding in a green
+    suite."""
+    REGISTRY.counter("jepsen_engine_fallback_total",
+                     engine=str(engine), reason=str(reason)).inc()
+
+
+def count_lint(rule: str, kind: str = "finding") -> None:
+    """One lint finding/waiver, counted per rule into
+    `jepsen_lint_total{rule=,kind=}` (scraped at /metrics and rolled
+    into the tier-1 CI artifact's lint row)."""
+    REGISTRY.counter("jepsen_lint_total", rule=str(rule),
+                     kind=str(kind)).inc()
 
 
 # ---------------------------------------------------------------------------

@@ -63,7 +63,7 @@ class Span:
         self.trace_id = trace_id
         self.span_id = _id64()
         self.parent_id = parent_id
-        self.start_us = int(time.time() * 1e6)
+        self.start_us = int(time.time() * 1e6)  # lint: wall-ok(Dapper span stamps are display-only)
         self.end_us: Optional[int] = None
         self.attributes = dict(attributes)
         self.annotations: list = []
@@ -103,7 +103,7 @@ class _SpanCtx:
         stack = _span_stack()
         if stack and stack[-1] is self.span:
             stack.pop()
-        self.span.end_us = int(time.time() * 1e6)
+        self.span.end_us = int(time.time() * 1e6)  # lint: wall-ok(Dapper span stamps are display-only)
         if etype is not None:
             self.span.attributes["error"] = True
             self.span.attributes["error.message"] = str(e)
@@ -139,7 +139,7 @@ class Tracer:
         stack = _span_stack()
         if stack:
             stack[-1].annotations.append(
-                {"timeUs": int(time.time() * 1e6),
+                {"timeUs": int(time.time() * 1e6),  # lint: wall-ok(Dapper annotation stamp, display-only)
                  "message": message, **attributes})
 
     def attribute(self, key: str, value: Any) -> None:

@@ -143,6 +143,32 @@ def _fleet_summary():
         return None
 
 
+def _lint_summary():
+    """The jlint row (ISSUE 15): ast-pass findings/waivers + trace-
+    audited engine count + wall, read from the lint test's own run
+    (jepsen_tpu.lint.engine.LAST — the artifact never re-lints).
+    Recorded so a waiver explosion, a rule silently stopping to fire,
+    or the trace audit losing an engine diffs across PRs instead of
+    hiding in a green suite.  None when the lint tests didn't run this
+    session."""
+    try:
+        import sys
+        eng = sys.modules.get("jepsen_tpu.lint.engine")
+        if eng is None or eng.LAST.get("report") is None:
+            return None
+        rep = eng.LAST["report"]
+        audit = eng.LAST.get("audit") or {}
+        return {"findings": len(rep.findings),
+                "waivers": len(rep.waivers),
+                "files": rep.files,
+                "wall_s": round(rep.wall_s, 3),
+                "trace_engines": len(audit.get("engines") or []),
+                "trace_kernels": audit.get("traced"),
+                "trace_findings": audit.get("findings")}
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _campaign_summary():
     """The tier-1 smoke campaign's counters (ISSUE 13):
     run/novel/deduped/quarantined schedule counts from the registry —
@@ -202,6 +228,7 @@ def pytest_sessionfinish(session, exitstatus):
             "pack_backend": _pack_backend(),
             "campaign": _campaign_summary(),
             "fleet": _fleet_summary(),
+            "lint": _lint_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],
         }

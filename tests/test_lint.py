@@ -1,0 +1,615 @@
+"""jlint (ISSUE 15): planted-violation battery (one fixture per rule,
+exact rule-id + span), waiver grammar, the baseline ratchet, discovery
+discipline (store/.cache/__pycache__ never parsed as source), the
+repo's own lint-clean pass under a wall budget, the jaxpr trace
+auditor (a deliberately non-uniform collective is caught; the real
+engines pass), and the CLI wiring."""
+
+import json
+import textwrap
+
+import pytest
+
+from jepsen_tpu import cli
+from jepsen_tpu.lint import baseline as baseline_mod
+from jepsen_tpu.lint import engine as engine_mod
+from jepsen_tpu.lint import run_lint
+from jepsen_tpu.lint.engine import discover, lint_source
+
+
+def _lint(src, name="mod.py", rules=None):
+    return lint_source(textwrap.dedent(src), name, rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# Planted violations: one fixture per rule, exact id + span
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    def test_wall_clock_in_frame(self):
+        fs, _ = _lint("""\
+            import time
+
+            def deadline(ttl):
+                return time.time() + ttl
+        """)
+        (f,) = _only(fs, "wall-clock-in-frame")
+        assert (f.line, f.qualname) == (4, "deadline")
+
+    def test_wall_clock_datetime_forms(self):
+        fs, _ = _lint("""\
+            import datetime
+
+            def a():
+                return datetime.datetime.now()
+
+            def b():
+                return __import__("datetime").datetime.utcnow()
+        """)
+        assert [f.line for f in _only(fs, "wall-clock-in-frame")] \
+            == [4, 7]
+
+    def test_wall_clock_monotonic_clean(self):
+        fs, _ = _lint("""\
+            import time
+
+            def deadline(ttl):
+                return time.monotonic() + ttl
+        """)
+        assert not _only(fs, "wall-clock-in-frame")
+
+    def test_unfsynced_rename(self):
+        fs, _ = _lint("""\
+            import os
+
+            def publish(tmp, dst):
+                with open(tmp, "w") as f:
+                    f.write("x")
+                os.replace(tmp, dst)
+        """)
+        (f,) = _only(fs, "unfsynced-rename")
+        assert (f.line, f.qualname) == (6, "publish")
+
+    def test_fsynced_rename_clean_including_helper(self):
+        fs, _ = _lint("""\
+            import os
+
+            def _stage(p):
+                with open(p, "w") as f:
+                    f.write("x")
+                    f.flush()
+                    os.fsync(f.fileno())
+
+            def publish(tmp, dst):
+                _stage(tmp)
+                os.replace(tmp, dst)
+        """)
+        assert not _only(fs, "unfsynced-rename")
+
+    def test_inject_before_register(self):
+        fs, _ = _lint("""\
+            def invoke(test, op):
+                drop_all(test, {})
+        """, name="jepsen_tpu/nemesis.py")
+        (f,) = _only(fs, "inject-before-register")
+        assert (f.line, f.qualname) == (2, "invoke")
+
+    def test_inject_after_register_clean(self):
+        fs, _ = _lint("""\
+            def invoke(test, op):
+                ledger(test).register("k", lambda: heal(test), {})
+                drop_all(test, {})
+        """, name="jepsen_tpu/nemesis.py")
+        assert not _only(fs, "inject-before-register")
+        # ...and the rule is scoped to nemesis/fault modules
+        fs, _ = _lint("def f(t):\n    drop_all(t, {})\n",
+                      name="jepsen_tpu/util.py")
+        assert not _only(fs, "inject-before-register")
+
+    def test_global_rng_in_draw(self):
+        fs, _ = _lint("""\
+            import random
+
+            def draw(frontier):
+                return random.choice(frontier)
+        """, name="jepsen_tpu/campaign.py")
+        (f,) = _only(fs, "global-rng-in-draw")
+        assert (f.line, f.qualname) == (4, "draw")
+        # a threaded Random instance is the fix, not a violation
+        fs, _ = _lint("""\
+            import random
+
+            def draw(frontier, seed):
+                return random.Random(seed).choice(frontier)
+        """, name="jepsen_tpu/campaign.py")
+        assert not _only(fs, "global-rng-in-draw")
+
+    def test_bare_fallback(self):
+        fs, _ = _lint("""\
+            def check(h):
+                try:
+                    return fast(h)
+                except Unsupported:
+                    return None
+        """)
+        (f,) = _only(fs, "bare-fallback")
+        assert (f.line, f.qualname) == (4, "check")
+
+    def test_counted_or_reraising_fallback_clean(self):
+        fs, _ = _lint("""\
+            def check(h):
+                try:
+                    return fast(h)
+                except Unsupported:
+                    telemetry.count_fallback("fast", "state-space")
+                    return None
+
+            def check2(h):
+                try:
+                    return fast(h)
+                except Unsupported as e:
+                    raise CheckError(str(e)) from e
+        """)
+        assert not _only(fs, "bare-fallback")
+
+    def test_stray_writer(self):
+        fs, _ = _lint("""\
+            def bad(d):
+                p = d / "live.jsonl"
+                with open(p, "a") as f:
+                    f.write("x")
+        """, name="jepsen_tpu/web.py")
+        (f,) = _only(fs, "stray-writer")
+        assert (f.line, f.qualname) == (3, "bad")
+
+    def test_stray_writer_allows_scheduler_and_reads(self):
+        src = """\
+            def ok(d):
+                p = d / "live.jsonl"
+                with open(p, "a") as f:
+                    f.write("x")
+        """
+        fs, _ = _lint(src, name="jepsen_tpu/live/scheduler.py")
+        assert not _only(fs, "stray-writer")
+        fs, _ = _lint("""\
+            import json
+
+            def read(d):
+                with open(d / "live.jsonl") as f:
+                    return f.read()
+        """, name="jepsen_tpu/web.py")
+        assert not _only(fs, "stray-writer")
+
+    def test_unjoined_thread(self):
+        fs, _ = _lint("""\
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn).start()
+        """)
+        (f,) = _only(fs, "unjoined-thread")
+        assert (f.line, f.qualname) == (4, "spawn")
+
+    def test_daemon_or_joined_thread_clean(self):
+        fs, _ = _lint("""\
+            import threading
+
+            def spawn(fn):
+                threading.Thread(target=fn, daemon=True).start()
+
+            def run(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        """)
+        assert not _only(fs, "unjoined-thread")
+
+    def test_naked_sleep_loop(self):
+        fs, _ = _lint("""\
+            import time
+
+            def loop():
+                while True:
+                    time.sleep(1)
+        """)
+        (f,) = _only(fs, "naked-sleep-loop")
+        assert (f.line, f.qualname) == (4, "loop")
+        fs, _ = _lint("""\
+            import time
+
+            def loop(stop):
+                while True:
+                    if stop.is_set():
+                        break
+                    time.sleep(1)
+        """)
+        assert not _only(fs, "naked-sleep-loop")
+
+    def test_rule_selection(self):
+        fs, _ = _lint("""\
+            import time
+
+            def f():
+                while True:
+                    time.sleep(1)
+
+            def g():
+                return time.time()
+        """, rules=["naked-sleep-loop"])
+        assert {f.rule for f in fs} == {"naked-sleep-loop"}
+
+
+# ---------------------------------------------------------------------------
+# Waiver grammar
+# ---------------------------------------------------------------------------
+
+class TestWaivers:
+    def test_waiver_on_line_and_line_above(self):
+        fs, ws = _lint("""\
+            import time
+
+            def stamp():
+                return time.time()  # lint: wall-ok(operator display)
+
+            def stamp2():
+                # lint: wall-ok(advisory envelope field)
+                return time.time()
+        """)
+        assert not fs
+        assert [w.reason for w in ws] \
+            == ["operator display", "advisory envelope field"]
+
+    def test_reasonless_waiver_is_a_finding(self):
+        fs, ws = _lint("""\
+            import time
+
+            def stamp():
+                return time.time()  # lint: wall-ok()
+        """)
+        assert not ws
+        rules = sorted(f.rule for f in fs)
+        assert rules == ["reasonless-waiver", "wall-clock-in-frame"]
+
+    def test_wrong_token_does_not_waive(self):
+        fs, ws = _lint("""\
+            import time
+
+            def stamp():
+                return time.time()  # lint: sleep-ok(not the right rule)
+        """)
+        assert _only(fs, "wall-clock-in-frame")
+
+    def test_two_waivers_share_one_marker(self):
+        fs, ws = _lint("""\
+            import time
+
+            def heal(test):
+                # lint: wall-ok(true time IS the heal) inject-ok(heal path)
+                set_time(time.time())
+        """, name="jepsen_tpu/nemesis.py")
+        assert not fs
+        assert {w.rule for w in ws} \
+            == {"wall-clock-in-frame", "inject-before-register"}
+
+
+# ---------------------------------------------------------------------------
+# Baseline ratchet
+# ---------------------------------------------------------------------------
+
+class TestRatchet:
+    SRC = """\
+        import time
+
+        def deadline(ttl):
+            return time.time() + ttl
+    """
+
+    def test_new_finding_blocked_then_baselined_then_shrunk(self, tmp_path):
+        fs, _ = _lint(self.SRC)
+        bl = tmp_path / "bl.json"
+        # empty baseline: the finding is new -> ratchet fails
+        assert baseline_mod.new_findings(fs, baseline_mod.load(bl))
+        # accept: write the baseline, now it passes
+        baseline_mod.write(fs, bl)
+        assert not baseline_mod.new_findings(fs, baseline_mod.load(bl))
+        # a SECOND instance of the same key is still new
+        assert baseline_mod.new_findings(fs + fs,
+                                         baseline_mod.load(bl))
+        # shrink: the code is fixed, the smaller (empty) baseline is
+        # accepted — the ratchet only ever tightens
+        baseline_mod.write([], bl)
+        assert not baseline_mod.new_findings([], baseline_mod.load(bl))
+        assert baseline_mod.load(bl) == {}
+
+    def test_baseline_key_is_line_stable(self):
+        fs1, _ = _lint(self.SRC)
+        fs2, _ = _lint("# a new leading comment line\n"
+                       + textwrap.dedent(self.SRC))
+        assert fs1[0].key == fs2[0].key
+        assert fs1[0].line != fs2[0].line
+
+
+# ---------------------------------------------------------------------------
+# Discovery discipline (store/.cache/__pycache__ are artifacts)
+# ---------------------------------------------------------------------------
+
+class TestDiscovery:
+    def test_artifact_trees_never_parsed(self, tmp_path):
+        (tmp_path / "src").mkdir()
+        (tmp_path / "src" / "ok.py").write_text(
+            "import time\n\n\ndef f():\n    return time.time()\n")
+        for bad in ("store/campaigns", ".cache/jax", "__pycache__",
+                    "src/store", "src/__pycache__"):
+            d = tmp_path / bad
+            d.mkdir(parents=True)
+            # deliberately UNPARSEABLE: discovery must not even read it
+            (d / "artifact.py").write_text("this is { not python\n")
+        (tmp_path / "store").mkdir(exist_ok=True)
+        (tmp_path / "store" / "latest").symlink_to(tmp_path / "store")
+        files = discover([tmp_path], tmp_path)
+        assert [f.name for f in files] == ["ok.py"]
+        rep = run_lint(paths=[tmp_path], root=tmp_path,
+                       counters=False)
+        assert rep.files == 1 and not rep.errors
+        assert [f.rule for f in rep.findings] == ["wall-clock-in-frame"]
+
+    def test_exclusions_are_pinned(self):
+        # the store.tests() discipline, regression-pinned: artifact
+        # dirs stay excluded even as the list grows
+        for name in ("store", ".cache", "__pycache__"):
+            assert name in engine_mod.EXCLUDE_DIRS
+
+
+# ---------------------------------------------------------------------------
+# The repo's own pass: lint-clean, reasoned waivers, wall budget
+# ---------------------------------------------------------------------------
+
+class TestRepoPass:
+    def test_repo_is_lint_clean_and_fast(self):
+        rep = run_lint()
+        bl = baseline_mod.load()
+        new = baseline_mod.new_findings(rep.findings, bl)
+        assert not new, "\n".join(f.render() for f in new)
+        assert not rep.errors
+        assert rep.files > 100
+        # every waiver carries a reason (the reasonless ones are
+        # findings, caught above — this pins the invariant directly)
+        assert all(w.reason.strip() for w in rep.waivers)
+        assert rep.waivers, "the triaged wall stamps should be waived"
+        # CI wall budget: the ast pass must stay cheap enough to run
+        # every tier-1 invocation
+        assert rep.wall_s < 20.0, rep.wall_s
+        # the conftest artifact row reads this
+        assert engine_mod.LAST["report"] is rep
+
+    def test_lint_counters_flow(self):
+        from jepsen_tpu import telemetry
+        run_lint()
+        coll = telemetry.REGISTRY.collect()
+        kind, by_label = coll["jepsen_lint_total"]
+        assert kind == "counter"
+        assert sum(m.value for m in by_label.values()) > 0
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr trace audit
+# ---------------------------------------------------------------------------
+
+def _shard_mapped(body, n_in=1):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+
+    from jepsen_tpu.ops.shard_map_compat import shard_map_compat
+    mesh = Mesh(np.array(jax.devices()), ("r",))
+    spec = PartitionSpec("r")
+    return jax.jit(shard_map_compat(body, mesh=mesh,
+                                    in_specs=(spec,) * n_in,
+                                    out_specs=spec))
+
+
+class TestTraceAudit:
+    def test_nonuniform_collective_is_caught(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jepsen_tpu.lint import trace_audit
+        D = len(jax.devices())
+        perm = [(d, (d + 1) % D) for d in range(D)]
+
+        def bad(x):
+            def cond(st):
+                c, n = st
+                return (c.sum() > 0) & (n < 5)   # device-LOCAL trip
+
+            def rnd(st):
+                c, n = st
+                return c | jax.lax.ppermute(c, "r", perm), n + 1
+
+            c, _ = jax.lax.while_loop(cond, rnd, (x, jnp.int32(0)))
+            return c
+
+        fn = _shard_mapped(bad)
+        closed = jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((D, 4), jnp.uint32))
+        fs, stats = trace_audit.audit_closed_jaxpr(closed, "<planted>")
+        assert [f.rule for f in fs] == ["trace-nonuniform-collective"]
+        assert stats["whiles"] == 1 and stats["collectives"] >= 1
+
+    def test_psum_frontier_trip_is_uniform(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jepsen_tpu.lint import trace_audit
+        from jepsen_tpu.ops.shard_map_compat import (
+            all_gather_frontier, frontier_settled)
+
+        def good(x):
+            def cond(st):
+                c, n, done = st
+                return (~done) & (n < 5)
+
+            def rnd(st):
+                c, n, _ = st
+                g = all_gather_frontier(c, "r")
+                c2 = c | (g.sum() > 0).astype(jnp.uint32)
+                ch = jnp.any(c2 != c)
+                return c2, n + 1, frontier_settled(ch, "r")
+
+            c, _, _ = jax.lax.while_loop(
+                cond, rnd, (x, jnp.int32(0), jnp.bool_(False)))
+            return c
+
+        fn = _shard_mapped(good)
+        D = len(jax.devices())
+        closed = jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((D, 4), jnp.uint32))
+        fs, stats = trace_audit.audit_closed_jaxpr(closed, "<planted>")
+        assert not fs
+        assert stats["collectives"] >= 2    # all_gather + psum
+
+    def test_inexact_dot_is_caught(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jepsen_tpu.lint import trace_audit
+
+        def f(a, b):
+            # 512-wide bf16 contraction accumulating in bf16: 0/1
+            # counts past 256 lose exactness
+            return jnp.dot(a, b)
+
+        closed = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((8, 512), jnp.bfloat16),
+            jax.ShapeDtypeStruct((512, 8), jnp.bfloat16))
+        fs, _ = trace_audit.audit_closed_jaxpr(closed, "<planted>")
+        assert [f.rule for f in fs] == ["trace-dot-inexact"]
+
+        def g(a, b):
+            return jax.lax.dot(a, b,
+                               preferred_element_type=jnp.float32)
+
+        closed = jax.make_jaxpr(g)(
+            jax.ShapeDtypeStruct((8, 512), jnp.bfloat16),
+            jax.ShapeDtypeStruct((512, 8), jnp.bfloat16))
+        fs, _ = trace_audit.audit_closed_jaxpr(closed, "<planted>")
+        assert not fs
+
+    def test_host_callback_is_caught(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from jepsen_tpu.lint import trace_audit
+
+        def f(x):
+            return jax.pure_callback(
+                lambda v: np.asarray(v).sum(keepdims=False),
+                jax.ShapeDtypeStruct((), jnp.float32), x)
+
+        closed = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((8,), jnp.float32))
+        fs, _ = trace_audit.audit_closed_jaxpr(closed, "<planted>")
+        assert "trace-host-callback" in {f.rule for f in fs}
+
+    @pytest.mark.slow
+    def test_full_seeded_sweep_is_clean(self):
+        from jepsen_tpu.lint import trace_audit
+        res = trace_audit.sweep(per_engine=3)
+        assert not res.findings, [f.rule for f in res.findings]
+
+    def test_bounded_sweep_audits_every_traceable_engine(self):
+        # Tier-1 budget: one bucket per engine; the audit is about
+        # program STRUCTURE, which the smallest bucket exhibits.
+        # Plans are reused from the planner's compiled caches where
+        # warm, so this costs trace time only.
+        from jepsen_tpu.lint import trace_audit
+        from jepsen_tpu.ops import planner
+        res = trace_audit.sweep(per_engine=1)
+        assert not res.findings, [f.render() for f in res.findings]
+        audited = {r["engine"] for r in res.rows if "error" not in r}
+        # the mesh engines — where the rendezvous invariant lives —
+        # must actually be audited on this 8-device host
+        assert {"elle-mesh", "wgl_deep_hc", "live-jit"} <= audited
+        assert res.traced >= 4
+        errors = [r for r in res.rows if "error" in r]
+        assert not errors, errors
+        assert set(audited) <= set(planner.traceable_engines())
+        assert engine_mod.LAST["audit"] is not None
+        # the donated pipeline kernel's donation audit is recorded —
+        # skipped on this cpu host (XLA ignores donation by design),
+        # never passed vacuously
+        seg = [r for r in res.rows
+               if r["engine"] == "wgl_seg_pipeline"
+               and "error" not in r]
+        assert seg and seg[0]["donation"].startswith("skipped")
+
+    def test_donation_audit_never_vacuous_on_cpu(self):
+        import jax
+        import jax.numpy as jnp
+
+        from jepsen_tpu.lint import trace_audit
+        jf = jax.jit(lambda x: x + 1, donate_argnums=(0,))
+        fs, stats = trace_audit.audit_donation(
+            jf, [jax.ShapeDtypeStruct((8,), jnp.float32)], "<planted>")
+        assert not fs
+        assert stats["donation"].startswith("skipped")
+
+    def test_traceable_hook_is_additive(self):
+        from jepsen_tpu.ops import planner
+        plan = planner.Plan(engine="no-such-engine")
+        assert planner.traceable(plan) is None
+
+
+# ---------------------------------------------------------------------------
+# CLI wiring
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    def test_lint_in_both_command_maps(self):
+        assert "lint" in cli.standard_commands()
+        assert "lint" in cli.single_test_cmd(lambda o: {})
+
+    def test_cli_ratchet_roundtrip(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\n\ndef f():\n"
+                       "    return time.time()\n")
+        bl = tmp_path / "bl.json"
+        cmds = cli.standard_commands()
+        argv = ["lint", str(bad), "--baseline", str(bl)]
+        assert cli.main(cmds, argv) == 1          # new finding
+        capsys.readouterr()
+        assert cli.main(cmds, argv + ["--write-baseline"]) == 0
+        capsys.readouterr()
+        assert cli.main(cmds, argv) == 0          # baselined
+        capsys.readouterr()
+
+    def test_cli_json_report(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\n\ndef f():\n"
+                       "    return time.time()\n")
+        bl = tmp_path / "bl.json"
+        rc = cli.main(cli.standard_commands(),
+                      ["lint", str(bad), "--baseline", str(bl),
+                       "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["new_findings"][0]["rule"] == "wall-clock-in-frame"
+        assert out["files"] == 1
+
+    def test_cli_rule_filter(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import time\n\n\ndef f():\n"
+                       "    while True:\n        time.sleep(1)\n")
+        bl = tmp_path / "bl.json"
+        rc = cli.main(cli.standard_commands(),
+                      ["lint", str(bad), "--baseline", str(bl),
+                       "--json", "--rule", "naked-sleep-loop"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["rule"] for f in out["new_findings"]} \
+            == {"naked-sleep-loop"}
