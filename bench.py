@@ -629,6 +629,125 @@ def bench_fleet() -> dict:
             "live_fleet_ttl_s": ttl}
 
 
+def bench_remote() -> dict:
+    """ISSUE 16: the remote-tenant network ingest tier, priced three
+    ways over N paced TCP feeders streaming register histories to one
+    ingest listener on localhost (the real wire path — crc+seq framed
+    lines, cursor acks, lease-epoch registration — not an in-memory
+    shortcut).
+
+    (a) **sustained ingest ops/s**: total framed records (invokes +
+    completions) landed durably across all tenants / wall from first
+    append to last drain.  (b) **p99 ingest lag**: client append
+    wall-stamp -> fsynced into the tenant WAL, from the server's own
+    live_ingest_lag_seconds histogram (same-host clocks; loopback, so
+    this prices framing+fsync+ack, not a WAN).  (c) **reconnect-
+    resume gap**: one feeder's socket is severed mid-stream
+    (client.kick()); wall until the server journals the re-dialed
+    session's cursor resume.  Every tenant WAL is byte-compared
+    against its local twin at the end — a lossy drain is an ERROR
+    row, never a fast one.
+
+    CPU-scaled per the PR 11 discipline (feeder count stays at the
+    ISSUE floor — feeders are socket-bound, not core-bound — the
+    per-tenant op count scales); the scaled knobs ride the metric
+    label and the bench_cpus tail key."""
+    import shutil
+    import tempfile
+    import threading
+
+    from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu.live.client import StreamingWAL
+    from jepsen_tpu.live.ingest import LAG_BUCKETS_S, IngestServer
+
+    cpus = os.cpu_count() or 1
+    n_ten = 8                       # the ISSUE 16 floor (N >= 8)
+    ops = int(os.environ.get("JEPSEN_TPU_BENCH_REMOTE_OPS",
+                             2_500 if cpus >= 8 else 600))
+    rootbase = pathlib.Path(tempfile.mkdtemp(prefix="bench-remote-"))
+    srv = IngestServer(rootbase / "root", server_id="bench-ingest",
+                       lease_ttl=2.0).start()
+    gap = None
+    try:
+        locald = rootbase / "local"
+        locald.mkdir()
+        wals = []
+        for i in range(n_ten):
+            h = list(make_history(ops, 4, seed=300 + i))
+            wals.append((StreamingWAL(locald / f"w{i}.wal",
+                                      f"127.0.0.1:{srv.port}",
+                                      f"bt{i}", "t1", writer=f"bw{i}",
+                                      fsync=False), h))
+        n_rec = sum(len(h) for _w, h in wals)
+
+        def feed(wal, hist):
+            for j, o in enumerate(hist):
+                wal.append(o)
+                if j % 50 == 49:    # paced: yield so 8 feeders + the
+                    time.sleep(0.001)   # server share the host fairly
+
+        ths = [threading.Thread(target=feed, args=(w, h), daemon=True)
+               for w, h in wals]
+        t0 = time.monotonic()
+        for t in ths:
+            t.start()
+        # sever one feeder mid-stream: gap = kick -> the server
+        # journals the re-dialed session's cursor resume
+        victim = wals[0][0]
+        while victim.client.acked_seq < 50 \
+                and time.monotonic() - t0 < 60:
+            time.sleep(0.005)
+        r_before = srv.counts["resumes"]
+        tk = time.monotonic()
+        victim.client.kick()
+        while srv.counts["resumes"] <= r_before \
+                and time.monotonic() - tk < 60:
+            time.sleep(0.002)
+        gap = time.monotonic() - tk
+        for t in ths:
+            t.join(600)
+        for w, _h in wals:
+            w.close()               # drains: every frame acked
+        wall = time.monotonic() - t0
+        lossy = []
+        for i, (w, _h) in enumerate(wals):
+            remote = srv.root / f"bt{i}" / "t1" / "history.wal"
+            if not remote.exists() or remote.read_bytes() \
+                    != (locald / f"w{i}.wal").read_bytes():
+                lossy.append(f"bt{i}")
+        p99 = telemetry_mod.REGISTRY.histogram(
+            "live_ingest_lag_seconds",
+            buckets=LAG_BUCKETS_S).quantile(0.99)
+        fenced = srv.counts["fenced"]
+    finally:
+        srv.close()
+        shutil.rmtree(rootbase, ignore_errors=True)
+
+    if lossy or fenced:
+        print(json.dumps({"metric": "ERROR: remote ingest bench lost "
+                          f"or corrupted tenant WALs {lossy} "
+                          f"(fenced={fenced})", "value": 0,
+                          "unit": "ops/sec", "vs_baseline": 0}))
+        return {"error": True}
+    rate = n_rec / wall
+    print(json.dumps({
+        "metric": (f"remote-tenant ingest: {n_ten} paced TCP feeders "
+                   f"x {ops} ops streamed over localhost (crc+seq "
+                   "frames, fsynced tenant WALs, byte-verified; one "
+                   "mid-stream disconnect + cursor resume included "
+                   "in the wall)"),
+        "value": round(rate, 1),
+        "unit": "ops/sec",
+        "vs_baseline": round(p99, 4)}), file=sys.stderr)
+    print(f"# remote ingest: {n_rec} records / {wall:.2f}s "
+          f"({rate:.0f} rec/s); p99 append->fsync lag {p99:.4f}s; "
+          f"reconnect-resume gap {gap:.3f}s", file=sys.stderr)
+    return {"live_remote_ops_s": round(rate, 1),
+            "live_remote_p99_lag_s": round(p99, 4),
+            "live_remote_reconnect_gap_s": round(gap, 3),
+            "live_remote_tenants": n_ten}
+
+
 N_COLD_KEYS = 64         # plan-cache row: small enough that the child
                          # process wall is compile-dominated, same
                          # kernel SHAPES as any 64-key one-shot
@@ -1855,6 +1974,10 @@ def main() -> int:
     if fleet_stats.get("error"):
         return 1
 
+    remote_stats = bench_remote()
+    if remote_stats.get("error"):
+        return 1
+
     plan_stats = bench_plan_cache()
     if plan_stats.get("error"):
         return 1
@@ -1989,6 +2112,12 @@ def main() -> int:
         # sustained drain + the measured takeover gap after a worker
         # dies mid-drain (bench_fleet; ttl disclosed)
         **{k: v for k, v in fleet_stats.items() if v is not None},
+        # the remote-tenant network ingest tier (ISSUE 16): sustained
+        # framed-record ops/s over N paced TCP feeders, p99 client
+        # append -> fsynced-WAL lag, and the measured mid-stream
+        # disconnect -> cursor-resume gap (bench_remote; byte-verified
+        # drain, feeder count disclosed)
+        **{k: v for k, v in remote_stats.items() if v is not None},
         # planner rows (BENCH_r08+): cold-vs-warm PROCESS start with
         # the persistent compiled-plan cache (subprocess-measured,
         # compile seconds child-disclosed) and the double-buffered

@@ -827,8 +827,416 @@ class FleetTarget:
         self._procs = []
 
 
+class RemoteTarget:
+    """The ingest tier's fault space as a campaign target (ISSUE 16):
+    the SUT is a `serve-checker --listen` daemon receiving framed
+    history over TCP, and the nemesis is the NETWORK itself — plus
+    SIGKILL of the receiver.  Every tenant's ground truth is its
+    clean pre-encoded frame list, so the verdict is the robustness
+    contract verbatim: after all faults, each server-side WAL must be
+    byte-identical to the clean stream (torn/dup/reordered frames
+    never reach a WAL), and every fault must surface as counted,
+    journaled events.
+
+    Window names (one-shot per window, except slow-frames):
+      * `frame-torn`    — ship a crc-corrupted copy of the next frame;
+      * `frame-dup`     — re-ship the previous frame (stale seq);
+      * `frame-reorder` — ship frame i+1 before i;
+      * `slow-frames`   — throttle the sender while the window is
+        open;
+      * `disconnect`    — close the socket halfway through a frame;
+      * `stale-writer`  — a second writer claims the tenant with
+        epoch 0 (must be fenced);
+      * `kill-receiver` — SIGKILL the daemon at `at`, respawn on the
+        same port at window end (the survivor-takeover shape).
+
+    Outcome anomaly classes: `frame-torn` / `frame-dup` /
+    `frame-reorder` / `resume` / `fenced` / `backpressure` /
+    `receiver-killed` are coverage (the fault exercised the detection
+    or recovery path); `wal-mismatch` and `stream-stalled` are
+    protocol violations (verdict False — corruption reached a WAL, or
+    acked delivery never completed)."""
+
+    name = "remote"
+    workloads = ("stream",)
+    nemeses = {"frame-torn": None, "frame-dup": None,
+               "frame-reorder": None, "slow-frames": None,
+               "disconnect": None, "stale-writer": None,
+               "kill-receiver": None}
+
+    _ONE_SHOT = ("frame-torn", "frame-dup", "frame-reorder",
+                 "disconnect", "stale-writer")
+
+    def __init__(self, tenants: int = 2, ops_per_tenant: int = 70,
+                 lease_ttl: float = 0.5,
+                 budget_bytes: int = 256 << 10):
+        self.tenants = tenants
+        self.ops_per_tenant = ops_per_tenant
+        self.lease_ttl = lease_ttl
+        self.budget_bytes = budget_bytes
+        self._procs: list = []
+
+    # -- receiver process management -----------------------------------------
+
+    def _spawn(self, root, port: int):
+        import subprocess
+        import sys as sys_mod
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        argv = [sys_mod.executable, "-m", "jepsen_tpu.cli",
+                "serve-checker", str(root),
+                "--listen", f"127.0.0.1:{port}",
+                "--lease-ttl", str(self.lease_ttl),
+                "--backend", "host",
+                "--poll-interval", "0.02",
+                "--tenant-budget-mb",
+                str(self.budget_bytes / (1 << 20))]
+        p = subprocess.Popen(
+            argv, cwd=repo,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self._procs.append(p)
+        return p
+
+    @staticmethod
+    def _learn_port(root, deadline: float) -> int:
+        """The bound port, from the newest ingest status sidecar
+        (the daemon was started with an ephemeral port)."""
+        d = root / "ingest"
+        while time.monotonic() < deadline:
+            sidecars = sorted(d.glob("*.json"),
+                              key=lambda p: p.stat().st_mtime) \
+                if d.is_dir() else []
+            for p in reversed(sidecars):
+                try:
+                    with open(p) as f:
+                        port = int(json.load(f).get("port") or 0)
+                    if port:
+                        return port
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.05)
+        raise TimeoutError("ingest listener never published a port")
+
+    # -- the run -------------------------------------------------------------
+
+    def run(self, schedule: dict, campaign: "Campaign") -> dict:
+        import shutil
+        import tempfile
+        import threading
+        from jepsen_tpu.history import frame_line, invoke_op, ok_op
+        rng = _rng(campaign.seed, "remote", schedule["id"])
+        tl = max(schedule["time_limit"], 3 * self.lease_ttl)
+        root = Path(tempfile.mkdtemp(prefix="remote-campaign-"))
+        outcome = {"verdict": "unknown", "anomalies": [],
+                   "engines": ["remote"], "lag_bucket": "na",
+                   "overlap": "nowin", "quarantined": False,
+                   "leaked": [], "run": None}
+        try:
+            # clean ground-truth streams, pre-encoded: invoke/ok write
+            # pairs (checker-legal; the verdict here is byte identity,
+            # not flags)
+            streams = []
+            for ti in range(self.tenants):
+                lines, seq = [], 0
+                for j in range(self.ops_per_tenant):
+                    v = (j * 7 + ti) % 5
+                    for op in (invoke_op(0, "write", v, index=seq),
+                               ok_op(0, "write", v, index=seq + 1)):
+                        lines.append(frame_line(
+                            op.to_dict(), seq,
+                            wall=time.time()))  # lint: wall-ok(frame stamp, advisory)
+                        seq += 1
+                streams.append(lines)
+            self._spawn(root, 0)
+            port = self._learn_port(root, time.monotonic() + 15.0)
+            port_box = [port]
+
+            # per-tenant fault plans: each window fires against the
+            # tenant that drew it (kill-receiver is global)
+            plans = [[] for _ in range(self.tenants)]
+            kills = []
+            for wi, w in enumerate(schedule["windows"]):
+                entry = {"name": w["name"], "at": w["at"],
+                         "end": w["at"] + w["dur"], "fired": False}
+                if w["name"] == "kill-receiver":
+                    kills.append(entry)
+                else:
+                    plans[wi % self.tenants].append(entry)
+
+            t0 = time.monotonic()
+            deadline = t0 + tl + 20 * self.lease_ttl + 10.0
+            results = [None] * self.tenants
+            threads = [threading.Thread(
+                target=self._feed,
+                args=(ti, port_box, streams[ti], plans[ti], t0, tl,
+                      deadline, results),
+                daemon=True) for ti in range(self.tenants)]
+            for t in threads:
+                t.start()
+            killed = False
+            while any(t.is_alive() for t in threads) \
+                    and time.monotonic() < deadline:
+                el = time.monotonic() - t0
+                for k in kills:
+                    if not k["fired"] and el >= k["at"]:
+                        k["fired"] = True
+                        killed = True
+                        for p in self._procs:
+                            if p.poll() is None:
+                                p.kill()
+                                p.wait(5)
+                        # respawn on the SAME port at window end: the
+                        # takeover shape (a fleet survivor's listener)
+                        time.sleep(min(max(k["end"] - el, 0.0), 1.0))
+                        self._spawn(root, port_box[0])
+                time.sleep(0.05)
+            for t in threads:
+                t.join(1.0)
+            anomalies, resume_gap = self._reduce(root, streams,
+                                                 results, killed)
+            outcome["verdict"] = not ({"wal-mismatch",
+                                       "stream-stalled"} & anomalies)
+            outcome["anomalies"] = sorted(anomalies)
+            outcome["lag_bucket"] = lag_bucket(resume_gap)
+            outcome["overlap"] = \
+                "all" if schedule["windows"] and all(
+                    w["at"] < tl for w in schedule["windows"]) \
+                else ("some" if schedule["windows"] else "nowin")
+        except Exception as e:          # noqa: BLE001 - harness error
+            outcome["verdict"] = "crashed"
+            outcome["error"] = type(e).__name__
+            log.warning("remote target crashed on %s",
+                        schedule["id"], exc_info=True)
+        finally:
+            self.reap()
+            shutil.rmtree(root, ignore_errors=True)
+        return outcome
+
+    # -- the protocol feeder (fault-injecting sender) ------------------------
+
+    def _feed(self, ti: int, port_box, lines, plan, t0, tl, deadline,
+              results) -> None:
+        """Stream one tenant's frames, injecting this tenant's
+        scheduled wire faults; reconnect-and-resume from the acked
+        cursor after every server-side close.  Records (acked, resume
+        gap) into results[ti]."""
+        import socket as socket_mod
+        from jepsen_tpu.live.ingest import (ctl_line, parse_ctl,
+                                            split_lines)
+        name, ts = f"remote{ti}", "t1"
+        writer = f"feeder{ti}"
+        total = len(lines)
+        state = {"epoch": 0, "acked": 0, "paused": False,
+                 "resume_gap": None}
+        pace = max(tl * 0.5 / max(total, 1), 0.001)
+        down_since = None
+
+        def pump(sock, buf, wait_s=0.0):
+            """Drain inbound ctl frames; returns (buf, alive)."""
+            sock.settimeout(max(wait_s, 0.005))
+            try:
+                chunk = sock.recv(1 << 14)
+                if not chunk:
+                    return buf, False
+                buf += chunk
+            except socket_mod.timeout:
+                return buf, True
+            except OSError:
+                return buf, False
+            lines_in, buf = split_lines(buf)
+            for ln in lines_in:
+                c = parse_ctl(ln)
+                if not c:
+                    continue
+                if c.get("t") == "ack":
+                    state["epoch"] = int(c.get("epoch")
+                                         or state["epoch"])
+                    state["acked"] = max(state["acked"],
+                                         int(c.get("seq") or 0))
+                elif c.get("t") == "pause":
+                    state["paused"] = True
+                elif c.get("t") == "resume":
+                    state["paused"] = False
+                elif c.get("t") in ("torn", "fenced"):
+                    return buf, False
+            return buf, True
+
+        while state["acked"] < total \
+                and time.monotonic() < deadline:
+            try:
+                sock = socket_mod.create_connection(
+                    ("127.0.0.1", port_box[0]), timeout=1.0)
+            except OSError:
+                time.sleep(0.05)
+                continue
+            try:
+                sock.sendall(ctl_line(t="hello", name=name, ts=ts,
+                                      writer=writer,
+                                      epoch=state["epoch"]))
+                buf, alive = b"", True
+                got_ack = state["acked"]
+                reg_end = time.monotonic() + 2.0
+                while alive and time.monotonic() < reg_end:
+                    before = state["epoch"]
+                    buf, alive = pump(sock, buf, wait_s=0.05)
+                    if state["epoch"] != before or before > 0:
+                        break
+                if not alive:
+                    continue
+                if down_since is not None:
+                    gap = time.monotonic() - down_since
+                    state["resume_gap"] = max(
+                        state["resume_gap"] or 0.0, gap)
+                    down_since = None
+                i = state["acked"]
+                state["paused"] = False
+                while i < total and alive \
+                        and time.monotonic() < deadline:
+                    buf, alive = pump(sock, buf)
+                    if not alive:
+                        break
+                    if state["paused"]:
+                        buf, alive = pump(sock, buf, wait_s=0.05)
+                        continue
+                    el = time.monotonic() - t0
+                    # one fault per frame, earliest-scheduled first;
+                    # a one-shot whose window elapsed mid-reconnect
+                    # still fires late (the fault space cares that it
+                    # happened, not when)
+                    fault = None
+                    slow = False
+                    for w in plan:
+                        if w["name"] == "slow-frames":
+                            slow = slow or w["at"] <= el < w["end"]
+                        elif not w["fired"] and w["at"] <= el \
+                                and (fault is None
+                                     or w["at"] < fault["at"]):
+                            fault = w
+                    if fault is not None:
+                        nm = fault["name"]
+                        if (nm == "frame-dup" and i == 0) \
+                                or (nm == "stale-writer"
+                                    and state["epoch"] < 1):
+                            fault = None       # preconditions not met
+                    if fault is not None:
+                        fault["fired"] = True
+                        nm = fault["name"]
+                        if nm == "frame-torn":
+                            sock.sendall(lines[i].replace(
+                                b'"crc":"', b'"crc":"f', 1))
+                            alive = False
+                            break
+                        if nm == "frame-reorder" and i + 1 < total:
+                            sock.sendall(lines[i + 1])
+                            alive = False
+                            break
+                        if nm == "frame-dup":
+                            sock.sendall(lines[i - 1])
+                        elif nm == "disconnect":
+                            sock.sendall(lines[i][:max(
+                                len(lines[i]) // 2, 1)])
+                            alive = False
+                            break
+                        elif nm == "stale-writer":
+                            self._stale_probe(port_box[0], name, ts)
+                    if slow:
+                        time.sleep(0.01)
+                    sock.sendall(lines[i])
+                    i += 1
+                    time.sleep(pace)
+                # wait for the tail acks, then part cleanly
+                tail_end = time.monotonic() + 5.0
+                while alive and state["acked"] < total \
+                        and time.monotonic() < min(tail_end,
+                                                   deadline):
+                    buf, alive = pump(sock, buf, wait_s=0.05)
+                if state["acked"] >= total:
+                    sock.sendall(ctl_line(t="bye"))
+            except OSError:
+                pass
+            finally:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if state["acked"] < total and down_since is None:
+                    down_since = time.monotonic()
+        results[ti] = (state["acked"], state["resume_gap"])
+
+    @staticmethod
+    def _stale_probe(port: int, name: str, ts: str) -> None:
+        """The duplicate-writer shape: a second writer claims the
+        tenant with epoch 0 and must be fenced."""
+        import socket as socket_mod
+        from jepsen_tpu.live.ingest import ctl_line
+        try:
+            s = socket_mod.create_connection(("127.0.0.1", port),
+                                             timeout=1.0)
+            s.sendall(ctl_line(t="hello", name=name, ts=ts,
+                               writer="zombie", epoch=0))
+            s.settimeout(1.0)
+            try:
+                s.recv(4096)            # the fenced verdict
+            except OSError:
+                pass
+            s.close()
+        except OSError:
+            pass
+
+    def _reduce(self, root, streams, results, killed):
+        """Coverage classes from the server journals + the byte-level
+        verdict from the WALs themselves."""
+        anomalies = set()
+        resume_gap = None
+        for ti, lines in enumerate(streams):
+            wal = root / f"remote{ti}" / "t1" / "history.wal"
+            clean = b"".join(lines)
+            try:
+                got = wal.read_bytes()
+            except OSError:
+                got = b""
+            if got != clean:
+                anomalies.add("wal-mismatch" if got
+                              else "stream-stalled")
+            r = results[ti]
+            if r is None or r[0] < len(lines):
+                anomalies.add("stream-stalled")
+            if r is not None and isinstance(r[1], (int, float)):
+                resume_gap = max(resume_gap or 0.0, r[1])
+        d = root / "ingest"
+        classes = {"ingest-torn": "frame-torn",
+                   "ingest-dup": "frame-dup",
+                   "ingest-reorder": "frame-reorder",
+                   "ingest-fenced": "fenced",
+                   "ingest-pause": "backpressure"}
+        for p in sorted(d.glob("*.jsonl")) if d.is_dir() else []:
+            for e in telemetry.read_events(p):
+                cls = classes.get(e.get("type"))
+                if cls:
+                    anomalies.add(cls)
+                if e.get("type") == "ingest-register" \
+                        and e.get("resumed"):
+                    anomalies.add("resume")
+        if killed:
+            anomalies.add("receiver-killed")
+        return anomalies, resume_gap
+
+    def reap(self) -> None:
+        import signal
+        for p in self._procs:
+            try:
+                if p.poll() is None:
+                    p.send_signal(signal.SIGCONT)
+                    p.send_signal(signal.SIGKILL)
+                    p.wait(5)
+            except Exception:           # noqa: BLE001
+                pass
+        self._procs = []
+
+
 TARGETS = {"kvd": KvdTarget, "mock": MockTarget,
-           "fleet": FleetTarget}
+           "fleet": FleetTarget, "remote": RemoteTarget}
 
 
 def suite_target(name: str, test_fn: Callable, registry: dict,

@@ -512,13 +512,17 @@ def campaign_cmd_spec(test_fn: Optional[Callable] = None,
                                  "store/campaigns/<name>/)")
         if test_fn is None or registry is None:
             parser.add_argument("--sut", default="kvd",
-                                choices=["kvd", "mock", "fleet"],
+                                choices=["kvd", "mock", "fleet",
+                                         "remote"],
                                 help="in-tree target: kvd over the "
                                      "local transport, the "
-                                     "deterministic mock SUT, or the "
+                                     "deterministic mock SUT, the "
                                      "serve-checker fleet itself "
                                      "(nemesis kills/pauses checker "
-                                     "workers)")
+                                     "workers), or the remote ingest "
+                                     "tier (nemesis = the network: "
+                                     "torn/dup/reordered frames, "
+                                     "disconnects, receiver kills)")
         parser.add_argument("--seed", type=int, default=0)
         parser.add_argument("--schedules", type=int, default=20,
                             metavar="N", help="schedule budget")
@@ -608,6 +612,22 @@ def serve_checker_cmd(opts) -> int:
         deadline_s=opts.deadline_s,
         worker_id=opts.worker_id,
         lease_ttl=(opts.lease_ttl or None))
+    ingest = None
+    if getattr(opts, "listen", None):
+        # the network ingest tier (ISSUE 16): remote runs stream
+        # crc+seq-framed history over TCP into per-tenant WALs under
+        # this root, which the scheduler above then checks like any
+        # local run (docs/remote-ingest.md)
+        from jepsen_tpu.live.ingest import IngestServer
+        host, _, port = str(opts.listen).rpartition(":")
+        ingest = IngestServer(
+            root, host=host or "127.0.0.1", port=int(port or 0),
+            server_id=svc.scheduler.worker_id,
+            lease_ttl=(opts.lease_ttl or 2.0),
+            tenant_budget_bytes=int(opts.tenant_budget_mb * (1 << 20)),
+            scheduler=svc.scheduler).start()
+        print(f"ingest listening on {ingest.host}:{ingest.port}",
+              file=sys.stderr, flush=True)
     if opts.once:
         ticks = svc.drain()
         sched = svc.scheduler
@@ -621,9 +641,15 @@ def serve_checker_cmd(opts) -> int:
               f"{sched.flags_total} violation flag(s)"
               + (f", {unowned} unowned run(s)" if unowned else ""),
               file=sys.stderr)
+        if ingest is not None:
+            ingest.close()
         svc.close()
         return 1 if sched.flags_total else 0
-    svc.run()
+    try:
+        svc.run()
+    finally:
+        if ingest is not None:
+            ingest.close()
     return 0
 
 
@@ -658,6 +684,12 @@ def serve_checker_fleet(opts) -> int:
                 "--max-states", str(opts.max_states),
                 "--window-events", str(opts.window_events),
                 "--tenant-budget-mb", str(opts.tenant_budget_mb)]
+        if getattr(opts, "listen", None):
+            # each worker binds its own ephemeral port (published in
+            # its store/ingest/<id>.json sidecar): clients treat the
+            # set as a failover list
+            host = str(opts.listen).rpartition(":")[0] or "127.0.0.1"
+            argv += ["--listen", f"{host}:0"]
         if opts.strict_init:
             argv.append("--strict-init")
         if opts.deadline_s is not None:
@@ -793,6 +825,15 @@ def serve_checker_cmd_spec() -> dict:
                                  "root and restart dead ones with "
                                  "backoff (implies --lease-ttl, "
                                  "default 5s)")
+        parser.add_argument("--listen", default=None,
+                            metavar="HOST:PORT",
+                            help="accept remote tenants: stream "
+                                 "crc+seq-framed history over TCP "
+                                 "into per-tenant WALs under the root "
+                                 "(port 0 binds an ephemeral port, "
+                                 "published in the store/ingest/ "
+                                 "status sidecar; with --workers, "
+                                 "every worker gets its own port)")
 
     return {"serve-checker": {
         "opts": add_opts, "run": serve_checker_cmd,

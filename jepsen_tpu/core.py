@@ -587,8 +587,20 @@ def run_case(test) -> History:
     if test.get("name") and test.get("start-time"):
         from jepsen_tpu import store
         from jepsen_tpu.history import HistoryWAL
-        wal = HistoryWAL(store.make_path(test, "history.wal"),
-                         telemetry=telemetry_mod.of(test))
+        stream = test.get("live-stream")
+        if stream:
+            # one test-map key turns the WAL into a remote tenant:
+            # every journaled frame also streams to a serve-checker
+            # --listen daemon (live/client.py, docs/remote-ingest.md)
+            from jepsen_tpu.live.client import StreamingWAL
+            wal = StreamingWAL(store.make_path(test, "history.wal"),
+                               stream, store._sanitize(test["name"]),
+                               test["start-time"],
+                               writer=test.get("live-stream-writer"),
+                               telemetry=telemetry_mod.of(test))
+        else:
+            wal = HistoryWAL(store.make_path(test, "history.wal"),
+                             telemetry=telemetry_mod.of(test))
     history = History(journal=True, wal=wal)  # columns build as ops
     lock = threading.RLock()                  # land, so analysis
     test["history"] = history                 # starts from arrays

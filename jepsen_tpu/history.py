@@ -600,6 +600,47 @@ def _wal_payload(op_dict: dict) -> str:
                       default=repr)
 
 
+def frame_line(payload_dict: dict, seq: int,
+               wall: Optional[float] = None, key: str = "op") -> bytes:
+    """Encode ONE frame line — the unit both the WAL and the ingest
+    wire protocol (docs/remote-ingest.md) are made of.  With `wall`
+    the bytes are exactly what HistoryWAL.append writes; without it,
+    the no-stamp variant (campaign ledgers).  The `w` stamp rides
+    outside the crc-guarded payload, as always."""
+    body = _wal_payload(payload_dict)
+    crc = zlib.crc32(body.encode())
+    w = "" if wall is None else f'"w":{wall:.6f},'
+    return f'{{"i":{seq},{w}"crc":"{crc:08x}","{key}":{body}}}\n' \
+        .encode()
+
+
+def parse_frame_line(line, key: str = "op",
+                     seq: Optional[int] = None):
+    """Validate ONE complete frame line; `(record, None)` when it
+    holds, `(None, reason)` when it doesn't.  The single definition of
+    frame validity: `follow_frames` applies it per line with the
+    running sequence, the ingest tier (live/ingest.py) applies it per
+    wire frame with `seq=None` and classifies the sequence number
+    itself (dup vs reorder).  Guard order is parse → envelope → seq →
+    crc, matching the historical stop_reason strings byte-for-byte."""
+    if isinstance(line, (bytes, bytearray)):
+        line = bytes(line).decode("utf-8", errors="replace")
+    line = line.strip()
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None, "unparseable complete record"
+    if not isinstance(rec, dict) or key not in rec:
+        return None, f"not a {key!r} frame"
+    if seq is not None and rec.get("i") != seq:
+        return None, (f"sequence break (expected {seq}, got "
+                      f"{rec.get('i')})")
+    payload = _wal_payload(rec[key])
+    if f"{zlib.crc32(payload.encode()):08x}" != rec.get("crc"):
+        return None, "crc mismatch"
+    return rec, None
+
+
 @dataclasses.dataclass
 class FrameSegment:
     """One `follow_frames` read: the validated records, plus the cursor
@@ -648,23 +689,9 @@ def follow_frames(path, offset: int = 0, seq: int = 0,
         if not line:
             pos = nl + 1
             continue
-        try:
-            rec = json.loads(line)
-        except json.JSONDecodeError:
-            corrupt, reason = True, \
-                f"record {seq}: unparseable complete record"
-            break
-        if not isinstance(rec, dict) or key not in rec:
-            corrupt, reason = True, f"record {seq}: not a {key!r} frame"
-            break
-        if rec.get("i") != seq:
-            corrupt, reason = True, (f"record {seq}: sequence break "
-                                     f"(expected {seq}, got "
-                                     f"{rec.get('i')})")
-            break
-        payload = _wal_payload(rec[key])
-        if f"{zlib.crc32(payload.encode()):08x}" != rec.get("crc"):
-            corrupt, reason = True, f"record {seq}: crc mismatch"
+        rec, err = parse_frame_line(line, key=key, seq=seq)
+        if err is not None:
+            corrupt, reason = True, f"record {seq}: {err}"
             break
         records.append(rec)
         seq += 1
@@ -741,23 +768,30 @@ class HistoryWAL:
         self.lock = threading.Lock()
         self._n = 0
         self._dead = False
-        self._f = open(self.path, "a")
+        self._f = open(self.path, "ab")
+
+    def _write_line(self, line: bytes) -> None:
+        """The single write point for a framed line.  Subclass hook:
+        the streaming client (live/client.py StreamingWAL) overrides
+        this to tee the exact bytes onto the ingest wire — byte
+        identity between the local WAL and the remote copy is the
+        robustness contract, so there is exactly one encoder."""
+        self._f.write(line)
 
     def append(self, o: "Op") -> None:
         with self.lock:
             if self._dead:
                 return
             try:
-                payload = _wal_payload(o.to_dict())
-                crc = zlib.crc32(payload.encode())
-                # embed the canonical payload verbatim (it is itself
-                # JSON) — the reader re-derives the crc from it alone.
-                # `w` (append wall clock) rides outside the guarded
-                # payload: follow()-based consumers measure detection
-                # lag from it; recover() ignores it.
-                # lint: wall-ok(advisory envelope stamp; recovery orders by i/crc, never w)
-                self._f.write(f'{{"i":{self._n},"w":{time.time():.6f},'
-                              f'"crc":"{crc:08x}","op":{payload}}}\n')
+                # frame_line embeds the canonical payload verbatim (it
+                # is itself JSON) — the reader re-derives the crc from
+                # it alone.  `w` (append wall clock) rides outside the
+                # guarded payload: follow()-based consumers measure
+                # detection lag from it; recover() ignores it.
+                self._write_line(frame_line(
+                    o.to_dict(), self._n,
+                    # lint: wall-ok(advisory envelope stamp; recovery orders by i/crc, never w)
+                    wall=time.time()))
                 self._f.flush()
                 if self.fsync:
                     t0 = time.monotonic()

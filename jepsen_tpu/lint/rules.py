@@ -413,14 +413,17 @@ def _rule_bare_fallback(ctx) -> list:
 # stray-writer
 # ---------------------------------------------------------------------------
 #
-# WHY: live.jsonl and lease.json are single-writer-under-lease
-# surfaces — the fleet's exactly-once and fencing guarantees hold only
-# because every write goes through the scheduler's lease check.  Any
-# other module opening them for write is a fenced-bypass bug waiting
-# for a fault schedule to find it.
+# WHY: live.jsonl, lease.json and history.wal are single-writer-
+# under-lease surfaces — the fleet's exactly-once and fencing
+# guarantees hold only because every write goes through the
+# scheduler's lease check (live.jsonl/lease.json) or the WAL class /
+# the ingest tier's epoch-fenced registration (history.wal, ISSUE
+# 16).  Any other module opening them for write is a fenced-bypass
+# bug waiting for a fault schedule to find it.
 
-_GUARDED_FILES = ("live.jsonl", "lease.json")
-_ALLOWED_WRITERS = ("live/scheduler.py", "live/lease.py")
+_GUARDED_FILES = ("live.jsonl", "lease.json", "history.wal")
+_ALLOWED_WRITERS = ("live/scheduler.py", "live/lease.py",
+                    "live/ingest.py", "history.py")
 _WRITE_ATTRS = frozenset({"write_text", "write_bytes"})
 
 
@@ -481,10 +484,11 @@ def _rule_stray_writer(ctx) -> list:
                 "stray-writer", ctx.relpath, node.lineno,
                 node.col_offset,
                 "write to a single-writer-under-lease surface "
-                "(live.jsonl / lease.json) outside scheduler/lease "
-                "code",
+                "(live.jsonl / lease.json / history.wal) outside "
+                "scheduler/lease/WAL/ingest code",
                 "route the write through live/scheduler.py (lease-"
-                "checked) or live/lease.py",
+                "checked), live/lease.py, history.py (the WAL class) "
+                "or live/ingest.py (epoch-fenced)",
                 _qualname(stack)))
     return out
 

@@ -1,0 +1,348 @@
+"""The embeddable ingest client (ISSUE 16): stream a run's history
+WAL to a `serve-checker --listen` daemon while it is being written.
+
+`StreamingWAL` is a drop-in `history.HistoryWAL` — same path, same
+fsync discipline, same bytes — that tees every framed line onto the
+wire via `IngestClient`.  Byte identity is structural: there is one
+encoder (`history.frame_line`, called by `HistoryWAL.append`) and the
+client ships the encoded bytes verbatim, so the remote WAL can only
+ever be a prefix-or-equal copy of the local one.
+
+Fault model (the robustness contract's client half):
+
+* The socket dying — or the server closing it on a torn/reordered
+  frame — never loses data: frames stay buffered until the server's
+  fsynced-then-acked cursor covers them, and every reconnect
+  re-registers (hello carries the last acked epoch) and resends from
+  the acked seq.  Reconnects ride `reconnect.CircuitBreaker` +
+  `reconnect.backoff_s` — the same discipline as every other flaky
+  transport in-tree.
+* Server `pause` frames stop the sender; the producer keeps running
+  until the bounded buffer fills, then blocks — backpressure
+  propagates into the run loop as real flow control, never unbounded
+  memory.
+* A `fenced` verdict is terminal: this writer lost its epoch (a newer
+  writer owns the tenant).  The client goes quiet and the run
+  continues on its local WAL alone — streaming is an overlay, never a
+  single point of failure for the run itself.
+
+`kick()` force-closes the current socket mid-frame — the fault hook
+the acceptance tests and `RemoteTarget` use to exercise the
+disconnect/resume path deterministically.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Optional
+
+from jepsen_tpu import history as history_mod
+from jepsen_tpu import reconnect
+from jepsen_tpu.live.ingest import ctl_line, parse_ctl, split_lines
+
+log = logging.getLogger("jepsen.ingest")
+
+
+def _as_addrs(addr) -> list:
+    """[(host, port), ...] from 'h:p', (h, p), or a list of either.
+    Multiple addresses are the failover set: a fleet survivor's
+    listener is just the next address on reconnect."""
+    if isinstance(addr, (list, tuple)) and addr \
+            and not (len(addr) == 2 and isinstance(addr[1], int)):
+        out = []
+        for a in addr:
+            out.extend(_as_addrs(a))
+        return out
+    if isinstance(addr, tuple):
+        return [(addr[0], int(addr[1]))]
+    host, _, port = str(addr).rpartition(":")
+    return [(host or "127.0.0.1", int(port))]
+
+
+class IngestClient:
+    """Background sender for framed WAL lines.  `send` never raises
+    and never loses an accepted frame short of `fenced`/`close`."""
+
+    def __init__(self, addr, name: str, ts: str,
+                 writer: Optional[str] = None, *, epoch: int = 0,
+                 breaker: Optional[reconnect.CircuitBreaker] = None,
+                 max_buffer: int = 4096,
+                 base_backoff_s: float = 0.05,
+                 cap_backoff_s: float = 1.0,
+                 connect_timeout_s: float = 2.0):
+        self.addrs = _as_addrs(addr)
+        self.name, self.ts = name, ts
+        self.writer = writer or f"run-{id(self):x}"
+        self.epoch = int(epoch)         # last acked epoch (credential)
+        self.breaker = breaker or reconnect.CircuitBreaker(
+            node=f"ingest:{self.addrs[0][0]}:{self.addrs[0][1]}",
+            threshold=5, cooldown_s=1.0)
+        self.base_backoff_s = base_backoff_s
+        self.cap_backoff_s = cap_backoff_s
+        self.connect_timeout_s = connect_timeout_s
+        self.max_buffer = int(max_buffer)
+        self._cond = threading.Condition()
+        self._buf: list = []            # [(seq, line)] not yet acked
+        self._sent = 0                  # prefix of _buf on the wire
+        self.acked_seq = 0              # server's next expected seq
+        self.paused = False
+        self.fenced = False
+        self.closed = False
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self.reconnects = 0
+        self.registered = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="ingest-send",
+                                        daemon=True)
+        self._thread.start()
+
+    # -- producer side -------------------------------------------------------
+
+    def send(self, seq: int, line: bytes) -> bool:
+        """Enqueue one framed line.  Blocks while the bounded buffer
+        is full (backpressure reaching the producer); returns False —
+        frame dropped from the STREAM, never from the local WAL —
+        once fenced or closed."""
+        with self._cond:
+            while len(self._buf) >= self.max_buffer \
+                    and not (self.fenced or self.closed
+                             or self._stop.is_set()):
+                self._cond.wait(0.05)
+            if self.fenced or self.closed or self._stop.is_set():
+                return False
+            self._buf.append((int(seq), line))
+            self._cond.notify_all()
+        return True
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._buf)
+
+    def kick(self) -> None:
+        """Force-close the live socket (fault hook: a mid-frame
+        network failure on demand).  The sender reconnects and
+        resumes from the acked cursor."""
+        s = self._sock
+        if s is not None:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """True once every accepted frame is acked (or fenced)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._buf and not self.fenced \
+                    and not self._stop.is_set():
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._cond.wait(min(left, 0.05))
+            return not self._buf or self.fenced
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout_s)
+        if self._thread.is_alive():     # server gone: stop retrying
+            self._stop.set()
+            self.kick()
+            with self._cond:
+                self._cond.notify_all()
+            self._thread.join(2.0)
+
+    # -- sender thread -------------------------------------------------------
+
+    def _idle(self, delay_s: float) -> None:
+        self._stop.wait(min(max(delay_s, 0.01), 0.5))
+
+    def _done(self) -> bool:
+        with self._cond:
+            return self._stop.is_set() or self.fenced \
+                or (self.closed and not self._buf)
+
+    def _run(self) -> None:
+        attempt = 0
+        addr_i = 0
+        while not self._done():
+            try:
+                self.breaker.check()
+            except reconnect.BreakerOpen as e:
+                self._idle(e.retry_in_s)
+                continue
+            addr = self.addrs[addr_i % len(self.addrs)]
+            sock = None
+            try:
+                sock = socket.create_connection(
+                    addr, timeout=self.connect_timeout_s)
+                sock.settimeout(0.02)
+                self._sock = sock
+                clean = self._session(sock)
+            except OSError:
+                clean = False
+            finally:
+                self._sock = None
+                if sock is not None:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            if self._done():
+                break
+            self.breaker.failure()
+            self.registered.clear()
+            self.reconnects += 1
+            addr_i += 1                 # failover: next listener
+            self._idle(reconnect.backoff_s(
+                attempt, self.base_backoff_s, self.cap_backoff_s,
+                name=self.writer))
+            attempt = 0 if clean else attempt + 1
+
+    def _session(self, sock) -> bool:
+        """One registered connection; returns True when it ended for
+        a clean reason (drained + bye, or pause-idle kick)."""
+        sock.sendall(ctl_line(t="hello", name=self.name, ts=self.ts,
+                              writer=self.writer, epoch=self.epoch))
+        ok, buf = self._await_ack(sock)
+        if not ok:
+            return False
+        self.breaker.success()
+        self.paused = False
+        while not self._stop.is_set():
+            # 1) drain inbound ctl frames
+            try:
+                chunk = sock.recv(1 << 14)
+                if not chunk:
+                    return False        # server closed on us
+                buf += chunk
+            except socket.timeout:
+                pass
+            lines, buf = split_lines(buf)
+            for line in lines:
+                if not self._ctl(parse_ctl(line)):
+                    return False        # fenced (terminal)
+            if self.fenced:
+                return False
+            # 2) push outbound frames
+            with self._cond:
+                batch = [] if self.paused \
+                    else self._buf[self._sent:self._sent + 64]
+                drained = self.closed and not self._buf
+            if batch:
+                sock.sendall(b"".join(line for _, line in batch))
+                with self._cond:
+                    self._sent = min(self._sent + len(batch),
+                                     len(self._buf))
+            elif drained:
+                sock.sendall(ctl_line(t="bye"))
+                return True
+            else:
+                with self._cond:
+                    self._cond.wait(0.02)
+        return True
+
+    def _await_ack(self, sock):
+        """(registered?, unconsumed bytes) — the registration ack,
+        plus whatever the server pipelined right behind it (a pause,
+        typically) for `_session` to process in order."""
+        buf = b""
+        deadline = time.monotonic() + self.connect_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                chunk = sock.recv(1 << 12)
+                if not chunk:
+                    return False, b""
+                buf += chunk
+            except socket.timeout:
+                continue
+            lines, buf = split_lines(buf)
+            for k, line in enumerate(lines):
+                ctl = parse_ctl(line)
+                if not ctl:
+                    continue
+                if ctl.get("t") == "fenced":
+                    self._fence(ctl)
+                    return False, b""
+                if ctl.get("t") == "ack":
+                    self._on_ack(ctl)
+                    self._sent = 0      # resend everything unacked
+                    self.registered.set()
+                    for later in lines[k + 1:]:
+                        if not self._ctl(parse_ctl(later)):
+                            return False, b""
+                    return True, buf
+        return False, b""
+
+    def _fence(self, ctl: dict) -> None:
+        log.warning("ingest writer %s fenced for %s/%s (%s); "
+                    "continuing on the local WAL alone", self.writer,
+                    self.name, self.ts, ctl.get("why"))
+        with self._cond:
+            self.fenced = True
+            self._cond.notify_all()
+
+    def _on_ack(self, ctl: dict) -> None:
+        with self._cond:
+            self.epoch = int(ctl.get("epoch") or self.epoch)
+            seq = int(ctl.get("seq") or 0)
+            if seq > self.acked_seq:
+                self.acked_seq = seq
+            drop = 0
+            while drop < len(self._buf) and self._buf[drop][0] < seq:
+                drop += 1
+            if drop:
+                del self._buf[:drop]
+                self._sent = max(self._sent - drop, 0)
+            self._cond.notify_all()
+
+    def _ctl(self, ctl: Optional[dict]) -> bool:
+        if not ctl:
+            return True
+        t = ctl.get("t")
+        if t == "ack":
+            self._on_ack(ctl)
+        elif t == "pause":
+            self.paused = True
+        elif t == "resume":
+            self.paused = False
+        elif t == "fenced":
+            self._fence(ctl)
+            return False
+        # "torn": informational — the server closes the socket next,
+        # and the reconnect path resumes from the acked cursor
+        return True
+
+
+class StreamingWAL(history_mod.HistoryWAL):
+    """A HistoryWAL that also streams: every framed line goes to disk
+    exactly as before AND onto the ingest wire.  `core.run_case`
+    builds one instead of a plain WAL when the test map carries
+    `live-stream: "HOST:PORT"`."""
+
+    def __init__(self, path, addr, name: str, ts: str,
+                 writer: Optional[str] = None, fsync: bool = True,
+                 telemetry=None, **client_kw):
+        super().__init__(path, fsync=fsync, telemetry=telemetry)
+        self.client = IngestClient(addr, name, ts, writer=writer,
+                                   **client_kw)
+
+    def _write_line(self, line: bytes) -> None:
+        super()._write_line(line)
+        # under the WAL lock: stream order == journal order, and a
+        # full client buffer blocks the producer here — backpressure
+        # reaching the run loop is the point, not a hazard
+        self.client.send(self._n, line)
+
+    def close(self) -> None:
+        super().close()
+        self.client.close()

@@ -1,0 +1,670 @@
+"""Remote tenants: the fault-tolerant network ingest tier (ISSUE 16).
+
+The WAL format IS the wire format.  A client streams the exact bytes
+`history.HistoryWAL` writes — crc+seq-framed JSON lines — over one TCP
+connection, and this server journals the *raw validated bytes* into a
+per-tenant `store/<name>/<ts>/history.wal` it owns via lease.  Because
+the server never re-encodes, the remote WAL is byte-identical to the
+clean client-side stream no matter what the network did in between:
+torn, duplicated, and reordered frames are detected by the same
+`parse_frame_line` guard `follow_frames` applies to files, counted,
+journaled, and kept OUT of the WAL — never silent corruption.
+
+Protocol (docs/remote-ingest.md), one JSON line per frame, full
+duplex on a single socket:
+
+  data  frame  client→server: a verbatim WAL line  {"i":seq,"w":...,
+               "crc":"...","op":{...}}\n
+  ctl   frame  either way: a line starting {"ctl": — currently
+               hello/bye client→server; ack/pause/resume/torn/fenced
+               server→client.
+
+Fencing: registration rides lease epochs (live/lease.py) under
+`store/ingest/<name>/<ts>/lease.json` — separate from the *checker's*
+run-dir lease, because the writer of a WAL and the checker of a WAL
+are different roles.  A duplicate writer, or a zombie reconnecting
+with a stale epoch, is rejected exactly like a fenced fleet worker:
+counted, journaled, connection closed.  Every registration bumps the
+epoch (takeover), so the acked epoch the client carries is the only
+credential it needs across reconnects.
+
+Durability: a frame is acked only after its bytes are fsynced, so the
+acked (offset, seq) cursor survives SIGKILL of this server; a fleet
+survivor re-derives the cursor from the WAL's intact prefix and the
+client resumes exactly there (resend of unacked frames; anything the
+dead server journaled-but-never-acked arrives again with a stale seq
+and is dropped as a dup — idempotent, not lossy).
+
+Flow control: per-tenant backlog (bytes journaled minus bytes the
+co-resident checker has consumed) over the byte budget emits a
+`pause` ctl frame; the client stops sending and buffers — boundedly —
+until `resume`.  The same budget that sheds load inside the scheduler
+(ISSUE 6) is now a real wire-level protocol.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from jepsen_tpu import history as history_mod
+from jepsen_tpu import telemetry
+from jepsen_tpu.live import lease as lease_mod
+
+log = logging.getLogger("jepsen.ingest")
+
+# Store-root bookkeeping dir for the ingest tier: writer-registration
+# leases + the server's own event journal/status sidecar.  Excluded
+# from store.tests() and scheduler discovery like fleet/ and
+# campaigns/ (store.ingest_root is the canonical accessor).
+INGEST_DIR = "ingest"
+
+# Tenant names that can never be run dirs (scheduler.NON_RUN_DIRS plus
+# our own bookkeeping dir) — a client claiming one is refused outright.
+_RESERVED = {"ci", "current", "latest", "campaigns", "plan-cache",
+             "fleet", INGEST_DIR}
+
+# Ingest-lag histogram buckets (append wall stamp → journaled here):
+# sub-ms loopback through multi-second WAN/backpressure stalls.
+LAG_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+_KIND_NAMES = ("invoke", "ok", "fail", "info", "unknown", "nonclient")
+
+
+def ctl_line(**fields) -> bytes:
+    """Encode one control frame.  Control lines are distinguishable
+    from data frames by their first bytes: data is always {"i": (the
+    framing puts the sequence first), control is always {"ctl":."""
+    return (json.dumps({"ctl": fields}, sort_keys=True,
+                       separators=(",", ":")) + "\n").encode()
+
+
+def parse_ctl(line) -> Optional[dict]:
+    """The ctl payload dict, or None when the line isn't control."""
+    if isinstance(line, (bytes, bytearray)):
+        line = bytes(line).decode("utf-8", errors="replace")
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        return None
+    if isinstance(rec, dict) and isinstance(rec.get("ctl"), dict):
+        return rec["ctl"]
+    return None
+
+
+def split_lines(buf: bytes):
+    """(complete_lines, remainder): each returned line keeps its
+    trailing newline — the server journals data lines verbatim, so
+    the split must never normalize bytes."""
+    lines = []
+    pos = 0
+    while True:
+        nl = buf.find(b"\n", pos)
+        if nl < 0:
+            break
+        lines.append(buf[pos:nl + 1])
+        pos = nl + 1
+    return lines, buf[pos:]
+
+
+def _safe_component(s) -> bool:
+    return (isinstance(s, str) and bool(s) and "/" not in s
+            and "\\" not in s and s not in (".", "..")
+            and not s.startswith("."))
+
+
+class _Session:
+    """One registered tenant connection (owned by its conn thread)."""
+
+    def __init__(self, sock, key, writer, ls, lease_dir, wal_path,
+                 wal_f, offset, seq):
+        self.sock = sock
+        self.key = key                  # (name, ts)
+        self.writer = writer
+        self.lease = ls
+        self.lease_dir = lease_dir
+        self.wal_path = wal_path
+        self.wal = wal_f
+        self.offset = int(offset)       # bytes journaled (== acked)
+        self.seq = int(seq)             # next expected frame seq
+        self.paused = False
+        self.dead = False
+        self.kinds = [0] * 6            # route_ops demux tally
+        self.route_n = seq              # index-synthesis base
+        self.last_renew = time.monotonic()
+        self.last_live_poll = 0.0
+        self.checker_offset = 0
+        self.frames = {"ok": 0, "torn": 0, "dup": 0, "reorder": 0}
+
+    @property
+    def tenant(self) -> str:
+        return f"{self.key[0]}/{self.key[1]}"
+
+
+class IngestServer:
+    """The TCP receiver: accepts framed history streams, fences
+    writers by lease epoch, journals validated frames into per-tenant
+    WALs, and speaks ack/pause/resume back.  Runs happily beside a
+    LiveScheduler (pass it for zero-lag backlog reads) or standalone
+    (backlog falls back to the tenant's published live.json offset)."""
+
+    def __init__(self, root, *, host: str = "127.0.0.1", port: int = 0,
+                 server_id: Optional[str] = None,
+                 lease_ttl: float = 2.0,
+                 tenant_budget_bytes: int = 4 << 20,
+                 scheduler=None, status_every_s: float = 0.5):
+        self.root = Path(root)
+        self.host = host
+        self.port = int(port)
+        self.server_id = server_id or f"i{os.getpid()}"
+        self.lease_ttl = float(lease_ttl or 2.0)
+        self.tenant_budget_bytes = int(tenant_budget_bytes)
+        self.scheduler = scheduler
+        self.status_every_s = status_every_s
+        self.ingest_dir = self.root / INGEST_DIR
+        self.ingest_dir.mkdir(parents=True, exist_ok=True)
+        self.journal = telemetry.EventLog(
+            self.ingest_dir / f"{self.server_id}.jsonl", resume=True)
+        self._lock = threading.Lock()
+        self._sessions: dict = {}       # (name, ts) -> _Session
+        self._known: set = set()        # tenants ever registered
+        self.counts = {"ok": 0, "torn": 0, "dup": 0, "reorder": 0,
+                       "fenced": 0, "registers": 0, "resumes": 0}
+        self._stop = threading.Event()
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "IngestServer":
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(64)
+        s.settimeout(0.2)
+        self._sock = s
+        self.port = s.getsockname()[1]
+        self.write_status()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="ingest-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("ingest tier %s listening on %s:%d", self.server_id,
+                 self.host, self.port)
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            sessions = list(self._sessions.values())
+        for sess in sessions:
+            sess.dead = True
+            try:
+                sess.sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+        self.write_status()
+        self.journal.close()
+
+    # -- journal / metrics ---------------------------------------------------
+
+    def _event(self, type_: str, durable: bool = True, **fields):
+        self.journal.append({"type": type_, "server": self.server_id,
+                             **fields}, durable=durable)
+
+    def _frame_outcome(self, sess: Optional[_Session], outcome: str,
+                       n: int = 1):
+        self.counts[outcome] = self.counts.get(outcome, 0) + n
+        if sess is not None and outcome in sess.frames:
+            sess.frames[outcome] += n
+        telemetry.REGISTRY.counter("jepsen_ingest_frames_total",
+                                   outcome=outcome).inc(n)
+
+    # -- accept loop ---------------------------------------------------------
+
+    def _accept_loop(self):
+        last_status = time.monotonic()
+        while not self._stop.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                pass
+            except OSError:
+                break                   # listening socket closed
+            else:
+                threading.Thread(target=self._serve_conn,
+                                 args=(conn, addr),
+                                 name="ingest-conn", daemon=True
+                                 ).start()
+            now = time.monotonic()
+            if now - last_status >= self.status_every_s:
+                last_status = now
+                self.write_status()
+
+    # -- per-connection protocol ---------------------------------------------
+
+    def _serve_conn(self, conn: socket.socket, addr):
+        conn.settimeout(0.1)
+        buf = b""
+        sess: Optional[_Session] = None
+        try:
+            while not self._stop.is_set():
+                if sess is not None:
+                    self._flow(sess)
+                    if sess.dead:
+                        break
+                try:
+                    chunk = conn.recv(1 << 16)
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                if not chunk:
+                    break
+                buf += chunk
+                lines, buf = split_lines(buf)
+                if sess is None:
+                    if not lines:
+                        if len(buf) > (1 << 16):
+                            break       # pre-hello garbage flood
+                        continue
+                    hello = parse_ctl(lines[0])
+                    if hello is None or hello.get("t") != "hello":
+                        break           # not speaking the protocol
+                    sess = self._register(conn, hello)
+                    if sess is None:
+                        break           # fenced/refused (ctl sent)
+                    lines = lines[1:]
+                self._frames(sess, lines)
+                if sess.dead:
+                    break
+        finally:
+            if sess is not None:
+                self._teardown(sess)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self.write_status()
+
+    def _fence(self, conn, why: str, hello: dict,
+               disk_epoch: Optional[int] = None):
+        self._frame_outcome(None, "fenced")
+        self._event("ingest-fenced", why=why,
+                    tenant=f"{hello.get('name')}/{hello.get('ts')}",
+                    writer=hello.get("writer"),
+                    epoch=hello.get("epoch"), disk_epoch=disk_epoch)
+        try:
+            conn.sendall(ctl_line(t="fenced", why=why,
+                                  epoch=disk_epoch))
+        except OSError:
+            pass
+
+    def _register(self, conn, hello: dict) -> Optional[_Session]:
+        name, ts = hello.get("name"), hello.get("ts")
+        writer = hello.get("writer")
+        epoch = hello.get("epoch") or 0
+        if not (_safe_component(name) and _safe_component(ts)
+                and isinstance(writer, str) and writer) \
+                or name in _RESERVED:
+            self._fence(conn, "bad-tenant", hello)
+            return None
+        key = (name, ts)
+        with self._lock:
+            cur = self._sessions.get(key)
+            if cur is not None and cur.writer != writer:
+                self._fence(conn, "duplicate-writer", hello,
+                            disk_epoch=cur.lease.epoch)
+                return None
+            if cur is not None:
+                # same writer reconnected while its old socket
+                # lingers: the new connection is the writer's latest —
+                # evict the zombie (the takeover below fences its
+                # lease epoch too)
+                cur.dead = True
+                try:
+                    cur.sock.close()
+                except OSError:
+                    pass
+                self._sessions.pop(key, None)
+            d = self.ingest_dir / name / ts
+            d.mkdir(parents=True, exist_ok=True)
+            disk = lease_mod.read(d)
+            if disk is None:
+                ls = lease_mod.try_acquire(d, writer, self.lease_ttl)
+                if ls is None:
+                    self._fence(conn, "lost-acquire-race", hello)
+                    return None
+            else:
+                if not disk.corrupt and not disk.released \
+                        and epoch < disk.epoch:
+                    self._fence(conn, "stale-epoch", hello,
+                                disk_epoch=disk.epoch)
+                    return None
+                ls = lease_mod.takeover(d, writer, self.lease_ttl,
+                                        disk)
+                if ls is None:
+                    self._fence(conn, "takeover-lost", hello)
+                    return None
+            # ground-truth resume cursor: the WAL's intact prefix (a
+            # SIGKILLed predecessor may have left a torn tail — the
+            # ingest tier owns this WAL, so the tear is discarded
+            # before appending resumes)
+            wal_path = self.root / name / ts / "history.wal"
+            offset = seq = 0
+            if wal_path.exists() and wal_path.stat().st_size:
+                seg = history_mod.follow_frames(wal_path)
+                offset, seq = seg.offset, seg.seq
+                if seg.tail_bytes or seg.corrupt:
+                    with open(wal_path, "r+b") as f:
+                        f.truncate(offset)
+                    self._event("ingest-truncate", tenant=f"{name}/{ts}",
+                                offset=offset,
+                                reason=seg.stop_reason
+                                or f"torn tail ({seg.tail_bytes}B)")
+            else:
+                wal_path.parent.mkdir(parents=True, exist_ok=True)
+            ls = lease_mod.renew(d, ls, cursor=(offset, seq)) or ls
+            wal_f = open(wal_path, "ab")
+            sess = _Session(conn, key, writer, ls, d, wal_path, wal_f,
+                            offset, seq)
+            self._sessions[key] = sess
+            resumed = seq > 0
+            self.counts["registers"] += 1
+            if key not in self._known:
+                self._known.add(key)
+                telemetry.REGISTRY.counter(
+                    "jepsen_ingest_tenants_total").inc()
+            if resumed:
+                self.counts["resumes"] += 1
+                telemetry.REGISTRY.counter(
+                    "jepsen_ingest_resumes_total").inc()
+        self._event("ingest-register", tenant=sess.tenant,
+                    writer=writer, epoch=ls.epoch, offset=offset,
+                    seq=seq, resumed=resumed)
+        try:
+            conn.sendall(ctl_line(t="ack", epoch=ls.epoch,
+                                  offset=offset, seq=seq))
+        except OSError:
+            sess.dead = True
+        return sess
+
+    def _frames(self, sess: _Session, lines: list) -> None:
+        wrote = 0
+        ops_batch = []
+        for raw in lines:
+            if raw.lstrip().startswith(b'{"ctl"'):
+                ctl = parse_ctl(raw) or {}
+                if ctl.get("t") == "bye":
+                    self._sync(sess, wrote)
+                    wrote = 0
+                    self._ack(sess)
+                    got = lease_mod.renew(
+                        sess.lease_dir, sess.lease,
+                        cursor=(sess.offset, sess.seq), released=True)
+                    sess.lease = got or sess.lease
+                    self._event("ingest-bye", tenant=sess.tenant,
+                                seq=sess.seq)
+                    sess.dead = True
+                    return
+                continue                # unknown ctl: forward-compat
+            if not raw.strip():
+                continue
+            rec, err = history_mod.parse_frame_line(raw, "op")
+            if err is None and not isinstance(rec.get("i"), int):
+                err = "missing sequence number"
+            if err is not None:
+                # torn on the wire: never journaled; ack what IS
+                # durable, tell the client, and drop the connection —
+                # it resumes from the acked cursor
+                self._sync(sess, wrote)
+                wrote = 0
+                self._frame_outcome(sess, "torn")
+                self._event("ingest-torn", tenant=sess.tenant,
+                            seq=sess.seq, why=err)
+                self._ack(sess)
+                self._send(sess, ctl_line(t="torn", seq=sess.seq))
+                sess.dead = True
+                return
+            i = rec.get("i")
+            if i < sess.seq:
+                # replay of an already-journaled frame (network dup,
+                # or a resend racing an ack): idempotent drop
+                self._frame_outcome(sess, "dup")
+                self._event("ingest-dup", tenant=sess.tenant, got=i,
+                            seq=sess.seq)
+                continue
+            if i > sess.seq:
+                self._sync(sess, wrote)
+                wrote = 0
+                self._frame_outcome(sess, "reorder")
+                self._event("ingest-reorder", tenant=sess.tenant,
+                            got=i, seq=sess.seq)
+                self._ack(sess)
+                sess.dead = True
+                return
+            sess.wal.write(raw)         # the raw validated bytes
+            sess.offset += len(raw)
+            sess.seq += 1
+            wrote += 1
+            w = rec.get("w")
+            if isinstance(w, (int, float)):
+                telemetry.REGISTRY.histogram(
+                    "live_ingest_lag_seconds",
+                    buckets=LAG_BUCKETS_S).observe(
+                        # lint: wall-ok(advisory lag metric; protocol decisions ride seq/crc, never w)
+                        max(time.time() - w, 0.0))
+            ops_batch.append(rec["op"])
+        if wrote:
+            self._sync(sess, wrote)
+            self._ack(sess)
+            self._route(sess, ops_batch)
+
+    def _sync(self, sess: _Session, wrote: int) -> None:
+        """Make journaled frames durable BEFORE they are acked: the
+        acked cursor must survive SIGKILL of this server."""
+        if not wrote:
+            return
+        try:
+            sess.wal.flush()
+            os.fsync(sess.wal.fileno())
+        except OSError:
+            sess.dead = True
+            return
+        self._frame_outcome(sess, "ok", wrote)
+
+    def _send(self, sess: _Session, line: bytes) -> None:
+        try:
+            sess.sock.sendall(line)
+        except OSError:
+            sess.dead = True
+
+    def _ack(self, sess: _Session) -> None:
+        self._send(sess, ctl_line(t="ack", epoch=sess.lease.epoch,
+                                  offset=sess.offset, seq=sess.seq))
+
+    # -- demux (native route pass) -------------------------------------------
+
+    def _route(self, sess: _Session, op_dicts: list) -> None:
+        """Classify the batch with the same native route pass the
+        scheduler's Tenant.ingest uses (packext.route_ops, ISSUE 9) —
+        per-kind tallies for the /ingest page; the Python twin when
+        the extension is unavailable."""
+        try:
+            ops = [history_mod.Op.from_dict(d) for d in op_dicts]
+        except Exception:  # noqa: BLE001 - stats must never kill ingest
+            return
+        kinds = self._route_native(ops, sess.route_n)
+        if kinds is None:
+            kinds = []
+            for op in ops:
+                if type(op.process) is not int or op.process < 0:
+                    kinds.append(5)
+                elif op.type == "invoke":
+                    kinds.append(0)
+                elif op.type in ("ok", "fail", "info"):
+                    kinds.append(1 + ("ok", "fail",
+                                      "info").index(op.type))
+                else:
+                    kinds.append(4)
+        for k in kinds:
+            sess.kinds[min(int(k), 5)] += 1
+        sess.route_n += len(ops)
+
+    @staticmethod
+    def _route_native(ops: list, base_n: int):
+        from jepsen_tpu import native
+        from jepsen_tpu.ops import planner
+        if planner.pack_threads_effective() <= 0:
+            return None
+        mod = native.packext()
+        if mod is None or not hasattr(mod, "route_ops"):
+            return None
+        try:
+            return mod.route_ops(ops, base_n)[0]
+        except Exception:  # noqa: BLE001 - degrade to the loop
+            return None
+
+    # -- flow control / lease heartbeat --------------------------------------
+
+    def _checker_offset(self, sess: _Session) -> int:
+        """Bytes of this tenant's WAL the checker has consumed — from
+        the co-resident scheduler when we have one, else the tenant's
+        published live.json (polled, rate-limited)."""
+        if self.scheduler is not None:
+            t = self.scheduler.tenants.get(sess.key)
+            if t is not None:
+                return int(getattr(t, "offset", 0))
+        now = time.monotonic()
+        if now - sess.last_live_poll >= 0.2:
+            sess.last_live_poll = now
+            try:
+                with open(self.root / sess.key[0] / sess.key[1]
+                          / "live.json") as f:
+                    sess.checker_offset = int(
+                        json.load(f).get("offset") or 0)
+            except (OSError, ValueError):
+                pass
+        return sess.checker_offset
+
+    def _flow(self, sess: _Session) -> None:
+        now = time.monotonic()
+        if now - sess.last_renew >= self.lease_ttl / 3:
+            sess.last_renew = now
+            got = lease_mod.renew(sess.lease_dir, sess.lease,
+                                  cursor=(sess.offset, sess.seq))
+            if got is None:
+                # a newer epoch owns this tenant: WE are the zombie
+                self._frame_outcome(sess, "fenced")
+                self._event("ingest-fenced", why="lease-lost",
+                            tenant=sess.tenant, writer=sess.writer,
+                            epoch=sess.lease.epoch)
+                self._send(sess, ctl_line(t="fenced",
+                                          why="lease-lost"))
+                sess.dead = True
+                return
+            sess.lease = got
+        backlog = max(sess.offset - self._checker_offset(sess), 0)
+        telemetry.REGISTRY.gauge("live_ingest_backlog_bytes",
+                                 tenant=sess.tenant).set(backlog)
+        if not sess.paused and backlog > self.tenant_budget_bytes:
+            sess.paused = True
+            self._event("ingest-pause", tenant=sess.tenant,
+                        backlog=backlog)
+            self._send(sess, ctl_line(t="pause", backlog=backlog))
+        elif sess.paused and backlog < self.tenant_budget_bytes // 2:
+            sess.paused = False
+            self._event("ingest-unpause", tenant=sess.tenant,
+                        backlog=backlog)
+            self._send(sess, ctl_line(t="resume", backlog=backlog))
+
+    # -- teardown / status ---------------------------------------------------
+
+    def _teardown(self, sess: _Session) -> None:
+        try:
+            sess.wal.flush()
+            os.fsync(sess.wal.fileno())
+        except OSError:
+            pass
+        try:
+            sess.wal.close()
+        except OSError:
+            pass
+        with self._lock:
+            if self._sessions.get(sess.key) is sess:
+                del self._sessions[sess.key]
+        self._event("ingest-disconnect", tenant=sess.tenant,
+                    seq=sess.seq, durable=False)
+
+    def write_status(self) -> None:
+        """Atomic operator sidecar store/ingest/<server>.json — the
+        /ingest page's data source, and how tests/campaigns learn the
+        bound port when started with --listen HOST:0."""
+        with self._lock:
+            tenants = {
+                s.tenant: {"writer": s.writer,
+                           "epoch": s.lease.epoch,
+                           "offset": s.offset, "seq": s.seq,
+                           "paused": s.paused,
+                           "backlog": max(s.offset
+                                          - s.checker_offset, 0),
+                           "frames": dict(s.frames),
+                           "kinds": dict(zip(_KIND_NAMES, s.kinds))}
+                for s in self._sessions.values()}
+            doc = {"server": self.server_id, "pid": os.getpid(),
+                   "host": self.host, "port": self.port,
+                   # lint: wall-ok(operator-facing staleness stamp)
+                   "updated": time.time(),
+                   "counts": dict(self.counts),
+                   "known_tenants": len(self._known),
+                   "tenants": tenants}
+        tmp = self.ingest_dir / f".{self.server_id}.json.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.ingest_dir / f"{self.server_id}.json")
+        except OSError:
+            log.debug("ingest status write failed", exc_info=True)
+
+
+def ci_summary() -> Optional[dict]:
+    """The tier-1 CI row (conftest): what the ingest tier did this
+    session, from the metrics registry — None when it never ran."""
+    try:
+        kinds = telemetry.REGISTRY.collect()
+
+        def total(name):
+            got = kinds.get(name)
+            if not got:
+                return None
+            return int(sum(m.value for m in got[1].values()))
+
+        frames = kinds.get("jepsen_ingest_frames_total")
+        if frames is None:
+            return None
+        by_outcome = {}
+        for labels, m in frames[1].items():
+            d = dict(labels)
+            by_outcome[d.get("outcome", "?")] = \
+                by_outcome.get(d.get("outcome", "?"), 0) + int(m.value)
+        return {"tenants": total("jepsen_ingest_tenants_total") or 0,
+                "frames": by_outcome,
+                "fenced": by_outcome.get("fenced", 0),
+                "resumes": total("jepsen_ingest_resumes_total") or 0}
+    except Exception:  # noqa: BLE001 - CI row must never fail the run
+        return None

@@ -78,7 +78,7 @@ LAG_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # exclusion class store.tests() applies (campaigns/ci from PR 11,
 # fleet/ worker status + lease bookkeeping from ISSUE 14).
 NON_RUN_DIRS = ("ci", "current", "latest", "campaigns", "plan-cache",
-                "fleet")
+                "fleet", "ingest")
 
 
 def _default_model(name: Optional[str]):

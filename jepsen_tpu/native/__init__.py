@@ -109,6 +109,49 @@ def wgloracle():
     return _load("_wgloracle", "wgloracle.c")
 
 
+def _build_bin(name: str, source: str,
+               flags: tuple = ()) -> Optional[str]:
+    """Standalone executable variant of `_build` — same md5-staleness
+    stamp discipline, no -shared/-fPIC, no Python headers.  For
+    helpers that must run where Python doesn't (walsend on
+    static-binary SUT hosts)."""
+    out = os.path.join(_BUILD, name)
+    src = os.path.join(_DIR, source)
+    stamp = out + ".md5"
+    try:
+        digest = _src_digest([src]) \
+            + ("+" + " ".join(flags) if flags else "")
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return out
+        os.makedirs(_BUILD, exist_ok=True)
+        cc = os.environ.get("CC", "cc")
+        cmd = [cc, "-O2", *flags, src, "-o", out]
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        if r.returncode != 0:
+            return None
+        with open(stamp, "w") as f:
+            f.write(digest)
+        return out
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def walsend() -> Optional[str]:
+    """Path to the standalone `walsend` WAL-streaming binary (ingest
+    wire client for hosts without Python, ISSUE 16), or None when no
+    compiler is available.  Strict build, like packext: -Wall -Werror."""
+    if os.environ.get("JEPSEN_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        key = "bin:walsend"
+        if key not in _cache:
+            _cache[key] = _build_bin("walsend", "walsend.c",
+                                     flags=("-Wall", "-Werror"))
+        return _cache[key]
+
+
 def packext():
     """The _packext parallel-ingest extension, or None (Python
     fallback).  Strict build: -Wall -Werror (plus -pthread for the

@@ -1,0 +1,238 @@
+/* walsend: stream an existing history.wal to a serve-checker
+ * --listen daemon (ISSUE 16) from hosts that have a C compiler and
+ * nothing else — the static-binary SUT story.
+ *
+ *   walsend HOST PORT NAME TS WAL_PATH [WRITER]
+ *
+ * Wire protocol (docs/remote-ingest.md): newline-framed JSON.  Data
+ * lines are shipped VERBATIM from the WAL file — the framing (crc +
+ * seq) was written by history.HistoryWAL and the server re-validates
+ * it, so this sender never parses op payloads at all.  Control lines:
+ * we send {"ctl":{"t":"hello",...}} and {"ctl":{"t":"bye"}}, and
+ * honor ack (resume cursor: skip the first `seq` lines), pause/resume
+ * (flow control), and fenced (terminal).
+ *
+ * Exit codes: 0 streamed + fully acked; 2 fenced (a newer writer owns
+ * the tenant); 1 anything else.  Rerunning after a partial send is
+ * safe and cheap: the registration ack carries the server's durable
+ * cursor and the sender skips exactly that many lines.
+ */
+
+#include <errno.h>
+#include <netdb.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#define CTLBUF 65536
+
+static int sock_fd = -1;
+
+/* -- tiny field scanners (good enough for our own compact ctl json) -- */
+
+static long json_long(const char *line, const char *key, long dflt)
+{
+    const char *p = strstr(line, key);
+    if (!p)
+        return dflt;
+    p += strlen(key);
+    return strtol(p, NULL, 10);
+}
+
+static int json_is(const char *line, const char *needle)
+{
+    return strstr(line, needle) != NULL;
+}
+
+/* -- socket helpers -------------------------------------------------- */
+
+static int dial(const char *host, const char *port)
+{
+    struct addrinfo hints, *res, *rp;
+    int fd = -1;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, port, &hints, &res) != 0)
+        return -1;
+    for (rp = res; rp; rp = rp->ai_next) {
+        fd = socket(rp->ai_family, rp->ai_socktype, rp->ai_protocol);
+        if (fd < 0)
+            continue;
+        if (connect(fd, rp->ai_addr, rp->ai_addrlen) == 0)
+            break;
+        close(fd);
+        fd = -1;
+    }
+    freeaddrinfo(res);
+    return fd;
+}
+
+static int send_all(const char *buf, size_t n)
+{
+    while (n > 0) {
+        ssize_t w = send(sock_fd, buf, n, 0);
+        if (w < 0) {
+            if (errno == EINTR)
+                continue;
+            return -1;
+        }
+        buf += w;
+        n -= (size_t)w;
+    }
+    return 0;
+}
+
+/* Shared ctl-line state: recv bytes accumulate here and are handed
+ * out one line at a time.  paused/fenced/acked are updated as lines
+ * arrive. */
+static char ctl[CTLBUF];
+static size_t ctl_n = 0;
+static int paused = 0, fenced = 0;
+static long acked_seq = 0;
+
+static void ctl_handle(const char *line)
+{
+    if (json_is(line, "\"t\":\"fenced\"")) {
+        fenced = 1;
+    } else if (json_is(line, "\"t\":\"pause\"")) {
+        paused = 1;
+    } else if (json_is(line, "\"t\":\"resume\"")) {
+        paused = 0;
+    } else if (json_is(line, "\"t\":\"ack\"")) {
+        long s = json_long(line, "\"seq\":", -1);
+        if (s > acked_seq)
+            acked_seq = s;
+    }
+}
+
+/* Pump inbound ctl frames; waits up to wait_ms for the first byte.
+ * Returns -1 on socket death. */
+static int ctl_pump(int wait_ms)
+{
+    struct timeval tv;
+    fd_set rd;
+    tv.tv_sec = wait_ms / 1000;
+    tv.tv_usec = (wait_ms % 1000) * 1000;
+    FD_ZERO(&rd);
+    FD_SET(sock_fd, &rd);
+    if (select(sock_fd + 1, &rd, NULL, NULL, &tv) <= 0)
+        return 0;
+    ssize_t r = recv(sock_fd, ctl + ctl_n, sizeof(ctl) - ctl_n - 1, 0);
+    if (r <= 0)
+        return -1;
+    ctl_n += (size_t)r;
+    ctl[ctl_n] = '\0';
+    char *start = ctl, *nl;
+    while ((nl = memchr(start, '\n', ctl_n - (size_t)(start - ctl)))) {
+        *nl = '\0';
+        ctl_handle(start);
+        start = nl + 1;
+    }
+    ctl_n -= (size_t)(start - ctl);
+    memmove(ctl, start, ctl_n);
+    return 0;
+}
+
+int main(int argc, char **argv)
+{
+    if (argc < 6) {
+        fprintf(stderr, "usage: walsend HOST PORT NAME TS WAL_PATH "
+                        "[WRITER]\n");
+        return 1;
+    }
+    const char *host = argv[1], *port = argv[2];
+    const char *name = argv[3], *ts = argv[4], *path = argv[5];
+    const char *writer = argc > 6 ? argv[6] : "walsend";
+
+    FILE *wal = fopen(path, "rb");
+    if (!wal) {
+        perror(path);
+        return 1;
+    }
+    sock_fd = dial(host, port);
+    if (sock_fd < 0) {
+        fprintf(stderr, "walsend: cannot reach %s:%s\n", host, port);
+        fclose(wal);
+        return 1;
+    }
+
+    char hello[1024];
+    int n = snprintf(hello, sizeof(hello),
+                     "{\"ctl\":{\"epoch\":0,\"name\":\"%s\","
+                     "\"t\":\"hello\",\"ts\":\"%s\","
+                     "\"writer\":\"%s\"}}\n",
+                     name, ts, writer);
+    if (n <= 0 || n >= (int)sizeof(hello) || send_all(hello, (size_t)n))
+        goto dead;
+
+    /* registration ack: the server's durable cursor */
+    acked_seq = -1;
+    for (int spins = 0; acked_seq < 0 && !fenced && spins < 100;
+         spins++)
+        if (ctl_pump(100) < 0)
+            goto dead;
+    if (fenced)
+        goto fenced_out;
+    if (acked_seq < 0)
+        goto dead;
+
+    /* stream: skip the acked prefix, ship the rest verbatim */
+    char *line = NULL;
+    size_t cap = 0;
+    ssize_t len;
+    long lineno = 0, sent = 0;
+    while ((len = getline(&line, &cap, wal)) > 0) {
+        if (lineno++ < acked_seq)
+            continue;
+        while (paused && !fenced)
+            if (ctl_pump(50) < 0)
+                goto dead_line;
+        if (fenced)
+            break;
+        if (send_all(line, (size_t)len))
+            goto dead_line;
+        sent++;
+        if ((sent & 63) == 0 && ctl_pump(0) < 0)
+            goto dead_line;
+    }
+    free(line);
+    line = NULL;
+    if (fenced)
+        goto fenced_out;
+
+    /* wait until everything we shipped is acked, then say bye */
+    long total = lineno;
+    for (int spins = 0; acked_seq < total && !fenced && spins < 600;
+         spins++)
+        if (ctl_pump(100) < 0)
+            goto dead;
+    if (fenced)
+        goto fenced_out;
+    if (acked_seq < total)
+        goto dead;
+    if (send_all("{\"ctl\":{\"t\":\"bye\"}}\n", 20))
+        goto dead;
+    close(sock_fd);
+    fclose(wal);
+    return 0;
+
+dead_line:
+    free(line);
+dead:
+    fprintf(stderr, "walsend: connection lost (acked %ld)\n",
+            acked_seq);
+    close(sock_fd);
+    fclose(wal);
+    return 1;
+
+fenced_out:
+    fprintf(stderr, "walsend: fenced — a newer writer owns %s/%s\n",
+            name, ts);
+    close(sock_fd);
+    fclose(wal);
+    return 2;
+}

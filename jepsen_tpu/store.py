@@ -172,7 +172,8 @@ def tests(name: Optional[str] = None) -> dict:
     names = [name] if name else [p.name for p in BASE.iterdir()
                                  if p.is_dir() and p.name not in
                                  ("latest", "current", "campaigns",
-                                  "ci", "plan-cache", "fleet")]
+                                  "ci", "plan-cache", "fleet",
+                                  "ingest")]
     for n in names:
         d = BASE / _sanitize(n)
         if not d.is_dir():
@@ -277,6 +278,21 @@ def campaigns_root() -> Path:
 
 def fleet_root() -> Path:
     return BASE / "fleet"
+
+
+# ---------------------------------------------------------------------------
+# Ingest-tier bookkeeping (live/ingest.py, ISSUE 16)
+# ---------------------------------------------------------------------------
+#
+# Layout: store/ingest/<server-id>.json (atomic status sidecar, carries
+# the bound port) + store/ingest/<server-id>.jsonl (the server's event
+# journal: fenced registrations, torn/dup/reordered frames, pause/
+# resume) + store/ingest/<name>/<ts>/lease.json (WRITER registration
+# leases — distinct from the checker's run-dir lease).  Excluded from
+# tests() and run discovery like fleet/ and campaigns/.
+
+def ingest_root() -> Path:
+    return BASE / "ingest"
 
 
 def campaign_dir(name: str) -> Path:

@@ -114,6 +114,7 @@ def home_html() -> bytes:
     body = ("<h1>Jepsen</h1><p><a href='/telemetry'>telemetry</a> &middot; "
             "<a href='/live'>live</a> &middot; "
             "<a href='/fleet'>fleet</a> &middot; "
+            "<a href='/ingest'>ingest</a> &middot; "
             "<a href='/campaign'>campaigns</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
@@ -574,6 +575,144 @@ def fleet_html() -> bytes:
 
 
 # ---------------------------------------------------------------------------
+# Ingest page (ISSUE 16): /ingest — the remote-tenant network tier:
+# listeners (from store/ingest/<server>.json status sidecars),
+# connected tenants with writer/epoch/cursor/backlog/backpressure
+# state, and the fenced-rejection + frame-fault timeline from the
+# servers' journals (store/ingest/<server>.jsonl)
+# ---------------------------------------------------------------------------
+
+def _ingest_servers() -> list:
+    out = []
+    root = store.ingest_root()
+    if not root.is_dir():
+        return out
+    for p in sorted(root.glob("*.json")):
+        try:
+            with open(p) as f:
+                out.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    return out
+
+
+def _ingest_events(limit: int = 50) -> list:
+    """The network-fault timeline: fenced registrations and torn/dup/
+    reordered frames from every server's journal, newest first."""
+    from jepsen_tpu import telemetry
+    evs = []
+    root = store.ingest_root()
+    if root.is_dir():
+        for p in sorted(root.glob("*.jsonl")):
+            for e in telemetry.read_events(p):
+                if str(e.get("type", "")).startswith("ingest-"):
+                    evs.append(e)
+    evs.sort(key=lambda e: e.get("t") or 0.0, reverse=True)
+    return evs[:limit]
+
+
+def ingest_html() -> bytes:
+    import time as time_mod
+    now = time_mod.time()
+    body = ["<h1>Remote ingest</h1>",
+            "<p><a href='/'>&larr; tests</a> &middot; "
+            "<a href='/fleet'>fleet</a> &middot; "
+            "<a href='/live'>live</a> &middot; "
+            "<a href='/metrics'>metrics</a></p>"]
+
+    servers = _ingest_servers()
+    if servers:
+        body.append("<h2>Listeners</h2>"
+                    "<table><tr><th>Server</th><th>Listen</th>"
+                    "<th>Tenants</th><th>Frames ok</th>"
+                    "<th>Torn</th><th>Dup</th><th>Reorder</th>"
+                    "<th>Fenced</th><th>Resumes</th>"
+                    "<th>Last beat</th></tr>")
+        for s in servers:
+            age = now - (s.get("updated") or 0)
+            stale = age > 10.0
+            c = s.get("counts") or {}
+            body.append(
+                f"<tr{' style=background:#F3EABB' if stale else ''}>"
+                f"<td>{html.escape(str(s.get('server')))}</td>"
+                f"<td>{html.escape(str(s.get('host')))}:"
+                f"{s.get('port')}</td>"
+                f"<td>{len(s.get('tenants') or {})}"
+                f"/{s.get('known_tenants')}</td>"
+                f"<td>{c.get('ok')}</td><td>{c.get('torn')}</td>"
+                f"<td>{c.get('dup')}</td><td>{c.get('reorder')}</td>"
+                f"<td>{c.get('fenced')}</td>"
+                f"<td>{c.get('resumes')}</td>"
+                f"<td>{age:.1f}s ago"
+                f"{' (stale)' if stale else ''}</td></tr>")
+        body.append("</table>")
+    else:
+        body.append("<p>(no listener status files under store/ingest/ "
+                    "— start one with <code>serve-checker store/ "
+                    "--listen 127.0.0.1:7419</code>)</p>")
+
+    tenant_rows = []
+    for s in servers:
+        for tenant, t in sorted((s.get("tenants") or {}).items()):
+            f = t.get("frames") or {}
+            paused = t.get("paused")
+            tenant_rows.append(
+                f"<tr{' style=background:#F3EABB' if paused else ''}>"
+                f"<td>{html.escape(tenant)}</td>"
+                f"<td>{html.escape(str(s.get('server')))}</td>"
+                f"<td>{html.escape(str(t.get('writer')))}</td>"
+                f"<td>{t.get('epoch')}</td>"
+                f"<td>{t.get('offset')}/{t.get('seq')}</td>"
+                f"<td>{t.get('backlog')}</td>"
+                f"<td>{'<b>paused</b>' if paused else 'flowing'}</td>"
+                f"<td>{f.get('torn', 0)}/{f.get('dup', 0)}"
+                f"/{f.get('reorder', 0)}</td></tr>")
+    if tenant_rows:
+        body.append("<h2>Connected tenants</h2>"
+                    "<table><tr><th>Tenant</th><th>Server</th>"
+                    "<th>Writer</th><th>Epoch</th>"
+                    "<th>Cursor (off/seq)</th>"
+                    "<th>Backlog (bytes)</th><th>Flow</th>"
+                    "<th>Torn/dup/reorder</th></tr>"
+                    + "".join(tenant_rows) + "</table>")
+
+    evs = _ingest_events()
+    if evs:
+        body.append("<h2>Fencing / frame-fault timeline</h2>"
+                    "<table><tr><th>When</th><th>Event</th>"
+                    "<th>Tenant</th><th>Server</th><th>Seq</th>"
+                    "<th>Detail</th></tr>")
+        for e in evs:
+            t = e.get("t")
+            detail = []
+            if e.get("why"):
+                detail.append(str(e["why"]))
+            if e.get("writer"):
+                detail.append(f"writer {e['writer']}")
+            if e.get("epoch") is not None:
+                detail.append(f"epoch {e['epoch']}")
+            if e.get("resumed"):
+                detail.append("resumed")
+            color = {"ingest-fenced": "#F3BBBC",
+                     "ingest-torn": "#F3EABB",
+                     "ingest-dup": "#F3EABB",
+                     "ingest-reorder": "#F3EABB",
+                     "ingest-pause": "#D8E8F8"}.get(e.get("type"), "")
+            body.append(
+                f"<tr{f' style=background:{color}' if color else ''}>"
+                f"<td>{now - t:.1f}s ago</td>" if t else
+                "<tr><td>?</td>")
+            body.append(
+                f"<td>{html.escape(str(e.get('type')))}</td>"
+                f"<td>{html.escape(str(e.get('tenant', '-')))}</td>"
+                f"<td>{html.escape(str(e.get('server', '-')))}</td>"
+                f"<td>{e.get('seq', '')}</td>"
+                f"<td>{html.escape('; '.join(detail))}</td></tr>")
+        body.append("</table>")
+    return _page("Remote ingest", "".join(body))
+
+
+# ---------------------------------------------------------------------------
 # Campaign pages (ISSUE 13): /campaign index + per-campaign coverage
 # matrix (nemesis x workload x anomaly class, gaps visible) — rendered
 # from store/campaigns/<name>/{status,coverage}.json
@@ -813,6 +952,8 @@ class Handler(BaseHTTPRequestHandler):
                                   "charset=utf-8")
             if path == "/fleet" or path == "/fleet/":
                 return self._send(200, fleet_html())
+            if path == "/ingest" or path == "/ingest/":
+                return self._send(200, ingest_html())
             if path == "/live" or path == "/live/":
                 return self._send(200, live_index_html())
             if path.startswith("/live/"):

@@ -169,6 +169,23 @@ def _lint_summary():
         return None
 
 
+def _ingest_summary():
+    """The network ingest tier's counters (ISSUE 16): tenants
+    registered, frames by outcome (ok/torn/dup/reorder), fenced
+    writers and cursor resumes — recorded so a regression that
+    silently stops exercising the wire path (frames all "ok" because
+    the fault batteries vanished, or fenced drops to 0 while the
+    duplicate-writer test passes vacuously) diffs across PRs instead
+    of hiding in a green suite.  Counts cover THIS process only; the
+    kill9 serve-checker subprocesses keep their own registries.  None
+    when no ingest server ran this session."""
+    try:
+        from jepsen_tpu.live import ingest
+        return ingest.ci_summary()
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _campaign_summary():
     """The tier-1 smoke campaign's counters (ISSUE 13):
     run/novel/deduped/quarantined schedule counts from the registry —
@@ -228,6 +245,7 @@ def pytest_sessionfinish(session, exitstatus):
             "pack_backend": _pack_backend(),
             "campaign": _campaign_summary(),
             "fleet": _fleet_summary(),
+            "ingest": _ingest_summary(),
             "lint": _lint_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],

@@ -1,0 +1,819 @@
+"""Remote-tenant ingest tier tests (ISSUE 16): the crc+seq wire
+framing (frame_line/parse_frame_line as the single codec), the
+epoch-fenced TCP ingest server (torn/dup/reordered frames journaled
+and kept out of the WAL, duplicate/zombie writers rejected,
+byte-budget backpressure as wire pause/resume), the resuming client +
+StreamingWAL (`live-stream` test-map key), the walsend C sender, the
+/ingest web surface, the RemoteTarget campaign fault space, and the
+kill9 batteries — SIGKILL the receiver mid-frame, a fleet survivor
+takes the tenant over with exactly-once flags, plus the full
+acceptance scenario: a real core.run streaming over TCP to a
+`serve-checker --listen` daemon in another process."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import campaign, store, telemetry, web
+from jepsen_tpu.history import (HistoryWAL, follow_frames, frame_line,
+                                invoke_op, ok_op, parse_frame_line)
+from jepsen_tpu.live import ingest as ingest_mod
+from jepsen_tpu.live import lease as lease_mod
+from jepsen_tpu.live.client import IngestClient, StreamingWAL
+from jepsen_tpu.live.ingest import (IngestServer, ctl_line, parse_ctl,
+                                    split_lines)
+from jepsen_tpu.live.scheduler import NON_RUN_DIRS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.03)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def op_lines(n, start_seq=0, vmax=5, wall=True):
+    """n invoke/ok write pairs, pre-framed exactly as HistoryWAL
+    journals them (the wire IS the WAL)."""
+    lines, seq = [], start_seq
+    for k in range(n):
+        for op in (invoke_op(0, "write", k % vmax, index=seq),
+                   ok_op(0, "write", k % vmax, index=seq + 1)):
+            lines.append(frame_line(op.to_dict(), seq,
+                                    wall=time.time() if wall else None))
+            seq += 1
+    return lines
+
+
+class Wire:
+    """A raw protocol endpoint: exact bytes out, parsed ctl frames
+    in — the fault-injection surface the client class won't expose."""
+
+    def __init__(self, port, host="127.0.0.1"):
+        self.sock = socket.create_connection((host, port), timeout=5)
+        self.buf = b""
+
+    def hello(self, name, ts, writer, epoch=0):
+        self.sock.sendall(ctl_line(t="hello", name=name, ts=ts,
+                                   writer=writer, epoch=epoch))
+        return self.ctl(timeout=5.0)
+
+    def send(self, data):
+        self.sock.sendall(data)
+
+    def ctl(self, timeout=5.0):
+        """Next ctl frame (None on close/timeout)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            lines, self.buf = split_lines(self.buf)
+            for ln in lines:
+                c = parse_ctl(ln)
+                if c is not None:
+                    return c
+            self.sock.settimeout(max(deadline - time.monotonic(),
+                                     0.01))
+            try:
+                chunk = self.sock.recv(1 << 14)
+            except socket.timeout:
+                continue
+            except OSError:
+                return None
+            if not chunk:
+                return None
+            self.buf += chunk
+        return None
+
+    def ctl_until(self, t, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            c = self.ctl(timeout=deadline - time.monotonic())
+            if c is None:
+                return None
+            if c.get("t") == t:
+                return c
+        return None
+
+    def closed(self, timeout=5.0):
+        """True once the server closes the connection."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.sock.settimeout(0.1)
+            try:
+                if not self.sock.recv(1 << 14):
+                    return True
+            except socket.timeout:
+                continue
+            except OSError:
+                return True
+        return False
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = IngestServer(tmp_path / "root", server_id="i-test",
+                       lease_ttl=1.0).start()
+    yield srv
+    srv.close()
+
+
+def journal_types(srv):
+    p = srv.ingest_dir / f"{srv.server_id}.jsonl"
+    if not p.exists():
+        return []
+    return [e.get("type") for e in telemetry.read_events(p)]
+
+
+def journal_events(srv):
+    p = srv.ingest_dir / f"{srv.server_id}.jsonl"
+    if not p.exists():
+        return []
+    return list(telemetry.read_events(p))
+
+
+# ---------------------------------------------------------------------------
+# the wire codec: frame_line / parse_frame_line / ctl frames
+# ---------------------------------------------------------------------------
+
+class TestFrameCodec:
+    def test_roundtrip_is_wal_compatible(self, tmp_path):
+        """frame_line emits EXACTLY what HistoryWAL journals — stream
+        those bytes into a file and follow_frames reads them back
+        clean (the wire format and the disk format are one codec)."""
+        lines = op_lines(5)
+        p = tmp_path / "history.wal"
+        p.write_bytes(b"".join(lines))
+        seg = follow_frames(p)
+        assert len(seg.records) == 10 and not seg.corrupt
+        wal = HistoryWAL(tmp_path / "ref.wal", fsync=False)
+        for k in range(5):
+            wal.append(invoke_op(0, "write", k % 5, index=2 * k))
+            wal.append(ok_op(0, "write", k % 5, index=2 * k + 1))
+        wal.close()
+        ref = follow_frames(tmp_path / "ref.wal")
+        assert [r["op"] for r in ref.records] \
+            == [r["op"] for r in seg.records]
+
+    def test_parse_frame_line_error_taxonomy(self):
+        good = frame_line({"x": 1}, 3)
+        rec, err = parse_frame_line(good, key="x")
+        assert err == "not a 'x' frame" or rec is None or err
+        rec, err = parse_frame_line(good, key="op", seq=3)
+        assert err is None and rec["i"] == 3
+        _, err = parse_frame_line(good, key="op", seq=7)
+        assert err == "sequence break (expected 7, got 3)"
+        _, err = parse_frame_line(good[:-5] + b'"}\n', key="op")
+        assert err == "unparseable complete record"
+        bad_crc = good.replace(b'"crc":"', b'"crc":"f', 1)
+        _, err = parse_frame_line(bad_crc, key="op", seq=3)
+        assert err == "crc mismatch"
+
+    def test_no_wall_matches_ledger_framing(self):
+        assert b'"w":' not in frame_line({"a": 1}, 0)
+        assert b'"w":' in frame_line({"a": 1}, 0, wall=1.5)
+
+    def test_ctl_roundtrip_and_split(self):
+        line = ctl_line(t="ack", epoch=2, offset=10, seq=4)
+        assert line.endswith(b"\n") and line.startswith(b'{"ctl"')
+        c = parse_ctl(line)
+        assert c == {"t": "ack", "epoch": 2, "offset": 10, "seq": 4}
+        assert parse_ctl(op_lines(1)[0]) is None   # data, not ctl
+        lines, rest = split_lines(line + b'{"ctl"')
+        assert lines == [line] and rest == b'{"ctl"'
+
+
+# ---------------------------------------------------------------------------
+# the server: fencing, fault classification, WAL byte-identity
+# ---------------------------------------------------------------------------
+
+class TestIngestServer:
+    def test_clean_stream_is_byte_identical(self, tmp_path, server):
+        lines = op_lines(10)
+        w = Wire(server.port)
+        ack = w.hello("r0", "t1", "wA")
+        assert ack["t"] == "ack" and ack["epoch"] == 1 \
+            and ack["seq"] == 0
+        w.send(b"".join(lines))
+        got = w.ctl_until("ack")
+        wait_for(lambda: server.counts["ok"] >= len(lines), 10,
+                 "all frames journaled")
+        w.send(ctl_line(t="bye"))
+        assert got is not None
+        wal = server.root / "r0" / "t1" / "history.wal"
+        wait_for(lambda: wal.read_bytes() == b"".join(lines), 10,
+                 "byte-identical WAL")
+        w.close()
+        # the writer lease is real and carries the cursor
+        ls = wait_for(
+            lambda: lease_mod.read(server.ingest_dir / "r0" / "t1"),
+            5, "the writer lease")
+        assert ls.epoch == 1
+
+    def test_torn_frame_journaled_then_resume(self, tmp_path, server):
+        lines = op_lines(6)
+        w = Wire(server.port)
+        w.hello("r0", "t1", "wA")
+        w.send(b"".join(lines[:3]))
+        wait_for(lambda: server.counts["ok"] >= 3, 10,
+                 "the clean prefix")
+        # a complete line whose crc lies: torn, counted, never journaled
+        w.send(lines[3].replace(b'"crc":"', b'"crc":"f', 1))
+        torn = w.ctl_until("torn")
+        assert torn is not None and torn["seq"] == 3
+        assert w.closed(), "a torn frame must close the connection"
+        # resume from the acked cursor with a bumped epoch
+        w2 = Wire(server.port)
+        ack = w2.hello("r0", "t1", "wA", epoch=1)
+        assert ack["t"] == "ack" and ack["epoch"] == 2 \
+            and ack["seq"] == 3
+        w2.send(b"".join(lines[3:]))
+        wal = server.root / "r0" / "t1" / "history.wal"
+        wait_for(lambda: wal.read_bytes() == b"".join(lines), 10,
+                 "byte-identical WAL after resume")
+        w2.close()
+        types = journal_types(server)
+        assert "ingest-torn" in types
+        assert server.counts["torn"] == 1 \
+            and server.counts["resumes"] == 1
+
+    def test_dup_dropped_reorder_closes(self, tmp_path, server):
+        lines = op_lines(3)             # 6 frames
+        w = Wire(server.port)
+        w.hello("r0", "t1", "wA")
+        w.send(b"".join(lines[:2]))
+        wait_for(lambda: server.counts["ok"] >= 2, 10, "the prefix")
+        w.send(lines[0])                # stale seq: dup, dropped
+        w.send(lines[2])                # still in-order afterwards
+        wait_for(lambda: server.counts["dup"] == 1
+                 and server.counts["ok"] >= 3, 10, "the dup count")
+        w.send(lines[4])                # skips seq 3: reorder
+        assert w.closed(), "a reordered frame must close the conn"
+        wal = server.root / "r0" / "t1" / "history.wal"
+        # exactly the in-order prefix landed — the dup and the
+        # reordered frame never reached the WAL
+        assert wal.read_bytes() == b"".join(lines[:3])
+        assert server.counts["reorder"] == 1
+        types = journal_types(server)
+        assert "ingest-dup" in types and "ingest-reorder" in types
+        w.close()
+
+    def test_duplicate_and_stale_writers_fenced(self, tmp_path,
+                                                server):
+        w = Wire(server.port)
+        ack = w.hello("r0", "t1", "wA")
+        assert ack["t"] == "ack"
+        # live session, different writer: fenced, the session stays
+        w2 = Wire(server.port)
+        f = w2.hello("r0", "t1", "wB")
+        assert f["t"] == "fenced" and f["why"] == "duplicate-writer"
+        w2.close()
+        lines = op_lines(2)
+        w.send(b"".join(lines))
+        wait_for(lambda: server.counts["ok"] >= len(lines), 10,
+                 "the live session kept streaming")
+        w.close()
+        wait_for(lambda: "ingest-disconnect" in journal_types(server),
+                 10, "the disconnect journal entry")
+        # no live session now, but the disk lease says epoch 1: a
+        # writer presenting a smaller epoch is a zombie
+        w3 = Wire(server.port)
+        f = w3.hello("r0", "t1", "wB", epoch=0)
+        assert f["t"] == "fenced" and f["why"] == "stale-epoch"
+        w3.close()
+        evs = [e for e in journal_events(server)
+               if e["type"] == "ingest-fenced"]
+        assert {e["why"] for e in evs} \
+            == {"duplicate-writer", "stale-epoch"}
+        assert server.counts["fenced"] == 2
+
+    def test_bad_tenant_names_fenced(self, tmp_path, server):
+        w = Wire(server.port)
+        f = w.hello("..", "t1", "wA")
+        assert f["t"] == "fenced" and f["why"] == "bad-tenant"
+        w.close()
+        w = Wire(server.port)
+        f = w.hello("ingest", "t1", "wA")   # reserved bookkeeping dir
+        assert f["t"] == "fenced" and f["why"] == "bad-tenant"
+        w.close()
+
+    def test_backpressure_pause_resume_no_loss(self, tmp_path):
+        srv = IngestServer(tmp_path / "root", server_id="i-bp",
+                           lease_ttl=1.0,
+                           tenant_budget_bytes=2000).start()
+        try:
+            lines = op_lines(40)        # ~5KB >> the 2KB budget
+            w = Wire(srv.port)
+            w.hello("r0", "t1", "wA")
+            w.send(b"".join(lines))
+            assert w.ctl_until("pause", timeout=10) is not None
+            # the checker catches up: backlog collapses, flow resumes
+            run_dir = srv.root / "r0" / "t1"
+            (run_dir / "live.json").write_text(
+                json.dumps({"offset": 10 ** 9}))
+            assert w.ctl_until("resume", timeout=10) is not None
+            wait_for(lambda: srv.counts["ok"] == len(lines), 10,
+                     "every frame journaled despite the pause")
+            assert (run_dir / "history.wal").read_bytes() \
+                == b"".join(lines)
+            types = journal_types(srv)
+            assert "ingest-pause" in types \
+                and "ingest-unpause" in types
+            w.close()
+        finally:
+            srv.close()
+
+    def test_sidecar_and_metrics(self, tmp_path, server):
+        lines = op_lines(3)
+        w = Wire(server.port)
+        w.hello("r0", "t1", "wA")
+        w.send(b"".join(lines))
+        wait_for(lambda: server.counts["ok"] >= len(lines), 10,
+                 "frames in")
+        server.write_status()
+        doc = json.loads(
+            (server.ingest_dir / "i-test.json").read_text())
+        assert doc["port"] == server.port
+        assert doc["tenants"]["r0/t1"]["writer"] == "wA"
+        assert doc["tenants"]["r0/t1"]["seq"] == len(lines)
+        kinds = telemetry.REGISTRY.collect()
+        frames = kinds["jepsen_ingest_frames_total"][1]
+        ok = sum(m.value for labels, m in frames.items()
+                 if dict(labels).get("outcome") == "ok")
+        assert ok >= len(lines)
+        assert ingest_mod.ci_summary() is not None
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# store/discovery: ingest/ is bookkeeping, never a test name
+# ---------------------------------------------------------------------------
+
+class TestStoreExclusions:
+    def test_store_tests_skips_ingest_dir(self, tmp_path):
+        (store.BASE / "ingest" / "r0" / "t1").mkdir(parents=True)
+        (store.BASE / "real" / "t1").mkdir(parents=True)
+        (store.BASE / "real" / "t1" / "test.json").write_text("{}")
+        assert "ingest" not in store.tests()
+        assert "real" in store.tests()
+        assert store.ingest_root() == store.BASE / "ingest"
+
+    def test_scheduler_skips_ingest_dir(self):
+        assert "ingest" in NON_RUN_DIRS
+
+
+# ---------------------------------------------------------------------------
+# the client: StreamingWAL, breaker reconnect, fencing is terminal
+# ---------------------------------------------------------------------------
+
+class TestIngestClient:
+    def test_streaming_wal_mirrors_bytes(self, tmp_path):
+        srv = IngestServer(tmp_path / "root",
+                           server_id="i-cl").start()
+        try:
+            local = tmp_path / "local.wal"
+            wal = StreamingWAL(local, f"127.0.0.1:{srv.port}",
+                               "r0", "t1", writer="wA", fsync=False)
+            for k in range(8):
+                wal.append(invoke_op(0, "write", k % 5, index=2 * k))
+                wal.append(ok_op(0, "write", k % 5, index=2 * k + 1))
+            wal.close()                 # drains before returning
+            remote = srv.root / "r0" / "t1" / "history.wal"
+            wait_for(lambda: remote.exists()
+                     and remote.read_bytes() == local.read_bytes(),
+                     10, "remote WAL == local WAL, byte for byte")
+        finally:
+            srv.close()
+
+    def test_reconnect_through_breaker_no_loss(self, tmp_path):
+        srv = IngestServer(tmp_path / "root",
+                           server_id="i-rc").start()
+        try:
+            local = tmp_path / "local.wal"
+            wal = StreamingWAL(local, f"127.0.0.1:{srv.port}",
+                               "r0", "t1", writer="wA", fsync=False)
+            for k in range(6):
+                wal.append(invoke_op(0, "write", k % 5, index=2 * k))
+                wal.append(ok_op(0, "write", k % 5, index=2 * k + 1))
+            wait_for(lambda: wal.client.acked_seq > 0, 10,
+                     "first acks")
+            wal.client.kick()           # mid-stream disconnect
+            for k in range(6, 12):
+                wal.append(invoke_op(0, "write", k % 5, index=2 * k))
+                wal.append(ok_op(0, "write", k % 5, index=2 * k + 1))
+            wal.close()
+            assert wal.client.reconnects >= 1   # the kicked session
+            remote = srv.root / "r0" / "t1" / "history.wal"
+            wait_for(lambda: remote.exists()
+                     and remote.read_bytes() == local.read_bytes(),
+                     10, "no frame lost or duplicated across kick")
+            assert srv.counts["resumes"] >= 1
+        finally:
+            srv.close()
+
+    def test_fenced_is_terminal_but_local_wal_survives(self,
+                                                       tmp_path):
+        srv = IngestServer(tmp_path / "root",
+                           server_id="i-fc").start()
+        try:
+            w = Wire(srv.port)          # the legitimate writer
+            w.hello("r0", "t1", "wA")
+            local = tmp_path / "local.wal"
+            wal = StreamingWAL(local, f"127.0.0.1:{srv.port}",
+                               "r0", "t1", writer="wB", fsync=False)
+            for k in range(3):
+                wal.append(invoke_op(0, "write", k, index=2 * k))
+                wal.append(ok_op(0, "write", k, index=2 * k + 1))
+            wait_for(lambda: wal.client.fenced, 10,
+                     "the duplicate writer to be fenced")
+            # the run itself is unharmed: local journaling continues
+            wal.append(invoke_op(0, "write", 4, index=6))
+            wal.close()
+            seg = follow_frames(local)
+            assert len(seg.records) == 7 and not seg.corrupt
+            w.close()
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# /ingest web surface
+# ---------------------------------------------------------------------------
+
+class TestIngestWeb:
+    def test_page_renders_servers_tenants_timeline(self, tmp_path):
+        # the page reads store/ingest — root the server at the
+        # (monkeypatched) store base so its sidecar lands there
+        srv2 = IngestServer(store.BASE, server_id="i-web2",
+                            lease_ttl=1.0).start()
+        try:
+            lines = op_lines(2)
+            w = Wire(srv2.port)
+            w.hello("r0", "t1", "wA")
+            w.send(b"".join(lines))
+            wait_for(lambda: srv2.counts["ok"] >= len(lines), 10,
+                     "frames in")
+            w2 = Wire(srv2.port)
+            f = w2.hello("r0", "t1", "wB")
+            assert f["t"] == "fenced"
+            srv2.write_status()
+            page = web.ingest_html().decode()
+            assert "i-web2" in page
+            assert "r0/t1" in page
+            assert "ingest-fenced" in page
+            assert "duplicate-writer" in page
+            w.close()
+            w2.close()
+        finally:
+            srv2.close()
+
+    def test_empty_state_hint(self):
+        page = web.ingest_html().decode()
+        assert "--listen" in page       # the operator hint renders
+
+
+# ---------------------------------------------------------------------------
+# the C sender (native/walsend.c) — compiler-gated like packext
+# ---------------------------------------------------------------------------
+
+class TestWalsend:
+    def test_walsend_ships_a_wal_byte_identically(self, tmp_path):
+        from jepsen_tpu import native
+        exe = native.walsend()
+        if exe is None:
+            pytest.skip("no C compiler for native/walsend.c")
+        srv = IngestServer(tmp_path / "root",
+                           server_id="i-c").start()
+        try:
+            lines = op_lines(12)
+            p = tmp_path / "ship.wal"
+            p.write_bytes(b"".join(lines))
+            proc = subprocess.run(
+                [exe, "127.0.0.1", str(srv.port), "r0", "t1",
+                 str(p), "wC"],
+                capture_output=True, timeout=30)
+            assert proc.returncode == 0, proc.stderr
+            remote = srv.root / "r0" / "t1" / "history.wal"
+            assert remote.read_bytes() == b"".join(lines)
+            # rerun after a clean bye: the released lease is taken
+            # over, the acked prefix skipped, nothing duplicated
+            # (walsend exits as soon as the bye is on the wire — wait
+            # for the server to process it and release the lease)
+            wait_for(lambda: (lambda ls: ls is not None
+                              and ls.released)(
+                lease_mod.read(srv.ingest_dir / "r0" / "t1")),
+                10, "the bye to release the writer lease")
+            proc = subprocess.run(
+                [exe, "127.0.0.1", str(srv.port), "r0", "t1",
+                 str(p), "wC"],
+                capture_output=True, timeout=30)
+            assert proc.returncode == 0, proc.stderr
+            assert remote.read_bytes() == b"".join(lines)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# RemoteTarget: the network fault space as a campaign target
+# ---------------------------------------------------------------------------
+
+class FakeCampaign:
+    seed = 11
+
+
+@pytest.mark.kill9
+class TestRemoteTarget:
+    def test_coverage_classes_and_byte_identity(self, tmp_path):
+        """One deterministic schedule exercising >= 4 network-fault
+        coverage classes; the verdict is the robustness contract:
+        every fault journaled, no corrupt frame in any WAL."""
+        t = campaign.RemoteTarget(tenants=2, ops_per_tenant=50,
+                                  lease_ttl=0.5)
+        sched = {"id": "s-smoke", "workload": "stream",
+                 "time_limit": 2.0,
+                 "windows": [
+                     {"name": "frame-torn", "at": 0.3, "dur": 0.4},
+                     {"name": "frame-dup", "at": 0.5, "dur": 0.4},
+                     {"name": "frame-reorder", "at": 0.7,
+                      "dur": 0.4},
+                     {"name": "stale-writer", "at": 0.9, "dur": 0.4},
+                     {"name": "disconnect", "at": 0.6, "dur": 0.4}]}
+        out = t.run(sched, FakeCampaign())
+        assert out["verdict"] is True, out
+        got = set(out["anomalies"])
+        assert len(got & {"frame-torn", "frame-dup", "frame-reorder",
+                          "resume", "fenced", "backpressure"}) >= 4, \
+            out["anomalies"]
+        assert out["leaked"] == []
+
+    def test_campaign_loop_zero_leaks(self, tmp_path):
+        """A tiny real campaign over the remote target: the ledger
+        closes clean — no leaked faults, no crashed schedules."""
+        t = campaign.RemoteTarget(tenants=1, ops_per_tenant=30,
+                                  lease_ttl=0.5)
+        c = campaign.Campaign("remote-smoke", t, seed=3, schedules=2,
+                              base_time_limit=1.2, run_grace_s=60.0)
+        c.run()
+        assert c.counts["run"] == 2
+        assert c.counts["leaks"] == 0
+        assert c.counts["crashed"] == 0
+        assert c.counts["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# kill9 batteries: daemon subprocesses, SIGKILL, survivor takeover
+# ---------------------------------------------------------------------------
+
+def spawn_listener(root, wid, ttl=0.8, port=0):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         str(root), "--worker-id", wid, "--lease-ttl", str(ttl),
+         "--backend", "host", "--poll-interval", "0.02",
+         "--listen", f"127.0.0.1:{port}"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def learn_port(root, wid, timeout=30):
+    def read():
+        p = root / "ingest" / f"{wid}.json"
+        try:
+            return int(json.loads(p.read_text()).get("port") or 0)
+        except (OSError, ValueError):
+            return 0
+    return wait_for(read, timeout, f"{wid}'s ingest port")
+
+
+@pytest.mark.kill9
+class TestIngestKill9:
+    TTL = 0.8
+
+    def test_sigkill_receiver_survivor_takes_over(self, tmp_path):
+        """SIGKILL the receiving daemon mid-frame: the client fails
+        over to the fleet survivor's listener, the tenant's writer
+        lease is taken over (epoch bumped), the stream resumes from
+        the acked cursor, and the planted violation is flagged
+        exactly once — zero lost, zero duplicated."""
+        root = tmp_path / "store"
+        root.mkdir()
+        a = spawn_listener(root, "A", self.TTL)
+        b = spawn_listener(root, "B", self.TTL)
+        procs = [a, b]
+        try:
+            pa = learn_port(root, "A")
+            pb = learn_port(root, "B")
+            local = tmp_path / "local.wal"
+            wal = StreamingWAL(
+                local, [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"],
+                "r0", "t1", writer="wK", fsync=False)
+            i = 0
+            for k in range(12):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.01)
+            wait_for(lambda: wal.client.acked_seq > 0, 30,
+                     "the first listener to ack")
+            a.send_signal(signal.SIGKILL)   # mid-stream, mid-frame
+            a.wait(10)
+            for k in range(12):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.01)
+            # post-kill planted violation: only the survivor sees it
+            wal.append(invoke_op(0, "read", None, index=i))
+            wal.append(ok_op(0, "read", 99, index=i + 1))
+            flag_idx = i + 1
+            i += 2
+            wal.close()
+            d = root / "r0" / "t1"
+            wait_for(lambda: d.joinpath("history.wal").exists()
+                     and d.joinpath("history.wal").read_bytes()
+                     == local.read_bytes(), 30,
+                     "survivor WAL byte-identical to the local WAL")
+            # the survivor's checker flags the violation exactly once
+            wait_for(lambda: [
+                e for e in telemetry.read_events(d / "live.jsonl")
+                if e.get("type") == "live-flag"], 60,
+                "the survivor to flag the planted violation")
+            flags = [e for e in
+                     telemetry.read_events(d / "live.jsonl")
+                     if e.get("type") == "live-flag"]
+            by_idx = {}
+            for f in flags:
+                by_idx[f["op_index"]] = by_idx.get(f["op_index"],
+                                                   0) + 1
+            assert by_idx == {flag_idx: 1}, by_idx
+            # the writer lease was taken over, not re-minted
+            ls = lease_mod.read(root / "ingest" / "r0" / "t1")
+            assert ls is not None and ls.epoch >= 2
+            assert wal.client.reconnects >= 1
+        finally:
+            for p in procs:
+                try:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGCONT)
+                        p.send_signal(signal.SIGKILL)
+                        p.wait(10)
+                except OSError:
+                    pass
+
+    def test_acceptance_core_run_streams_over_tcp(self, tmp_path,
+                                                  monkeypatch):
+        """THE ISSUE 16 acceptance scenario: a real core.run streams
+        its history over TCP (one `live-stream` test-map key) to a
+        `serve-checker --listen` daemon in ANOTHER process; a planted
+        mid-stream violation is flagged while the run is still going;
+        a mid-frame disconnect forces a resume with no duplicate
+        flag; a stale-epoch second writer is fenced and journaled."""
+        from jepsen_tpu import checker as ck
+        from jepsen_tpu import core, generator as gen, models
+        from jepsen_tpu import tests as tst
+        root = tmp_path / "daemon-store"
+        root.mkdir()
+        daemon = spawn_listener(root, "D", 1.0)
+        try:
+            port = learn_port(root, "D")
+            state = tst.Atom()
+            client = tst.atom_client(state)
+            base_invoke = client.invoke
+            n_ops = [0]
+
+            def lying_slow_invoke(test, op):
+                time.sleep(0.006)
+                out = base_invoke(test, op)
+                n_ops[0] += 1
+                if (op.f == "read" and out.type == "ok"
+                        and n_ops[0] > 150):
+                    return out.assoc(value=99)  # planted mid-stream
+                return out
+            client.invoke = lying_slow_invoke
+            test = dict(tst.noop_test(), **{
+                "name": "remote-acceptance",
+                "nodes": ["n1"],
+                "concurrency": 4,
+                "db": tst.atom_db(state),
+                "client": client,
+                "live-stream": f"127.0.0.1:{port}",
+                "live-stream-writer": "wRun",
+                "generator": gen.nemesis(gen.void,
+                                         gen.limit(600, gen.cas)),
+                "checker": ck.linearizable(
+                    {"model": models.CASRegister(0)}),
+            })
+            flagged_during_run = [False]
+            kicked = [False]
+            fenced_probe = [None]
+            # core.run copies the test map, so reach the streaming
+            # WAL by capturing the instance run_case constructs
+            from jepsen_tpu.live import client as client_mod
+            streamed = []
+
+            class CapturingWAL(StreamingWAL):
+                def __init__(self, *a, **kw):
+                    super().__init__(*a, **kw)
+                    streamed.append(self)
+            monkeypatch.setattr(client_mod, "StreamingWAL",
+                                CapturingWAL)
+
+            def run_test():
+                core.run(test)
+
+            runner = threading.Thread(target=run_test, daemon=True)
+            runner.start()
+            # core.run mints the timestamp itself — learn the tenant
+            # dir from the daemon's store as the stream arrives
+            d = wait_for(
+                lambda: next(iter(
+                    (root / "remote-acceptance").glob("*")), None)
+                if (root / "remote-acceptance").is_dir() else None,
+                60, "the streamed tenant dir on the daemon")
+            ts = d.name
+            # mid-frame disconnect while ops still flow: the client
+            # must resume with no duplicate frames (and therefore no
+            # duplicate flags)
+            wal = wait_for(lambda: streamed[0] if streamed else None,
+                           10, "the run's streaming WAL")
+            wait_for(lambda: wal.client.acked_seq > 50, 60,
+                     "a mid-stream cursor")
+            wal.client.kick()
+            kicked[0] = True
+            wait_for(lambda: wal.client.reconnects >= 1
+                     and wal.client.registered.is_set(), 30,
+                     "the kicked client to have re-dialed")
+            # a second writer presenting the run's identity with a
+            # stale epoch (a SIGKILLed predecessor re-dialing): fenced
+            # and journaled, and the real client just resumes again
+            w = Wire(port)
+            fenced_probe[0] = w.hello("remote-acceptance", ts,
+                                      "wRun", epoch=0)
+            w.close()
+            # the daemon flags the violation BEFORE the run ends
+            wait_for(lambda: [
+                e for e in telemetry.read_events(d / "live.jsonl")
+                if e.get("type") == "live-flag"]
+                if (d / "live.jsonl").exists() else None, 90,
+                "the daemon to flag the planted violation in-flight")
+            flagged_during_run[0] = runner.is_alive()
+            runner.join(120)
+            assert not runner.is_alive(), "the run wedged"
+            assert flagged_during_run[0], \
+                "the flag landed only after teardown"
+            # byte-identity across the disconnect: the daemon's WAL
+            # is exactly the run's local WAL
+            local = store.BASE / "remote-acceptance" / ts \
+                / "history.wal"
+            wait_for(lambda: (d / "history.wal").read_bytes()
+                     == local.read_bytes(), 30,
+                     "daemon WAL byte-identical to the run's WAL")
+            flags = [e for e in
+                     telemetry.read_events(d / "live.jsonl")
+                     if e.get("type") == "live-flag"]
+            by_idx = {}
+            for f in flags:
+                by_idx[f["op_index"]] = by_idx.get(f["op_index"],
+                                                   0) + 1
+            assert by_idx and all(n == 1 for n in by_idx.values()), \
+                f"duplicate flags across the resume: {by_idx}"
+            assert fenced_probe[0]["t"] == "fenced" \
+                and fenced_probe[0]["why"] == "stale-epoch"
+            evs = []
+            for p in (root / "ingest").glob("*.jsonl"):
+                evs.extend(telemetry.read_events(p))
+            fenced = [e for e in evs if e["type"] == "ingest-fenced"]
+            assert any(e["why"] == "stale-epoch" for e in fenced)
+            assert any(e["type"] == "ingest-register"
+                       and e.get("resumed") for e in evs), \
+                "the kick never produced a journaled resume"
+        finally:
+            try:
+                if daemon.poll() is None:
+                    daemon.send_signal(signal.SIGCONT)
+                    daemon.send_signal(signal.SIGKILL)
+                    daemon.wait(10)
+            except OSError:
+                pass
