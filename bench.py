@@ -629,6 +629,258 @@ def bench_fleet() -> dict:
             "live_fleet_ttl_s": ttl}
 
 
+def bench_live_txn() -> dict:
+    """ISSUE 18: the incremental transactional (Elle) tier, priced
+    three ways.
+
+    (a) **sustained txn drain**: N tenants of clean paced list-append
+    mop WALs drained end-to-end by one scheduler; value is client ops
+    (invokes) per second through feed -> delta -> packed-plane update
+    -> warm closure -> classify.  Clean streams must stay flag-free
+    (asserted, like bench_fleet).
+
+    (b) **commit -> anomaly-flag detection lag**: a paced stream with
+    a G-single planted mid-way; wall seconds from appending the
+    planted txn's ok record to the durable `live-flag` landing in
+    live.jsonl.  This is the headline the incremental mode exists
+    for: the one-shot checker's answer arrives only after teardown.
+
+    (c) **txn takeover gap**: two lease-coordinated workers over
+    paced txn feeds; worker A's tick loop stops dead; wall to the
+    survivor's journaled `lease-takeover`.  The survivor resumes from
+    A's checkpointed frontier (resumed txns disclosed) — the
+    subprocess twin is pinned by tests/test_txn_fleet.py."""
+    import shutil
+    import tempfile
+    import threading
+
+    from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu.campaign import TxnFleetTarget
+    from jepsen_tpu.history import HistoryWAL
+    from jepsen_tpu.live.scheduler import LiveScheduler
+
+    cpus = os.cpu_count() or 1
+    n_ten = 2
+    txns = int(os.environ.get("JEPSEN_TPU_BENCH_TXN_N",
+                              600 if cpus >= 8 else 200))
+    ttl = 0.4
+    NEVER = 10 ** 9                    # plant position that never fires
+    rootbase = pathlib.Path(tempfile.mkdtemp(prefix="bench-txn-"))
+    mk = TxnFleetTarget(txns_per_tenant=txns)
+
+    def write_store(sub: str, seed0: int) -> tuple:
+        root = rootbase / sub
+        n_inv = 0
+        for i in range(n_ten):
+            d = root / f"txn{i}" / "t1"
+            d.mkdir(parents=True)
+            ops = mk._txn_stream(random.Random(seed0 + i),
+                                 "g-single", NEVER)
+            n_inv += sum(1 for o in ops if o.type == "invoke")
+            wal = HistoryWAL(d / "history.wal", fsync=False)
+            for o in ops:
+                wal.append(o)
+            wal.close()
+            (d / "results.json").write_text('{"valid?": true}')
+        return root, n_inv
+
+    gap = None
+    resumed = 0
+    try:
+        # (a) sustained drain, clean streams
+        root1, n_inv = write_store("drain", 100)
+        s1 = LiveScheduler(root1, backend="host", scan_every=1)
+        t0 = time.monotonic()
+        s1.drain()
+        drain_s = time.monotonic() - t0
+        clean = s1.flags_total == 0
+        s1.close()
+        if not clean:
+            print(json.dumps({"metric": "ERROR: txn bench flagged a "
+                              "clean stream", "value": 0,
+                              "unit": "ops/sec", "vs_baseline": 0}))
+            return {"error": True}
+        rate = n_inv / drain_s
+
+        # (b) commit -> flag detection lag on a paced planted stream
+        root2 = rootbase / "lag"
+        d2 = root2 / "rt0" / "t1"
+        d2.mkdir(parents=True)
+        plant_at = txns // 2
+        ops2 = mk._txn_stream(random.Random(5), "g-single", plant_at)
+        wal2 = HistoryWAL(d2 / "history.wal", fsync=False)
+        s2 = LiveScheduler(root2, backend="host", scan_every=1)
+        stop2 = threading.Event()
+
+        def drive(s, stop):
+            while not stop.is_set():
+                s.tick()
+
+        th2 = threading.Thread(target=drive, args=(s2, stop2),
+                               daemon=True)
+        th2.start()
+        # the planted pattern is 3 txns (6 records) ending at the
+        # anomalous read's ok; find that record's position
+        plant_end = None
+        pos2 = 0
+        t0 = time.monotonic()
+        planted_t = None
+        lag = None
+        for o in ops2:
+            wal2.append(o)
+            pos2 += 1
+            if o.type == "ok" and isinstance(o.value, list) \
+                    and any(m[0] == "r" and m[1] == 101
+                            for m in o.value):
+                plant_end = pos2
+                planted_t = time.monotonic()
+            time.sleep(0.001)
+        wal2.close()
+        (d2 / "results.json").write_text('{"valid?": false}')
+        deadline = time.monotonic() + 120
+        while lag is None and time.monotonic() < deadline:
+            p = d2 / "live.jsonl"
+            if p.exists() and any(
+                    e.get("type") == "live-flag"
+                    for e in telemetry_mod.read_events(p)):
+                lag = time.monotonic() - planted_t
+            time.sleep(0.005)
+        stop2.set()
+        th2.join(5)
+        s2.drain()
+        s2.close()
+        if lag is None or plant_end is None:
+            print(json.dumps({"metric": "ERROR: txn bench planted "
+                              "G-single never flagged", "value": 0,
+                              "unit": "s", "vs_baseline": 0}))
+            return {"error": True}
+
+        # (c) takeover gap with checkpointed-frontier resume
+        root3 = rootbase / "takeover"
+        feeders = []
+        for i in range(n_ten):
+            d = root3 / f"rt{i}" / "t1"
+            d.mkdir(parents=True)
+            feeders.append((d, mk._txn_stream(
+                random.Random(700 + i), "g-single", NEVER)))
+        wals = [HistoryWAL(d / "history.wal", fsync=False)
+                for d, _ in feeders]
+        A = LiveScheduler(root3, backend="host", scan_every=1,
+                          worker_id="tA", lease_ttl=ttl)
+        B = LiveScheduler(root3, backend="host", scan_every=1,
+                          worker_id="tB", lease_ttl=ttl)
+        a_stop, b_stop = threading.Event(), threading.Event()
+        tha = threading.Thread(target=drive, args=(A, a_stop),
+                               daemon=True)
+        thb = threading.Thread(target=drive, args=(B, b_stop),
+                               daemon=True)
+        tha.start()
+        thb.start()
+
+        def takeovers() -> int:
+            n = 0
+            for d, _f in feeders:
+                p = d / "live.jsonl"
+                if p.exists():
+                    n += sum(1 for e in telemetry_mod.read_events(p)
+                             if e.get("type") == "lease-takeover")
+            return n
+
+        pos = [0] * n_ten
+        t0 = time.monotonic()
+        kill_at = None
+        base_takeovers = 0
+        survivor = B
+        while (any(pos[i] < len(feeders[i][1])
+                   for i in range(n_ten))
+               or kill_at is None or gap is None) \
+                and time.monotonic() - t0 < 300:
+            el = time.monotonic() - t0
+            target = int(el * 1_000) + 8
+            for i, (_d, fops) in enumerate(feeders):
+                stop_i = min(target, len(fops))
+                while pos[i] < stop_i:
+                    wals[i].append(fops[pos[i]])
+                    pos[i] += 1
+            if kill_at is None and el > 0.5 \
+                    and (A.tenants or B.tenants):
+                # kill whichever worker won the adoption race — the
+                # initial lease scramble can leave either as owner
+                base_takeovers = takeovers()
+                if A.tenants:
+                    a_stop.set()       # the in-process SIGKILL analog
+                    tha.join(5)
+                else:
+                    survivor = A
+                    b_stop.set()
+                    thb.join(5)
+                kill_at = time.monotonic()
+            if kill_at is not None and gap is None \
+                    and takeovers() > base_takeovers:
+                gap = time.monotonic() - kill_at
+            time.sleep(0.01)
+        for w in wals:
+            w.close()
+        for d, _f in feeders:
+            (d / "results.json").write_text('{"valid?": true}')
+        a_stop.set()
+        b_stop.set()
+        tha.join(5)
+        thb.join(5)
+        survivor.drain()
+        for d, _f in feeders:
+            try:
+                with open(d / "live.json") as f:
+                    resumed += int((json.load(f).get("txn") or {})
+                                   .get("resumed_txns") or 0)
+            except (OSError, json.JSONDecodeError, ValueError):
+                pass
+        A.close()
+        B.close()
+    finally:
+        shutil.rmtree(rootbase, ignore_errors=True)
+
+    if gap is None:
+        print(json.dumps({"metric": "ERROR: txn bench survivor never "
+                          "took over the dead worker's tenants",
+                          "value": 0, "unit": "s",
+                          "vs_baseline": 0}))
+        return {"error": True}
+
+    print(json.dumps({
+        "metric": (f"incremental txn tier: sustained drain over "
+                   f"{n_ten} tenants x {txns}-txn list-append mop "
+                   "WALs (feed -> delta -> packed planes -> warm "
+                   "closure -> classify; clean streams flag-free)"),
+        "value": round(rate, 1),
+        "unit": "ops/sec",
+        "vs_baseline": 1.0}), file=sys.stderr)
+    print(json.dumps({
+        "metric": ("txn commit -> anomaly-flag detection lag "
+                   "(G-single planted mid-stream; wall from the "
+                   "planted ok record to the durable live-flag — the "
+                   "one-shot checker answers only after teardown)"),
+        "value": round(lag, 3),
+        "unit": "seconds",
+        "vs_baseline": 1.0}), file=sys.stderr)
+    print(json.dumps({
+        "metric": (f"txn takeover gap after a worker dies mid-stream "
+                   f"(lease ttl {ttl}s; survivor resumes from the "
+                   f"checkpointed frontier — {resumed} txns resumed "
+                   "without replay)"),
+        "value": round(gap, 3),
+        "unit": "seconds",
+        "vs_baseline": round(gap / ttl, 2)}), file=sys.stderr)
+    print(f"# live-txn: drain {rate:.0f} ops/s ({drain_s:.2f}s), "
+          f"detect lag {lag:.3f}s, takeover gap {gap:.3f}s at ttl "
+          f"{ttl}s ({resumed} txns resumed)", file=sys.stderr)
+    return {"live_txn_ops_s": round(rate, 1),
+            "live_txn_detect_lag_s": round(lag, 3),
+            "live_txn_takeover_s": round(gap, 3),
+            "live_txn_resumed": resumed,
+            "live_txn_ttl_s": ttl}
+
+
 def bench_remote() -> dict:
     """ISSUE 16: the remote-tenant network ingest tier, priced three
     ways over N paced TCP feeders streaming register histories to one
@@ -1978,6 +2230,10 @@ def main() -> int:
     if remote_stats.get("error"):
         return 1
 
+    txn_stats = bench_live_txn()
+    if txn_stats.get("error"):
+        return 1
+
     plan_stats = bench_plan_cache()
     if plan_stats.get("error"):
         return 1
@@ -2118,6 +2374,12 @@ def main() -> int:
         # disconnect -> cursor-resume gap (bench_remote; byte-verified
         # drain, feeder count disclosed)
         **{k: v for k, v in remote_stats.items() if v is not None},
+        # the incremental transactional tier (ISSUE 18): sustained
+        # txn-stream drain ops/s, commit -> anomaly-flag detection
+        # lag on a planted G-single, and the txn takeover gap with
+        # checkpointed-frontier resume (bench_live_txn; ttl and
+        # resumed-txn count disclosed)
+        **{k: v for k, v in txn_stats.items() if v is not None},
         # planner rows (BENCH_r08+): cold-vs-warm PROCESS start with
         # the persistent compiled-plan cache (subprocess-measured,
         # compile seconds child-disclosed) and the double-buffered

@@ -827,6 +827,283 @@ class FleetTarget:
         self._procs = []
 
 
+class TxnFleetTarget(FleetTarget):
+    """The transactional fault space of the serve-checker (ISSUE 18):
+    the SUT is a worker fleet streaming *mop-list txn* WALs through
+    the incremental Elle tier (live/txn.TxnTenant), and the nemesis
+    kills / pauses workers mid-closure AND tears the txn checkpoint
+    sidecars — searching the checkpoint/restore/full-replay protocol
+    for lost or duplicated anomaly flags.
+
+    Window names:
+      * `kill-worker` / `pause-worker` — as FleetTarget (the fleet
+        shapes), but landing while incremental closure state is warm;
+      * `tear-checkpoint` — truncate every tenant's `txn-state.json`
+        in place (`lease.tear_txn_sidecar`): the crc pointer must
+        detect the tear and the successor must degrade to full replay
+        rather than resume a wrong frontier.
+
+    Each tenant's stream plants one anomaly drawn from distinct
+    isolation levels (G-single / G1c / duplicate-elements), so the
+    coverage matrix spans `level:*` classes — the isolation-level
+    coverage axis.  Verdict True = every planted anomaly flagged
+    exactly once with its correct level, across every fault mix."""
+
+    name = "txn-fleet"
+    workloads = ("list-append",)
+    nemeses = {"kill-worker": None, "pause-worker": None,
+               "tear-checkpoint": None}
+
+    # (plant key prefix, expected flag lane, expected level)
+    PLANTS = (
+        ("g-single", "txn:G-single", "snapshot-isolation"),
+        ("g1c", "txn:G1c", "read-committed"),
+        ("dup", "txn:duplicate-elements", "read-uncommitted"),
+    )
+
+    def __init__(self, workers: int = 2, tenants: int = 2,
+                 lease_ttl: float = 0.5, txns_per_tenant: int = 60):
+        super().__init__(workers=workers, tenants=tenants,
+                         lease_ttl=lease_ttl,
+                         ops_per_tenant=2 * txns_per_tenant)
+        self.txns_per_tenant = txns_per_tenant
+
+    # -- stream construction -------------------------------------------------
+
+    def _txn_stream(self, rng, plant_kind: str, plant_at: int):
+        """One tenant's client-op list (invoke/ok pairs in WAL order):
+        a clean paced list-append stream with `plant_kind` inserted at
+        txn position `plant_at`.  Clean txns commit sequentially, so
+        the only cycles are the planted ones."""
+        from jepsen_tpu.history import Op
+        ops: list = []
+        idx = [0]
+        lists: dict = {}
+
+        def emit(p, vin, vok):
+            ops.append(Op(process=p, type="invoke", f="txn",
+                          value=vin, index=idx[0]))
+            idx[0] += 1
+            ops.append(Op(process=p, type="ok", f="txn",
+                          value=vok, index=idx[0]))
+            idx[0] += 1
+
+        def plant(u):
+            if plant_kind == "g-single":
+                # tb writes (100, 101); ta reads 100 seeing tb (wr
+                # tb->ta) but reads 101 empty (rw ta->tb): one-rw cycle
+                emit(0, [["append", 100, u]], [["append", 100, u]])
+                emit(1, [["append", 100, u + 1], ["append", 101, u]],
+                     [["append", 100, u + 1], ["append", 101, u]])
+                emit(2, [["r", 100, None], ["r", 101, None]],
+                     [["r", 100, [u, u + 1]], ["r", 101, []]])
+            elif plant_kind == "g1c":
+                # wr cycle: ta reads tb's future write, tb reads ta's
+                emit(0, [["append", 103, u], ["r", 104, None]],
+                     [["append", 103, u], ["r", 104, [u + 1]]])
+                emit(1, [["append", 104, u + 1], ["r", 103, None]],
+                     [["append", 104, u + 1], ["r", 103, [u]]])
+            else:                       # duplicate-elements
+                # the same element committed by two writers: the
+                # second append of (k, v) is the direct anomaly
+                emit(0, [["append", 102, u]], [["append", 102, u]])
+                emit(1, [["append", 102, u]], [["append", 102, u]])
+
+        for j in range(self.txns_per_tenant):
+            if j == plant_at:
+                plant(10_000 + j)
+            k = rng.randrange(4)
+            cur = lists.setdefault(k, [])
+            if rng.random() < 0.6:
+                cur.append(j)
+                emit(j % 3, [["append", k, j]],
+                     [["append", k, j]])
+            else:
+                emit(j % 3, [["r", k, None]],
+                     [["r", k, list(cur)]])
+        return ops
+
+    def run(self, schedule: dict, campaign: "Campaign") -> dict:
+        import shutil
+        import signal
+        import tempfile
+        from jepsen_tpu.history import HistoryWAL
+        from jepsen_tpu.live import lease as lease_mod
+        rng = _rng(campaign.seed, "txn-fleet", schedule["id"])
+        tl = max(schedule["time_limit"], 3 * self.lease_ttl)
+        root = Path(tempfile.mkdtemp(prefix="txnfleet-campaign-"))
+        outcome = {"verdict": "unknown", "anomalies": [],
+                   "engines": ["txn-fleet"], "lag_bucket": "na",
+                   "overlap": "nowin", "quarantined": False,
+                   "leaked": [], "run": None}
+        try:
+            plants = [self.PLANTS[rng.randrange(len(self.PLANTS))]
+                      for _ in range(self.tenants)]
+            plant_at = [int(self.txns_per_tenant
+                            * rng.uniform(0.45, 0.8))
+                        for _ in range(self.tenants)]
+            dirs, wals, streams = [], [], []
+            for ti in range(self.tenants):
+                d = root / f"txn{ti}" / "t1"
+                d.mkdir(parents=True)
+                dirs.append(d)
+                wals.append(HistoryWAL(d / "history.wal",
+                                       fsync=False))
+                streams.append(self._txn_stream(
+                    rng, plants[ti][0], plant_at[ti]))
+            self._procs = [self._spawn(root, i)
+                           for i in range(self.workers)]
+            events = []
+            for wi, w in enumerate(schedule["windows"]):
+                victim = wi % self.workers
+                events.append((w["at"], w["name"], "start", victim))
+                events.append((min(w["at"] + w["dur"], tl - 0.05),
+                               w["name"], "stop", victim))
+            events.sort(key=lambda e: e[0])
+
+            t0 = time.monotonic()
+            pos = [0] * self.tenants
+            ev_box = [0]
+
+            def fire_windows():
+                el = time.monotonic() - t0
+                while ev_box[0] < len(events) \
+                        and el >= events[ev_box[0]][0]:
+                    _at, nm, phase, victim = events[ev_box[0]]
+                    ev_box[0] += 1
+                    try:
+                        if nm == "kill-worker":
+                            proc = self._procs[victim]
+                            if phase == "start":
+                                proc.send_signal(signal.SIGKILL)
+                                proc.wait(5)
+                            else:
+                                self._procs[victim] = self._spawn(
+                                    root, victim + 10)
+                        elif nm == "pause-worker":
+                            self._procs[victim].send_signal(
+                                signal.SIGSTOP if phase == "start"
+                                else signal.SIGCONT)
+                        elif nm == "tear-checkpoint" \
+                                and phase == "start":
+                            for d in dirs:
+                                lease_mod.tear_txn_sidecar(d)
+                    except Exception:   # noqa: BLE001
+                        pass
+
+            total = [len(s) for s in streams]
+            while any(pos[ti] < total[ti]
+                      for ti in range(self.tenants)):
+                el = time.monotonic() - t0
+                fire_windows()
+                frac = el / max(tl * 0.6, 0.1)
+                for ti in range(self.tenants):
+                    target = min(total[ti],
+                                 int(frac * total[ti]) + 4)
+                    while pos[ti] < target:
+                        wals[ti].append(streams[ti][pos[ti]])
+                        pos[ti] += 1
+                time.sleep(0.01)
+            for ti, w in enumerate(wals):
+                w.close()
+                (dirs[ti] / "results.json").write_text(
+                    '{"valid?": false}')
+            if all(p.poll() is not None for p in self._procs):
+                self._procs.append(self._spawn(root, 90))
+            deadline = time.monotonic() + tl \
+                + 20 * self.lease_ttl + 5.0
+            lanes = {}
+            while time.monotonic() < deadline:
+                fire_windows()
+                lanes = self._collect_lanes(dirs)
+                if all(lanes.get((ti, plants[ti][1]))
+                       for ti in range(self.tenants)) \
+                        and self._all_done(dirs):
+                    break
+                time.sleep(0.1)
+            outcome.update(self._reduce_txn(root, dirs, plants,
+                                            lanes, schedule))
+            outcome["overlap"] = \
+                "all" if schedule["windows"] and all(
+                    w["at"] < tl for w in schedule["windows"]) \
+                else ("some" if schedule["windows"] else "nowin")
+        except Exception as e:          # noqa: BLE001 - harness error
+            outcome["verdict"] = "crashed"
+            outcome["error"] = type(e).__name__
+            log.warning("txn-fleet target crashed on %s",
+                        schedule["id"], exc_info=True)
+        finally:
+            self.reap()
+            shutil.rmtree(root, ignore_errors=True)
+        return outcome
+
+    @staticmethod
+    def _collect_lanes(dirs) -> dict:
+        """{(tenant_i, lane): [levels...]} over every live.jsonl —
+        txn flags key on the anomaly lane, not an op index."""
+        out: dict = {}
+        for ti, d in enumerate(dirs):
+            p = d / "live.jsonl"
+            if not p.exists():
+                continue
+            for e in telemetry.read_events(p):
+                if e.get("type") == "live-flag":
+                    out.setdefault((ti, e.get("lane")), []).append(
+                        e.get("level"))
+        return out
+
+    def _reduce_txn(self, root, dirs, plants, lanes,
+                    schedule) -> dict:
+        anomalies = set()
+        for ti, (_kind, lane, level) in enumerate(plants):
+            got = lanes.get((ti, lane), [])
+            if not got:
+                anomalies.add("flag-lost")
+            elif len(got) > 1:
+                anomalies.add("flag-dup")
+            elif got[0] != level:
+                anomalies.add("level-wrong")
+            else:
+                anomalies.add(f"level:{level}")
+        takeover_lag = None
+        resumed = False
+        for d in dirs:
+            p = d / "live.jsonl"
+            if p.exists():
+                for e in telemetry.read_events(p):
+                    if e.get("type") == "lease-takeover":
+                        anomalies.add("takeover")
+                        s = e.get("silent_s")
+                        if isinstance(s, (int, float)):
+                            takeover_lag = max(takeover_lag or 0.0, s)
+            try:
+                with open(d / "live.json") as f:
+                    txn = json.load(f).get("txn") or {}
+                if txn.get("resumed_txns"):
+                    resumed = True
+            except (OSError, json.JSONDecodeError):
+                pass
+        if resumed:
+            anomalies.add("resumed")
+        if any(w["name"] == "tear-checkpoint"
+               for w in schedule["windows"]):
+            anomalies.add("torn-ckpt")
+        fenced = 0
+        if (root / "fleet").is_dir():
+            for p in sorted((root / "fleet").glob("*.jsonl")):
+                for e in telemetry.read_events(p):
+                    if e.get("type") == "lease-fenced":
+                        fenced += 1
+        if fenced:
+            anomalies.add("fenced")
+        verdict = not ({"flag-lost", "flag-dup", "level-wrong"}
+                       & anomalies)
+        return {"verdict": verdict,
+                "anomalies": sorted(anomalies),
+                "lag_bucket": lag_bucket(takeover_lag),
+                "fenced": fenced}
+
+
 class RemoteTarget:
     """The ingest tier's fault space as a campaign target (ISSUE 16):
     the SUT is a `serve-checker --listen` daemon receiving framed
@@ -1236,7 +1513,8 @@ class RemoteTarget:
 
 
 TARGETS = {"kvd": KvdTarget, "mock": MockTarget,
-           "fleet": FleetTarget, "remote": RemoteTarget}
+           "fleet": FleetTarget, "txn-fleet": TxnFleetTarget,
+           "remote": RemoteTarget}
 
 
 def suite_target(name: str, test_fn: Callable, registry: dict,

@@ -71,6 +71,14 @@ def violated_levels(found) -> list:
     return list(ISOLATION_LEVELS[min(idx):])
 
 
+def weakest_violated(found) -> Optional[str]:
+    """The weakest isolation level the found anomalies rule out, or
+    None for a clean set — what the live transactional tenants report
+    per window (live/txn.py) and /live renders mid-stream."""
+    levels = violated_levels(found)
+    return levels[0] if levels else None
+
+
 class Elle(ck.Checker):
     """Transactional isolation checker.
 

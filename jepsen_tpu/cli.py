@@ -513,16 +513,21 @@ def campaign_cmd_spec(test_fn: Optional[Callable] = None,
         if test_fn is None or registry is None:
             parser.add_argument("--sut", default="kvd",
                                 choices=["kvd", "mock", "fleet",
-                                         "remote"],
+                                         "txn-fleet", "remote"],
                                 help="in-tree target: kvd over the "
                                      "local transport, the "
                                      "deterministic mock SUT, the "
                                      "serve-checker fleet itself "
                                      "(nemesis kills/pauses checker "
-                                     "workers), or the remote ingest "
-                                     "tier (nemesis = the network: "
-                                     "torn/dup/reordered frames, "
-                                     "disconnects, receiver kills)")
+                                     "workers), the transactional "
+                                     "fleet (nemesis kills workers "
+                                     "mid-closure and tears txn "
+                                     "checkpoints; isolation-level "
+                                     "coverage classes), or the "
+                                     "remote ingest tier (nemesis = "
+                                     "the network: torn/dup/"
+                                     "reordered frames, disconnects, "
+                                     "receiver kills)")
         parser.add_argument("--seed", type=int, default=0)
         parser.add_argument("--schedules", type=int, default=20,
                             metavar="N", help="schedule budget")

@@ -43,6 +43,8 @@ import numpy as np
 from jepsen_tpu import txn as mop
 from jepsen_tpu.history import History
 
+_MISS = object()
+
 # Fixed plane order — ops/elle_graph.py indexes by position.
 PLANES = ("ww", "wr", "rw", "po", "rt")
 DEP_PLANES = ("ww", "wr", "rw")
@@ -487,3 +489,504 @@ def infer(history, workload: str = "auto") -> Inference:
                      edge_types=edges.types, direct=direct,
                      workload=workload, meta=meta,
                      edge_lists=edges.edge_arrays())
+
+
+# ---------------------------------------------------------------------------
+# Incremental mode (ISSUE 18): streaming ops -> edge DELTAS
+# ---------------------------------------------------------------------------
+#
+# Both one-shot passes above are *key-separable*: every flag and every
+# dependency edge of key k is a pure function of (the ordered committed
+# txns touching k, the failed set, the indeterminate set) — no
+# cross-key coupling anywhere.  The incremental engine exploits that:
+# it keeps per-key touch lists, marks a key dirty whenever any op
+# could change its classification (a commit touching it, a fail/abort
+# of one of its writers, a new in-flight write to it), and on drain()
+# recomputes each dirty key's COMPLETE flag+edge contribution with a
+# faithful single-key transcription of the one-shot logic, diffing it
+# against the cached previous contribution.  Exactness is therefore by
+# construction (pinned window-by-window by tests/test_live_txn.py's
+# differential sweep), and the work per drain is proportional to the
+# dirty keys, not the history.
+#
+# The diff is emitted as per-plane edge ADDS and REMOVES (an edge is
+# shared by however many keys derive it — a refcount decides when a
+# bit actually sets or clears).  Removals are classified for the warm
+# closure downstream (ops/elle_mesh.classify_packed_warm):
+#
+#   * a removal is COVERED when the key's new edge set implies it
+#     transitively (ww tail supersession w1->T becoming w1->w2->T, and
+#     the wr last-writer analogue).  A covered edge stays inside the
+#     closure of the exact set, so a warm-started closure that never
+#     un-learns it is still the exact closure — by induction over
+#     removal events, as long as every removal since the last cold
+#     rebuild was covered at its removal time.
+#   * anything else (a read condemned by a late G1a/G1b/
+#     incompatible-order, an evidence wipe after cyclic-version-order)
+#     is UNCOVERED: drain() raises `rebuild`, and the consumer must
+#     rebuild closure cold from the (exact, bit-cleared) direct
+#     planes.  Uncovered removals coincide with freshly-found direct
+#     anomalies, so rebuilds are rare on clean streams.
+#
+# po is monotone (a process's next txn only appends to its chain); rt
+# is handled per new txn in both directions, so no order edge is ever
+# retracted.
+
+
+def _writes_of(value):
+    """(k, v) write/append pairs of one mop list — the collect_txns
+    inner helper, shared with the incremental feed."""
+    return {(mop.key(m), mop.value(m)) for m in (value or [])
+            if mop.is_op(m) and (mop.is_write(m) or mop.is_append(m))
+            and not isinstance(mop.value(m), (list, dict, set))}
+
+
+class IncrementalInference:
+    """Streaming twin of `infer()`: feed ops in WAL order, drain edge
+    deltas + the current direct-anomaly map.  The whole state
+    serializes to JSON (`to_state`/`from_state`) so a fleet takeover
+    resumes mid-stream from a lease checkpoint."""
+
+    # txn record layout: (process, inv_index, ok_index, value, ok_dict)
+    _P, _INV, _OK, _VAL, _DICT = range(5)
+
+    def __init__(self, workload: str):
+        if workload not in (LIST_APPEND, RW_REGISTER):
+            raise ValueError(f"unknown elle workload {workload!r}")
+        self.workload = workload
+        self.txns: list = []           # committed, completion order
+        self.inflight: dict = {}       # process -> (inv_index, value)
+        self.failed: set = set()       # (k, v) of failed writes
+        self.indet_done: set = set()   # (k, v) of info-txn writes
+        self.touch: dict = {}          # key -> [txn indices, ascending]
+        self._inv_idx: list = []       # per txn, -1 when unknown
+        self._ok_idx: list = []
+        self._last_by_proc: dict = {}  # process -> last txn index (po)
+        self._dirty: set = set()
+        self._key_cache: dict = {}     # key -> (flags, frozenset edges)
+        self._edge_ref: dict = {}      # (plane, a, b) -> key refcount
+        self._ordered = 0              # txns already po/rt-emitted
+        self._pending_po: list = []    # (a, b) awaiting drain
+
+    @property
+    def n(self) -> int:
+        return len(self.txns)
+
+    # -- feed ---------------------------------------------------------------
+
+    def feed(self, op) -> None:
+        """One history Op, in WAL order (gating and pairing mirror
+        collect_txns exactly, including dangling-invoke-as-indet)."""
+        v = op.value
+        if not isinstance(v, (list, tuple)) or isinstance(v, str):
+            return
+        if v and not all(mop.is_op(m) for m in v):
+            return
+        if op.is_invoke:
+            old = self.inflight.pop(op.process, None)
+            if old is not None:
+                # a re-invoke on a busy process drops the dangling
+                # txn from the indeterminate set (collect_txns
+                # overwrites inv[p]) — its write keys reclassify
+                self._mark_writes_dirty(old[1])
+            idx = op.index if isinstance(op.index, int) else -1
+            self.inflight[op.process] = (idx, list(v))
+            self._mark_writes_dirty(v)
+            return
+        got = self.inflight.pop(op.process, None)
+        if got is None:
+            return
+        inv_index, inv_value = got
+        if op.is_ok:
+            i = len(self.txns)
+            self.txns.append((op.process, inv_index,
+                              op.index if isinstance(op.index, int)
+                              else -1, list(v), op.to_dict()))
+            self._inv_idx.append(inv_index)
+            self._ok_idx.append(self.txns[i][self._OK])
+            for m in v:
+                k = mop.key(m)
+                seq = self.touch.setdefault(k, [])
+                if not seq or seq[-1] != i:
+                    seq.append(i)
+                self._dirty.add(k)
+            prev = self._last_by_proc.get(op.process)
+            if prev is not None:
+                self._pending_po.append((prev, i))
+            self._last_by_proc[op.process] = i
+        elif op.is_fail:
+            w = _writes_of(inv_value)
+            self.failed |= w
+            self._dirty.update(k for k, _ in w)
+        else:                          # info: indeterminate
+            # membership in the effective indet set is unchanged (the
+            # writes were already indeterminate while in flight)
+            self.indet_done |= _writes_of(inv_value)
+
+    def _mark_writes_dirty(self, value) -> None:
+        self._dirty.update(k for k, _ in _writes_of(value))
+
+    def _indet(self) -> set:
+        out = set(self.indet_done)
+        for _idx, v in self.inflight.values():
+            out |= _writes_of(v)
+        return out
+
+    def _mops(self, i: int) -> list:
+        return [m for m in self.txns[i][self._VAL] if mop.is_op(m)]
+
+    # -- drain --------------------------------------------------------------
+
+    def drain(self) -> dict:
+        """Recompute dirty keys, diff, and return the delta:
+
+            {"added":   [(plane, a, b), ...],
+             "removed": [(plane, a, b), ...],   # already bit-clearable
+             "rebuild": bool,   # an uncovered removal happened
+             "n": txn count, "dirty_keys": recomputed key count}
+        """
+        indet = self._indet()
+        added: list = []
+        removed: list = []
+        rebuild = False
+        recompute = (self._recompute_append_key
+                     if self.workload == LIST_APPEND
+                     else self._recompute_register_key)
+        ndirty = len(self._dirty)
+        for k in list(self._dirty):
+            flags, edges = recompute(k, indet)
+            _old_flags, old_edges = self._key_cache.get(
+                k, ((), frozenset()))
+            for e in edges - old_edges:
+                r = self._edge_ref.get(e, 0)
+                if r == 0:
+                    added.append(e)
+                self._edge_ref[e] = r + 1
+            for e in old_edges - edges:
+                r = self._edge_ref.get(e, 0) - 1
+                if r <= 0:
+                    self._edge_ref.pop(e, None)
+                    removed.append(e)
+                    if not self._covered(e, edges):
+                        rebuild = True
+                else:
+                    self._edge_ref[e] = r
+            self._key_cache[k] = (tuple(flags), edges)
+        self._dirty.clear()
+        self._order_delta(added)
+        return {"added": added, "removed": removed,
+                "rebuild": rebuild, "n": self.n,
+                "dirty_keys": ndirty}
+
+    @staticmethod
+    def _covered(e, new_edges: frozenset) -> bool:
+        """True when the key's new edge set transitively implies the
+        removed edge (so a warm closure keeping it stays exact)."""
+        p, a, b = e
+        if p == "ww":
+            return any(q == "ww" and x == a
+                       and ("ww", y, b) in new_edges
+                       for q, x, y in new_edges)
+        if p == "wr":
+            return any(q == "ww" and x == a
+                       and ("wr", y, b) in new_edges
+                       for q, x, y in new_edges)
+        return False                   # rw retractions always rebuild
+
+    def _order_delta(self, added: list) -> None:
+        """po/rt edges for txns committed since the last drain — both
+        directions per new txn, so monotonicity is unconditional."""
+        n = self.n
+        start = self._ordered
+        if start >= n:
+            return
+        for a, b in self._pending_po:
+            added.append(("po", a, b))
+        self._pending_po.clear()
+        inv = np.asarray(self._inv_idx, np.int64)
+        ok = np.asarray(self._ok_idx, np.int64)
+        known = (inv >= 0) & (ok >= 0)
+        idx = np.arange(n)
+        for j in range(start, n):
+            if known[j]:
+                # incoming rt: every txn that completed before j
+                # invoked (covers pairs among the new txns too)
+                for i in np.nonzero((ok < inv[j]) & known
+                                    & (idx != j))[0]:
+                    added.append(("rt", int(i), j))
+                # outgoing rt toward PRE-EXISTING txns (ok_j < inv_i
+                # cannot hold under WAL-ordered indices, but indices
+                # are caller-supplied — stay exact, not clever)
+                if start:
+                    for i in np.nonzero(
+                            (ok[j] < inv[:start]) & known[:start])[0]:
+                        added.append(("rt", j, int(i)))
+        self._ordered = n
+
+    # -- verdict inputs -----------------------------------------------------
+
+    def direct(self) -> dict:
+        """Current direct-anomaly map, exact for the fed prefix
+        (payloads match the one-shot `infer().direct` witnesses)."""
+        out: dict = {}
+        for k in sorted(self._key_cache, key=repr):
+            for name, payload in self._key_cache[k][0]:
+                out.setdefault(name, []).append(payload)
+        return out
+
+    def meta(self) -> dict:
+        return {"txn-count": self.n, "keys": len(self.touch),
+                "inflight": len(self.inflight),
+                "edges-live": len(self._edge_ref)}
+
+    # -- per-key recomputes (single-key transcriptions of the one-shot
+    #    passes; every flag payload is byte-compatible) ----------------------
+
+    def _recompute_append_key(self, k, indet):
+        flags: list = []
+        edges: set = set()
+        txns = self.txns
+
+        def flag(name, i, m, **kw):
+            flags.append((name, dict({"op": txns[i][self._DICT],
+                                      "mop": list(m)}, **kw)))
+
+        writer_of: dict = {}           # v -> txn index
+        appends: dict = {}             # txn index -> [v, ...] mop order
+        seq = self.touch.get(k, ())
+        for i in seq:
+            for m in self._mops(i):
+                if mop.is_append(m) and mop.key(m) == k:
+                    v = mop.value(m)
+                    if v in writer_of and writer_of[v] != i:
+                        flag("duplicate-elements", i, m,
+                             other=txns[writer_of[v]][self._DICT])
+                        continue
+                    writer_of[v] = i
+                    appends.setdefault(i, []).append(v)
+        reads: list = []
+        for i in seq:
+            for m in self._mops(i):
+                if mop.is_read(m) and mop.key(m) == k:
+                    s = mop.value(m)
+                    if s is None:
+                        s = []
+                    if not isinstance(s, (list, tuple)):
+                        continue
+                    reads.append((i, tuple(s), m))
+        order: tuple = ()
+        for i, s, m in reads:
+            if len(s) > len(order):
+                order = s
+        for i, s, m in reads:
+            bad = False
+            for v in s:
+                if (k, v) in self.failed:
+                    flag("G1a", i, m, kind="aborted")
+                    bad = True
+                    break
+                if writer_of.get(v) is None and (k, v) not in indet:
+                    flag("G1a", i, m, kind="garbage")
+                    bad = True
+                    break
+            if bad:
+                continue
+            seen = set(s)
+            for t, vs in appends.items():
+                if t == i or len(vs) < 2:
+                    continue
+                if any(v in seen for v in vs[:-1]) \
+                        and vs[-1] not in seen:
+                    flag("G1b", i, m, writer=txns[t][self._DICT])
+                    bad = True
+                    break
+            if bad:
+                continue
+            if tuple(order[:len(s)]) != tuple(s):
+                flag("incompatible-order", i, m, longest=list(order))
+                continue
+            for v in reversed(s):
+                w = writer_of.get(v)
+                if w is not None and w != i:
+                    edges.add(("wr", w, i))
+                    break
+            seen2 = set(s)
+            for t, vs in appends.items():
+                if t != i and not seen2.issuperset(vs):
+                    edges.add(("rw", i, t))
+        prev = None
+        for v in order:
+            w = writer_of.get(v)
+            if w is None:
+                continue
+            if prev is not None and prev != w:
+                edges.add(("ww", prev, w))
+            prev = w
+        if prev is not None:
+            observed = set(order)
+            for t, vs in appends.items():
+                if t != prev and not observed.issuperset(vs):
+                    edges.add(("ww", prev, t))
+        return flags, frozenset(edges)
+
+    def _recompute_register_key(self, k, indet):
+        flags: list = []
+        edges: set = set()
+        txns = self.txns
+
+        def flag(name, i, m, **kw):
+            flags.append((name, dict({"op": txns[i][self._DICT],
+                                      "mop": list(m)}, **kw)))
+
+        writer_of: dict = {}           # v -> txn of the FINAL write
+        intermediate: dict = {}        # v -> txn whose non-final write
+        finals: dict = {}              # txn index -> final value
+        seq = self.touch.get(k, ())
+        for i in seq:
+            last = _MISS
+            for m in self._mops(i):
+                if mop.is_write(m) and mop.key(m) == k:
+                    if last is not _MISS:
+                        intermediate[last] = i
+                    last = mop.value(m)
+            if last is _MISS:
+                continue
+            if last in writer_of and writer_of[last] != i:
+                flag("duplicate-elements", i, ["w", k, last],
+                     other=txns[writer_of[last]][self._DICT])
+                continue
+            writer_of[last] = i
+            finals[i] = last
+        clean_reads: list = []         # (txn, value read)
+        evidence: dict = {}            # u -> set of successor finals
+        for i in seq:
+            wrote = False
+            pre_read = _MISS
+            for m in self._mops(i):
+                if mop.key(m) != k:
+                    continue
+                if mop.is_write(m):
+                    wrote = True
+                    continue
+                if not mop.is_read(m) or wrote:
+                    continue
+                v = mop.value(m)
+                if isinstance(v, (list, dict, set)):
+                    continue
+                if v is not None:
+                    if (k, v) in self.failed:
+                        flag("G1a", i, m, kind="aborted")
+                        continue
+                    if v in intermediate:
+                        t = intermediate[v]
+                        if t != i:
+                            flag("G1b", i, m,
+                                 writer=txns[t][self._DICT])
+                            continue
+                    if writer_of.get(v) is None:
+                        if (k, v) not in indet:
+                            flag("G1a", i, m, kind="garbage")
+                        continue
+                clean_reads.append((i, v))
+                if pre_read is _MISS:
+                    pre_read = v
+            if i in finals and pre_read is not _MISS:
+                evidence.setdefault(pre_read, set()).add(finals[i])
+        succ = evidence
+        color: dict = {}
+        bad = False
+        for root in list(succ):
+            if color.get(root, 0):
+                continue
+            stack = [(root, iter(succ.get(root, ())))]
+            color[root] = 1
+            while stack and not bad:
+                u, it = stack[-1]
+                v = next(it, None)
+                if v is None:
+                    color[u] = 2
+                    stack.pop()
+                elif color.get(v, 0) == 1:
+                    bad = True
+                elif color.get(v, 0) == 0:
+                    color[v] = 1
+                    stack.append((v, iter(succ.get(v, ()))))
+            if bad:
+                break
+        if bad:
+            flag("cyclic-version-order", 0, ["r", k, None],
+                 key=repr(k))
+            succ = {}
+        for u, vs in succ.items():
+            wu = writer_of.get(u) if u is not None else None
+            for v in vs:
+                wv = writer_of.get(v)
+                if wu is not None and wv is not None and wu != wv:
+                    edges.add(("ww", wu, wv))
+        for i, v in clean_reads:
+            if v is not None:
+                w = writer_of.get(v)
+                if w is not None and w != i:
+                    edges.add(("wr", w, i))
+            for nxt in succ.get(v, ()):
+                wv = writer_of.get(nxt)
+                if wv is not None and wv != i:
+                    edges.add(("rw", i, wv))
+        return flags, frozenset(edges)
+
+    # -- checkpoint serialization (lease sidecar payload) --------------------
+
+    def to_state(self) -> dict:
+        """JSON-able checkpoint of the WHOLE incremental state —
+        caches and planes are derivable, so only the core facts ship:
+        committed txns, in-flight invokes, failed/indet write sets.
+        Raises TypeError/ValueError on non-JSON-able keys/values (the
+        caller skips the checkpoint; full replay stays correct)."""
+        import json
+        state = {"workload": self.workload, "v": 1,
+                 "txns": [[t[self._P], t[self._INV], t[self._OK],
+                           t[self._VAL], t[self._DICT]]
+                          for t in self.txns],
+                 "inflight": [[p, idx, val] for p, (idx, val)
+                              in self.inflight.items()],
+                 "failed": [list(kv) for kv in sorted(
+                     self.failed, key=repr)],
+                 "indet": [list(kv) for kv in sorted(
+                     self.indet_done, key=repr)]}
+        json.dumps(state)              # fail fast, not at write time
+        return state
+
+    @classmethod
+    def from_state(cls, state: dict) -> "IncrementalInference":
+        """Rebuild from a checkpoint: bookkeeping is reconstructed,
+        every key marked dirty — the first drain() re-emits the full
+        edge set, from which the consumer rebuilds planes + closure
+        cold (O(state), not O(WAL))."""
+        inc = cls(state["workload"])
+        for p, inv_i, ok_i, val, okd in state.get("txns") or []:
+            i = len(inc.txns)
+            inc.txns.append((p, int(inv_i), int(ok_i),
+                             list(val), okd))
+            inc._inv_idx.append(int(inv_i))
+            inc._ok_idx.append(int(ok_i))
+            for m in val:
+                if not mop.is_op(m):
+                    continue
+                k = mop.key(m)
+                seq = inc.touch.setdefault(k, [])
+                if not seq or seq[-1] != i:
+                    seq.append(i)
+            inc._last_by_proc[p] = i
+        # po chains replay from the rebuilt per-process order
+        by_proc: dict = {}
+        for i, t in enumerate(inc.txns):
+            by_proc.setdefault(t[cls._P], []).append(i)
+        for chain in by_proc.values():
+            inc._pending_po.extend(zip(chain, chain[1:]))
+        for p, idx, val in state.get("inflight") or []:
+            inc.inflight[p] = (int(idx), list(val))
+        inc.failed = {tuple(kv) for kv in state.get("failed") or []}
+        inc.indet_done = {tuple(kv)
+                          for kv in state.get("indet") or []}
+        inc._dirty = set(inc.touch)
+        return inc

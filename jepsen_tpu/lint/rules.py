@@ -421,7 +421,8 @@ def _rule_bare_fallback(ctx) -> list:
 # 16).  Any other module opening them for write is a fenced-bypass
 # bug waiting for a fault schedule to find it.
 
-_GUARDED_FILES = ("live.jsonl", "lease.json", "history.wal")
+_GUARDED_FILES = ("live.jsonl", "lease.json", "history.wal",
+                  "txn-state.json")
 _ALLOWED_WRITERS = ("live/scheduler.py", "live/lease.py",
                     "live/ingest.py", "history.py")
 _WRITE_ATTRS = frozenset({"write_text", "write_bytes"})

@@ -349,6 +349,22 @@ def _build_elle_mesh(plan, devices):
     return fn, args, {"n_pad": n_pad, "devices": len(devs)}
 
 
+def _build_elle_delta(plan, devices):
+    """The incremental (warm-seeded) closure kernel: 4 packed direct
+    planes + the previous 3-plane closure triple (ISSUE 18)."""
+    from jepsen_tpu.ops import elle_mesh
+    devs = tuple(devices)
+    tile = elle_mesh.mesh_tile(len(devs))
+    n_pad = int(plan.bucket[1]) if len(plan.bucket) > 1 else tile
+    if n_pad % tile:
+        n_pad = tile
+    fn, _mesh = elle_mesh._build_kernel(
+        n_pad, devs, elle_mesh._block_for(n_pad), warm=True)
+    args = [_sds((n_pad, n_pad // 32), "uint32") for _ in range(7)]
+    return fn, args, {"n_pad": n_pad, "devices": len(devs),
+                      "warm": True}
+
+
 def _build_deep_hc(plan, devices):
     from jepsen_tpu.ops import wgl_deep
     R = int(plan.bucket[1])
@@ -460,6 +476,7 @@ def register_builtin_traceables() -> None:
         return
     from jepsen_tpu.ops import planner
     planner.register_traceable("elle-mesh", _build_elle_mesh)
+    planner.register_traceable("elle-delta", _build_elle_delta)
     planner.register_traceable("wgl_deep_hc", _build_deep_hc)
     planner.register_traceable("wgl_deep", _build_deep)
     planner.register_traceable("wgl_deep_split", _build_deep)

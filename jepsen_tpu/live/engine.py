@@ -355,6 +355,52 @@ def check_batch(lanes: list, *, backend: str = "auto",
     return results
 
 
+def txn_classify(planes, n: int, *, closure=None, backend: str = "host",
+                 include_order: bool = True,
+                 dispatches: Optional[list] = None) -> tuple:
+    """One transactional tenant's incremental closure update (ISSUE
+    18): packed direct planes + the previous settled closure triple ->
+    (row, new_closure, engine).  backend "device" runs the warm
+    elle-delta mesh kernel and raises on failure; "host" is the dense
+    numpy twin (bit-identical verdicts and closures); "auto" tries the
+    device and falls back.  Each call is one dispatch — `dispatches`
+    collects the same metadata shape as `check_batch` buckets."""
+    from jepsen_tpu.ops import elle_mesh
+    if backend == "auto":
+        try:
+            return txn_classify(planes, n, closure=closure,
+                                backend="device",
+                                include_order=include_order,
+                                dispatches=dispatches)
+        except Exception:   # noqa: BLE001 - host path must be total
+            return txn_classify(planes, n, closure=closure,
+                                backend="host",
+                                include_order=include_order,
+                                dispatches=dispatches)
+    t0 = time.monotonic()
+    if backend == "device":
+        row, out_closure = elle_mesh.classify_packed_warm(
+            planes, n, closure=closure, include_order=include_order)
+        engine = "elle-delta"
+    else:
+        row, out_closure = elle_mesh.classify_host_warm(
+            planes, n, closure=closure, include_order=include_order)
+        engine = "elle-delta-host"
+    if dispatches is not None:
+        dispatches.append({
+            "bucket": [int(row.get("n_pad", 0)), 1],
+            "lanes": 1, "engine": engine,
+            "cache": "warm" if closure is not None else "cold",
+            "seconds": round(time.monotonic() - t0, 6)})
+    rec = telemetry.dispatch_record(
+        engine, why="live txn closure update",
+        cache="warm" if closure is not None else "cold",
+        lanes=1, bucket=[int(row.get("n_pad", 0)),
+                         int(row.get("shards", 0))])
+    telemetry.attach_dispatch([], rec)
+    return row, out_closure, engine
+
+
 def _verdict(plane, sopen, viol: int, engine: str, cache: str) -> dict:
     return {"valid?": viol < 0, "violated_event": int(viol),
             "plane": np.asarray(plane, bool),

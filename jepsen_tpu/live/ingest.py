@@ -523,6 +523,14 @@ class IngestServer:
         for k in kinds:
             sess.kinds[min(int(k), 5)] += 1
         sess.route_n += len(ops)
+        # transactional streams (ISSUE 18): count mop-list txn ops so
+        # /ingest and the conftest CI row can tell a remote tenant is
+        # feeding the incremental Elle tier, not a KV model
+        ntxn = sum(1 for op in ops if op.f == "txn"
+                   and isinstance(op.value, (list, tuple)))
+        if ntxn:
+            telemetry.REGISTRY.counter(
+                "live_ingest_txn_ops_total").inc(ntxn)
 
     @staticmethod
     def _route_native(ops: list, base_n: int):

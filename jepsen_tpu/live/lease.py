@@ -287,6 +287,100 @@ def check_fence(run_dir, mine: Lease) -> bool:
     return disk.owner == mine.owner and disk.epoch == mine.epoch
 
 
+# ---------------------------------------------------------------------------
+# Txn checkpoint sidecar (ISSUE 18)
+# ---------------------------------------------------------------------------
+#
+# A transactional tenant's incremental state is too large for the
+# lease's inline `state` slot (the lease is read on every fence check
+# and renewal).  It lives in a per-tenant sidecar file instead; the
+# lease carries only a small pointer {"txn": {"crc", "seq", "bytes"}}
+# paired with the safe cursor.  The sidecar is written with the same
+# fsync-before-rename discipline as every durable artifact here, and
+# verified by crc on restore: a torn/stale/missing sidecar restores
+# NOTHING — the caller falls back to full replay from the safe cursor
+# (lenient, never a silent wrong verdict).  Single-writer-under-lease:
+# only this module writes the sidecar (jlint's stray-writer guard).
+
+TXN_SIDECAR = "txn-state.json"
+
+
+def txn_sidecar_path(run_dir) -> Path:
+    return Path(run_dir) / TXN_SIDECAR
+
+
+def write_txn_sidecar(run_dir, payload: dict,
+                      seq: int = 0) -> Optional[dict]:
+    """Durably persist one txn checkpoint payload; returns the small
+    lease-pointer dict, or None when the payload won't serialize or
+    the write fails (the checkpoint is advisory — replay covers)."""
+    try:
+        data = json.dumps({"seq": int(seq), "state": payload},
+                          separators=(",", ":")).encode()
+    except (TypeError, ValueError):
+        return None
+    crc = zlib.crc32(data)
+    tmp = Path(run_dir) / (f".{TXN_SIDECAR}.{os.getpid()}."
+                           f"{next(_tmp_seq)}.tmp")
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, txn_sidecar_path(run_dir))
+    except OSError as e:
+        log.warning("txn sidecar write failed for %s: %s", run_dir, e)
+        return None
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return {"crc": crc, "seq": int(seq), "bytes": len(data)}
+
+
+def tear_txn_sidecar(run_dir, keep: float = 0.5) -> bool:
+    """Fault injection (campaigns / kill9 tests): truncate the sidecar
+    IN PLACE — no fsync, no rename, that is the fault being modeled.
+    The crc pointer must detect the tear and `read_txn_sidecar` must
+    return None, degrading the successor to full replay.  Returns True
+    when a sidecar existed to tear."""
+    p = txn_sidecar_path(run_dir)
+    try:
+        raw = p.read_bytes()
+    except OSError:
+        return False
+    try:
+        with open(p, "wb") as f:
+            f.write(raw[:max(0, int(len(raw) * keep))])
+    except OSError:
+        return False
+    return True
+
+
+def read_txn_sidecar(run_dir, pointer: dict) -> Optional[dict]:
+    """The checkpoint payload the lease pointer references, or None
+    for anything less than a byte-exact match (missing file, torn
+    write, crc mismatch, seq drift) — the full-replay trigger."""
+    if not isinstance(pointer, dict):
+        return None
+    try:
+        raw = txn_sidecar_path(run_dir).read_bytes()
+    except OSError:
+        return None
+    if zlib.crc32(raw) != pointer.get("crc"):
+        return None
+    try:
+        d = json.loads(raw)
+    except ValueError:
+        return None
+    if not isinstance(d, dict) \
+            or d.get("seq") != pointer.get("seq"):
+        return None
+    state = d.get("state")
+    return state if isinstance(state, dict) else None
+
+
 class LeaseObserver:
     """Monotonic expiry tracking for leases this worker does NOT own.
 

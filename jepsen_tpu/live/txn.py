@@ -1,0 +1,475 @@
+"""Transactional tenants for the serve-checker (ISSUE 18).
+
+A `TxnTenant` duck-types `live/windows.Tenant` for the scheduler
+(ingest / queue_depth / frontier_state / stats), but instead of
+demuxing KV ops into per-key model lanes it streams whole mop-list
+transactions through `elle/infer.IncrementalInference`:
+
+  feed (WAL order)  ->  drain edge DELTAS  ->  `set_bits`/`clear_bits`
+  on the packed uint32 planes  ->  warm closure update
+  (`ops/elle_mesh.classify_host_warm` / `classify_packed_warm`,
+  seeded from the previous settled (cww, p0, p1) triple)  ->  the
+  weakest-violated isolation level so far.
+
+Exactness contract: the incremental planes equal the one-shot
+`infer()` planes after every drain, and the warm closure equals the
+cold closure as long as every retraction since the last cold rebuild
+was *covered* (the delta's `rebuild` bit); an uncovered retraction
+drops the closure seed and the next window re-closes from the exact
+bit-cleared direct planes.  Either way the verdict is bit-identical
+to the post-hoc `checker/elle.py` answer for the fed prefix
+(tests/test_live_txn.py pins this differentially).
+
+Crash survival: the whole incremental state serializes through
+`live/lease.write_txn_sidecar` (fsync-before-rename, crc32-pointered
+from the lease `state` slot), so a fleet takeover resumes mid-stream
+from the checkpointed frontier; a torn/stale sidecar restores
+nothing and the scheduler full-replays from byte 0 — flags stay
+exactly-once because the successor de-dups against the journaled
+`live.jsonl` flags, exactly like window tenants."""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from jepsen_tpu import telemetry
+from jepsen_tpu import txn as mop
+from jepsen_tpu.elle import infer as infer_mod
+from jepsen_tpu.live import lease as lease_mod
+from jepsen_tpu.ops import elle_mesh
+
+# completion types mirrored from live/windows.py (no import cycle)
+INVOKE, OK, FAIL, INFO = "invoke", "ok", "fail", "info"
+
+# rough per-record accounting for the scheduler's byte budget
+_PENDING_COST_B = 256
+_TXN_COST_B = 320
+_EDGE_COST_B = 96
+
+
+class ElleIncremental:
+    """Model placeholder so `live-adopt` / `live.json` render a
+    meaningful model name for transactional tenants (they carry no
+    per-lane state model; the 'model' is the Elle inference)."""
+
+
+def sniff_txn_workload(ops) -> Optional[str]:
+    """Classify a WAL batch as a transactional stream: client ops
+    whose values are mop lists (`[[f, k, v], ...]`).  Returns the
+    workload name when at least one *write* mop decides it (append ->
+    list-append, w -> rw-register), `"auto"` when the batch is
+    txn-shaped but all-reads, None when this is not a txn stream."""
+    shaped = False
+    for op in ops:
+        v = getattr(op, "value", None)
+        if not isinstance(v, (list, tuple)) or isinstance(v, str) \
+                or not v:
+            continue
+        if not all(mop.is_op(m) for m in v):
+            continue
+        shaped = True
+        for m in v:
+            if mop.is_append(m):
+                return infer_mod.LIST_APPEND
+            if mop.is_write(m):
+                return infer_mod.RW_REGISTER
+    return "auto" if shaped else None
+
+
+class TxnTenant:
+    """One run dir checked transactionally.  The scheduler drives it
+    through the same verbs as a window tenant; `advance()` is the
+    dispatch step (fed from `LiveScheduler._dispatch_txn`)."""
+
+    is_txn = True
+
+    def __init__(self, name: str, ts: str, run_dir, *,
+                 workload: str = "auto", backend: str = "host",
+                 window_txns: int = 32, include_order: bool = True,
+                 max_flags: int = 64):
+        self.name = name
+        self.ts = ts
+        self.run_dir = Path(run_dir)
+        self.model = ElleIncremental()
+        self.workload = workload
+        self.backend = backend
+        self.window_txns = max(1, int(window_txns))
+        self.include_order = include_order
+        self.max_flags = max_flags
+        # scheduler-facing duck type (windows.Tenant contract)
+        self.lanes: dict = {}
+        self.open_by_process: dict = {}
+        self.offset = 0
+        self.seq = 0
+        self.safe_offset = 0
+        self.safe_seq = 0
+        self.safe_state: Optional[dict] = None
+        self.flags_emitted: set = set()
+        self.corrupt: Optional[str] = None
+        self.paused = False
+        self.done = False
+        self.ops_ingested = 0
+        self.skipped = 0
+        self._record_n = 0
+        # incremental verification state
+        self.inc: Optional[infer_mod.IncrementalInference] = None
+        self._pending: list = []       # (op, wall) awaiting feed
+        self._wall: dict = {}          # op index -> WAL append wall
+        self._wall_order: list = []    # pruning ring for _wall
+        self._planes: Optional[np.ndarray] = None   # [5, n_pad, W]
+        self._closure: Optional[np.ndarray] = None  # [3, n_pad, W]
+        self._n_pad = 0
+        self._need_classify = False
+        self._last_classify_n = 0
+        self.windows_checked = 0
+        self.last_wall: Optional[float] = None
+        self._found: set = set()       # anomaly names so far
+        self._weakest: Optional[str] = None
+        self._flag_records: list = []  # last emitted flags (live.json)
+        self.flags_capped = 0
+        self.closure_rebuilds = 0
+        self.resumed_txns = 0
+        self._last_engine: Optional[str] = None
+        self._last_rounds = 0
+        self._state_seq = 0            # bumps per fed batch
+        self._sidecar_ptr: Optional[dict] = None
+        self._sidecar_seq_written = -1
+
+    # -- ingest (scheduler verb) --------------------------------------------
+
+    def ingest(self, ops: list, walls: list) -> None:
+        """Buffer client ops in WAL order (cheap — the expensive feed
+        + classify happens in `advance`, the dispatch phase)."""
+        for op, wall in zip(ops, walls):
+            if op.index is None:
+                # same WAL-position synthesis as windows.Tenant: the
+                # run loop stamps indices at analyze time, not journal
+                # time, and flags must carry a real history index
+                op.index = self._record_n
+            self._record_n += 1
+            p = op.process
+            if type(p) is not int or p < 0:
+                self.skipped += 1      # nemesis / non-client actor
+                continue
+            if op.type == INVOKE:
+                self.ops_ingested += 1
+            self._pending.append((op, wall))
+            self.last_wall = wall
+
+    # -- advance (dispatch verb) --------------------------------------------
+
+    def _guess_workload(self) -> Optional[str]:
+        wl = sniff_txn_workload([op for op, _w in self._pending])
+        return None if wl == "auto" else wl
+
+    def advance(self, now: Optional[float] = None,
+                force: bool = False) -> dict:
+        """Feed buffered ops, then (when a window's worth of new txns
+        accumulated, or `force` at stream quiescence) drain the edge
+        delta into the packed planes and update the closure warm.
+
+        Returns {"flags": [...], "window": {...}|None}; flags are
+        PROPOSALS — the scheduler owns exactly-once emission (fencing
+        + `flags_emitted` de-dup)."""
+        out = {"flags": [], "window": None}
+        if self._pending:
+            if self.inc is None:
+                wl = self.workload if self.workload in (
+                    infer_mod.LIST_APPEND, infer_mod.RW_REGISTER) \
+                    else self._guess_workload()
+                if wl is None:
+                    # `force` fires every tick once the WAL backlog is
+                    # caught up, so it does NOT imply end-of-stream:
+                    # defaulting to rw-register on a paced stream whose
+                    # first window is read-only would lock in the wrong
+                    # inference for good.  Wait for a deciding write
+                    # mop; only a CLOSED stream that never wrote gets
+                    # the detect_workload default.
+                    if not (force and self.done):
+                        return out     # wait for a deciding write mop
+                    wl = infer_mod.RW_REGISTER  # detect_workload default
+                self.inc = infer_mod.IncrementalInference(wl)
+                self.workload = wl
+            for op, wall in self._pending:
+                self.inc.feed(op)
+                if isinstance(op.index, int):
+                    self._wall[op.index] = wall
+                    self._wall_order.append(op.index)
+            if len(self._wall_order) > 8192:
+                for idx in self._wall_order[:4096]:
+                    self._wall.pop(idx, None)
+                del self._wall_order[:4096]
+            self._pending.clear()
+            self._state_seq += 1
+            self._need_classify = True
+        if self.inc is None or not self._need_classify:
+            return out
+        if not force and (self.inc.n - self._last_classify_n) \
+                < self.window_txns:
+            return out
+        t0 = time.monotonic()
+        delta = self.inc.drain()
+        n = delta["n"]
+        self._apply_delta(delta)
+        if delta["rebuild"]:
+            self._closure = None
+            self.closure_rebuilds += 1
+            telemetry.REGISTRY.counter(
+                "live_txn_closure_rebuilds_total").inc()
+        from jepsen_tpu.live import engine as engine_mod
+        row, self._closure, engine = engine_mod.txn_classify(
+            self._planes, n, closure=self._closure,
+            backend=self.backend, include_order=self.include_order)
+        self._last_engine = engine
+        self._last_rounds = int(row.get("rounds", 0))
+        new_txns = n - self._last_classify_n
+        self._last_classify_n = n
+        self._need_classify = False
+        self.windows_checked += 1
+        out["flags"] = self._collect_flags(row)
+        found = set(self.inc.direct()) | set(row["anomalies"])
+        self._found = found
+        self._weakest = _weakest_violated(found)
+        out["window"] = {
+            "txns": n, "new_txns": new_txns,
+            "dirty_keys": delta["dirty_keys"],
+            "added": len(delta["added"]),
+            "removed": len(delta["removed"]),
+            "rebuild": bool(delta["rebuild"]),
+            "rounds": self._last_rounds, "engine": engine,
+            "n_pad": self._n_pad, "weakest": self._weakest,
+            "seconds": round(time.monotonic() - t0, 6)}
+        return out
+
+    def _apply_delta(self, delta: dict) -> None:
+        need = elle_mesh.pad_for_mesh(max(delta["n"], 1),
+                                      self._ndev())
+        if self._planes is None:
+            self._n_pad = need
+            self._planes = np.zeros(
+                (len(infer_mod.PLANES), need, need // 32), np.uint32)
+        elif need > self._n_pad:
+            self._planes = elle_mesh.grow_packed(self._planes, need)
+            if self._closure is not None:
+                self._closure = elle_mesh.grow_packed(
+                    self._closure, need)
+            self._n_pad = need
+        for bits, op in ((delta["added"], elle_mesh.set_bits),
+                         (delta["removed"], elle_mesh.clear_bits)):
+            by_plane: dict = {}
+            for pl, a, b in bits:
+                src, dst = by_plane.setdefault(pl, ([], []))
+                src.append(a)
+                dst.append(b)
+            for pl, (src, dst) in by_plane.items():
+                op(self._planes[infer_mod.PLANES.index(pl)], src, dst)
+
+    def _ndev(self) -> int:
+        if self.backend != "device":
+            return 1
+        try:
+            import jax
+            return max(1, len(jax.devices()))
+        except Exception:  # noqa: BLE001 - degrade to host sizing
+            self.backend = "host"
+            return 1
+
+    # -- flags ---------------------------------------------------------------
+
+    def _collect_flags(self, row: dict) -> list:
+        """Flag proposals for anomalies not yet journaled.  Direct
+        anomalies key on the witnessing txn's ok-op WAL index (one
+        flag per (anomaly, txn)); cycle classes key on the class
+        alone (op_index -1) — one flag per class per tenant."""
+        flags = []
+
+        def propose(name, op_index, value, wall):
+            if (f"txn:{name}", op_index) in self.flags_emitted:
+                return
+            if len(self.flags_emitted) + len(flags) >= self.max_flags:
+                self.flags_capped += 1
+                return
+            flags.append({
+                "lane": f"txn:{name}", "op_index": op_index,
+                "f": "txn", "value": value, "event": name,
+                "level": _level_of(name),
+                "wall": wall, "engine": self._last_engine})
+
+        for name, payloads in sorted(self.inc.direct().items()):
+            seen = set()
+            for p in payloads:
+                idx = p.get("op", {}).get("index")
+                idx = idx if isinstance(idx, int) else -1
+                if idx in seen:
+                    continue
+                seen.add(idx)
+                value = {k: v for k, v in p.items() if k != "op"}
+                propose(name, idx, value, self._wall.get(idx))
+        for cls, (a, b) in sorted(row["anomalies"].items()):
+            oka = self.inc.txns[a][self.inc._OK] \
+                if a < self.inc.n else -1
+            okb = self.inc.txns[b][self.inc._OK] \
+                if b < self.inc.n else -1
+            propose(cls, -1,
+                    {"edge": [a, b], "ok_ops": [oka, okb]},
+                    self._wall.get(okb))
+        return flags
+
+    def record_flag(self, flag: dict) -> None:
+        """Bounded emitted-flag summaries for live.json / /live."""
+        self._flag_records.append(
+            {"key": "txn", "f": flag.get("event"),
+             "op_index": flag.get("op_index"),
+             "level": flag.get("level"),
+             "value": flag.get("value")})
+        del self._flag_records[:-20]
+
+    # -- frontier capture / restore (fleet handoff) --------------------------
+
+    def frontier_state(self) -> Optional[dict]:
+        """Checkpoint the WHOLE incremental state into the run dir's
+        txn sidecar (fsync-before-rename) and return the small
+        crc32-pointer that rides the lease `state` slot.  Called by
+        the scheduler only at fully quiescent points, so the state
+        pairs exactly with the safe cursor recorded beside it."""
+        if self.inc is None:
+            return None
+        if self._sidecar_seq_written == self._state_seq \
+                and self._sidecar_ptr is not None:
+            return {"txn": self._sidecar_ptr}
+        try:
+            payload = self.inc.to_state()
+        except ValueError:
+            return None
+        ptr = lease_mod.write_txn_sidecar(self.run_dir, payload,
+                                          seq=self._state_seq)
+        if ptr is None:
+            return None
+        self._sidecar_ptr = ptr
+        self._sidecar_seq_written = self._state_seq
+        telemetry.REGISTRY.counter(
+            "live_txn_checkpoints_total").inc()
+        return {"txn": ptr}
+
+    def restore_frontier(self, state: dict) -> int:
+        """Resume from a lease-carried sidecar pointer.  Returns >0 on
+        an exact restore (the scheduler then resumes the cursor), 0
+        when the sidecar is torn/stale/missing — the scheduler
+        full-replays from byte 0 instead, which can only cost time
+        (flags de-dup against live.jsonl), never a wrong verdict."""
+        ptr = state.get("txn") if isinstance(state, dict) else None
+        if not isinstance(ptr, dict):
+            return 0
+        payload = lease_mod.read_txn_sidecar(self.run_dir, ptr)
+        if payload is None:
+            telemetry.REGISTRY.counter(
+                "live_txn_torn_checkpoints_total").inc()
+            return 0
+        try:
+            self.inc = infer_mod.IncrementalInference.from_state(
+                payload)
+        except Exception:  # noqa: BLE001 - torn state = full replay
+            telemetry.REGISTRY.counter(
+                "live_txn_torn_checkpoints_total").inc()
+            return 0
+        self.workload = self.inc.workload
+        self._need_classify = True
+        self.resumed_txns = self.inc.n
+        # resume the checkpoint sequence past what the sidecar holds
+        # so the next capture can never collide with a stale one
+        self._state_seq = int(ptr.get("seq", 0)) + 1
+        telemetry.REGISTRY.counter("live_txn_resumes_total").inc()
+        return 1 + self.inc.n
+
+    # -- aggregates (scheduler duck type) ------------------------------------
+
+    @property
+    def pending_ops(self) -> int:
+        return len(self._pending)
+
+    @property
+    def need_classify(self) -> bool:
+        return self._need_classify
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending) + (1 if self._need_classify else 0)
+
+    @property
+    def nbytes(self) -> int:
+        total = len(self._pending) * _PENDING_COST_B
+        if self._planes is not None:
+            total += self._planes.nbytes
+        if self._closure is not None:
+            total += self._closure.nbytes
+        if self.inc is not None:
+            total += self.inc.n * _TXN_COST_B
+            total += len(self.inc._edge_ref) * _EDGE_COST_B
+        return total
+
+    @property
+    def flags(self) -> list:
+        return list(self._flag_records)
+
+    @property
+    def saturated(self) -> dict:
+        return {}
+
+    @property
+    def verdict_so_far(self):
+        if self._found or self.flags_emitted:
+            return False
+        if self.corrupt:
+            return "unknown"
+        return True
+
+    def stats(self) -> dict:
+        inc = self.inc
+        return {
+            "verdict-so-far": self.verdict_so_far,
+            "ops_ingested": self.ops_ingested,
+            "ops_checked": inc.n if inc is not None else 0,
+            "windows_checked": self.windows_checked,
+            "lanes": 0,
+            "queue_depth": self.queue_depth,
+            "bytes": self.nbytes,
+            "evictions": 0,
+            "evict_reasons": [],
+            "span_reads": 0,
+            "flags": self.flags,
+            "saturated": {},
+            "paused": self.paused,
+            "corrupt": self.corrupt,
+            "done": self.done,
+            "offset": self.offset,
+            "txn": {
+                "workload": self.workload,
+                "txns": inc.n if inc is not None else 0,
+                "keys": len(inc.touch) if inc is not None else 0,
+                "inflight": len(inc.inflight)
+                if inc is not None else 0,
+                "weakest-violated": self._weakest,
+                "anomalies": sorted(self._found),
+                "windows": self.windows_checked,
+                "closure_rebuilds": self.closure_rebuilds,
+                "resumed_txns": self.resumed_txns,
+                "flags_capped": self.flags_capped,
+                "engine": self._last_engine,
+                "rounds": self._last_rounds,
+                "n_pad": self._n_pad,
+            },
+        }
+
+
+def _level_of(name: str) -> Optional[str]:
+    from jepsen_tpu.checker import elle as elle_checker
+    return elle_checker.ANOMALY_LEVEL.get(name)
+
+
+def _weakest_violated(found) -> Optional[str]:
+    from jepsen_tpu.checker import elle as elle_checker
+    return elle_checker.weakest_violated(found)

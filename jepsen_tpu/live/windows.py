@@ -676,6 +676,11 @@ class LaneState:
 class Tenant:
     """One followed run: cursor state + its lanes."""
 
+    # transactional tenants (live/txn.TxnTenant) duck-type this class
+    # for the scheduler; the flag lets shared paths branch without an
+    # isinstance import cycle
+    is_txn = False
+
     def __init__(self, name: str, ts: str, run_dir, model, *,
                  bits: int = 6, max_states: int = 64,
                  max_window_events: int = 256,

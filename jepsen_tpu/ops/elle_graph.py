@@ -214,6 +214,33 @@ def _mm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return a.astype(np.float32) @ b.astype(np.float32) > 0
 
 
+def closure_reference(stack: np.ndarray,
+                      include_order: bool = True) -> tuple:
+    """Cold pair-closure triple (cww, p0, p1) of one dense
+    [len(PLANES), n, n] bool stack, computed to the unconditional
+    fixpoint with the mesh kernel's exact update rule — the oracle the
+    incremental tier's warm-started closures are pinned against
+    (tests/test_live_txn.py): a warm closure over any covered-removal
+    history must equal this, square for square."""
+    ww, wr, rw, po, rt = (np.asarray(stack[i], bool)
+                          for i in range(len(PLANES)))
+    n = ww.shape[-1]
+    order = (po | rt) if include_order else np.zeros_like(ww)
+    eye = np.eye(n, dtype=bool)
+    cww = ww | order
+    p0 = ww | wr | order | eye
+    p1 = rw.copy()
+    while True:
+        q = p0 | p1
+        cww2 = cww | _mm(cww, cww)
+        p0n = p0 | _mm(p0, p0)
+        p1n = p1 | _mm(q, p1) | _mm(p1, q)
+        if (np.array_equal(cww2, cww) and np.array_equal(p0n, p0)
+                and np.array_equal(p1n, p1)):
+            return cww, p0, p1
+        cww, p0, p1 = cww2, p0n, p1n
+
+
 class _HostDeadline(Exception):
     pass
 

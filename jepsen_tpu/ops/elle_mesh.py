@@ -155,6 +155,41 @@ def set_bits(plane: np.ndarray, src, dst) -> None:
     np.bitwise_or.at(plane, (src, dst >> 5), masks)
 
 
+def clear_bits(plane: np.ndarray, src, dst) -> None:
+    """Sparse edge RETRACTION from one packed plane — the inverse of
+    `set_bits`, for the incremental tier's covered-removal deltas
+    (elle/infer.IncrementalInference): plane[src, dst//32] &=
+    ~(1 << (dst%32)).  Pure numpy (bitwise_and.at over raveled word
+    indices, the same flat-index trick set_bits' fallback uses);
+    retractions are orders of magnitude rarer than insertions, so the
+    native OR path has no AND twin."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    if not len(src):
+        return
+    W = plane.shape[-1]
+    masks = ~(np.uint32(1) << (dst & 31).astype(np.uint32))
+    if plane.flags.c_contiguous:
+        words = src * np.int64(W) + (dst >> 5)
+        np.bitwise_and.at(plane.reshape(-1), words, masks)
+        return
+    np.bitwise_and.at(plane, (src, dst >> 5), masks)
+
+
+def grow_packed(packed: np.ndarray, n_pad: int) -> np.ndarray:
+    """Re-pad a packed plane stack [..., rows, W] to a larger n_pad
+    (row AND word growth — the packed layout is word-aligned, so the
+    old words copy verbatim into the top-left corner)."""
+    old_rows, old_w = packed.shape[-2], packed.shape[-1]
+    if n_pad < old_rows:
+        raise ValueError(f"cannot shrink packed planes "
+                         f"{old_rows} -> {n_pad}")
+    out = np.zeros(packed.shape[:-2] + (n_pad, n_pad // 32),
+                   np.uint32)
+    out[..., :old_rows, :old_w] = packed
+    return out
+
+
 def _packext():
     """The native ingest extension, honoring the pack-threads knob
     (JEPSEN_TPU_PACK_THREADS=0 pins the pure-numpy twins)."""
@@ -248,9 +283,18 @@ def _device_fns(n_pad: int, block: int):
 
     return unpack, pack, pmm
 
-def _build_kernel(n_pad: int, devs: tuple, block: int):
+def _build_kernel(n_pad: int, devs: tuple, block: int,
+                  warm: bool = False):
     """One compiled shard_map program: packed pair closure with early
-    exit + class masks + per-device defining-edge picks."""
+    exit + class masks + per-device defining-edge picks.
+
+    With `warm` (the incremental tier, ISSUE 18) the program takes the
+    previous closure triple (cww, p0, p1) as three extra row-sharded
+    operands seeding the while_loop, and returns the settled triple
+    alongside the verdict.  The state is monotone, so the same
+    early-exit psum that proves cold convergence proves warm
+    convergence — a delta that extends the frontier by a short path
+    settles in ~log2(delta diameter) rounds, not log2(n)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec
@@ -295,7 +339,7 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
         return (found, (a0 + al).astype(jnp.int32),
                 (wi * 32 + bit).astype(jnp.int32))
 
-    def body(ww, wr, rw, od):
+    def body(ww, wr, rw, od, *seed):
         idx = jax.lax.axis_index("rows")
         a0 = idx * m
         rows_idx = a0 + jnp.arange(m)
@@ -323,9 +367,16 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
             done = frontier_settled(ch, "rows")
             return cww2, p0n, p1n, rounds + 1, done
 
+        init = (ww | od, base | eye, rw)
+        if warm:
+            # OR the previous closure under the fresh direct planes:
+            # the union's closure equals the exact closure as long as
+            # every retraction since the last cold rebuild was covered
+            # (elle/infer.IncrementalInference's rebuild contract)
+            init = (init[0] | seed[0], init[1] | seed[1],
+                    init[2] | seed[2])
         cww, p0, p1, rounds, _ = jax.lax.while_loop(
-            cond, round_, (ww | od, base | eye, rw,
-                           jnp.int32(0), jnp.bool_(False)))
+            cond, round_, init + (jnp.int32(0), jnp.bool_(False)))
 
         t_cww = tpose(gather(cww), a0)
         t_p0 = tpose(gather(p0), a0)
@@ -339,27 +390,32 @@ def _build_kernel(n_pad: int, devs: tuple, block: int):
             f, a, b = pick(mk, a0)
             flags.append(f)
             edges.append(jnp.stack([a, b]))
-        return (jnp.stack(flags)[None], jnp.stack(edges)[None],
-                rounds.reshape(1))
+        out = (jnp.stack(flags)[None], jnp.stack(edges)[None],
+               rounds.reshape(1))
+        if warm:
+            out += (cww, p0, p1)
+        return out
 
     mesh = Mesh(np.array(list(devs)), ("rows",))
     spec = PartitionSpec("rows")
     fn = shard_map_compat(
-        body, mesh=mesh, in_specs=(spec,) * 4,
-        out_specs=(spec, spec, spec))
+        body, mesh=mesh, in_specs=(spec,) * (7 if warm else 4),
+        out_specs=(spec, spec, spec) + ((spec,) * 3 if warm else ()))
     return jax.jit(fn), mesh
 
-def _kernel(n_pad: int, devs: tuple):
-    """Compiled-plan cache over (n_pad, devices, block) shape buckets,
-    hit/miss counted (the mesh-path analogue of the dense engine's
-    kernel-bucket counters)."""
+def _kernel(n_pad: int, devs: tuple, warm: bool = False):
+    """Compiled-plan cache over (n_pad, devices, block, warm) shape
+    buckets, hit/miss counted (the mesh-path analogue of the dense
+    engine's kernel-bucket counters)."""
     block = _block_for(n_pad)
-    key = (n_pad, devs, block)
+    key = (n_pad, devs, block, "warm") if warm \
+        else (n_pad, devs, block)
     hit = key in _PLAN_CACHE
     if hit:
         _PLAN_STATS["hits"] += 1
     else:
-        _PLAN_CACHE[key] = _build_kernel(n_pad, devs, block)
+        _PLAN_CACHE[key] = _build_kernel(n_pad, devs, block,
+                                         warm=warm)
         _PLAN_STATS["misses"] += 1
     try:
         from jepsen_tpu import telemetry
@@ -431,6 +487,126 @@ def classify_packed(packed_stacks: Sequence[np.ndarray],
         out.append({"anomalies": found, "n": int(n), "n_pad": n_pad,
                     "rounds": int(rounds[0]), "shards": n_dev})
     return out
+
+CLOSURE_PLANES = 3                     # (cww, p0, p1)
+
+
+def empty_closure(n_pad: int) -> np.ndarray:
+    """A cold closure seed: the warm entry points treat all-zeros as
+    'start from the direct planes alone'."""
+    return np.zeros((CLOSURE_PLANES, n_pad, n_pad // 32), np.uint32)
+
+
+def classify_packed_warm(packed_stack: np.ndarray, n: int,
+                         closure: Optional[np.ndarray] = None,
+                         include_order: bool = True,
+                         devices=None,
+                         max_devices: Optional[int] = None) -> tuple:
+    """Incremental classify on the device mesh: one history's packed
+    planes plus the PREVIOUS settled closure triple ([3, n_pad, W]
+    uint32, or None for a cold start).  The while_loop seeds from the
+    old closure OR'd under the current direct planes, so the delta's
+    frontier-product rounds are all that run (monotone state — the
+    early-exit psum proves convergence exactly as in the cold path).
+    Returns (row, closure) where `row` matches `classify_packed` rows
+    and `closure` is the settled triple to seed the next window."""
+    import jax
+
+    devs = _devices(devices, max_devices)
+    packed = np.asarray(packed_stack, np.uint32)
+    n_pad = packed.shape[-2]
+    n_dev = len(devs)
+    if n_pad % mesh_tile(n_dev):
+        raise ValueError(
+            f"n_pad={n_pad} not a multiple of mesh_tile({n_dev})="
+            f"{mesh_tile(n_dev)}; pad with pad_for_mesh")
+    if closure is None:
+        closure = empty_closure(n_pad)
+    closure = np.asarray(closure, np.uint32)
+    if closure.shape[-2] != n_pad:
+        closure = grow_packed(closure, n_pad)
+    fn, mesh = _kernel(n_pad, tuple(devs), warm=True)
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(mesh, PartitionSpec("rows"))
+    ww, wr, rw = (jax.device_put(packed[i], sh) for i in range(3))
+    if include_order:
+        od = jax.device_put(packed[3] | packed[4], sh)
+    else:
+        od = jax.device_put(np.zeros_like(packed[0]), sh)
+    c0, q0, r0 = (jax.device_put(closure[i], sh) for i in range(3))
+    flags, edges, rounds, cww, p0, p1 = fn(ww, wr, rw, od, c0, q0, r0)
+    flags, edges, rounds = (np.asarray(x)
+                            for x in (flags, edges, rounds))
+    found: dict = {}
+    for c, cls in enumerate(ANOMALY_CLASSES):
+        hits = np.nonzero(flags[:, c])[0]
+        if len(hits):
+            d = int(hits[0])
+            found[cls] = (int(edges[d, c, 0]), int(edges[d, c, 1]))
+    row = {"anomalies": found, "n": int(n), "n_pad": n_pad,
+           "rounds": int(rounds[0]), "shards": n_dev}
+    out_closure = np.stack([np.asarray(cww), np.asarray(p0),
+                            np.asarray(p1)]).astype(np.uint32)
+    return row, out_closure
+
+
+def classify_host_warm(packed_stack: np.ndarray, n: int,
+                       closure: Optional[np.ndarray] = None,
+                       include_order: bool = True) -> tuple:
+    """Numpy twin of `classify_packed_warm` — same update rule, same
+    early exit, same masks, same lowest-row-major defining-edge pick,
+    so verdicts and closures interchange with the device path
+    bit-for-bit (the live txn tenants' default engine; dense float32
+    matmuls are exact 0/1 counts below 2^24)."""
+    packed = np.asarray(packed_stack, np.uint32)
+    n_pad = packed.shape[-2]
+    if n_pad == 0:
+        return ({"anomalies": {}, "n": 0, "n_pad": 0, "rounds": 0,
+                 "shards": 0}, empty_closure(0))
+    dense = [unpack_bits(packed[i], n_pad) for i in range(len(PLANES))]
+    ww, wr, rw = dense[:3]
+    od = (dense[3] | dense[4]) if include_order \
+        else np.zeros_like(ww)
+    base = ww | wr | od
+    eye = np.eye(n_pad, dtype=bool)
+    cww = ww | od
+    p0 = base | eye
+    p1 = rw.copy()
+    if closure is not None and closure.shape[-2]:
+        closure = np.asarray(closure, np.uint32)
+        if closure.shape[-2] != n_pad:
+            closure = grow_packed(closure, n_pad)
+        cww |= unpack_bits(closure[0], n_pad)
+        p0 |= unpack_bits(closure[1], n_pad)
+        p1 |= unpack_bits(closure[2], n_pad)
+
+    def bmm(a, b):
+        return (a.astype(np.float32) @ b.astype(np.float32)) > 0.5
+
+    steps = max(1, math.ceil(math.log2(max(n_pad - 1, 2))))
+    rounds = 0
+    done = False
+    while not done and rounds < steps:
+        q = p0 | p1
+        cww2 = cww | bmm(cww, cww)
+        p0n = p0 | bmm(p0, p0)
+        p1n = p1 | bmm(q, p1) | bmm(p1, q)
+        done = (np.array_equal(cww2, cww) and np.array_equal(p0n, p0)
+                and np.array_equal(p1n, p1))
+        cww, p0, p1 = cww2, p0n, p1n
+        rounds += 1
+    masks = (ww & cww.T, wr & p0.T, rw & p0.T, rw & p1.T & ~p0.T)
+    found: dict = {}
+    for cls, mk in zip(ANOMALY_CLASSES, masks):
+        if mk.any():
+            a, b = np.unravel_index(int(np.argmax(mk)), mk.shape)
+            found[cls] = (int(a), int(b))
+    row = {"anomalies": found, "n": int(n), "n_pad": n_pad,
+           "rounds": rounds, "shards": 0}
+    out_closure = np.stack([pack_bits(cww), pack_bits(p0),
+                            pack_bits(p1)]).astype(np.uint32)
+    return row, out_closure
+
 
 def classify_mesh(stacks: Sequence[np.ndarray],
                   include_order: bool = True,

@@ -695,6 +695,30 @@ def plan_live(lanes: int, events: int, bits: int, states: int,
                 bucket=bucket)
 
 
+def plan_live_txn(n_pad: int, devices: int = 1,
+                  backend: str = "host",
+                  env: Optional[dict] = None) -> Plan:
+    """Shape-bucketed plan for one live TRANSACTIONAL tenant's warm
+    closure update (ISSUE 18): the incremental delta kernel
+    (elle_mesh's warm-seeded pair closure) compiled per padded plane
+    size, with the numpy warm twin as the unconditional fallback.  The
+    bucket keys the compiled-plan cache AND the static trace audit
+    (lint/trace_audit registers the `elle-delta` builder)."""
+    del env
+    n_pad = max(int(n_pad), 1)
+    devices = max(int(devices), 1)
+    if backend == "device":
+        chain = ["elle-delta", "elle-delta-host"]
+        why = (f"warm-seeded mesh closure over {devices} devices "
+               f"(n_pad={n_pad})")
+    else:
+        chain = ["elle-delta-host"]
+        why = f"numpy warm closure twin (n_pad={n_pad})"
+    bucket = ("elle-delta", n_pad, devices)
+    return Plan(engine=chain[0], fallbacks=tuple(chain[1:]), why=why,
+                bucket=bucket)
+
+
 def runner_plan(engine_name: str, fallback_name: str = "wgl_cpu",
                 why: str = "resilient-runner degradation") -> Plan:
     """The ResilientRunner's own plan for verdicts IT produced

@@ -287,12 +287,16 @@ def live_index_html() -> bytes:
             except (OSError, json.JSONDecodeError):
                 continue
             v = lj.get("verdict-so-far")
+            txn = lj.get("txn") or {}
+            weakest = txn.get("weakest-violated")
             rows.append(
                 f"<tr style='background:{_live_color(v)}'>"
                 f"<td>{html.escape(name)}</td>"
                 f"<td><a href='/live/{quote(name)}/{quote(ts)}'>"
                 f"{html.escape(ts)}</a></td>"
                 f"<td>{html.escape(json.dumps(v))}</td>"
+                f"<td>{html.escape(weakest) if weakest else '&mdash;'}"
+                "</td>"
                 f"<td>{lj.get('ops_checked', 0)}</td>"
                 f"<td>{lj.get('windows_checked', 0)}</td>"
                 f"<td>{len(lj.get('flags') or [])}</td>"
@@ -302,7 +306,8 @@ def live_index_html() -> bytes:
             "<p><a href='/'>&larr; tests</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Run</th>"
-            "<th>Verdict so far</th><th>Ops checked</th>"
+            "<th>Verdict so far</th><th>Weakest violated</th>"
+            "<th>Ops checked</th>"
             "<th>Windows</th><th>Flags</th><th>Done?</th></tr>"
             + "".join(rows) + "</table>")
     if not rows:
@@ -330,6 +335,23 @@ def live_run_html(name: str, ts: str) -> bytes:
             f"<b>verdict so far: {html.escape(json.dumps(v))}</b> "
             f"({'run complete' if lj.get('done') else 'still tailing'}"
             ")</p>"]
+    txn = lj.get("txn") or {}
+    if txn:
+        weakest = txn.get("weakest-violated")
+        body.append(
+            "<h2>Transactional (incremental Elle)</h2>"
+            f"<p><b>weakest violated level so far: "
+            f"{html.escape(weakest) if weakest else 'none (clean)'}"
+            "</b></p><table>"
+            + "".join(
+                f"<tr><th>{html.escape(k)}</th>"
+                f"<td>{html.escape(json.dumps(txn.get(k), default=repr))}"
+                "</td></tr>"
+                for k in ("workload", "txns", "keys", "anomalies",
+                          "windows", "closure_rebuilds",
+                          "resumed_txns", "engine", "rounds",
+                          "n_pad", "flags_capped"))
+            + "</table>")
     body.append(
         "<table>"
         + "".join(f"<tr><th>{html.escape(k)}</th>"

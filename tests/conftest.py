@@ -34,6 +34,10 @@ _ci_failed: list = []
 def pytest_sessionstart(session):
     import time as _time
     _ci_t0.append(_time.monotonic())
+    # the bench-baseline ratchet (ISSUE 18, tests/test_zz_ratchet.py)
+    # reads the session start through the environment: the test file
+    # cannot import this conftest by module name under rootdir layouts
+    os.environ["JEPSEN_TPU_T1_T0"] = repr(_time.monotonic())
 
 
 def pytest_runtest_logreport(report):
@@ -186,6 +190,49 @@ def _ingest_summary():
         return None
 
 
+def _live_txn_summary():
+    """The incremental transactional tier's counters (ISSUE 18):
+    txn tenants constructed, windows classified, txns drained, flags
+    by isolation level, closure rebuilds, checkpoints written / found
+    torn, and checkpointed-frontier resumes — recorded so a
+    regression that silently stops exercising the streaming Elle path
+    (no windows in a green suite), weakens checkpointing (resumes
+    drop to 0 while the kill9 battery passes vacuously), or changes
+    the rebuild/torn mix diffs across PRs.  Counts cover THIS process
+    only; kill9 subprocess workers keep their own registries.  None
+    when no txn tenant ran this session."""
+    try:
+        from jepsen_tpu import telemetry
+        coll = telemetry.REGISTRY.collect()
+
+        def total(name):
+            _k, by_label = coll.get(name, (None, {}))
+            return int(sum(m.value for m in by_label.values())) \
+                if by_label else 0
+
+        tenants = total("live_txn_tenants_total")
+        if not tenants:
+            return None
+        _k, by_level = coll.get("live_txn_levels_total", (None, {}))
+        levels = {}
+        for key, m in (by_level or {}).items():
+            lv = dict(key).get("level", "?")
+            levels[lv] = levels.get(lv, 0) + int(m.value)
+        return {"tenants": tenants,
+                "windows": total("live_txn_windows_total"),
+                "txns": total("live_txn_txns_total"),
+                "flags": total("live_txn_flags_total"),
+                "levels": levels,
+                "closure_rebuilds":
+                    total("live_txn_closure_rebuilds_total"),
+                "checkpoints": total("live_txn_checkpoints_total"),
+                "torn_checkpoints":
+                    total("live_txn_torn_checkpoints_total"),
+                "resumes": total("live_txn_resumes_total")}
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _campaign_summary():
     """The tier-1 smoke campaign's counters (ISSUE 13):
     run/novel/deduped/quarantined schedule counts from the registry —
@@ -245,6 +292,7 @@ def pytest_sessionfinish(session, exitstatus):
             "pack_backend": _pack_backend(),
             "campaign": _campaign_summary(),
             "fleet": _fleet_summary(),
+            "live_txn": _live_txn_summary(),
             "ingest": _ingest_summary(),
             "lint": _lint_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}

@@ -1,0 +1,529 @@
+"""Live transactional verification tests (ISSUE 18): the incremental
+Elle tier.  The exactness contract is the whole point — streaming
+window-by-window classification must be BIT-IDENTICAL to the one-shot
+`elle/infer` + `elle_mesh` verdict (same packed planes, same direct
+flags, same cycle anomalies, same closure words) on clean, planted,
+and crashed streams — plus the txn sidecar checkpoint (crc round-trip,
+torn-tear degradation), workload sniffing, the elle-delta planner
+bucket, and the in-process takeover-resume / torn-replay scenarios.
+The subprocess kill9 twins live in tests/test_txn_fleet.py."""
+
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.checker import elle as chk_elle
+from jepsen_tpu.elle import infer as inf
+from jepsen_tpu.history import HistoryWAL, Op
+from jepsen_tpu.live import lease as lease_mod
+from jepsen_tpu.live.scheduler import LiveScheduler
+from jepsen_tpu.live.txn import TxnTenant, sniff_txn_workload
+from jepsen_tpu.ops import elle_graph as eg
+from jepsen_tpu.ops import elle_mesh as em
+
+
+# ---------------------------------------------------------------------------
+# history generators
+# ---------------------------------------------------------------------------
+
+def gen_history(rng, n_proc=4, n_keys=3, n_txn=40,
+                workload="list-append", crash=False):
+    """Random mop-list history as Op records in WAL order: committed
+    reads reflect sequential state, a tail of ok/fail/info mixes, and
+    (crash=True) dangling invokes left open at the end."""
+    ops = []
+    idx = 0
+    busy = {}
+    reads: dict = {}
+    counters = {k: 0 for k in range(n_keys)}
+    for _ in range(n_txn):
+        p = rng.randrange(n_proc)
+        if p in busy:
+            _inv_i, val = busy.pop(p)
+            r = rng.random()
+            if r < 0.75:
+                done = []
+                for f, k, v in val:
+                    if f == "r":
+                        done.append(["r", k, list(reads.get(k, []))])
+                    else:
+                        done.append([f, k, v])
+                        if f == "append":
+                            reads.setdefault(k, []).append(v)
+                        else:
+                            reads[k] = [v]
+                ops.append(Op(process=p, type="ok", f="txn",
+                              value=done, index=idx))
+            elif r < 0.9:
+                ops.append(Op(process=p, type="fail", f="txn",
+                              value=val, index=idx))
+            else:
+                ops.append(Op(process=p, type="info", f="txn",
+                              value=val, index=idx))
+            idx += 1
+        nm = rng.randrange(1, 4)
+        val = []
+        for _ in range(nm):
+            k = rng.randrange(n_keys)
+            wf = "append" if workload == "list-append" else "w"
+            if rng.random() < 0.5:
+                counters[k] += 1
+                val.append([wf, k, counters[k]])
+            else:
+                val.append(["r", k, None])
+        ops.append(Op(process=p, type="invoke", f="txn", value=val,
+                      index=idx))
+        idx += 1
+        busy[p] = (idx - 1, val)
+    if not crash:
+        for p, (_inv_i, val) in list(busy.items()):
+            done = []
+            for f, k, v in val:
+                if f == "r":
+                    done.append(["r", k, list(reads.get(k, []))])
+                else:
+                    done.append([f, k, v])
+            ops.append(Op(process=p, type="ok", f="txn", value=done,
+                          index=idx))
+            idx += 1
+    return ops
+
+
+def g_single_ops(start_index=0, key_z=5, key_y=8):
+    """The planted G-single pair: Tb commits (z<-2, y<-1); Ta reads z
+    seeing Tb (wr Tb->Ta) but reads y empty (rw Ta->Tb) — a cycle
+    with exactly one rw edge."""
+    i = [start_index]
+    out = []
+
+    def emit(p, vin, vok):
+        out.append(Op(process=p, type="invoke", f="txn", value=vin,
+                      index=i[0]))
+        i[0] += 1
+        out.append(Op(process=p, type="ok", f="txn", value=vok,
+                      index=i[0]))
+        i[0] += 1
+
+    emit(2, [["append", key_z, 1]], [["append", key_z, 1]])
+    emit(2, [["append", key_z, 2], ["append", key_y, 1]],
+         [["append", key_z, 2], ["append", key_y, 1]])
+    emit(0, [["r", key_z, None], ["r", key_y, None]],
+         [["r", key_z, [1, 2]], ["r", key_y, []]])
+    return out
+
+
+def write_wal(run_dir, ops):
+    run_dir.mkdir(parents=True, exist_ok=True)
+    wal = HistoryWAL(run_dir / "history.wal", fsync=False)
+    for o in ops:
+        wal.append(o)
+    wal.close()
+
+
+# ---------------------------------------------------------------------------
+# the differential sweep (the acceptance battery)
+# ---------------------------------------------------------------------------
+
+class TestIncrementalDifferential:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_windowed_matches_one_shot(self, seed):
+        """Incremental feed/drain applied window-by-window through
+        set_bits/clear_bits + warm closure must reproduce the
+        one-shot pipeline exactly: packed planes, direct flags, cycle
+        anomalies, weakest level, AND the closure words against the
+        dense numpy oracle.  Workloads alternate; every 5th stream
+        ends crashed (dangling invokes)."""
+        rng = random.Random(seed)
+        wl = inf.LIST_APPEND if seed % 2 == 0 else inf.RW_REGISTER
+        ops = gen_history(rng, n_txn=30 + seed, workload=wl,
+                          crash=(seed % 5 == 0))
+        ref = inf.infer(ops, workload=wl)
+
+        inc = inf.IncrementalInference(wl)
+        n_pad = 128
+        planes = np.zeros((5, n_pad, n_pad // 32), np.uint32)
+        closure = None
+        final_row = None
+        step = max(1, len(ops) // 7)
+        for pos in range(0, len(ops), step):
+            for op in ops[pos:pos + step]:
+                inc.feed(op)
+            d = inc.drain()
+            need = em.pad_for_mesh(max(d["n"], 1), 1)
+            if need > n_pad:
+                planes = em.grow_packed(planes, need)
+                if closure is not None:
+                    closure = em.grow_packed(closure, need)
+                n_pad = need
+            for bits, apply in ((d["added"], em.set_bits),
+                                (d["removed"], em.clear_bits)):
+                by_plane: dict = {}
+                for pl, a, b in bits:
+                    g = by_plane.setdefault(pl, ([], []))
+                    g[0].append(a)
+                    g[1].append(b)
+                for pl, (src, dst) in by_plane.items():
+                    apply(planes[inf.PLANES.index(pl)], src, dst)
+            if d["rebuild"]:
+                closure = None
+            final_row, closure = em.classify_host_warm(
+                planes, d["n"], closure=closure)
+
+        # 1) planes bit-identical to the one-shot packed stack
+        ref_packed = ref.packed_stacked(n_pad=n_pad)
+        assert np.array_equal(ref_packed, planes), \
+            f"seed {seed} [{wl}]: incremental planes diverged"
+        # 2) direct flags byte-identical
+        assert json.dumps(ref.direct, sort_keys=True, default=repr) \
+            == json.dumps(inc.direct(), sort_keys=True, default=repr)
+        # 3) warm cycle verdict == cold classify of the same planes
+        cold = em.classify_host_packed(planes, ref.n)
+        assert final_row["anomalies"] == cold["anomalies"]
+        # 4) weakest level identical through the checker vocabulary
+        found_inc = set(inc.direct()) | set(final_row["anomalies"])
+        found_ref = set(ref.direct) | set(cold["anomalies"])
+        assert chk_elle.weakest_violated(found_inc) \
+            == chk_elle.weakest_violated(found_ref)
+        # 5) warm-kept closure == cold closure == dense oracle
+        _row2, cl_cold = em.classify_host_warm(planes, ref.n,
+                                               closure=None)
+        dense = eg.closure_reference(np.stack(
+            [em.unpack_bits(planes[i], n_pad) for i in range(5)]))
+        for i, name in enumerate(("cww", "p0", "p1")):
+            assert np.array_equal(closure[i], cl_cold[i]), \
+                f"warm-vs-cold closure {name} diverged"
+            assert np.array_equal(cl_cold[i], em.pack_bits(dense[i])), \
+                f"closure {name} diverged from the dense oracle"
+
+    @pytest.mark.parametrize("seed", [1, 4, 9])
+    def test_state_roundtrip_mid_stream(self, seed):
+        """to_state/from_state across a JSON round-trip mid-stream
+        (the checkpoint shape) must converge to the same edge set and
+        direct flags as an uninterrupted incremental run."""
+        rng = random.Random(seed)
+        wl = inf.LIST_APPEND if seed % 2 else inf.RW_REGISTER
+        ops = gen_history(rng, n_txn=36, workload=wl,
+                          crash=(seed == 4))
+        ref = inf.infer(ops, workload=wl)
+        n_pad = em.pad_for_mesh(max(ref.n, 1), 1)
+        ref_packed = ref.packed_stacked(n_pad=n_pad)
+
+        a = inf.IncrementalInference(wl)
+        half = len(ops) // 2
+        for op in ops[:half]:
+            a.feed(op)
+        a.drain()
+        state = json.loads(json.dumps(a.to_state()))
+        b = inf.IncrementalInference.from_state(state)
+        for op in ops[half:]:
+            b.feed(op)
+        b.drain()
+        ref_edges = {(pl, u, v) for pl in inf.DEP_PLANES
+                     for u in range(ref.n)
+                     for v in em._row_indices(
+                         ref_packed[inf.PLANES.index(pl)][u], ref.n)}
+        assert set(b._edge_ref) == ref_edges
+        assert json.dumps(ref.direct, sort_keys=True, default=repr) \
+            == json.dumps(b.direct(), sort_keys=True, default=repr)
+
+
+# ---------------------------------------------------------------------------
+# workload sniffing + weakest level
+# ---------------------------------------------------------------------------
+
+class TestSniff:
+    def test_append_mop_decides_list_append(self):
+        ops = [Op(process=0, type="invoke", f="txn",
+                  value=[["append", 0, 1]], index=0)]
+        assert sniff_txn_workload(ops) == inf.LIST_APPEND
+
+    def test_write_mop_decides_rw_register(self):
+        ops = [Op(process=0, type="invoke", f="txn",
+                  value=[["w", 0, 1]], index=0)]
+        assert sniff_txn_workload(ops) == inf.RW_REGISTER
+
+    def test_reads_only_is_undecided(self):
+        ops = [Op(process=0, type="invoke", f="txn",
+                  value=[["r", 0, None]], index=0)]
+        assert sniff_txn_workload(ops) == "auto"
+
+    def test_non_txn_ops_are_not_txn(self):
+        ops = [Op(process=0, type="invoke", f="write", value=3,
+                  index=0)]
+        assert sniff_txn_workload(ops) is None
+
+    def test_weakest_violated_vocabulary(self):
+        assert chk_elle.weakest_violated(set()) is None
+        assert chk_elle.weakest_violated({"G-single"}) \
+            == "snapshot-isolation"
+        assert chk_elle.weakest_violated({"G-single", "G0"}) \
+            == "read-uncommitted"
+        assert chk_elle.weakest_violated({"G2-item"}) == "serializable"
+
+
+# ---------------------------------------------------------------------------
+# the txn sidecar checkpoint
+# ---------------------------------------------------------------------------
+
+class TestSidecar:
+    def test_write_read_roundtrip(self, tmp_path):
+        ptr = lease_mod.write_txn_sidecar(
+            tmp_path, {"workload": "list-append", "x": [1, 2]}, seq=3)
+        assert ptr is not None and ptr["seq"] == 3
+        got = lease_mod.read_txn_sidecar(tmp_path, ptr)
+        assert got == {"workload": "list-append", "x": [1, 2]}
+
+    def test_seq_mismatch_rejected(self, tmp_path):
+        ptr = lease_mod.write_txn_sidecar(tmp_path, {"a": 1}, seq=3)
+        stale = dict(ptr, seq=2)
+        assert lease_mod.read_txn_sidecar(tmp_path, stale) is None
+
+    def test_torn_sidecar_rejected(self, tmp_path):
+        ptr = lease_mod.write_txn_sidecar(tmp_path, {"a": [1] * 100},
+                                          seq=0)
+        assert lease_mod.tear_txn_sidecar(tmp_path)
+        assert lease_mod.read_txn_sidecar(tmp_path, ptr) is None
+
+    def test_missing_sidecar_rejected(self, tmp_path):
+        assert lease_mod.read_txn_sidecar(
+            tmp_path, {"crc": 0, "seq": 0, "bytes": 1}) is None
+
+    def test_tear_on_missing_is_false(self, tmp_path):
+        assert not lease_mod.tear_txn_sidecar(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# the planner bucket + traceable registration
+# ---------------------------------------------------------------------------
+
+class TestPlanner:
+    def test_plan_live_txn_buckets(self):
+        from jepsen_tpu.ops import planner
+        p = planner.plan_live_txn(128, devices=1, backend="device")
+        assert p.engine == "elle-delta"
+        assert "elle-delta-host" in p.chain
+        ph = planner.plan_live_txn(128, devices=1, backend="host")
+        assert ph.chain == ("elle-delta-host",)
+
+    def test_elle_delta_traceable(self):
+        """The registered trace builder must produce a jaxpr for the
+        warm kernel (the jlint trace audit's coverage path)."""
+        import jax
+
+        from jepsen_tpu.lint import trace_audit
+        from jepsen_tpu.ops import planner
+        trace_audit.register_builtin_traceables()
+        p = planner.plan_live_txn(128, devices=1, backend="device")
+        out = planner.traceable(p, devices=jax.devices()[:1])
+        assert out is not None
+
+
+# ---------------------------------------------------------------------------
+# TxnTenant through the scheduler (in-process)
+# ---------------------------------------------------------------------------
+
+class TestTxnTenant:
+    def test_drain_flags_planted_g_single(self, tmp_path):
+        """The acceptance shape, in-process: a list-append WAL with a
+        planted G-single is adopted as a txn tenant (declared
+        workload), flagged exactly once with the correct weakest
+        level, and the verdict matches the post-hoc checker."""
+        d = tmp_path / "la" / "t1"
+        ops = []
+        i = 0
+        for j in range(8):      # clean prefix
+            ops.append(Op(process=j % 2, type="invoke", f="txn",
+                          value=[["append", 0, j]], index=i))
+            i += 1
+            ops.append(Op(process=j % 2, type="ok", f="txn",
+                          value=[["append", 0, j]], index=i))
+            i += 1
+        ops += g_single_ops(start_index=i)
+        write_wal(d, ops)
+        (d / "test.json").write_text(json.dumps(
+            {"name": "la", "workload": "list-append"}))
+        s = LiveScheduler(tmp_path, scan_every=1, backend="host")
+        s.drain()
+        t = s.tenants[("la", "t1")]
+        assert t.is_txn
+        st = t.stats()
+        assert st["txn"]["weakest-violated"] == "snapshot-isolation"
+        assert st["txn"]["anomalies"] == ["G-single"]
+        assert st["verdict-so-far"] is False
+        flags = [e for e in telemetry.read_events(d / "live.jsonl")
+                 if e.get("type") == "live-flag"]
+        assert len(flags) == 1
+        assert flags[0]["lane"] == "txn:G-single"
+        assert flags[0]["level"] == "snapshot-isolation"
+        # post-hoc twin agrees
+        res = chk_elle.checker(workload="list-append",
+                               algorithm="host").check({}, ops)
+        assert res["valid?"] is False
+        assert set(res["anomaly-types"]) == {"G-single"}
+        s.close()
+
+    def test_promote_on_first_ingest(self, tmp_path):
+        """No test.json declaration: a WAL whose records are
+        txn-shaped promotes the freshly adopted register tenant to a
+        TxnTenant on first ingest, losslessly."""
+        d = tmp_path / "anon" / "t1"
+        write_wal(d, g_single_ops())
+        s = LiveScheduler(tmp_path, scan_every=1, backend="host")
+        s.drain()
+        t = s.tenants[("anon", "t1")]
+        assert isinstance(t, TxnTenant)
+        assert t.stats()["txn"]["anomalies"] == ["G-single"]
+        evs = [e["type"] for e in
+               telemetry.read_events(d / "live.jsonl")]
+        assert "live-adopt-txn" in evs
+        s.close()
+
+    def test_read_only_first_window_defers_workload(self, tmp_path):
+        """Regression: a paced stream whose first forced window is
+        read-only must NOT lock in the rw-register default — the
+        later append mops decide list-append and the planted cycle
+        still flags."""
+        d = tmp_path / "ro" / "t1"
+        d.mkdir(parents=True)
+        t = TxnTenant("t1", "ro", d, backend="host", window_txns=8)
+        ops = [Op(process=0, type="invoke", f="txn",
+                  value=[["r", 0, None]], index=0),
+               Op(process=0, type="ok", f="txn",
+                  value=[["r", 0, []]], index=1)]
+        ops += g_single_ops(start_index=2)
+        now = time.time()
+        proposed = []
+        for k in range(0, len(ops), 2):
+            t.ingest(ops[k:k + 2], [now] * 2)
+            proposed += t.advance(now=now, force=True)["flags"]
+        assert t.workload == inf.LIST_APPEND
+        assert any(f["lane"] == "txn:G-single" for f in proposed)
+
+    def test_reads_only_stream_classifies_at_close(self, tmp_path):
+        """An all-read stream never decides the workload mid-flight;
+        only the CLOSED stream gets the rw-register default (and a
+        clean verdict)."""
+        d = tmp_path / "ro2" / "t1"
+        d.mkdir(parents=True)
+        t = TxnTenant("t1", "ro2", d, backend="host")
+        ops = [Op(process=0, type="invoke", f="txn",
+                  value=[["r", 0, None]], index=0),
+               Op(process=0, type="ok", f="txn",
+                  value=[["r", 0, []]], index=1)]
+        now = time.time()
+        t.ingest(ops, [now] * 2)
+        out = t.advance(now=now, force=True)
+        assert out["window"] is None and t.inc is None
+        t.done = True
+        out = t.advance(now=now, force=True)
+        assert out["window"] is not None
+        assert t.workload == inf.RW_REGISTER
+        assert t.verdict_so_far is True
+
+
+# ---------------------------------------------------------------------------
+# checkpoint resume / torn replay (in-process twins of the kill9 battery)
+# ---------------------------------------------------------------------------
+
+class TestCheckpointResume:
+    TTL = 0.5
+
+    def test_takeover_resumes_from_checkpoint(self, tmp_path):
+        """Worker A checkpoints mid-stream and dies (abandoned, no
+        release); worker B's takeover restores the incremental state
+        from the sidecar — resumed txn count proves no replay — and
+        the post-death planted G-single flags exactly once."""
+        d = tmp_path / "la" / "t1"
+        d.mkdir(parents=True)
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        idx = 0
+        for j in range(20):
+            for ty in ("invoke", "ok"):
+                wal.append(Op(process=j % 4, type=ty, f="txn",
+                              value=[["append", j % 3, j]],
+                              index=idx))
+                idx += 1
+        A = LiveScheduler(tmp_path, scan_every=1, backend="host",
+                          worker_id="wA", lease_ttl=self.TTL)
+        A.drain()
+        tA = A.tenants[("la", "t1")]
+        assert tA.is_txn and tA.inc.n == 20
+        A.renew_leases(force=True)
+        assert (d / lease_mod.TXN_SIDECAR).exists()
+        # A dies silently; the planted pair lands after its death
+        for o in g_single_ops(start_index=idx):
+            wal.append(o)
+        wal.close()
+        time.sleep(self.TTL + 0.3)
+        B = LiveScheduler(tmp_path, scan_every=1, backend="host",
+                          worker_id="wB", lease_ttl=self.TTL)
+        deadline = time.monotonic() + 30
+        while ("la", "t1") not in B.tenants \
+                and time.monotonic() < deadline:
+            B.tick()
+            time.sleep(0.05)
+        B.drain()
+        st = B.tenants[("la", "t1")].stats()["txn"]
+        assert st["resumed_txns"] == 20, "must resume, not replay"
+        assert st["weakest-violated"] == "snapshot-isolation"
+        flags = [e for e in telemetry.read_events(d / "live.jsonl")
+                 if e.get("type") == "live-flag"]
+        assert len(flags) == 1, "exactly-once"
+        A.close()
+        B.close()
+
+    def test_torn_checkpoint_degrades_to_full_replay(self, tmp_path):
+        """A torn sidecar under a valid lease pointer must fail the
+        crc gate and fall back to full replay from byte 0 — never a
+        partial resume — and the journal de-dup keeps the flag count
+        at one."""
+        d = tmp_path / "la" / "t1"
+        d.mkdir(parents=True)
+        ops = []
+        idx = 0
+        for j in range(20):
+            for ty in ("invoke", "ok"):
+                ops.append(Op(process=j % 4, type=ty, f="txn",
+                              value=[["append", j % 3, j]],
+                              index=idx))
+                idx += 1
+        ops += g_single_ops(start_index=idx)
+        write_wal(d, ops)
+        A = LiveScheduler(tmp_path, scan_every=1, backend="host",
+                          worker_id="wA", lease_ttl=self.TTL)
+        A.drain()
+        A.renew_leases(force=True)
+        nflags0 = len([e for e in
+                       telemetry.read_events(d / "live.jsonl")
+                       if e.get("type") == "live-flag"])
+        assert nflags0 == 1
+        # tear the checkpoint, expire the lease in place
+        assert lease_mod.tear_txn_sidecar(d)
+        with open(d / "lease.json") as f:
+            lease = json.load(f)
+        lease["owner"] = "dead"
+        lease["stamp"] = time.time() - 99
+        with open(d / "lease.json", "w") as f:
+            json.dump(lease, f)
+        time.sleep(self.TTL + 0.2)
+        C = LiveScheduler(tmp_path, scan_every=1, backend="host",
+                          worker_id="wC", lease_ttl=self.TTL)
+        deadline = time.monotonic() + 30
+        while ("la", "t1") not in C.tenants \
+                and time.monotonic() < deadline:
+            C.tick()
+            time.sleep(0.05)
+        C.drain()
+        st = C.tenants[("la", "t1")].stats()["txn"]
+        assert st["resumed_txns"] == 0, "torn sidecar must not restore"
+        assert st["txns"] == 23, "full replay must re-feed everything"
+        assert st["weakest-violated"] == "snapshot-isolation"
+        flags = [e for e in telemetry.read_events(d / "live.jsonl")
+                 if e.get("type") == "live-flag"]
+        assert len(flags) == 1, "replay must de-dup the journaled flag"
+        A.close()
+        C.close()

@@ -1,0 +1,270 @@
+"""Transactional kill9 battery (ISSUE 18): real serve-checker worker
+subprocesses streaming list-append mop WALs through the incremental
+Elle tier, SIGKILLed mid-closure.  Pins the acceptance criteria the
+checkpoint protocol exists for: the survivor resumes from the
+checkpointed frontier (resumed-txn count, not a replay), anomaly flags
+stay exactly-once across the handoff, a deliberately torn checkpoint
+provably degrades to full replay (never a partial resume, never a
+wrong verdict), and the TxnFleetTarget campaign searches that fault
+space with isolation-level coverage classes.  The in-process twins
+live in tests/test_live_txn.py."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import store, telemetry
+from jepsen_tpu.history import HistoryWAL, Op, follow_frames
+from jepsen_tpu.live import lease as lease_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store-base")
+    yield
+
+
+def spawn_worker(root, wid, ttl=0.8):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         str(root), "--worker-id", wid, "--lease-ttl", str(ttl),
+         "--backend", "host", "--poll-interval", "0.02"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.03)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def txn_op(p, ty, val, i):
+    return Op(process=p, type=ty, f="txn", value=val, index=i)
+
+
+def append_pair(wal, p, mops_in, mops_ok, i):
+    wal.append(txn_op(p, "invoke", mops_in, i))
+    wal.append(txn_op(p, "ok", mops_ok, i + 1))
+    return i + 2
+
+
+def plant_g_single(wal, i, key_z=55, key_y=88):
+    """wr Tb->Ta + rw Ta->Tb: a cycle with exactly one rw edge."""
+    i = append_pair(wal, 2, [["append", key_z, 1]],
+                    [["append", key_z, 1]], i)
+    i = append_pair(wal, 2,
+                    [["append", key_z, 2], ["append", key_y, 1]],
+                    [["append", key_z, 2], ["append", key_y, 1]], i)
+    i = append_pair(wal, 0,
+                    [["r", key_z, None], ["r", key_y, None]],
+                    [["r", key_z, [1, 2]], ["r", key_y, []]], i)
+    return i
+
+
+def live_flags(d):
+    p = d / "live.jsonl"
+    if not p.exists():
+        return []
+    return [e for e in telemetry.read_events(p)
+            if e.get("type") == "live-flag"]
+
+
+def txn_stats(d):
+    try:
+        with open(d / "live.json") as f:
+            return json.load(f).get("txn") or {}
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+@pytest.mark.kill9
+class TestTxnKill9:
+    TTL = 0.8
+
+    def test_sigkill_mid_closure_resumes_from_checkpoint(
+            self, tmp_path):
+        """The acceptance scenario: two real workers, a paced
+        list-append txn stream, SIGKILL the owner after it has
+        checkpointed incremental state.  The survivor must resume
+        from the checkpointed frontier (resumed_txns > 0 in its
+        published stats), flag the post-kill planted G-single with
+        the correct weakest level, and the flag count must stay
+        exactly one."""
+        root = tmp_path / "store"
+        d = root / "la" / "t1"
+        d.mkdir(parents=True)
+        (d / "test.json").write_text(json.dumps(
+            {"name": "la", "workload": "list-append"}))
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        procs = [spawn_worker(root, "A", self.TTL),
+                 spawn_worker(root, "B", self.TTL)]
+        try:
+            i = 0
+            for k in range(20):
+                i = append_pair(wal, k % 3, [["append", k % 4, k]],
+                                [["append", k % 4, k]], i)
+                time.sleep(0.005)
+            ls = wait_for(lambda: lease_mod.read(d), 30,
+                          "a worker to acquire the txn tenant")
+            owner = ls.owner
+            victim = procs[0] if owner == "A" else procs[1]
+            survivor_id = "B" if owner == "A" else "A"
+            # the incremental state must actually be checkpointed
+            # before the kill — that is what "resume" means
+            wait_for(lambda: (lambda l2: l2 is not None
+                              and isinstance(l2.state, dict)
+                              and "txn" in l2.state)(
+                         lease_mod.read(d)),
+                     self.TTL * 6 + 10,
+                     "a renewal to checkpoint the txn frontier")
+            assert (d / lease_mod.TXN_SIDECAR).exists()
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10)
+            t_kill = time.monotonic()
+            new = wait_for(
+                lambda: (lambda l2: l2 if l2 is not None
+                         and l2.owner == survivor_id else None)(
+                    lease_mod.read(d)),
+                self.TTL * 6 + 15, "the survivor takeover")
+            gap = time.monotonic() - t_kill
+            assert new.epoch >= 2
+            assert gap < self.TTL * 2 + 2.0, \
+                f"takeover took {gap:.2f}s (ttl {self.TTL})"
+            # post-kill plant: only the survivor can flag it
+            i = plant_g_single(wal, i)
+            wal.close()
+            (d / "results.json").write_text('{"valid?": false}')
+            wait_for(lambda: [f for f in live_flags(d)
+                              if f.get("lane") == "txn:G-single"],
+                     60, "the survivor to flag the planted G-single")
+            wait_for(lambda: txn_stats(d).get("resumed_txns"),
+                     30, "the survivor to publish resumed stats")
+            st = txn_stats(d)
+            assert st["resumed_txns"] > 0, \
+                "survivor replayed instead of resuming the checkpoint"
+            assert st["weakest-violated"] == "snapshot-isolation"
+            # settle, then assert exactly-once
+            wait_for(lambda: not (root / "la").exists()
+                     or txn_stats(d).get("inflight") == 0, 30,
+                     "the stream to settle")
+            time.sleep(self.TTL)
+            flags = [f for f in live_flags(d)
+                     if f.get("lane") == "txn:G-single"]
+            assert len(flags) == 1, \
+                f"expected exactly one flag, got {len(flags)}"
+            assert flags[0]["level"] == "snapshot-isolation"
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(10)
+
+    def test_torn_checkpoint_full_replay_subprocess(self, tmp_path):
+        """Tear the checkpoint sidecar after the owner died: the next
+        worker's crc gate must reject it and full-replay from byte 0
+        — the resumed count stays 0, the replayed verdict is still
+        correct, and the journal de-dup keeps the flag count at
+        one."""
+        root = tmp_path / "store"
+        d = root / "la" / "t1"
+        d.mkdir(parents=True)
+        (d / "test.json").write_text(json.dumps(
+            {"name": "la", "workload": "list-append"}))
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        i = 0
+        for k in range(20):
+            i = append_pair(wal, k % 3, [["append", k % 4, k]],
+                            [["append", k % 4, k]], i)
+        i = plant_g_single(wal, i)
+        wal.close()
+        (d / "results.json").write_text('{"valid?": false}')
+        w1 = spawn_worker(root, "A", self.TTL)
+        try:
+            wait_for(lambda: live_flags(d), 60,
+                     "the first worker to flag the plant")
+            wait_for(lambda: (lambda l2: l2 is not None
+                              and isinstance(l2.state, dict)
+                              and "txn" in l2.state)(
+                         lease_mod.read(d)),
+                     self.TTL * 6 + 10, "a checkpoint renewal")
+        finally:
+            w1.kill()
+            w1.wait(10)
+        assert lease_mod.tear_txn_sidecar(d), "sidecar must exist"
+        # expire the dead owner's lease in place
+        with open(d / "lease.json") as f:
+            lease = json.load(f)
+        lease["stamp"] = time.time() - 99
+        with open(d / "lease.json", "w") as f:
+            json.dump(lease, f)
+        w2 = spawn_worker(root, "B", self.TTL)
+        try:
+            wait_for(lambda: txn_stats(d).get("txns") == 23, 60,
+                     "the second worker to full-replay the stream")
+            st = txn_stats(d)
+            assert st["resumed_txns"] == 0, \
+                "a torn checkpoint must never partially resume"
+            assert st["weakest-violated"] == "snapshot-isolation"
+            time.sleep(self.TTL)
+            assert len(live_flags(d)) == 1, \
+                "replay must de-dup the journaled flag"
+        finally:
+            w2.kill()
+            w2.wait(10)
+
+
+# ---------------------------------------------------------------------------
+# the TxnFleetTarget campaign smoke (acceptance)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.kill9
+class TestTxnFleetCampaign:
+    def test_txn_fleet_target_campaign_smoke(self, tmp_path):
+        """A small coverage-guided campaign over the transactional
+        fault space: worker kills/pauses mid-closure plus torn
+        checkpoint sidecars.  Every planted anomaly must flag exactly
+        once WITH its correct isolation level (verdict True; False is
+        a real checkpoint-protocol finding), and the coverage matrix
+        must record the isolation-level classes."""
+        from jepsen_tpu import campaign as campaign_mod
+        target = campaign_mod.TxnFleetTarget(
+            workers=2, tenants=1, lease_ttl=0.4, txns_per_tenant=30)
+        c = campaign_mod.Campaign(
+            "txn-fleet-smoke", target, seed=7, schedules=2,
+            bootstrap=2, k_dry=8, mutants_per_novel=0,
+            base_time_limit=2.0)
+        out = c.run()
+        assert out["run"] == 2
+        assert out["quarantined"] == 0
+        led = store.campaigns_root() / "txn-fleet-smoke" \
+            / "ledger.jsonl"
+        results = [r["ev"] for r in
+                   follow_frames(led, key="ev").records
+                   if r["ev"]["type"] == "result"]
+        assert len(results) == 2
+        for r in results:
+            assert r["verdict"] is True, r
+            assert "flag-lost" not in r["anomalies"], r
+            assert "flag-dup" not in r["anomalies"], r
+            assert "level-wrong" not in r["anomalies"], r
+            # the isolation-level coverage class is the point
+            assert any(a.startswith("level:")
+                       for a in r["anomalies"]), r
+        cov = json.loads((store.campaigns_root() / "txn-fleet-smoke"
+                          / "coverage.json").read_text())
+        assert set(cov["nemeses"]) == {"kill-worker", "pause-worker",
+                                       "tear-checkpoint"}
+        assert cov["cells"]

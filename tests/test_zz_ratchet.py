@@ -1,0 +1,85 @@
+"""The bench-baseline ratchet (ISSUE 18, ROADMAP #5c): committed
+tolerance bands for the latency numbers tier-1 actually measures —
+session wall, commit->flag detection lag, worker-death->takeover gap —
+diffed against `store/ci/bench-baseline.json` and FAILED (not warned)
+on regression, the lint-baseline pattern applied to performance.
+
+Named `test_zz_*` so it collects LAST under the tier's alphabetical
+order (`-p no:randomly` in the tier-1 command): every fleet / live-txn
+battery has already run and the registry gauges hold this session's
+observed worst cases.  Rows whose instrument never fired this session
+(partial runs, `-k` selections) are skipped, never passed vacuously —
+the committed baseline is only authoritative against a full tier.
+
+Raising a band is a reviewed edit to the committed baseline, exactly
+like adding a lint waiver: the diff is the ratchet."""
+
+import json
+import os
+import time
+
+import pytest
+
+BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "store", "ci", "bench-baseline.json")
+
+
+def _rows() -> dict:
+    if not os.path.exists(BASELINE):
+        pytest.skip("no committed bench baseline "
+                    "(store/ci/bench-baseline.json)")
+    with open(BASELINE) as f:
+        base = json.load(f)
+    assert base.get("version") == 1, "unknown bench-baseline version"
+    return base["rows"]
+
+
+def _gauge(name: str):
+    """Max observed value of a gauge across label sets, or None when
+    the instrument never fired this session."""
+    from jepsen_tpu import telemetry
+    _k, by_label = telemetry.REGISTRY.collect().get(name, (None, {}))
+    if not by_label:
+        return None
+    return max(m.value for m in by_label.values())
+
+
+def test_tier1_wall_within_band():
+    t0 = os.environ.get("JEPSEN_TPU_T1_T0")
+    if t0 is None:
+        pytest.skip("session start not stamped (not under conftest)")
+    row = _rows().get("tier1_wall_s")
+    if row is None:
+        pytest.skip("no tier1_wall_s row in the baseline")
+    wall = time.monotonic() - float(t0)
+    assert wall <= row["max"], (
+        f"tier-1 wall {wall:.1f}s exceeds the committed band "
+        f"{row['max']:.1f}s ({BASELINE}); find the new cost center in "
+        "store/ci/last-tier1.json 'slowest' or raise the band in a "
+        "reviewed baseline edit")
+
+
+def test_detection_lag_within_band():
+    row = _rows().get("live_txn_detect_lag_s")
+    if row is None:
+        pytest.skip("no live_txn_detect_lag_s row in the baseline")
+    lag = _gauge("live_txn_detect_lag_seconds")
+    if lag is None:
+        pytest.skip("no txn tenant flagged an anomaly this session "
+                    "(partial run?)")
+    assert lag <= row["max"], (
+        f"txn commit->flag detection lag {lag:.3f}s exceeds the "
+        f"committed band {row['max']:.1f}s ({BASELINE})")
+
+
+def test_takeover_gap_within_band():
+    row = _rows().get("live_takeover_gap_s")
+    if row is None:
+        pytest.skip("no live_takeover_gap_s row in the baseline")
+    gap = _gauge("live_lease_max_takeover_lag_seconds")
+    if gap is None:
+        pytest.skip("no lease takeover happened in-process this "
+                    "session (partial run?)")
+    assert gap <= row["max"], (
+        f"worker-death->takeover gap {gap:.3f}s exceeds the committed "
+        f"band {row['max']:.1f}s ({BASELINE})")
