@@ -654,11 +654,14 @@ class FrameSegment:
     corrupt: bool = False               # a COMPLETE line failed a guard
     stop_reason: Optional[str] = None
     tail_bytes: int = 0                 # unconsumed bytes past `offset`
+    epoch: int = 0                      # highest writer epoch accepted
 
 
 def follow_frames(path, offset: int = 0, seq: int = 0,
                   key: str = "op",
-                  max_records: Optional[int] = None) -> FrameSegment:
+                  max_records: Optional[int] = None,
+                  epoch_key: Optional[str] = None,
+                  epoch: int = 0) -> FrameSegment:
     """Tail a crc/seq-framed JSONL log (history.wal, telemetry.jsonl —
     both use the same framing discipline) from a byte offset.
 
@@ -674,7 +677,22 @@ def follow_frames(path, offset: int = 0, seq: int = 0,
 
     `max_records` bounds one read (backpressure: a tailer ingesting
     into bounded memory reads in slices); the returned offset/seq
-    resume exactly after the last consumed record."""
+    resume exactly after the last consumed record.
+
+    **Epoch fencing** (`epoch_key`, fleet tenant logs): records may
+    carry their writer's lease epoch in that envelope field.  A
+    paused-then-resumed stale worker can finish an in-flight append
+    into a log a successor already owns — no writer-side fence can
+    close that window (the pause may land between the fence check and
+    the write syscall), so the READER fences, Raft-style: a valid
+    record whose epoch is BELOW the highest epoch seen is a stale
+    intrusion — skipped, never a sequence break; a record RAISING the
+    epoch is a takeover — it supersedes any lower-epoch records at or
+    after its own sequence number (the new owner resumed there before
+    the stale line landed) and the expected sequence continues from
+    it; within one epoch the log is single-writer and a sequence
+    break still means a real tear.  Records without the field are
+    epoch 0 (legacy / non-fleet logs: behavior is unchanged)."""
     with open(path, "rb") as f:
         f.seek(offset)
         buf = f.read()
@@ -689,15 +707,44 @@ def follow_frames(path, offset: int = 0, seq: int = 0,
         if not line:
             pos = nl + 1
             continue
-        rec, err = parse_frame_line(line, key=key, seq=seq)
+        if epoch_key is None:
+            rec, err = parse_frame_line(line, key=key, seq=seq)
+            if err is not None:
+                corrupt, reason = True, f"record {seq}: {err}"
+                break
+            records.append(rec)
+            seq += 1
+            pos = nl + 1
+            continue
+        rec, err = parse_frame_line(line, key=key, seq=None)
         if err is not None:
             corrupt, reason = True, f"record {seq}: {err}"
             break
+        e = rec.get(epoch_key)
+        e = e if isinstance(e, int) else 0
+        if e < epoch:
+            pos = nl + 1                # fenced stale writer: skip
+            continue
+        i = rec.get("i")
+        if not isinstance(i, int):
+            corrupt, reason = True, (f"record {seq}: sequence break "
+                                     f"(expected {seq}, got {i})")
+            break
+        if e > epoch:
+            # takeover: the new owner's timeline supersedes any
+            # lower-epoch records at/after its resume point
+            while records and records[-1].get("i", -1) >= i:
+                records.pop()
+            epoch = e
+        elif i != seq:
+            corrupt, reason = True, (f"record {seq}: sequence break "
+                                     f"(expected {seq}, got {i})")
+            break
         records.append(rec)
-        seq += 1
+        seq = i + 1
         pos = nl + 1
     return FrameSegment(records, offset + pos, seq, corrupt, reason,
-                        len(buf) - pos)
+                        len(buf) - pos, epoch)
 
 
 @dataclasses.dataclass

@@ -32,6 +32,13 @@ already rely on.  A lease carries
   and nothing correctness-critical reads them.
 * **released** — a clean shutdown marks the lease released so the next
   worker can take over immediately instead of waiting out the TTL.
+* **done** — a *terminal* release: the tenant was fully drained and
+  its final verdict published.  Unlike a plain release (a handoff —
+  please resume me), a done lease must never be taken over: a worker
+  fenced earlier that re-adopted a completed run would re-process it
+  and republish `live.json` under its own id, flapping ownership on a
+  finished tenant.  Workers that see `done` mark the run locally
+  finished and stop scanning it.
 
 Atomicity:
 
@@ -90,6 +97,7 @@ class Lease:
     stamp: Optional[float] = None
     deadline: Optional[float] = None
     released: bool = False
+    done: bool = False                  # terminal: never re-adopt
     state: Optional[dict] = None        # checker frontier @ cursor
     corrupt: Optional[str] = None       # why the file failed to parse
     fp: int = 0                         # crc32 of the raw bytes
@@ -103,7 +111,8 @@ class Lease:
                "ttl": self.ttl,
                "cursor": {"offset": self.offset, "seq": self.seq},
                "beat": self.beat, "stamp": self.stamp,
-               "deadline": self.deadline, "released": self.released}
+               "deadline": self.deadline, "released": self.released,
+               "done": self.done}
         if self.state is not None:
             out["state"] = self.state
         return out
@@ -134,6 +143,7 @@ def read(run_dir) -> Optional[Lease]:
                      stamp=d.get("stamp"),
                      deadline=d.get("deadline"),
                      released=bool(d.get("released")),
+                     done=bool(d.get("done")),
                      state=d.get("state")
                      if isinstance(d.get("state"), dict) else None,
                      fp=fp)
@@ -239,7 +249,8 @@ def takeover(run_dir, worker_id: str, ttl: float, observed: Lease,
 def renew(run_dir, mine: Lease, *, cursor: Optional[tuple] = None,
           state: Optional[dict] = None,
           now: Optional[float] = None,
-          released: bool = False) -> Optional[Lease]:
+          released: bool = False,
+          done: bool = False) -> Optional[Lease]:
     """Heartbeat: refresh the deadline (and optionally the safe
     cursor + checker-frontier state) of a lease this worker believes
     it owns.  Read-verify first: a higher on-disk epoch (or another
@@ -261,6 +272,7 @@ def renew(run_dir, mine: Lease, *, cursor: Optional[tuple] = None,
                 seq=(cursor[1] if cursor else mine.seq),
                 beat=mine.beat + 1, stamp=now,
                 deadline=now + mine.ttl, released=released,
+                done=done,
                 state=state if cursor else mine.state)
     tmp = _write_tmp(run_dir, nxt, "ren")
     try:

@@ -239,6 +239,16 @@ class LiveScheduler:
         """(lease, how) when this worker should adopt `key`; (None, _)
         otherwise.  `how` is 'acquire' or 'takeover'."""
         ls = lease_mod.read(ts_dir)
+        if ls is not None and not ls.corrupt and ls.done:
+            # terminal release: the tenant was fully drained and its
+            # final live.json published.  Never take a finished run
+            # back over — a once-fenced worker re-adopting here would
+            # re-process the whole WAL and republish the snapshot
+            # under its own id/epoch, flapping ownership on a
+            # completed tenant.
+            self.finished.add(key)
+            self.unadopted.pop(key, None)
+            return None, None
         if ls is not None and not ls.corrupt \
                 and ls.owner == self.worker_id \
                 and key in self.tenants:
@@ -310,8 +320,13 @@ class LiveScheduler:
                                              ev.get("op_index")))
             except Exception:  # noqa: BLE001 - dedupe is best-effort
                 pass
+        # fleet logs are epoch-stamped: a SIGSTOP-resumed stale worker
+        # finishing an in-flight append after takeover is fenced by
+        # READERS (lower-epoch records skipped), since no writer-side
+        # check can cover a pause landing after the fence gate
         self._logs[key] = telemetry.EventLog(
-            ts_dir / "live.jsonl", resume=resume)
+            ts_dir / "live.jsonl", resume=resume,
+            epoch=owned.epoch if owned is not None else None)
         if owned is not None:
             with self._lease_lock:
                 self._leases[key] = owned
@@ -513,9 +528,11 @@ class LiveScheduler:
             renewed += 1
         return renewed
 
-    def _release_lease(self, key, t) -> None:
-        """Mark an owned lease released (clean handoff: the next
-        worker may take over immediately, no TTL wait)."""
+    def _release_lease(self, key, t, done: bool = False) -> None:
+        """Mark an owned lease released.  A plain release is a clean
+        handoff (the next worker may take over immediately, no TTL
+        wait); `done=True` is terminal — the tenant drained and its
+        final snapshot published, so no worker may ever re-adopt."""
         with self._lease_lock:
             mine = self._leases.pop(key, None)
         self._fence_checked.pop(key, None)
@@ -523,7 +540,8 @@ class LiveScheduler:
             lease_mod.renew(t.run_dir, mine,
                             cursor=(t.safe_offset, t.safe_seq),
                             state=getattr(t, "safe_state", None),
-                            now=self.clock(), released=True)
+                            now=self.clock(), released=True,
+                            done=done)
 
     # -- ingest --------------------------------------------------------------
 
@@ -900,7 +918,7 @@ class LiveScheduler:
                 self._emit(key, "live-done", durable=True,
                            **{"verdict-so-far":
                               t.stats()["verdict-so-far"]})
-                self._release_lease(key, t)
+                self._release_lease(key, t, done=True)
                 lg = self._logs.pop(key, None)
                 if lg is not None:
                     lg.close()

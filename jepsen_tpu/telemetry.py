@@ -284,12 +284,21 @@ class EventLog:
     trailing line — a writer killed mid-append — is truncated away,
     and appends pick up at the next sequence number.  This is the
     fleet-takeover path: a new lease owner keeps the dead worker's
-    live.jsonl timeline readable as ONE log."""
+    live.jsonl timeline readable as ONE log.
 
-    def __init__(self, path, fsync: bool = True, resume: bool = False):
+    `epoch` (fleet tenant logs) stamps every record with the writer's
+    lease epoch (`e` envelope field).  A SIGSTOP-paused worker can
+    resume an in-flight append into a log a successor took over —
+    after ANY writer-side fence check — so readers fence instead:
+    follow_events skips lower-epoch intrusions rather than reading
+    them as a tear (see history.follow_frames)."""
+
+    def __init__(self, path, fsync: bool = True, resume: bool = False,
+                 epoch: Optional[int] = None):
         self.path = Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self.fsync = fsync
+        self.epoch = epoch
         self.lock = threading.Lock()
         self._n = 0
         self._dead = False
@@ -297,7 +306,8 @@ class EventLog:
                 and self.path.stat().st_size:
             try:
                 from jepsen_tpu.history import follow_frames
-                seg = follow_frames(self.path, key="ev")
+                seg = follow_frames(self.path, key="ev",
+                                    epoch_key="e")
                 if seg.tail_bytes and not seg.corrupt:
                     with open(self.path, "r+b") as f:
                         f.truncate(seg.offset)
@@ -316,8 +326,11 @@ class EventLog:
             try:
                 payload = _payload(ev)
                 crc = zlib.crc32(payload.encode())
+                e = f'"e":{self.epoch},' if self.epoch is not None \
+                    else ""
                 # lint: wall-ok(advisory envelope stamp; readers order by i/crc, never t)
-                self._f.write(f'{{"i":{self._n},"t":{time.time():.6f},'
+                t = time.time()
+                self._f.write(f'{{"i":{self._n},{e}"t":{t:.6f},'
                               f'"crc":"{crc:08x}","ev":{payload}}}\n')
                 self._f.flush()
                 if durable and self.fsync:
@@ -353,21 +366,27 @@ class EventSegment:
     corrupt: bool = False
     stop_reason: Optional[str] = None
     tail_bytes: int = 0
+    epoch: int = 0
 
 
 def follow_events(path, offset: int = 0, seq: int = 0,
-                  max_records: Optional[int] = None) -> EventSegment:
+                  max_records: Optional[int] = None,
+                  epoch: int = 0) -> EventSegment:
     """Resumable cursor over a (possibly still-being-written) event
     log — the streaming counterpart of `read_events`, sharing
     `history.follow_frames`'s torn-tail contract: only intact complete
     records since `offset` are returned; an incomplete trailing line is
     left unconsumed and re-read whole on the next call; a COMPLETE line
-    failing a guard marks the stream `corrupt`.  Each event dict has
-    `t` (wall seconds) and `i` (sequence) merged in, like
-    `read_events`."""
+    failing a guard marks the stream `corrupt`.  Records are
+    epoch-fenced (`e` envelope field, fleet tenant logs): a stale
+    lower-epoch writer's intrusions are skipped and superseded, never
+    a sequence break — pass the returned `epoch` back along with
+    `offset`/`seq` when streaming.  Each event dict has `t` (wall
+    seconds) and `i` (sequence) merged in, like `read_events`."""
     from jepsen_tpu.history import follow_frames
     seg = follow_frames(path, offset, seq, key="ev",
-                        max_records=max_records)
+                        max_records=max_records,
+                        epoch_key="e", epoch=epoch)
     events = []
     for rec in seg.records:
         ev = dict(rec["ev"])
@@ -375,15 +394,17 @@ def follow_events(path, offset: int = 0, seq: int = 0,
         ev["i"] = rec["i"]
         events.append(ev)
     return EventSegment(events, seg.offset, seg.seq, seg.corrupt,
-                        seg.stop_reason, seg.tail_bytes)
+                        seg.stop_reason, seg.tail_bytes, seg.epoch)
 
 
 def read_events(path) -> list[dict]:
     """Recover the intact prefix of an event log: records in order,
     stopping at the first torn/unparseable line, crc mismatch, or
-    sequence break (everything past a tear is unattributable).  Each
-    returned dict is the event payload with `t` (wall seconds) and `i`
-    (sequence) merged in.  One full-file `follow_events` read."""
+    same-epoch sequence break (everything past a tear is
+    unattributable; a fenced stale writer's epoch-stamped intrusions
+    are skipped, not a tear).  Each returned dict is the event payload
+    with `t` (wall seconds) and `i` (sequence) merged in.  One
+    full-file `follow_events` read."""
     return follow_events(path).events
 
 

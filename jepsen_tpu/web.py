@@ -542,8 +542,9 @@ def fleet_html() -> bytes:
                 + "</td>"
                 f"<td>{html.escape(json.dumps(v))}</td></tr>")
             continue
-        status = "released" if ls.released else \
-            ("torn" if ls.corrupt else "held")
+        status = "done" if ls.done else \
+            ("released" if ls.released else
+             ("torn" if ls.corrupt else "held"))
         owned_rows.append(
             f"<tr style='background:{_live_color(v)}'>"
             f"<td>{html.escape(name)}/"

@@ -214,6 +214,41 @@ class TestFleetScheduler:
         s.close()
         assert lease_mod.read(d).released    # clean handoff
 
+    def test_done_lease_is_terminal_never_readopted(self, tmp_path):
+        """A drained tenant's lease is released DONE — terminal, not
+        a handoff: a peer (e.g. a worker fenced off earlier) must
+        refuse to re-adopt, mark the run finished locally, and leave
+        the survivor's live.json untouched.  Pins the ownership-flap
+        race the kill9 SIGSTOP/SIGCONT test intermittently caught:
+        without the done marker the resumed stale worker re-acquired
+        the completed tenant and republished the snapshot under its
+        own id/epoch."""
+        root = store.BASE
+        d = root / "r" / "t1"
+        write_wal(d, register_ops(6))
+        (d / "results.json").write_text('{"valid?": true}')
+        A = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="A", lease_ttl=5.0)
+        A.drain(20)
+        assert ("r", "t1") in A.finished
+        disk = lease_mod.read(d)
+        assert disk.released and disk.done and disk.owner == "A"
+        snap = (d / "live.json").read_text()
+        # a peer whose clock makes every lease look long-expired
+        # still refuses: done means finished, not "please resume me"
+        B = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="B", lease_ttl=0.5,
+                          mono=FakeMono(step=10.0))
+        for _ in range(4):
+            B.tick()
+        assert ("r", "t1") in B.finished and not B.tenants
+        assert B.takeovers == 0
+        after = lease_mod.read(d)
+        assert after.owner == "A" and after.epoch == disk.epoch
+        assert (d / "live.json").read_text() == snap
+        A.close()
+        B.close()
+
     def test_fleet_byte_budget_bounds_acquisition(self, tmp_path):
         """A worker only acquires tenants it can afford: with the
         whole WAL backlog of one tenant over budget, one discover

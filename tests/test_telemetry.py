@@ -200,6 +200,44 @@ class TestEventLog:
         evs = telemetry.read_events(p)
         assert [e["n"] for e in evs] == [0]
 
+    def test_epoch_fenced_stale_writer_skipped(self, tmp_path):
+        """Reader-side fencing (fleet tenant logs): a SIGSTOP-resumed
+        stale worker finishing an in-flight append into a taken-over
+        log must not hide the new owner's later records behind a
+        sequence break — a lower-epoch intrusion is skipped."""
+        p = tmp_path / "live.jsonl"
+        old = telemetry.EventLog(p, epoch=1)
+        for i in range(3):
+            old.append({"type": "op", "n": i})
+        new = telemetry.EventLog(p, resume=True, epoch=2)
+        new.append({"type": "live-flag", "n": 3})
+        old.append({"type": "op", "n": 99})       # stale i=3, e=1
+        new.append({"type": "op", "n": 4})
+        new.close()
+        old.close()
+        evs = telemetry.read_events(p)
+        assert [e["n"] for e in evs] == [0, 1, 2, 3, 4]
+
+    def test_epoch_takeover_supersedes_conflicting_record(
+            self, tmp_path):
+        """The other interleaving: the stale owner's append lands
+        FIRST, at the exact sequence the new owner resumed — the
+        higher epoch supersedes it (Raft conflict rule), so the new
+        owner's record at that sequence is the one read back."""
+        p = tmp_path / "live.jsonl"
+        old = telemetry.EventLog(p, epoch=1)
+        for i in range(2):
+            old.append({"type": "op", "n": i})
+        new = telemetry.EventLog(p, resume=True, epoch=2)
+        old.append({"type": "op", "n": 99})        # stale i=2, e=1
+        new.append({"type": "live-flag", "n": 2})  # rightful i=2, e=2
+        new.append({"type": "op", "n": 3})
+        new.close()
+        old.close()
+        evs = telemetry.read_events(p)
+        assert [e["n"] for e in evs] == [0, 1, 2, 3]
+        assert evs[2]["type"] == "live-flag"
+
     def test_append_after_close_is_noop(self, tmp_path):
         p = tmp_path / "t.jsonl"
         log = telemetry.EventLog(p)
