@@ -1000,6 +1000,191 @@ def bench_remote() -> dict:
             "live_remote_tenants": n_ten}
 
 
+def bench_trace() -> dict:
+    """ISSUE 19: the causal flight recorder, priced two ways.
+
+    (a) **trace_overhead_pct**: the same N-tenant register store is
+    built and drained twice per round — once with every WAL record
+    carrying a trace context (an open client span around each append,
+    the real `core.run` path, so `follow` parses the `c` envelope and
+    the tenant tracks per-op contexts), once envelope-clean — for 3
+    rounds; overhead compares the best traced drain against the best
+    plain drain.  The recorder's acceptance is < 5%: a regression is
+    an ERROR row, never a footnote.
+
+    (b) **lag_segments_p99**: one violation planted per tenant under
+    paced wall-stamped traced feeders; every flag's detection lag
+    decomposes into the six trace segments and feeds the
+    live_lag_segment_seconds histogram; p99 is pooled across segment
+    label sets from that instrument (the one /metrics exports —
+    including segments the earlier live/fleet/txn rows observed this
+    run), with the per-segment p99s disclosed beside it."""
+    import shutil
+    import tempfile
+
+    from jepsen_tpu import telemetry as telemetry_mod
+    from jepsen_tpu import trace as trace_mod
+    from jepsen_tpu.history import HistoryWAL
+    from jepsen_tpu.live.scheduler import LAG_BUCKETS_S, LiveScheduler
+
+    cpus = os.cpu_count() or 1
+    n_ten = 4
+    ops = int(os.environ.get("JEPSEN_TPU_BENCH_TRACE_OPS",
+                             8_000 if cpus >= 8 else 2_000))
+    rootbase = pathlib.Path(tempfile.mkdtemp(prefix="bench-trace-"))
+
+    def write_store(sub: str, traced: bool, seed0: int) -> tuple:
+        root = rootbase / sub
+        tr = trace_mod.Tracer(enabled=traced)
+        tr.set_sink(lambda m: None)
+        n_inv = 0
+        for i in range(n_ten):
+            d = root / f"t{i}" / "t1"
+            d.mkdir(parents=True)
+            h = make_history(ops, 4, seed=seed0 + i)
+            n_inv += sum(1 for o in h if o.is_invoke)
+            wal = HistoryWAL(d / "history.wal", fsync=False)
+            for o in h:
+                if traced:
+                    with tr.span("client/invoke"):
+                        wal.append(o)
+                else:
+                    wal.append(o)
+            wal.close()
+            (d / "results.json").write_text('{"valid?": true}')
+        return root, n_inv
+
+    walls: dict = {"plain": [], "traced": []}
+    try:
+        # warm the compiled-plan cache on a small same-shaped store so
+        # neither arm pays a compile inside its timed drain
+        warm_root, _ = write_store("warm", False, 7)
+        ws = LiveScheduler(warm_root, backend="device", scan_every=1)
+        ws.drain()
+        ws.close()
+        shutil.rmtree(warm_root, ignore_errors=True)
+
+        n_inv = 0
+        for rnd in range(3):
+            # alternate the arms inside each round so slow host drift
+            # lands on both sides, not just one
+            for label, traced in (("plain", False), ("traced", True)):
+                root, n_inv = write_store(f"{label}{rnd}", traced,
+                                          100 + 10 * rnd)
+                s = LiveScheduler(root, backend="device", scan_every=1)
+                t0 = time.monotonic()
+                s.drain()
+                walls[label].append(time.monotonic() - t0)
+                clean = s.flags_total == 0
+                s.close()
+                shutil.rmtree(root, ignore_errors=True)
+                if not clean:
+                    print(json.dumps({
+                        "metric": "ERROR: trace bench flagged a clean "
+                                  f"{label} tenant", "value": 0,
+                        "unit": "%", "vs_baseline": 0}))
+                    return {"error": True}
+        plain_s, traced_s = min(walls["plain"]), min(walls["traced"])
+        overhead_pct = (traced_s - plain_s) / plain_s * 100.0
+
+        # (b) paced traced feeders, one planted violation per tenant
+        rt_root = rootbase / "rt"
+        tr = trace_mod.Tracer(enabled=True)
+        tr.set_sink(lambda m: None)
+        feeders = []
+        for i in range(n_ten):
+            d = rt_root / f"rt{i}" / "t1"
+            d.mkdir(parents=True)
+            fops = list(make_history(max(ops // 4, 1_000), 4,
+                                     seed=500 + i))
+            for j, o in enumerate(fops):
+                if (o.is_ok and o.f == "read" and o.value is not None
+                        and j > len(fops) * 0.6):
+                    o.value = 99      # vmax=4: provably never written
+                    break
+            feeders.append((d, fops))
+        wals = [HistoryWAL(d / "history.wal", fsync=False)
+                for d, _ in feeders]
+        rt = LiveScheduler(rt_root, backend="device", scan_every=1)
+        pos = [0] * n_ten
+        t_start = time.monotonic()
+        while any(pos[i] < len(feeders[i][1]) for i in range(n_ten)):
+            target = int((time.monotonic() - t_start) * 2_000 * 2) + 8
+            for i, (_d, fops) in enumerate(feeders):
+                stop = min(target, len(fops))
+                while pos[i] < stop:
+                    with tr.span("client/invoke"):
+                        wals[i].append(fops[pos[i]])
+                    pos[i] += 1
+            rt.tick()
+        for w in wals:
+            w.close()
+        for d, _ in feeders:
+            (d / "results.json").write_text('{"valid?": false}')
+        rt.drain()
+        n_flags = rt.flags_total
+        rt.close()
+        if n_flags < n_ten:
+            print(json.dumps({
+                "metric": "ERROR: trace bench flagged only "
+                          f"{n_flags}/{n_ten} planted tenants",
+                "value": 0, "unit": "%", "vs_baseline": 0}))
+            return {"error": True}
+    finally:
+        shutil.rmtree(rootbase, ignore_errors=True)
+
+    # pooled p99 across the per-segment label sets of the session's
+    # live_lag_segment_seconds histogram (+ per-segment disclosure)
+    _k, by_label = telemetry_mod.REGISTRY.collect().get(
+        "live_lag_segment_seconds", (None, {}))
+    pool = telemetry_mod.Histogram(buckets=LAG_BUCKETS_S)
+    per_seg = {}
+    for key, m in by_label.items():
+        with m._lock:
+            counts, msum, mcount = list(m.counts), m.sum, m.count
+        for i, c in enumerate(counts):
+            pool.counts[i] += c
+        pool.sum += msum
+        pool.count += mcount
+        per_seg[dict(key).get("segment", "?")] = round(
+            m.quantile(0.99), 4)
+    if not pool.count:
+        print(json.dumps({
+            "metric": "ERROR: trace bench observed no lag segments",
+            "value": 0, "unit": "%", "vs_baseline": 0}))
+        return {"error": True}
+    p99 = pool.quantile(0.99)
+
+    if overhead_pct >= 5.0:
+        print(json.dumps({
+            "metric": ("ERROR: flight-recorder overhead "
+                       f"{overhead_pct:.2f}% breaks the < 5% "
+                       "acceptance (traced best "
+                       f"{traced_s:.3f}s vs plain {plain_s:.3f}s)"),
+            "value": round(overhead_pct, 2), "unit": "%",
+            "vs_baseline": 0}))
+        return {"error": True}
+
+    print(json.dumps({
+        "metric": (f"causal flight recorder: {n_ten} tenants x "
+                   f"{ops // 1000}k-op register WALs drained traced "
+                   "(per-record contexts, trace-flag journaling) vs "
+                   "envelope-clean, best of 3 rounds each; "
+                   "vs_baseline = pooled detection-lag segment p99 "
+                   "over the session's flags"),
+        "value": round(overhead_pct, 2),
+        "unit": "% overhead",
+        "vs_baseline": round(p99, 4)}), file=sys.stderr)
+    print(f"# trace: plain {plain_s:.3f}s vs traced {traced_s:.3f}s "
+          f"-> {overhead_pct:.2f}% overhead (< 5% acceptance); "
+          f"segment p99 pooled {p99:.4f}s over {pool.count} "
+          f"observations, per segment {per_seg}", file=sys.stderr)
+    return {"trace_overhead_pct": round(overhead_pct, 2),
+            "lag_segments_p99": round(p99, 4),
+            "lag_segments_p99_by_segment": per_seg,
+            "trace_flags": n_flags}
+
+
 N_COLD_KEYS = 64         # plan-cache row: small enough that the child
                          # process wall is compile-dominated, same
                          # kernel SHAPES as any 64-key one-shot
@@ -2234,6 +2419,10 @@ def main() -> int:
     if txn_stats.get("error"):
         return 1
 
+    trace_stats = bench_trace()
+    if trace_stats.get("error"):
+        return 1
+
     plan_stats = bench_plan_cache()
     if plan_stats.get("error"):
         return 1
@@ -2380,6 +2569,11 @@ def main() -> int:
         # checkpointed-frontier resume (bench_live_txn; ttl and
         # resumed-txn count disclosed)
         **{k: v for k, v in txn_stats.items() if v is not None},
+        # the causal flight recorder (ISSUE 19): traced-vs-untraced
+        # drain overhead (< 5% acceptance, asserted) and the session's
+        # pooled detection-lag segment p99 with per-segment disclosure
+        # (bench_trace)
+        **{k: v for k, v in trace_stats.items() if v is not None},
         # planner rows (BENCH_r08+): cold-vs-warm PROCESS start with
         # the persistent compiled-plan cache (subprocess-measured,
         # compile seconds child-disclosed) and the double-buffered

@@ -93,13 +93,39 @@ log = logging.getLogger("jepsen.campaign")
 LAG_BUCKETS_S = (2.0, 8.0, 30.0)
 
 
-def lag_bucket(lag_s) -> str:
+def lag_bucket(lag_s, segment=None) -> str:
+    """Coarse lag bucket, optionally qualified by the dominant
+    detection-lag segment (ISSUE 19): two runs whose flags took the
+    same wall time for *different reasons* (fsync stall vs window
+    starvation) are different coverage points."""
     if lag_s is None:
-        return "na"
-    for edge in LAG_BUCKETS_S:
-        if lag_s < edge:
-            return f"lt{edge:g}s"
-    return f"ge{LAG_BUCKETS_S[-1]:g}s"
+        b = "na"
+    else:
+        b = f"ge{LAG_BUCKETS_S[-1]:g}s"
+        for edge in LAG_BUCKETS_S:
+            if lag_s < edge:
+                b = f"lt{edge:g}s"
+                break
+    return f"{b}:{segment}" if segment else b
+
+
+def dominant_lag_segment(dirs):
+    """Most common `lag_segment` across every tenant's live-flag
+    events (the scheduler stamps each flag with the widest segment of
+    its detection-lag decomposition) — the lag_bucket() qualifier, so
+    equal wall lags with different causes stay distinct signatures."""
+    counts: dict = {}
+    for d in dirs:
+        p = d / "live.jsonl"
+        if not p.exists():
+            continue
+        for e in telemetry.read_events(p):
+            if e.get("type") == "live-flag" and e.get("lag_segment"):
+                s = e["lag_segment"]
+                counts[s] = counts.get(s, 0) + 1
+    if not counts:
+        return None
+    return max(sorted(counts), key=lambda s: counts[s])
 
 
 # ---------------------------------------------------------------------------
@@ -809,7 +835,9 @@ class FleetTarget:
         verdict = not ({"flag-lost", "flag-dup"} & anomalies)
         return {"verdict": verdict,
                 "anomalies": sorted(anomalies),
-                "lag_bucket": lag_bucket(takeover_lag),
+                "lag_bucket": lag_bucket(
+                    takeover_lag,
+                    segment=dominant_lag_segment(dirs)),
                 "fenced": fenced}
 
     def reap(self) -> None:
@@ -1100,7 +1128,9 @@ class TxnFleetTarget(FleetTarget):
                        & anomalies)
         return {"verdict": verdict,
                 "anomalies": sorted(anomalies),
-                "lag_bucket": lag_bucket(takeover_lag),
+                "lag_bucket": lag_bucket(
+                    takeover_lag,
+                    segment=dominant_lag_segment(dirs)),
                 "fenced": fenced}
 
 

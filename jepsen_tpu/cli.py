@@ -285,9 +285,19 @@ def metrics_cmd(opts) -> int:
     volume + top latencies, engine mix + stage seconds, fault windows,
     breaker transitions, runner resilience counters (ISSUE 4).
     `store_dir` is a store/<name>/<ts>/ directory (or a
-    telemetry.jsonl path)."""
+    telemetry.jsonl path).  `--fleet` treats `store_dir` as the store
+    ROOT and prints the federated Prometheus exposition instead:
+    every fleet worker's exported snapshot merged with `worker_id`
+    labels and staleness marking (ISSUE 19)."""
     from jepsen_tpu import telemetry
     d = Path(opts.store_dir)
+    if getattr(opts, "fleet", False):
+        if not (d / "fleet").is_dir():
+            print(f"no fleet/ sidecars under {opts.store_dir}",
+                  file=sys.stderr)
+            return 255
+        sys.stdout.write(telemetry.federate(d))
+        return 0
     f = d if d.is_file() else d / "telemetry.jsonl"
     if not f.exists():
         print(f"no telemetry.jsonl under {opts.store_dir}",
@@ -303,12 +313,89 @@ def metrics_cmd_spec() -> dict:
     def add_opts(parser):
         parser.add_argument("store_dir", metavar="STORE_DIR",
                             help="store/<name>/<ts> dir (or "
-                                 "telemetry.jsonl path)")
+                                 "telemetry.jsonl path); the store "
+                                 "root with --fleet")
+        parser.add_argument("--fleet", action="store_true",
+                            help="federate every fleet worker's "
+                                 "metrics snapshot (worker_id-"
+                                 "labeled, stale-marked) from "
+                                 "STORE_DIR/fleet/*.json")
 
     return {"metrics": {"opts": add_opts, "run": metrics_cmd,
                         "help": "Summarize a run's telemetry log (op "
                                 "latencies, engine mix, fault "
-                                "windows)."}}
+                                "windows); --fleet federates worker "
+                                "metrics."}}
+
+
+def trace_cmd(opts) -> int:
+    """`trace <store-dir> [--slowest N]`: the causal flight recorder's
+    terminal surface (ISSUE 19).  `store_dir` may be one run dir or
+    the store root; prints every traced flag's detection-lag
+    decomposition (append->fsync->frame->ack->window->dispatch->flag)
+    plus the cross-worker handoff links, slowest first."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu import trace as trace_mod
+    d = Path(opts.store_dir)
+    if not d.is_dir():
+        print(f"no such directory: {opts.store_dir}", file=sys.stderr)
+        return 255
+    indexes = [d / "trace-index.jsonl"] \
+        if (d / "trace-index.jsonl").exists() \
+        else sorted(d.glob("*/*/trace-index.jsonl"))
+    flags, links = [], []
+    for p in indexes:
+        try:
+            evs = telemetry.read_events(p)
+        except Exception:  # noqa: BLE001 - a torn index is skipped
+            continue
+        run = f"{p.parent.parent.name}/{p.parent.name}" \
+            if p.parent != d else p.parent.name
+        for ev in evs:
+            if ev.get("type") == "trace-flag":
+                flags.append((ev.get("lag_s") or 0.0, run, ev))
+            elif ev.get("type") == "trace-link":
+                links.append((run, ev))
+    if not flags and not links:
+        print(f"no trace-index.jsonl under {opts.store_dir}",
+              file=sys.stderr)
+        return 255
+    flags.sort(key=lambda row: row[0], reverse=True)
+    n = getattr(opts, "slowest", 0) or 0
+    if n:
+        flags = flags[:n]
+    for run, lk in links:
+        print(f"# {run}: handoff {lk.get('from_worker')} (epoch "
+              f"{lk.get('from_epoch')}) -> {lk.get('to_worker')} "
+              f"(epoch {lk.get('to_epoch')}) after "
+              f"{lk.get('silent_s')}s; resume span "
+              f"{lk.get('resume_span')}")
+    for lag, run, ev in flags:
+        segs = ev.get("segments") or {}
+        parts = " ".join(f"{s}={segs.get(s)}"
+                         for s in trace_mod.SEGMENTS if s in segs)
+        print(f"{run} trace={ev.get('trace_id')} "
+              f"lane={ev.get('lane')} op={ev.get('op_index')} "
+              f"event={ev.get('event')} lag_s={ev.get('lag_s')} "
+              f"dominant={ev.get('dominant')} "
+              f"worker={ev.get('worker')}"
+              + (f" [{parts}]" if parts else ""))
+    return 0
+
+
+def trace_cmd_spec() -> dict:
+    def add_opts(parser):
+        parser.add_argument("store_dir", metavar="STORE_DIR",
+                            help="one store/<name>/<ts> run dir, or "
+                                 "the store root (all runs)")
+        parser.add_argument("--slowest", type=int, default=0,
+                            metavar="N",
+                            help="only the N slowest traced flags")
+
+    return {"trace": {"opts": add_opts, "run": trace_cmd,
+                      "help": "Print traced flags' detection-lag "
+                              "decomposition and cross-worker "
+                              "handoff links, slowest first."}}
 
 
 def lint_cmd(opts) -> int:
@@ -891,6 +978,7 @@ def single_test_cmd(test_fn: Callable[[dict], dict],
                     "help": "Rebuild a SIGKILLed run's history from its "
                             "WAL and re-analyze it."},
         **metrics_cmd_spec(),
+        **trace_cmd_spec(),
         **lint_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),
@@ -956,6 +1044,7 @@ def standard_commands() -> dict:
                     "help": "Rebuild a SIGKILLed run's history files "
                             "from its history.wal."},
         **metrics_cmd_spec(),
+        **trace_cmd_spec(),
         **lint_cmd_spec(),
         **serve_cmd(),
         **serve_checker_cmd_spec(),

@@ -9,6 +9,7 @@ checker — where this framework swaps knossos for the TPU kernels.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time as time_mod
@@ -320,24 +321,29 @@ class ClientWorker(Worker):
                         log_op(fail)
                         self.client = None
                         continue
-                conj_op(test, op)
-                cancel = self._mark_inflight(op) if watched else None
                 tr = test.get("tracer")
-                try:
-                    if tr is not None and tr.enabled:
-                        # dgraph trace.clj:52-63 wraps client ops in spans
-                        with tr.span("client/invoke", f=str(op.f),
-                                     process=op.process):
-                            completion = invoke_op(op, test, self.client,
-                                                   self.abort, cancel)
-                            tr.attribute("type", str(completion.type))
-                    else:
+                traced = tr is not None and tr.enabled
+                # dgraph trace.clj:52-63 wraps client ops in spans.
+                # The span covers BOTH WAL appends (invoke and
+                # completion), not just the client call: the open
+                # span's context is what HistoryWAL.append stamps
+                # into the record's `c` envelope field — the root of
+                # the causal flight-recorder chain (ISSUE 19).
+                with (tr.span("client/invoke", f=str(op.f),
+                              process=op.process) if traced
+                      else contextlib.nullcontext()):
+                    conj_op(test, op)
+                    cancel = self._mark_inflight(op) if watched \
+                        else None
+                    try:
                         completion = invoke_op(op, test, self.client,
                                                self.abort, cancel)
-                finally:
-                    if watched:
-                        self._mark_inflight(None)
-                conj_op(test, completion)
+                        if traced:
+                            tr.attribute("type", str(completion.type))
+                    finally:
+                        if watched:
+                            self._mark_inflight(None)
+                    conj_op(test, completion)
                 log_op(completion)
                 # per-op latency histogram keyed (f, node, outcome) +
                 # one event — the telemetry.jsonl attribution stream

@@ -601,16 +601,21 @@ def _wal_payload(op_dict: dict) -> str:
 
 
 def frame_line(payload_dict: dict, seq: int,
-               wall: Optional[float] = None, key: str = "op") -> bytes:
+               wall: Optional[float] = None, key: str = "op",
+               ctx: Optional[str] = None) -> bytes:
     """Encode ONE frame line — the unit both the WAL and the ingest
     wire protocol (docs/remote-ingest.md) are made of.  With `wall`
     the bytes are exactly what HistoryWAL.append writes; without it,
     the no-stamp variant (campaign ledgers).  The `w` stamp rides
-    outside the crc-guarded payload, as always."""
+    outside the crc-guarded payload, as always; `ctx` is the trace
+    context envelope field `c` (ISSUE 19) — uncrc'd beside `w`/`e`,
+    so old readers skip it and a garbled context can never invalidate
+    the record it annotates."""
     body = _wal_payload(payload_dict)
     crc = zlib.crc32(body.encode())
     w = "" if wall is None else f'"w":{wall:.6f},'
-    return f'{{"i":{seq},{w}"crc":"{crc:08x}","{key}":{body}}}\n' \
+    c = "" if ctx is None else f'"c":{json.dumps(str(ctx))},'
+    return f'{{"i":{seq},{w}{c}"crc":"{crc:08x}","{key}":{body}}}\n' \
         .encode()
 
 
@@ -759,6 +764,11 @@ class WalSegment:
     corrupt: bool = False
     stop_reason: Optional[str] = None
     tail_bytes: int = 0
+    ctxs: list = dataclasses.field(default_factory=list)
+    # parallel trace contexts (`c` envelope field, None if untraced)
+    seqs: list = dataclasses.field(default_factory=list)
+    # parallel record sequence numbers (`i`) — the join key between a
+    # surfaced op and the ingest tier's transport stamps (ISSUE 19)
 
 
 def follow(path, offset: int = 0, seq: int = 0,
@@ -783,13 +793,16 @@ def follow(path, offset: int = 0, seq: int = 0,
         existed."""
     seg = follow_frames(path, offset, seq, key="op",
                         max_records=max_records)
-    ops, walls = [], []
+    ops, walls, ctxs, seqs = [], [], [], []
     for rec in seg.records:
         ops.append(Op.from_dict(rec["op"]))
         w = rec.get("w")
         walls.append(float(w) if isinstance(w, (int, float)) else None)
+        c = rec.get("c")
+        ctxs.append(c if isinstance(c, str) else None)
+        seqs.append(rec.get("i"))
     return WalSegment(ops, walls, seg.offset, seg.seq, seg.corrupt,
-                      seg.stop_reason, seg.tail_bytes)
+                      seg.stop_reason, seg.tail_bytes, ctxs, seqs)
 
 
 class HistoryWAL:
@@ -826,6 +839,12 @@ class HistoryWAL:
         self._f.write(line)
 
     def append(self, o: "Op") -> None:
+        # the appending thread's open span (core.run's client/invoke
+        # wraps the completion append) becomes the record's `c`
+        # envelope field — resolved OUTSIDE the WAL lock, it belongs
+        # to this thread alone
+        from jepsen_tpu import trace as trace_mod
+        ctx = trace_mod.current_ctx()
         with self.lock:
             if self._dead:
                 return
@@ -838,7 +857,7 @@ class HistoryWAL:
                 self._write_line(frame_line(
                     o.to_dict(), self._n,
                     # lint: wall-ok(advisory envelope stamp; recovery orders by i/crc, never w)
-                    wall=time.time()))
+                    wall=time.time(), ctx=ctx))
                 self._f.flush()
                 if self.fsync:
                     t0 = time.monotonic()
@@ -846,11 +865,21 @@ class HistoryWAL:
                     if self.telemetry is not None:
                         self.telemetry.observe_wal_fsync(
                             time.monotonic() - t0)
+                seq = self._n
                 self._n += 1
             except Exception:
                 self._dead = True
                 log.warning("history WAL write failed; continuing "
                             "without crash-safety", exc_info=True)
+                return
+        self._post_sync(seq, ctx)
+
+    def _post_sync(self, seq: int, ctx: Optional[str]) -> None:
+        """Post-durability hook, called (outside the lock) after a
+        record is flushed — and fsynced when fsync is on.  Default:
+        nothing.  StreamingWAL overrides it to ship a `mark` control
+        frame stamping when record `seq` became durable, the fsync
+        segment of the detection-lag decomposition (ISSUE 19)."""
 
     def close(self) -> None:
         with self.lock:

@@ -422,7 +422,7 @@ def _rule_bare_fallback(ctx) -> list:
 # bug waiting for a fault schedule to find it.
 
 _GUARDED_FILES = ("live.jsonl", "lease.json", "history.wal",
-                  "txn-state.json")
+                  "txn-state.json", "trace-index.jsonl")
 _ALLOWED_WRITERS = ("live/scheduler.py", "live/lease.py",
                     "live/ingest.py", "history.py")
 _WRITE_ATTRS = frozenset({"write_text", "write_bytes"})

@@ -86,6 +86,7 @@ class IngestClient:
         self.max_buffer = int(max_buffer)
         self._cond = threading.Condition()
         self._buf: list = []            # [(seq, line)] not yet acked
+        self._marks: list = []          # encoded mark ctl frames
         self._sent = 0                  # prefix of _buf on the wire
         self.acked_seq = 0              # server's next expected seq
         self.paused = False
@@ -117,6 +118,24 @@ class IngestClient:
             self._buf.append((int(seq), line))
             self._cond.notify_all()
         return True
+
+    def send_mark(self, seq: int, fs: float) -> None:
+        """Enqueue a durability mark: record `seq` hit the local disk
+        at wall `fs` (the fsync stamp of the detection-lag chain,
+        ISSUE 19).  Marks ride a DEDICATED queue, not the ack-tracked
+        `_buf` — the server's ack for `seq` can already be in flight
+        when the mark is enqueued, and `_on_ack` would drop it from
+        `_buf` unsent.  Best-effort: marks are advisory (a lost mark
+        collapses the fsync segment to zero-width, never breaks the
+        chain), so the queue is bounded and never blocks."""
+        with self._cond:
+            if self.fenced or self.closed or self._stop.is_set():
+                return
+            if len(self._marks) >= 1024:
+                del self._marks[:512]   # advisory: shed oldest
+            self._marks.append(ctl_line(t="mark", seq=int(seq),
+                                        fs=float(fs)))
+            self._cond.notify_all()
 
     def pending(self) -> int:
         with self._cond:
@@ -233,11 +252,16 @@ class IngestClient:
                     return False        # fenced (terminal)
             if self.fenced:
                 return False
-            # 2) push outbound frames
+            # 2) push outbound frames (marks first: a mark for seq N
+            #    is only meaningful if it reaches the server before
+            #    the batch holding N is synced away)
             with self._cond:
+                marks, self._marks = self._marks, []
                 batch = [] if self.paused \
                     else self._buf[self._sent:self._sent + 64]
                 drained = self.closed and not self._buf
+            if marks:
+                sock.sendall(b"".join(marks))
             if batch:
                 sock.sendall(b"".join(line for _, line in batch))
                 with self._cond:
@@ -342,6 +366,17 @@ class StreamingWAL(history_mod.HistoryWAL):
         # full client buffer blocks the producer here — backpressure
         # reaching the run loop is the point, not a hazard
         self.client.send(self._n, line)
+
+    def _post_sync(self, seq: int, ctx: Optional[str]) -> None:
+        # Traced records only: the mark stamps when record `seq`
+        # became locally durable (the fsync segment boundary of the
+        # detection-lag chain).  Untraced streams ship zero marks, so
+        # the bench's untraced drain path stays byte-identical.
+        if ctx is None:
+            return
+        self.client.send_mark(
+            seq,
+            time.time())  # lint: wall-ok(advisory lag stamp; ordering still rides seq)
 
     def close(self) -> None:
         super().close()

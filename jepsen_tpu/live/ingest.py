@@ -142,6 +142,7 @@ class _Session:
         self.last_live_poll = 0.0
         self.checker_offset = 0
         self.frames = {"ok": 0, "torn": 0, "dup": 0, "reorder": 0}
+        self.marks: list = []           # [(seq, fs)] durability marks
 
     @property
     def tenant(self) -> str:
@@ -405,12 +406,17 @@ class IngestServer:
     def _frames(self, sess: _Session, lines: list) -> None:
         wrote = 0
         ops_batch = []
+        traced_rows = []                # [(seq, w)] records carrying c
+        # lint: wall-ok(advisory trace stamp; protocol decisions ride seq/crc, never walls)
+        recv = time.time()
         for raw in lines:
             if raw.lstrip().startswith(b'{"ctl"'):
                 ctl = parse_ctl(raw) or {}
                 if ctl.get("t") == "bye":
                     self._sync(sess, wrote)
+                    self._trace_batch(sess, traced_rows, recv)
                     wrote = 0
+                    traced_rows = []
                     self._ack(sess)
                     got = lease_mod.renew(
                         sess.lease_dir, sess.lease,
@@ -420,6 +426,9 @@ class IngestServer:
                                 seq=sess.seq)
                     sess.dead = True
                     return
+                if ctl.get("t") == "mark":
+                    self._mark(sess, ctl)
+                    continue
                 continue                # unknown ctl: forward-compat
             if not raw.strip():
                 continue
@@ -467,11 +476,76 @@ class IngestServer:
                     buckets=LAG_BUCKETS_S).observe(
                         # lint: wall-ok(advisory lag metric; protocol decisions ride seq/crc, never w)
                         max(time.time() - w, 0.0))
+            if rec.get("c") is not None:
+                traced_rows.append((i, w))
             ops_batch.append(rec["op"])
         if wrote:
             self._sync(sess, wrote)
+            self._trace_batch(sess, traced_rows, recv)
             self._ack(sess)
             self._route(sess, ops_batch)
+
+    def _mark(self, sess: _Session, ctl: dict) -> None:
+        """A client durability mark: record `seq` hit the CLIENT's
+        disk at wall `fs` — the fsync boundary of the detection-lag
+        chain.  Advisory and bounded; a mark landing after its record
+        was already synced is forwarded straight to the scheduler as
+        a late fs-only stamp (the span join is by seq, not arrival)."""
+        seq, fs = ctl.get("seq"), ctl.get("fs")
+        if not isinstance(seq, int) or not isinstance(fs, (int, float)):
+            return
+        if seq < sess.seq:              # record already synced away
+            if self.scheduler is not None:
+                try:
+                    self.scheduler.note_transport(
+                        sess.key, [(seq, fs, None, None)])
+                except Exception:  # noqa: BLE001 - advisory stamps
+                    pass
+            return
+        if len(sess.marks) >= 4096:
+            del sess.marks[:2048]       # advisory: shed oldest
+        sess.marks.append((seq, float(fs)))
+
+    def _trace_batch(self, sess: _Session, rows: list,
+                     recv: float) -> None:
+        """Journal (non-durably) one `ingest-span` per synced batch
+        that carried traced records, and push the per-record transport
+        stamps to the in-process scheduler.  The journal copy is what
+        survives this worker's death — the takeover survivor's flag
+        page joins it by seq to recover the frame/ack segments the
+        dead worker measured (ISSUE 19 acceptance)."""
+        if not rows:
+            return
+        # lint: wall-ok(advisory trace stamp; acks already happened on the seq/crc path)
+        synced = time.time()
+        hi = sess.seq
+        marks, keep = {}, []
+        for mseq, mfs in sess.marks:
+            (marks.__setitem__(mseq, mfs) if mseq < hi
+             else keep.append((mseq, mfs)))
+        sess.marks = keep
+        base = marks.get(rows[0][0])
+        if base is None:
+            base = rows[0][1]           # fall back to the append wall
+        if isinstance(base, (int, float)):
+            telemetry.REGISTRY.histogram(
+                "live_ingest_frame_seconds",
+                buckets=LAG_BUCKETS_S).observe(max(recv - base, 0.0))
+        telemetry.REGISTRY.histogram(
+            "live_ingest_ack_seconds",
+            buckets=LAG_BUCKETS_S).observe(max(synced - recv, 0.0))
+        self._event("ingest-span", durable=False, tenant=sess.tenant,
+                    lo=rows[0][0], hi=hi, recv=round(recv, 6),
+                    synced=round(synced, 6),
+                    marks=[[s, round(f, 6)]
+                           for s, f in sorted(marks.items())])
+        if self.scheduler is not None:
+            try:
+                self.scheduler.note_transport(
+                    sess.key, [(s, marks.get(s), recv, synced)
+                               for s, _w in rows])
+            except Exception:  # noqa: BLE001 - advisory stamps
+                pass
 
     def _sync(self, sess: _Session, wrote: int) -> None:
         """Make journaled frames durable BEFORE they are acked: the

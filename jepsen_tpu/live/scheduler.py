@@ -63,6 +63,7 @@ from typing import Optional
 from jepsen_tpu import history as history_mod
 from jepsen_tpu import models as models_mod
 from jepsen_tpu import telemetry
+from jepsen_tpu import trace as trace_mod
 from jepsen_tpu.live import engine as engine_mod
 from jepsen_tpu.live import lease as lease_mod
 from jepsen_tpu.live.txn import TxnTenant, sniff_txn_workload
@@ -74,6 +75,32 @@ log = logging.getLogger("jepsen.live")
 # Detection-lag histogram buckets: sub-ms through tens of seconds.
 LAG_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                  0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+# Per-segment SLO bands (seconds): the in-code fallback when
+# store/ci/bench-baseline.json has no `lag_segment_<name>_s` row yet.
+# `live_lag_slo_burn{segment=}` reports the fraction of flags whose
+# segment exceeded its band — the honesty gauge ISSUE 19 ratchets.
+_SEGMENT_SLO_S = {"fsync": 0.05, "frame": 0.25, "ack": 0.25,
+                  "window": 2.0, "dispatch": 2.0, "flag": 1.0}
+
+
+def _segment_bands() -> dict:
+    """bench-baseline `lag_segment_<name>_s` rows override the in-code
+    defaults, so the burn gauge ratchets with the published prices."""
+    bands = dict(_SEGMENT_SLO_S)
+    try:
+        path = Path(__file__).resolve().parents[2] \
+            / "store" / "ci" / "bench-baseline.json"
+        with open(path) as f:
+            rows = json.load(f).get("rows") or {}
+        for seg in trace_mod.SEGMENTS:
+            row = rows.get(f"lag_segment_{seg}_s")
+            if isinstance(row, dict) \
+                    and isinstance(row.get("max"), (int, float)):
+                bands[seg] = float(row["max"])
+    except Exception:  # noqa: BLE001 - bands are advisory
+        pass
+    return bands
 
 # Store-root entries that are bookkeeping, never run dirs: the same
 # exclusion class store.tests() applies (campaigns/ci from PR 11,
@@ -135,6 +162,15 @@ class LiveScheduler:
         self.tenants: dict = {}        # (name, ts) -> Tenant
         self.finished: set = set()
         self._logs: dict = {}          # (name, ts) -> EventLog
+        # -- causal flight recorder (ISSUE 19) ---------------------------
+        self._tracelogs: dict = {}     # key -> trace-index EventLog
+        self._trace_links: dict = {}   # key -> cross-worker span link
+        self._transport: dict = {}     # key -> {seq: [fs, recv, syncd]}
+        self._transport_lock = threading.Lock()
+        self._seg_bands = _segment_bands()
+        self._seg_over: dict = {}      # segment -> flags over band
+        self._seg_n = 0                # flags with segments observed
+        self._seg_max: dict = {}       # segment -> worst seconds seen
         self._tick_n = 0
         self._dispatch_seq = 0
         self.flags_total = 0
@@ -327,6 +363,12 @@ class LiveScheduler:
         self._logs[key] = telemetry.EventLog(
             ts_dir / "live.jsonl", resume=resume,
             epoch=owned.epoch if owned is not None else None)
+        # the trace index rides beside live.jsonl with the same
+        # resume/epoch discipline: one causal record per flag, plus
+        # the cross-worker span links a takeover mints
+        self._tracelogs[key] = telemetry.EventLog(
+            ts_dir / "trace-index.jsonl", resume=resume,
+            epoch=owned.epoch if owned is not None else None)
         if owned is not None:
             with self._lease_lock:
                 self._leases[key] = owned
@@ -373,6 +415,7 @@ class LiveScheduler:
                                "seq": owned.seq},
                        silent_s=round(
                            getattr(owned, "_silent_s", 0.0), 3))
+            self._link_trace(key, owned, old)
 
     def _is_txn_run(self, ts_dir, owned) -> bool:
         st = getattr(owned, "state", None)
@@ -423,6 +466,140 @@ class LiveScheduler:
         if lg is not None:
             lg.append({"type": type_, **fields}, durable=durable)
 
+    def _emit_trace(self, key, type_: str, **fields) -> None:
+        lg = self._tracelogs.get(key)
+        if lg is not None:
+            lg.append({"type": type_, **fields}, durable=True)
+
+    # -- causal flight recorder (ISSUE 19) -----------------------------------
+
+    def _link_trace(self, key, owned, old) -> None:
+        """Mint the cross-worker span link on takeover: the dead
+        worker's checkpointed span (riding the lease `state` slot
+        exactly like the checker frontier) links to THIS worker's
+        deterministic resume span.  Journaled durably into the trace
+        index — once per takeover — so the flag's causal chain can
+        shade the handoff gap."""
+        st = getattr(owned, "state", None)
+        prev = st.get("trace") if isinstance(st, dict) else None
+        prev = prev if isinstance(prev, dict) else {}
+        parsed = trace_mod.parse_ctx(trace_mod.synth_ctx(
+            key[0], key[1], self.worker_id, owned.epoch))
+        trace_id, resume_span = parsed
+        link = {"trace_id": prev.get("trace_id") or trace_id,
+                "from_worker": prev.get("worker")
+                or getattr(old, "owner", None),
+                "from_epoch": prev.get("epoch")
+                or getattr(old, "epoch", None),
+                "from_span": prev.get("span"),
+                "to_worker": self.worker_id,
+                "to_epoch": owned.epoch,
+                "resume_span": resume_span,
+                "silent_s": round(
+                    getattr(owned, "_silent_s", 0.0), 3)}
+        self._trace_links[key] = link
+        self._emit_trace(key, "trace-link", **link)
+        telemetry.REGISTRY.counter("live_trace_links_total").inc()
+
+    def _wrap_trace_state(self, key, fs_state):
+        """Ride this worker's checkpoint span on the lease state slot
+        beside the checker frontier.  Extra keys are invisible to both
+        restore paths (window tenants match on `model`, txn tenants on
+        `txn`), so old readers behave exactly as before."""
+        with self._lease_lock:
+            mine = self._leases.get(key)
+        epoch = getattr(mine, "epoch", 0)
+        parsed = trace_mod.parse_ctx(trace_mod.synth_ctx(
+            key[0], key[1], self.worker_id, epoch))
+        out = dict(fs_state) if isinstance(fs_state, dict) else {}
+        out["trace"] = {"worker": self.worker_id, "epoch": epoch,
+                        "trace_id": parsed[0], "span": parsed[1]}
+        return out
+
+    def note_transport(self, key, rows) -> None:
+        """Transport stamps pushed by an in-process ingest server:
+        `rows` is [(seq, fs, recv, synced)] per traced record.  Late
+        stamps (a mark outrun by its ack) merge field-wise; the dict
+        is bounded per tenant — stamps are advisory, the flag path
+        collapses a missing one to a zero-width segment."""
+        key = tuple(key)
+        with self._transport_lock:
+            stamps = self._transport.setdefault(key, {})
+            for row in rows:
+                seq = row[0]
+                if not isinstance(seq, int):
+                    continue
+                slot = stamps.setdefault(seq, [None, None, None])
+                for j, v in enumerate(row[1:4]):
+                    if v is not None and slot[j] is None:
+                        slot[j] = float(v)
+            if len(stamps) > 8192:
+                for s in sorted(stamps)[:4096]:
+                    del stamps[s]
+
+    def _transport_for(self, key, seq) -> tuple:
+        if not isinstance(seq, int):
+            return (None, None, None)
+        with self._transport_lock:
+            slot = self._transport.get(tuple(key), {}).get(seq)
+            return tuple(slot) if slot else (None, None, None)
+
+    def _trace_flag(self, key, t, lane_repr: str, flag: dict,
+                    det, now: float, win_wall, dis_s,
+                    dispatch_id, engine) -> tuple:
+        """Journal one causal `trace-flag` record for a just-emitted
+        flag and feed the segment histograms + SLO burn gauges.
+        Returns (trace_id, dominant_segment) for the live-flag row.
+        Advisory end to end: any failure here must never block the
+        exactly-once flag emission, so the caller wraps it."""
+        ctx = flag.get("ctx")
+        parsed = trace_mod.parse_ctx(ctx) if ctx else None
+        if parsed is None:
+            parsed = trace_mod.parse_ctx(trace_mod.synth_ctx(
+                key[0], key[1], flag.get("op_index")))
+        trace_id, span_id = parsed
+        fs, recv, synced = self._transport_for(key, flag.get("seq"))
+        stamps = {"w": flag.get("wall"), "fs": fs, "recv": recv,
+                  "synced": synced, "win": win_wall, "dis_s": dis_s,
+                  "flag": now}
+        segs = trace_mod.lag_segments(stamps)
+        dominant = trace_mod.dominant_segment(segs)
+        if segs is not None:
+            self._seg_n += 1
+            for seg, v in segs.items():
+                telemetry.REGISTRY.histogram(
+                    "live_lag_segment_seconds", segment=seg,
+                    buckets=LAG_BUCKETS_S).observe(v)
+                if v > self._seg_max.get(seg, 0.0):
+                    self._seg_max[seg] = v
+                    telemetry.REGISTRY.gauge(
+                        "live_trace_max_segment_seconds",
+                        segment=seg).set(round(v, 6))
+                if v > self._seg_bands.get(seg, float("inf")):
+                    self._seg_over[seg] = \
+                        self._seg_over.get(seg, 0) + 1
+            for seg in trace_mod.SEGMENTS:
+                telemetry.REGISTRY.gauge(
+                    "live_lag_slo_burn", segment=seg).set(round(
+                        self._seg_over.get(seg, 0) / self._seg_n, 6))
+        link = self._trace_links.get(key)
+        self._emit_trace(
+            key, "trace-flag", trace_id=trace_id, span=span_id,
+            parent=link.get("resume_span") if link else None,
+            ctx_source="wal" if ctx else "synth",
+            lane=lane_repr, op_index=flag.get("op_index"),
+            f=flag.get("f"), event=flag.get("event"),
+            seq=flag.get("seq"),
+            stamps={k: round(v, 6) for k, v in stamps.items()
+                    if isinstance(v, (int, float))},
+            segments=segs,
+            lag_s=round(det, 6) if det is not None else None,
+            dominant=dominant, worker=self.worker_id,
+            epoch=getattr(self._leases.get(key), "epoch", None),
+            dispatch_id=dispatch_id, engine=engine, link=link)
+        telemetry.REGISTRY.counter("live_trace_records_total").inc()
+        return trace_id, dominant
+
     # -- fencing (fleet mode) ------------------------------------------------
 
     def _fenced(self, key, fresh: bool = False) -> bool:
@@ -463,6 +640,12 @@ class LiveScheduler:
         lg = self._logs.pop(key, None)
         if lg is not None:
             lg.close()
+        tlg = self._tracelogs.pop(key, None)
+        if tlg is not None:
+            tlg.close()
+        self._trace_links.pop(key, None)
+        with self._transport_lock:
+            self._transport.pop(key, None)
         log.warning("worker %s fenced off %s/%s (stale epoch %s); "
                     "publish refused, tenant dropped", self.worker_id,
                     key[0], key[1],
@@ -582,7 +765,7 @@ class LiveScheduler:
                 wl = sniff_txn_workload(seg.ops)
                 if wl is not None:
                     t = self._promote_txn(key, t, wl)
-            t.ingest(seg.ops, walls)
+            t.ingest(seg.ops, walls, ctxs=seg.ctxs, seqs=seg.seqs)
             t.offset, t.seq = seg.offset, seg.seq
             telemetry.REGISTRY.counter(
                 "live_ops_ingested_total").inc(len(seg.ops))
@@ -600,11 +783,13 @@ class LiveScheduler:
 
     def _collect(self) -> list:
         items = []
+        cut = self.clock()             # the window-cut stamp (`win`)
         for key, t in self.tenants.items():
             for lane_key, lane in t.lanes.items():
                 w = lane.take_window()
                 if w is not None:
                     w.lane_key = lane_key
+                    w.cut_wall = cut
                     items.append((key, lane_key, lane, w))
         return items
 
@@ -715,6 +900,16 @@ class LiveScheduler:
                     telemetry.REGISTRY.histogram(
                         "live_detection_lag_histogram_seconds",
                         buckets=LAG_BUCKETS_S).observe(det)
+                try:
+                    trace_id, dominant = self._trace_flag(
+                        key, t, repr(lane_key), flag, det, now,
+                        getattr(w, "cut_wall", None),
+                        disp.get("seconds"), disp.get("id"),
+                        v.get("engine"))
+                except Exception:  # noqa: BLE001 - tracing is
+                    trace_id = dominant = None   # advisory, the flag
+                    log.debug("trace-flag failed",  # is not
+                              exc_info=True)
                 self._emit(key, "live-flag", durable=True,
                            lane=repr(lane_key),
                            op_index=flag.get("op_index"),
@@ -725,7 +920,9 @@ class LiveScheduler:
                            if det is not None else None,
                            dispatch_id=disp.get("id"),
                            engine=v.get("engine"),
-                           cache=v.get("cache"))
+                           cache=v.get("cache"),
+                           trace=trace_id,
+                           lag_segment=dominant)
 
     # -- dispatch: transactional tenants (ISSUE 18) --------------------------
 
@@ -814,6 +1011,16 @@ class LiveScheduler:
                     telemetry.REGISTRY.histogram(
                         "live_detection_lag_histogram_seconds",
                         buckets=LAG_BUCKETS_S).observe(det)
+                try:
+                    win_wall = (now - win["seconds"]) if win else None
+                    trace_id, dominant = self._trace_flag(
+                        key, t, flag["lane"], flag, det, now,
+                        win_wall, win["seconds"] if win else None,
+                        None, flag.get("engine"))
+                except Exception:  # noqa: BLE001 - tracing is
+                    trace_id = dominant = None   # advisory, the flag
+                    log.debug("trace-flag failed",  # is not
+                              exc_info=True)
                 self._emit(key, "live-flag", durable=True,
                            lane=flag["lane"],
                            op_index=flag["op_index"],
@@ -822,7 +1029,9 @@ class LiveScheduler:
                            level=flag.get("level"),
                            detection_lag_s=round(det, 6)
                            if det is not None else None,
-                           engine=flag.get("engine"))
+                           engine=flag.get("engine"),
+                           trace=trace_id,
+                           lag_segment=dominant)
         return nwin
 
     # -- snapshots -----------------------------------------------------------
@@ -912,8 +1121,11 @@ class LiveScheduler:
                 t.safe_offset, t.safe_seq = t.offset, t.seq
                 if self.lease_ttl:
                     # the frontier capture pairs with THIS cursor: a
-                    # successor restoring it resumes exactly here
-                    t.safe_state = t.frontier_state()
+                    # successor restoring it resumes exactly here —
+                    # and carries this worker's checkpoint span, so a
+                    # takeover can mint the cross-worker span link
+                    t.safe_state = self._wrap_trace_state(
+                        key, t.frontier_state())
             if t.done and t.queue_depth == 0:
                 self._emit(key, "live-done", durable=True,
                            **{"verdict-so-far":
@@ -922,6 +1134,11 @@ class LiveScheduler:
                 lg = self._logs.pop(key, None)
                 if lg is not None:
                     lg.close()
+                tlg = self._tracelogs.pop(key, None)
+                if tlg is not None:
+                    tlg.close()
+                with self._transport_lock:
+                    self._transport.pop(key, None)
                 self.finished.add(key)
                 del self.tenants[key]
         self.renew_leases()
@@ -996,6 +1213,9 @@ class LiveScheduler:
         for lg in self._logs.values():
             lg.close()
         self._logs.clear()
+        for tlg in self._tracelogs.values():
+            tlg.close()
+        self._tracelogs.clear()
         if self._fleet_logger is not None:
             self._fleet_logger.close()
             self._fleet_logger = None

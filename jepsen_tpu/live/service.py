@@ -110,7 +110,11 @@ class CheckerService:
                   sched.max_takeover_lag_s, 4),
               "lag_p50_s": round(lag.quantile(0.5), 4),
               "lag_p99_s": round(lag.quantile(0.99), 4),
-              "bytes": sched._owned_bytes()}
+              "bytes": sched._owned_bytes(),
+              # the federation payload (ISSUE 19): the supervisor's
+              # /metrics and `cli metrics --fleet` merge these across
+              # workers via telemetry.federate()
+              "metrics": telemetry.REGISTRY.export()}
         d = sched.root / "fleet"
         try:
             d.mkdir(parents=True, exist_ok=True)

@@ -117,9 +117,11 @@ class TxnTenant:
         self._record_n = 0
         # incremental verification state
         self.inc: Optional[infer_mod.IncrementalInference] = None
-        self._pending: list = []       # (op, wall) awaiting feed
+        self._pending: list = []       # (op, wall, ctx, seq) to feed
         self._wall: dict = {}          # op index -> WAL append wall
-        self._wall_order: list = []    # pruning ring for _wall
+        self._ctx: dict = {}           # op index -> trace context
+        self._seqmap: dict = {}        # op index -> stream frame seq
+        self._wall_order: list = []    # pruning ring for the 3 above
         self._planes: Optional[np.ndarray] = None   # [5, n_pad, W]
         self._closure: Optional[np.ndarray] = None  # [3, n_pad, W]
         self._n_pad = 0
@@ -141,10 +143,16 @@ class TxnTenant:
 
     # -- ingest (scheduler verb) --------------------------------------------
 
-    def ingest(self, ops: list, walls: list) -> None:
+    def ingest(self, ops: list, walls: list,
+               ctxs: Optional[list] = None,
+               seqs: Optional[list] = None) -> None:
         """Buffer client ops in WAL order (cheap — the expensive feed
         + classify happens in `advance`, the dispatch phase)."""
-        for op, wall in zip(ops, walls):
+        if ctxs is None:
+            ctxs = [None] * len(ops)
+        if seqs is None:
+            seqs = [None] * len(ops)
+        for op, wall, ctx, seq in zip(ops, walls, ctxs, seqs):
             if op.index is None:
                 # same WAL-position synthesis as windows.Tenant: the
                 # run loop stamps indices at analyze time, not journal
@@ -157,13 +165,13 @@ class TxnTenant:
                 continue
             if op.type == INVOKE:
                 self.ops_ingested += 1
-            self._pending.append((op, wall))
+            self._pending.append((op, wall, ctx, seq))
             self.last_wall = wall
 
     # -- advance (dispatch verb) --------------------------------------------
 
     def _guess_workload(self) -> Optional[str]:
-        wl = sniff_txn_workload([op for op, _w in self._pending])
+        wl = sniff_txn_workload([row[0] for row in self._pending])
         return None if wl == "auto" else wl
 
     def advance(self, now: Optional[float] = None,
@@ -194,14 +202,20 @@ class TxnTenant:
                     wl = infer_mod.RW_REGISTER  # detect_workload default
                 self.inc = infer_mod.IncrementalInference(wl)
                 self.workload = wl
-            for op, wall in self._pending:
+            for op, wall, ctx, seq in self._pending:
                 self.inc.feed(op)
                 if isinstance(op.index, int):
                     self._wall[op.index] = wall
+                    if ctx is not None:
+                        self._ctx[op.index] = ctx
+                    if seq is not None:
+                        self._seqmap[op.index] = seq
                     self._wall_order.append(op.index)
             if len(self._wall_order) > 8192:
                 for idx in self._wall_order[:4096]:
                     self._wall.pop(idx, None)
+                    self._ctx.pop(idx, None)
+                    self._seqmap.pop(idx, None)
                 del self._wall_order[:4096]
             self._pending.clear()
             self._state_seq += 1
@@ -297,7 +311,9 @@ class TxnTenant:
                 "lane": f"txn:{name}", "op_index": op_index,
                 "f": "txn", "value": value, "event": name,
                 "level": _level_of(name),
-                "wall": wall, "engine": self._last_engine})
+                "wall": wall, "engine": self._last_engine,
+                "ctx": self._ctx.get(op_index),
+                "seq": self._seqmap.get(op_index)})
 
         for name, payloads in sorted(self.inc.direct().items()):
             seen = set()

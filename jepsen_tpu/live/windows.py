@@ -186,12 +186,14 @@ class LaneState:
 
     # -- ingest --------------------------------------------------------------
 
-    def on_invoke(self, process, f, val, op_index, wall) -> None:
+    def on_invoke(self, process, f, val, op_index, wall,
+                  ctx=None, seq=None) -> None:
         if self.saturated:
             return
         entry = {"kind": "inv", "p": process, "f": f, "val": val,
                  "idx": op_index, "wall": wall, "comp_idx": None,
-                 "slot": None, "gen": self.gen, "built": False}
+                 "slot": None, "gen": self.gen, "built": False,
+                 "ctx": ctx, "seq": seq}
         self.buffer.append(entry)
         self.open_refs[process] = entry
         self.open_in_buffer += 1
@@ -199,7 +201,7 @@ class LaneState:
             self._seal()               # forced cut: ops span it
 
     def on_complete(self, process, outcome, comp_val, op_index,
-                    wall) -> None:
+                    wall, ctx=None, seq=None) -> None:
         if self.saturated:
             return
         entry = self.open_refs.pop(process, None)
@@ -223,7 +225,8 @@ class LaneState:
                 self.buffer.append({"kind": "cancel", "p": process,
                                     "f": entry["f"],
                                     "val": entry["val"],
-                                    "idx": op_index, "wall": wall})
+                                    "idx": op_index, "wall": wall,
+                                    "ctx": ctx, "seq": seq})
             elif outcome == INFO:
                 j = self.span_slot.pop(process, None)
                 self.span_payload.pop(process, None)
@@ -236,7 +239,8 @@ class LaneState:
                 self.buffer.append({"kind": "ret", "p": process,
                                     "f": entry["f"],
                                     "val": entry["val"],
-                                    "idx": op_index, "wall": wall})
+                                    "idx": op_index, "wall": wall,
+                                    "ctx": ctx, "seq": seq})
         else:
             if outcome == FAIL or (outcome == INFO
                                    and entry["f"] == "read"):
@@ -247,10 +251,13 @@ class LaneState:
                 if entry["val"] is None:
                     entry["val"] = comp_val
                 entry["comp_idx"] = op_index
+                if entry.get("ctx") is None:
+                    entry["ctx"] = ctx  # invoke predates the span
                 self.buffer.append({"kind": "ret", "p": process,
                                     "f": entry["f"],
                                     "val": entry["val"],
-                                    "idx": op_index, "wall": wall})
+                                    "idx": op_index, "wall": wall,
+                                    "ctx": ctx, "seq": seq})
         if self.open_in_buffer == 0 and self.buffer:
             self._seal()               # quiescent cut: exact
 
@@ -487,7 +494,9 @@ class LaneState:
                 ev_legal.append(None)
                 op_refs.append({"op_index": e["idx"], "process": e["p"],
                                 "f": e["f"], "value": e["val"],
-                                "wall": e["wall"]})
+                                "wall": e["wall"],
+                                "ctx": e.get("ctx"),
+                                "seq": e.get("seq")})
                 walls.append(e["wall"])
                 continue
             tab = self._tables(e["f"], e["val"])
@@ -505,7 +514,9 @@ class LaneState:
                 else e["idx"]
             op_refs.append({"op_index": ref_idx, "process": e["p"],
                             "f": e["f"], "value": e["val"],
-                            "wall": e["wall"]})
+                            "wall": e["wall"],
+                            "ctx": e.get("ctx"),
+                            "seq": e.get("seq")})
             walls.append(e["wall"])
             if kind == "info":
                 self.residue[j] = (e["f"], e["val"], e["idx"])
@@ -664,7 +675,9 @@ class LaneState:
                 "op_index": ref.get("op_index"),
                 "f": ref.get("f"),
                 "value": ref.get("value"),
-                "wall": ref.get("wall")}
+                "wall": ref.get("wall"),
+                "ctx": ref.get("ctx"),
+                "seq": ref.get("seq")}
         self.flags.append(flag)
         # re-arm past the refutation so later, independent violations
         # can still surface (the verdict-so-far stays false); not a
@@ -756,7 +769,13 @@ class Tenant:
         except Exception:       # noqa: BLE001 - degrade to the loop
             return None
 
-    def ingest(self, ops: list, walls: list) -> None:
+    def ingest(self, ops: list, walls: list,
+               ctxs: Optional[list] = None,
+               seqs: Optional[list] = None) -> None:
+        if ctxs is None:
+            ctxs = [None] * len(ops)
+        if seqs is None:
+            seqs = [None] * len(ops)
         routed = self._route_native(ops) if ops else None
         if routed is not None:
             kinds, procs_b, idxs_b, fs, keys, vals = routed
@@ -772,7 +791,8 @@ class Tenant:
                     key = keys[i]
                     self.open_by_process[p] = key
                     self.lane(key).on_invoke(p, fs[i], vals[i],
-                                             int(idxs[i]), wall)
+                                             int(idxs[i]), wall,
+                                             ctx=ctxs[i], seq=seqs[i])
                     self.ops_ingested += 1
                 elif k == 4:           # unknown op type
                     self.skipped += 1
@@ -783,9 +803,10 @@ class Tenant:
                         continue
                     self.lane(key).on_complete(
                         p, self._TYPE_OF_KIND[k], vals[i],
-                        int(idxs[i]), wall)
+                        int(idxs[i]), wall,
+                        ctx=ctxs[i], seq=seqs[i])
             return
-        for op, wall in zip(ops, walls):
+        for op, wall, ctx, seq in zip(ops, walls, ctxs, seqs):
             # the run loop assigns op.index at analyze time, not at
             # journal time: synthesize the WAL position (the same
             # order History.index() will stamp) so flags carry a real
@@ -799,7 +820,8 @@ class Tenant:
             if op.type == INVOKE:
                 key, val = self._split_kv(op.value)
                 self.open_by_process[p] = key
-                self.lane(key).on_invoke(p, op.f, val, op.index, wall)
+                self.lane(key).on_invoke(p, op.f, val, op.index, wall,
+                                         ctx=ctx, seq=seq)
                 self.ops_ingested += 1
             elif op.type in (OK, FAIL, INFO):
                 key = self.open_by_process.pop(p, _MISSING)
@@ -808,7 +830,7 @@ class Tenant:
                     continue
                 _k, val = self._split_kv(op.value)
                 self.lane(key).on_complete(p, op.type, val, op.index,
-                                           wall)
+                                           wall, ctx=ctx, seq=seq)
             else:
                 self.skipped += 1
 

@@ -238,6 +238,29 @@ class MetricsRegistry:
                            else f"{name}_count {c}")
         return "\n".join(out) + ("\n" if out else "")
 
+    def export(self) -> dict:
+        """JSON-able dump of every metric — the worker-sidecar half of
+        fleet federation (ISSUE 19): each fleet worker embeds this in
+        its `store/fleet/<id>.json` status, and `federate()` re-renders
+        the merged set as one exposition with `worker_id` labels."""
+        out = {}
+        for name, (kind, by_label) in sorted(self.collect().items()):
+            samples = []
+            for key, m in sorted(by_label.items()):
+                labels = {k: v for k, v in key}
+                if kind in ("counter", "gauge"):
+                    samples.append({"labels": labels,
+                                    "value": m.value})
+                else:
+                    with m._lock:
+                        samples.append({"labels": labels,
+                                        "buckets": list(m.buckets),
+                                        "counts": list(m.counts),
+                                        "sum": m.sum,
+                                        "count": m.count})
+            out[name] = {"kind": kind, "samples": samples}
+        return out
+
 
 # The process-global registry: engines, breakers, and the runner record
 # into it without per-test plumbing (Prometheus semantics — counters
@@ -248,6 +271,85 @@ REGISTRY = MetricsRegistry()
 def snapshot() -> str:
     """Prometheus text exposition of the process-global registry."""
     return REGISTRY.snapshot()
+
+
+def federate(root, now: "float | None" = None,
+             stale_after: "float | None" = None) -> str:
+    """One Prometheus exposition for the whole fleet: merge every
+    `store/fleet/<worker>.json` metrics snapshot, each sample labeled
+    with its `worker_id`, NEVER summed across workers — two workers'
+    counters are two time series, and collapsing them would silently
+    launder a dead worker's last value into a live total.
+
+    Staleness honesty: a worker whose snapshot is older than
+    `stale_after` (default 3x its own lease TTL) contributes only
+    `fleet_worker_stale{worker_id=...} 1` — its metrics are withheld,
+    visibly, rather than served as if current."""
+    root = Path(root)
+    if now is None:
+        now = time.time()  # lint: wall-ok(staleness display; ownership truth stays in lease epochs)
+    merged: dict = {}      # name -> [kind, [(labels, sample), ...]]
+
+    def add(name, kind, labels, sample):
+        ent = merged.setdefault(name, [kind, []])
+        if ent[0] == kind:
+            ent[1].append((labels, sample))
+
+    for p in sorted((root / "fleet").glob("*.json")):
+        try:
+            with open(p) as f:
+                st = json.load(f)
+        except Exception:  # noqa: BLE001 - a torn sidecar is skipped
+            continue
+        if not isinstance(st, dict) or not st.get("worker"):
+            continue
+        wid = str(st["worker"])
+        age = max(now - float(st.get("updated") or 0.0), 0.0)
+        ttl = float(st.get("lease_ttl") or 0.0)
+        limit = stale_after if stale_after is not None \
+            else (3.0 * ttl if ttl > 0 else 10.0)
+        stale = age > limit
+        add("fleet_worker_stale", "gauge", {"worker_id": wid},
+            {"value": 1.0 if stale else 0.0})
+        add("fleet_worker_age_seconds", "gauge", {"worker_id": wid},
+            {"value": round(age, 3)})
+        if stale:
+            continue
+        metrics = st.get("metrics")
+        if not isinstance(metrics, dict):
+            continue
+        for name, spec in sorted(metrics.items()):
+            if not isinstance(spec, dict):
+                continue
+            for s in spec.get("samples") or []:
+                labels = dict(s.get("labels") or {})
+                labels["worker_id"] = wid
+                add(name, spec.get("kind"), labels, s)
+
+    out = []
+    for name, (kind, rows) in sorted(merged.items()):
+        out.append(f"# TYPE {name} {kind}")
+        for labels, s in rows:
+            lab = ",".join(f'{k}="{_esc(str(v))}"'
+                           for k, v in sorted(labels.items()))
+            if kind in ("counter", "gauge"):
+                v = s.get("value")
+                v = float(v) if isinstance(v, (int, float)) else 0.0
+                out.append(f"{name}{{{lab}}} {v:g}")
+                continue
+            buckets = s.get("buckets") or []
+            counts = s.get("counts") or []
+            acc = 0
+            for i, b in enumerate(buckets):
+                acc += counts[i] if i < len(counts) else 0
+                out.append(
+                    f'{name}_bucket{{{lab},le="{float(b):g}"}} {acc}')
+            c = s.get("count") or 0
+            out.append(f'{name}_bucket{{{lab},le="+Inf"}} {c}')
+            out.append(f"{name}_sum{{{lab}}} "
+                       f"{float(s.get('sum') or 0.0):g}")
+            out.append(f"{name}_count{{{lab}}} {c}")
+    return "\n".join(out) + ("\n" if out else "")
 
 
 # ---------------------------------------------------------------------------

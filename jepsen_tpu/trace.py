@@ -123,6 +123,7 @@ class Tracer:
         self.endpoint = endpoint
         self._sink = sink          # callable(span_map) | None
         self._spans: list = []
+        self._pending: list = []   # finished before any sink attached
         self._lock = threading.Lock()
 
     # -- recording ---------------------------------------------------------
@@ -153,19 +154,37 @@ class Tracer:
 
     def set_sink(self, sink) -> None:
         """Attach (or replace) the per-span sink callable — core.run
-        uses this to bridge spans into the telemetry event log."""
+        uses this to bridge spans into the telemetry event log.  Spans
+        that finished BEFORE a sink was attached (nemesis/campaign
+        orchestrator setup spans open during core.run's bootstrap) are
+        buffered and flushed through the new sink here, so attach
+        order can't silently drop the head of the trace."""
         with self._lock:
             self._sink = sink
+            pending, self._pending = self._pending, []
+        if sink is None:
+            return
+        for m in pending:
+            try:
+                sink(m)
+            except Exception:       # noqa: BLE001 - sinks must not
+                pass                # fail the traced operation
 
     def _emit(self, span: Span) -> None:
+        global _finished
         m = span.to_map()
         with self._lock:
+            _finished += 1
             self._spans.append(m)
-            if self._sink is not None:
-                try:
-                    self._sink(m)
-                except Exception:   # noqa: BLE001 - sinks must not
-                    pass            # fail the traced operation
+            if self._sink is None:
+                # no sink yet: hold the span for set_sink's flush
+                self._pending.append(m)
+                return
+            sink = self._sink
+        try:
+            sink(m)
+        except Exception:           # noqa: BLE001 - sinks must not
+            pass                    # fail the traced operation
 
     # -- export ------------------------------------------------------------
 
@@ -206,6 +225,114 @@ class Tracer:
 
 
 _NOOP = Tracer(enabled=False)
+
+# process-wide finished-span count: the tier-1 artifact's trace row
+# reads it so a regression that silently stops opening spans (tracer
+# wired but never enabled) diffs across PRs instead of hiding
+_finished = 0
+
+
+def spans_finished() -> int:
+    return _finished
+
+
+# ---------------------------------------------------------------------------
+# W3C-style context propagation (ISSUE 19)
+#
+# The causal flight recorder threads one trace context through the op
+# lifecycle: the context of the innermost OPEN span on the appending
+# thread rides the WAL record as the uncrc'd envelope field `c`
+# (beside PR 16's `w` and PR 17's `e`), survives the wire verbatim
+# (frames are raw WAL bytes), and is read back by the scheduler when
+# the op surfaces in a window.  The serialized form is
+# `<32-hex traceId>-<16-hex spanId>` — the traceparent fields that
+# matter here, without version/flags noise.
+# ---------------------------------------------------------------------------
+
+def current_ctx() -> Optional[str]:
+    """Serialize the innermost open span on THIS thread as a wire
+    context string, or None when no traced span is open.  HistoryWAL
+    .append calls this on the client worker thread, where core.run's
+    `client/invoke` span is still open around the completion append."""
+    stack = getattr(_local, "spans", None)
+    if not stack:
+        return None
+    top = stack[-1]
+    return f"{top.trace_id}-{top.span_id}"
+
+
+def parse_ctx(ctx) -> Optional[tuple]:
+    """`"<traceId>-<spanId>"` -> (trace_id, span_id), or None when the
+    field is absent/garbled (a torn envelope must never break the
+    reader — same forward-compat stance as unknown ctl frames)."""
+    if not isinstance(ctx, str):
+        return None
+    trace_id, sep, span_id = ctx.rpartition("-")
+    if not sep or not trace_id or not span_id:
+        return None
+    return trace_id, span_id
+
+
+# The detection-lag segment taxonomy (docs/observability.md):
+#   fsync    append wall (`w`)      -> client WAL durable (mark `fs`)
+#   frame    client durable         -> ingest receipt (`recv`)
+#   ack      ingest receipt         -> remote WAL fsynced+acked (`synced`)
+#   window   remote durable         -> scheduler window cut (`win`)
+#   dispatch window cut             -> engine verdict (`win + dis_s`)
+#   flag     engine verdict         -> durable live-flag (`flag`)
+SEGMENTS = ("fsync", "frame", "ack", "window", "dispatch", "flag")
+
+
+def lag_segments(stamps: dict) -> Optional[dict]:
+    """Decompose one flag's detection lag into the six named segments
+    from its stamp chain `{w, fs, recv, synced, win, dis_s, flag}`.
+    Missing stamps (a local run has no transport; a takeover survivor
+    may lack the dead ingest tier's marks) collapse to zero-width, and
+    every stamp is monotonized into `[w, flag]`, so the segments ALWAYS
+    sum to exactly `flag - w` — the measured detection lag — never to
+    an approximation of it."""
+    w, flag = stamps.get("w"), stamps.get("flag")
+    if not isinstance(w, (int, float)) \
+            or not isinstance(flag, (int, float)):
+        return None
+    end = max(float(flag), float(w))
+    win = stamps.get("win")
+    dis_s = stamps.get("dis_s")
+    done = (win + dis_s) if isinstance(win, (int, float)) \
+        and isinstance(dis_s, (int, float)) else win
+    chain = [stamps.get("fs"), stamps.get("recv"),
+             stamps.get("synced"), win, done]
+    bounds, prev = [float(w)], float(w)
+    for t in chain:
+        t = prev if not isinstance(t, (int, float)) \
+            else min(max(float(t), prev), end)
+        bounds.append(t)
+        prev = t
+    bounds.append(end)
+    return {name: round(b - a, 6) for name, a, b
+            in zip(SEGMENTS, bounds[:-1], bounds[1:])}
+
+
+def dominant_segment(segments: Optional[dict]) -> Optional[str]:
+    """The segment that ate the most of a flag's detection lag — the
+    campaign signature's lag-bucket qualifier (ISSUE 19)."""
+    if not segments:
+        return None
+    best = max(SEGMENTS, key=lambda s: segments.get(s) or 0.0)
+    return best if (segments.get(best) or 0.0) > 0.0 else None
+
+
+def synth_ctx(*parts) -> str:
+    """A deterministic context for untraced ops, derived from stable
+    identifiers (tenant name, seq, worker id) instead of the RNG —
+    two workers reconstructing the same op's chain derive the same
+    ids, and replays are byte-stable."""
+    import zlib
+    seed = "\x00".join(str(p) for p in parts).encode()
+    a = zlib.crc32(seed)
+    b = zlib.crc32(seed, 0x9E3779B9)
+    c = zlib.crc32(seed, 0x85EBCA6B)
+    return f"{a:08x}{b:08x}{a ^ b:08x}{c:08x}-{b:08x}{c:08x}"
 
 
 def tracer(test_or_opts=None) -> Tracer:

@@ -115,6 +115,7 @@ def home_html() -> bytes:
             "<a href='/live'>live</a> &middot; "
             "<a href='/fleet'>fleet</a> &middot; "
             "<a href='/ingest'>ingest</a> &middot; "
+            "<a href='/trace'>traces</a> &middot; "
             "<a href='/campaign'>campaigns</a> &middot; "
             "<a href='/metrics'>metrics</a></p>"
             "<table><tr><th>Test</th><th>Time</th>"
@@ -932,6 +933,271 @@ def _dispatch_plans_html(events) -> str:
             + "".join(rows) + "</table>")
 
 
+# ---------------------------------------------------------------------------
+# Causal flight recorder (ISSUE 19): /trace index, per-run flag list,
+# and the /trace/<name>/<ts>/<trace_id> waterfall with the detection-
+# lag decomposition and the cross-worker handoff link shaded.
+# ---------------------------------------------------------------------------
+
+_SEGMENT_COLORS = {"fsync": "#B8D4F0", "frame": "#A8E6CF",
+                   "ack": "#FFE9A8", "window": "#F5C6A0",
+                   "dispatch": "#E0B8F0", "flag": "#F3BBBC"}
+
+
+def _trace_events(d: Path) -> list:
+    from jepsen_tpu import telemetry
+    p = d / "trace-index.jsonl"
+    if not p.exists():
+        return []
+    try:
+        return telemetry.read_events(p)
+    except Exception:  # noqa: BLE001 - a torn index renders empty
+        return []
+
+
+def _ingest_span_stamps(tenant: str, seq) -> tuple:
+    """(fs, recv, synced) for one streamed record, joined at render
+    time from every ingest server journal under store/ingest/ — the
+    copy that survives the worker that measured them being SIGKILLed
+    (the takeover survivor's flag page still renders the full chain)."""
+    from jepsen_tpu import telemetry
+    fs = recv = synced = None
+    root = store.BASE / "ingest"
+    if not isinstance(seq, int) or not root.is_dir():
+        return fs, recv, synced
+    for p in sorted(root.glob("*.jsonl")):
+        try:
+            evs = telemetry.read_events(p)
+        except Exception:  # noqa: BLE001 - skip torn journals
+            continue
+        for ev in evs:
+            if ev.get("type") != "ingest-span" \
+                    or ev.get("tenant") != tenant:
+                continue
+            for mark in ev.get("marks") or []:
+                if isinstance(mark, list) and len(mark) == 2 \
+                        and mark[0] == seq and fs is None:
+                    fs = mark[1]
+            lo, hi = ev.get("lo"), ev.get("hi")
+            if isinstance(lo, int) and isinstance(hi, int) \
+                    and lo <= seq < hi:
+                recv = ev.get("recv") if recv is None else recv
+                synced = ev.get("synced") if synced is None \
+                    else synced
+    return fs, recv, synced
+
+
+def _resolve_segments(name: str, ts: str, rec: dict) -> dict:
+    """The record's segment decomposition, re-derived after joining
+    any transport stamps the emitting worker lacked (its in-memory
+    stamps died with it; the ingest journal's copy did not)."""
+    from jepsen_tpu import trace as trace_mod
+    stamps = dict(rec.get("stamps") or {})
+    if any(stamps.get(k) is None for k in ("fs", "recv", "synced")):
+        fs, recv, synced = _ingest_span_stamps(f"{name}/{ts}",
+                                               rec.get("seq"))
+        for k, v in (("fs", fs), ("recv", recv), ("synced", synced)):
+            if stamps.get(k) is None and v is not None:
+                stamps[k] = v
+    segs = trace_mod.lag_segments(stamps)
+    return segs if segs is not None else (rec.get("segments") or {})
+
+
+def trace_index_html(slowest: int = 0) -> bytes:
+    rows = []
+    for name, stamps in sorted(store.tests().items()):
+        for ts in sorted(stamps, reverse=True):
+            d = store.BASE / store._sanitize(name) / ts
+            evs = _trace_events(d)
+            if not evs:
+                continue
+            flags = [e for e in evs if e.get("type") == "trace-flag"]
+            links = [e for e in evs if e.get("type") == "trace-link"]
+            worst = max((e.get("lag_s") or 0.0 for e in flags),
+                        default=0.0)
+            rows.append((worst, name, ts, len(flags), len(links)))
+    rows.sort(reverse=True)
+    if slowest:
+        rows = rows[:slowest]
+    body = ("<h1>Traces</h1>"
+            "<p><a href='/'>&larr; tests</a> &middot; "
+            "<a href='/live'>live</a> &middot; "
+            "<a href='/metrics'>metrics</a></p>"
+            "<table><tr><th>Test</th><th>Run</th><th>Flags traced</th>"
+            "<th>Handoff links</th><th>Worst lag (s)</th></tr>"
+            + "".join(
+                f"<tr><td>{html.escape(n)}</td>"
+                f"<td><a href='/trace/{quote(n)}/{quote(t)}'>"
+                f"{html.escape(t)}</a></td>"
+                f"<td>{nf}</td><td>{nl}</td><td>{w:.4f}</td></tr>"
+                for w, n, t, nf, nl in rows)
+            + "</table>")
+    if not rows:
+        body += ("<p>(no traced flags yet — run with "
+                 "<code>trace: true</code> under a serve-checker)</p>")
+    return _page("Traces", body)
+
+
+def trace_run_html(name: str, ts: str) -> bytes:
+    d = _safe_path(f"{name}/{ts}")
+    evs = _trace_events(d)
+    flags = [e for e in evs if e.get("type") == "trace-flag"]
+    links = [e for e in evs if e.get("type") == "trace-link"]
+    base = f"/trace/{quote(name)}/{quote(ts)}"
+    body = [f"<h1>{html.escape(name)} / {html.escape(ts)} "
+            "&mdash; traces</h1>",
+            "<p><a href='/trace'>&larr; traces</a> &middot; "
+            f"<a href='/live/{quote(name)}/{quote(ts)}'>live</a> "
+            "&middot; "
+            f"<a href='/files/{quote(name)}/{quote(ts)}/"
+            "trace-index.jsonl'>raw index</a></p>"]
+    if links:
+        body.append(
+            "<h2>Cross-worker handoffs</h2><table><tr>"
+            "<th>From</th><th>Epoch</th><th>To</th><th>Epoch</th>"
+            "<th>Resume span</th><th>Silent (s)</th></tr>"
+            + "".join(
+                f"<tr style='background:{UNKNOWN_COLOR}'>"
+                f"<td>{html.escape(str(lk.get('from_worker')))}</td>"
+                f"<td>{html.escape(str(lk.get('from_epoch')))}</td>"
+                f"<td>{html.escape(str(lk.get('to_worker')))}</td>"
+                f"<td>{html.escape(str(lk.get('to_epoch')))}</td>"
+                f"<td><code>{html.escape(str(lk.get('resume_span')))}"
+                "</code></td>"
+                f"<td>{lk.get('silent_s')}</td></tr>"
+                for lk in links)
+            + "</table>")
+    body.append(
+        "<h2>Traced flags</h2><table><tr><th>Trace</th><th>Lane</th>"
+        "<th>Op</th><th>Event</th><th>Lag (s)</th>"
+        "<th>Dominant segment</th><th>Worker</th></tr>"
+        + "".join(
+            f"<tr><td><a href='{base}/{quote(str(f.get('trace_id')))}'>"
+            f"<code>{html.escape(str(f.get('trace_id'))[:16])}&hellip;"
+            "</code></a></td>"
+            f"<td>{html.escape(str(f.get('lane')))}</td>"
+            f"<td>{html.escape(str(f.get('op_index')))}</td>"
+            f"<td>{html.escape(str(f.get('event')))}</td>"
+            f"<td>{f.get('lag_s')}</td>"
+            f"<td>{html.escape(str(f.get('dominant')))}</td>"
+            f"<td>{html.escape(str(f.get('worker')))}</td></tr>"
+            for f in flags)
+        + "</table>")
+    if not flags:
+        body.append("<p>(no traced flags in this run)</p>")
+    return _page(f"traces {name}/{ts}", "".join(body))
+
+
+def trace_flag_html(name: str, ts: str, trace_id: str) -> bytes:
+    d = _safe_path(f"{name}/{ts}")
+    evs = _trace_events(d)
+    recs = [e for e in evs if e.get("type") == "trace-flag"
+            and str(e.get("trace_id")) == trace_id]
+    if not recs:
+        raise FileNotFoundError(trace_id)
+    links = [e for e in evs if e.get("type") == "trace-link"]
+    body = [f"<h1>trace <code>{html.escape(trace_id[:16])}&hellip;"
+            f"</code> &mdash; {html.escape(name)} / {html.escape(ts)}"
+            "</h1>",
+            f"<p><a href='/trace/{quote(name)}/{quote(ts)}'>"
+            "&larr; run traces</a></p>"]
+    for rec in recs:
+        segs = _resolve_segments(name, ts, rec)
+        lag = rec.get("lag_s")
+        total = sum(v for v in segs.values()
+                    if isinstance(v, (int, float))) if segs else 0.0
+        body.append(
+            f"<h2>flag: {html.escape(str(rec.get('event')))} on lane "
+            f"{html.escape(str(rec.get('lane')))} (op "
+            f"{html.escape(str(rec.get('op_index')))})</h2>"
+            f"<p>span <code>{html.escape(str(rec.get('span')))}</code>"
+            f" &middot; worker {html.escape(str(rec.get('worker')))}"
+            f" (epoch {html.escape(str(rec.get('epoch')))})"
+            f" &middot; context from "
+            f"{html.escape(str(rec.get('ctx_source')))}"
+            f" &middot; dispatch "
+            f"{html.escape(str(rec.get('dispatch_id')))}</p>")
+        # the handoff gap, shaded, between the dead worker's last
+        # span and this record's parent (the survivor's resume span)
+        for lk in links:
+            if lk.get("resume_span") == rec.get("parent"):
+                body.append(
+                    f"<p style='background:{UNKNOWN_COLOR};"
+                    "padding:.5em'>cross-worker handoff: "
+                    f"<b>{html.escape(str(lk.get('from_worker')))}</b>"
+                    f" (epoch {html.escape(str(lk.get('from_epoch')))}"
+                    f", span <code>"
+                    f"{html.escape(str(lk.get('from_span')))}</code>)"
+                    " &rarr; "
+                    f"<b>{html.escape(str(lk.get('to_worker')))}</b>"
+                    f" resume span <code>"
+                    f"{html.escape(str(lk.get('resume_span')))}</code>"
+                    f" after {lk.get('silent_s')}s of silence</p>")
+        if segs and total > 0:
+            bars = "".join(
+                f"<td style='background:"
+                f"{_SEGMENT_COLORS.get(seg, '#EAEAEA')};width:"
+                f"{max(int(600 * (segs.get(seg) or 0) / total), 1)}px'"
+                f" title='{html.escape(seg)}: {segs.get(seg)}s'>"
+                "</td>"
+                for seg in _SEGMENT_COLORS)
+            body.append(
+                "<table><tr>" + bars + "</tr></table>"
+                "<table><tr><th>Segment</th><th>Seconds</th>"
+                "<th>Share</th></tr>"
+                + "".join(
+                    f"<tr><td style='background:"
+                    f"{_SEGMENT_COLORS.get(seg, '#EAEAEA')}'>"
+                    f"{html.escape(seg)}</td>"
+                    f"<td>{segs.get(seg)}</td>"
+                    f"<td>{100.0 * (segs.get(seg) or 0) / total:.1f}%"
+                    "</td></tr>"
+                    for seg in _SEGMENT_COLORS)
+                + "</table>")
+            if isinstance(lag, (int, float)) and lag > 0:
+                pct = abs(total - lag) / lag * 100.0
+                body.append(
+                    f"<p>segments sum to {total:.6f}s vs measured "
+                    f"flag lag {lag}s ({pct:.1f}% apart)</p>")
+        stamps = rec.get("stamps") or {}
+        body.append(
+            "<h3>stamps</h3><table>"
+            + "".join(
+                f"<tr><th>{html.escape(k)}</th>"
+                f"<td>{stamps.get(k)}</td></tr>"
+                for k in ("w", "fs", "recv", "synced", "win",
+                          "dis_s", "flag") if k in stamps)
+            + "</table>")
+    return _page(f"trace {trace_id[:16]}", "".join(body))
+
+
+def metrics_text() -> str:
+    """/metrics: the process exposition — federated with every fleet
+    worker's exported snapshot (worker_id-labeled, stale-marked) when
+    store/fleet/ sidecars exist.  Collisions resolve toward the
+    federation: a supervisor's own registry says nothing useful about
+    the workers doing the checking."""
+    from jepsen_tpu import telemetry
+    local = telemetry.snapshot()
+    try:
+        if not any((store.BASE / "fleet").glob("*.json")):
+            return local
+        fed = telemetry.federate(store.BASE)
+    except Exception:  # noqa: BLE001 - federation must not break
+        return local   # scraping the process metrics
+    if not fed:
+        return local
+    fed_names = {ln.split()[2] for ln in fed.splitlines()
+                 if ln.startswith("# TYPE ")}
+    keep, skip = [], False
+    for ln in local.splitlines():
+        if ln.startswith("# TYPE "):
+            skip = ln.split()[2] in fed_names
+        if not skip:
+            keep.append(ln)
+    return fed + "\n".join(keep) + ("\n" if keep else "")
+
+
 def zip_bytes(name: str, ts: str) -> bytes:
     d = _safe_path(f"{name}/{ts}")
     buf = io.BytesIO()
@@ -969,10 +1235,19 @@ class Handler(BaseHTTPRequestHandler):
             if path == "/" or path == "":
                 return self._send(200, home_html())
             if path == "/metrics":
-                from jepsen_tpu import telemetry
-                return self._send(200, telemetry.snapshot().encode(),
+                return self._send(200, metrics_text().encode(),
                                   "text/plain; version=0.0.4; "
                                   "charset=utf-8")
+            if path == "/trace" or path == "/trace/":
+                return self._send(200, trace_index_html())
+            if path.startswith("/trace/"):
+                parts = [unquote(x) for x in
+                         path[len("/trace/"):].strip("/").split("/")]
+                if len(parts) == 2:
+                    return self._send(200, trace_run_html(*parts))
+                if len(parts) == 3:
+                    return self._send(200, trace_flag_html(*parts))
+                return self._send(404, b"not found", "text/plain")
             if path == "/fleet" or path == "/fleet/":
                 return self._send(200, fleet_html())
             if path == "/ingest" or path == "/ingest/":

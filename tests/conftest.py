@@ -233,6 +233,43 @@ def _live_txn_summary():
         return None
 
 
+def _trace_summary():
+    """The causal flight recorder's counters (ISSUE 19): finished
+    spans, durable trace-flag records, linked lease handoffs, and the
+    widest detection-lag segment observed — recorded so a regression
+    that silently stops threading context (spans drop to 0 while the
+    suite stays green), loses the takeover span link, or blows a
+    segment out diffs across PRs.  Counts cover THIS process only;
+    kill9 subprocess workers keep their own registries.  None when no
+    span finished and no flag was traced this session."""
+    try:
+        from jepsen_tpu import telemetry, trace
+        coll = telemetry.REGISTRY.collect()
+
+        def total(name):
+            _k, by_label = coll.get(name, (None, {}))
+            return int(sum(m.value for m in by_label.values())) \
+                if by_label else 0
+
+        spans = trace.spans_finished()
+        records = total("live_trace_records_total")
+        if not spans and not records:
+            return None
+        _k, by_seg = coll.get("live_trace_max_segment_seconds",
+                              (None, {}))
+        max_seg = None
+        for key, m in (by_seg or {}).items():
+            if max_seg is None or m.value > max_seg["s"]:
+                max_seg = {"segment": dict(key).get("segment", "?"),
+                           "s": round(m.value, 4)}
+        return {"spans": spans,
+                "records": records,
+                "linked_handoffs": total("live_trace_links_total"),
+                "max_segment": max_seg}
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _campaign_summary():
     """The tier-1 smoke campaign's counters (ISSUE 13):
     run/novel/deduped/quarantined schedule counts from the registry —
@@ -294,6 +331,7 @@ def pytest_sessionfinish(session, exitstatus):
             "fleet": _fleet_summary(),
             "live_txn": _live_txn_summary(),
             "ingest": _ingest_summary(),
+            "trace": _trace_summary(),
             "lint": _lint_summary(),
             "slowest": [{"test": n, "s": round(s, 3)}
                         for n, s in slowest],

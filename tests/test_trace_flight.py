@@ -1,0 +1,787 @@
+"""Causal flight recorder tests (ISSUE 19): W3C-style context
+propagation through the op lifecycle (WAL envelope `c`, wire marks,
+transport stamps), the detection-lag segment decomposition and its
+sum-exactness invariant, the per-store trace index + /trace waterfall
+pages + `cli trace`, fleet metrics federation (`cli metrics --fleet`,
+supervisor /metrics, staleness honesty), the pre-sink span buffering
+regression, and the kill9 battery asserting trace continuity across a
+fleet takeover — the flag's chain must contain a span link from the
+dead worker's checkpointed lease epoch to the survivor's resume span,
+exactly once."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu import cli, store, telemetry, web
+from jepsen_tpu import trace as trace_mod
+from jepsen_tpu.history import HistoryWAL, frame_line, invoke_op, ok_op
+from jepsen_tpu.live import lease as lease_mod
+from jepsen_tpu.live.client import StreamingWAL
+from jepsen_tpu.live.ingest import IngestServer
+from jepsen_tpu.live.scheduler import LiveScheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def store_tmpdir(tmp_path, monkeypatch):
+    monkeypatch.setattr(store, "BASE", tmp_path / "store")
+    yield
+
+
+def write_wal(run_dir, ops, fsync=False):
+    run_dir.mkdir(parents=True, exist_ok=True)
+    wal = HistoryWAL(run_dir / "history.wal", fsync=fsync)
+    for o in ops:
+        wal.append(o)
+    wal.close()
+
+
+def register_ops(n, vmax=5, start_index=0):
+    ops = []
+    i = start_index
+    for k in range(n):
+        ops.append(invoke_op(0, "write", k % vmax, index=i))
+        ops.append(ok_op(0, "write", k % vmax, index=i + 1))
+        i += 2
+    return ops
+
+
+def wait_for(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.03)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def trace_events(d):
+    p = Path(d) / "trace-index.jsonl"
+    if not p.exists():
+        return []
+    return [e for e in telemetry.read_events(p)]
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: spans finished before set_sink must not be dropped
+# ---------------------------------------------------------------------------
+
+class TestTracerSinkBuffer:
+    def test_pre_sink_spans_flush_on_attach(self):
+        """The regression: a per-run sink is attached mid-bootstrap,
+        and every span that finished BEFORE the attach (orchestrator
+        setup spans) used to vanish.  They must buffer and flush —
+        in finish order — through the newly attached sink."""
+        t = trace_mod.Tracer(enabled=True)
+        with t.span("setup/one"):
+            pass
+        with t.span("setup/two"):
+            pass
+        got = []
+        t.set_sink(got.append)
+        assert [m["name"] for m in got] == ["setup/one", "setup/two"]
+        # post-attach spans go straight through, no replay
+        with t.span("live/three"):
+            pass
+        assert [m["name"] for m in got] == ["setup/one", "setup/two",
+                                            "live/three"]
+        # re-attaching must not replay what was already delivered
+        got2 = []
+        t.set_sink(got2.append)
+        assert got2 == []
+
+    def test_detach_rebuffers_until_next_sink(self):
+        t = trace_mod.Tracer(enabled=True)
+        t.set_sink(lambda m: None)
+        t.set_sink(None)
+        with t.span("offline"):
+            pass
+        late = []
+        t.set_sink(late.append)
+        assert [m["name"] for m in late] == ["offline"]
+
+    def test_failing_sink_never_breaks_the_span(self):
+        t = trace_mod.Tracer(enabled=True)
+
+        def boom(m):
+            raise RuntimeError("sink down")
+        with t.span("pre"):
+            pass
+        t.set_sink(boom)                  # flush path swallows
+        with t.span("post"):              # direct path swallows
+            pass
+        assert len(t.spans()) == 2
+
+
+# ---------------------------------------------------------------------------
+# the WAL envelope: `c` rides outside the crc
+# ---------------------------------------------------------------------------
+
+class TestEnvelope:
+    def test_ctx_field_outside_crc(self):
+        line = frame_line({"f": "write", "value": 1}, 0, wall=123.0,
+                          ctx="ab" * 16 + "-" + "cd" * 8)
+        rec = json.loads(line)
+        assert rec["c"] == "ab" * 16 + "-" + "cd" * 8
+        # same payload without ctx carries the SAME crc: `c` is an
+        # uncrc'd envelope field, so a garbled context can never
+        # invalidate the record
+        bare = json.loads(frame_line({"f": "write", "value": 1}, 0,
+                                     wall=123.0))
+        assert "c" not in bare
+        assert rec["crc"] == bare["crc"]
+
+    def test_append_stamps_the_open_span(self, tmp_path):
+        """HistoryWAL.append must capture the appending thread's
+        innermost open span as the record's `c` — and leave untraced
+        records envelope-clean."""
+        t = trace_mod.Tracer(enabled=True)
+        wal = HistoryWAL(tmp_path / "history.wal", fsync=False)
+        with t.span("client/invoke") as sp:
+            wal.append(invoke_op(0, "write", 1, index=0))
+            want = f"{sp.trace_id}-{sp.span_id}"
+        wal.append(ok_op(0, "write", 1, index=1))
+        wal.close()
+        lines = (tmp_path / "history.wal").read_bytes().splitlines()
+        recs = [json.loads(ln) for ln in lines]
+        assert recs[0]["c"] == want
+        assert "c" not in recs[1]
+
+    def test_follow_surfaces_ctxs_and_old_records(self, tmp_path):
+        """The segment reader hands (ctx, seq) per op to the tenant;
+        pre-ISSUE-19 records (no `c`) read as None, never an error."""
+        t = trace_mod.Tracer(enabled=True)
+        wal = HistoryWAL(tmp_path / "history.wal", fsync=False)
+        with t.span("client/invoke"):
+            wal.append(invoke_op(0, "write", 3, index=0))
+        wal.append(ok_op(0, "write", 3, index=1))
+        wal.close()
+        from jepsen_tpu.history import follow
+        seg = follow(tmp_path / "history.wal", 0, 0)
+        assert [c is not None for c in seg.ctxs] == [True, False]
+        assert seg.seqs == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# segment decomposition invariants
+# ---------------------------------------------------------------------------
+
+class TestLagSegments:
+    def test_full_chain_sums_exactly(self):
+        stamps = {"w": 100.0, "fs": 100.2, "recv": 100.5,
+                  "synced": 100.9, "win": 101.5, "dis_s": 0.5,
+                  "flag": 103.0}
+        segs = trace_mod.lag_segments(stamps)
+        assert set(segs) == set(trace_mod.SEGMENTS)
+        assert abs(sum(segs.values()) - 3.0) < 1e-6
+        assert segs["fsync"] == pytest.approx(0.2)
+        assert segs["frame"] == pytest.approx(0.3)
+        assert segs["ack"] == pytest.approx(0.4)
+        assert segs["window"] == pytest.approx(0.6)
+        assert segs["dispatch"] == pytest.approx(0.5)
+        assert segs["flag"] == pytest.approx(1.0)
+        assert trace_mod.dominant_segment(segs) == "flag"
+
+    def test_missing_stamps_collapse_zero_width(self):
+        """A local (untransported) run has no fs/recv/synced: those
+        segments are zero, and the total still sums exactly to
+        flag - w — the 'every segment accounted for' criterion holds
+        by construction, not by approximation."""
+        segs = trace_mod.lag_segments({"w": 10.0, "win": 11.0,
+                                       "dis_s": 0.25, "flag": 12.0})
+        assert segs["fsync"] == segs["frame"] == segs["ack"] == 0.0
+        assert abs(sum(segs.values()) - 2.0) < 1e-6
+
+    def test_out_of_order_stamps_are_monotonized(self):
+        """Clock skew between the client and ingest hosts can place
+        recv before fs; the chain clamps, never goes negative, and
+        the sum stays exact."""
+        segs = trace_mod.lag_segments(
+            {"w": 50.0, "fs": 52.0, "recv": 51.0, "synced": 49.0,
+             "win": 53.0, "dis_s": 1.0, "flag": 53.5})
+        assert all(v >= 0.0 for v in segs.values())
+        assert abs(sum(segs.values()) - 3.5) < 1e-6
+
+    def test_no_anchor_no_segments(self):
+        assert trace_mod.lag_segments({"fs": 1.0}) is None
+        assert trace_mod.dominant_segment(None) is None
+        assert trace_mod.dominant_segment(
+            {s: 0.0 for s in trace_mod.SEGMENTS}) is None
+
+    def test_synth_ctx_deterministic_and_parseable(self):
+        a = trace_mod.synth_ctx("r", "t1", 7)
+        assert a == trace_mod.synth_ctx("r", "t1", 7)
+        assert a != trace_mod.synth_ctx("r", "t1", 8)
+        parsed = trace_mod.parse_ctx(a)
+        assert parsed is not None
+        assert len(parsed[0]) == 32 and len(parsed[1]) == 16
+        assert trace_mod.parse_ctx("garbled") is None
+        assert trace_mod.parse_ctx(None) is None
+        assert trace_mod.parse_ctx(42) is None
+
+
+# ---------------------------------------------------------------------------
+# the trace index: scheduler -> trace-index.jsonl -> /trace + cli
+# ---------------------------------------------------------------------------
+
+class TestTraceIndex:
+    def _run_traced_store(self):
+        """One tenant whose WAL carries real span contexts and a
+        planted violation; returns (run_dir, ctx trace_id)."""
+        root = store.BASE
+        d = root / "r" / "t1"
+        d.mkdir(parents=True)
+        t = trace_mod.Tracer(enabled=True)
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        i = 0
+        tid = None
+        for k in range(4):
+            with t.span("client/invoke", f="write") as sp:
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                tid = sp.trace_id
+            i += 2
+        with t.span("client/invoke", f="read"):
+            wal.append(invoke_op(0, "read", None, index=i))
+            wal.append(ok_op(0, "read", 99, index=i + 1))   # planted
+        wal.close()
+        s = LiveScheduler(root, backend="host", scan_every=1,
+                          worker_id="w1", lease_ttl=5.0)
+        s.drain(20)
+        s.close()
+        return d, tid
+
+    def test_flag_journals_causal_record(self):
+        d, _tid = self._run_traced_store()
+        evs = trace_events(d)
+        recs = [e for e in evs if e.get("type") == "trace-flag"]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec["ctx_source"] == "wal"
+        assert rec["op_index"] == 9
+        assert len(rec["trace_id"]) == 32
+        # the chain invariant: segments sum EXACTLY to the measured
+        # detection lag (the acceptance criterion's 10% with margin)
+        segs = rec["segments"]
+        assert set(segs) == set(trace_mod.SEGMENTS)
+        assert rec["lag_s"] is not None
+        assert abs(sum(segs.values()) - rec["lag_s"]) \
+            <= max(0.1 * rec["lag_s"], 1e-4)
+        assert rec["dominant"] in trace_mod.SEGMENTS
+        assert rec["worker"] == "w1" and rec["epoch"] == 1
+        # ...and the live-flag row carries the join keys
+        flags = [e for e in telemetry.read_events(d / "live.jsonl")
+                 if e.get("type") == "live-flag"]
+        assert flags[0]["trace"] == rec["trace_id"]
+        assert flags[0]["lag_segment"] == rec["dominant"]
+
+    def test_wal_ctx_wins_over_synth(self):
+        """The flag's invoke rode a real span: the trace record must
+        reuse that trace_id, not mint a synthetic one."""
+        d, _ = self._run_traced_store()
+        rec = [e for e in trace_events(d)
+               if e.get("type") == "trace-flag"][0]
+        synth = trace_mod.parse_ctx(
+            trace_mod.synth_ctx("r", "t1", rec["op_index"]))[0]
+        assert rec["trace_id"] != synth
+
+    def test_untraced_flag_gets_deterministic_synth_ctx(self):
+        root = store.BASE
+        d = root / "r" / "t1"
+        ops = register_ops(3)
+        ops += [invoke_op(0, "read", None, index=6),
+                ok_op(0, "read", 99, index=7)]
+        write_wal(d, ops)
+        s = LiveScheduler(root, backend="host", scan_every=1)
+        s.drain(20)
+        s.close()
+        rec = [e for e in trace_events(d)
+               if e.get("type") == "trace-flag"][0]
+        assert rec["ctx_source"] == "synth"
+        want = trace_mod.parse_ctx(trace_mod.synth_ctx("r", "t1", 7))
+        assert (rec["trace_id"], rec["span"]) == want
+
+    def test_web_trace_pages_render(self):
+        d, _ = self._run_traced_store()
+        rec = [e for e in trace_events(d)
+               if e.get("type") == "trace-flag"][0]
+        idx = web.trace_index_html().decode()
+        assert "r/t1" in idx
+        run = web.trace_run_html("r", "t1").decode()
+        assert rec["trace_id"][:12] in run
+        flagp = web.trace_flag_html("r", "t1",
+                                    rec["trace_id"]).decode()
+        for seg in trace_mod.SEGMENTS:
+            assert seg in flagp
+        assert "apart" in flagp            # the sum-vs-lag honesty line
+        with pytest.raises(FileNotFoundError):
+            web.trace_flag_html("r", "t1", "no-such-trace")
+
+    def test_cli_trace_prints_decomposition(self, capsys):
+        d, _ = self._run_traced_store()
+        rec = [e for e in trace_events(d)
+               if e.get("type") == "trace-flag"][0]
+        rc = cli.main(cli.standard_commands(), ["trace", str(d)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert rec["trace_id"] in out and "dominant=" in out
+        # store-root form + --slowest
+        rc = cli.main(cli.standard_commands(),
+                      ["trace", str(store.BASE), "--slowest", "1"])
+        out = capsys.readouterr().out
+        assert rc == 0 and rec["trace_id"] in out
+
+    def test_trace_index_survives_resume(self):
+        """A re-adopted tenant resumes its trace index (same
+        resume/epoch discipline as live.jsonl) — records append, the
+        earlier chain is not clobbered."""
+        d, _ = self._run_traced_store()
+        n0 = len(trace_events(d))
+        assert n0 >= 1
+        s = LiveScheduler(store.BASE, backend="host", scan_every=1,
+                          worker_id="w2", lease_ttl=5.0)
+        s.drain(10)
+        s.close()
+        assert len(trace_events(d)) >= n0
+
+
+# ---------------------------------------------------------------------------
+# transport stamps: marks over the wire -> ingest journal -> scheduler
+# ---------------------------------------------------------------------------
+
+class TestTransportStamps:
+    def test_note_transport_merges_field_wise(self, tmp_path):
+        s = LiveScheduler(tmp_path / "root", backend="host")
+        key = ("r", "t1")
+        s.note_transport(key, [(5, None, 10.0, 10.1)])
+        s.note_transport(key, [(5, 9.9, None, None)])   # late mark
+        assert s._transport_for(key, 5) == (9.9, 10.0, 10.1)
+        # first write wins; later values never clobber
+        s.note_transport(key, [(5, 1.0, 2.0, 3.0)])
+        assert s._transport_for(key, 5) == (9.9, 10.0, 10.1)
+        assert s._transport_for(key, 6) == (None, None, None)
+        assert s._transport_for(key, None) == (None, None, None)
+        s.close()
+
+    def test_streamed_traced_flag_carries_wire_stamps(self, tmp_path):
+        """End to end in-process: traced appends stream through a
+        real IngestServer wired to the scheduler; the flag's causal
+        record must carry nonzero transport segments (frame/ack), and
+        the ingest journal must hold the survivable ingest-span copy."""
+        root = store.BASE
+        root.mkdir(parents=True, exist_ok=True)
+        s = LiveScheduler(root, backend="host", scan_every=1)
+        srv = IngestServer(root, server_id="i-tr", lease_ttl=1.0,
+                           scheduler=s).start()
+        try:
+            t = trace_mod.Tracer(enabled=True)
+            wal = StreamingWAL(tmp_path / "local.wal",
+                               f"127.0.0.1:{srv.port}", "r", "t1",
+                               writer="wA", fsync=False)
+            i = 0
+            for k in range(3):
+                with t.span("client/invoke"):
+                    wal.append(invoke_op(0, "write", k, index=i))
+                    wal.append(ok_op(0, "write", k, index=i + 1))
+                i += 2
+                time.sleep(0.02)
+            with t.span("client/invoke"):
+                wal.append(invoke_op(0, "read", None, index=i))
+                wal.append(ok_op(0, "read", 99, index=i + 1))
+            wal.close()
+            d = root / "r" / "t1"
+            wait_for(lambda: (d / "history.wal").exists()
+                     and (d / "history.wal").read_bytes()
+                     == (tmp_path / "local.wal").read_bytes(),
+                     30, "the server-side WAL to catch up")
+            wait_for(lambda: [e for e in trace_events(d)
+                              if e.get("type") == "trace-flag"]
+                     if s.drain(5) is not None else None,
+                     30, "the traced flag")
+            rec = [e for e in trace_events(d)
+                   if e.get("type") == "trace-flag"][0]
+            st = rec["stamps"]
+            assert "recv" in st and "synced" in st, st
+            assert st["synced"] >= st["recv"]
+            assert "fs" in st, st       # the client's durability mark
+            # the SIGKILL-survivable copy: ingest-span events with the
+            # matched marks live in the server journal, not worker RAM
+            spans = []
+            for p in (root / "ingest").glob("*.jsonl"):
+                spans += [e for e in telemetry.read_events(p)
+                          if e.get("type") == "ingest-span"]
+            assert spans and any(e.get("marks") for e in spans)
+            # render-time join: the web page re-derives transport
+            # stamps from the journal alone
+            fs, recv, synced = web._ingest_span_stamps(
+                "r/t1", rec["seq"])
+            assert recv is not None and synced is not None
+        finally:
+            srv.close()
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics federation
+# ---------------------------------------------------------------------------
+
+def _sidecar(root, wid, updated, ttl=1.0, metrics=None):
+    d = root / "fleet"
+    d.mkdir(parents=True, exist_ok=True)
+    (d / f"{wid}.json").write_text(json.dumps(
+        {"worker": wid, "updated": updated, "lease_ttl": ttl,
+         "metrics": metrics or {}}))
+
+
+def _export_with(fill):
+    r = telemetry.MetricsRegistry()
+    fill(r)
+    return r.export()
+
+
+class TestFederation:
+    def test_worker_labels_and_no_summing(self):
+        root = store.BASE
+        now = 1000.0
+        _sidecar(root, "A", now - 0.5, metrics=_export_with(
+            lambda r: r.counter("live_flags_total").inc(3)))
+        _sidecar(root, "B", now - 0.5, metrics=_export_with(
+            lambda r: r.counter("live_flags_total").inc(4)))
+        text = telemetry.federate(root, now=now)
+        assert 'live_flags_total{worker_id="A"} 3' in text
+        assert 'live_flags_total{worker_id="B"} 4' in text
+        # never summed across workers: no unlabeled merged series
+        assert "live_flags_total 7" not in text
+        assert "live_flags_total{} 7" not in text
+        assert 'fleet_worker_stale{worker_id="A"} 0' in text
+        assert "# TYPE live_flags_total counter" in text
+
+    def test_stale_worker_withheld_not_summed(self):
+        """Staleness honesty: a dead worker's last snapshot is marked
+        stale and its metrics WITHHELD — a frozen counter served as
+        current is a lie about a dead process."""
+        root = store.BASE
+        now = 1000.0
+        _sidecar(root, "A", now - 0.5, ttl=1.0, metrics=_export_with(
+            lambda r: r.gauge("live_window_queue_depth").set(2)))
+        _sidecar(root, "dead", now - 50.0, ttl=1.0,
+                 metrics=_export_with(
+                     lambda r: r.gauge("live_window_queue_depth")
+                     .set(99)))
+        text = telemetry.federate(root, now=now)
+        assert 'fleet_worker_stale{worker_id="dead"} 1' in text
+        assert 'worker_id="dead"} 99' not in text
+        assert 'live_window_queue_depth{worker_id="A"} 2' in text
+        assert "fleet_worker_age_seconds" in text
+
+    def test_histograms_federate_cumulatively(self):
+        def fill(r):
+            h = r.histogram("live_window_lag_seconds",
+                            buckets=(2.0, 8.0, 30.0))
+            h.observe(1.0)
+            h.observe(9.0)
+        root = store.BASE
+        _sidecar(root, "A", 1000.0 - 0.1, metrics=_export_with(fill))
+        text = telemetry.federate(root, now=1000.0)
+        assert 'le="2"' in text and 'le="+Inf"' in text
+        assert 'live_window_lag_seconds_count{worker_id="A"} 2' \
+            in text
+
+    def test_supervisor_metrics_prefers_federation(self):
+        """/metrics on a store with fleet sidecars: federated series
+        first, and a process-local block whose NAME collides is
+        dropped — one # TYPE per name, the exposition stays valid."""
+        telemetry.REGISTRY.counter("trace_fed_collide_total").inc(5)
+        try:
+            root = store.BASE
+            _sidecar(root, "A", time.time(), metrics=_export_with(
+                lambda r: r.counter("trace_fed_collide_total")
+                .inc(11)))
+            text = web.metrics_text()
+            assert text.count("# TYPE trace_fed_collide_total") == 1
+            assert 'trace_fed_collide_total{worker_id="A"} 11' in text
+            assert "\ntrace_fed_collide_total 5" not in text
+        finally:
+            pass
+
+    def test_metrics_text_without_fleet_is_process_snapshot(self):
+        text = web.metrics_text()
+        assert "fleet_worker_stale" not in text
+
+    def test_cli_metrics_fleet(self, capsys):
+        root = store.BASE
+        _sidecar(root, "A", time.time(), metrics=_export_with(
+            lambda r: r.counter("live_flags_total").inc(2)))
+        rc = cli.main(cli.standard_commands(),
+                      ["metrics", str(root), "--fleet"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert 'live_flags_total{worker_id="A"} 2' in out
+        rc = cli.main(cli.standard_commands(),
+                      ["metrics", str(root / "nowhere"), "--fleet"])
+        assert rc == 255
+
+
+# ---------------------------------------------------------------------------
+# satellite 3 (kill9): trace continuity across a fleet takeover
+# ---------------------------------------------------------------------------
+
+def spawn_worker(root, wid, ttl=0.8):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         str(root), "--worker-id", wid, "--lease-ttl", str(ttl),
+         "--backend", "host", "--poll-interval", "0.02"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.mark.kill9
+class TestTraceKill9:
+    TTL = 0.8
+
+    def test_takeover_links_dead_workers_span_exactly_once(
+            self, tmp_path):
+        """SIGKILL the owner mid-stream: the survivor's trace index
+        must gain EXACTLY ONE trace-link whose from side is the dead
+        worker's checkpointed lease epoch (the context rode the lease
+        state slot through the SIGKILL) and whose resume_span is the
+        survivor's deterministic span — and the post-kill flag's
+        causal record must parent onto that resume span."""
+        root = tmp_path / "store"
+        d = root / "r" / "t1"
+        d.mkdir(parents=True)
+        wal = HistoryWAL(d / "history.wal", fsync=False)
+        procs = [spawn_worker(root, "A", self.TTL),
+                 spawn_worker(root, "B", self.TTL)]
+        try:
+            i = 0
+            for k in range(15):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.005)
+            ls = wait_for(lambda: lease_mod.read(d), 30,
+                          "a worker to acquire the tenant")
+            owner = ls.owner
+            victim = procs[0] if owner == "A" else procs[1]
+            survivor_id = "B" if owner == "A" else "A"
+            # the kill must land AFTER a heartbeat checkpointed the
+            # victim's trace context into the lease state slot
+            wait_for(lambda: (lambda l2: l2 is not None
+                              and isinstance(l2.state, dict)
+                              and "trace" in l2.state)(
+                lease_mod.read(d)),
+                self.TTL * 4 + 10,
+                "a renewal to checkpoint the trace context")
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10)
+            # post-kill violation: only the survivor can flag it
+            for k in range(6):
+                wal.append(invoke_op(0, "write", k % 5, index=i))
+                wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+            wal.append(invoke_op(0, "read", None, index=i))
+            wal.append(ok_op(0, "read", 88, index=i + 1))
+            flag_idx = i + 1
+            wal.close()
+            (d / "results.json").write_text('{"valid?": false}')
+            wait_for(lambda: (lambda lj: lj.get("done"))(
+                json.loads((d / "live.json").read_text()))
+                if (d / "live.json").exists() else None,
+                30, "the survivor to drain the tenant")
+
+            evs = trace_events(d)
+            links = [e for e in evs if e.get("type") == "trace-link"]
+            assert len(links) == 1, links     # exactly once
+            link = links[0]
+            assert link["from_worker"] == owner
+            assert link["from_epoch"] == 1
+            assert link["to_worker"] == survivor_id
+            assert link["to_epoch"] == 2
+            # both sides are deterministic synth contexts: the dead
+            # worker's checkpointed span and the survivor's resume
+            # span are recomputable from stable identifiers alone
+            assert link["from_span"] == trace_mod.parse_ctx(
+                trace_mod.synth_ctx("r", "t1", owner, 1))[1]
+            assert link["resume_span"] == trace_mod.parse_ctx(
+                trace_mod.synth_ctx("r", "t1", survivor_id, 2))[1]
+            assert link["silent_s"] >= self.TTL * 0.5
+            # the post-kill flag's chain crosses the handoff: its
+            # record parents onto the survivor's resume span
+            recs = [e for e in evs if e.get("type") == "trace-flag"
+                    and e.get("op_index") == flag_idx]
+            assert len(recs) == 1
+            rec = recs[0]
+            assert rec["parent"] == link["resume_span"]
+            assert rec["worker"] == survivor_id and rec["epoch"] == 2
+            segs = rec["segments"]
+            assert abs(sum(segs.values()) - rec["lag_s"]) \
+                <= max(0.1 * rec["lag_s"], 1e-4)
+            # the waterfall page shades the handoff
+            old_base = store.BASE
+            store.BASE = root
+            try:
+                page = web.trace_flag_html(
+                    "r", "t1", rec["trace_id"]).decode()
+                assert "handoff" in page.lower()
+                assert survivor_id in page
+                runp = web.trace_run_html("r", "t1").decode()
+                assert owner in runp and survivor_id in runp
+            finally:
+                store.BASE = old_base
+        finally:
+            for p in procs:
+                try:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGCONT)
+                        p.send_signal(signal.SIGKILL)
+                        p.wait(10)
+                except OSError:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# acceptance: remote streaming + SIGKILL takeover -> complete chain
+# ---------------------------------------------------------------------------
+
+def spawn_listener(root, wid, ttl=0.8, port=0):
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.cli", "serve-checker",
+         str(root), "--worker-id", wid, "--lease-ttl", str(ttl),
+         "--backend", "host", "--poll-interval", "0.02",
+         "--listen", f"127.0.0.1:{port}"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+def learn_port(root, wid, timeout=30):
+    def read():
+        p = root / "ingest" / f"{wid}.json"
+        try:
+            return int(json.loads(p.read_text()).get("port") or 0)
+        except (OSError, ValueError):
+            return 0
+    return wait_for(read, timeout, f"{wid}'s ingest port")
+
+
+@pytest.mark.kill9
+class TestTraceAcceptance:
+    TTL = 0.8
+
+    def test_streamed_kill_takeover_chain_complete(self, tmp_path):
+        """The ISSUE 19 acceptance scenario: traced ops stream over
+        TCP to a fleet of serve-checker --listen daemons; a planted
+        violation, a mid-stream SIGKILL of the receiving owner, and a
+        fleet takeover later, the flag's /trace/<id> page renders a
+        complete causal chain — wire-derived context (not synth),
+        every detection-lag segment accounted for (sum within 10% of
+        the measured flag lag), and the cross-worker handoff link."""
+        root = tmp_path / "store"
+        root.mkdir()
+        a = spawn_listener(root, "A", self.TTL)
+        b = spawn_listener(root, "B", self.TTL)
+        procs = [a, b]
+        try:
+            pa = learn_port(root, "A")
+            pb = learn_port(root, "B")
+            t = trace_mod.Tracer(enabled=True)
+            wal = StreamingWAL(
+                tmp_path / "local.wal",
+                [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"],
+                "r0", "t1", writer="wK", fsync=False)
+            i = 0
+            for k in range(12):
+                with t.span("client/invoke", f="write"):
+                    wal.append(invoke_op(0, "write", k % 5, index=i))
+                    wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.01)
+            wait_for(lambda: wal.client.acked_seq > 0, 30,
+                     "the first listener to ack")
+            d = root / "r0" / "t1"
+            sched_ls = wait_for(lambda: lease_mod.read(d), 30,
+                                "a checker to own the tenant")
+            owner = sched_ls.owner
+            victim = a if owner == "A" else b
+            wait_for(lambda: (lambda l2: l2 is not None
+                              and isinstance(l2.state, dict)
+                              and "trace" in l2.state)(
+                lease_mod.read(d)),
+                self.TTL * 4 + 10,
+                "the owner to checkpoint its trace context")
+            victim.send_signal(signal.SIGKILL)
+            victim.wait(10)
+            # post-kill traced violation: crosses the takeover
+            for k in range(6):
+                with t.span("client/invoke", f="write"):
+                    wal.append(invoke_op(0, "write", k % 5, index=i))
+                    wal.append(ok_op(0, "write", k % 5, index=i + 1))
+                i += 2
+                time.sleep(0.01)
+            with t.span("client/invoke", f="read") as sp:
+                wal.append(invoke_op(0, "read", None, index=i))
+                wal.append(ok_op(0, "read", 99, index=i + 1))
+                flag_trace_id = sp.trace_id
+            flag_idx = i + 1
+            wal.close()
+            wait_for(lambda: (d / "history.wal").exists()
+                     and (d / "history.wal").read_bytes()
+                     == (tmp_path / "local.wal").read_bytes(), 30,
+                     "the survivor WAL to catch up")
+            (d / "results.json").write_text('{"valid?": false}')
+            wait_for(lambda: [
+                e for e in trace_events(d)
+                if e.get("type") == "trace-flag"
+                and e.get("op_index") == flag_idx], 60,
+                "the survivor to journal the causal flag record")
+            recs = [e for e in trace_events(d)
+                    if e.get("type") == "trace-flag"
+                    and e.get("op_index") == flag_idx]
+            assert len(recs) == 1
+            rec = recs[0]
+            # wire-propagated context, end to end
+            assert rec["ctx_source"] == "wal"
+            assert rec["trace_id"] == flag_trace_id
+            # every segment accounted for: sum within 10% of the lag
+            segs = rec["segments"]
+            assert set(segs) == set(trace_mod.SEGMENTS)
+            assert abs(sum(segs.values()) - rec["lag_s"]) \
+                <= max(0.1 * rec["lag_s"], 1e-4)
+            # the handoff link exists exactly once and the flag
+            # parents onto the survivor's resume span
+            links = [e for e in trace_events(d)
+                     if e.get("type") == "trace-link"]
+            assert len(links) == 1
+            assert rec["parent"] == links[0]["resume_span"]
+            # transport stamps survived the victim: recv/synced are
+            # renderable on the waterfall (journal join or survivor's
+            # own in-process stamps)
+            old_base = store.BASE
+            store.BASE = root
+            try:
+                page = web.trace_flag_html(
+                    "r0", "t1", rec["trace_id"]).decode()
+                for seg in trace_mod.SEGMENTS:
+                    assert seg in page
+                assert "handoff" in page.lower()
+            finally:
+                store.BASE = old_base
+        finally:
+            for p in procs:
+                try:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGCONT)
+                        p.send_signal(signal.SIGKILL)
+                        p.wait(10)
+                except OSError:
+                    pass

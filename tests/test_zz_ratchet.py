@@ -72,6 +72,27 @@ def test_detection_lag_within_band():
         f"committed band {row['max']:.1f}s ({BASELINE})")
 
 
+def test_trace_segment_within_band():
+    """ISSUE 19: the widest detection-lag segment any trace-flag
+    observed this session.  A segment can never outgrow the lag it
+    decomposes (the chain is monotonized and sums exactly), so this
+    band fails when a single stage of the op lifecycle — fsync, wire,
+    window cut, dispatch, or flag journaling — silently absorbs more
+    of the detection lag than the committed worst case."""
+    row = _rows().get("live_trace_max_segment_s")
+    if row is None:
+        pytest.skip("no live_trace_max_segment_s row in the baseline")
+    worst = _gauge("live_trace_max_segment_seconds")
+    if worst is None:
+        pytest.skip("no trace-flag decomposed a detection lag this "
+                    "session (partial run?)")
+    assert worst <= row["max"], (
+        f"widest detection-lag segment {worst:.3f}s exceeds the "
+        f"committed band {row['max']:.1f}s ({BASELINE}); the segment "
+        "name is on the live_trace_max_segment_seconds label in "
+        "store/ci/last-tier1.json")
+
+
 def test_takeover_gap_within_band():
     row = _rows().get("live_takeover_gap_s")
     if row is None:
