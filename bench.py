@@ -686,6 +686,8 @@ def bench_live_txn() -> dict:
 
     gap = None
     resumed = 0
+    lat_lag = None
+    lattice_classes: list = []
     try:
         # (a) sustained drain, clean streams
         root1, n_inv = write_store("drain", 100)
@@ -753,6 +755,58 @@ def bench_live_txn() -> dict:
             print(json.dumps({"metric": "ERROR: txn bench planted "
                               "G-single never flagged", "value": 0,
                               "unit": "s", "vs_baseline": 0}))
+            return {"error": True}
+
+        # (b2) commit -> lattice-flag detection lag (ISSUE 20): a
+        # monotonic-writes plant — the weakest session rung, which
+        # the Adya tier cannot name — paced the same way; wall from
+        # the inverted read's ok record to the durable lattice flag
+        root2b = rootbase / "lat"
+        d2b = root2b / "lt0" / "t1"
+        d2b.mkdir(parents=True)
+        ops2b = mk._txn_stream(random.Random(6), "mw", plant_at)
+        wal2b = HistoryWAL(d2b / "history.wal", fsync=False)
+        s2b = LiveScheduler(root2b, backend="host", scan_every=1)
+        stop2b = threading.Event()
+        th2b = threading.Thread(target=drive, args=(s2b, stop2b),
+                                daemon=True)
+        th2b.start()
+        planted_tb = None
+        lat_lag = None
+        for o in ops2b:
+            wal2b.append(o)
+            if o.type == "ok" and isinstance(o.value, list) \
+                    and any(m[0] == "r" and m[1] == 105
+                            for m in o.value):
+                planted_tb = time.monotonic()
+            time.sleep(0.001)
+        wal2b.close()
+        (d2b / "results.json").write_text('{"valid?": false}')
+        deadline = time.monotonic() + 120
+        while lat_lag is None and time.monotonic() < deadline:
+            p = d2b / "live.jsonl"
+            if p.exists() and any(
+                    e.get("type") == "live-flag"
+                    and e.get("lane") == "txn:monotonic-writes"
+                    for e in telemetry_mod.read_events(p)):
+                lat_lag = time.monotonic() - planted_tb
+            time.sleep(0.005)
+        stop2b.set()
+        th2b.join(5)
+        s2b.drain()
+        lattice_classes = []
+        try:
+            with open(d2b / "live.json") as f:
+                lattice_classes = ((json.load(f).get("txn") or {})
+                                   .get("lattice_classes") or [])
+        except (OSError, json.JSONDecodeError):
+            pass
+        s2b.close()
+        if lat_lag is None:
+            print(json.dumps({
+                "metric": "ERROR: txn bench planted monotonic-writes "
+                          "never lattice-flagged", "value": 0,
+                "unit": "s", "vs_baseline": 0}))
             return {"error": True}
 
         # (c) takeover gap with checkpointed-frontier resume
@@ -864,6 +918,15 @@ def bench_live_txn() -> dict:
         "unit": "seconds",
         "vs_baseline": 1.0}), file=sys.stderr)
     print(json.dumps({
+        "metric": ("txn commit -> lattice-flag detection lag "
+                   "(monotonic-writes planted mid-stream; wall from "
+                   "the inverted read's ok record to the durable "
+                   "session-class live-flag — the lattice pass rides "
+                   "every window, not teardown)"),
+        "value": round(lat_lag, 3),
+        "unit": "seconds",
+        "vs_baseline": 1.0}), file=sys.stderr)
+    print(json.dumps({
         "metric": (f"txn takeover gap after a worker dies mid-stream "
                    f"(lease ttl {ttl}s; survivor resumes from the "
                    f"checkpointed frontier — {resumed} txns resumed "
@@ -872,10 +935,14 @@ def bench_live_txn() -> dict:
         "unit": "seconds",
         "vs_baseline": round(gap / ttl, 2)}), file=sys.stderr)
     print(f"# live-txn: drain {rate:.0f} ops/s ({drain_s:.2f}s), "
-          f"detect lag {lag:.3f}s, takeover gap {gap:.3f}s at ttl "
-          f"{ttl}s ({resumed} txns resumed)", file=sys.stderr)
+          f"detect lag {lag:.3f}s, lattice lag {lat_lag:.3f}s "
+          f"({','.join(lattice_classes) or 'none'}), takeover gap "
+          f"{gap:.3f}s at ttl {ttl}s ({resumed} txns resumed)",
+          file=sys.stderr)
     return {"live_txn_ops_s": round(rate, 1),
             "live_txn_detect_lag_s": round(lag, 3),
+            "live_lattice_detect_lag_s": round(lat_lag, 3),
+            "lattice_classes": lattice_classes,
             "live_txn_takeover_s": round(gap, 3),
             "live_txn_resumed": resumed,
             "live_txn_ttl_s": ttl}

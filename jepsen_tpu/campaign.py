@@ -462,12 +462,16 @@ def _run_bounded(fn: Callable, deadline_s: float,
 class KvdTarget:
     """The in-tree SUT: kvd over the local transport, with the full
     partition/disk/kill-pause/clock nemesis menu (suites/kvd.py) and
-    two workloads — `register` (the standard independent-keys
-    register) and `register-racy` (--unsafe-cas: the deliberately
-    racy CAS whose nonlinearizable histories the search can hunt)."""
+    four workloads — `register` (the standard independent-keys
+    register), `register-racy` (--unsafe-cas: the deliberately racy
+    CAS whose nonlinearizable histories the search can hunt), and the
+    lattice pair `causal` / `predicate` (ISSUE 20), whose checkers
+    name session/causal and predicate anomaly classes (`causal`,
+    `G2-predicate`, ...) that land on the coverage matrix via
+    `anomaly_classes`."""
 
     name = "kvd"
-    workloads = ("register", "register-racy")
+    workloads = ("register", "register-racy", "causal", "predicate")
 
     def __init__(self):
         from jepsen_tpu.suites import kvd
@@ -491,7 +495,11 @@ class KvdTarget:
         if schedule["workload"] == "register-racy":
             opts.update({"unsafe-cas": True, "value-max": 1,
                          "threads-per-key": 4, "stagger": 0.002})
-        test = self.kvd.kvd_test(opts)
+        if schedule["workload"] in ("causal", "predicate"):
+            opts["workload"] = schedule["workload"]
+            test = self.kvd.test_for(opts)
+        else:
+            test = self.kvd.kvd_test(opts)
         test["name"] = f"campaign-{campaign.name}-{schedule['id']}"
         test["fault_ledger"] = nem.FaultLedger()
         test["stall_budget_s"] = max(5.0, schedule["time_limit"])
@@ -872,10 +880,14 @@ class TxnFleetTarget(FleetTarget):
         rather than resume a wrong frontier.
 
     Each tenant's stream plants one anomaly drawn from distinct
-    isolation levels (G-single / G1c / duplicate-elements), so the
-    coverage matrix spans `level:*` classes — the isolation-level
-    coverage axis.  Verdict True = every planted anomaly flagged
-    exactly once with its correct level, across every fault mix."""
+    isolation levels — Adya's item classes (G-single / G1c /
+    duplicate-elements) AND the session/causal lattice classes
+    (monotonic-writes / read-your-writes / PRAM / causal /
+    long-fork), so the coverage matrix spans `level:*` classes down
+    to the weakest rungs of the consistency lattice — the
+    isolation-level coverage axis.  Verdict True = every planted
+    anomaly flagged exactly once with its correct level, across
+    every fault mix."""
 
     name = "txn-fleet"
     workloads = ("list-append",)
@@ -887,6 +899,11 @@ class TxnFleetTarget(FleetTarget):
         ("g-single", "txn:G-single", "snapshot-isolation"),
         ("g1c", "txn:G1c", "read-committed"),
         ("dup", "txn:duplicate-elements", "read-uncommitted"),
+        ("mw", "txn:monotonic-writes", "monotonic-writes"),
+        ("ryw", "txn:read-your-writes", "read-your-writes"),
+        ("pram", "txn:PRAM", "PRAM"),
+        ("causal", "txn:causal", "causal"),
+        ("long-fork", "txn:long-fork", "parallel-snapshot-isolation"),
     )
 
     def __init__(self, workers: int = 2, tenants: int = 2,
@@ -931,6 +948,47 @@ class TxnFleetTarget(FleetTarget):
                      [["append", 103, u], ["r", 104, [u + 1]]])
                 emit(1, [["append", 104, u + 1], ["r", 103, None]],
                      [["append", 104, u + 1], ["r", 103, [u]]])
+            elif plant_kind == "mw":
+                # session appends u then u+1; a reader observes the
+                # inverted order, so the ww version edge points back
+                # against session order: monotonic-writes
+                emit(0, [["append", 105, u]], [["append", 105, u]])
+                emit(0, [["append", 105, u + 1]],
+                     [["append", 105, u + 1]])
+                emit(1, [["r", 105, None]], [["r", 105, [u + 1, u]]])
+            elif plant_kind == "ryw":
+                # the session's own later read misses its write (the
+                # nil read anti-depends on it): read-your-writes
+                emit(0, [["append", 106, u]], [["append", 106, u]])
+                emit(0, [["r", 106, None]], [["r", 106, []]])
+                emit(1, [["r", 106, None]], [["r", 106, [u]]])
+            elif plant_kind == "pram":
+                # split sessions read-then-write across two keys: the
+                # only return path alternates wr and so edges with no
+                # anti-dependency, so nothing below PRAM names it
+                emit(0, [["r", 110, None]], [["r", 110, [u + 1]]])
+                emit(0, [["append", 111, u]], [["append", 111, u]])
+                emit(1, [["r", 111, None]], [["r", 111, [u]]])
+                emit(1, [["append", 110, u + 1]],
+                     [["append", 110, u + 1]])
+            elif plant_kind == "causal":
+                # w -> reader session writes -> second reader session
+                # whose stale nil read anti-depends on w: exactly one
+                # rw on a so-threaded return path = causal
+                emit(2, [["append", 112, u]], [["append", 112, u]])
+                emit(0, [["r", 112, None]], [["r", 112, [u]]])
+                emit(0, [["append", 113, u]], [["append", 113, u]])
+                emit(1, [["r", 113, None]], [["r", 113, [u]]])
+                emit(1, [["r", 112, None]], [["r", 112, []]])
+            elif plant_kind == "long-fork":
+                # two independent writes seen in opposite orders by
+                # two readers: the classic PSI-only fork
+                emit(0, [["append", 107, u]], [["append", 107, u]])
+                emit(1, [["append", 108, u]], [["append", 108, u]])
+                emit(2, [["r", 107, None], ["r", 108, None]],
+                     [["r", 107, [u]], ["r", 108, []]])
+                emit(3, [["r", 108, None], ["r", 107, None]],
+                     [["r", 108, [u]], ["r", 107, []]])
             else:                       # duplicate-elements
                 # the same element committed by two writers: the
                 # second append of (k, v) is the direct anomaly

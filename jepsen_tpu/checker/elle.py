@@ -53,6 +53,8 @@ ANOMALY_LEVEL = {
     "G1c": "read-committed",
     "incompatible-order": "read-committed",
     "cyclic-version-order": "read-committed",
+    # a dirty/garbage predicate read breaks read-committed (ISSUE 20)
+    "G1-predicate": "read-committed",
     # a single anti-dependency cycle is read skew: breaks SI
     "G-single": "snapshot-isolation",
     # ≥2 anti-dependencies is write skew: breaks serializability only
@@ -63,20 +65,25 @@ ALL_ANOMALIES = tuple(sorted(ANOMALY_LEVEL))
 
 
 def violated_levels(found) -> list:
-    """Levels ruled out by the found anomaly types, weakest first."""
-    idx = [ISOLATION_LEVELS.index(ANOMALY_LEVEL[a]) for a in found
-           if a in ANOMALY_LEVEL]
-    if not idx:
-        return []
-    return list(ISOLATION_LEVELS[min(idx):])
+    """Adya-chain levels ruled out by the found anomaly types, weakest
+    first — the full-lattice `not` list (jepsen_tpu.lattice) projected
+    onto ISOLATION_LEVELS, so session/causal classes surface the chain
+    levels they transitively rule out (e.g. `causal` -> SI and up via
+    parallel-snapshot-isolation) instead of vanishing."""
+    from jepsen_tpu import lattice
+    return [m for m in lattice.violated_models(found)
+            if m in ISOLATION_LEVELS]
 
 
 def weakest_violated(found) -> Optional[str]:
-    """The weakest isolation level the found anomalies rule out, or
-    None for a clean set — what the live transactional tenants report
-    per window (live/txn.py) and /live renders mid-stream."""
-    levels = violated_levels(found)
-    return levels[0] if levels else None
+    """The weakest violated consistency model over the FULL lattice
+    (session guarantees, PRAM, causal, long fork, predicate classes
+    and Adya's chain) — what the live transactional tenants report
+    per window (live/txn.py) and /live renders mid-stream.  On
+    pure-Adya anomaly sets this is exactly the chain answer the
+    pre-lattice checker returned."""
+    from jepsen_tpu import lattice
+    return lattice.weakest_violated(found)
 
 
 class Elle(ck.Checker):
@@ -276,7 +283,7 @@ class Elle(ck.Checker):
             "failing-anomaly-types": bad,
             "txn-count": inf.n,
             "workload": inf.workload,
-            "weakest-violated": levels[0] if levels else None,
+            "weakest-violated": weakest_violated(found),
             "not": levels,
             "engine": engine,
             "elle": dict(inf.meta),

@@ -64,6 +64,7 @@ class Inference:
     workload: str
     meta: dict = dataclasses.field(default_factory=dict)
     edge_lists: Optional[dict] = None   # plane -> (src i64[], dst i64[])
+    predicate: Optional[dict] = None    # {"prw": (src, dst), "reads": n}
 
     @property
     def n(self) -> int:
@@ -464,6 +465,149 @@ def _infer_rw_register(txns, failed, indet, edges: _Edges):
 
 
 # ---------------------------------------------------------------------------
+# predicate reads (ISSUE 20): phantom evidence for G1/G2-predicate
+# ---------------------------------------------------------------------------
+
+def _infer_predicate(txns, failed, indet, edges: _Edges):
+    """Evidence from ["rp", pred, observed] micro-ops, workload-
+    independent (runs after either item pass; zero rp mops => no-op).
+
+      * an observed (k, v) whose writer failed (or doesn't exist and
+        isn't indeterminate) is a DIRECT G1-predicate flag — a dirty/
+        garbage predicate read breaks read-committed on its own;
+      * an observed (k, v) with a committed writer is an ordinary wr
+        observation (the predicate read read that version);
+      * a committed final write to a key INSIDE the predicate's match
+        set (`txn.predicate_keys`) that the read observed NOTHING for
+        is a phantom: the write can only have been installed after
+        the read's snapshot (nil-first version order), so it emits a
+        predicate anti-dependency `prw` read -> writer.  Non-nil
+        mismatches get no edge (conservative: without a version-order
+        witness the unseen version could be older).
+
+    Returns (direct, (prw_src, prw_dst)); prw is NOT one of PLANES —
+    the lattice engine carries it as its own packed plane.
+    """
+    direct: dict = {}
+
+    def flag(name, i, m, **kw):
+        direct.setdefault(name, []).append(
+            dict({"op": txns[i][1].to_dict(), "mop": list(m)}, **kw))
+
+    any_rp = any(mop.is_predicate_read(m)
+                 for _, okop in txns for m in txn_mops(okop))
+    if not any_rp:
+        return direct, ([], [])
+
+    writer_of: dict = {}          # (k, v) -> committed writer txn
+    finals: dict = {}             # key -> {txn: final value written}
+    for i, (_, okop) in enumerate(txns):
+        last: dict = {}
+        for m in txn_mops(okop):
+            if mop.is_write(m):
+                last[mop.key(m)] = mop.value(m)
+            elif mop.is_append(m):
+                k, v = mop.key(m), mop.value(m)
+                writer_of.setdefault((k, v), i)
+                finals.setdefault(k, {})[i] = v
+        for k, v in last.items():
+            writer_of.setdefault((k, v), i)
+            finals.setdefault(k, {})[i] = v
+
+    prw_src: list = []
+    prw_dst: list = []
+    for i, (_, okop) in enumerate(txns):
+        for m in txn_mops(okop):
+            if not mop.is_predicate_read(m):
+                continue
+            observed = mop.value(m)
+            if not isinstance(observed, dict):
+                observed = {}
+            for k, v in observed.items():
+                if v is None:
+                    continue
+                if (k, v) in failed:
+                    flag("G1-predicate", i, m, kind="aborted",
+                         key=repr(k))
+                    continue
+                w = writer_of.get((k, v))
+                if w is None:
+                    if (k, v) not in indet:
+                        flag("G1-predicate", i, m, kind="garbage",
+                             key=repr(k))
+                    continue
+                if w != i:
+                    edges.add("wr", w, i)
+            for k in mop.predicate_keys(m):
+                if observed.get(k) is not None:
+                    continue       # saw a version; no phantom for k
+                for t in finals.get(k, ()):
+                    if t != i:
+                        prw_src.append(i)
+                        prw_dst.append(t)
+    return direct, (prw_src, prw_dst)
+
+
+# ---------------------------------------------------------------------------
+# session-order plane families (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+SESSION_PLANES = ("so_ww", "so_wr", "so_rw", "so_rr")
+
+
+def txn_roles(txns) -> tuple:
+    """(wrote, read) bool indicator vectors over committed txns — a
+    predicate read counts as a read."""
+    n = len(txns)
+    wrote = np.zeros(n, bool)
+    read = np.zeros(n, bool)
+    for i, (_, okop) in enumerate(txns):
+        for m in txn_mops(okop):
+            if mop.is_write(m) or mop.is_append(m):
+                wrote[i] = True
+            elif mop.is_read(m) or mop.is_predicate_read(m):
+                read[i] = True
+    return wrote, read
+
+
+def session_planes(txns) -> dict:
+    """The transitively-closed session order (every ordered pair of
+    one process's committed txns — `po`'s closure, built closed by
+    construction) split into endpoint-role families:
+
+        so_ww  writer -> writer     (monotonic-writes' defining edges)
+        so_wr  writer -> reader     (read-your-writes')
+        so_rw  reader -> writer     (writes-follow-reads')
+        so_rr  reader -> reader     (monotonic-reads')
+
+    A txn that both reads and writes puts its edges in every matching
+    family; the lattice masks' priority chain disambiguates.  Returns
+    {"planes": {name: bool [n, n]}, "edge_lists": {name: (src, dst)},
+    "wrote": bool [n], "read": bool [n]}.
+    """
+    n = len(txns)
+    wrote, read = txn_roles(txns)
+    so = np.zeros((n, n), bool)
+    by_proc: dict = {}
+    for i, (inv, _) in enumerate(txns):
+        by_proc.setdefault(inv.process, []).append(i)
+    for seq in by_proc.values():
+        for ai, a in enumerate(seq):
+            for b in seq[ai + 1:]:
+                so[a, b] = True
+    fams = {"so_ww": so & np.outer(wrote, wrote),
+            "so_wr": so & np.outer(wrote, read),
+            "so_rw": so & np.outer(read, wrote),
+            "so_rr": so & np.outer(read, read)}
+    lists = {}
+    for name, plane in fams.items():
+        s, d = np.nonzero(plane)
+        lists[name] = (s.astype(np.int64), d.astype(np.int64))
+    return {"planes": fams, "edge_lists": lists,
+            "wrote": wrote, "read": read}
+
+
+# ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
 
@@ -481,14 +625,28 @@ def infer(history, workload: str = "auto") -> Inference:
         direct, meta = _infer_rw_register(txns, failed, indet, edges)
     else:
         raise ValueError(f"unknown elle workload {workload!r}")
+    pred_direct, (prw_src, prw_dst) = _infer_predicate(
+        txns, failed, indet, edges)
+    for name, flags in pred_direct.items():
+        direct.setdefault(name, []).extend(flags)
     _order_planes(txns, edges)
     planes = edges.finalize()
     meta["txn-count"] = len(txns)
     meta["edge-counts"] = {p: int(planes[p].sum()) for p in PLANES}
+    predicate = None
+    if prw_src or "G1-predicate" in pred_direct:
+        predicate = {"prw": (np.asarray(prw_src, np.int64),
+                             np.asarray(prw_dst, np.int64)),
+                     "reads": sum(
+                         1 for _, okop in txns
+                         for m in txn_mops(okop)
+                         if mop.is_predicate_read(m))}
+        meta["predicate-reads"] = predicate["reads"]
     return Inference(txns=txns, planes=planes,
                      edge_types=edges.types, direct=direct,
                      workload=workload, meta=meta,
-                     edge_lists=edges.edge_arrays())
+                     edge_lists=edges.edge_arrays(),
+                     predicate=predicate)
 
 
 # ---------------------------------------------------------------------------
@@ -605,6 +763,13 @@ class IncrementalInference:
             self._inv_idx.append(inv_index)
             self._ok_idx.append(self.txns[i][self._OK])
             for m in v:
+                if mop.is_predicate_read(m):
+                    # predicate descriptors are list-shaped (not
+                    # hashable keys) and their phantom evidence is a
+                    # one-shot pass (`_infer_predicate`); the live
+                    # tier's lattice classes come from the session
+                    # planes, which rp mops don't touch
+                    continue
                 k = mop.key(m)
                 seq = self.touch.setdefault(k, [])
                 if not seq or seq[-1] != i:

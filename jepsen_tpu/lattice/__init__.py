@@ -1,0 +1,24 @@
+"""The full weak-consistency lattice (ISSUE 20).
+
+Widens the Elle engine from the four Adya serializability classes
+(G0/G1c/G-single/G2-item) to the combined Adya + session/causal +
+predicate lattice:
+
+  * `lattice`  — the consistency-model partial order and the one
+    `weakest_violated` that `checker/elle.py`, the live tier and
+    campaign signatures all consume;
+  * `planes`   — session-order / predicate plane families lowered
+    from an `elle/infer.Inference` (so_ww/so_wr/so_rw/so_rr + prw),
+    dense or packed uint32 (the same word layout `ops/elle_mesh`
+    shards);
+  * `engine`   — the masked-closure classifier in three bit-identical
+    tiers (lattice-host numpy oracle, lattice-device jitted dense,
+    lattice-mesh packed/sharded) plus per-class witness recovery;
+  * `checker`  — the post-hoc Checker and `classify_history`;
+  * `adapters` — workloads/causal, long_fork, monotonic lowered onto
+    the plane engine (legacy host code stays the differential oracle).
+"""
+
+from jepsen_tpu.lattice.lattice import (  # noqa: F401
+    LATTICE_CLASSES, MODEL_OF, MODELS, model_of, violated_models,
+    weakest_violated)

@@ -1,0 +1,123 @@
+"""Full-lattice post-hoc checker (ISSUE 20).
+
+`LatticeChecker` is the Checker-protocol face of the lattice engine:
+infer base planes -> lower to the 8-plane stack (`planes.py`) ->
+classify down the planner chain lattice-mesh -> lattice-device ->
+lattice-host (`engine.py`) -> verdict.  The verdict mirrors
+`checker/elle.py`'s shape (`valid?`, `anomalies` with recovered
+witness cycles, `weakest-violated`, `not`) but ranges over the FULL
+consistency lattice: session guarantees, PRAM, causal, long fork and
+the predicate classes join Adya's chain, and `weakest-violated` /
+`not` name models from `lattice.MODELS` rather than the 4-level
+isolation chain.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from jepsen_tpu import checker as ck
+from jepsen_tpu.elle import infer as infer_mod
+from jepsen_tpu.lattice import engine as engine_mod
+from jepsen_tpu.lattice import lattice as lattice_mod
+from jepsen_tpu.lattice import planes as planes_mod
+
+
+class LatticeChecker(ck.Checker):
+    """Classify one txn history over the full consistency lattice.
+
+    workload: "list-append" | "rw-register" | "auto" (sniffed)
+    anomalies: subset of classes to FAIL on (default: every class the
+        engine or the direct passes can name); everything found is
+        always reported.
+    algorithm / mesh_threshold / devices: tier routing, as
+        `ops.planner.plan_lattice` (auto routes to the bit-packed
+        mesh closure above the threshold).
+    """
+
+    def __init__(self, workload: str = "auto", anomalies=None,
+                 algorithm: str = "auto", mesh_threshold: int = 4096,
+                 devices=None):
+        self.workload = workload
+        self.anomalies = (None if anomalies is None
+                          else set(anomalies))
+        if algorithm not in ("auto", "mesh", "device", "host"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        self.algorithm = algorithm
+        self.mesh_threshold = mesh_threshold
+        self.devices = devices
+
+    def check(self, test, history, opts=None) -> dict:
+        del test, opts
+        t0 = time.monotonic()
+        lp, inf = planes_mod.from_history(history,
+                                          workload=self.workload)
+        infer_s = time.monotonic() - t0
+        return self.check_planes(lp, inf, infer_s=infer_s)
+
+    def check_planes(self, lp: planes_mod.LatticePlanes,
+                     inf: infer_mod.Inference,
+                     infer_s: float = 0.0) -> dict:
+        row, engine, plan = engine_mod.classify(
+            lp, algorithm=self.algorithm,
+            mesh_threshold=self.mesh_threshold, devices=self.devices)
+        found: dict = {k: list(v) for k, v in inf.direct.items()}
+        stack = lp.stacked()
+        for cls, edge in row["anomalies"].items():
+            cyc = engine_mod.find_witness(stack, cls, edge)
+            if cyc is None:         # engine flagged it; witness must exist
+                found.setdefault(cls, []).append(
+                    {"edge": [int(edge[0]), int(edge[1])],
+                     "witness": "unrecovered"})
+                continue
+            found.setdefault(cls, []).append({
+                "cycle": [inf.txns[i][1].to_dict() for i in cyc],
+                "steps": list(map(int, cyc)),
+            })
+        bad = sorted(set(found) & self.anomalies
+                     if self.anomalies is not None else found)
+        models = lattice_mod.violated_models(found)
+        out = {
+            "valid?": not bad,
+            "anomaly-types": sorted(found),
+            "anomalies": found,
+            "failing-anomaly-types": bad,
+            "txn-count": lp.n,
+            "workload": inf.workload,
+            "weakest-violated": lattice_mod.weakest_violated(found),
+            "not": models,
+            "engine": engine,
+            "lattice": dict(lp.meta),
+        }
+        for k in ("rounds", "n_pad", "shards"):
+            if row.get(k) is not None:
+                out[k] = row[k]
+        self._attach_dispatch(out, lp, plan, engine, infer_s)
+        return out
+
+    def _attach_dispatch(self, verdict: dict, lp, plan, engine: str,
+                         infer_s: float) -> None:
+        try:
+            from jepsen_tpu import telemetry
+            eng_plan = plan if engine == plan.engine else plan.refine(
+                why=f"degraded from {plan.engine}")
+            telemetry.attach_dispatch(
+                [verdict], eng_plan.record(
+                    engine=engine, batch=1,
+                    planes=len(planes_mod.LATTICE_PLANES),
+                    n_max=lp.n),
+                stages={"infer_s": infer_s})
+        except Exception:           # noqa: BLE001 - telemetry advisory
+            pass
+
+
+def checker(workload: str = "auto", **kw) -> LatticeChecker:
+    return LatticeChecker(workload=workload, **kw)
+
+
+def classify_history(history, workload: str = "auto",
+                     **kw) -> dict:
+    """One-shot convenience: history -> full-lattice verdict."""
+    return LatticeChecker(workload=workload, **kw).check(
+        None, history)

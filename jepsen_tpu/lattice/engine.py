@@ -1,0 +1,573 @@
+"""Masked-closure classification over the full lattice (ISSUE 20).
+
+PR 7's one-pair-closure trick, extended per class: close a handful of
+typed path relations once, then every anomaly class is a boolean mask
+`defining_plane & closure.T` — an edge (a, b) with a matching return
+path b -> a closes a cycle of exactly that class.  Seven relations
+cover all twelve classes:
+
+    Cww          ww paths                    (G0)
+    P0a / P1a    zero-rw / >=1-rw paths over ww|wr (+rw)
+                                             (G1c, G-single, G2-item,
+                                              session-guarantee returns)
+    P0s / P1s    the same pair closure with the session order joined
+                 into the base                (PRAM / causal residuals)
+    Cpred        paths over ww|wr|rw|prw      (G2-predicate)
+    LF           wr·(rw·wr)* alternating paths (long-fork)
+
+The masks are PRIORITY-SUBTRACTED in `lattice.LATTICE_CLASSES` order,
+so one defining edge belongs to exactly one class: the four session
+guarantees (typed by the so edge's endpoint roles) shadow PRAM, PRAM
+shadows causal, and long-fork claims its rw edges before G2-item.
+Adya's item classes run over the PURE dependency planes — session
+flavor lives entirely in the session classes.
+
+Three tiers, bit-identical verdicts and defining-edge picks (lowest
+(a, b) row-major, matching `ops/elle_graph` / `ops/elle_mesh`):
+
+    lattice-host     numpy oracle (terminal)
+    lattice-device   one jitted dense program per padded size
+    lattice-mesh     bit-packed planes, row-sharded pair closure with
+                     the same early-exit psum as `elle_mesh`
+
+plus per-class witness recovery (`find_witness`) via the BFS family
+each class's return-path relation calls for.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from jepsen_tpu.lattice.lattice import LATTICE_CLASSES
+from jepsen_tpu.lattice.planes import LATTICE_PLANES, LatticePlanes
+
+_TILE = 128
+
+_SESSION4 = ("monotonic-writes", "writes-follow-reads",
+             "read-your-writes", "monotonic-reads")
+
+
+# ---------------------------------------------------------------------------
+# host oracle
+# ---------------------------------------------------------------------------
+
+def _mm(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return (a.astype(np.float32) @ b.astype(np.float32)) > 0.5
+
+
+def _closure(m: np.ndarray) -> np.ndarray:
+    """Strict transitive closure (paths of >= 1 edge), log-squaring."""
+    r = m.copy()
+    while True:
+        nr = r | _mm(r, r)
+        if (nr == r).all():
+            return r
+        r = nr
+
+
+def _reflexive(m: np.ndarray) -> np.ndarray:
+    return _closure(m | np.eye(m.shape[0], dtype=bool)) \
+        if m.shape[0] else m
+
+
+def _pair(base: np.ndarray, rw: np.ndarray) -> tuple:
+    """(p0, p1): zero-rw reflexive closure of `base`, and >=1-rw
+    paths over base|rw — the elle pair-closure update rule."""
+    p0 = _reflexive(base)
+    p1 = rw.copy()
+    while True:
+        q = p0 | p1
+        np1 = p1 | _mm(q, p1) | _mm(p1, q)
+        if (np1 == p1).all():
+            return p0, p1
+        p1 = np1
+
+
+def _host_masks(stack: np.ndarray) -> dict:
+    """Class name -> bool [n, n] mask of defining edges, priority-
+    subtracted in LATTICE_CLASSES order.  The single source of truth
+    the device and mesh kernels mirror."""
+    ww, wr, rw = stack[0], stack[1], stack[2]
+    so_ww, so_wr, so_rw, so_rr = stack[3], stack[4], stack[5], stack[6]
+    prw = stack[7]
+    so = so_ww | so_wr | so_rw | so_rr
+    base_a = ww | wr
+    cww = _closure(ww)
+    p0a, p1a = _pair(base_a, rw)
+    p0s, p1s = _pair(base_a | so, rw)
+    cpred = _closure(ww | wr | rw | prw)
+    lf = _mm(_reflexive(_mm(wr, rw)), wr)
+
+    tdep = (p0a | p1a).T               # any-dep return (eye is inert:
+    m: dict = {}                       # every mask ANDs a loop-free plane)
+    m["monotonic-writes"] = so_ww & tdep
+    m["writes-follow-reads"] = so_rw & tdep \
+        & ~m["monotonic-writes"]
+    m["read-your-writes"] = so_wr & tdep \
+        & ~m["monotonic-writes"] & ~m["writes-follow-reads"]
+    m["monotonic-reads"] = so_rr & tdep \
+        & ~m["monotonic-writes"] & ~m["writes-follow-reads"] \
+        & ~m["read-your-writes"]
+    sess = (m["monotonic-writes"] | m["writes-follow-reads"]
+            | m["read-your-writes"] | m["monotonic-reads"])
+    m["PRAM"] = so & p0s.T & ~sess
+    m["causal"] = so & p1s.T & ~p0s.T & ~sess & ~m["PRAM"]
+    m["long-fork"] = rw & lf.T & ~p0a.T
+    m["G0"] = ww & cww.T
+    m["G1c"] = wr & p0a.T
+    m["G-single"] = rw & p0a.T
+    m["G2-item"] = rw & p1a.T & ~p0a.T & ~m["long-fork"]
+    m["G2-predicate"] = prw & cpred.T
+    return m
+
+
+def _pick(mask: np.ndarray) -> Optional[tuple]:
+    if not mask.any():
+        return None
+    flat = int(np.argmax(mask))
+    n = mask.shape[1]
+    return (flat // n, flat % n)
+
+
+def classify_host(stack: np.ndarray, n: Optional[int] = None) -> dict:
+    """Numpy oracle over a dense [8, n, n] lattice stack."""
+    if n is None:
+        n = stack.shape[1]
+    found: dict = {}
+    if n:
+        for cls, mask in _host_masks(np.asarray(stack, bool)).items():
+            e = _pick(mask)
+            if e is not None:
+                found[cls] = e
+    return {"anomalies": found, "n": int(n), "n_pad": int(n)}
+
+
+# ---------------------------------------------------------------------------
+# dense device tier
+# ---------------------------------------------------------------------------
+
+def _pad_to_tile(n: int) -> int:
+    return max(_TILE, -(-n // _TILE) * _TILE)
+
+
+@functools.lru_cache(maxsize=32)
+def _dense_kernel(n_pad: int):
+    import jax
+    import jax.numpy as jnp
+
+    steps = max(1, math.ceil(math.log2(max(n_pad - 1, 2))))
+    eye = jnp.eye(n_pad, dtype=bool)
+
+    def sq(a, b):
+        return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                       preferred_element_type=jnp.float32) > 0.5
+
+    def closure(mat):
+        return jax.lax.fori_loop(
+            0, steps, lambda _, r: r | sq(r, r), mat)
+
+    def pair(base, rwp):
+        p0 = closure(base | eye)
+
+        def body(_, p1):
+            q = p0 | p1
+            return p1 | sq(q, p1) | sq(p1, q)
+        return p0, jax.lax.fori_loop(0, steps, body, rwp)
+
+    def kernel(stack):
+        ww, wr, rw = stack[0], stack[1], stack[2]
+        so = stack[3] | stack[4] | stack[5] | stack[6]
+        prw = stack[7]
+        base_a = ww | wr
+        cww = closure(ww)
+        p0a, p1a = pair(base_a, rw)
+        p0s, p1s = pair(base_a | so, rw)
+        cpred = closure(base_a | rw | prw)
+        lf = sq(closure(sq(wr, rw) | eye), wr)
+
+        tdep = (p0a | p1a).T
+        m_mw = stack[3] & tdep
+        m_wfr = stack[5] & tdep & ~m_mw
+        m_ryw = stack[4] & tdep & ~m_mw & ~m_wfr
+        m_mr = stack[6] & tdep & ~m_mw & ~m_wfr & ~m_ryw
+        sess = m_mw | m_wfr | m_ryw | m_mr
+        m_pram = so & p0s.T & ~sess
+        m_causal = so & p1s.T & ~p0s.T & ~sess & ~m_pram
+        m_lf = rw & lf.T & ~p0a.T
+        masks = jnp.stack([
+            m_mw, m_wfr, m_ryw, m_mr, m_pram, m_causal, m_lf,
+            ww & cww.T, wr & p0a.T, rw & p0a.T,
+            rw & p1a.T & ~p0a.T & ~m_lf, prw & cpred.T])
+        flat = masks.reshape(len(LATTICE_CLASSES), -1)
+        flags = flat.any(axis=1)
+        idx = jnp.argmax(flat, axis=1)
+        edges = jnp.stack([idx // n_pad, idx % n_pad],
+                          axis=1).astype(jnp.int32)
+        return flags, edges
+
+    return jax.jit(kernel)
+
+
+def classify_device(stack: np.ndarray,
+                    n: Optional[int] = None) -> dict:
+    """One jitted dense program, shape-bucketed by padded size."""
+    stack = np.asarray(stack, bool)
+    if n is None:
+        n = stack.shape[1]
+    if not n:
+        return {"anomalies": {}, "n": 0, "n_pad": 0}
+    n_pad = _pad_to_tile(n)
+    padded = np.zeros((len(LATTICE_PLANES), n_pad, n_pad), bool)
+    padded[:, :n, :n] = stack
+    flags, edges = (np.asarray(x) for x in _dense_kernel(n_pad)(padded))
+    found = {cls: (int(edges[c, 0]), int(edges[c, 1]))
+             for c, cls in enumerate(LATTICE_CLASSES) if flags[c]}
+    return {"anomalies": found, "n": int(n), "n_pad": n_pad}
+
+
+# ---------------------------------------------------------------------------
+# packed mesh tier
+# ---------------------------------------------------------------------------
+
+_MESH_CACHE: dict = {}
+
+
+def _mesh_kernel(n_pad: int, devs: tuple):
+    from jepsen_tpu.ops import elle_mesh
+    block = elle_mesh._block_for(n_pad)
+    key = (n_pad, devs, block)
+    if key not in _MESH_CACHE:
+        _MESH_CACHE[key] = _build_mesh_kernel(n_pad, devs, block)
+    return _MESH_CACHE[key]
+
+
+def _build_mesh_kernel(n_pad: int, devs: tuple, block: int):
+    """One compiled shard_map program: the seven packed closures with
+    the elle_mesh early-exit psum, then the twelve masks and one
+    defining-edge pick per class per shard."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec
+
+    from jepsen_tpu.ops import elle_mesh
+    from jepsen_tpu.ops.shard_map_compat import (all_gather_frontier,
+                                                 frontier_settled,
+                                                 shard_map_compat)
+
+    n_dev = len(devs)
+    m = n_pad // n_dev
+    w = n_pad // 32
+    wm = m // 32
+    steps = max(1, math.ceil(math.log2(max(n_pad - 1, 2))))
+    unpack, pack, pmm = elle_mesh._device_fns(n_pad, block)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    nk = n_pad // block
+    wb = block // 32
+
+    def tpose(full, a0):
+        def bbody(k, out):
+            blk = jax.lax.dynamic_slice(
+                full, (k * block, a0 // 32), (block, wm))
+            bits = ((blk[:, :, None] >> shifts) & jnp.uint32(1)
+                    ).reshape(block, m)
+            return jax.lax.dynamic_update_slice(
+                out, pack(bits.T), (0, k * wb))
+        return jax.lax.fori_loop(
+            0, nk, bbody, jnp.zeros((m, w), jnp.uint32))
+
+    def pick(mask, a0):
+        row_any = (mask != 0).any(axis=1)
+        found = row_any.any()
+        al = jnp.argmax(row_any)
+        rowm = mask[al]
+        wi = jnp.argmax(rowm != 0)
+        word = rowm[wi]
+        bit = jnp.argmax(((word >> shifts) & jnp.uint32(1)) > 0)
+        return (found, (a0 + al).astype(jnp.int32),
+                (wi * 32 + bit).astype(jnp.int32))
+
+    def body(ww, wr, rw, so_ww, so_wr, so_rw, so_rr, prw):
+        idx = jax.lax.axis_index("rows")
+        a0 = idx * m
+        rows_idx = a0 + jnp.arange(m)
+        eye = jnp.zeros((m, w), jnp.uint32).at[
+            jnp.arange(m), rows_idx // 32].set(
+            jnp.uint32(1) << (rows_idx % 32).astype(jnp.uint32))
+        so = so_ww | so_wr | so_rw | so_rr
+        base_a = ww | wr
+        base_s = base_a | so
+
+        def gather(x):
+            return all_gather_frontier(x, "rows")
+
+        mm0 = pmm(wr, gather(rw))      # wr·rw, the long-fork step
+
+        def cond(st):
+            return (~st[-1]) & (st[-2] < steps)
+
+        def round_(st):
+            cww, p0a, p1a, p0s, p1s, cpred, cm, rounds, _ = st
+            fs = [gather(x) for x in
+                  (cww, p0a, p1a, p0s, p1s, cpred, cm)]
+            cww_f, p0a_f, p1a_f, p0s_f, p1s_f, cpred_f, cm_f = fs
+            cww2 = cww | pmm(cww, cww_f)
+            p0a2 = p0a | pmm(p0a, p0a_f)
+            p1a2 = p1a | pmm(p0a | p1a, p1a_f) \
+                | pmm(p1a, p0a_f | p1a_f)
+            p0s2 = p0s | pmm(p0s, p0s_f)
+            p1s2 = p1s | pmm(p0s | p1s, p1s_f) \
+                | pmm(p1s, p0s_f | p1s_f)
+            cpred2 = cpred | pmm(cpred, cpred_f)
+            cm2 = cm | pmm(cm, cm_f)
+            ch = (jnp.any(cww2 != cww) | jnp.any(p0a2 != p0a)
+                  | jnp.any(p1a2 != p1a) | jnp.any(p0s2 != p0s)
+                  | jnp.any(p1s2 != p1s) | jnp.any(cpred2 != cpred)
+                  | jnp.any(cm2 != cm))
+            done = frontier_settled(ch, "rows")
+            return (cww2, p0a2, p1a2, p0s2, p1s2, cpred2, cm2,
+                    rounds + 1, done)
+
+        init = (ww, base_a | eye, rw, base_s | eye, rw,
+                base_a | rw | prw, mm0 | eye,
+                jnp.int32(0), jnp.bool_(False))
+        (cww, p0a, p1a, p0s, p1s, cpred, cm,
+         rounds, _) = jax.lax.while_loop(cond, round_, init)
+
+        lf = pmm(cm, gather(wr))
+        t_dep = tpose(gather(p0a | p1a), a0)
+        t_p0a = tpose(gather(p0a), a0)
+        t_p1a = tpose(gather(p1a), a0)
+        t_p0s = tpose(gather(p0s), a0)
+        t_p1s = tpose(gather(p1s), a0)
+        t_cww = tpose(gather(cww), a0)
+        t_cpred = tpose(gather(cpred), a0)
+        t_lf = tpose(gather(lf), a0)
+
+        m_mw = so_ww & t_dep
+        m_wfr = so_rw & t_dep & ~m_mw
+        m_ryw = so_wr & t_dep & ~m_mw & ~m_wfr
+        m_mr = so_rr & t_dep & ~m_mw & ~m_wfr & ~m_ryw
+        sess = m_mw | m_wfr | m_ryw | m_mr
+        m_pram = so & t_p0s & ~sess
+        m_causal = so & t_p1s & ~t_p0s & ~sess & ~m_pram
+        m_lf = rw & t_lf & ~t_p0a
+        masks = (m_mw, m_wfr, m_ryw, m_mr, m_pram, m_causal, m_lf,
+                 ww & t_cww, wr & t_p0a, rw & t_p0a,
+                 rw & t_p1a & ~t_p0a & ~m_lf, prw & t_cpred)
+        flags, edges = [], []
+        for mk in masks:
+            f, a, b = pick(mk, a0)
+            flags.append(f)
+            edges.append(jnp.stack([a, b]))
+        return (jnp.stack(flags)[None], jnp.stack(edges)[None],
+                rounds.reshape(1))
+
+    mesh = Mesh(np.array(list(devs)), ("rows",))
+    spec = PartitionSpec("rows")
+    fn = shard_map_compat(body, mesh=mesh, in_specs=(spec,) * 8,
+                          out_specs=(spec, spec, spec))
+    return jax.jit(fn), mesh
+
+
+def classify_packed(packed_stack: np.ndarray, n: int,
+                    devices=None,
+                    max_devices: Optional[int] = None) -> dict:
+    """Mesh tier over an already-packed [8, n_pad, W] uint32 stack
+    (LatticePlanes.packed_stacked layout, n_pad a multiple of
+    mesh_tile(D))."""
+    import jax
+
+    from jepsen_tpu.ops import elle_mesh
+
+    devs = elle_mesh._devices(devices, max_devices)
+    packed = np.asarray(packed_stack, np.uint32)
+    n_pad = packed.shape[-2]
+    n_dev = len(devs)
+    if n_pad % elle_mesh.mesh_tile(n_dev):
+        raise ValueError(
+            f"n_pad={n_pad} not a multiple of mesh_tile({n_dev})="
+            f"{elle_mesh.mesh_tile(n_dev)}; pad with pad_for_mesh")
+    fn, mesh = _mesh_kernel(n_pad, tuple(devs))
+    from jax.sharding import NamedSharding, PartitionSpec
+    sh = NamedSharding(mesh, PartitionSpec("rows"))
+    planes = [jax.device_put(packed[i], sh)
+              for i in range(len(LATTICE_PLANES))]
+    flags, edges, rounds = (np.asarray(x) for x in fn(*planes))
+    found: dict = {}
+    for c, cls in enumerate(LATTICE_CLASSES):
+        hits = np.nonzero(flags[:, c])[0]
+        if len(hits):
+            d = int(hits[0])        # lowest device = lowest row block
+            found[cls] = (int(edges[d, c, 0]), int(edges[d, c, 1]))
+    return {"anomalies": found, "n": int(n), "n_pad": n_pad,
+            "rounds": int(rounds[0]), "shards": n_dev}
+
+
+# ---------------------------------------------------------------------------
+# witness recovery
+# ---------------------------------------------------------------------------
+
+def _bfs(adj: np.ndarray, src: int, dst: int) -> Optional[list]:
+    """Shortest src -> dst path (>= 1 edge) as a node list."""
+    n = adj.shape[0]
+    prev = np.full(n, -1, np.int64)
+    dq = deque([src])
+    seen = {src}
+    while dq:
+        u = dq.popleft()
+        for v in np.nonzero(adj[u])[0]:
+            if v == dst:
+                path = [int(dst), int(u)]
+                while path[-1] != src:
+                    path.append(int(prev[path[-1]]))
+                return path[::-1]
+            if int(v) not in seen:
+                seen.add(int(v))
+                prev[v] = u
+                dq.append(int(v))
+    return None
+
+
+def _bfs_rw(base: np.ndarray, rw: np.ndarray, src: int,
+            dst: int) -> Optional[list]:
+    """Shortest src -> dst path over base|rw containing >= 1 rw edge
+    (product BFS over (node, seen-rw))."""
+    n = base.shape[0]
+    both = base | rw
+    prev: dict = {}
+    start = (src, 0)
+    dq = deque([start])
+    seen = {start}
+    while dq:
+        u, got = dq.popleft()
+        for v in np.nonzero(both[u])[0]:
+            v = int(v)
+            g2 = 1 if (got or rw[u, v]) else 0
+            if v == dst and g2:
+                path = [v]
+                cur = (u, got)
+                while cur is not None:
+                    path.append(cur[0])
+                    cur = prev.get(cur)
+                return path[::-1]
+            st = (v, g2)
+            if st not in seen:
+                seen.add(st)
+                prev[st] = (u, got)
+                dq.append(st)
+    return None
+
+
+def _bfs_alt(wr: np.ndarray, rw: np.ndarray, src: int,
+             dst: int) -> Optional[list]:
+    """Shortest src -> dst path of shape wr·(rw·wr)* — the long-fork
+    return: an automaton BFS alternating wr / rw, starting and ending
+    on a wr edge."""
+    prev: dict = {}
+    start = (src, "wr")                # next edge must be wr
+    dq = deque([start])
+    seen = {start}
+    while dq:
+        u, expect = dq.popleft()
+        plane = wr if expect == "wr" else rw
+        for v in np.nonzero(plane[u])[0]:
+            v = int(v)
+            if v == dst and expect == "wr":
+                path = [v]
+                cur = (u, expect)
+                while cur is not None:
+                    path.append(cur[0])
+                    cur = prev.get(cur)
+                return path[::-1]
+            st = (v, "rw" if expect == "wr" else "wr")
+            if st not in seen:
+                seen.add(st)
+                prev[st] = (u, expect)
+                dq.append(st)
+    return None
+
+
+def find_witness(stack: np.ndarray, cls: str, edge) -> Optional[list]:
+    """Recover a concrete cycle [a, b, ..., a] for a flagged class:
+    the defining edge followed by the class's return-path relation.
+    None only if the flag was wrong (tests treat that as a failure)."""
+    stack = np.asarray(stack, bool)
+    ww, wr, rw = stack[0], stack[1], stack[2]
+    so = stack[3] | stack[4] | stack[5] | stack[6]
+    prw = stack[7]
+    a, b = int(edge[0]), int(edge[1])
+    if cls in _SESSION4:
+        back = _bfs(ww | wr | rw, b, a)
+    elif cls == "PRAM":
+        back = _bfs(ww | wr | so, b, a)
+    elif cls == "causal":
+        back = _bfs_rw(ww | wr | so, rw, b, a)
+    elif cls == "long-fork":
+        back = _bfs_alt(wr, rw, b, a)
+    elif cls == "G0":
+        back = _bfs(ww, b, a)
+    elif cls in ("G1c", "G-single"):
+        back = _bfs(ww | wr, b, a)
+    elif cls == "G2-item":
+        back = _bfs_rw(ww | wr, rw, b, a)
+    elif cls == "G2-predicate":
+        back = _bfs(ww | wr | rw | prw, b, a)
+    else:
+        return None
+    return [a] + back if back else None
+
+
+# ---------------------------------------------------------------------------
+# tiered dispatch
+# ---------------------------------------------------------------------------
+
+def classify(lp: LatticePlanes, algorithm: str = "auto",
+             mesh_threshold: int = 4096, devices=None) -> tuple:
+    """Walk the planner's lattice chain: (row, engine, plan).  A
+    recoverable failure degrades one tier; lattice-host is total."""
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.ops import planner
+
+    pl = planner.plan_lattice(lp.n, algorithm=algorithm,
+                              mesh_threshold=mesh_threshold)
+    row, engine = None, "lattice-host"
+    err: Optional[Exception] = None
+    chain = (pl.engine,) + pl.fallbacks
+    t0 = time.monotonic()
+    for eng in chain:
+        try:
+            if eng == "lattice-mesh":
+                from jepsen_tpu.ops import elle_mesh
+                devs = elle_mesh._devices(devices)
+                packed = lp.packed_stacked(n_dev=len(devs))
+                row = classify_packed(packed, lp.n, devices=devs)
+            elif eng == "lattice-device":
+                row = classify_device(lp.stacked(), lp.n)
+            else:
+                row = classify_host(lp.stacked(), lp.n)
+            engine = eng
+            break
+        except Exception as e:      # noqa: BLE001 - degrade a tier
+            err = e
+            continue
+    if row is None:
+        raise err if err is not None else RuntimeError(
+            "empty lattice engine chain")
+    try:
+        telemetry.REGISTRY.counter(
+            "lattice_classify_total", engine=engine).inc()
+        telemetry.REGISTRY.gauge(
+            "lattice_classify_seconds", engine=engine).set(
+            round(time.monotonic() - t0, 6))
+        for cls in row["anomalies"]:
+            telemetry.REGISTRY.counter(
+                "lattice_anomalies_total", cls=cls).inc()
+    except Exception:               # noqa: BLE001 - telemetry advisory
+        pass
+    return row, engine, pl

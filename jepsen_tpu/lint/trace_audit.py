@@ -365,6 +365,34 @@ def _build_elle_delta(plan, devices):
                       "warm": True}
 
 
+def _build_lattice_mesh(plan, devices):
+    """The full-lattice packed closure (ISSUE 20): eight packed
+    planes through the seven-relation while_loop plus the twelve
+    class masks, sharded by rows like elle-mesh."""
+    from jepsen_tpu.lattice import engine as lattice_engine
+    from jepsen_tpu.ops import elle_mesh
+    devs = tuple(devices)
+    tile = elle_mesh.mesh_tile(len(devs))
+    n_pad = tile                    # smallest legal mesh bucket
+    fn, _mesh = lattice_engine._build_mesh_kernel(
+        n_pad, devs, elle_mesh._block_for(n_pad))
+    args = [_sds((n_pad, n_pad // 32), "uint32")
+            for _ in range(len(lattice_engine.LATTICE_PLANES))]
+    return fn, args, {"n_pad": n_pad, "devices": len(devs),
+                      "planes": len(lattice_engine.LATTICE_PLANES)}
+
+
+def _build_lattice_device(plan, devices):
+    """The dense single-device lattice kernel: one [8, n, n] bool
+    stack in, per-class flags + defining edges out."""
+    from jepsen_tpu.lattice import engine as lattice_engine
+    n_pad = lattice_engine._TILE
+    fn = lattice_engine._dense_kernel(n_pad)
+    args = [_sds((len(lattice_engine.LATTICE_PLANES), n_pad, n_pad),
+                 "bool_")]
+    return fn, args, {"n_pad": n_pad}
+
+
 def _build_deep_hc(plan, devices):
     from jepsen_tpu.ops import wgl_deep
     R = int(plan.bucket[1])
@@ -477,6 +505,8 @@ def register_builtin_traceables() -> None:
     from jepsen_tpu.ops import planner
     planner.register_traceable("elle-mesh", _build_elle_mesh)
     planner.register_traceable("elle-delta", _build_elle_delta)
+    planner.register_traceable("lattice-mesh", _build_lattice_mesh)
+    planner.register_traceable("lattice-device", _build_lattice_device)
     planner.register_traceable("wgl_deep_hc", _build_deep_hc)
     planner.register_traceable("wgl_deep", _build_deep)
     planner.register_traceable("wgl_deep_split", _build_deep)
@@ -501,7 +531,7 @@ def seeded_shapes(n: int = 400, seed: int = 11) -> list:
     for _ in range(n):
         kind = rng.choice(["linear", "linear-many", "linear-pipeline",
                            "deep-pipeline", "deep-mesh", "batch-many",
-                           "elle", "live"])
+                           "elle", "live", "lattice"])
         mesh = rng.choice([None, 2, 8])
         if kind == "deep-mesh":
             mesh = mesh or 2            # a meshless mesh shape is

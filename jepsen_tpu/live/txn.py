@@ -90,7 +90,7 @@ class TxnTenant:
     def __init__(self, name: str, ts: str, run_dir, *,
                  workload: str = "auto", backend: str = "host",
                  window_txns: int = 32, include_order: bool = True,
-                 max_flags: int = 64):
+                 max_flags: int = 64, lattice_cap: int = 2048):
         self.name = name
         self.ts = ts
         self.run_dir = Path(run_dir)
@@ -131,6 +131,12 @@ class TxnTenant:
         self.last_wall: Optional[float] = None
         self._found: set = set()       # anomaly names so far
         self._weakest: Optional[str] = None
+        # per-window full-lattice pass (ISSUE 20): session/causal/
+        # long-fork classes inherited from the incremental planes,
+        # host-side, gated by lattice_cap txns
+        self.lattice_cap = max(0, int(lattice_cap))
+        self._lattice_found: set = set()
+        self._lattice_s = 0.0
         self._flag_records: list = []  # last emitted flags (live.json)
         self.flags_capped = 0
         self.closure_rebuilds = 0
@@ -245,10 +251,14 @@ class TxnTenant:
         self._need_classify = False
         self.windows_checked += 1
         out["flags"] = self._collect_flags(row)
-        found = set(self.inc.direct()) | set(row["anomalies"])
+        lat_flags, lat_summary = self._lattice_pass()
+        out["flags"].extend(lat_flags)
+        found = (set(self.inc.direct()) | set(row["anomalies"])
+                 | self._lattice_found)
         self._found = found
         self._weakest = _weakest_violated(found)
         out["window"] = {
+            "lattice": lat_summary,
             "txns": n, "new_txns": new_txns,
             "dirty_keys": delta["dirty_keys"],
             "added": len(delta["added"]),
@@ -302,18 +312,7 @@ class TxnTenant:
         flags = []
 
         def propose(name, op_index, value, wall):
-            if (f"txn:{name}", op_index) in self.flags_emitted:
-                return
-            if len(self.flags_emitted) + len(flags) >= self.max_flags:
-                self.flags_capped += 1
-                return
-            flags.append({
-                "lane": f"txn:{name}", "op_index": op_index,
-                "f": "txn", "value": value, "event": name,
-                "level": _level_of(name),
-                "wall": wall, "engine": self._last_engine,
-                "ctx": self._ctx.get(op_index),
-                "seq": self._seqmap.get(op_index)})
+            self._propose(flags, name, op_index, value, wall)
 
         for name, payloads in sorted(self.inc.direct().items()):
             seen = set()
@@ -334,6 +333,100 @@ class TxnTenant:
                     {"edge": [a, b], "ok_ops": [oka, okb]},
                     self._wall.get(okb))
         return flags
+
+    def _propose(self, flags: list, name, op_index, value,
+                 wall) -> None:
+        if (f"txn:{name}", op_index) in self.flags_emitted:
+            return
+        if len(self.flags_emitted) + len(flags) >= self.max_flags:
+            self.flags_capped += 1
+            return
+        flags.append({
+            "lane": f"txn:{name}", "op_index": op_index,
+            "f": "txn", "value": value, "event": name,
+            "level": _level_of(name),
+            "wall": wall, "engine": self._last_engine,
+            "ctx": self._ctx.get(op_index),
+            "seq": self._seqmap.get(op_index)})
+
+    # -- per-window lattice pass (ISSUE 20) ---------------------------------
+
+    _LATTICE_ONLY = ("monotonic-writes", "writes-follow-reads",
+                     "read-your-writes", "monotonic-reads",
+                     "PRAM", "causal", "long-fork")
+
+    def _lattice_pass(self) -> tuple:
+        """Widen the window verdict to the full consistency lattice:
+        rebuild the 8-plane stack from the incrementally-maintained
+        packed dep planes plus session families derived from the
+        committed txn list, classify on the lattice HOST engine, and
+        propose flags for the session/causal/long-fork classes the
+        base Adya pass cannot name (the Adya classes themselves stay
+        with the warm packed closure — no double flags).  Gated by
+        `lattice_cap` txns: the dense host pass is O(n^2) memory, so
+        past the cap the tenant reports honestly that the lattice
+        view is capped instead of stalling the stream.
+
+        Returns (flag proposals, window summary dict)."""
+        n = self.inc.n
+        if not n or self.lattice_cap and n > self.lattice_cap:
+            return [], ({"capped": n} if n else None)
+        t0 = time.monotonic()
+        try:
+            from jepsen_tpu.lattice import engine as lat_engine
+        except Exception:           # noqa: BLE001 - lattice optional
+            return [], None
+        stack = np.zeros((8, n, n), bool)
+        for si, name in enumerate(("ww", "wr", "rw")):
+            pi = infer_mod.PLANES.index(name)
+            stack[si] = elle_mesh.unpack_bits(
+                self._planes[pi, :n], n)
+        T = self.inc.txns
+        wrote = np.zeros(n, bool)
+        read = np.zeros(n, bool)
+        by_proc: dict = {}
+        for i, t in enumerate(T):
+            for m in t[self.inc._VAL]:
+                if not mop.is_op(m):
+                    continue
+                if mop.is_write(m) or mop.is_append(m):
+                    wrote[i] = True
+                elif mop.is_read(m) or mop.is_predicate_read(m):
+                    read[i] = True
+            by_proc.setdefault(t[self.inc._P], []).append(i)
+        so = np.zeros((n, n), bool)
+        for seq in by_proc.values():
+            for ai, a in enumerate(seq):
+                so[a, seq[ai + 1:]] = True
+        stack[3] = so & np.outer(wrote, wrote)
+        stack[4] = so & np.outer(wrote, read)
+        stack[5] = so & np.outer(read, wrote)
+        stack[6] = so & np.outer(read, read)
+        # plane 7 (prw) stays empty: predicate reads are a one-shot
+        # evidence pass; the incremental feed skips rp micro-ops
+        row = lat_engine.classify_host(stack, n)
+        self._lattice_s = round(time.monotonic() - t0, 6)
+        fresh = {cls: edge for cls, edge in row["anomalies"].items()
+                 if cls in self._LATTICE_ONLY
+                 and cls not in self._lattice_found}
+        flags: list = []
+        now_wall = time.time()  # lint: wall-ok(advisory detect-lag gauge; flags ride the lane/seq path)
+        for cls, (a, b) in sorted(fresh.items()):
+            self._lattice_found.add(cls)
+            oka = T[a][self.inc._OK] if a < n else -1
+            okb = T[b][self.inc._OK] if b < n else -1
+            wall = self._wall.get(okb)
+            self._propose(flags, cls, -1,
+                          {"edge": [int(a), int(b)],
+                           "ok_ops": [oka, okb]}, wall)
+            if wall is not None:
+                telemetry.REGISTRY.gauge(
+                    "live_lattice_detect_lag_seconds").set(
+                    round(max(0.0, now_wall - wall), 6))
+        summary = {"classes": sorted(
+            set(row["anomalies"]) & set(self._LATTICE_ONLY)),
+            "seconds": self._lattice_s}
+        return flags, summary
 
     def record_flag(self, flag: dict) -> None:
         """Bounded emitted-flag summaries for live.json / /live."""
@@ -477,13 +570,21 @@ class TxnTenant:
                 "engine": self._last_engine,
                 "rounds": self._last_rounds,
                 "n_pad": self._n_pad,
+                "lattice_classes": sorted(self._lattice_found),
+                "lattice_seconds": self._lattice_s,
             },
         }
 
 
 def _level_of(name: str) -> Optional[str]:
+    """Weakest violated model for a flag: the full consistency
+    lattice first (covers the session/causal/predicate classes the
+    per-window lattice pass proposes, and agrees with ANOMALY_LEVEL
+    on Adya's), the Adya map as fallback for any legacy name."""
+    from jepsen_tpu import lattice
     from jepsen_tpu.checker import elle as elle_checker
-    return elle_checker.ANOMALY_LEVEL.get(name)
+    return (lattice.model_of(name)
+            or elle_checker.ANOMALY_LEVEL.get(name))
 
 
 def _weakest_violated(found) -> Optional[str]:

@@ -90,7 +90,8 @@ class Unsupported(CheckError):
 
 #: Engines that can check ANY in-scope input for their family — every
 #: chain the planner emits ends with one of these.
-TERMINAL_ENGINES = frozenset({"wgl_cpu", "elle-host", "live-host"})
+TERMINAL_ENGINES = frozenset({"wgl_cpu", "elle-host", "live-host",
+                              "lattice-host"})
 
 #: Env knobs that PRUNE engines from a plan (knob value "1" active).
 #: This is the one registry the knobs-only-prune property checks
@@ -586,6 +587,9 @@ def plan_engines(shape: Shape, env: Optional[dict] = None,
     elif s.kind == "live":
         return plan_live(lanes=s.batch, events=s.n_ops,
                          bits=s.R, states=s.Sn or 1, env=env)
+    elif s.kind == "lattice":
+        return plan_lattice(n_max=s.n_ops, batch=s.batch, env=env,
+                            devices=s.mesh)
     else:
         raise ValueError(f"unknown plan kind {shape.kind!r}")
 
@@ -671,6 +675,49 @@ def plan_elle(n_max: int, batch: int = 1, *, algorithm: str = "auto",
             rejected.append(("elle-mesh",
                              f"n_max={n_max} below mesh_threshold"))
     bucket = ("elle", chain[0], _next_pow2(max(n_max, 1)),
+              _next_pow2(max(batch, 1)))
+    return Plan(engine=chain[0], fallbacks=tuple(chain[1:]), why=why,
+                bucket=bucket, rejected=tuple(rejected),
+                pack_backend=pack_backend_effective(env),
+                pack_threads=pack_threads_effective(env))
+
+
+def plan_lattice(n_max: int, batch: int = 1, *,
+                 algorithm: str = "auto",
+                 mesh_threshold: int = 4096,
+                 env: Optional[dict] = None,
+                 devices: Optional[int] = None) -> Plan:
+    """Tier chain for the full-lattice consistency engine (ISSUE 20):
+    lattice-mesh -> lattice-device -> lattice-host.  Same selection
+    contract as `plan_elle` — `algorithm` is caller intent, knobs only
+    prune — but the lattice closes seven coupled relations per round
+    (Adya pair closure, session pair closure, predicate closure,
+    long-fork automaton), so the mesh threshold sits lower: the dense
+    8-plane stack outgrows one device sooner than the 5-plane one."""
+    env = _snapshot_env(env)
+    rejected: list = []
+    if algorithm == "host":
+        chain = ["lattice-host"]
+        why = "host oracle requested (algorithm='host')"
+    elif algorithm == "mesh":
+        chain = ["lattice-mesh", "lattice-host"]
+        why = "strict packed mesh requested; host oracle below"
+    elif algorithm == "device":
+        chain = ["lattice-device", "lattice-host"]
+        why = "strict dense device engine requested"
+    else:
+        if n_max >= mesh_threshold:
+            chain = ["lattice-mesh", "lattice-device", "lattice-host"]
+            why = (f"n_max={n_max} >= mesh_threshold={mesh_threshold}: "
+                   "bit-packed row-sharded lattice closure"
+                   + (f" over {devices} devices" if devices else ""))
+        else:
+            chain = ["lattice-device", "lattice-host"]
+            why = (f"n_max={n_max} < mesh_threshold={mesh_threshold}: "
+                   "dense lattice closure on one device")
+            rejected.append(("lattice-mesh",
+                             f"n_max={n_max} below mesh_threshold"))
+    bucket = ("lattice", chain[0], _next_pow2(max(n_max, 1)),
               _next_pow2(max(batch, 1)))
     return Plan(engine=chain[0], fallbacks=tuple(chain[1:]), why=why,
                 bucket=bucket, rejected=tuple(rejected),

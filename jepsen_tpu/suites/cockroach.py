@@ -44,7 +44,11 @@ from jepsen_tpu import nemesis_time as nt
 from jepsen_tpu.checker import timeline
 from jepsen_tpu.control import lit
 from jepsen_tpu.history import History
+from jepsen_tpu import txn as mop_txn
 from jepsen_tpu.workloads import adya as adya_wl
+from jepsen_tpu.workloads import causal as causal_wl
+from jepsen_tpu.workloads import predicate as predicate_wl
+from jepsen_tpu.workloads import session as session_wl
 from jepsen_tpu.workloads import list_append as list_append_wl
 from jepsen_tpu.workloads import rw_register as rw_register_wl
 from jepsen_tpu.workloads import bank as bank_wl
@@ -1006,6 +1010,75 @@ class ElleListAppendClient(SQLClient):
         return op.assoc(type="ok", value=out)
 
 
+class CausalClient(SQLClient):
+    """Causal-register ops over SQL (ISSUE 20): independent keyed
+    registers; write installs the session's counter value, reads
+    return the current value (None while unwritten, which the causal
+    register treats as the init state)."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS causal "
+           "(id INT PRIMARY KEY, val INT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "causal")
+        k, v = op.value
+        if op.f == "write":
+            def w():
+                self.conn.txn([
+                    f"UPSERT INTO causal (id, val) VALUES ({k}, {v})"])
+            with_txn_retry(w)
+            update_keyrange(test, "causal", k)
+            return op.assoc(type="ok")
+        if op.f in ("read", "read-init"):
+            rows = with_txn_retry(lambda: self.conn.sql(
+                "SELECT val FROM causal WHERE id = ?", (k,)))
+            val = int(rows[0][0]) if rows else None
+            return op.assoc(type="ok", value=independent.tuple_(k, val))
+        raise ValueError(f"unknown f {op.f!r}")
+
+
+class PredicateClient(SQLClient):
+    """Predicate-read txns over SQL (ISSUE 20): `["w", k, v]` upserts;
+    `["rp", ["keys", ks], nil]` evaluates the predicate as one scalar
+    subquery per matched key (one row per key, so results align with
+    mops by position — the ElleListAppendClient discipline) and fills
+    the observed {k: v} map."""
+
+    DDL = ("CREATE TABLE IF NOT EXISTS pred "
+           "(k INT PRIMARY KEY, val INT)")
+
+    def _invoke(self, test, op):
+        ensure_table(self.conn, test, self.DDL, "pred")
+        txn = [list(m) for m in (op.value or [])]
+        stmts = []
+        for m in txn:
+            if mop_txn.is_predicate_read(m):
+                for k in mop_txn.predicate_keys(m):
+                    stmts.append(
+                        f"SELECT {k}, (SELECT val FROM pred "
+                        f"WHERE k = {k})")
+            else:
+                _, k, v = m
+                stmts.append(f"UPSERT INTO pred (k, val) "
+                             f"VALUES ({k}, {v})")
+        rows = with_txn_retry(lambda: self.conn.txn(stmts))
+        reads = iter(rows)
+        out = []
+        for m in txn:
+            if not mop_txn.is_predicate_read(m):
+                out.append(m)
+                continue
+            observed = {}
+            for k in mop_txn.predicate_keys(m):
+                row = next(reads, None)
+                val = row[1] if row is not None and len(row) > 1 \
+                    else None
+                if val is not None:
+                    observed[k] = int(val)
+            out.append([m[0], m[1], observed])
+        return op.assoc(type="ok", value=out)
+
+
 class ElleRwRegisterClient(SQLClient):
     """Elle rw-register txns over SQL (same one-txn discipline)."""
 
@@ -1279,8 +1352,64 @@ def rw_register_test(opts) -> dict:
     return test
 
 
+def session_test(opts) -> dict:
+    """Session guarantees over the full consistency lattice
+    (ISSUE 20): list-append sessions classified by the lattice
+    checker — read-your-writes, monotonic-reads/writes,
+    writes-follow-reads, PRAM, causal each surface as their own
+    class with weakest-violated naming the minimal broken model."""
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = session_wl.workload(opts)
+    test = base_test(opts, nm, "session")
+    test["client"] = ElleListAppendClient()
+    test["checker"] = ck.compose({"lattice": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 20, wl["generator"]), nm)
+    return test
+
+
+def causal_test(opts) -> dict:
+    """Causal registers (ISSUE 20): the lattice-backed causal checker
+    (legacy causal register as pinned differential oracle) over
+    independent keys."""
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    test = base_test(opts, nm, "causal")
+    test["client"] = CausalClient()
+    test["checker"] = ck.compose({
+        "causal": independent.checker(causal_wl.check()),
+        "perf": ck.perf()})
+    test["concurrency"] = _rounded_concurrency(opts, 1)
+    g = independent.concurrent_generator(
+        1, itertools.count(),
+        lambda k: gen.gseq([causal_wl.ri, causal_wl.cw1,
+                            causal_wl.r, causal_wl.cw2,
+                            causal_wl.r]))
+    _with_nemesis(opts, test, gen.stagger(1 / 10, g), nm)
+    return test
+
+
+def predicate_test(opts) -> dict:
+    """Predicate reads (ISSUE 20): phantom hunting — rp micro-ops
+    over a keyed register table, G1/G2-predicate via the lattice
+    engine's predicate evidence pass."""
+    opts = dict(opts or {})
+    nm = _nemesis_for(opts)
+    wl = predicate_wl.workload(opts)
+    test = base_test(opts, nm, "predicate")
+    test["client"] = PredicateClient()
+    test["checker"] = ck.compose({"lattice": wl["checker"],
+                                  "perf": ck.perf()})
+    _with_nemesis(opts, test, gen.stagger(1 / 20, wl["generator"]), nm)
+    return test
+
+
 tests = {
     "bank": bank_test,
+    "causal": causal_test,
+    "session": session_test,
+    "predicate": predicate_test,
     "bank-multitable": multitable_bank_test,
     "comments": comments_test,
     "register": register_test,

@@ -294,13 +294,152 @@ def etcd_test(opts) -> dict:
     })
 
 
+class EtcdCausalClient(EtcdClient):
+    """Causal-register ops over the kv gateway (ISSUE 20): read-init
+    reads like read; the int registers carry the causal counter."""
+
+    def invoke(self, test, op):
+        if op.f == "read-init":
+            out = super().invoke(test, op.assoc(f="read"))
+            return out.assoc(f="read-init")
+        return super().invoke(test, op)
+
+
+class EtcdPredicateClient(client_mod.Client):
+    """Predicate txns over the kv gateway (ISSUE 20): `["w", k, v]`
+    puts; `["rp", ["keys", ks], nil]` evaluates the key-set predicate
+    as one range read per key and fills the observed {k: v} map.
+    Micro-ops execute individually (the gateway has no multi-key
+    txn), so phantom evidence reflects the store's real interleaving."""
+
+    def __init__(self, http_factory=EtcdHttp):
+        self.http_factory = http_factory
+        self.http: Optional[EtcdHttp] = None
+
+    def open(self, test, node):
+        out = EtcdPredicateClient(self.http_factory)
+        out.http = self.http_factory(node)
+        return out
+
+    def invoke(self, test, op):
+        from jepsen_tpu import txn as mop_txn
+        try:
+            out = []
+            for m in (op.value or []):
+                if mop_txn.is_predicate_read(m):
+                    observed = {}
+                    for k in mop_txn.predicate_keys(m):
+                        v = self.http.get(f"p{k}")
+                        if v is not None:
+                            observed[k] = v
+                    out.append([m[0], m[1], observed])
+                else:
+                    _, k, v = m
+                    self.http.put(f"p{k}", v)
+                    out.append(list(m))
+            return op.assoc(type="ok", value=out)
+        except socket.timeout:
+            return op.assoc(type="info", error="timeout")
+        except ConnectionRefusedError as e:
+            return op.assoc(type="fail", error=str(e))
+        except urllib.error.URLError as e:
+            reason = getattr(e, "reason", None)
+            if isinstance(reason, socket.timeout):
+                return op.assoc(type="info", error="timeout")
+            return op.assoc(type="fail", error=str(reason or e))
+
+
+def _lattice_test(opts, name: str, client, generator, checker) -> dict:
+    """Shared shell for the lattice workloads: etcd_test's node /
+    nemesis / phase wiring with the workload swapped out."""
+    opts = dict(opts or {})
+    from jepsen_tpu.suites._template import resolve_named_nemeses
+    nm = resolve_named_nemeses(nemeses, opts, default=["parts"])
+    av = opts.get("argv-options") or {}
+    disk = any(n in faultfs.DISK_NEMESES
+               for n in (opts.get("nemesis") or av.get("nemesis") or []))
+    nodes = opts.get("nodes") or ["n1", "n2", "n3", "n4", "n5"]
+    from jepsen_tpu import tests as tst
+    return dict(tst.noop_test(), **{
+        "name": f"etcd {name}",
+        "nodes": nodes,
+        "concurrency": opts.get("concurrency", len(nodes)),
+        "ssh": opts.get("ssh", {}),
+        "db": EtcdDB(disk_faults=disk),
+        "client": client,
+        "net": net.iptables,
+        "nemesis": nm["client"],
+        "disk-faults": disk,
+        "generator": gen.phases(
+            gen.time_limit(opts.get("time-limit", 60),
+                           gen.nemesis(nm["during"], generator)),
+            gen.nemesis(nm["final"], gen.void)),
+        "checker": ck.compose({"perf": ck.perf(), name: checker}),
+    })
+
+
+def causal_test(opts) -> dict:
+    """Causal registers on etcd (ISSUE 20): the lattice-backed causal
+    checker (legacy causal register pinned as differential oracle)
+    over independent keys."""
+    from jepsen_tpu.workloads import causal as causal_wl
+    opts = dict(opts or {})
+    g = independent.concurrent_generator(
+        1, itertools.count(),
+        lambda k: gen.gseq([causal_wl.ri, causal_wl.cw1, causal_wl.r,
+                            causal_wl.cw2, causal_wl.r]))
+    test = _lattice_test(
+        opts, "causal", EtcdCausalClient(),
+        gen.stagger(1 / 10, g),
+        independent.checker(causal_wl.check()))
+    test["concurrency"] = max(1, opts.get("concurrency", 5))
+    return test
+
+
+def predicate_test(opts) -> dict:
+    """Predicate reads on etcd (ISSUE 20): phantom hunting over the
+    kv gateway, G1/G2-predicate via the lattice engine's predicate
+    evidence pass."""
+    from jepsen_tpu.workloads import predicate as predicate_wl
+    opts = dict(opts or {})
+    wl = predicate_wl.workload(opts)
+    return _lattice_test(
+        opts, "predicate", EtcdPredicateClient(),
+        gen.stagger(1 / 20, wl["generator"]), wl["checker"])
+
+
+tests = {
+    "register": etcd_test,
+    "causal": causal_test,
+    "predicate": predicate_test,
+}
+
+
+def test_for(opts) -> dict:
+    """Look up the workload by name (default: the classic register
+    test) and build its test map."""
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    name = opts.get("workload") or av.get("workload") or "register"
+    try:
+        ctor = tests[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; one of {sorted(tests)}")
+    return ctor(opts)
+
+
 def _opt_fn(parser):
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(tests),
+                        help="which workload to run")
     cli.nemesis_opt_spec(parser, nemeses, default="parts")
 
 
 def main(argv=None):
-    """etcd.clj -main :182-188 (+ the --nemesis registry flag)."""
-    cli.run(cli.single_test_cmd(etcd_test, _opt_fn), argv)
+    """etcd.clj -main :182-188 (+ the --nemesis and --workload
+    registry flags)."""
+    cli.run(cli.single_test_cmd(test_for, _opt_fn), argv)
 
 
 if __name__ == "__main__":

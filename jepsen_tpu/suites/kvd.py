@@ -327,7 +327,120 @@ def kvd_test(opts) -> dict:
     return test
 
 
+class KvdCausalClient(KVRegisterClient):
+    """Causal-register ops over the kvd line protocol (ISSUE 20):
+    read-init reads like read; the int registers carry the causal
+    counter."""
+
+    def invoke(self, test, op):
+        if op.f == "read-init":
+            out = super().invoke(test, op.assoc(f="read"))
+            return out.assoc(f="read-init")
+        return super().invoke(test, op)
+
+
+class KvdPredicateClient(KVRegisterClient):
+    """Predicate txns over the kvd line protocol (ISSUE 20): each
+    `["w", k, v]` SETs; each `["rp", ["keys", ks], nil]` GETs the
+    key-set predicate one key at a time and fills the observed
+    {k: v} map (no multi-key txn on the wire, so phantom evidence
+    reflects the store's real interleaving)."""
+
+    def invoke(self, test, op):
+        from jepsen_tpu import txn as mop_txn
+        try:
+            out = []
+            for m in (op.value or []):
+                if mop_txn.is_predicate_read(m):
+                    observed = {}
+                    for k in mop_txn.predicate_keys(m):
+                        v = self.conn.get(k)
+                        if v is not None:
+                            observed[k] = v
+                    out.append([m[0], m[1], observed])
+                else:
+                    _, k, v = m
+                    self.conn.put(k, v)
+                    out.append(list(m))
+            return op.assoc(type="ok", value=out)
+        except TimeoutError as e:
+            return op.assoc(type="info", error=str(e))
+        except ConnectionRefusedError as e:
+            return op.assoc(type="fail", error=str(e))
+
+
+def causal_test(opts) -> dict:
+    """Causal registers on kvd (ISSUE 20): the register test shell
+    with the lattice-backed causal checker (legacy causal register
+    pinned as differential oracle)."""
+    from jepsen_tpu import checker as ck
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import independent
+    from jepsen_tpu.workloads import causal as causal_wl
+    import itertools
+    opts = dict(opts or {})
+    test = kvd_test(opts)
+    test["name"] = "kvd causal"
+    test["client"] = KvdCausalClient(opts.get("kv-factory") or KvdConn)
+    test["checker"] = ck.compose({
+        "causal": independent.checker(causal_wl.check()),
+        "perf": ck.perf()})
+    g = independent.concurrent_generator(
+        1, itertools.count(),
+        lambda k: gen.gseq([causal_wl.ri, causal_wl.cw1, causal_wl.r,
+                            causal_wl.cw2, causal_wl.r]))
+    test["generator"] = gen.time_limit(
+        opts.get("time-limit", 60), gen.stagger(1 / 10, g))
+    test["concurrency"] = max(1, opts.get("concurrency", 5))
+    return test
+
+
+def predicate_test(opts) -> dict:
+    """Predicate reads on kvd (ISSUE 20): phantom hunting over the
+    line protocol, G1/G2-predicate via the lattice engine's
+    predicate evidence pass."""
+    from jepsen_tpu import checker as ck
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu.workloads import predicate as predicate_wl
+    opts = dict(opts or {})
+    wl = predicate_wl.workload(opts)
+    test = kvd_test(opts)
+    test["name"] = "kvd predicate"
+    test["client"] = KvdPredicateClient(opts.get("kv-factory")
+                                        or KvdConn)
+    test["checker"] = ck.compose({"lattice": wl["checker"],
+                                  "perf": ck.perf()})
+    test["generator"] = gen.time_limit(
+        opts.get("time-limit", 60),
+        gen.stagger(1 / 20, wl["generator"]))
+    return test
+
+
+tests = {
+    "register": kvd_test,
+    "causal": causal_test,
+    "predicate": predicate_test,
+}
+
+
+def test_for(opts) -> dict:
+    """Look up the workload by name (default: the linearizable
+    register test) and build its test map."""
+    opts = dict(opts or {})
+    av = opts.get("argv-options") or {}
+    name = opts.get("workload") or av.get("workload") or "register"
+    try:
+        ctor = tests[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown workload {name!r}; one of {sorted(tests)}")
+    return ctor(opts)
+
+
 def _opt_fn(parser):
+    parser.add_argument("--workload", default="register",
+                        choices=sorted(tests),
+                        help="which workload to run")
     cli.nemesis_opt_spec(parser, nemeses, default="pause")
 
 
@@ -339,7 +452,7 @@ def _campaign_target():
     return campaign.KvdTarget()
 
 
-main = simple_main(kvd_test, _opt_fn,
+main = simple_main(test_for, _opt_fn,
                    nemesis_registry=_campaign_target)
 
 if __name__ == "__main__":

@@ -6,6 +6,14 @@ A micro-op is a 3-element sequence [f, k, v] with f in {"r", "w",
 value.  "append" is the list-append workload's write (Elle §4: append
 a unique element to the list at key k; reads observe the whole list,
 which is what makes version orders recoverable from observations).
+
+"rp" is the predicate read (ISSUE 20): ["rp", pred, observed] where
+pred is a predicate descriptor — canonically ["keys", [k, ...]], the
+explicit match set the workload queried — and observed maps each
+matched key to the version the read saw ({} on invoke).  A committed
+write to a key inside the predicate's match set that the read did NOT
+observe is phantom evidence (the `prw` plane in `jepsen_tpu.lattice`),
+which is what makes G1-predicate / G2-predicate detectable.
 """
 
 from __future__ import annotations
@@ -35,6 +43,21 @@ def is_append(mop) -> bool:
     return f(mop) == "append"
 
 
+def is_predicate_read(mop) -> bool:
+    return f(mop) == "rp"
+
+
+def predicate_keys(mop) -> tuple:
+    """The explicit match set of a ["keys", [...]] predicate read, or
+    () when the descriptor is opaque (no phantom evidence derivable)."""
+    pred = key(mop)
+    if (isinstance(pred, (list, tuple)) and len(pred) == 2
+            and pred[0] == "keys"
+            and isinstance(pred[1], (list, tuple))):
+        return tuple(pred[1])
+    return ()
+
+
 def is_op(mop) -> bool:
     return (isinstance(mop, (list, tuple)) and len(mop) == 3
-            and f(mop) in ("r", "w", "read", "write", "append"))
+            and f(mop) in ("r", "w", "read", "write", "append", "rp"))

@@ -349,6 +349,7 @@ def live_run_html(name: str, ts: str) -> bytes:
                 f"<td>{html.escape(json.dumps(txn.get(k), default=repr))}"
                 "</td></tr>"
                 for k in ("workload", "txns", "keys", "anomalies",
+                          "lattice_classes", "lattice_seconds",
                           "windows", "closure_rebuilds",
                           "resumed_txns", "engine", "rounds",
                           "n_pad", "flags_capped"))

@@ -79,7 +79,12 @@ class CausalChecker(ck.Checker):
 
 
 def check(model=None):
-    return CausalChecker(model)
+    """Lattice-backed causal checker (ISSUE 20): the register history
+    lowers to list-append planes and classifies over the full
+    consistency lattice; `CausalChecker` above stays as the pinned
+    differential oracle run alongside."""
+    from jepsen_tpu.lattice import adapters
+    return adapters.CausalLatticeChecker(model)
 
 
 def r(test, process):

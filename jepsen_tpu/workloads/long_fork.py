@@ -252,7 +252,14 @@ class LongForkChecker(ck.Checker):
 
 
 def checker(n: int):
-    return LongForkChecker(n)
+    """Lattice-backed long-fork checker (ISSUE 20): the group-read
+    history classifies directly on the plane engine (nil-first rw
+    augmentation supplies the anti-deps; the wr-(rw-wr)* automaton
+    finds the fork as a `long-fork` class with weakest-violated
+    parallel-snapshot-isolation); `LongForkChecker` above stays as
+    the pinned differential oracle run alongside."""
+    from jepsen_tpu.lattice import adapters
+    return adapters.LongForkLatticeChecker(n)
 
 
 def workload(opts=None) -> dict:

@@ -78,7 +78,13 @@ class MonotonicChecker(ck.Checker):
 
 
 def checker():
-    return MonotonicChecker()
+    """Lattice-backed monotonic checker (ISSUE 20): the timestamped
+    rows lower to one list-append session read back in ts order, so
+    a ts/value inversion classifies as a `monotonic-writes` cycle;
+    `MonotonicChecker` above stays as the pinned differential oracle
+    run alongside."""
+    from jepsen_tpu.lattice import adapters
+    return adapters.MonotonicLatticeChecker()
 
 
 class MonotonicSource:

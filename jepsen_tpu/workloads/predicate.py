@@ -1,0 +1,76 @@
+"""Predicate-read workload (ISSUE 20): phantom hunting.
+
+Transactions mix register writes `["w", k, v]` (v unique per key)
+with predicate reads `["rp", ["keys", [k...]], observed]` — the
+client evaluates the predicate (a key set, `txn.predicate_keys`) and
+fills `observed` with every (k, v) it matched.  The lattice engine's
+predicate evidence pass (`elle/infer._infer_predicate`) then flags:
+
+  * G1-predicate — the predicate observed a failed or garbage write
+    (dirty/garbage phantom: breaks read-committed on its own);
+  * G2-predicate — a predicate anti-dependency cycle: the read's
+    match set missed a key a committed txn wrote, and a dependency
+    path leads back (write skew through a phantom: breaks
+    serializability only).
+
+The checker is the full-lattice checker, so item anomalies from the
+write traffic are still named alongside the predicate classes.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from jepsen_tpu import generator as gen
+
+
+class PredicateGenerator(gen.Generator):
+    """Writes with unique values per key, predicate reads over random
+    key subsets of the live keyspace."""
+
+    def __init__(self, key_count: int = 4, read_ratio: float = 0.5,
+                 max_mops: int = 2):
+        self.lock = threading.Lock()
+        self.keys = list(range(key_count))
+        self.counters = {k: 0 for k in self.keys}
+        self.read_ratio = read_ratio
+        self.max_mops = max_mops
+
+    def _mop(self):
+        if random.random() < self.read_ratio:
+            ks = sorted(random.sample(
+                self.keys, random.randint(1, len(self.keys))))
+            return ["rp", ["keys", ks], None]
+        k = random.choice(self.keys)
+        with self.lock:
+            self.counters[k] += 1
+            v = self.counters[k]
+        return ["w", k, v]
+
+    def op(self, test, process):
+        n = random.randint(1, self.max_mops)
+        return {"type": "invoke", "f": "txn",
+                "value": [self._mop() for _ in range(n)]}
+
+
+def generator(opts=None) -> gen.Generator:
+    o = dict(opts or {})
+    return PredicateGenerator(
+        key_count=o.get("key-count", 4),
+        read_ratio=o.get("read-ratio", 0.5),
+        max_mops=o.get("max-txn-length", 2))
+
+
+def checker(opts=None):
+    from jepsen_tpu.lattice import checker as lattice_ck
+    o = dict(opts or {})
+    return lattice_ck.checker(
+        workload="rw-register",
+        anomalies=o.get("anomalies"),
+        algorithm=o.get("lattice-algorithm", "auto"))
+
+
+def workload(opts=None) -> dict:
+    o = dict(opts or {})
+    return {"generator": generator(o), "checker": checker(o)}

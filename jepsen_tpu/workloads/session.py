@@ -1,0 +1,43 @@
+"""Session-guarantee workload (ISSUE 20): per-process sessions of
+list-append txns, checked over the FULL consistency lattice.
+
+Each worker's ops form one session; the lattice checker
+(`jepsen_tpu.lattice.checker`) classifies the history against the
+session-order planes, so read-your-writes, monotonic-reads,
+monotonic-writes, writes-follow-reads, PRAM and causal violations
+each surface as their own class with `weakest-violated` naming the
+minimal broken model — not just Adya's chain.
+
+Sessions deliberately interleave reads and appends on a small shared
+keyspace (`read_ratio` high, txns short) so every session family gets
+defining edges: a read-mostly session exercises monotonic-reads, a
+write-mostly one monotonic-writes, the mixed middle
+read-your-writes / writes-follow-reads.
+"""
+
+from __future__ import annotations
+
+from jepsen_tpu.workloads import list_append as list_append_wl
+
+
+def generator(opts=None):
+    o = dict(opts or {})
+    # short mixed txns, read-heavy: session families need both roles
+    o.setdefault("min-txn-length", 1)
+    o.setdefault("max-txn-length", 2)
+    o.setdefault("read-ratio", 0.6)
+    return list_append_wl.generator(o)
+
+
+def checker(opts=None):
+    from jepsen_tpu.lattice import checker as lattice_ck
+    o = dict(opts or {})
+    return lattice_ck.checker(
+        workload="list-append",
+        anomalies=o.get("anomalies"),
+        algorithm=o.get("lattice-algorithm", "auto"))
+
+
+def workload(opts=None) -> dict:
+    o = dict(opts or {})
+    return {"generator": generator(o), "checker": checker(o)}

@@ -233,6 +233,43 @@ def _live_txn_summary():
         return None
 
 
+def _lattice_summary():
+    """The full-lattice engine's counters (ISSUE 20): classify calls
+    by engine tier (lattice-host / lattice-device / lattice-mesh)
+    and anomalies by lattice class — recorded so a regression that
+    silently reroutes every classification to the host tier (device
+    path dead while the parity battery stays green) or stops naming
+    a session/causal/predicate class diffs across PRs.  Counts cover
+    THIS process only; kill9 subprocess workers keep their own
+    registries.  None when no lattice classification ran this
+    session."""
+    try:
+        from jepsen_tpu import telemetry
+        coll = telemetry.REGISTRY.collect()
+        _k, by_engine = coll.get("lattice_classify_total", (None, {}))
+        if not by_engine:
+            return None
+        engines = {}
+        for key, m in by_engine.items():
+            e = dict(key).get("engine", "?")
+            engines[e] = engines.get(e, 0) + int(m.value)
+        _k, by_cls = coll.get("lattice_anomalies_total", (None, {}))
+        classes = {}
+        for key, m in (by_cls or {}).items():
+            c = dict(key).get("cls", "?")
+            classes[c] = classes.get(c, 0) + int(m.value)
+        _k, lag = coll.get("live_lattice_detect_lag_seconds",
+                           (None, {}))
+        return {"classified": sum(engines.values()),
+                "engines": engines,
+                "classes": classes,
+                "live_detect_lag_s": round(
+                    max((m.value for m in lag.values()), default=0.0),
+                    4) if lag else None}
+    except Exception:   # noqa: BLE001 - artifact must never fail
+        return None
+
+
 def _trace_summary():
     """The causal flight recorder's counters (ISSUE 19): finished
     spans, durable trace-flag records, linked lease handoffs, and the
@@ -330,6 +367,7 @@ def pytest_sessionfinish(session, exitstatus):
             "campaign": _campaign_summary(),
             "fleet": _fleet_summary(),
             "live_txn": _live_txn_summary(),
+            "lattice": _lattice_summary(),
             "ingest": _ingest_summary(),
             "trace": _trace_summary(),
             "lint": _lint_summary(),

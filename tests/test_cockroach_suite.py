@@ -126,12 +126,25 @@ class TestWorkloadsEndToEnd:
         ("sequential", "sequential"),
         ("comments", "comments"),
         ("g2", "g2"),
+        ("session", "lattice"),
+        ("causal", "causal"),
+        ("predicate", "lattice"),
     ])
     def test_valid_against_memsql(self, workload, key):
         result, _ = run_suite(workload)
         res = result["results"]
         assert res[key]["valid?"] is True, res[key]
         assert res["valid?"] is True
+
+    def test_session_workload_classifies_on_lattice(self):
+        """ISSUE 20: the session workload's verdict comes from the
+        full-lattice checker — weakest-violated ranges over
+        lattice.MODELS and the engine is a lattice tier."""
+        result, _ = run_suite("session")
+        lat = result["results"]["lattice"]
+        assert lat["valid?"] is True, lat
+        assert lat["engine"].startswith("lattice-")
+        assert lat["workload"] == "list-append"
 
     def test_bank_multitable(self):
         result, _ = run_suite("bank-multitable")

@@ -1,0 +1,504 @@
+"""Full consistency-lattice battery (ISSUE 20): one planted history
+per lattice class (session guarantees, PRAM, causal, long-fork, the
+Adya item classes and the predicate pair), each asserting EXACTLY that
+class, the correct weakest-violated model, and a valid recovered
+witness cycle; a randomized three-tier differential (host vs dense
+device vs packed mesh, bit-identical flags and defining edges); the
+partial-order unit tests for `lattice.weakest_violated`; and the
+adapter parity battery pinning the migrated causal / long-fork /
+monotonic checkers against their legacy host oracles."""
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import History, fail_op, invoke_op, ok_op
+from jepsen_tpu.lattice import adapters
+from jepsen_tpu.lattice import checker as lattice_ck
+from jepsen_tpu.lattice import engine as lattice_engine
+from jepsen_tpu.lattice import lattice as lattice_mod
+from jepsen_tpu.lattice import planes as planes_mod
+from jepsen_tpu.workloads import causal as causal_wl
+from jepsen_tpu.workloads import long_fork as long_fork_wl
+from jepsen_tpu.workloads import monotonic as monotonic_wl
+
+
+def hist(ops) -> History:
+    return History(ops).index()
+
+
+def txns(*triples) -> History:
+    """[(process, mops), ...] -> indexed ok-only txn history."""
+    ops = []
+    for p, mops in triples:
+        ops.append(invoke_op(p, "txn", [list(m) for m in mops]))
+        ops.append(ok_op(p, "txn", [list(m) for m in mops]))
+    return hist(ops)
+
+
+def classify(h, workload="list-append", **kw):
+    return lattice_ck.classify_history(h, workload=workload, **kw)
+
+
+def assert_witness(v, cls):
+    """Every engine-flagged class must carry a real recovered cycle
+    (steps closing on themselves), never the 'unrecovered' marker."""
+    entries = v["anomalies"][cls]
+    assert entries, (cls, v)
+    cyc = [e for e in entries if "steps" in e]
+    assert cyc, (cls, entries)
+    steps = cyc[0]["steps"]
+    assert len(steps) >= 2
+    assert steps[0] == steps[-1] or len(set(steps)) == len(steps)
+
+
+# ---------------------------------------------------------------------------
+# Planted histories: one per lattice class (the acceptance battery)
+# ---------------------------------------------------------------------------
+
+def h_monotonic_writes():
+    """One session appends 1 then 2; a reader observes them
+    inverted, so the version order points back against session
+    order."""
+    return txns(
+        (0, [["append", "x", 1]]),
+        (0, [["append", "x", 2]]),
+        (1, [["r", "x", [2, 1]]]),
+    )
+
+
+def h_read_your_writes():
+    """The session's own later read misses its write."""
+    return txns(
+        (0, [["append", "x", 1]]),
+        (0, [["r", "x", []]]),
+        (1, [["r", "x", [1]]]),
+    )
+
+
+def h_monotonic_reads():
+    """The session reads [1] then forgets it."""
+    return txns(
+        (2, [["append", "x", 1]]),
+        (0, [["r", "x", [1]]]),
+        (0, [["r", "x", []]]),
+    )
+
+
+def h_writes_follow_reads():
+    """Session reads w's write then writes y; a third txn sees y but
+    anti-depends on w — w's write didn't follow the session out."""
+    return txns(
+        (1, [["append", "x", 1]]),
+        (0, [["r", "x", [1]]]),
+        (0, [["append", "y", 1]]),
+        (2, [["r", "y", [1]], ["r", "x", []]]),
+    )
+
+
+def h_pram():
+    """Two sessions, each read-then-write in SEPARATE txns across two
+    keys: every return path alternates wr and so edges with no
+    anti-dependency, so no single session guarantee (and nothing in
+    Adya's chain) names it — only PRAM does."""
+    return txns(
+        (0, [["r", "x", [7]]]),
+        (0, [["append", "y", 5]]),
+        (1, [["r", "y", [5]]]),
+        (1, [["append", "x", 7]]),
+    )
+
+
+def h_causal():
+    """w -> reader session writes y -> second reader session sees y
+    but holds a stale nil read of x: exactly one anti-dependency on a
+    so-threaded return path = causal, nothing stronger."""
+    return txns(
+        (2, [["append", "x", 1]]),
+        (0, [["r", "x", [1]]]),
+        (0, [["append", "y", 1]]),
+        (1, [["r", "y", [1]]]),
+        (1, [["r", "x", []]]),
+    )
+
+
+def h_long_fork():
+    """rw-register long fork: two independent writers, two readers
+    observing them in opposite orders (the nil-first rw augmentation
+    supplies the anti-dependencies)."""
+    return txns(
+        (0, [["w", "x", 1]]),
+        (1, [["w", "y", 1]]),
+        (2, [["r", "x", 1], ["r", "y", None]]),
+        (3, [["r", "y", 1], ["r", "x", None]]),
+    )
+
+
+def h_g0():
+    return txns(
+        (0, [["append", "x", 1], ["append", "y", 1]]),
+        (1, [["append", "x", 2], ["append", "y", 2]]),
+        (2, [["r", "x", [1, 2]], ["r", "y", [2, 1]]]),
+    )
+
+
+def h_g1c():
+    return txns(
+        (0, [["append", "x", 1], ["r", "y", [2]]]),
+        (1, [["append", "y", 2], ["r", "x", [1]]]),
+    )
+
+
+def h_g_single():
+    return txns(
+        (0, [["append", "x", 1]]),
+        (1, [["append", "x", 2], ["append", "y", 1]]),
+        (2, [["r", "x", [1, 2]], ["r", "y", []]]),
+    )
+
+
+def h_g2_item():
+    """Classic write skew: both txns read the other's key empty."""
+    return txns(
+        (0, [["r", "x", []], ["append", "y", 1]]),
+        (1, [["r", "y", []], ["append", "x", 1]]),
+    )
+
+
+def h_g2_predicate():
+    """Write skew through a phantom: t0's predicate read over {y}
+    missed t1's committed y while reading t1's z — an anti-dependency
+    only the predicate plane carries."""
+    return txns(
+        (0, [["rp", ["keys", ["y"]], {}], ["r", "z", 1]]),
+        (1, [["w", "y", 1], ["w", "z", 1]]),
+    )
+
+
+PLANTS = [
+    ("monotonic-writes", h_monotonic_writes, "list-append",
+     "monotonic-writes"),
+    ("read-your-writes", h_read_your_writes, "list-append",
+     "read-your-writes"),
+    ("monotonic-reads", h_monotonic_reads, "list-append",
+     "monotonic-reads"),
+    ("writes-follow-reads", h_writes_follow_reads, "list-append",
+     "writes-follow-reads"),
+    ("PRAM", h_pram, "list-append", "PRAM"),
+    ("causal", h_causal, "list-append", "causal"),
+    ("long-fork", h_long_fork, "rw-register",
+     "parallel-snapshot-isolation"),
+    ("G0", h_g0, "list-append", "read-uncommitted"),
+    ("G1c", h_g1c, "list-append", "read-committed"),
+    ("G-single", h_g_single, "list-append", "snapshot-isolation"),
+    ("G2-item", h_g2_item, "list-append", "serializable"),
+    ("G2-predicate", h_g2_predicate, "rw-register", "serializable"),
+]
+
+
+class TestPlantedLattice:
+    @pytest.mark.parametrize("cls,mk,workload,level",
+                             PLANTS, ids=[p[0] for p in PLANTS])
+    def test_exact_class_level_witness(self, cls, mk, workload, level):
+        v = classify(mk(), workload=workload, algorithm="host")
+        assert v["anomaly-types"] == [cls], v
+        assert v["valid?"] is False
+        assert v["weakest-violated"] == level, v
+        assert_witness(v, cls)
+
+    @pytest.mark.parametrize("cls,mk,workload,level",
+                             PLANTS, ids=[p[0] for p in PLANTS])
+    def test_device_tier_matches(self, cls, mk, workload, level):
+        v = classify(mk(), workload=workload, algorithm="device")
+        assert v["anomaly-types"] == [cls], v
+        assert v["weakest-violated"] == level
+        assert v["engine"] == "lattice-device"
+
+    def test_g1_predicate_direct(self):
+        """A predicate read observing an aborted write is flagged by
+        the direct evidence pass (no cycle needed)."""
+        h = hist([
+            invoke_op(0, "txn", [["w", "x", 5]]),
+            fail_op(0, "txn", [["w", "x", 5]]),
+            invoke_op(1, "txn", [["rp", ["keys", ["x"]], None]]),
+            ok_op(1, "txn", [["rp", ["keys", ["x"]], {"x": 5}]]),
+        ])
+        v = classify(h, workload="rw-register", algorithm="host")
+        assert "G1-predicate" in v["anomaly-types"], v
+        assert v["valid?"] is False
+        assert v["weakest-violated"] == "read-committed"
+
+    def test_clean_history_is_valid(self):
+        h = txns(
+            (0, [["append", "x", 1]]),
+            (0, [["r", "x", [1]]]),
+            (1, [["r", "x", [1]], ["append", "x", 2]]),
+            (0, [["r", "x", [1, 2]]]),
+        )
+        v = classify(h, workload="list-append", algorithm="host")
+        assert v["valid?"] is True, v
+        assert v["anomaly-types"] == []
+        assert v["weakest-violated"] is None
+
+    def test_nil_first_rw_is_lattice_only(self):
+        """The nil-first augmentation must not leak spurious Adya
+        classes into a clean register history."""
+        h = txns(
+            (0, [["w", "x", 1]]),
+            (1, [["r", "x", 1]]),
+            (2, [["r", "x", None]]),   # raced ahead of the write
+        )
+        v = classify(h, workload="rw-register", algorithm="host")
+        assert v["valid?"] is True, v
+        assert v["lattice"]["nil-first-rw"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# weakest_violated: the partial order itself
+# ---------------------------------------------------------------------------
+
+class TestWeakestViolated:
+    def test_empty_is_none(self):
+        assert lattice_mod.weakest_violated({}) is None
+
+    @pytest.mark.parametrize("found,expect", [
+        ({"G0"}, "read-uncommitted"),
+        ({"G1c"}, "read-committed"),
+        ({"G-single"}, "snapshot-isolation"),
+        ({"G2-item"}, "serializable"),
+        ({"long-fork"}, "parallel-snapshot-isolation"),
+        ({"G2-predicate"}, "serializable"),
+        ({"G1-predicate"}, "read-committed"),
+        ({"PRAM"}, "PRAM"),
+        ({"causal"}, "causal"),
+        ({"read-your-writes"}, "read-your-writes"),
+        ({"monotonic-reads"}, "monotonic-reads"),
+        ({"monotonic-writes"}, "monotonic-writes"),
+        ({"writes-follow-reads"}, "writes-follow-reads"),
+    ])
+    def test_single_class(self, found, expect):
+        assert lattice_mod.weakest_violated(found) == expect
+
+    def test_weaker_class_wins(self):
+        # a session violation is weaker than any Adya violation
+        assert lattice_mod.weakest_violated(
+            {"G2-item", "read-your-writes"}) == "read-your-writes"
+        assert lattice_mod.weakest_violated(
+            {"G1c", "PRAM"}) == "PRAM"
+
+    def test_incomparable_ties_break_on_models_order(self):
+        # read-your-writes and monotonic-reads are incomparable;
+        # MODELS lists read-your-writes first
+        assert lattice_mod.weakest_violated(
+            {"read-your-writes", "monotonic-reads"}) \
+            == "read-your-writes"
+
+    def test_adya_chain_backward_compatible(self):
+        # the old 4-level chain ordering survives inside the lattice
+        chain = [({"G0"}, "read-uncommitted"),
+                 ({"G1c"}, "read-committed"),
+                 ({"G-single"}, "snapshot-isolation"),
+                 ({"G2-item"}, "serializable")]
+        for found, lv in chain:
+            assert lattice_mod.weakest_violated(found) == lv
+        assert lattice_mod.weakest_violated(
+            {"G0", "G1c", "G-single", "G2-item"}) == "read-uncommitted"
+
+    def test_violated_models_up_closure(self):
+        models = lattice_mod.violated_models({"PRAM"})
+        assert "PRAM" in models
+        assert "causal" in models          # stronger models fall too
+        assert "serializable" in models
+        assert "read-your-writes" not in models   # weaker ones stand
+
+
+# ---------------------------------------------------------------------------
+# Three-tier differential: host / dense device / packed mesh
+# ---------------------------------------------------------------------------
+
+def random_stack(rng, n):
+    """A random 8-plane stack: sparse dep planes, session families
+    from a random per-process order (transitively closed, role-split
+    like planes.session_planes builds them)."""
+    stack = np.zeros((len(planes_mod.LATTICE_PLANES), n, n), bool)
+    for pi in (0, 1, 2):               # ww / wr / rw
+        m = rng.randrange(0, max(2, n))
+        for _ in range(m):
+            a, b = rng.randrange(n), rng.randrange(n)
+            if a != b:
+                stack[pi, a, b] = True
+    procs = [rng.randrange(3) for _ in range(n)]
+    wrote = [rng.random() < 0.7 for _ in range(n)]
+    read = [rng.random() < 0.7 for _ in range(n)]
+    by_p: dict = {}
+    for i, p in enumerate(procs):
+        by_p.setdefault(p, []).append(i)
+    for seq in by_p.values():
+        for ai in range(len(seq)):
+            for bi in range(ai + 1, len(seq)):
+                a, b = seq[ai], seq[bi]
+                if wrote[a] and wrote[b]:
+                    stack[3, a, b] = True
+                if wrote[a] and read[b]:
+                    stack[4, a, b] = True
+                if read[a] and wrote[b]:
+                    stack[5, a, b] = True
+                if read[a] and read[b]:
+                    stack[6, a, b] = True
+    m = rng.randrange(0, max(2, n // 2))
+    for _ in range(m):
+        a, b = rng.randrange(n), rng.randrange(n)
+        if a != b:
+            stack[7, a, b] = True
+    return stack
+
+
+class TestThreeTierDifferential:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_host_device_mesh_identical(self, seed):
+        from jepsen_tpu.ops import elle_mesh
+        rng = random.Random(seed)
+        n = rng.choice([5, 9, 17, 33])
+        stack = random_stack(rng, n)
+        host = lattice_engine.classify_host(stack, n)
+        dev = lattice_engine.classify_device(stack, n)
+        assert set(host["anomalies"]) == set(dev["anomalies"]), seed
+        for cls, edge in host["anomalies"].items():
+            assert tuple(dev["anomalies"][cls]) == tuple(edge), \
+                (seed, cls)
+        packed = elle_mesh.pack_planes(stack, n_dev=2)
+        mesh = lattice_engine.classify_packed(packed, n,
+                                              max_devices=2)
+        assert set(host["anomalies"]) == set(mesh["anomalies"]), seed
+        for cls, edge in host["anomalies"].items():
+            assert tuple(mesh["anomalies"][cls]) == tuple(edge), \
+                (seed, cls)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_witness_recovers_for_every_flag(self, seed):
+        rng = random.Random(1000 + seed)
+        n = rng.choice([6, 12, 20])
+        stack = random_stack(rng, n)
+        host = lattice_engine.classify_host(stack, n)
+        for cls, edge in host["anomalies"].items():
+            cyc = lattice_engine.find_witness(stack, cls, edge)
+            assert cyc is not None, (seed, cls, edge)
+            assert cyc[0] == cyc[-1] or len(cyc) >= 2
+
+    def test_planner_chain_routes_and_records(self):
+        v = classify(h_g_single(), workload="list-append",
+                     algorithm="auto")
+        assert v["engine"] in ("lattice-device", "lattice-mesh",
+                               "lattice-host")
+        assert v["anomaly-types"] == ["G-single"]
+
+    def test_mesh_algorithm_end_to_end(self):
+        v = classify(h_pram(), workload="list-append",
+                     algorithm="mesh")
+        assert v["anomaly-types"] == ["PRAM"]
+        assert v["engine"] == "lattice-mesh"
+        assert v["shards"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# Migrated workload checkers: lattice primary, legacy pinned oracle
+# ---------------------------------------------------------------------------
+
+def causal_hist(seq):
+    """[(f, value)] single-session register history."""
+    ops = []
+    for f, v in seq:
+        ops.append(invoke_op(0, f, None if f != "write" else v))
+        ops.append(ok_op(0, f, v))
+    return hist(ops)
+
+
+class TestAdapterParity:
+    def test_causal_clean_agrees(self):
+        h = causal_hist([("read-init", 0), ("write", 1), ("read", 1),
+                         ("write", 2), ("read", 2)])
+        v = causal_wl.check().check({}, h, {})
+        assert v["valid?"] is True, v
+        assert v["oracle-agrees"] is True
+
+    def test_causal_stale_read_agrees_invalid(self):
+        h = causal_hist([("read-init", 0), ("write", 1), ("read", 1),
+                         ("write", 2), ("read", 1)])
+        v = causal_wl.check().check({}, h, {})
+        assert v["valid?"] is False, v
+        assert v["oracle-agrees"] is True
+        assert v["weakest-violated"] is not None
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_causal_randomized_parity(self, seed):
+        rng = random.Random(seed)
+        seq = [("read-init", 0)]
+        value = 0
+        for nxt in (1, 2):
+            seq.append(("write", nxt))
+            value = nxt
+            for _ in range(rng.randrange(0, 3)):
+                corrupt = rng.random() < 0.3
+                seq.append(("read",
+                            rng.randrange(0, value) if corrupt
+                            and value else value))
+        v = causal_wl.check().check({}, causal_hist(seq), {})
+        assert v["oracle-agrees"] is True, (seed, seq, v)
+
+    def test_long_fork_planted_agrees_invalid(self):
+        h = hist([
+            invoke_op(0, "write", [["w", 0, 1]]),
+            ok_op(0, "write", [["w", 0, 1]]),
+            invoke_op(1, "write", [["w", 1, 1]]),
+            ok_op(1, "write", [["w", 1, 1]]),
+            invoke_op(2, "read", [["r", 0, 1], ["r", 1, None]]),
+            ok_op(2, "read", [["r", 0, 1], ["r", 1, None]]),
+            invoke_op(3, "read", [["r", 1, 1], ["r", 0, None]]),
+            ok_op(3, "read", [["r", 1, 1], ["r", 0, None]]),
+        ])
+        v = long_fork_wl.checker(2).check({}, h, {})
+        assert v["valid?"] is False, v
+        assert "long-fork" in v["anomaly-types"]
+        assert v["weakest-violated"] == "parallel-snapshot-isolation"
+        assert v["oracle-agrees"] is True
+
+    def test_monotonic_inversion_agrees_invalid(self):
+        h = hist([
+            invoke_op(0, "read", None),
+            ok_op(0, "read", [[1, 100, 0], [3, 150, 1], [2, 200, 0]]),
+        ])
+        v = monotonic_wl.checker().check({}, h, {})
+        assert v["valid?"] is False, v
+        assert v["errors"]
+        assert v["oracle-agrees"] is True
+
+    def test_monotonic_clean_agrees_valid(self):
+        h = hist([
+            invoke_op(0, "read", None),
+            ok_op(0, "read", [[1, 100, 0], [2, 200, 1], [3, 300, 0]]),
+        ])
+        v = monotonic_wl.checker().check({}, h, {})
+        assert v["valid?"] is True, v
+        assert v["count"] == 3
+        assert v["oracle-agrees"] is True
+
+
+# ---------------------------------------------------------------------------
+# checker/elle.py integration: weakest-violated over the full lattice
+# ---------------------------------------------------------------------------
+
+class TestElleCheckerLattice:
+    def test_weakest_violated_delegates_to_lattice(self):
+        from jepsen_tpu.checker import elle as elle_ck
+        assert elle_ck.weakest_violated({"PRAM": []}) == "PRAM"
+        assert elle_ck.weakest_violated({"G1c": [], "causal": []}) \
+            == "causal"
+        assert elle_ck.weakest_violated({"G-single": []}) \
+            == "snapshot-isolation"
+
+    def test_violated_levels_stay_isolation_only(self):
+        from jepsen_tpu.checker import elle as elle_ck
+        levels = elle_ck.violated_levels({"PRAM": [], "G1c": []})
+        assert "read-committed" in levels
+        assert "PRAM" not in levels

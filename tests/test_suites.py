@@ -97,6 +97,46 @@ class TestEtcdSuite:
         assert any("iptables" in c and "DROP" in c for _, c in cmds)
         assert any("iptables -F" in c for _, c in cmds)
 
+    def run_lattice_suite(self, workload, client_cls, key,
+                          time_limit=2):
+        """ISSUE 20: the --workload registry's lattice pair end to
+        end over the in-memory cluster."""
+        mem = MemEtcd()
+        control.set_dummy_handler(lambda n, c, s: "/tmp/jepsen.X"
+                                  if "mktemp -d" in c else "")
+        try:
+            test = etcd.test_for({
+                "nodes": ["n1", "n2", "n3"],
+                "concurrency": 3,
+                "time-limit": time_limit,
+                "workload": workload,
+                "ssh": {"dummy": True},
+            })
+            test["client"] = client_cls(http_factory=mem.client)
+            result = core.run(test)
+        finally:
+            control.set_dummy_handler(None)
+        res = result["results"]
+        assert res[key]["valid?"] is True, res[key]
+        assert res["valid?"] is True
+        return result
+
+    def test_causal_workload_end_to_end(self):
+        self.run_lattice_suite("causal", etcd.EtcdCausalClient,
+                               "causal")
+
+    def test_predicate_workload_end_to_end(self):
+        result = self.run_lattice_suite(
+            "predicate", etcd.EtcdPredicateClient, "predicate")
+        lat = result["results"]["predicate"]
+        assert lat["workload"] == "rw-register"
+        assert lat["engine"].startswith("lattice-")
+
+    def test_workload_registry_dispatch(self):
+        assert set(etcd.tests) == {"register", "causal", "predicate"}
+        with pytest.raises(ValueError):
+            etcd.test_for({"workload": "nope"})
+
     def test_client_error_taxonomy(self):
         class Timeouty:
             def get(self, key):

@@ -977,3 +977,19 @@ class TestDiskNemesisPlumbing:
 
         with pytest.raises(ValueError):
             kvd.kvd_test({"nemesis": ["nope"]})
+
+    def test_kvd_workload_registry(self):
+        """ISSUE 20: the --workload registry dispatches the lattice
+        pair; each builder yields a runnable test map with its own
+        client/checker/generator."""
+        from jepsen_tpu.suites import kvd
+
+        assert set(kvd.tests) == {"register", "causal", "predicate"}
+        t = kvd.test_for({"workload": "causal"})
+        assert isinstance(t["client"], kvd.KvdCausalClient)
+        assert t["name"] == "kvd causal"
+        t = kvd.test_for({"workload": "predicate"})
+        assert isinstance(t["client"], kvd.KvdPredicateClient)
+        assert t["generator"] is not None and t["checker"] is not None
+        with pytest.raises(ValueError):
+            kvd.test_for({"workload": "nope"})

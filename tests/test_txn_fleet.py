@@ -268,3 +268,48 @@ class TestTxnFleetCampaign:
         assert set(cov["nemeses"]) == {"kill-worker", "pause-worker",
                                        "tear-checkpoint"}
         assert cov["cells"]
+
+    def test_lattice_plants_fill_matrix_cells(self, tmp_path):
+        """ISSUE 20: the seeded lattice smoke — plants drawn ONLY
+        from the session/causal/long-fork rungs must flag with their
+        lattice levels, landing `level:PRAM` / `level:causal` / ...
+        coverage cells that the Adya-only plant set never reached."""
+        from jepsen_tpu import campaign as campaign_mod
+
+        lattice_levels = {"monotonic-writes", "read-your-writes",
+                          "PRAM", "causal",
+                          "parallel-snapshot-isolation"}
+
+        class LatticeFleetTarget(campaign_mod.TxnFleetTarget):
+            name = "txn-fleet-lattice"
+            PLANTS = tuple(
+                p for p in campaign_mod.TxnFleetTarget.PLANTS
+                if p[2] in lattice_levels)
+
+        target = LatticeFleetTarget(
+            workers=2, tenants=1, lease_ttl=0.4, txns_per_tenant=30)
+        assert len(target.PLANTS) == 5
+        c = campaign_mod.Campaign(
+            "txn-fleet-lattice-smoke", target, seed=11, schedules=3,
+            bootstrap=3, k_dry=8, mutants_per_novel=0,
+            base_time_limit=2.0)
+        out = c.run()
+        assert out["run"] == 3
+        assert out["quarantined"] == 0
+        led = store.campaigns_root() / "txn-fleet-lattice-smoke" \
+            / "ledger.jsonl"
+        results = [r["ev"] for r in
+                   follow_frames(led, key="ev").records
+                   if r["ev"]["type"] == "result"]
+        assert len(results) == 3
+        seen_levels = set()
+        for r in results:
+            assert r["verdict"] is True, r
+            assert "flag-lost" not in r["anomalies"], r
+            assert "level-wrong" not in r["anomalies"], r
+            got = {a.split(":", 1)[1] for a in r["anomalies"]
+                   if a.startswith("level:")}
+            assert got and got <= lattice_levels, r
+            seen_levels |= got
+        # three seeded schedules must cover >1 distinct lattice rung
+        assert len(seen_levels) >= 2, seen_levels

@@ -72,6 +72,23 @@ def test_detection_lag_within_band():
         f"committed band {row['max']:.1f}s ({BASELINE})")
 
 
+def test_lattice_detection_lag_within_band():
+    """ISSUE 20: commit -> durable lattice-class flag (the session /
+    causal / long-fork rungs the Adya tier cannot name).  The lattice
+    pass rides every advance window, so its lag band tracks the Adya
+    flag path plus one host classification."""
+    row = _rows().get("live_lattice_detect_lag_s")
+    if row is None:
+        pytest.skip("no live_lattice_detect_lag_s row in the baseline")
+    lag = _gauge("live_lattice_detect_lag_seconds")
+    if lag is None:
+        pytest.skip("no txn tenant lattice-flagged an anomaly this "
+                    "session (partial run?)")
+    assert lag <= row["max"], (
+        f"txn commit->lattice-flag detection lag {lag:.3f}s exceeds "
+        f"the committed band {row['max']:.1f}s ({BASELINE})")
+
+
 def test_trace_segment_within_band():
     """ISSUE 19: the widest detection-lag segment any trace-flag
     observed this session.  A segment can never outgrow the lag it
